@@ -1,0 +1,262 @@
+"""pathway_tpu — a TPU-native incremental stream/batch data-processing
+framework with a live LLM/RAG toolkit.
+
+A ground-up rebuild of the capabilities of the reference Pathway framework
+(Python + Rust/timely-differential, /root/reference) designed TPU-first:
+
+* host plane: a lean micro-batch incremental dataflow engine
+  (``internals/engine.py``) keeping the reference's semantics — keyed diff
+  streams, per-timestamp consistency, as-of-now serving joins;
+* device plane: JAX/XLA/Pallas — jit-compiled embedders/rerankers
+  (``models/``), HBM-resident vector indexes with Pallas top-k kernels
+  (``ops/``), multi-chip sharding via ``jax.sharding`` meshes
+  (``parallel/``).
+
+Import as ``import pathway_tpu as pw`` — the public surface mirrors
+``import pathway as pw`` (reference: python/pathway/__init__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .internals import dtype as dt
+from .internals.value import (
+    Json,
+    Pointer,
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+    ERROR,
+    PENDING,
+)
+from .internals.keys import ref_scalar, unsafe_make_pointer
+from .internals.schema import (
+    Schema,
+    column_definition,
+    schema_from_types,
+    schema_from_dict,
+    schema_from_pandas,
+    schema_builder,
+)
+from .internals.expression import (
+    ApplyExpression,
+    AsyncApplyExpression,
+    CastExpression,
+    CoalesceExpression,
+    ColumnExpression,
+    ColumnReference,
+    DeclareTypeExpression,
+    FillErrorExpression,
+    IfElseExpression,
+    MakeTupleExpression,
+    RequireExpression,
+    UnwrapExpression,
+    smart_wrap,
+)
+from .internals.thisclass import this, left, right
+from .internals.table import Table, TableLike, groupby
+from .internals.groupbys import GroupedTable
+from .internals.joins import JoinMode, JoinResult
+from .internals import reducers
+from .internals import udfs
+from .internals.udfs import UDF, udf
+from .internals.run import run, run_all, MonitoringLevel
+from .internals.graph import G as global_graph
+from .internals.iterate import iterate, iterate_universe
+
+__version__ = "0.1.0"
+
+Type = dt  # pw.Type-ish access to dtypes
+
+
+# ---------------------------------------------------------------------------
+# free functions (reference: python/pathway/__init__.py exports)
+# ---------------------------------------------------------------------------
+
+
+def apply(fun, *args, **kwargs) -> ColumnExpression:
+    """Row-wise application, result type inferred from annotations
+    (reference: internals/common.py apply)."""
+    import inspect
+
+    try:
+        hints = inspect.get_annotations(fun, eval_str=True)
+    except Exception:
+        hints = getattr(fun, "__annotations__", {})
+    return_type = hints.get("return", Any)
+    return ApplyExpression(fun, return_type, *args, **kwargs)
+
+
+def apply_with_type(fun, ret_type, *args, **kwargs) -> ColumnExpression:
+    return ApplyExpression(fun, ret_type, *args, **kwargs)
+
+
+def apply_async(fun, *args, **kwargs) -> ColumnExpression:
+    import inspect
+
+    from .internals.udfs import coerce_async
+
+    try:
+        hints = inspect.get_annotations(fun, eval_str=True)
+    except Exception:
+        hints = getattr(fun, "__annotations__", {})
+    return_type = hints.get("return", Any)
+    return AsyncApplyExpression(coerce_async(fun), return_type, *args, **kwargs)
+
+
+def cast(target_type, expr) -> ColumnExpression:
+    return CastExpression(target_type, smart_wrap(expr))
+
+
+def declare_type(target_type, expr) -> ColumnExpression:
+    return DeclareTypeExpression(target_type, smart_wrap(expr))
+
+
+def coalesce(*args) -> ColumnExpression:
+    return CoalesceExpression(*args)
+
+
+def require(val, *args) -> ColumnExpression:
+    return RequireExpression(val, *args)
+
+
+def if_else(if_clause, then_clause, else_clause) -> ColumnExpression:
+    return IfElseExpression(if_clause, then_clause, else_clause)
+
+
+def make_tuple(*args) -> ColumnExpression:
+    return MakeTupleExpression(*args)
+
+
+def unwrap(expr) -> ColumnExpression:
+    return UnwrapExpression(smart_wrap(expr))
+
+
+def fill_error(expr, replacement) -> ColumnExpression:
+    return FillErrorExpression(smart_wrap(expr), replacement)
+
+
+def assert_table_has_schema(
+    table: Table,
+    schema,
+    *,
+    allow_superset: bool = True,
+    ignore_primary_keys: bool = True,
+) -> None:
+    """reference: internals/asserts.py"""
+    from .internals.schema import is_subschema
+
+    if allow_superset:
+        ok = is_subschema(table.schema, schema)
+    else:
+        ok = is_subschema(table.schema, schema) and is_subschema(schema, table.schema)
+    if not ok:
+        raise AssertionError(
+            f"table schema {table.schema!r} does not match expected {schema!r}"
+        )
+
+
+class universes:
+    """reference: python/pathway/universes.py"""
+
+    @staticmethod
+    def promise_are_equal(*tables: Table) -> None:
+        for t in tables[1:]:
+            tables[0]._universe.promise_equal(t._universe)
+
+    @staticmethod
+    def promise_is_subset_of(t1: Table, t2: Table) -> None:
+        t1._universe.promise_subset_of(t2._universe)
+
+    @staticmethod
+    def promise_are_pairwise_disjoint(*tables: Table) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# lazy submodules
+# ---------------------------------------------------------------------------
+
+_LAZY_SUBMODULES = {
+    "io",
+    "debug",
+    "demo",
+    "stdlib",
+    "indexing",
+    "temporal",
+    "ml",
+    "graphs",
+    "stateful",
+    "statistical",
+    "ordered",
+    "utils",
+    "xpacks",
+    "persistence",
+    "ops",
+    "models",
+    "parallel",
+    "cli",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        if name in ("indexing", "temporal", "ml", "graphs", "stateful", "statistical", "ordered", "utils"):
+            mod = importlib.import_module(f".stdlib.{name}", __name__)
+        else:
+            mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "Table",
+    "TableLike",
+    "Schema",
+    "Json",
+    "Pointer",
+    "DateTimeNaive",
+    "DateTimeUtc",
+    "Duration",
+    "ColumnExpression",
+    "ColumnReference",
+    "GroupedTable",
+    "JoinMode",
+    "JoinResult",
+    "MonitoringLevel",
+    "UDF",
+    "udf",
+    "udfs",
+    "reducers",
+    "this",
+    "left",
+    "right",
+    "apply",
+    "apply_with_type",
+    "apply_async",
+    "cast",
+    "declare_type",
+    "coalesce",
+    "require",
+    "if_else",
+    "make_tuple",
+    "unwrap",
+    "fill_error",
+    "iterate",
+    "iterate_universe",
+    "run",
+    "run_all",
+    "groupby",
+    "column_definition",
+    "schema_from_types",
+    "schema_from_dict",
+    "schema_from_pandas",
+    "schema_builder",
+    "assert_table_has_schema",
+    "universes",
+    "unsafe_make_pointer",
+]

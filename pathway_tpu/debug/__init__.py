@@ -1,0 +1,393 @@
+"""Debug helpers: build tables from literals, run and inspect.
+
+reference: python/pathway/debug/__init__.py (table_from_markdown:431,
+compute_and_print:207, table_from_pandas, compute_and_print_update_stream:235)
+and python/pathway/tests/utils.py assert_table_equality:544-556.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable
+
+import pandas as pd
+
+from ..internals import dtype as dt
+from ..internals.engine import OutputNode, freeze_row
+from ..internals.graph import Operator
+from ..internals.keys import ref_scalar, unsafe_make_pointer
+from ..internals.runtime import GraphRunner
+from ..internals.schema import (
+    ColumnSchema,
+    SchemaMetaclass,
+    _schema_from_columns,
+    schema_from_pandas,
+)
+from ..internals.table import Table
+from ..internals.universe import Universe
+from ..internals.value import Json, Pointer
+
+__all__ = [
+    "table_from_markdown",
+    "table_from_pandas",
+    "table_from_rows",
+    "table_to_pandas",
+    "table_to_dicts",
+    "compute_and_print",
+    "compute_and_print_update_stream",
+    "materialize",
+    "assert_table_equality",
+    "assert_table_equality_wo_index",
+    "assert_table_equality_wo_types",
+    "assert_table_equality_wo_index_wo_types",
+    "parse_to_table",
+]
+
+_SPECIAL_COLS = ("__time__", "__diff__")
+
+# Auto-generated row keys are salted per table so two literal tables never
+# collide in a concat (explicit ids stay cross-table comparable on purpose —
+# assert_table_equality relies on that, like the reference's debug tables).
+import itertools as _itertools
+
+_table_salt = _itertools.count()
+
+
+def _parse_value(raw: str) -> Any:
+    raw = raw.strip()
+    if raw in ("", "None"):
+        return None
+    if raw == "True":
+        return True
+    if raw == "False":
+        return False
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in "'\"":
+        return raw[1:-1]
+    return raw
+
+
+def table_from_markdown(
+    txt: str,
+    *,
+    id_from: list[str] | None = None,
+    schema: SchemaMetaclass | None = None,
+    _stream: bool = False,
+) -> Table:
+    """Parse a markdown-style table (reference: debug/__init__.py:431).
+
+    The optional first unnamed column carries explicit row ids; special
+    columns ``__time__``/``__diff__`` build update streams."""
+    lines = [l for l in txt.splitlines() if l.strip() and not set(l.strip()) <= {"-", "|", " "}]
+    header = lines[0]
+    sep = "|"
+    header_cells = [c.strip() for c in header.split(sep)]
+    has_leading_id = header_cells[0] == ""
+    names = [c for c in header_cells if c != ""]
+
+    rows = []
+    for line in lines[1:]:
+        cells = [c.strip() for c in line.split(sep)]
+        if has_leading_id:
+            rid = cells[0]
+            vals = cells[1 : 1 + len(names)]
+        else:
+            rid = None
+            vals = cells[: len(names)]
+        rows.append((rid, [_parse_value(v) for v in vals]))
+
+    data_names = [n for n in names if n not in _SPECIAL_COLS]
+    time_idx = names.index("__time__") if "__time__" in names else None
+    diff_idx = names.index("__diff__") if "__diff__" in names else None
+    data_idx = [i for i, n in enumerate(names) if n not in _SPECIAL_COLS]
+
+    # dtype inference per column
+    if schema is not None:
+        out_schema = schema
+    else:
+        columns = {}
+        for i, n in zip(data_idx, data_names):
+            col_vals = [r[1][i] for r in rows]
+            columns[n] = ColumnSchema(name=n, dtype=_infer_dtype(col_vals))
+        out_schema = _schema_from_columns(columns)
+
+    salt = next(_table_salt)
+    entries = []  # (time, key, values, diff)
+    for rownum, (rid, vals) in enumerate(rows):
+        key = (
+            unsafe_make_pointer(int(rid))
+            if rid is not None
+            else ref_scalar("__autogen__", salt, rownum)
+        )
+        if id_from is not None:
+            key = ref_scalar(*[vals[names.index(c)] for c in id_from])
+        t = vals[time_idx] if time_idx is not None else 0
+        d = vals[diff_idx] if diff_idx is not None else 1
+        values = tuple(_coerce(vals[i], out_schema[n].dtype) for i, n in zip(data_idx, data_names))
+        entries.append((t, key, values, d))
+
+    if time_idx is None:
+        op = Operator(
+            "input",
+            [],
+            params=dict(rows=[(k, v) for _, k, v, _ in entries], schema=out_schema),
+        )
+    else:
+        op = Operator(
+            "input",
+            [],
+            params=dict(rows=None, stream=entries, schema=out_schema),
+        )
+    return Table._new(op, out_schema, Universe())
+
+
+parse_to_table = table_from_markdown
+
+
+def _infer_dtype(vals: list) -> dt.DType:
+    non_null = [v for v in vals if v is not None]
+    types = {type(v) for v in non_null}
+    if not non_null:
+        return dt.ANY
+    if types == {bool}:
+        base = dt.BOOL
+    elif types == {int}:
+        base = dt.INT
+    elif types <= {int, float}:
+        base = dt.FLOAT
+    elif types == {str}:
+        base = dt.STR
+    else:
+        base = dt.ANY
+    if len(non_null) != len(vals) and base is not dt.ANY:
+        return dt.Optional(base)
+    return base
+
+
+def _coerce(v, dtype: dt.DType):
+    if v is None:
+        return None
+    base = dt.unoptionalize(dtype)
+    if base is dt.FLOAT and isinstance(v, int):
+        return float(v)
+    return v
+
+
+def table_from_pandas(
+    df: pd.DataFrame,
+    *,
+    id_from: list[str] | None = None,
+    schema: SchemaMetaclass | None = None,
+) -> Table:
+    if schema is None:
+        schema = schema_from_pandas(df, id_from=id_from)
+    names = schema.column_names()
+    rows = []
+    for pos, (idx, row) in enumerate(df.iterrows()):
+        if id_from is not None:
+            key = ref_scalar(*[row[c] for c in id_from])
+        elif isinstance(idx, int):
+            key = unsafe_make_pointer(idx)
+        else:
+            key = ref_scalar(idx)
+        values = tuple(_pd_value(row[n], schema[n].dtype) for n in names)
+        rows.append((key, values))
+    op = Operator("input", [], params=dict(rows=rows, schema=schema))
+    return Table._new(op, schema, Universe())
+
+
+def _pd_value(v, dtype):
+    import numpy as np
+
+    if v is None or (isinstance(v, float) and pd.isna(v)):
+        return None
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    if isinstance(v, np.str_):
+        return str(v)
+    return _coerce(v, dtype)
+
+
+def table_from_rows(
+    schema: SchemaMetaclass,
+    rows: list[tuple],
+    unsafe_trusted_ids: bool = False,
+    is_stream: bool = False,
+) -> Table:
+    """reference: debug/__init__.py table_from_rows; first element of each
+    tuple may be the id when the schema has no primary key."""
+    names = schema.column_names()
+    pk = schema.primary_key_columns()
+    salt = next(_table_salt)
+    entries = []
+    data_rows = []
+    for rownum, r in enumerate(rows):
+        if is_stream:
+            *vals, t, d = r
+        else:
+            vals, t, d = list(r), 0, 1
+        if pk:
+            key = ref_scalar(*[vals[names.index(c)] for c in pk])
+        else:
+            key = ref_scalar("__autogen__", salt, rownum)
+        entries.append((t, key, tuple(vals), d))
+        data_rows.append((key, tuple(vals)))
+    if is_stream:
+        op = Operator("input", [], params=dict(rows=None, stream=entries, schema=schema))
+    else:
+        op = Operator("input", [], params=dict(rows=data_rows, schema=schema))
+    return Table._new(op, schema, Universe())
+
+
+# ---------------------------------------------------------------------------
+# running / materializing
+# ---------------------------------------------------------------------------
+
+
+def materialize(*tables: Table) -> list[OutputNode]:
+    """Run the graph in batch mode and return OutputNodes per table."""
+    outs = [OutputNode(name=f"debug_out") for _ in tables]
+    runner = GraphRunner()
+    engine = runner.build(list(zip(tables, outs)))
+    _drive(engine, runner)
+    return outs
+
+
+def _drive(engine, runner):
+    """Run to completion, handling both static and stream inputs."""
+    # stream inputs were queued with their own times by the lowering
+    engine.run_all()
+
+
+def table_to_pandas(table: Table, include_id: bool = True) -> pd.DataFrame:
+    (out,) = materialize(table)
+    names = table.column_names()
+    data = {n: [] for n in names}
+    ids = []
+    for key, row in sorted(out.current.items(), key=lambda kv: kv[0]):
+        ids.append(key)
+        for n, v in zip(names, row):
+            data[n].append(v)
+    df = pd.DataFrame(data, columns=list(names))
+    if include_id:
+        df.index = ids
+    return df
+
+
+def table_to_dicts(table: Table):
+    (out,) = materialize(table)
+    names = table.column_names()
+    ids = list(out.current.keys())
+    columns = {
+        n: {k: row[i] for k, row in out.current.items()} for i, n in enumerate(names)
+    }
+    return ids, columns
+
+
+def compute_and_print(
+    table: Table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    n_rows: int | None = None,
+    **kwargs,
+) -> None:
+    """reference: debug/__init__.py:207"""
+    (out,) = materialize(table)
+    names = table.column_names()
+    rows = sorted(out.current.items(), key=lambda kv: kv[0])
+    if n_rows is not None:
+        rows = rows[:n_rows]
+    header = (["id"] if include_id else []) + list(names)
+    print(" | ".join(header))
+    for key, row in rows:
+        cells = []
+        if include_id:
+            cells.append(_fmt(key, short_pointers))
+        cells.extend(_fmt(v, short_pointers) for v in row)
+        print(" | ".join(cells))
+
+
+def compute_and_print_update_stream(
+    table: Table, *, include_id: bool = True, short_pointers: bool = True, **kwargs
+) -> None:
+    """reference: debug/__init__.py:235"""
+    (out,) = materialize(table)
+    names = table.column_names()
+    header = (["id"] if include_id else []) + list(names) + ["__time__", "__diff__"]
+    print(" | ".join(header))
+    for key, row, time, diff in out.history:
+        cells = []
+        if include_id:
+            cells.append(_fmt(key, short_pointers))
+        cells.extend(_fmt(v, short_pointers) for v in row)
+        cells.append(str(time))
+        cells.append(str(diff))
+        print(" | ".join(cells))
+
+
+def _fmt(v, short_pointers: bool) -> str:
+    if isinstance(v, Pointer) and short_pointers:
+        return f"^{v.value % 0xFFFFF:05X}..."
+    return repr(v) if isinstance(v, str) else str(v)
+
+
+# ---------------------------------------------------------------------------
+# equality asserts (reference: python/pathway/tests/utils.py:544-580)
+# ---------------------------------------------------------------------------
+
+
+def _snapshot(table: Table, out: OutputNode):
+    return {key: freeze_row(row) for key, row in out.current.items()}
+
+
+def _assert_equality(t1: Table, t2: Table, wo_index: bool, wo_types: bool):
+    if not wo_types:
+        d1 = {n: c for n, c in t1.schema.dtypes().items()}
+        d2 = {n: c for n, c in t2.schema.dtypes().items()}
+        assert list(d1.keys()) == list(d2.keys()), f"column sets differ: {list(d1)} vs {list(d2)}"
+        for n in d1:
+            assert _dtype_compatible(d1[n], d2[n]), (
+                f"column {n!r} dtypes differ: {d1[n]!r} vs {d2[n]!r}"
+            )
+    else:
+        assert list(t1.column_names()) == list(t2.column_names())
+    out1, out2 = materialize(t1, t2)
+    s1, s2 = _snapshot(t1, out1), _snapshot(t2, out2)
+    if wo_index:
+        m1 = sorted(s1.values(), key=repr)
+        m2 = sorted(s2.values(), key=repr)
+        assert m1 == m2, f"tables differ (ignoring ids):\n{m1}\nvs\n{m2}"
+    else:
+        assert s1 == s2, f"tables differ:\n{s1}\nvs\n{s2}"
+
+
+def _dtype_compatible(a: dt.DType, b: dt.DType) -> bool:
+    return a == b or a is dt.ANY or b is dt.ANY
+
+
+def assert_table_equality(t1: Table, t2: Table) -> None:
+    _assert_equality(t1, t2, wo_index=False, wo_types=False)
+
+
+def assert_table_equality_wo_index(t1: Table, t2: Table) -> None:
+    _assert_equality(t1, t2, wo_index=True, wo_types=False)
+
+
+def assert_table_equality_wo_types(t1: Table, t2: Table) -> None:
+    _assert_equality(t1, t2, wo_index=False, wo_types=True)
+
+
+def assert_table_equality_wo_index_wo_types(t1: Table, t2: Table) -> None:
+    _assert_equality(t1, t2, wo_index=True, wo_types=True)

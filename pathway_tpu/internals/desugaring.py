@@ -1,0 +1,113 @@
+"""Desugaring pass: resolve ``pw.this``/``pw.left``/``pw.right`` and column
+name targets in select/filter/reduce argument lists.
+
+reference: python/pathway/internals/desugaring.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, TYPE_CHECKING
+
+from .expression import (
+    ColumnExpression,
+    ColumnReference,
+    PointerExpression,
+    smart_wrap,
+)
+from .thisclass import ThisColumnReference, ThisWithout, this as this_sentinel, left as left_sentinel, right as right_sentinel
+
+if TYPE_CHECKING:
+    from .table import Table
+
+__all__ = ["resolve_expression", "expand_select_args"]
+
+
+def resolve_expression(
+    e: Any,
+    this_table: "Table",
+    left_table: "Table | None" = None,
+    right_table: "Table | None" = None,
+) -> ColumnExpression:
+    """Substitute sentinel references with real table references."""
+    e = smart_wrap(e)
+
+    def mapping(node: ColumnExpression) -> ColumnExpression | None:
+        if isinstance(node, ThisColumnReference):
+            kind = node.sentinel.kind
+            if kind == "this":
+                target = this_table
+            elif kind == "left":
+                target = left_table or this_table
+            else:
+                target = right_table
+            if target is None:
+                raise ValueError(f"pw.{kind} used outside of a join context")
+            if node.name == "id":
+                return target.id
+            return target[node.name]
+        if isinstance(node, PointerExpression) and node._table is None:
+            resolved = PointerExpression(
+                this_table,
+                *[a._substitute(mapping) for a in node.args],
+                instance=node.instance._substitute(mapping) if node.instance is not None else None,
+                optional=node.optional,
+            )
+            return resolved
+        return None
+
+    return e._substitute(mapping)
+
+
+def expand_select_args(
+    args: Iterable[Any],
+    kwargs: dict[str, Any],
+    this_table: "Table",
+    left_table: "Table | None" = None,
+    right_table: "Table | None" = None,
+) -> dict[str, ColumnExpression]:
+    """Positional args must be column references (or ``*pw.this`` /
+    ``pw.this.without(...)`` markers); kwargs are named expressions
+    (reference: table.py Table.select docstring)."""
+    out: dict[str, ColumnExpression] = {}
+
+    def add_all_from(table: "Table", exclude: tuple[str, ...]):
+        for name in table.column_names():
+            if name not in exclude:
+                out[name] = table[name]
+
+    for a in args:
+        if isinstance(a, ThisWithout):
+            kind = a.sentinel.kind
+            table = {
+                "this": this_table,
+                "left": left_table or this_table,
+                "right": right_table,
+            }[kind]
+            if table is None:
+                raise ValueError(f"pw.{kind} used outside of join")
+            add_all_from(table, a.names)
+        elif a is this_sentinel or a is left_sentinel or a is right_sentinel:
+            kind = getattr(a, "kind")
+            table = {
+                "this": this_table,
+                "left": left_table or this_table,
+                "right": right_table,
+            }[kind]
+            add_all_from(table, ())
+        elif isinstance(a, ThisColumnReference):
+            resolved = resolve_expression(a, this_table, left_table, right_table)
+            assert isinstance(resolved, ColumnReference)
+            out[a.name] = resolved
+        elif isinstance(a, ColumnReference):
+            out[a.name] = a
+        elif isinstance(a, type) and hasattr(a, "__columns__"):
+            # a Schema: select all its columns from this table
+            for name in a.column_names():
+                out[name] = this_table[name]
+        else:
+            raise TypeError(
+                f"positional select arguments must be column references, got {a!r}"
+            )
+    for name, e in kwargs.items():
+        out[name] = resolve_expression(e, this_table, left_table, right_table)
+    return out

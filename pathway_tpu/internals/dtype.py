@@ -1,0 +1,415 @@
+"""Dtype lattice for schema columns.
+
+reference: python/pathway/internals/dtype.py (979 LoC) — this is a leaner
+re-design keeping the parts the API surface needs: scalar singletons,
+Optional/Tuple/List/Array/Json/Pointer/Callable/Future composites, python
+type wrapping, lattice operations (``dtype_issubclass``, ``types_lcm``).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Callable as TCallable, Optional as TOptional, Union, get_args, get_origin
+
+import numpy as np
+
+from . import value as _v
+
+__all__ = [
+    "DType",
+    "ANY",
+    "NONE",
+    "INT",
+    "FLOAT",
+    "BOOL",
+    "STR",
+    "BYTES",
+    "POINTER",
+    "JSON",
+    "DATE_TIME_NAIVE",
+    "DATE_TIME_UTC",
+    "DURATION",
+    "ANY_ARRAY",
+    "INT_ARRAY",
+    "FLOAT_ARRAY",
+    "Optional",
+    "Tuple",
+    "List",
+    "Array",
+    "Callable",
+    "Future",
+    "Pointer",
+    "wrap",
+    "unoptionalize",
+    "dtype_issubclass",
+    "types_lcm",
+    "coerce_arithmetic",
+]
+
+
+class DType:
+    """Base class; scalar dtypes are singletons."""
+
+    name: str = "DType"
+
+    def __repr__(self) -> str:
+        return self.name
+
+    def is_value_compatible(self, value: Any) -> bool:
+        return True
+
+    @property
+    def typehint(self) -> Any:
+        return Any
+
+    def __eq__(self, other: Any) -> bool:
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self) -> int:
+        return hash(type(self).__name__)
+
+
+class _Simple(DType):
+    def __init__(self, name: str, pytypes: tuple, typehint: Any):
+        self.name = name
+        self._pytypes = pytypes
+        self._typehint = typehint
+
+    def is_value_compatible(self, value: Any) -> bool:
+        if self is FLOAT and isinstance(value, int) and not isinstance(value, bool):
+            return True
+        if isinstance(value, bool) and self is not BOOL and self is not ANY:
+            return False
+        if self is ANY:
+            return True
+        return isinstance(value, self._pytypes)
+
+    @property
+    def typehint(self) -> Any:
+        return self._typehint
+
+    def __eq__(self, other: Any) -> bool:
+        return self is other
+
+    def __hash__(self) -> int:
+        return hash(self.name)
+
+
+ANY = _Simple("ANY", (object,), Any)
+NONE = _Simple("NONE", (type(None),), type(None))
+INT = _Simple("INT", (int, np.integer), int)
+FLOAT = _Simple("FLOAT", (float, np.floating), float)
+BOOL = _Simple("BOOL", (bool, np.bool_), bool)
+STR = _Simple("STR", (str,), str)
+BYTES = _Simple("BYTES", (bytes,), bytes)
+JSON = _Simple("JSON", (_v.Json,), _v.Json)
+DATE_TIME_NAIVE = _Simple("DATE_TIME_NAIVE", (_v.DateTimeNaive,), _v.DateTimeNaive)
+DATE_TIME_UTC = _Simple("DATE_TIME_UTC", (_v.DateTimeUtc,), _v.DateTimeUtc)
+DURATION = _Simple("DURATION", (_v.Duration,), _v.Duration)
+
+
+class Pointer(DType):
+    """Pointer dtype, optionally typed by target schema
+    (reference: dtype.py ``Pointer``)."""
+
+    def __init__(self, *args):
+        self.args = args
+        self.name = "POINTER"
+
+    def is_value_compatible(self, value: Any) -> bool:
+        return isinstance(value, _v.Pointer)
+
+    @property
+    def typehint(self):
+        return _v.Pointer
+
+    def __eq__(self, other):
+        return isinstance(other, Pointer)
+
+    def __hash__(self):
+        return hash("POINTER")
+
+    def __repr__(self):
+        return "POINTER"
+
+
+POINTER = Pointer()
+
+
+class Optional(DType):
+    def __new__(cls, wrapped: DType):
+        if isinstance(wrapped, Optional) or wrapped in (ANY, NONE):
+            return wrapped
+        self = object.__new__(cls)
+        self.wrapped = wrapped
+        self.name = f"Optional({wrapped!r})"
+        return self
+
+    def __init__(self, wrapped: DType):
+        pass
+
+    def is_value_compatible(self, value: Any) -> bool:
+        return value is None or self.wrapped.is_value_compatible(value)
+
+    @property
+    def typehint(self):
+        return TOptional[self.wrapped.typehint]
+
+    def __eq__(self, other):
+        return isinstance(other, Optional) and self.wrapped == other.wrapped
+
+    def __hash__(self):
+        return hash(("Optional", self.wrapped))
+
+
+class Tuple(DType):
+    def __init__(self, *args: DType):
+        self.args = tuple(args)
+        self.name = f"Tuple{self.args!r}"
+
+    def is_value_compatible(self, value: Any) -> bool:
+        return isinstance(value, tuple) and len(value) == len(self.args) and all(
+            a.is_value_compatible(v) for a, v in zip(self.args, value)
+        )
+
+    @property
+    def typehint(self):
+        return tuple
+
+    def __eq__(self, other):
+        return isinstance(other, Tuple) and self.args == other.args
+
+    def __hash__(self):
+        return hash(("Tuple", self.args))
+
+
+class List(DType):
+    def __init__(self, wrapped: DType):
+        self.wrapped = wrapped
+        self.name = f"List({wrapped!r})"
+
+    def is_value_compatible(self, value: Any) -> bool:
+        return isinstance(value, tuple) and all(
+            self.wrapped.is_value_compatible(v) for v in value
+        )
+
+    @property
+    def typehint(self):
+        return tuple
+
+    def __eq__(self, other):
+        return isinstance(other, List) and self.wrapped == other.wrapped
+
+    def __hash__(self):
+        return hash(("List", self.wrapped))
+
+
+class Array(DType):
+    """ndarray dtype (reference: dtype.py ``Array``/``ANY_ARRAY``;
+    engine IntArray/FloatArray value.rs:207)."""
+
+    def __init__(self, n_dim: int | None = None, wrapped: DType = ANY):
+        self.n_dim = n_dim
+        self.wrapped = wrapped
+        self.name = f"Array({n_dim}, {wrapped!r})"
+
+    def is_value_compatible(self, value: Any) -> bool:
+        if not isinstance(value, np.ndarray):
+            return False
+        if self.n_dim is not None and value.ndim != self.n_dim:
+            return False
+        return True
+
+    @property
+    def typehint(self):
+        return np.ndarray
+
+    def __eq__(self, other):
+        return isinstance(other, Array) and (self.n_dim, self.wrapped) == (
+            other.n_dim,
+            other.wrapped,
+        )
+
+    def __hash__(self):
+        return hash(("Array", self.n_dim, self.wrapped))
+
+
+ANY_ARRAY = Array()
+INT_ARRAY = Array(wrapped=INT)
+FLOAT_ARRAY = Array(wrapped=FLOAT)
+
+
+class Callable(DType):
+    def __init__(self, arg_types=..., return_type: DType = ANY):
+        self.arg_types = arg_types
+        self.return_type = return_type
+        self.name = f"Callable(..., {return_type!r})"
+
+    def is_value_compatible(self, value: Any) -> bool:
+        return callable(value)
+
+    def __eq__(self, other):
+        return isinstance(other, Callable) and self.return_type == other.return_type
+
+    def __hash__(self):
+        return hash(("Callable", self.return_type))
+
+
+class Future(DType):
+    """Column whose values may still be PENDING
+    (reference: dtype.py ``Future``, used by fully-async UDFs)."""
+
+    def __new__(cls, wrapped: DType):
+        if isinstance(wrapped, Future):
+            return wrapped
+        self = object.__new__(cls)
+        self.wrapped = wrapped
+        self.name = f"Future({wrapped!r})"
+        return self
+
+    def __init__(self, wrapped: DType):
+        pass
+
+    def is_value_compatible(self, value: Any) -> bool:
+        return value is _v.PENDING or self.wrapped.is_value_compatible(value)
+
+    def __eq__(self, other):
+        return isinstance(other, Future) and self.wrapped == other.wrapped
+
+    def __hash__(self):
+        return hash(("Future", self.wrapped))
+
+
+_SIMPLE_FROM_PY: dict[Any, DType] = {
+    int: INT,
+    float: FLOAT,
+    bool: BOOL,
+    str: STR,
+    bytes: BYTES,
+    type(None): NONE,
+    Any: ANY,
+    _v.Json: JSON,
+    _v.Pointer: POINTER,
+    _v.DateTimeNaive: DATE_TIME_NAIVE,
+    _v.DateTimeUtc: DATE_TIME_UTC,
+    _v.Duration: DURATION,
+    np.ndarray: ANY_ARRAY,
+    datetime.datetime: DATE_TIME_NAIVE,
+    datetime.timedelta: DURATION,
+    list: List(ANY),
+    tuple: Tuple(),
+    dict: JSON,
+}
+
+
+def wrap(t: Any) -> DType:
+    """Convert a python type annotation into a DType
+    (reference: dtype.py ``wrap``)."""
+    if isinstance(t, DType):
+        return t
+    if t is None:
+        return NONE
+    if t in _SIMPLE_FROM_PY:
+        return _SIMPLE_FROM_PY[t]
+    origin = get_origin(t)
+    if origin is Union:
+        args = get_args(t)
+        non_none = [a for a in args if a is not type(None)]
+        inner = types_lcm(*[wrap(a) for a in non_none]) if non_none else NONE
+        if type(None) in args:
+            return Optional(inner)
+        return inner
+    if origin in (tuple,):
+        args = get_args(t)
+        if len(args) == 2 and args[1] is Ellipsis:
+            return List(wrap(args[0]))
+        return Tuple(*[wrap(a) for a in args])
+    if origin in (list,):
+        args = get_args(t)
+        return List(wrap(args[0]) if args else ANY)
+    if origin is TCallable or t is TCallable:
+        return Callable()
+    if origin is np.ndarray:
+        args = get_args(t)
+        if len(args) == 2:
+            return Array(wrapped=wrap(get_args(args[1])[0]) if get_args(args[1]) else ANY)
+        return ANY_ARRAY
+    if isinstance(t, type) and issubclass(t, _v.Pointer):
+        return POINTER
+    return ANY
+
+
+def unoptionalize(dtype: DType) -> DType:
+    if isinstance(dtype, Optional):
+        return dtype.wrapped
+    return dtype
+
+
+def dtype_issubclass(sub: DType, sup: DType) -> bool:
+    """Lattice order (reference: dtype.py ``dtype_issubclass``)."""
+    if sup is ANY or sub == sup:
+        return True
+    if sub is ANY:
+        return False
+    if isinstance(sup, Optional):
+        if sub is NONE:
+            return True
+        return dtype_issubclass(unoptionalize(sub), sup.wrapped)
+    if isinstance(sub, Optional):
+        return False
+    if sub is INT and sup is FLOAT:
+        return True
+    if isinstance(sub, Tuple) and isinstance(sup, Tuple):
+        return len(sub.args) == len(sup.args) and all(
+            dtype_issubclass(a, b) for a, b in zip(sub.args, sup.args)
+        )
+    if isinstance(sub, Tuple) and isinstance(sup, List):
+        return all(dtype_issubclass(a, sup.wrapped) for a in sub.args)
+    if isinstance(sub, List) and isinstance(sup, List):
+        return dtype_issubclass(sub.wrapped, sup.wrapped)
+    if isinstance(sub, Array) and isinstance(sup, Array):
+        return sup.n_dim is None or sub.n_dim == sup.n_dim
+    if isinstance(sub, Pointer) and isinstance(sup, Pointer):
+        return True
+    return False
+
+
+def types_lcm(*dtypes: DType) -> DType:
+    """Least common supertype (reference: dtype.py ``types_lcm``)."""
+    if not dtypes:
+        return ANY
+    result = dtypes[0]
+    for d in dtypes[1:]:
+        result = _lcm2(result, d)
+    return result
+
+
+def _lcm2(a: DType, b: DType) -> DType:
+    if a == b:
+        return a
+    if dtype_issubclass(a, b):
+        return b
+    if dtype_issubclass(b, a):
+        return a
+    if a is NONE:
+        return Optional(b)
+    if b is NONE:
+        return Optional(a)
+    ua, ub = unoptionalize(a), unoptionalize(b)
+    opt = isinstance(a, Optional) or isinstance(b, Optional)
+    if ua == ub:
+        inner = ua
+    elif {ua, ub} == {INT, FLOAT}:
+        inner = FLOAT
+    else:
+        return ANY
+    return Optional(inner) if opt else inner
+
+
+def coerce_arithmetic(a: DType, b: DType) -> DType | None:
+    """Result dtype of +,-,* between numeric dtypes; None if invalid."""
+    if a is INT and b is INT:
+        return INT
+    if a in (INT, FLOAT) and b in (INT, FLOAT):
+        return FLOAT
+    return None

@@ -1,0 +1,337 @@
+"""Expression compiler: ColumnExpression tree -> Python closure.
+
+reference: python/pathway/internals/graph_runner/expression_evaluator.py:211
+(RowwiseEvaluator lowering the AST to engine expressions) + the row-wise
+interpreter src/engine/expression.rs.  Here the lowering target is a Python
+closure ``fn(ctx) -> value``; the caller supplies a resolver mapping
+ColumnReference nodes to accessors over its row context.
+
+Error semantics follow the reference (src/engine/error.rs): if any operand is
+``ERROR`` the result is ``ERROR``; exceptions raise unless the run was started
+with ``terminate_on_error=False`` in which case they produce ``ERROR`` rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from . import expression as expr_mod
+from .value import ERROR, Json, Pointer
+from .keys import ref_scalar
+from . import dtype as dt
+
+__all__ = ["compile_expression", "EvalContext"]
+
+
+class EvalContext:
+    """Runtime switches shared across compiled closures."""
+
+    terminate_on_error: bool = True
+
+    @classmethod
+    def handle(cls, exc: Exception):
+        if cls.terminate_on_error:
+            raise exc
+        return ERROR
+
+
+def compile_expression(
+    e: expr_mod.ColumnExpression,
+    resolve_ref: Callable[[expr_mod.ColumnReference], Callable[[Any], Any]],
+) -> Callable[[Any], Any]:
+    """Compile ``e`` into ``fn(ctx) -> value``."""
+
+    def rec(node: expr_mod.ColumnExpression) -> Callable[[Any], Any]:
+        return compile_expression(node, resolve_ref)
+
+    if isinstance(e, expr_mod.ColumnConstExpression):
+        v = e._value
+        return lambda ctx: v
+
+    if isinstance(e, expr_mod.ColumnReference):
+        return resolve_ref(e)
+
+    if isinstance(e, expr_mod.ColumnBinaryOpExpression):
+        lf, rf = rec(e.left), rec(e.right)
+        impl = expr_mod.binary_op_impl(e.op)
+        op = e.op
+
+        def run_binary(ctx):
+            a = lf(ctx)
+            if a is ERROR:
+                return ERROR
+            b = rf(ctx)
+            if b is ERROR:
+                return ERROR
+            if op == "==":
+                return a == b
+            if op == "!=":
+                return a != b
+            if a is None or b is None:
+                return None
+            try:
+                return impl(a, b)
+            except Exception as exc:
+                return EvalContext.handle(exc)
+
+        return run_binary
+
+    if isinstance(e, expr_mod.ColumnUnaryOpExpression):
+        f = rec(e.expr)
+        op = e.op
+
+        def run_unary(ctx):
+            v = f(ctx)
+            if v is ERROR:
+                return ERROR
+            if v is None:
+                return None
+            try:
+                if op == "-":
+                    return -v
+                if op == "~":
+                    return not v if isinstance(v, bool) else ~v
+                if op == "abs":
+                    return abs(v)
+            except Exception as exc:
+                return EvalContext.handle(exc)
+            raise ValueError(f"unknown unary op {op}")
+
+        return run_unary
+
+    if isinstance(e, (expr_mod.ApplyExpression,)):
+        # Async applies are handled at the operator level (AsyncMapNode);
+        # when reached here they run synchronously via the event loop.
+        arg_fns = [rec(a) for a in e.args]
+        kwarg_fns = {k: rec(v) for k, v in e.kwargs.items()}
+        fun = e.fun
+        propagate_none = e.propagate_none
+        is_async = isinstance(e, expr_mod.AsyncApplyExpression)
+
+        def run_apply(ctx):
+            args = [f(ctx) for f in arg_fns]
+            kwargs = {k: f(ctx) for k, f in kwarg_fns.items()}
+            if any(a is ERROR for a in args) or any(v is ERROR for v in kwargs.values()):
+                return ERROR
+            if propagate_none and (
+                any(a is None for a in args) or any(v is None for v in kwargs.values())
+            ):
+                return None
+            try:
+                if is_async:
+                    import asyncio
+
+                    return asyncio.run(fun(*args, **kwargs))
+                return fun(*args, **kwargs)
+            except Exception as exc:
+                return EvalContext.handle(exc)
+
+        return run_apply
+
+    if isinstance(e, expr_mod.CastExpression):
+        f = rec(e.expr)
+        target = e.return_type
+
+        def run_cast(ctx):
+            v = f(ctx)
+            if v is ERROR:
+                return ERROR
+            if v is None:
+                return None
+            try:
+                return _cast(v, target)
+            except Exception as exc:
+                return EvalContext.handle(exc)
+
+        return run_cast
+
+    if isinstance(e, expr_mod.ConvertExpression):
+        f = rec(e.expr)
+        target = e.return_type
+        unwrap = e.unwrap
+
+        def run_convert(ctx):
+            v = f(ctx)
+            if v is ERROR:
+                return ERROR
+            if v is None:
+                return None
+            if isinstance(v, Json):
+                res = {
+                    dt.INT: v.as_int,
+                    dt.FLOAT: v.as_float,
+                    dt.STR: v.as_str,
+                    dt.BOOL: v.as_bool,
+                }[target]()
+            else:
+                res = _cast(v, target)
+            if res is None and unwrap:
+                return EvalContext.handle(ValueError(f"cannot convert {v!r}"))
+            return res
+
+        return run_convert
+
+    if isinstance(e, expr_mod.DeclareTypeExpression):
+        return rec(e.expr)
+
+    if isinstance(e, expr_mod.CoalesceExpression):
+        fns = [rec(a) for a in e.args]
+
+        def run_coalesce(ctx):
+            for f in fns:
+                v = f(ctx)
+                if v is not None:
+                    return v
+            return None
+
+        return run_coalesce
+
+    if isinstance(e, expr_mod.RequireExpression):
+        vf = rec(e.val)
+        fns = [rec(a) for a in e.args]
+
+        def run_require(ctx):
+            for f in fns:
+                if f(ctx) is None:
+                    return None
+            return vf(ctx)
+
+        return run_require
+
+    if isinstance(e, expr_mod.IfElseExpression):
+        cf, tf, ef = rec(e.if_), rec(e.then), rec(e.else_)
+
+        def run_ifelse(ctx):
+            c = cf(ctx)
+            if c is ERROR:
+                return ERROR
+            return tf(ctx) if c else ef(ctx)
+
+        return run_ifelse
+
+    if isinstance(e, expr_mod.IsNotNoneExpression):
+        f = rec(e.expr)
+        return lambda ctx: f(ctx) is not None
+
+    if isinstance(e, expr_mod.IsNoneExpression):
+        f = rec(e.expr)
+        return lambda ctx: f(ctx) is None
+
+    if isinstance(e, expr_mod.MakeTupleExpression):
+        fns = [rec(a) for a in e.args]
+        return lambda ctx: tuple(f(ctx) for f in fns)
+
+    if isinstance(e, expr_mod.GetExpression):
+        of, idxf, df = rec(e.obj), rec(e.index), rec(e.default)
+        checked = e.check_if_exists
+
+        def run_get(ctx):
+            obj = of(ctx)
+            if obj is ERROR:
+                return ERROR
+            idx = idxf(ctx)
+            try:
+                if isinstance(obj, Json):
+                    inner = obj.value
+                    res = inner[idx]
+                    return Json(res)
+                return obj[idx]
+            except (KeyError, IndexError, TypeError) as exc:
+                if checked:
+                    return df(ctx)
+                return EvalContext.handle(exc)
+
+        return run_get
+
+    if isinstance(e, expr_mod.MethodCallExpression):
+        fns = [rec(a) for a in e.args]
+        fun = e.fun
+        propagate_none = e.propagate_none
+
+        def run_method(ctx):
+            args = [f(ctx) for f in fns]
+            if any(a is ERROR for a in args):
+                return ERROR
+            if propagate_none and args and args[0] is None:
+                return None
+            try:
+                return fun(*args)
+            except Exception as exc:
+                return EvalContext.handle(exc)
+
+        return run_method
+
+    if isinstance(e, expr_mod.UnwrapExpression):
+        f = rec(e.expr)
+
+        def run_unwrap(ctx):
+            v = f(ctx)
+            if v is None:
+                return EvalContext.handle(ValueError("unwrap() on None"))
+            return v
+
+        return run_unwrap
+
+    if isinstance(e, expr_mod.FillErrorExpression):
+        f, rf = rec(e.expr), rec(e.replacement)
+
+        def run_fill(ctx):
+            try:
+                v = f(ctx)
+            except Exception:
+                return rf(ctx)
+            if v is ERROR:
+                return rf(ctx)
+            return v
+
+        return run_fill
+
+    if isinstance(e, expr_mod.PointerExpression):
+        fns = [rec(a) for a in e.args]
+        inst_fn = rec(e.instance) if e.instance is not None else None
+        optional = e.optional
+
+        def run_pointer(ctx):
+            vals = [f(ctx) for f in fns]
+            if any(v is ERROR for v in vals):
+                return ERROR
+            if optional and any(v is None for v in vals):
+                return None
+            key = ref_scalar(*vals)
+            if inst_fn is not None:
+                inst_key = ref_scalar(inst_fn(ctx))
+                key = key.with_shard(inst_key.value >> (128 - Pointer.SHARD_BITS))
+            return key
+
+        return run_pointer
+
+    if isinstance(e, expr_mod.ReducerExpression):
+        raise TypeError(
+            "reducer expression used outside of reduce() context"
+        )
+
+    # unknown node kinds (internal slot references etc.) resolve like refs
+    try:
+        return resolve_ref(e)  # type: ignore[arg-type]
+    except Exception:
+        pass
+    raise TypeError(f"cannot compile expression of type {type(e).__name__}")
+
+
+def _cast(v: Any, target: dt.DType) -> Any:
+    target = dt.unoptionalize(target)
+    if target is dt.INT:
+        return int(v)
+    if target is dt.FLOAT:
+        return float(v)
+    if target is dt.BOOL:
+        return bool(v)
+    if target is dt.STR:
+        if isinstance(v, bool):
+            return "True" if v else "False"
+        return str(v)
+    if target is dt.BYTES:
+        return v.encode() if isinstance(v, str) else bytes(v)
+    if target is dt.JSON:
+        return v if isinstance(v, Json) else Json(v)
+    return v

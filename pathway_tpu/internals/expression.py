@@ -1,0 +1,888 @@
+"""Column expression AST.
+
+reference: python/pathway/internals/expression.py (ColumnReference:566,
+ColumnBinaryOpExpression:664, ReducerExpression:707, ApplyExpression:744,
+CastExpression:795, IfElseExpression:891, MakeTupleExpression:979) and the
+row-wise interpreter in src/engine/expression.rs:325.
+
+Design difference vs the reference: types are interpreted lazily (cached
+``_dtype``) so that ``pw.this``-based unbound expressions can be built before
+they are attached to a table; the desugaring pass substitutes references and
+then dtypes resolve.  Evaluation compiles each tree into a Python closure
+(``internals/evaluator.py``); numeric batch work escapes to JAX at the
+operator level (index/model ops), not per-expression.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Iterable, TYPE_CHECKING
+
+import numpy as np
+
+from . import dtype as dt
+from .value import ERROR, Json, Pointer
+
+if TYPE_CHECKING:
+    from .table import Table
+
+__all__ = [
+    "ColumnExpression",
+    "ColumnReference",
+    "ColumnConstExpression",
+    "ColumnBinaryOpExpression",
+    "ColumnUnaryOpExpression",
+    "ReducerExpression",
+    "ApplyExpression",
+    "AsyncApplyExpression",
+    "CastExpression",
+    "ConvertExpression",
+    "DeclareTypeExpression",
+    "CoalesceExpression",
+    "RequireExpression",
+    "IfElseExpression",
+    "IsNoneExpression",
+    "IsNotNoneExpression",
+    "MakeTupleExpression",
+    "GetExpression",
+    "MethodCallExpression",
+    "UnwrapExpression",
+    "FillErrorExpression",
+    "PointerExpression",
+    "IdExpression",
+    "smart_wrap",
+]
+
+
+def smart_wrap(value: Any) -> "ColumnExpression":
+    if isinstance(value, ColumnExpression):
+        return value
+    return ColumnConstExpression(value)
+
+
+class ColumnExpression:
+    """Base expression node; builds bigger trees via operator overloads."""
+
+    _dtype_cache: dt.DType | None
+
+    def __init__(self) -> None:
+        self._dtype_cache = None
+
+    # -- typing --
+    @property
+    def _dtype(self) -> dt.DType:
+        if self._dtype_cache is None:
+            self._dtype_cache = self._compute_dtype()
+        return self._dtype_cache
+
+    def _compute_dtype(self) -> dt.DType:
+        return dt.ANY
+
+    def _deps(self) -> Iterable["ColumnExpression"]:
+        return ()
+
+    # -- substitution used by desugaring --
+    def _substitute(self, mapping: Callable[["ColumnExpression"], "ColumnExpression | None"]) -> "ColumnExpression":
+        replaced = mapping(self)
+        if replaced is not None:
+            return replaced
+        return self._rebuild(mapping)
+
+    def _rebuild(self, mapping) -> "ColumnExpression":
+        return self
+
+    # -- arithmetic --
+    def __add__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "+")
+
+    def __radd__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "+")
+
+    def __sub__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "-")
+
+    def __rsub__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "-")
+
+    def __mul__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "*")
+
+    def __rmul__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "*")
+
+    def __truediv__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "/")
+
+    def __rtruediv__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "/")
+
+    def __floordiv__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "//")
+
+    def __rfloordiv__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "//")
+
+    def __mod__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "%")
+
+    def __rmod__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "%")
+
+    def __pow__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "**")
+
+    def __rpow__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "**")
+
+    def __matmul__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "@")
+
+    def __rmatmul__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "@")
+
+    def __lshift__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "<<")
+
+    def __rshift__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), ">>")
+
+    def __neg__(self):
+        return ColumnUnaryOpExpression(self, "-")
+
+    def __invert__(self):
+        # double negation folds (reference expression.py ColumnUnaryOpExpression)
+        if isinstance(self, ColumnUnaryOpExpression) and self.op == "~":
+            return self.expr
+        return ColumnUnaryOpExpression(self, "~")
+
+    def __abs__(self):
+        return ColumnUnaryOpExpression(self, "abs")
+
+    # -- comparisons --
+    def __eq__(self, other):  # type: ignore[override]
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "==")
+
+    def __ne__(self, other):  # type: ignore[override]
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "!=")
+
+    def __lt__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "<")
+
+    def __le__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "<=")
+
+    def __gt__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), ">")
+
+    def __ge__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), ">=")
+
+    # -- boolean --
+    def __and__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "&")
+
+    def __rand__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "&")
+
+    def __or__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "|")
+
+    def __ror__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "|")
+
+    def __xor__(self, other):
+        return ColumnBinaryOpExpression(self, smart_wrap(other), "^")
+
+    def __rxor__(self, other):
+        return ColumnBinaryOpExpression(smart_wrap(other), self, "^")
+
+    def __bool__(self):
+        raise RuntimeError(
+            "ColumnExpression is lazy and cannot be used in a boolean context; "
+            "use & | ~ instead of and/or/not"
+        )
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+    # -- access --
+    def __getitem__(self, item):
+        return GetExpression(self, smart_wrap(item), check_if_exists=False)
+
+    def get(self, index, default=None):
+        return GetExpression(self, smart_wrap(index), smart_wrap(default), check_if_exists=True)
+
+    def is_none(self):
+        return IsNoneExpression(self)
+
+    def is_not_none(self):
+        return IsNotNoneExpression(self)
+
+    # -- namespaces (reference: internals/expressions/) --
+    @property
+    def dt(self):
+        from .expressions.date_time import DateTimeNamespace
+
+        return DateTimeNamespace(self)
+
+    @property
+    def str(self):
+        from .expressions.string import StringNamespace
+
+        return StringNamespace(self)
+
+    @property
+    def num(self):
+        from .expressions.numerical import NumericalNamespace
+
+        return NumericalNamespace(self)
+
+    def as_int(self):
+        return ConvertExpression(dt.INT, self)
+
+    def as_float(self):
+        return ConvertExpression(dt.FLOAT, self)
+
+    def as_str(self):
+        return ConvertExpression(dt.STR, self)
+
+    def as_bool(self):
+        return ConvertExpression(dt.BOOL, self)
+
+    def to_string(self):
+        from .expressions.string import to_string_expr
+
+        return to_string_expr(self)
+
+    def __repr__(self):
+        return f"<{type(self).__name__}>"
+
+
+class ColumnConstExpression(ColumnExpression):
+    def __init__(self, value: Any):
+        super().__init__()
+        self._value = value
+
+    def _compute_dtype(self) -> dt.DType:
+        v = self._value
+        if v is None:
+            return dt.NONE
+        if isinstance(v, bool):
+            return dt.BOOL
+        if isinstance(v, int):
+            return dt.INT
+        if isinstance(v, float):
+            return dt.FLOAT
+        if isinstance(v, str):
+            return dt.STR
+        if isinstance(v, bytes):
+            return dt.BYTES
+        if isinstance(v, Pointer):
+            return dt.POINTER
+        if isinstance(v, Json):
+            return dt.JSON
+        if isinstance(v, np.ndarray):
+            return dt.ANY_ARRAY
+        if isinstance(v, tuple):
+            return dt.Tuple(*[smart_wrap(x)._dtype for x in v])
+        return dt.wrap(type(v))
+
+    def __repr__(self):
+        return f"Const({self._value!r})"
+
+
+class ColumnReference(ColumnExpression):
+    """``table.colname`` / ``table[colname]``
+    (reference: expression.py:566)."""
+
+    def __init__(self, table: "Table", name: str):
+        super().__init__()
+        self._table = table
+        self._name = name
+
+    @property
+    def table(self) -> "Table":
+        return self._table
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _compute_dtype(self) -> dt.DType:
+        if self._name == "id":
+            return dt.POINTER
+        return self._table.schema[self._name].dtype
+
+    def _substitute(self, mapping):
+        replaced = mapping(self)
+        return replaced if replaced is not None else self
+
+    def __repr__(self):
+        return f"<table>.{self._name}"
+
+
+class IdExpression(ColumnReference):
+    """``table.id`` pseudo-column."""
+
+    def __init__(self, table: "Table"):
+        super().__init__(table, "id")
+
+
+class ColumnBinaryOpExpression(ColumnExpression):
+    def __init__(self, left: ColumnExpression, right: ColumnExpression, op: str):
+        super().__init__()
+        self.left = left
+        self.right = right
+        self.op = op
+
+    def _deps(self):
+        return (self.left, self.right)
+
+    def _rebuild(self, mapping):
+        return ColumnBinaryOpExpression(
+            self.left._substitute(mapping), self.right._substitute(mapping), self.op
+        )
+
+    def _compute_dtype(self) -> dt.DType:
+        return binary_result_dtype(self.op, self.left._dtype, self.right._dtype)
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class ColumnUnaryOpExpression(ColumnExpression):
+    def __init__(self, expr: ColumnExpression, op: str):
+        super().__init__()
+        self.expr = expr
+        self.op = op
+
+    def _deps(self):
+        return (self.expr,)
+
+    def _rebuild(self, mapping):
+        return ColumnUnaryOpExpression(self.expr._substitute(mapping), self.op)
+
+    def _compute_dtype(self) -> dt.DType:
+        inner = self.expr._dtype
+        if self.op == "~":
+            return inner
+        if self.op in ("-", "abs"):
+            return inner
+        return dt.ANY
+
+
+class ReducerExpression(ColumnExpression):
+    """A reducer applied inside groupby/reduce
+    (reference: expression.py:707; src/engine/reduce.rs:22)."""
+
+    def __init__(self, reducer, *args: Any, **kwargs: Any):
+        super().__init__()
+        self.reducer = reducer
+        self.args = tuple(smart_wrap(a) for a in args)
+        self.kwargs = kwargs
+
+    def _deps(self):
+        return self.args
+
+    def _rebuild(self, mapping):
+        return ReducerExpression(
+            self.reducer, *[a._substitute(mapping) for a in self.args], **self.kwargs
+        )
+
+    def _compute_dtype(self) -> dt.DType:
+        return self.reducer.result_dtype([a._dtype for a in self.args])
+
+    def __repr__(self):
+        return f"{self.reducer.name}({', '.join(map(repr, self.args))})"
+
+
+class ApplyExpression(ColumnExpression):
+    """Row-wise escape to a Python callable
+    (reference: expression.py:744; engine Apply expression.rs:97)."""
+
+    def __init__(
+        self,
+        fun: Callable,
+        return_type: Any,
+        *args: Any,
+        propagate_none: bool = False,
+        deterministic: bool = True,
+        max_batch_size: int | None = None,
+        **kwargs: Any,
+    ):
+        super().__init__()
+        self.fun = fun
+        self.return_type = dt.wrap(return_type)
+        self.args = tuple(smart_wrap(a) for a in args)
+        self.kwargs = {k: smart_wrap(v) for k, v in kwargs.items()}
+        self.propagate_none = propagate_none
+        self.deterministic = deterministic
+        self.max_batch_size = max_batch_size
+
+    def _deps(self):
+        return (*self.args, *self.kwargs.values())
+
+    def _rebuild(self, mapping):
+        new = type(self)(
+            self.fun,
+            self.return_type,
+            *[a._substitute(mapping) for a in self.args],
+            propagate_none=self.propagate_none,
+            deterministic=self.deterministic,
+            max_batch_size=self.max_batch_size,
+            **{k: v._substitute(mapping) for k, v in self.kwargs.items()},
+        )
+        if hasattr(self, "capacity"):
+            new.capacity = self.capacity  # async executor fan-out bound
+        return new
+
+    def _compute_dtype(self) -> dt.DType:
+        return self.return_type
+
+
+class AsyncApplyExpression(ApplyExpression):
+    """Async UDF call fanned out by the async executor
+    (reference: expression.py:791; graph.rs:723 ``async_apply_table``)."""
+
+
+class FullyAsyncApplyExpression(AsyncApplyExpression):
+    """Non-blocking async apply producing Future dtype
+    (reference: udfs executor='fully_async')."""
+
+    def _compute_dtype(self) -> dt.DType:
+        return dt.Future(self.return_type)
+
+
+class CastExpression(ColumnExpression):
+    def __init__(self, return_type: Any, expr: ColumnExpression):
+        super().__init__()
+        self.return_type = dt.wrap(return_type)
+        self.expr = smart_wrap(expr)
+
+    def _deps(self):
+        return (self.expr,)
+
+    def _rebuild(self, mapping):
+        return CastExpression(self.return_type, self.expr._substitute(mapping))
+
+    def _compute_dtype(self) -> dt.DType:
+        if isinstance(self.expr._dtype, dt.Optional) and not isinstance(
+            self.return_type, dt.Optional
+        ):
+            return dt.Optional(self.return_type)
+        return self.return_type
+
+
+class ConvertExpression(ColumnExpression):
+    """Json ``as_int``/``as_float``/``as_str``/``as_bool``
+    (reference: expression.py ConvertExpression)."""
+
+    def __init__(self, return_type: dt.DType, expr: ColumnExpression, unwrap: bool = False):
+        super().__init__()
+        self.return_type = return_type
+        self.expr = smart_wrap(expr)
+        self.unwrap = unwrap
+
+    def _deps(self):
+        return (self.expr,)
+
+    def _rebuild(self, mapping):
+        return ConvertExpression(self.return_type, self.expr._substitute(mapping), self.unwrap)
+
+    def _compute_dtype(self) -> dt.DType:
+        return self.return_type if self.unwrap else dt.Optional(self.return_type)
+
+
+class DeclareTypeExpression(ColumnExpression):
+    def __init__(self, return_type: Any, expr: ColumnExpression):
+        super().__init__()
+        self.return_type = dt.wrap(return_type)
+        self.expr = smart_wrap(expr)
+
+    def _deps(self):
+        return (self.expr,)
+
+    def _rebuild(self, mapping):
+        return DeclareTypeExpression(self.return_type, self.expr._substitute(mapping))
+
+    def _compute_dtype(self) -> dt.DType:
+        return self.return_type
+
+
+class CoalesceExpression(ColumnExpression):
+    def __init__(self, *args: Any):
+        super().__init__()
+        self.args = tuple(smart_wrap(a) for a in args)
+
+    def _deps(self):
+        return self.args
+
+    def _rebuild(self, mapping):
+        return CoalesceExpression(*[a._substitute(mapping) for a in self.args])
+
+    def _compute_dtype(self) -> dt.DType:
+        non_none = [a._dtype for a in self.args]
+        if any(not isinstance(d, dt.Optional) and d is not dt.NONE for d in non_none):
+            return dt.types_lcm(*[dt.unoptionalize(d) for d in non_none if d is not dt.NONE])
+        return dt.Optional(
+            dt.types_lcm(*[dt.unoptionalize(d) for d in non_none if d is not dt.NONE])
+        )
+
+
+class RequireExpression(ColumnExpression):
+    def __init__(self, val: Any, *args: Any):
+        super().__init__()
+        self.val = smart_wrap(val)
+        self.args = tuple(smart_wrap(a) for a in args)
+
+    def _deps(self):
+        return (self.val, *self.args)
+
+    def _rebuild(self, mapping):
+        return RequireExpression(
+            self.val._substitute(mapping), *[a._substitute(mapping) for a in self.args]
+        )
+
+    def _compute_dtype(self) -> dt.DType:
+        return dt.Optional(dt.unoptionalize(self.val._dtype))
+
+
+class IfElseExpression(ColumnExpression):
+    def __init__(self, if_: Any, then: Any, else_: Any):
+        super().__init__()
+        self.if_ = smart_wrap(if_)
+        self.then = smart_wrap(then)
+        self.else_ = smart_wrap(else_)
+
+    def _deps(self):
+        return (self.if_, self.then, self.else_)
+
+    def _rebuild(self, mapping):
+        return IfElseExpression(
+            self.if_._substitute(mapping),
+            self.then._substitute(mapping),
+            self.else_._substitute(mapping),
+        )
+
+    def _compute_dtype(self) -> dt.DType:
+        return dt.types_lcm(self.then._dtype, self.else_._dtype)
+
+
+class IsNoneExpression(ColumnExpression):
+    def __init__(self, expr: ColumnExpression):
+        super().__init__()
+        self.expr = smart_wrap(expr)
+
+    def _deps(self):
+        return (self.expr,)
+
+    def _rebuild(self, mapping):
+        return IsNoneExpression(self.expr._substitute(mapping))
+
+    def _compute_dtype(self) -> dt.DType:
+        return dt.BOOL
+
+
+class IsNotNoneExpression(IsNoneExpression):
+    pass
+
+
+class MakeTupleExpression(ColumnExpression):
+    def __init__(self, *args: Any):
+        super().__init__()
+        self.args = tuple(smart_wrap(a) for a in args)
+
+    def _deps(self):
+        return self.args
+
+    def _rebuild(self, mapping):
+        return MakeTupleExpression(*[a._substitute(mapping) for a in self.args])
+
+    def _compute_dtype(self) -> dt.DType:
+        return dt.Tuple(*[a._dtype for a in self.args])
+
+
+class GetExpression(ColumnExpression):
+    def __init__(
+        self,
+        obj: ColumnExpression,
+        index: ColumnExpression,
+        default: ColumnExpression | None = None,
+        check_if_exists: bool = True,
+    ):
+        super().__init__()
+        self.obj = smart_wrap(obj)
+        self.index = smart_wrap(index)
+        self.default = smart_wrap(default) if default is not None else ColumnConstExpression(None)
+        self.check_if_exists = check_if_exists
+
+    def _deps(self):
+        return (self.obj, self.index, self.default)
+
+    def _rebuild(self, mapping):
+        return GetExpression(
+            self.obj._substitute(mapping),
+            self.index._substitute(mapping),
+            self.default._substitute(mapping),
+            self.check_if_exists,
+        )
+
+    def _compute_dtype(self) -> dt.DType:
+        obj_t = self.obj._dtype
+        if obj_t is dt.JSON or obj_t == dt.Optional(dt.JSON):
+            return dt.Optional(dt.JSON) if self.check_if_exists else dt.JSON
+        if isinstance(obj_t, dt.List):
+            return (
+                dt.types_lcm(obj_t.wrapped, self.default._dtype)
+                if self.check_if_exists
+                else obj_t.wrapped
+            )
+        if isinstance(obj_t, dt.Tuple):
+            if isinstance(self.index, ColumnConstExpression) and isinstance(
+                self.index._value, int
+            ):
+                idx = self.index._value
+                if -len(obj_t.args) <= idx < len(obj_t.args):
+                    inner = obj_t.args[idx]
+                    return (
+                        dt.types_lcm(inner, self.default._dtype)
+                        if self.check_if_exists
+                        else inner
+                    )
+                if not self.check_if_exists:
+                    raise IndexError(
+                        f"tuple index {idx} out of range for {obj_t!r}"
+                    )
+                return self.default._dtype
+            return dt.ANY
+        if isinstance(obj_t, dt.Array):
+            return dt.ANY
+        return dt.ANY
+
+
+class MethodCallExpression(ColumnExpression):
+    """A namespaced method like ``col.dt.year()`` or ``col.str.lower()``.
+
+    Carries the implementation directly (python callable over values) plus a
+    result-dtype function — leaner than the reference's engine-dispatched
+    method table (expression.py:1028)."""
+
+    def __init__(
+        self,
+        name: str,
+        fun: Callable,
+        result_dtype: Callable[[list[dt.DType]], dt.DType] | dt.DType,
+        *args: ColumnExpression,
+        propagate_none: bool = True,
+    ):
+        super().__init__()
+        self.name = name
+        self.fun = fun
+        self.result_dtype = result_dtype
+        self.args = tuple(smart_wrap(a) for a in args)
+        self.propagate_none = propagate_none
+
+    def _deps(self):
+        return self.args
+
+    def _rebuild(self, mapping):
+        return MethodCallExpression(
+            self.name,
+            self.fun,
+            self.result_dtype,
+            *[a._substitute(mapping) for a in self.args],
+            propagate_none=self.propagate_none,
+        )
+
+    def _compute_dtype(self) -> dt.DType:
+        if isinstance(self.result_dtype, dt.DType):
+            res = self.result_dtype
+        else:
+            res = self.result_dtype([a._dtype for a in self.args])
+        if self.propagate_none and any(
+            isinstance(a._dtype, dt.Optional) for a in self.args
+        ):
+            return dt.Optional(res)
+        return res
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+class UnwrapExpression(ColumnExpression):
+    def __init__(self, expr: ColumnExpression):
+        super().__init__()
+        self.expr = smart_wrap(expr)
+
+    def _deps(self):
+        return (self.expr,)
+
+    def _rebuild(self, mapping):
+        return UnwrapExpression(self.expr._substitute(mapping))
+
+    def _compute_dtype(self) -> dt.DType:
+        return dt.unoptionalize(self.expr._dtype)
+
+
+class FillErrorExpression(ColumnExpression):
+    def __init__(self, expr: ColumnExpression, replacement: Any):
+        super().__init__()
+        self.expr = smart_wrap(expr)
+        self.replacement = smart_wrap(replacement)
+
+    def _deps(self):
+        return (self.expr, self.replacement)
+
+    def _rebuild(self, mapping):
+        return FillErrorExpression(
+            self.expr._substitute(mapping), self.replacement._substitute(mapping)
+        )
+
+    def _compute_dtype(self) -> dt.DType:
+        return dt.types_lcm(self.expr._dtype, self.replacement._dtype)
+
+
+class PointerExpression(ColumnExpression):
+    """``table.pointer_from(*args, instance=..., optional=...)``
+    (reference: expression.py PointerExpression)."""
+
+    def __init__(self, table: "Table", *args: Any, instance=None, optional: bool = False):
+        super().__init__()
+        self._table = table
+        self.args = tuple(smart_wrap(a) for a in args)
+        self.instance = smart_wrap(instance) if instance is not None else None
+        self.optional = optional
+
+    def _deps(self):
+        return self.args if self.instance is None else (*self.args, self.instance)
+
+    def _rebuild(self, mapping):
+        return PointerExpression(
+            self._table,
+            *[a._substitute(mapping) for a in self.args],
+            instance=self.instance._substitute(mapping) if self.instance is not None else None,
+            optional=self.optional,
+        )
+
+    def _compute_dtype(self) -> dt.DType:
+        return dt.Optional(dt.POINTER) if self.optional else dt.POINTER
+
+
+# ---------------------------------------------------------------------------
+# binary operator typing + runtime impls
+# (reference: src/engine/expression.rs eval impls + cast matrix 120-125)
+# ---------------------------------------------------------------------------
+
+_NUMERIC = (dt.INT, dt.FLOAT)
+
+_BIN_IMPLS: dict[str, Callable[[Any, Any], Any]] = {
+    "+": operator.add,
+    "-": operator.sub,
+    "*": operator.mul,
+    "/": operator.truediv,
+    "//": operator.floordiv,
+    "%": operator.mod,
+    "**": operator.pow,
+    "==": operator.eq,
+    "!=": operator.ne,
+    "<": operator.lt,
+    "<=": operator.le,
+    ">": operator.gt,
+    ">=": operator.ge,
+    "&": operator.and_,
+    "|": operator.or_,
+    "^": operator.xor,
+    "<<": operator.lshift,
+    ">>": operator.rshift,
+    "@": operator.matmul,
+}
+
+
+def binary_op_impl(op: str) -> Callable[[Any, Any], Any]:
+    return _BIN_IMPLS[op]
+
+
+def binary_result_dtype(op: str, left: dt.DType, right: dt.DType) -> dt.DType:
+    lopt = isinstance(left, dt.Optional) or left is dt.NONE
+    ropt = isinstance(right, dt.Optional) or right is dt.NONE
+    lu, ru = dt.unoptionalize(left), dt.unoptionalize(right)
+    res = _binary_result_plain(op, lu, ru)
+    if (lopt or ropt) and res is not dt.ANY and op not in ("==", "!="):
+        return dt.Optional(res)
+    return res
+
+
+def _binary_result_plain(op: str, lu: dt.DType, ru: dt.DType) -> dt.DType:
+    if op in ("==", "!=", "<", "<=", ">", ">="):
+        return dt.BOOL
+    if lu is dt.ANY or ru is dt.ANY:
+        return dt.ANY
+    if op in ("+", "-", "*"):
+        num = dt.coerce_arithmetic(lu, ru)
+        if num is not None:
+            return num
+        if op == "+" and lu is dt.STR and ru is dt.STR:
+            return dt.STR
+        if op == "*" and {lu, ru} == {dt.STR, dt.INT}:
+            return dt.STR
+        if op == "+" and isinstance(lu, dt.Tuple) and isinstance(ru, dt.Tuple):
+            return dt.Tuple(*lu.args, *ru.args)
+        if op == "+" and isinstance(lu, dt.List) and isinstance(ru, dt.List):
+            return dt.List(dt.types_lcm(lu.wrapped, ru.wrapped))
+        # temporal arithmetic (reference: engine/time.rs operators)
+        if lu is dt.DURATION and ru is dt.DURATION:
+            return dt.DURATION
+        if op in ("+", "-") and lu in (dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC) and ru is dt.DURATION:
+            return lu
+        if op == "+" and lu is dt.DURATION and ru in (dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC):
+            return ru
+        if op == "-" and lu == ru and lu in (dt.DATE_TIME_NAIVE, dt.DATE_TIME_UTC):
+            return dt.DURATION
+        if op == "*" and {lu, ru} <= {dt.DURATION, dt.INT} and dt.DURATION in (lu, ru):
+            return dt.DURATION
+        if isinstance(lu, dt.Array) or isinstance(ru, dt.Array):
+            return dt.ANY_ARRAY
+        return dt.ANY
+    if op == "/":
+        if lu in _NUMERIC and ru in _NUMERIC:
+            return dt.FLOAT
+        if lu is dt.DURATION and ru is dt.DURATION:
+            return dt.FLOAT
+        if isinstance(lu, dt.Array) or isinstance(ru, dt.Array):
+            return dt.ANY_ARRAY
+        return dt.ANY
+    if op == "//":
+        if lu is dt.INT and ru is dt.INT:
+            return dt.INT
+        if lu in _NUMERIC and ru in _NUMERIC:
+            return dt.FLOAT
+        if lu is dt.DURATION and ru is dt.DURATION:
+            return dt.INT
+        if lu is dt.DURATION and ru is dt.INT:
+            return dt.DURATION
+        return dt.ANY
+    if op == "%":
+        if lu is dt.INT and ru is dt.INT:
+            return dt.INT
+        if lu in _NUMERIC and ru in _NUMERIC:
+            return dt.FLOAT
+        if lu is dt.DURATION and ru is dt.DURATION:
+            return dt.DURATION
+        return dt.ANY
+    if op == "**":
+        if lu is dt.INT and ru is dt.INT:
+            return dt.INT
+        if lu in _NUMERIC and ru in _NUMERIC:
+            return dt.FLOAT
+        return dt.ANY
+    if op in ("&", "|", "^"):
+        if lu is dt.BOOL and ru is dt.BOOL:
+            return dt.BOOL
+        if lu is dt.INT and ru is dt.INT:
+            return dt.INT
+        return dt.ANY
+    if op in ("<<", ">>"):
+        return dt.INT
+    if op == "@":
+        return dt.ANY_ARRAY
+    return dt.ANY

@@ -1,0 +1,5 @@
+from .date_time import DateTimeNamespace
+from .string import StringNamespace
+from .numerical import NumericalNamespace
+
+__all__ = ["DateTimeNamespace", "StringNamespace", "NumericalNamespace"]

@@ -1,0 +1,142 @@
+"""``col.dt.*`` namespace (reference: python/pathway/internals/expressions/date_time.py, 1613 LoC)."""
+
+from __future__ import annotations
+
+from .. import dtype as dt
+from ..expression import ColumnExpression, MethodCallExpression, smart_wrap
+from ..value import DateTimeNaive, DateTimeUtc, Duration
+
+
+def _m(name, fun, result, *args, propagate_none=True):
+    return MethodCallExpression(f"dt.{name}", fun, result, *args, propagate_none=propagate_none)
+
+
+def _dt_or_dur_same(arg_dtypes):
+    return dt.unoptionalize(arg_dtypes[0])
+
+
+class DateTimeNamespace:
+    def __init__(self, expr: ColumnExpression):
+        self._expr = expr
+
+    # component accessors
+    def year(self):
+        return _m("year", lambda v: v.year(), dt.INT, self._expr)
+
+    def month(self):
+        return _m("month", lambda v: v.month(), dt.INT, self._expr)
+
+    def day(self):
+        return _m("day", lambda v: v.day(), dt.INT, self._expr)
+
+    def hour(self):
+        return _m("hour", lambda v: v.hour(), dt.INT, self._expr)
+
+    def minute(self):
+        return _m("minute", lambda v: v.minute(), dt.INT, self._expr)
+
+    def second(self):
+        return _m("second", lambda v: v.second(), dt.INT, self._expr)
+
+    def millisecond(self):
+        return _m("millisecond", lambda v: v.millisecond(), dt.INT, self._expr)
+
+    def microsecond(self):
+        return _m("microsecond", lambda v: v.microsecond(), dt.INT, self._expr)
+
+    def nanosecond(self):
+        return _m("nanosecond", lambda v: v.nanosecond(), dt.INT, self._expr)
+
+    def timestamp(self, unit: str = "ns"):
+        return _m(
+            "timestamp", lambda v, u: v.timestamp(u), dt.FLOAT, self._expr, smart_wrap(unit)
+        )
+
+    def strftime(self, fmt: str):
+        return _m("strftime", lambda v, f: v.strftime(f), dt.STR, self._expr, smart_wrap(fmt))
+
+    def strptime(self, fmt: str | None = None, contains_timezone: bool | None = None):
+        tz = contains_timezone
+        if tz is None:
+            tz = fmt is not None and ("%z" in fmt or "%Z" in fmt)
+
+        def impl(v, f):
+            cls = DateTimeUtc if tz else DateTimeNaive
+            return cls(v, fmt=f)
+
+        return _m(
+            "strptime",
+            impl,
+            dt.DATE_TIME_UTC if tz else dt.DATE_TIME_NAIVE,
+            self._expr,
+            smart_wrap(fmt),
+        )
+
+    def to_naive(self, timezone: str = "UTC"):
+        def impl(v):
+            return DateTimeNaive(ns=v.ns)
+
+        return _m("to_naive", impl, dt.DATE_TIME_NAIVE, self._expr)
+
+    def to_utc(self, from_timezone: str = "UTC"):
+        def impl(v):
+            return DateTimeUtc(ns=v.ns)
+
+        return _m("to_utc", impl, dt.DATE_TIME_UTC, self._expr)
+
+    def from_timestamp(self, unit: str = "s"):
+        mult = {"s": 1_000_000_000, "ms": 1_000_000, "us": 1_000, "ns": 1}[unit]
+
+        def impl(v):
+            return DateTimeNaive(ns=int(v * mult))
+
+        return _m("from_timestamp", impl, dt.DATE_TIME_NAIVE, self._expr)
+
+    def utc_from_timestamp(self, unit: str = "s"):
+        mult = {"s": 1_000_000_000, "ms": 1_000_000, "us": 1_000, "ns": 1}[unit]
+
+        def impl(v):
+            return DateTimeUtc(ns=int(v * mult))
+
+        return _m("utc_from_timestamp", impl, dt.DATE_TIME_UTC, self._expr)
+
+    def round(self, duration):
+        def impl(v, d):
+            d_ns = d.ns if isinstance(d, Duration) else int(d)
+            half = d_ns // 2
+            rounded = ((v.ns + half) // d_ns) * d_ns
+            return type(v)(ns=rounded)
+
+        return _m("round", impl, _dt_or_dur_same, self._expr, smart_wrap(duration))
+
+    def floor(self, duration):
+        def impl(v, d):
+            d_ns = d.ns if isinstance(d, Duration) else int(d)
+            return type(v)(ns=(v.ns // d_ns) * d_ns)
+
+        return _m("floor", impl, _dt_or_dur_same, self._expr, smart_wrap(duration))
+
+    # duration accessors
+    def nanoseconds(self):
+        return _m("nanoseconds", lambda v: v.nanoseconds(), dt.INT, self._expr)
+
+    def microseconds(self):
+        return _m("microseconds", lambda v: v.microseconds(), dt.INT, self._expr)
+
+    def milliseconds(self):
+        return _m("milliseconds", lambda v: v.milliseconds(), dt.INT, self._expr)
+
+    def seconds(self):
+        return _m("seconds", lambda v: v.seconds(), dt.INT, self._expr)
+
+    def minutes(self):
+        return _m("minutes", lambda v: v.minutes(), dt.INT, self._expr)
+
+    def hours(self):
+        return _m("hours", lambda v: v.hours(), dt.INT, self._expr)
+
+    def days(self):
+        return _m("days", lambda v: v.days(), dt.INT, self._expr)
+
+    def weeks(self):
+        return _m("weeks", lambda v: v.weeks(), dt.INT, self._expr)

@@ -1,0 +1,46 @@
+"""``col.num.*`` namespace (reference: python/pathway/internals/expressions/numerical.py)."""
+
+from __future__ import annotations
+
+import math
+
+from .. import dtype as dt
+from ..expression import ColumnExpression, MethodCallExpression, smart_wrap
+from ..value import ERROR
+
+
+def _m(name, fun, result, *args, propagate_none=True):
+    return MethodCallExpression(f"num.{name}", fun, result, *args, propagate_none=propagate_none)
+
+
+class NumericalNamespace:
+    def __init__(self, expr: ColumnExpression):
+        self._expr = expr
+
+    def abs(self):
+        def res(arg_dtypes):
+            inner = dt.unoptionalize(arg_dtypes[0])
+            return inner if inner in (dt.INT, dt.FLOAT) else dt.FLOAT
+
+        return _m("abs", abs, res, self._expr)
+
+    def round(self, decimals=0):
+        def res(arg_dtypes):
+            return dt.unoptionalize(arg_dtypes[0])
+
+        return _m("round", lambda v, d: round(v, d), res, self._expr, smart_wrap(decimals))
+
+    def fill_na(self, default_value):
+        def impl(v, d):
+            if v is None or v is ERROR:
+                return d
+            if isinstance(v, float) and math.isnan(v):
+                return d
+            return v
+
+        def res(arg_dtypes):
+            return dt.types_lcm(dt.unoptionalize(arg_dtypes[0]), arg_dtypes[1])
+
+        return MethodCallExpression(
+            "num.fill_na", impl, res, self._expr, smart_wrap(default_value), propagate_none=False
+        )

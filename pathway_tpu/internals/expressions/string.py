@@ -1,0 +1,170 @@
+"""``col.str.*`` namespace (reference: python/pathway/internals/expressions/string.py, 931 LoC)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .. import dtype as dt
+from ..expression import ColumnExpression, MethodCallExpression, smart_wrap
+
+
+def _m(name, fun, result, *args, propagate_none=True):
+    return MethodCallExpression(f"str.{name}", fun, result, *args, propagate_none=propagate_none)
+
+
+def to_string_expr(expr: ColumnExpression) -> ColumnExpression:
+    def impl(v):
+        if isinstance(v, bool):
+            return "True" if v else "False"
+        if isinstance(v, float) and v.is_integer():
+            return str(v)
+        return str(v)
+
+    return _m("to_string", impl, dt.STR, expr)
+
+
+class StringNamespace:
+    """String methods over STR columns."""
+
+    def __init__(self, expr: ColumnExpression):
+        self._expr = expr
+
+    def lower(self):
+        return _m("lower", lambda s: s.lower(), dt.STR, self._expr)
+
+    def upper(self):
+        return _m("upper", lambda s: s.upper(), dt.STR, self._expr)
+
+    def reversed(self):
+        return _m("reversed", lambda s: s[::-1], dt.STR, self._expr)
+
+    def strip(self, chars: str | None = None):
+        return _m("strip", lambda s, c: s.strip(c), dt.STR, self._expr, smart_wrap(chars))
+
+    def rstrip(self, chars: str | None = None):
+        return _m("rstrip", lambda s, c: s.rstrip(c), dt.STR, self._expr, smart_wrap(chars))
+
+    def lstrip(self, chars: str | None = None):
+        return _m("lstrip", lambda s, c: s.lstrip(c), dt.STR, self._expr, smart_wrap(chars))
+
+    def len(self):
+        return _m("len", lambda s: len(s), dt.INT, self._expr)
+
+    def count(self, sub, start=None, end=None):
+        return _m(
+            "count",
+            lambda s, su, st, e: s.count(su, st, e),
+            dt.INT,
+            self._expr,
+            smart_wrap(sub),
+            smart_wrap(start),
+            smart_wrap(end),
+        )
+
+    def find(self, sub, start=None, end=None):
+        return _m(
+            "find",
+            lambda s, su, st, e: s.find(su, st, e),
+            dt.INT,
+            self._expr,
+            smart_wrap(sub),
+            smart_wrap(start),
+            smart_wrap(end),
+        )
+
+    def rfind(self, sub, start=None, end=None):
+        return _m(
+            "rfind",
+            lambda s, su, st, e: s.rfind(su, st, e),
+            dt.INT,
+            self._expr,
+            smart_wrap(sub),
+            smart_wrap(start),
+            smart_wrap(end),
+        )
+
+    def startswith(self, prefix):
+        return _m("startswith", lambda s, p: s.startswith(p), dt.BOOL, self._expr, smart_wrap(prefix))
+
+    def endswith(self, suffix):
+        return _m("endswith", lambda s, p: s.endswith(p), dt.BOOL, self._expr, smart_wrap(suffix))
+
+    def swapcase(self):
+        return _m("swapcase", lambda s: s.swapcase(), dt.STR, self._expr)
+
+    def title(self):
+        return _m("title", lambda s: s.title(), dt.STR, self._expr)
+
+    def replace(self, old, new, count: int = -1):
+        return _m(
+            "replace",
+            lambda s, o, n, c: s.replace(o, n, c),
+            dt.STR,
+            self._expr,
+            smart_wrap(old),
+            smart_wrap(new),
+            smart_wrap(count),
+        )
+
+    def split(self, sep=None, maxsplit: int = -1):
+        return _m(
+            "split",
+            lambda s, se, m: tuple(s.split(se, m)),
+            dt.List(dt.STR),
+            self._expr,
+            smart_wrap(sep),
+            smart_wrap(maxsplit),
+        )
+
+    def slice(self, start: int, end: int):
+        return _m(
+            "slice",
+            lambda s, a, b: s[a:b],
+            dt.STR,
+            self._expr,
+            smart_wrap(start),
+            smart_wrap(end),
+        )
+
+    def parse_int(self, optional: bool = False):
+        def impl(s):
+            try:
+                return int(s)
+            except (TypeError, ValueError):
+                if optional:
+                    return None
+                raise
+
+        res = dt.Optional(dt.INT) if optional else dt.INT
+        return _m("parse_int", impl, res, self._expr)
+
+    def parse_float(self, optional: bool = False):
+        def impl(s):
+            try:
+                return float(s)
+            except (TypeError, ValueError):
+                if optional:
+                    return None
+                raise
+
+        res = dt.Optional(dt.FLOAT) if optional else dt.FLOAT
+        return _m("parse_float", impl, res, self._expr)
+
+    def parse_bool(
+        self,
+        true_values=("on", "true", "yes", "1"),
+        false_values=("off", "false", "no", "0"),
+        optional: bool = False,
+    ):
+        def impl(s):
+            low = s.lower()
+            if low in true_values:
+                return True
+            if low in false_values:
+                return False
+            if optional:
+                return None
+            raise ValueError(f"cannot parse {s!r} as bool")
+
+        res = dt.Optional(dt.BOOL) if optional else dt.BOOL
+        return _m("parse_bool", impl, res, self._expr)

@@ -1,0 +1,129 @@
+"""Lazy operator graph (the "parse graph").
+
+reference: python/pathway/internals/parse_graph.py:104 (``ParseGraph``,
+global ``G``, ``add_operator``, tree-shaking via ``relevant_nodes``) and
+internals/operator.py.  Operators here are data: a kind tag + params; the
+GraphRunner (``internals/runtime.py``) lowers each kind onto a runtime node
+of the micro-batch diff engine.
+"""
+
+from __future__ import annotations
+
+import itertools
+import traceback
+from typing import Any, Callable, Iterable, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from .table import Table
+
+__all__ = ["Operator", "ParseGraph", "G"]
+
+
+class Trace:
+    """User stack frame that created an operator
+    (reference: internals/trace.py; src/engine/graph.rs:420 ``Trace``)."""
+
+    __slots__ = ("line", "file", "line_number", "function")
+
+    def __init__(self):
+        self.line = ""
+        self.file = ""
+        self.line_number = 0
+        self.function = ""
+        for frame in reversed(traceback.extract_stack(limit=16)):
+            fname = frame.filename
+            if "/pathway_tpu/" in fname.replace("\\", "/"):
+                continue
+            self.line = frame.line or ""
+            self.file = fname
+            self.line_number = frame.lineno or 0
+            self.function = frame.name
+            break
+
+    def __repr__(self):
+        return f"{self.file}:{self.line_number} {self.line}"
+
+
+class Operator:
+    """A node in the parse graph."""
+
+    def __init__(
+        self,
+        kind: str,
+        inputs: "list[Table]",
+        params: dict[str, Any] | None = None,
+    ):
+        self.kind = kind
+        self.inputs = inputs
+        self.params = params or {}
+        self.outputs: list[Table] = []
+        self.trace = Trace()
+        self.id = G.add_operator(self)
+
+    def input_operators(self) -> "Iterable[Operator]":
+        for t in self.inputs:
+            yield t._operator
+
+    def __repr__(self):
+        return f"Operator#{self.id}<{self.kind}>"
+
+
+class ParseGraph:
+    """Global lazy graph; rebuilt per run via tree-shaking from outputs."""
+
+    def __init__(self):
+        self._counter = itertools.count()
+        self.operators: dict[int, Operator] = {}
+        # callbacks fired at the start of pw.run (connectors register here)
+        self.run_hooks: list[Callable[[], None]] = []
+        # sink requests: (table, OutputNode) pairs registered by pw.io sinks
+        self.sinks: list = []
+
+    def add_operator(self, op: Operator) -> int:
+        op_id = next(self._counter)
+        self.operators[op_id] = op
+        return op_id
+
+    def relevant_operators(self, outputs: "Iterable[Operator]") -> list[Operator]:
+        """Tree-shake: all transitive inputs of ``outputs``, in topo (id) order
+        (reference: parse_graph.py:27-103 ``relevant_nodes``)."""
+        seen: set[int] = set()
+        stack = list(outputs)
+        while stack:
+            op = stack.pop()
+            if op.id in seen:
+                continue
+            seen.add(op.id)
+            stack.extend(op.input_operators())
+            for extra in op.params.get("extra_input_tables", ()):  # iterate bodies
+                stack.append(extra._operator)
+        return [self.operators[i] for i in sorted(seen)]
+
+    def scoped(self):
+        """Context manager: run graph-building code in an isolated scope
+        (used by pw.iterate's nested fixpoint execution;
+        reference: parse_graph.py scope stack)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            saved = (self._counter, self.operators, self.run_hooks, self.sinks)
+            self._counter = itertools.count()
+            self.operators = {}
+            self.run_hooks = []
+            self.sinks = []
+            try:
+                yield self
+            finally:
+                (self._counter, self.operators, self.run_hooks, self.sinks) = saved
+
+        return _scope()
+
+    def clear(self) -> None:
+        self._counter = itertools.count()
+        self.operators.clear()
+        self.run_hooks.clear()
+        self.sinks.clear()
+
+
+G = ParseGraph()

@@ -1,0 +1,129 @@
+"""GroupedTable: ``table.groupby(...).reduce(...)``.
+
+reference: python/pathway/internals/groupbys.py (402 LoC) + GroupedContext
+(internals/column.py:498).
+"""
+
+from __future__ import annotations
+
+from typing import Any, TYPE_CHECKING
+
+from . import dtype as dt
+from .expression import (
+    ColumnExpression,
+    ColumnReference,
+    IdExpression,
+    ReducerExpression,
+    smart_wrap,
+)
+from .desugaring import expand_select_args, resolve_expression
+from .graph import Operator
+from .schema import ColumnSchema, _schema_from_columns
+from .universe import Universe
+
+if TYPE_CHECKING:
+    from .table import Table
+
+
+class _GroupColExpression(ColumnExpression):
+    """Internal: slot reference to a grouping column in reduce output."""
+
+    def __init__(self, slot: int, dtype: dt.DType):
+        super().__init__()
+        self.slot = slot
+        self._slot_dtype = dtype
+
+    def _compute_dtype(self) -> dt.DType:
+        return self._slot_dtype
+
+
+class _ReducerSlotExpression(ColumnExpression):
+    """Internal: slot reference to a computed reducer value."""
+
+    def __init__(self, slot: int, dtype: dt.DType):
+        super().__init__()
+        self.slot = slot
+        self._slot_dtype = dtype
+
+    def _compute_dtype(self) -> dt.DType:
+        return self._slot_dtype
+
+
+class GroupedTable:
+    def __init__(
+        self,
+        table: "Table",
+        grouping: list[ColumnExpression],
+        *,
+        set_id: bool = False,
+        sort_by: ColumnExpression | None = None,
+        instance: ColumnExpression | None = None,
+    ):
+        self._table = table
+        self._grouping = grouping
+        self._set_id = set_id
+        self._sort_by = sort_by
+        self._instance = instance
+
+    def reduce(self, *args: Any, **kwargs: Any) -> "Table":
+        from .table import Table
+
+        table = self._table
+        exprs = expand_select_args(args, kwargs, table)
+        # map grouping expressions to slots, keyed by structural identity of refs
+        group_slots: dict[Any, int] = {}
+        for i, g in enumerate(self._grouping):
+            group_slots[_expr_token(g)] = i
+
+        reducer_slots: list[ReducerExpression] = []
+
+        def substitute(node: ColumnExpression) -> ColumnExpression | None:
+            tok = _expr_token(node)
+            if tok is not None and tok in group_slots:
+                return _GroupColExpression(group_slots[tok], node._dtype)
+            if isinstance(node, ReducerExpression):
+                slot = len(reducer_slots)
+                reducer_slots.append(node)
+                return _ReducerSlotExpression(slot, node._dtype)
+            if isinstance(node, IdExpression):
+                raise ValueError(
+                    "cannot use .id inside reduce(); group ids are derived from "
+                    "grouping columns"
+                )
+            if isinstance(node, ColumnReference):
+                raise ValueError(
+                    f"column {node.name!r} used in reduce() without a reducer "
+                    "and is not a grouping column"
+                )
+            return None
+
+        out_exprs: dict[str, ColumnExpression] = {}
+        columns: dict[str, ColumnSchema] = {}
+        for name, e in exprs.items():
+            sub = e._substitute(substitute)
+            out_exprs[name] = sub
+            columns[name] = ColumnSchema(name=name, dtype=sub._dtype)
+
+        schema = _schema_from_columns(columns)
+        op = Operator(
+            "groupby",
+            [table],
+            params=dict(
+                grouping=self._grouping,
+                out_exprs=out_exprs,
+                reducers=reducer_slots,
+                instance=self._instance,
+                sort_by=self._sort_by,
+                set_id=self._set_id,
+            ),
+        )
+        return Table._new(op, schema, Universe())
+
+
+def _expr_token(e: ColumnExpression):
+    """Structural identity for matching grouping exprs inside reduce args."""
+    if isinstance(e, IdExpression):
+        return ("id", id(e.table))
+    if isinstance(e, ColumnReference):
+        return ("col", id(e.table), e.name)
+    return None

@@ -1,0 +1,202 @@
+"""``pw.iterate`` — fixed-point iteration.
+
+reference: python/pathway/internals/decorators.py iterate +
+operator.py:316 IterateOperator; engine side src/engine/dataflow.rs:3774
+``iterate`` with differential ``Variable`` in a nested scope.
+
+TPU-era re-design: instead of nested product timestamps, the iterate body is
+re-executed as a scoped batch sub-graph until the iterated tables stop
+changing (or ``iteration_limit`` is hit).  This is the semantics of the
+reference's outer-scope iteration for batch inputs; on streaming updates the
+fixpoint is recomputed per micro-batch.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from types import SimpleNamespace
+from typing import Any, Callable
+
+from .engine import Node, Entry, consolidate, freeze_row
+from .graph import G, Operator
+from .table import Table
+from .universe import Universe
+
+__all__ = ["iterate", "iterate_universe"]
+
+
+class _IterateSpec:
+    def __init__(self, func: Callable, iteration_limit: int | None, names: list[str], tables: list[Table]):
+        self.func = func
+        self.iteration_limit = iteration_limit
+        self.names = names
+        self.tables = tables
+        self.schemas: dict[str, Any] = {}
+
+
+def _call_func(spec: _IterateSpec, tables: dict[str, Table]):
+    result = spec.func(**tables)
+    if isinstance(result, Table):
+        result = {spec.names[0]: result}
+    elif isinstance(result, dict):
+        pass
+    elif hasattr(result, "_asdict"):
+        result = result._asdict()
+    elif hasattr(result, "__dict__") and not isinstance(result, Table):
+        result = dict(result.__dict__)
+    else:
+        raise TypeError("iterate body must return a Table, dict, or namedtuple")
+    return result
+
+
+def iterate(func: Callable, iteration_limit: int | None = None, **kwargs: Table):
+    """reference: pw.iterate (internals/decorators.py).
+
+    ``func`` receives the tables as keyword args and returns the updated
+    tables (same names); the returned object exposes the fixpoint tables as
+    attributes."""
+    names = list(kwargs.keys())
+    tables = [kwargs[n] for n in names]
+    spec = _IterateSpec(func, iteration_limit, names, tables)
+
+    # trace once in a scoped graph to learn output schemas
+    with G.scoped():
+        placeholder = {}
+        for n, t in zip(names, tables):
+            op = Operator("input", [], params=dict(rows=[], schema=t.schema))
+            placeholder[n] = Table._new(op, t.schema, Universe())
+        result = _call_func(spec, placeholder)
+        for n, t in result.items():
+            spec.schemas[n] = t.schema
+
+    outs = {}
+    for n in result.keys():
+        op = Operator(
+            "iterate",
+            list(tables),
+            params=dict(spec=spec, out_name=n),
+        )
+        outs[n] = Table._new(op, spec.schemas[n], Universe())
+    if len(outs) == 1:
+        return next(iter(outs.values()))
+    return SimpleNamespace(**outs)
+
+
+def iterate_universe(func: Callable, **kwargs: Table):
+    return iterate(func, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# runtime
+# ---------------------------------------------------------------------------
+
+
+class IterateNode(Node):
+    """Recomputes the fixpoint per micro-batch over current input snapshots.
+
+    The fixpoint result for the *latest* input snapshot is cached per spec so
+    that sibling output nodes of the same pw.iterate don't recompute it; only
+    one entry is kept (older snapshots can never repeat in a totally-ordered
+    stream)."""
+
+    _fixpoint_cache: dict[int, tuple[tuple, dict]] = {}
+
+    def __init__(self, spec: _IterateSpec, out_name: str, name: str = "iterate"):
+        super().__init__(n_inputs=len(spec.tables), name=name)
+        self.spec = spec
+        self.out_name = out_name
+        self.snapshots: list[dict] = [dict() for _ in spec.tables]
+        self.last_out: dict = {}
+
+    def flush(self, time: int) -> list[Entry]:
+        changed = False
+        for port in range(self.n_inputs):
+            for key, row, diff in self.take(port):
+                changed = True
+                if diff > 0:
+                    self.snapshots[port][key] = row
+                else:
+                    self.snapshots[port].pop(key, None)
+        if not changed:
+            return []
+        result = self._compute_fixpoint()
+        new_out = result[self.out_name]
+        out: list[Entry] = []
+        for key, row in self.last_out.items():
+            if key not in new_out or freeze_row(new_out[key]) != freeze_row(row):
+                out.append((key, row, -1))
+        for key, row in new_out.items():
+            if key not in self.last_out or freeze_row(self.last_out[key]) != freeze_row(row):
+                out.append((key, row, 1))
+        self.last_out = new_out
+        return consolidate(out)
+
+    def _content_token(self) -> tuple:
+        return tuple(
+            frozenset((k, freeze_row(r)) for k, r in snap.items())
+            for snap in self.snapshots
+        )
+
+    def _compute_fixpoint(self) -> dict[str, dict]:
+        token = self._content_token()
+        cached = IterateNode._fixpoint_cache.get(id(self.spec))
+        if cached is not None and cached[0] == token:
+            return cached[1]
+        spec = self.spec
+        state: dict[str, dict] = {
+            n: dict(snap) for n, snap in zip(spec.names, self.snapshots)
+        }
+        limit = spec.iteration_limit
+        it = 0
+        while True:
+            it += 1
+            new_state_all = self._run_once(state)
+            new_state = {
+                n: new_state_all[n] for n in spec.names if n in new_state_all
+            }
+            stable = all(
+                _same(state[n], new_state.get(n, state[n])) for n in spec.names
+            )
+            for n in spec.names:
+                if n in new_state:
+                    state[n] = new_state[n]
+            if stable or (limit is not None and it >= limit):
+                result = new_state_all
+                break
+        IterateNode._fixpoint_cache[id(self.spec)] = (token, result)
+        return result
+
+    def _run_once(self, state: dict[str, dict]) -> dict[str, dict]:
+        from .runtime import GraphRunner
+        from .engine import OutputNode
+
+        spec = self.spec
+        with G.scoped():
+            tables = {}
+            for n, orig in zip(spec.names, spec.tables):
+                rows = [(k, r) for k, r in state[n].items()]
+                op = Operator("input", [], params=dict(rows=rows, schema=orig.schema))
+                tables[n] = Table._new(op, orig.schema, Universe())
+            result = _call_func(spec, tables)
+            out_nodes = {n: OutputNode(name=f"iter_{n}") for n in result}
+            runner = GraphRunner()
+            engine = runner.build([(t, out_nodes[n]) for n, t in result.items()])
+            engine.run_all()
+            return {n: dict(node.current) for n, node in out_nodes.items()}
+
+
+def _same(a: dict, b: dict) -> bool:
+    if len(a) != len(b):
+        return False
+    for k, r in a.items():
+        if k not in b or freeze_row(b[k]) != freeze_row(r):
+            return False
+    return True
+
+
+def lower_iterate(runner, op: Operator) -> None:
+    spec: _IterateSpec = op.params["spec"]
+    node = IterateNode(spec, op.params["out_name"], name=f"iterate#{op.id}")
+    runner.engine.add(node)
+    runner._connect_inputs(op, node)
+    runner._register(op, node)

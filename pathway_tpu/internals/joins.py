@@ -1,0 +1,162 @@
+"""Joins: ``t1.join(t2, t1.a == t2.b).select(...)``.
+
+reference: python/pathway/internals/joins.py (1422 LoC), join_mode.py,
+JoinContext (internals/column.py:931); engine side differential
+``join_core`` via src/engine/dataflow.rs join operators.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, TYPE_CHECKING
+
+from .expression import (
+    ColumnBinaryOpExpression,
+    ColumnExpression,
+    ColumnReference,
+    IdExpression,
+    smart_wrap,
+)
+from .desugaring import expand_select_args, resolve_expression
+from .graph import Operator
+from .schema import ColumnSchema, _schema_from_columns
+from . import dtype as dt
+from .universe import Universe
+
+if TYPE_CHECKING:
+    from .table import Table
+
+__all__ = ["JoinMode", "JoinResult"]
+
+
+class JoinMode(enum.Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    OUTER = "outer"
+
+
+class JoinResult:
+    """Deferred join; finalized by ``.select``/``.reduce``
+    (reference: joins.py JoinResult)."""
+
+    def __init__(
+        self,
+        left: "Table",
+        right: "Table",
+        on: tuple,
+        mode: JoinMode,
+        id_expr: ColumnExpression | None = None,
+        exact_match: bool = False,
+    ):
+        self._left = left
+        self._right = right
+        self._mode = mode
+        self._id_expr = id_expr
+        self._exact_match = exact_match
+        self._on: list[tuple[ColumnExpression, ColumnExpression]] = []
+        for cond in on:
+            self._on.append(self._split_condition(cond))
+
+    def _split_condition(self, cond) -> tuple[ColumnExpression, ColumnExpression]:
+        if not isinstance(cond, ColumnBinaryOpExpression) or cond.op != "==":
+            raise ValueError(
+                "join conditions must be of the form <left expr> == <right expr>"
+            )
+        lexpr = resolve_expression(cond.left, self._left, self._left, self._right)
+        rexpr = resolve_expression(cond.right, self._left, self._left, self._right)
+        lside = self._side_of(lexpr)
+        rside = self._side_of(rexpr)
+        if lside == "right" and rside == "left":
+            lexpr, rexpr = rexpr, lexpr
+        elif not (lside in ("left", "const") and rside in ("right", "const")):
+            if lside == "left" and rside == "left":
+                raise ValueError("both sides of a join condition refer to the left table")
+            if lside == "right" and rside == "right":
+                raise ValueError("both sides of a join condition refer to the right table")
+        return lexpr, rexpr
+
+    def _side_of(self, e: ColumnExpression) -> str:
+        tables = set()
+
+        def walk(node):
+            if isinstance(node, ColumnReference) and node.table is not None:
+                tables.add(id(node.table))
+            for d in node._deps():
+                walk(d)
+
+        walk(e)
+        if not tables:
+            return "const"
+        left_ids = {id(self._left)}
+        right_ids = {id(self._right)}
+        if tables <= left_ids:
+            return "left"
+        if tables <= right_ids:
+            return "right"
+        # fall back on universe identity
+        return "mixed"
+
+    def select(self, *args: Any, **kwargs: Any) -> "Table":
+        from .table import Table
+
+        exprs = expand_select_args(
+            args, kwargs, self._left, self._left, self._right
+        )
+        columns: dict[str, ColumnSchema] = {}
+        for name, e in exprs.items():
+            dtype = e._dtype
+            if self._mode in (JoinMode.LEFT, JoinMode.OUTER) and _refers_to(
+                e, self._right
+            ):
+                dtype = dt.Optional(dtype)
+            if self._mode in (JoinMode.RIGHT, JoinMode.OUTER) and _refers_to(
+                e, self._left
+            ):
+                dtype = dt.Optional(dtype)
+            columns[name] = ColumnSchema(name=name, dtype=dtype)
+        schema = _schema_from_columns(columns)
+
+        universe = Universe()
+        op = Operator(
+            "join",
+            [self._left, self._right],
+            params=dict(
+                on=self._on,
+                mode=self._mode,
+                out_exprs=exprs,
+                id_expr=self._id_expr,
+                exact_match=self._exact_match,
+            ),
+        )
+        return Table._new(op, schema, universe)
+
+    def reduce(self, *args: Any, **kwargs: Any) -> "Table":
+        return self._flat().reduce(*args, **kwargs)
+
+    def groupby(self, *args: Any, **kwargs: Any):
+        return self._flat().groupby(*args, **kwargs)
+
+    def filter(self, condition) -> "Table":
+        return self._flat_with_condition(condition)
+
+    def _flat(self) -> "Table":
+        """Materialize the join with all columns of both sides (left wins on
+        name conflicts, mirroring the reference's substitution rules)."""
+        exprs: dict[str, Any] = {}
+        for name in self._right.column_names():
+            exprs[name] = self._right[name]
+        for name in self._left.column_names():
+            exprs[name] = self._left[name]
+        return self.select(**exprs)
+
+    def _flat_with_condition(self, condition) -> "Table":
+        flat = self._flat()
+        cond = resolve_expression(condition, flat, flat, flat)
+        return flat.filter(cond)
+
+
+def _refers_to(e: ColumnExpression, table: "Table") -> bool:
+    if isinstance(e, ColumnReference) and e.table is table:
+        return True
+    return any(_refers_to(d, table) for d in e._deps())

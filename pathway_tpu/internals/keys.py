@@ -1,0 +1,128 @@
+"""Key derivation — 128-bit pointers from hashed values.
+
+reference: src/engine/value.rs ``Key::for_values`` (SipHash-based in the
+reference); here blake2b/16 via hashlib until the C++ native module takes
+over the hot path.  Shard semantics (low 16 bits) live on
+:class:`pathway_tpu.internals.value.Pointer`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Any, Iterable
+
+import numpy as np
+
+from .value import (
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+    Json,
+    Pointer,
+    ERROR,
+)
+
+try:  # hot-path native hasher (C++), built by pathway_tpu/_native
+    from pathway_tpu._native import hash_bytes as _native_hash_bytes  # type: ignore
+except Exception:  # pragma: no cover - fallback always works
+    _native_hash_bytes = None
+
+__all__ = [
+    "ref_scalar",
+    "ref_pointer",
+    "unsafe_make_pointer",
+    "shard_of_key",
+    "SHARD_BITS",
+]
+
+SHARD_BITS = Pointer.SHARD_BITS
+
+
+def _serialize(value: Any, out: bytearray) -> None:
+    """Stable byte serialization of a value for hashing."""
+    if value is None:
+        out += b"\x00"
+    elif value is ERROR:
+        out += b"\x0e"
+    elif isinstance(value, bool):
+        out += b"\x01" + (b"\x01" if value else b"\x00")
+    elif isinstance(value, int):
+        out += b"\x02" + value.to_bytes(16, "little", signed=True)
+    elif isinstance(value, float):
+        out += b"\x03" + struct.pack("<d", value)
+    elif isinstance(value, str):
+        b = value.encode("utf-8")
+        out += b"\x04" + len(b).to_bytes(8, "little") + b
+    elif isinstance(value, bytes):
+        out += b"\x05" + len(value).to_bytes(8, "little") + value
+    elif isinstance(value, Pointer):
+        out += b"\x06" + value.value.to_bytes(16, "little")
+    elif isinstance(value, tuple):
+        out += b"\x07" + len(value).to_bytes(8, "little")
+        for v in value:
+            _serialize(v, out)
+    elif isinstance(value, np.ndarray):
+        data = np.ascontiguousarray(value)
+        out += b"\x08" + str(data.dtype).encode() + b"|"
+        out += b"|".join(str(d).encode() for d in data.shape) + b"|"
+        out += data.tobytes()
+    elif isinstance(value, Json):
+        out += b"\x09" + value.to_string().encode("utf-8")
+    elif isinstance(value, DateTimeNaive):
+        out += b"\x0a" + value.ns.to_bytes(16, "little", signed=True)
+    elif isinstance(value, DateTimeUtc):
+        out += b"\x0b" + value.ns.to_bytes(16, "little", signed=True)
+    elif isinstance(value, Duration):
+        out += b"\x0c" + value.ns.to_bytes(16, "little", signed=True)
+    elif isinstance(value, (np.integer,)):
+        _serialize(int(value), out)
+    elif isinstance(value, (np.floating,)):
+        _serialize(float(value), out)
+    elif isinstance(value, (np.bool_,)):
+        _serialize(bool(value), out)
+    elif isinstance(value, list):
+        _serialize(tuple(value), out)
+    else:
+        raise TypeError(f"value of type {type(value)!r} is not hashable into a key")
+
+
+def _digest128(data: bytes) -> int:
+    if _native_hash_bytes is not None:
+        return _native_hash_bytes(data)
+    return int.from_bytes(hashlib.blake2b(data, digest_size=16).digest(), "little")
+
+
+def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
+    """Derive a deterministic Pointer from a tuple of values
+    (reference: python/pathway/internals/api.py ``ref_scalar``)."""
+    if optional and any(v is None for v in values):
+        return None  # type: ignore[return-value]
+    out = bytearray()
+    for v in values:
+        _serialize(v, out)
+    return Pointer(_digest128(bytes(out)))
+
+
+def ref_pointer(values: Iterable[Any], instance: Any = None) -> Pointer:
+    """Key for a row; if ``instance`` given, pin the shard field to the
+    instance hash (reference: value.rs:94 ``ShardPolicy::LastKeyColumn``)."""
+    key = ref_scalar(*values)
+    if instance is not None:
+        inst_key = ref_scalar(instance)
+        key = key.with_shard(inst_key.value >> (128 - SHARD_BITS))
+    return key
+
+
+def unsafe_make_pointer(value: int) -> Pointer:
+    """reference: python/pathway/internals/api.py ``unsafe_make_pointer``"""
+    return Pointer(int(value))
+
+
+def shard_of_key(key: Pointer, num_shards: int) -> int:
+    """Map a key to one of ``num_shards`` workers/devices.
+
+    Uses the *high* bits so that instance-pinned shard fields (low 16 bits)
+    can be honored separately via ``key.shard % num_shards`` by callers that
+    opt into instance policy."""
+    return (key.value >> SHARD_BITS) % num_shards

@@ -1,0 +1,390 @@
+"""Reducers for groupby/reduce.
+
+reference: src/engine/reduce.rs:22 (``Reducer`` enum: Count, IntSum/FloatSum/
+ArraySum, Unique, Min/Max, ArgMin/ArgMax, SortedTuple, Tuple, Any, Earliest,
+Latest, Stateful) and python/pathway/internals/reducers.py +
+custom_reducers.py.
+
+Engine contract: :meth:`Reducer.compute` receives the group's multiset as a
+list of ``(args, count, key, seq)`` where ``args`` is this reducer's argument
+tuple per distinct input row, ``count`` its multiplicity, ``key`` the source
+row id and ``seq`` a monotone insertion stamp (for earliest/latest).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from . import dtype as dt
+from .expression import ColumnExpression, ReducerExpression, smart_wrap
+
+__all__ = [
+    "Reducer",
+    "count",
+    "sum",
+    "avg",
+    "min",
+    "max",
+    "argmin",
+    "argmax",
+    "unique",
+    "any",
+    "tuple",
+    "sorted_tuple",
+    "ndarray",
+    "earliest",
+    "latest",
+    "stateful_single",
+    "stateful_many",
+    "udf_reducer",
+]
+
+_builtin_sum = sum
+_builtin_min = min
+_builtin_max = max
+_builtin_any = any
+_builtin_tuple = tuple
+
+
+def _arg1(args):
+    return args[0] if isinstance(args, _builtin_tuple) else args
+
+
+class Reducer:
+    name = "reducer"
+    distinguish_by_key = False
+
+    def result_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.ANY
+
+    def compute(self, rows: list) -> Any:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"reducers.{self.name}"
+
+
+class CountReducer(Reducer):
+    name = "count"
+
+    def result_dtype(self, arg_dtypes):
+        return dt.INT
+
+    def compute(self, rows):
+        return _builtin_sum(c for _, c, _, _ in rows)
+
+
+class SumReducer(Reducer):
+    name = "sum"
+
+    def result_dtype(self, arg_dtypes):
+        inner = dt.unoptionalize(arg_dtypes[0]) if arg_dtypes else dt.ANY
+        if inner in (dt.INT, dt.FLOAT) or isinstance(inner, dt.Array):
+            return inner
+        return dt.ANY
+
+    def compute(self, rows):
+        total = None
+        for args, c, _, _ in rows:
+            v = _arg1(args)
+            if v is None:
+                continue
+            contrib = v * c
+            total = contrib if total is None else total + contrib
+        return total if total is not None else 0
+
+
+class AvgReducer(Reducer):
+    name = "avg"
+
+    def result_dtype(self, arg_dtypes):
+        return dt.FLOAT
+
+    def compute(self, rows):
+        total = 0.0
+        n = 0
+        for args, c, _, _ in rows:
+            v = _arg1(args)
+            if v is None:
+                continue
+            total += v * c
+            n += c
+        return total / n if n else None
+
+
+class MinReducer(Reducer):
+    name = "min"
+
+    def result_dtype(self, arg_dtypes):
+        return arg_dtypes[0] if arg_dtypes else dt.ANY
+
+    def compute(self, rows):
+        vals = [_arg1(a) for a, c, _, _ in rows if _arg1(a) is not None]
+        return _builtin_min(vals) if vals else None
+
+
+class MaxReducer(MinReducer):
+    name = "max"
+
+    def compute(self, rows):
+        vals = [_arg1(a) for a, c, _, _ in rows if _arg1(a) is not None]
+        return _builtin_max(vals) if vals else None
+
+
+class ArgMinReducer(Reducer):
+    name = "argmin"
+    distinguish_by_key = True
+    _pick = staticmethod(_builtin_min)
+
+    def result_dtype(self, arg_dtypes):
+        return dt.POINTER
+
+    def compute(self, rows):
+        # deterministic tie-break on key, like the reference (reduce.rs ArgMin)
+        best = self._pick(
+            ((a[0], k) for a, c, k, _ in rows if a[0] is not None),
+            default=None,
+        )
+        return best[1] if best is not None else None
+
+
+class ArgMaxReducer(ArgMinReducer):
+    name = "argmax"
+    _pick = staticmethod(_builtin_max)
+
+
+class UniqueReducer(Reducer):
+    name = "unique"
+
+    def result_dtype(self, arg_dtypes):
+        return arg_dtypes[0] if arg_dtypes else dt.ANY
+
+    def compute(self, rows):
+        from .engine import freeze_value
+
+        distinct = {freeze_value(_arg1(a)): _arg1(a) for a, c, _, _ in rows}
+        if len(distinct) != 1:
+            raise ValueError(
+                f"More than one distinct value passed to the unique reducer: {list(distinct.values())[:2]}"
+            )
+        return next(iter(distinct.values()))
+
+
+class AnyReducer(Reducer):
+    name = "any"
+    distinguish_by_key = True
+
+    def result_dtype(self, arg_dtypes):
+        return arg_dtypes[0] if arg_dtypes else dt.ANY
+
+    def compute(self, rows):
+        # deterministic: smallest key wins
+        best = _builtin_min(rows, key=lambda r: r[2])
+        return _arg1(best[0])
+
+
+class TupleReducer(Reducer):
+    name = "tuple"
+    distinguish_by_key = True
+
+    def __init__(self, skip_nones: bool = False):
+        self.skip_nones = skip_nones
+
+    def result_dtype(self, arg_dtypes):
+        inner = arg_dtypes[0] if arg_dtypes else dt.ANY
+        return dt.List(dt.unoptionalize(inner) if self.skip_nones else inner)
+
+    def compute(self, rows):
+        out = []
+        for a, c, k, seq in sorted(rows, key=lambda r: r[3]):
+            v = _arg1(a)
+            if self.skip_nones and v is None:
+                continue
+            out.extend([v] * c)
+        return _builtin_tuple(out)
+
+
+class SortedTupleReducer(TupleReducer):
+    name = "sorted_tuple"
+
+    def compute(self, rows):
+        out = []
+        for a, c, _, _ in rows:
+            v = _arg1(a)
+            if self.skip_nones and v is None:
+                continue
+            out.extend([v] * c)
+        return _builtin_tuple(sorted(out))
+
+
+class NdarrayReducer(TupleReducer):
+    name = "ndarray"
+
+    def result_dtype(self, arg_dtypes):
+        return dt.ANY_ARRAY
+
+    def compute(self, rows):
+        vals = super().compute(rows)
+        return np.array(vals)
+
+
+class EarliestReducer(Reducer):
+    name = "earliest"
+    distinguish_by_key = True
+    _pick = staticmethod(_builtin_min)
+
+    def result_dtype(self, arg_dtypes):
+        return arg_dtypes[0] if arg_dtypes else dt.ANY
+
+    def compute(self, rows):
+        best = self._pick(rows, key=lambda r: r[3])
+        return _arg1(best[0])
+
+
+class LatestReducer(EarliestReducer):
+    name = "latest"
+    _pick = staticmethod(_builtin_max)
+
+
+class StatefulReducer(Reducer):
+    """``stateful_single``/``stateful_many`` custom reducers
+    (reference: internals/custom_reducers.py:409)."""
+
+    name = "stateful"
+
+    def __init__(self, combine_single: Callable | None = None, combine_many: Callable | None = None, result_type: Any = None):
+        self.combine_single = combine_single
+        self.combine_many = combine_many
+        self._result_type = result_type
+
+    def result_dtype(self, arg_dtypes):
+        if self._result_type is not None:
+            return dt.wrap(self._result_type)
+        return dt.ANY
+
+    def compute(self, rows):
+        if self.combine_many is not None:
+            state = None
+            for a, c, _, seq in sorted(rows, key=lambda r: r[3]):
+                args = a if isinstance(a, _builtin_tuple) else (a,)
+                state = self.combine_many(state, [(args, c)])
+            return state
+        state = None
+        for a, c, _, seq in sorted(rows, key=lambda r: r[3]):
+            v = _arg1(a)
+            for _ in range(c):
+                state = self.combine_single(state, v)
+        return state
+
+
+# ---------------------------------------------------------------------------
+# public constructors (pw.reducers.*)
+# ---------------------------------------------------------------------------
+
+
+def count(*args) -> ColumnExpression:
+    return ReducerExpression(CountReducer(), *(args or (0,)))
+
+
+def sum(expr) -> ColumnExpression:
+    return ReducerExpression(SumReducer(), expr)
+
+
+def avg(expr) -> ColumnExpression:
+    return ReducerExpression(AvgReducer(), expr)
+
+
+def min(expr) -> ColumnExpression:
+    return ReducerExpression(MinReducer(), expr)
+
+
+def max(expr) -> ColumnExpression:
+    return ReducerExpression(MaxReducer(), expr)
+
+
+def argmin(expr) -> ColumnExpression:
+    return ReducerExpression(ArgMinReducer(), expr)
+
+
+def argmax(expr) -> ColumnExpression:
+    return ReducerExpression(ArgMaxReducer(), expr)
+
+
+def unique(expr) -> ColumnExpression:
+    return ReducerExpression(UniqueReducer(), expr)
+
+
+def any(expr) -> ColumnExpression:
+    return ReducerExpression(AnyReducer(), expr)
+
+
+def tuple(expr, *, skip_nones: bool = False) -> ColumnExpression:
+    return ReducerExpression(TupleReducer(skip_nones=skip_nones), expr)
+
+
+def sorted_tuple(expr, *, skip_nones: bool = False) -> ColumnExpression:
+    return ReducerExpression(SortedTupleReducer(skip_nones=skip_nones), expr)
+
+
+def ndarray(expr, *, skip_nones: bool = False) -> ColumnExpression:
+    return ReducerExpression(NdarrayReducer(skip_nones=skip_nones), expr)
+
+
+def earliest(expr) -> ColumnExpression:
+    return ReducerExpression(EarliestReducer(), expr)
+
+
+def latest(expr) -> ColumnExpression:
+    return ReducerExpression(LatestReducer(), expr)
+
+
+def stateful_single(combine_fn: Callable, result_type: Any = None):
+    """reference: custom_reducers.py ``stateful_single``"""
+
+    def make(*args) -> ColumnExpression:
+        return ReducerExpression(
+            StatefulReducer(combine_single=combine_fn, result_type=result_type), *args
+        )
+
+    return make
+
+
+def stateful_many(combine_fn: Callable, result_type: Any = None):
+    def make(*args) -> ColumnExpression:
+        return ReducerExpression(
+            StatefulReducer(combine_many=combine_fn, result_type=result_type), *args
+        )
+
+    return make
+
+
+def udf_reducer(reducer_cls):
+    """Accumulator-class custom reducer (reference: custom_reducers.py
+    ``udf_reducer`` over BaseCustomAccumulator)."""
+
+    class _UDFReducer(Reducer):
+        name = getattr(reducer_cls, "__name__", "udf_reducer")
+
+        def result_dtype(self, arg_dtypes):
+            import typing
+
+            hints = typing.get_type_hints(getattr(reducer_cls, "retrieve", None)) if hasattr(reducer_cls, "retrieve") else {}
+            if "return" in hints:
+                return dt.wrap(hints["return"])
+            return dt.ANY
+
+        def compute(self, rows):
+            acc = None
+            for a, c, _, seq in sorted(rows, key=lambda r: r[3]):
+                args = a if isinstance(a, _builtin_tuple) else (a,)
+                for _ in range(c):
+                    nxt = reducer_cls.from_row(list(args))
+                    acc = nxt if acc is None else acc + nxt
+            return acc.retrieve() if acc is not None else None
+
+    def make(*args) -> ColumnExpression:
+        return ReducerExpression(_UDFReducer(), *args)
+
+    return make

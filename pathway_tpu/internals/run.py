@@ -1,0 +1,64 @@
+"""``pw.run`` — execute the dataflow.
+
+reference: python/pathway/internals/run.py:12 + graph_runner/__init__.py:129.
+Batch graphs run to fixpoint; graphs with live connectors enter the
+streaming loop (``io.streaming.StreamingDriver``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+from .graph import G
+from .runtime import GraphRunner
+
+__all__ = ["run", "run_all", "MonitoringLevel"]
+
+
+class MonitoringLevel(enum.Enum):
+    """reference: internals/monitoring.py MonitoringLevel"""
+
+    AUTO = 0
+    AUTO_ALL = 1
+    NONE = 2
+    IN_OUT = 3
+    ALL = 4
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level: MonitoringLevel = MonitoringLevel.AUTO,
+    with_http_server: bool = False,
+    default_logging: bool = True,
+    persistence_config: Any = None,
+    runtime_typechecking: bool = True,
+    terminate_on_error: bool = True,
+    **kwargs: Any,
+) -> None:
+    from .evaluator import EvalContext
+
+    EvalContext.terminate_on_error = terminate_on_error
+
+    sinks = list(getattr(G, "sinks", []))
+    if not sinks:
+        return
+
+    runner = GraphRunner()
+    engine = runner.build([(table, node) for table, node in sinks])
+
+    from ..io.streaming import StreamingDriver
+
+    driver = StreamingDriver(
+        engine,
+        runner,
+        persistence_config=persistence_config,
+        monitoring_level=monitoring_level,
+        with_http_server=with_http_server,
+    )
+    driver.run()
+
+
+def run_all(**kwargs: Any) -> None:
+    run(**kwargs)

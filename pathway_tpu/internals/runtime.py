@@ -1,0 +1,630 @@
+"""GraphRunner: lower the parse graph onto micro-batch engine nodes.
+
+reference: python/pathway/internals/graph_runner/__init__.py:36 (GraphRunner),
+storage_graph.py (column layout), expression_evaluator.py (lowering) — all
+collapsed into one pass here since the runtime is in-process Python instead
+of a PyO3-bridged Rust engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from . import dtype as dt
+from .engine import (
+    AsyncMapNode,
+    ConcatNode,
+    DeduplicateNode,
+    Engine,
+    GroupByNode,
+    JoinNode,
+    Node,
+    OutputNode,
+    RowwiseNode,
+    SemiJoinNode,
+    SourceNode,
+    UpdateCellsNode,
+    UpdateRowsNode,
+    ZipNode,
+)
+from .evaluator import compile_expression
+from .expression import (
+    AsyncApplyExpression,
+    ColumnExpression,
+    ColumnReference,
+    IdExpression,
+    ApplyExpression,
+)
+from .graph import G, Operator
+from .groupbys import _GroupColExpression, _ReducerSlotExpression
+from .joins import JoinMode
+from .keys import ref_pointer, ref_scalar
+from .value import Pointer
+
+__all__ = ["GraphRunner", "build_engine"]
+
+
+class _SlotExpression(ColumnExpression):
+    """Reference to a precomputed async-result slot appended to the row."""
+
+    def __init__(self, flat_idx: int, dtype: dt.DType):
+        super().__init__()
+        self.flat_idx = flat_idx
+        self._slot_dtype = dtype
+
+    def _compute_dtype(self) -> dt.DType:
+        return self._slot_dtype
+
+
+def _contains_async(e: ColumnExpression) -> bool:
+    if isinstance(e, AsyncApplyExpression):
+        return True
+    return any(_contains_async(d) for d in e._deps())
+
+
+def _contains_nondeterministic(e: ColumnExpression) -> bool:
+    if isinstance(e, ApplyExpression) and not e.deterministic:
+        return True
+    return any(_contains_nondeterministic(d) for d in e._deps())
+
+
+class _TableLayout:
+    """Flat row layout over the operator's input tables."""
+
+    def __init__(self, tables: list):
+        self.tables = tables
+        self.offsets: dict[int, int] = {}
+        off = 0
+        for t in tables:
+            self.offsets[id(t)] = off
+            off += len(t.column_names())
+        self.width = off
+        self.col_idx: dict[int, dict[str, int]] = {
+            id(t): {n: i for i, n in enumerate(t.column_names())} for t in tables
+        }
+
+    def resolver(self, extra_slots: int = 0):
+        def resolve(ref: ColumnReference) -> Callable:
+            if isinstance(ref, _SlotExpression):
+                idx = ref.flat_idx
+                return lambda ctx: ctx[1][idx]
+            if ref.name == "id":
+                return lambda ctx: ctx[0]
+            t = ref.table
+            if id(t) not in self.offsets:
+                raise ValueError(
+                    f"expression references table not among operator inputs: "
+                    f"{ref!r} (did you mean to join/ix?)"
+                )
+            idx = self.offsets[id(t)] + self.col_idx[id(t)][ref.name]
+            return lambda ctx: ctx[1][idx]
+
+        return resolve
+
+
+class GraphRunner:
+    """Builds an Engine from the parse graph, tree-shaken from outputs."""
+
+    def __init__(self):
+        self.engine = Engine()
+        self.table_node: dict[int, Node] = {}  # id(table) -> producing node
+        self.source_nodes: list[tuple[SourceNode, Operator]] = []
+
+    # ---- public ----
+    def build(self, output_requests: list[tuple[Any, OutputNode]]) -> Engine:
+        ops = G.relevant_operators([t._operator for t, _ in output_requests])
+        for op in ops:
+            self._lower(op)
+        for table, out_node in output_requests:
+            self.engine.add(out_node)
+            self._node_of(table).downstream.append((out_node, 0))
+        self._feed_static_sources()
+        return self.engine
+
+    def _feed_static_sources(self):
+        for src, op in self.source_nodes:
+            rows = op.params.get("rows")
+            if rows is not None:
+                entries = [(key, row, 1) for key, row in rows]
+                src.push(0, entries)
+            stream = op.params.get("stream")
+            if stream is not None:
+                for t, key, values, diff in stream:
+                    src.push(t, [(key, values, diff)])
+
+    # ---- helpers ----
+    def _node_of(self, table) -> Node:
+        return self.table_node[id(table)]
+
+    def _register(self, op: Operator, node: Node) -> None:
+        for out_table in op.outputs:
+            self.table_node[id(out_table)] = node
+
+    def _connect_inputs(self, op: Operator, node: Node) -> None:
+        for port, t in enumerate(op.inputs):
+            self._node_of(t).downstream.append((node, port))
+
+    # ---- lowering dispatch ----
+    def _lower(self, op: Operator) -> None:
+        handler = getattr(self, f"_lower_{op.kind}", None)
+        if handler is None:
+            raise NotImplementedError(f"no lowering for operator kind {op.kind!r}")
+        handler(op)
+
+    def _lower_input(self, op: Operator) -> None:
+        src = SourceNode(name=f"input#{op.id}")
+        self.engine.add(src)
+        self.source_nodes.append((src, op))
+        subject = op.params.get("subject")
+        if subject is not None:
+            subject._attach(src, self.engine)
+        self._register(op, src)
+
+    # rowwise family -------------------------------------------------------
+    def _rowwise_pipeline(
+        self,
+        op: Operator,
+        exprs: dict[str, ColumnExpression],
+        final_builder: Callable[[list[Callable], _TableLayout], Node],
+    ) -> None:
+        """Shared select/filter pipeline: [zip] -> [async map] -> final node."""
+        inputs = op.inputs
+        layout = _TableLayout(inputs)
+        upstream: Node | None = None
+
+        if len(inputs) > 1:
+            zip_node = ZipNode(
+                len(inputs),
+                fn=lambda key, rows: tuple(v for r in rows for v in r),
+                name=f"zip#{op.id}",
+            )
+            self.engine.add(zip_node)
+            self._connect_inputs(op, zip_node)
+            upstream = zip_node
+        # async slots
+        async_slots: list[AsyncApplyExpression] = []
+
+        def collect_async(e: ColumnExpression):
+            if isinstance(e, AsyncApplyExpression):
+                if not any(e is s for s in async_slots):
+                    async_slots.append(e)
+                return
+            for d in e._deps():
+                collect_async(d)
+
+        for e in exprs.values():
+            collect_async(e)
+
+        extra = 0
+        if async_slots:
+            resolve = layout.resolver()
+            slot_fns = []
+            capacity = None
+            for s in async_slots:
+                arg_fns = [compile_expression(a, resolve) for a in s.args]
+                kwarg_fns = {k: compile_expression(v, resolve) for k, v in s.kwargs.items()}
+                fun = s.fun
+                slot_fns.append((fun, arg_fns, kwarg_fns, s.propagate_none))
+                cap = getattr(s, "capacity", None)
+                if cap is not None:
+                    capacity = cap if capacity is None else min(capacity, cap)
+
+            async def async_fn(row, _slot_fns=slot_fns):
+                import asyncio
+
+                key, values = row
+                ctx = (key, values)
+                results = []
+                for fun, arg_fns, kwarg_fns, propagate_none in _slot_fns:
+                    args = [f(ctx) for f in arg_fns]
+                    kwargs = {k: f(ctx) for k, f in kwarg_fns.items()}
+                    if propagate_none and any(a is None for a in args):
+                        results.append(None)
+                        continue
+                    results.append(await fun(*args, **kwargs))
+                return (key, tuple(values) + tuple(results))
+
+            # AsyncMapNode operates on rows; we need key in ctx, so wrap rows
+            wrap_in = RowwiseNode(
+                lambda key, row, diff: [(key, ((key, row),), diff)],
+                name=f"asyncwrap#{op.id}",
+            )
+            self.engine.add(wrap_in)
+            if upstream is None:
+                self._connect_inputs(op, wrap_in)
+            else:
+                upstream.downstream.append((wrap_in, 0))
+            amap = AsyncMapNode(
+                lambda row: async_fn(row[0]), capacity=capacity, name=f"async#{op.id}"
+            )
+            self.engine.add(amap)
+            wrap_in.downstream.append((amap, 0))
+            unwrap = RowwiseNode(
+                lambda key, row, diff: [(key, row[1], diff)],
+                name=f"asyncunwrap#{op.id}",
+            )
+            self.engine.add(unwrap)
+            amap.downstream.append((unwrap, 0))
+            upstream = unwrap
+            # substitute async subtrees with slot refs
+            base_width = layout.width
+
+            def subst(node: ColumnExpression) -> ColumnExpression | None:
+                for i, s in enumerate(async_slots):
+                    if node is s:
+                        return _SlotExpression(base_width + i, s.return_type)
+                return None
+
+            exprs = {n: e._substitute(subst) for n, e in exprs.items()}
+            extra = len(async_slots)
+
+        resolve = layout.resolver(extra)
+        fns = [compile_expression(e, resolve) for e in exprs.values()]
+        final = final_builder(fns, layout)
+        self.engine.add(final)
+        if upstream is None:
+            self._connect_inputs(op, final)
+        else:
+            upstream.downstream.append((final, 0))
+        self._register(op, final)
+
+    def _lower_rowwise(self, op: Operator) -> None:
+        exprs = op.params["exprs"]
+        memoize = any(_contains_nondeterministic(e) for e in exprs.values())
+
+        def builder(fns, layout):
+            def fn(key, row, diff):
+                ctx = (key, row)
+                return [(key, tuple(f(ctx) for f in fns), diff)]
+
+            return RowwiseNode(fn, memoize=memoize, name=f"select#{op.id}")
+
+        self._rowwise_pipeline(op, exprs, builder)
+
+    def _lower_filter(self, op: Operator) -> None:
+        cond = op.params["condition"]
+        primary = op.inputs[0]
+        width = len(primary.column_names())
+
+        def builder(fns, layout):
+            cond_fn = fns[0]
+
+            def fn(key, row, diff):
+                ctx = (key, row)
+                if cond_fn(ctx):
+                    return [(key, tuple(row[:width]), diff)]
+                return []
+
+            return RowwiseNode(fn, name=f"filter#{op.id}")
+
+        self._rowwise_pipeline(op, {"__cond__": cond}, builder)
+
+    def _lower_flatten(self, op: Operator) -> None:
+        primary = op.inputs[0]
+        names = primary.column_names()
+        col_idx = names.index(op.params["column"])
+        origin = op.params.get("origin_id") is not None
+
+        def fn(key, row, diff):
+            seq = row[col_idx]
+            if seq is None:
+                return []
+            out = []
+            for i, v in enumerate(_iter_flat(seq)):
+                new_row = list(row)
+                new_row[col_idx] = v
+                if origin:
+                    new_row.append(key)
+                out.append((ref_scalar(key, i), tuple(new_row), diff))
+            return out
+
+        node = RowwiseNode(fn, name=f"flatten#{op.id}")
+        self.engine.add(node)
+        self._connect_inputs(op, node)
+        self._register(op, node)
+
+    def _lower_reindex(self, op: Operator) -> None:
+        exprs = op.params["exprs"]
+        instance = op.params.get("instance")
+        raw = op.params.get("raw", False)
+        layout = _TableLayout(op.inputs)
+        resolve = layout.resolver()
+        fns = [compile_expression(e, resolve) for e in exprs]
+        inst_fn = compile_expression(instance, resolve) if instance is not None else None
+
+        def fn(key, row, diff):
+            ctx = (key, row)
+            vals = [f(ctx) for f in fns]
+            if raw:
+                new_key = vals[0]
+            else:
+                new_key = ref_pointer(vals, inst_fn(ctx) if inst_fn else None)
+            return [(new_key, row, diff)]
+
+        node = RowwiseNode(fn, name=f"reindex#{op.id}")
+        self.engine.add(node)
+        self._connect_inputs(op, node)
+        self._register(op, node)
+
+    # stateful -------------------------------------------------------------
+    def _lower_groupby(self, op: Operator) -> None:
+        table = op.inputs[0]
+        layout = _TableLayout([table])
+        resolve = layout.resolver()
+        grouping = op.params["grouping"]
+        reducers = op.params["reducers"]
+        out_exprs = op.params["out_exprs"]
+        set_id = op.params.get("set_id", False)
+
+        g_fns = [compile_expression(g, resolve) for g in grouping]
+        red_arg_fns = [
+            [compile_expression(a, resolve) for a in r.args] for r in reducers
+        ]
+        instance = op.params.get("instance")
+        inst_fn = compile_expression(instance, resolve) if instance is not None else None
+        sort_by = op.params.get("sort_by")
+        sort_fn = compile_expression(sort_by, resolve) if sort_by is not None else None
+
+        def out_resolve(ref):
+            if isinstance(ref, _GroupColExpression):
+                slot = ref.slot
+                return lambda ctx: ctx[0][slot]
+            if isinstance(ref, _ReducerSlotExpression):
+                slot = ref.slot
+                return lambda ctx: ctx[1][slot]
+            raise ValueError(f"unexpected reference in reduce output: {ref!r}")
+
+        out_fns = [compile_expression(e, out_resolve) for e in out_exprs.values()]
+
+        def group_fn(key, row):
+            ctx = (key, row)
+            return tuple(f(ctx) for f in g_fns)
+
+        def args_fn(key, row):
+            ctx = (key, row)
+            return tuple(
+                tuple(f(ctx) for f in arg_fns) for arg_fns in red_arg_fns
+            )
+
+        def out_fn(gvals, rvals):
+            ctx = (gvals, rvals)
+            return tuple(f(ctx) for f in out_fns)
+
+        def key_fn(gvals, instance_val):
+            if set_id:
+                return gvals[0]
+            return ref_pointer(gvals, instance_val)
+
+        node = GroupByNode(
+            group_fn=group_fn,
+            instance_fn=(lambda key, row: inst_fn((key, row))) if inst_fn else None,
+            args_fn=args_fn,
+            out_fn=out_fn,
+            key_fn=key_fn,
+            reducers=[r.reducer for r in reducers],
+            sort_by_fn=(lambda key, row: sort_fn((key, row))) if sort_fn else None,
+            name=f"groupby#{op.id}",
+        )
+        self.engine.add(node)
+        self._connect_inputs(op, node)
+        self._register(op, node)
+
+    def _lower_join(self, op: Operator) -> None:
+        left, right = op.inputs
+        mode: JoinMode = op.params["mode"]
+        on = op.params["on"]
+        out_exprs = op.params["out_exprs"]
+        id_expr = op.params.get("id_expr")
+
+        llayout = _TableLayout([left])
+        rlayout = _TableLayout([right])
+        lfns = [compile_expression(le, llayout.resolver()) for le, _ in on]
+        rfns = [compile_expression(re, rlayout.resolver()) for _, re in on]
+
+        lcols = {n: i for i, n in enumerate(left.column_names())}
+        rcols = {n: i for i, n in enumerate(right.column_names())}
+
+        def join_resolve(ref: ColumnReference):
+            if ref.name == "id":
+                if ref.table is left:
+                    return lambda ctx: ctx[0]
+                if ref.table is right:
+                    return lambda ctx: ctx[2]
+                raise ValueError("id reference to table outside join")
+            if ref.table is left:
+                idx = lcols[ref.name]
+                return lambda ctx: (ctx[1][idx] if ctx[1] is not None else None)
+            if ref.table is right:
+                idx = rcols[ref.name]
+                return lambda ctx: (ctx[3][idx] if ctx[3] is not None else None)
+            raise ValueError(
+                f"join select references table that is neither side: {ref!r}"
+            )
+
+        out_fns = [compile_expression(e, join_resolve) for e in out_exprs.values()]
+
+        def out_fn(lkey, lrow, rkey, rrow):
+            ctx = (lkey, lrow, rkey, rrow)
+            return tuple(f(ctx) for f in out_fns)
+
+        if id_expr is not None:
+            if isinstance(id_expr, IdExpression) and id_expr.table is left:
+                out_key_fn = lambda lkey, lrow, rkey, rrow: lkey
+            elif isinstance(id_expr, IdExpression) and id_expr.table is right:
+                out_key_fn = lambda lkey, lrow, rkey, rrow: rkey
+            else:
+                id_fn = compile_expression(id_expr, join_resolve)
+                out_key_fn = lambda lkey, lrow, rkey, rrow: id_fn(
+                    (lkey, lrow, rkey, rrow)
+                )
+        else:
+            out_key_fn = lambda lkey, lrow, rkey, rrow: ref_scalar(lkey, rkey)
+
+        node = JoinNode(
+            left_key_fn=lambda key, row: tuple(f((key, row)) for f in lfns),
+            right_key_fn=lambda key, row: tuple(f((key, row)) for f in rfns),
+            out_fn=out_fn,
+            out_key_fn=out_key_fn,
+            left_outer=mode in (JoinMode.LEFT, JoinMode.OUTER),
+            right_outer=mode in (JoinMode.RIGHT, JoinMode.OUTER),
+            exact_match=op.params.get("exact_match", False),
+            name=f"join#{op.id}",
+        )
+        self.engine.add(node)
+        self._connect_inputs(op, node)
+        self._register(op, node)
+
+    def _lower_ix(self, op: Operator) -> None:
+        context_t, source_t = op.inputs
+        optional = op.params["optional"]
+        ptr = op.params["ptr"]
+        layout = _TableLayout([context_t])
+        ptr_fn = compile_expression(ptr, layout.resolver())
+        n_cols = len(source_t.column_names())
+
+        def out_fn(lkey, lrow, rkey, rrow):
+            if rrow is None:
+                if not optional:
+                    raise KeyError(
+                        f"ix: no row with key referenced by {ptr!r}"
+                    )
+                return tuple([None] * n_cols)
+            return tuple(rrow)
+
+        node = JoinNode(
+            left_key_fn=lambda key, row: ptr_fn((key, row)),
+            right_key_fn=lambda key, row: key,
+            out_fn=out_fn,
+            out_key_fn=lambda lkey, lrow, rkey, rrow: lkey,
+            left_outer=True,  # always emit context rows; missing handled above
+            right_outer=False,
+            name=f"ix#{op.id}",
+        )
+        self.engine.add(node)
+        self._connect_inputs(op, node)
+        self._register(op, node)
+
+    def _lower_concat(self, op: Operator) -> None:
+        # align each input's columns to the output order
+        names = op.outputs[0].column_names()
+        node = ConcatNode(len(op.inputs), reindex=op.params["reindex"], name=f"concat#{op.id}")
+        self.engine.add(node)
+        for port, t in enumerate(op.inputs):
+            proj = self._projection(t, names, f"concatproj#{op.id}.{port}")
+            self._node_of(t).downstream.append((proj, 0))
+            proj.downstream.append((node, port))
+        self._register(op, node)
+
+    def _projection(self, table, names: list[str], name: str) -> Node:
+        src_names = table.column_names()
+        if src_names == names:
+            idxs = None
+        else:
+            idxs = [src_names.index(n) for n in names]
+        if idxs is None:
+            fn = lambda key, row, diff: [(key, row, diff)]
+        else:
+            fn = lambda key, row, diff: [(key, tuple(row[i] for i in idxs), diff)]
+        node = RowwiseNode(fn, name=name)
+        self.engine.add(node)
+        return node
+
+    def _lower_update_rows(self, op: Operator) -> None:
+        names = op.outputs[0].column_names()
+        node = UpdateRowsNode(name=f"update_rows#{op.id}")
+        self.engine.add(node)
+        for port, t in enumerate(op.inputs):
+            proj = self._projection(t, names, f"urproj#{op.id}.{port}")
+            self._node_of(t).downstream.append((proj, 0))
+            proj.downstream.append((node, port))
+        self._register(op, node)
+
+    def _lower_update_cells(self, op: Operator) -> None:
+        node = UpdateCellsNode(op.params["positions"], name=f"update_cells#{op.id}")
+        self.engine.add(node)
+        self._connect_inputs(op, node)
+        self._register(op, node)
+
+    def _lower_semijoin(self, op: Operator) -> None:
+        right_key = op.params.get("right_key")
+        if right_key is not None:
+            rlayout = _TableLayout([op.inputs[1]])
+            rk_fn_c = compile_expression(right_key, rlayout.resolver())
+            right_key_fn = lambda key, row: rk_fn_c((key, row))
+        else:
+            right_key_fn = lambda key, row: key
+        node = SemiJoinNode(
+            mask_key_fn=lambda key, row: key,
+            right_key_fn=right_key_fn,
+            mode=op.params["mode"],
+            name=f"semijoin#{op.id}",
+        )
+        self.engine.add(node)
+        self._connect_inputs(op, node)
+        self._register(op, node)
+
+    def _lower_with_universe_of(self, op: Operator) -> None:
+        node = SemiJoinNode(
+            mask_key_fn=lambda key, row: key,
+            right_key_fn=lambda key, row: key,
+            mode="intersect",
+            name=f"with_universe_of#{op.id}",
+        )
+        self.engine.add(node)
+        self._connect_inputs(op, node)
+        self._register(op, node)
+
+    def _lower_deduplicate(self, op: Operator) -> None:
+        table = op.inputs[0]
+        layout = _TableLayout([table])
+        resolve = layout.resolver()
+        value_fn_c = compile_expression(op.params["value"], resolve)
+        instance = op.params.get("instance")
+        inst_fn_c = compile_expression(instance, resolve) if instance is not None else None
+        acceptor = op.params["acceptor"]
+        node = DeduplicateNode(
+            instance_fn=(lambda key, row: inst_fn_c((key, row))) if inst_fn_c else (lambda key, row: ()),
+            value_fn=lambda key, row: value_fn_c((key, row)),
+            acceptor=acceptor,
+            name=f"dedup#{op.id}",
+            persistent_id=op.params.get("persistent_id"),
+        )
+        self.engine.add(node)
+        self._connect_inputs(op, node)
+        self._register(op, node)
+
+    def _lower_external_index(self, op: Operator) -> None:
+        from ..stdlib.indexing.lowering import lower_external_index
+
+        lower_external_index(self, op)
+
+    def _lower_iterate(self, op: Operator) -> None:
+        from .iterate import lower_iterate
+
+        lower_iterate(self, op)
+
+    def _lower_sort(self, op: Operator) -> None:
+        from ..stdlib.indexing.lowering import lower_sort
+
+        lower_sort(self, op)
+
+
+def _iter_flat(seq):
+    import numpy as np
+
+    if isinstance(seq, np.ndarray):
+        return list(seq)
+    if isinstance(seq, str):
+        return list(seq)
+    if isinstance(seq, (tuple, list)):
+        return seq
+    from .value import Json
+
+    if isinstance(seq, Json):
+        inner = seq.value
+        return [Json(v) for v in inner]
+    raise TypeError(f"cannot flatten value of type {type(seq)}")
+
+
+def build_engine(output_requests) -> Engine:
+    return GraphRunner().build(output_requests)

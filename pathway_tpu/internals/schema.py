@@ -1,0 +1,324 @@
+"""Schema system: class-based table schemas with dtype-checked columns.
+
+reference: python/pathway/internals/schema.py:913 (``Schema`` metaclass,
+``column_definition``, ``schema_from_types``, ``schema_builder``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from . import dtype as dt
+
+__all__ = [
+    "Schema",
+    "SchemaProperties",
+    "ColumnSchema",
+    "column_definition",
+    "schema_from_types",
+    "schema_from_dict",
+    "schema_from_pandas",
+    "schema_builder",
+    "is_subschema",
+]
+
+_no_default = object()
+
+
+@dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    dtype: dt.DType
+    primary_key: bool = False
+    default_value: Any = _no_default
+    description: str | None = None
+    example: Any = None
+
+    @property
+    def has_default_value(self) -> bool:
+        return self.default_value is not _no_default
+
+
+class ColumnDefinition:
+    """Marker returned by :func:`column_definition`
+    (reference: schema.py ``column_definition``)."""
+
+    def __init__(
+        self,
+        *,
+        primary_key: bool = False,
+        default_value: Any = _no_default,
+        dtype: Any = None,
+        name: str | None = None,
+        description: str | None = None,
+        example: Any = None,
+    ):
+        self.primary_key = primary_key
+        self.default_value = default_value
+        self.dtype = dtype
+        self.name = name
+        self.description = description
+        self.example = example
+
+
+def column_definition(
+    *,
+    primary_key: bool = False,
+    default_value: Any = _no_default,
+    dtype: Any = None,
+    name: str | None = None,
+    description: str | None = None,
+    example: Any = None,
+) -> Any:
+    return ColumnDefinition(
+        primary_key=primary_key,
+        default_value=default_value,
+        dtype=dtype,
+        name=name,
+        description=description,
+        example=example,
+    )
+
+
+@dataclass(frozen=True)
+class SchemaProperties:
+    append_only: bool = False
+
+
+class SchemaMetaclass(type):
+    __columns__: dict[str, ColumnSchema]
+    __properties__: SchemaProperties
+
+    def __new__(mcs, name, bases, namespace, append_only: bool | None = None, **kwargs):
+        cls = super().__new__(mcs, name, bases, dict(namespace))
+        columns: dict[str, ColumnSchema] = {}
+        for base in bases:
+            if hasattr(base, "__columns__"):
+                columns.update(base.__columns__)
+        annotations = namespace.get("__annotations__", {})
+        for col_name, annotation in annotations.items():
+            if col_name.startswith("__"):
+                continue
+            definition = namespace.get(col_name, _no_default)
+            if isinstance(definition, ColumnDefinition):
+                dtype = dt.wrap(definition.dtype) if definition.dtype is not None else dt.wrap(annotation)
+                columns[definition.name or col_name] = ColumnSchema(
+                    name=definition.name or col_name,
+                    dtype=dtype,
+                    primary_key=definition.primary_key,
+                    default_value=definition.default_value,
+                    description=definition.description,
+                    example=definition.example,
+                )
+            else:
+                columns[col_name] = ColumnSchema(
+                    name=col_name,
+                    dtype=dt.wrap(annotation),
+                    default_value=definition,
+                )
+        cls.__columns__ = columns
+        inherited_ao = any(
+            getattr(getattr(base, "__properties__", None), "append_only", False)
+            for base in bases
+        )
+        cls.__properties__ = SchemaProperties(
+            append_only=inherited_ao if append_only is None else append_only
+        )
+        return cls
+
+    # --- schema algebra ---
+    def columns(cls) -> dict[str, ColumnSchema]:
+        return dict(cls.__columns__)
+
+    def column_names(cls) -> list[str]:
+        return list(cls.__columns__.keys())
+
+    def typehints(cls) -> dict[str, Any]:
+        return {n: c.dtype.typehint for n, c in cls.__columns__.items()}
+
+    def dtypes(cls) -> dict[str, dt.DType]:
+        return {n: c.dtype for n, c in cls.__columns__.items()}
+
+    def primary_key_columns(cls) -> list[str] | None:
+        pk = [n for n, c in cls.__columns__.items() if c.primary_key]
+        return pk or None
+
+    def default_values(cls) -> dict[str, Any]:
+        return {
+            n: c.default_value
+            for n, c in cls.__columns__.items()
+            if c.has_default_value
+        }
+
+    def keys(cls):
+        return cls.__columns__.keys()
+
+    def __getitem__(cls, name: str) -> ColumnSchema:
+        return cls.__columns__[name]
+
+    def __or__(cls, other: "SchemaMetaclass") -> "SchemaMetaclass":
+        cols = {**cls.__columns__}
+        for n, c in other.__columns__.items():
+            if n in cols and cols[n].dtype != c.dtype:
+                raise ValueError(f"column {n!r} has conflicting dtypes in schema union")
+            cols[n] = c
+        return _schema_from_columns(cols, name=f"{cls.__name__}|{other.__name__}")
+
+    def update_types(cls, **kwargs: Any) -> "SchemaMetaclass":
+        cols = dict(cls.__columns__)
+        for n, t in kwargs.items():
+            if n not in cols:
+                raise ValueError(f"no column {n!r} in schema")
+            cols[n] = ColumnSchema(
+                name=n,
+                dtype=dt.wrap(t),
+                primary_key=cols[n].primary_key,
+                default_value=cols[n].default_value,
+            )
+        return _schema_from_columns(cols, name=cls.__name__)
+
+    def update_properties(cls, **kwargs) -> "SchemaMetaclass":
+        schema = _schema_from_columns(dict(cls.__columns__), name=cls.__name__)
+        schema.__properties__ = SchemaProperties(**kwargs)
+        return schema
+
+    def without(cls, *names: str) -> "SchemaMetaclass":
+        names_set = {n if isinstance(n, str) else n.name for n in names}
+        cols = {n: c for n, c in cls.__columns__.items() if n not in names_set}
+        return _schema_from_columns(cols, name=cls.__name__)
+
+    def with_id_type(cls, target, **kwargs):
+        return cls
+
+    def __repr__(cls):
+        inner = ", ".join(f"{n}: {c.dtype!r}" for n, c in cls.__columns__.items())
+        return f"<Schema {cls.__name__}({inner})>"
+
+    def to_json_schema(cls) -> dict:
+        """OpenAPI/JSON-schema rendering (reference: io/http/_server.py
+        ``EndpointDocumentation``)."""
+        props = {}
+        required = []
+        type_map = {
+            dt.INT: "integer",
+            dt.FLOAT: "number",
+            dt.BOOL: "boolean",
+            dt.STR: "string",
+            dt.BYTES: "string",
+            dt.JSON: "object",
+        }
+        for n, c in cls.__columns__.items():
+            base = dt.unoptionalize(c.dtype)
+            props[n] = {"type": type_map.get(base, "string")}
+            if c.description:
+                props[n]["description"] = c.description
+            if not c.has_default_value and not isinstance(c.dtype, dt.Optional):
+                required.append(n)
+        schema: dict[str, Any] = {"type": "object", "properties": props}
+        if required:
+            schema["required"] = required
+        return schema
+
+
+_schema_counter = itertools.count()
+
+
+def _schema_from_columns(
+    columns: Mapping[str, ColumnSchema], name: str | None = None
+) -> "SchemaMetaclass":
+    name = name or f"Schema_{next(_schema_counter)}"
+    cls = SchemaMetaclass(name, (Schema,), {})
+    cls.__columns__ = dict(columns)
+    return cls
+
+
+class Schema(metaclass=SchemaMetaclass):
+    """Base class for user schemas::
+
+        class InputSchema(pw.Schema):
+            owner: str
+            pet: int = pw.column_definition(primary_key=True)
+    """
+
+
+def schema_from_types(_name: str | None = None, **kwargs: Any) -> SchemaMetaclass:
+    """reference: schema.py ``schema_from_types``"""
+    cols = {n: ColumnSchema(name=n, dtype=dt.wrap(t)) for n, t in kwargs.items()}
+    return _schema_from_columns(cols, name=_name)
+
+
+def schema_from_dict(
+    columns: Mapping[str, Any], *, name: str | None = None
+) -> SchemaMetaclass:
+    cols = {}
+    for n, spec in columns.items():
+        if isinstance(spec, dict):
+            cols[n] = ColumnSchema(
+                name=n,
+                dtype=dt.wrap(spec.get("dtype", Any)),
+                primary_key=spec.get("primary_key", False),
+                default_value=spec.get("default_value", _no_default),
+            )
+        else:
+            cols[n] = ColumnSchema(name=n, dtype=dt.wrap(spec))
+    return _schema_from_columns(cols, name=name)
+
+
+def schema_from_pandas(
+    df, *, id_from: list[str] | None = None, name: str | None = None, exclude_columns: set[str] = frozenset(),
+) -> SchemaMetaclass:
+    import numpy as np
+
+    cols = {}
+    for col in df.columns:
+        if col in exclude_columns:
+            continue
+        kind = df[col].dtype.kind
+        if kind == "i":
+            t: Any = int
+        elif kind == "f":
+            t = float
+        elif kind == "b":
+            t = bool
+        else:
+            inferred = {type(v) for v in df[col] if v is not None}
+            t = inferred.pop() if len(inferred) == 1 else Any
+            if t is np.str_:
+                t = str
+        cols[col] = ColumnSchema(
+            name=col, dtype=dt.wrap(t), primary_key=bool(id_from and col in id_from)
+        )
+    return _schema_from_columns(cols, name=name)
+
+
+def schema_builder(
+    columns: Mapping[str, ColumnDefinition],
+    *,
+    name: str | None = None,
+    properties: SchemaProperties | None = None,
+) -> SchemaMetaclass:
+    """reference: schema.py ``schema_builder``"""
+    cols = {}
+    for n, definition in columns.items():
+        dtype = dt.wrap(definition.dtype) if definition.dtype is not None else dt.ANY
+        cols[definition.name or n] = ColumnSchema(
+            name=definition.name or n,
+            dtype=dtype,
+            primary_key=definition.primary_key,
+            default_value=definition.default_value,
+        )
+    schema = _schema_from_columns(cols, name=name)
+    if properties is not None:
+        schema.__properties__ = properties
+    return schema
+
+
+def is_subschema(sub: SchemaMetaclass, sup: SchemaMetaclass) -> bool:
+    for n, c in sup.__columns__.items():
+        if n not in sub.__columns__:
+            return False
+        if not dt.dtype_issubclass(sub.__columns__[n].dtype, c.dtype):
+            return False
+    return True

@@ -1,0 +1,609 @@
+"""The Table API — keyed, incrementally-maintained tables.
+
+reference: python/pathway/internals/table.py (2675 LoC; select:382,
+filter:490, groupby:942, join flavors via joins.py, concat:1334,
+update_cells:1064, update_rows:1164, flatten, ix, deduplicate, …).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, TYPE_CHECKING
+
+from . import dtype as dt
+from .desugaring import expand_select_args, resolve_expression
+from .expression import (
+    CastExpression,
+    ColumnExpression,
+    ColumnReference,
+    DeclareTypeExpression,
+    IdExpression,
+    PointerExpression,
+    smart_wrap,
+)
+from .graph import Operator
+from .groupbys import GroupedTable
+from .joins import JoinMode, JoinResult
+from .schema import ColumnSchema, Schema, SchemaMetaclass, _schema_from_columns
+from .universe import Universe
+
+__all__ = ["Table", "TableLike", "groupby"]
+
+
+class Table:
+    """A keyed table = incrementally maintained collection of rows.
+
+    Each row has a 128-bit ``id`` (Pointer); every operation derives a new
+    lazy operator in the global parse graph, executed by ``pw.run`` /
+    ``pw.debug`` helpers."""
+
+    _operator: Operator
+    _schema: SchemaMetaclass
+    _universe: Universe
+
+    # -- construction --
+    @classmethod
+    def _new(cls, operator: Operator, schema: SchemaMetaclass, universe: Universe) -> "Table":
+        self = object.__new__(cls)
+        self._operator = operator
+        self._schema = schema
+        self._universe = universe
+        operator.outputs.append(self)
+        return self
+
+    @classmethod
+    def empty(cls, **kwargs: Any) -> "Table":
+        from .schema import schema_from_types
+
+        schema = schema_from_types(**kwargs)
+        op = Operator("input", [], params=dict(rows=[], schema=schema))
+        return cls._new(op, schema, Universe())
+
+    # -- basic info --
+    @property
+    def schema(self) -> SchemaMetaclass:
+        return self._schema
+
+    def column_names(self) -> list[str]:
+        return list(self._schema.column_names())
+
+    def keys(self):
+        return self._schema.keys()
+
+    def typehints(self) -> dict[str, Any]:
+        return self._schema.typehints()
+
+    @property
+    def id(self) -> IdExpression:
+        return IdExpression(self)
+
+    def __getattr__(self, name: str) -> ColumnReference:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._schema.__columns__:
+            return ColumnReference(self, name)
+        raise AttributeError(
+            f"Table has no column {name!r}; columns: {self.column_names()}"
+        )
+
+    def __getitem__(self, arg):
+        if isinstance(arg, str):
+            if arg == "id":
+                return self.id
+            return ColumnReference(self, arg)
+        if isinstance(arg, ColumnReference):
+            return ColumnReference(self, arg.name)
+        if isinstance(arg, (list, tuple)):
+            return self.select(*[self[a] for a in arg])
+        raise TypeError(f"cannot index Table with {arg!r}")
+
+    def __iter__(self):
+        raise TypeError("Table is not iterable; use pw.debug helpers")
+
+    def __repr__(self):
+        return f"<pathway_tpu.Table schema={dict(self._schema.dtypes())}>"
+
+    # -- core relational ops --
+    def select(self, *args: Any, **kwargs: Any) -> "Table":
+        """reference: table.py:382"""
+        exprs = expand_select_args(args, kwargs, self)
+        return self._select_exprs(exprs, universe=self._universe)
+
+    def _select_exprs(
+        self, exprs: dict[str, ColumnExpression], universe: Universe
+    ) -> "Table":
+        columns = {
+            name: ColumnSchema(name=name, dtype=e._dtype) for name, e in exprs.items()
+        }
+        schema = _schema_from_columns(columns)
+        extra = _referenced_tables(exprs.values(), primary=self)
+        op = Operator(
+            "rowwise",
+            [self, *extra],
+            params=dict(exprs=exprs),
+        )
+        return Table._new(op, schema, universe)
+
+    def filter(self, condition: Any) -> "Table":
+        """reference: table.py:490"""
+        cond = resolve_expression(condition, self)
+        extra = _referenced_tables([cond], primary=self)
+        op = Operator(
+            "filter",
+            [self, *extra],
+            params=dict(condition=cond),
+        )
+        return Table._new(op, self._schema, self._universe.subuniverse())
+
+    def split(self, condition: Any) -> tuple["Table", "Table"]:
+        cond = resolve_expression(condition, self)
+        positive = self.filter(cond)
+        negative = self.filter(~cond)
+        return positive, negative
+
+    def groupby(
+        self,
+        *args: Any,
+        id: ColumnReference | None = None,
+        sort_by: Any = None,
+        instance: Any = None,
+        **kwargs,
+    ) -> GroupedTable:
+        """reference: table.py:942"""
+        grouping = [resolve_expression(a, self) for a in args]
+        set_id = False
+        if id is not None:
+            grouping = [resolve_expression(id, self)]
+            set_id = True
+        return GroupedTable(
+            self,
+            grouping,
+            set_id=set_id,
+            sort_by=resolve_expression(sort_by, self) if sort_by is not None else None,
+            instance=resolve_expression(instance, self) if instance is not None else None,
+        )
+
+    def reduce(self, *args: Any, **kwargs: Any) -> "Table":
+        """Global reduction to a single row (reference: table.py reduce)."""
+        return GroupedTable(self, []).reduce(*args, **kwargs)
+
+    def deduplicate(
+        self,
+        *,
+        value: Any,
+        instance: Any = None,
+        acceptor: Any = None,
+        persistent_id: str | None = None,
+        name: str | None = None,
+    ) -> "Table":
+        """Keep one accepted row per instance
+        (reference: stdlib/stateful/deduplicate.py)."""
+        value_e = resolve_expression(value, self)
+        instance_e = (
+            resolve_expression(instance, self) if instance is not None else None
+        )
+        if acceptor is None:
+            acceptor = lambda new, old: new != old
+        op = Operator(
+            "deduplicate",
+            [self],
+            params=dict(
+                value=value_e,
+                instance=instance_e,
+                acceptor=acceptor,
+                persistent_id=persistent_id or name,
+            ),
+        )
+        return Table._new(op, self._schema, Universe())
+
+    # -- joins --
+    def join(
+        self,
+        other: "Table",
+        *on: Any,
+        id: Any = None,
+        how: JoinMode = JoinMode.INNER,
+        left_instance: Any = None,
+        right_instance: Any = None,
+        exact_match: bool = False,
+    ) -> JoinResult:
+        """reference: table.py join / joins.py:  modes INNER/LEFT/RIGHT/OUTER"""
+        on = list(on)
+        if left_instance is not None and right_instance is not None:
+            on.append(
+                smart_wrap(resolve_expression(left_instance, self))
+                == resolve_expression(right_instance, other)
+            )
+        id_expr = None
+        if id is not None:
+            id_expr = resolve_expression(id, self, self, other)
+        return JoinResult(self, other, tuple(on), how, id_expr, exact_match)
+
+    def join_inner(self, other: "Table", *on: Any, **kwargs: Any) -> JoinResult:
+        return self.join(other, *on, how=JoinMode.INNER, **kwargs)
+
+    def join_left(self, other: "Table", *on: Any, **kwargs: Any) -> JoinResult:
+        return self.join(other, *on, how=JoinMode.LEFT, **kwargs)
+
+    def join_right(self, other: "Table", *on: Any, **kwargs: Any) -> JoinResult:
+        return self.join(other, *on, how=JoinMode.RIGHT, **kwargs)
+
+    def join_outer(self, other: "Table", *on: Any, **kwargs: Any) -> JoinResult:
+        return self.join(other, *on, how=JoinMode.OUTER, **kwargs)
+
+    # -- set/universe ops --
+    def concat(self, *others: "Table") -> "Table":
+        """Universes must be disjoint (reference: table.py:1334)."""
+        tables = [self, *others]
+        schema = _common_schema(tables)
+        op = Operator("concat", tables, params=dict(reindex=False))
+        return Table._new(op, schema, Universe())
+
+    def concat_reindex(self, *others: "Table") -> "Table":
+        tables = [self, *others]
+        schema = _common_schema(tables)
+        op = Operator("concat", tables, params=dict(reindex=True))
+        return Table._new(op, schema, Universe())
+
+    def update_rows(self, other: "Table") -> "Table":
+        """reference: table.py:1164"""
+        schema = _common_schema([self, other])
+        universe = Universe()
+        self._universe.promise_subset_of(universe)
+        other._universe.promise_subset_of(universe)
+        op = Operator("update_rows", [self, other], params=dict())
+        return Table._new(op, schema, universe)
+
+    def update_cells(self, other: "Table") -> "Table":
+        """reference: table.py:1064"""
+        if not other._universe.is_subset_of(self._universe):
+            raise ValueError(
+                "update_cells: other table's universe is not a subset of self's; "
+                "use promise_universe_is_subset_of if this is guaranteed"
+            )
+        my_cols = self.column_names()
+        other_cols = other.column_names()
+        unknown = set(other_cols) - set(my_cols)
+        if unknown:
+            raise ValueError(f"update_cells: unknown columns {sorted(unknown)}")
+        positions = [
+            other_cols.index(c) if c in other_cols else None for c in my_cols
+        ]
+        columns = {}
+        for c in my_cols:
+            if c in other_cols:
+                dtype = dt.types_lcm(self._schema[c].dtype, other._schema[c].dtype)
+            else:
+                dtype = self._schema[c].dtype
+            columns[c] = ColumnSchema(name=c, dtype=dtype)
+        op = Operator("update_cells", [self, other], params=dict(positions=positions))
+        return Table._new(op, _schema_from_columns(columns), self._universe)
+
+    def __lshift__(self, other: "Table") -> "Table":
+        return self.update_cells(other)
+
+    def with_universe_of(self, other: "TableLike | Table") -> "Table":
+        op = Operator("with_universe_of", [self, other], params=dict())
+        return Table._new(op, self._schema, other._universe)
+
+    def restrict(self, other: "Table") -> "Table":
+        if not other._universe.is_subset_of(self._universe):
+            raise ValueError(
+                "restrict: other's universe is not promised to be a subset of self's"
+            )
+        op = Operator("semijoin", [self, other], params=dict(mode="intersect"))
+        return Table._new(op, self._schema, other._universe)
+
+    def intersect(self, *tables: "Table") -> "Table":
+        result = self
+        for t in tables:
+            op = Operator("semijoin", [result, t], params=dict(mode="intersect"))
+            result = Table._new(op, result._schema, result._universe.subuniverse())
+        return result
+
+    def difference(self, other: "Table") -> "Table":
+        op = Operator("semijoin", [self, other], params=dict(mode="difference"))
+        return Table._new(op, self._schema, self._universe.subuniverse())
+
+    def having(self, *indexers: ColumnReference) -> "Table":
+        """Restrict to rows whose id appears among indexer values
+        (reference: table.py having / indexing)."""
+        result = self
+        for indexer in indexers:
+            op = Operator(
+                "semijoin",
+                [result, indexer.table],
+                params=dict(mode="intersect", right_key=indexer),
+            )
+            result = Table._new(op, result._schema, result._universe.subuniverse())
+        return result
+
+    # -- pointer ops --
+    def pointer_from(self, *args: Any, optional: bool = False, instance: Any = None) -> PointerExpression:
+        return PointerExpression(
+            self,
+            *[resolve_expression(a, self) for a in args],
+            instance=resolve_expression(instance, self) if instance is not None else None,
+            optional=optional,
+        )
+
+    def ix(
+        self,
+        expression: Any,
+        *,
+        optional: bool = False,
+        context: "Table | None" = None,
+    ) -> "Table":
+        """``other.ix(t.ptr)`` — fetch rows of ``self`` by pointer
+        (reference: table.py ix / internals thisclass ix)."""
+        if context is None:
+            tables = _tables_of(expression)
+            if len(tables) != 1:
+                raise ValueError("ix: cannot infer context table; pass context=")
+            (context,) = tables
+        expr = resolve_expression(expression, context)
+        op = Operator(
+            "ix",
+            [context, self],
+            params=dict(ptr=expr, optional=optional),
+        )
+        schema = self._schema
+        if optional:
+            schema = _schema_from_columns(
+                {
+                    n: ColumnSchema(name=n, dtype=dt.Optional(c.dtype))
+                    for n, c in self._schema.columns().items()
+                }
+            )
+        return Table._new(op, schema, context._universe)
+
+    def ix_ref(self, *args: Any, optional: bool = False, instance: Any = None, context: "Table | None" = None) -> "Table":
+        if context is None:
+            tables = set()
+            for a in args:
+                tables |= set(_tables_of(a))
+            if len(tables) != 1:
+                raise ValueError("ix_ref: cannot infer context table; pass context=")
+            (context,) = tables
+        ptr = PointerExpression(
+            self,
+            *[resolve_expression(a, context) for a in args],
+            instance=resolve_expression(instance, context) if instance is not None else None,
+            optional=optional,
+        )
+        return self.ix(ptr, optional=optional, context=context)
+
+    # -- reshaping --
+    def flatten(self, to_flatten: ColumnReference, *, origin_id: str | None = None) -> "Table":
+        """Explode a sequence column (reference: table.py flatten /
+        graph.rs flatten_table)."""
+        col = resolve_expression(to_flatten, self)
+        if not isinstance(col, ColumnReference):
+            raise TypeError("flatten expects a column reference")
+        inner = self._schema[col.name].dtype
+        if isinstance(inner, dt.List):
+            flat_dtype = inner.wrapped
+        elif isinstance(inner, dt.Tuple):
+            flat_dtype = dt.types_lcm(*inner.args) if inner.args else dt.ANY
+        elif inner is dt.STR:
+            flat_dtype = dt.STR
+        elif isinstance(inner, dt.Array):
+            flat_dtype = dt.ANY
+        elif inner is dt.JSON:
+            flat_dtype = dt.JSON
+        else:
+            flat_dtype = dt.ANY
+        columns = {}
+        for n, c in self._schema.columns().items():
+            columns[n] = ColumnSchema(
+                name=n, dtype=flat_dtype if n == col.name else c.dtype
+            )
+        if origin_id is not None:
+            columns[origin_id] = ColumnSchema(name=origin_id, dtype=dt.POINTER)
+        op = Operator(
+            "flatten",
+            [self],
+            params=dict(column=col.name, origin_id=origin_id),
+        )
+        return Table._new(op, _schema_from_columns(columns), Universe())
+
+    def with_id_from(self, *args: Any, instance: Any = None) -> "Table":
+        """Re-key rows by hash of expressions (reference: table.py
+        with_id_from)."""
+        exprs = [resolve_expression(a, self) for a in args]
+        op = Operator(
+            "reindex",
+            [self],
+            params=dict(
+                exprs=exprs,
+                instance=resolve_expression(instance, self) if instance is not None else None,
+            ),
+        )
+        return Table._new(op, self._schema, Universe())
+
+    def with_id(self, new_index: ColumnReference) -> "Table":
+        expr = resolve_expression(new_index, self)
+        op = Operator("reindex", [self], params=dict(exprs=[expr], instance=None, raw=True))
+        return Table._new(op, self._schema, Universe())
+
+    # -- column-level sugar --
+    def with_columns(self, *args: Any, **kwargs: Any) -> "Table":
+        exprs = expand_select_args(args, kwargs, self)
+        all_exprs: dict[str, ColumnExpression] = {
+            n: self[n] for n in self.column_names()
+        }
+        all_exprs.update(exprs)
+        return self._select_exprs(all_exprs, universe=self._universe)
+
+    def without(self, *columns: Any) -> "Table":
+        names = {c.name if isinstance(c, ColumnReference) else c for c in columns}
+        keep = [n for n in self.column_names() if n not in names]
+        return self._select_exprs({n: self[n] for n in keep}, universe=self._universe)
+
+    def rename(self, names_mapping: dict | None = None, **kwargs: Any) -> "Table":
+        if names_mapping:
+            return self.rename_by_dict(names_mapping)
+        return self.rename_columns(**kwargs)
+
+    def rename_columns(self, **kwargs: Any) -> "Table":
+        # kwargs: new_name=old_ref
+        mapping = {}
+        for new, old in kwargs.items():
+            mapping[old.name if isinstance(old, ColumnReference) else old] = new
+        return self.rename_by_dict(mapping)
+
+    def rename_by_dict(self, names_mapping: dict) -> "Table":
+        mapping = {
+            (k.name if isinstance(k, ColumnReference) else k): v
+            for k, v in names_mapping.items()
+        }
+        exprs = {}
+        for n in self.column_names():
+            exprs[mapping.get(n, n)] = self[n]
+        return self._select_exprs(exprs, universe=self._universe)
+
+    def cast_to_types(self, **kwargs: Any) -> "Table":
+        exprs: dict[str, ColumnExpression] = {n: self[n] for n in self.column_names()}
+        for n, t in kwargs.items():
+            exprs[n] = CastExpression(t, self[n])
+        return self._select_exprs(exprs, universe=self._universe)
+
+    def update_types(self, **kwargs: Any) -> "Table":
+        exprs: dict[str, ColumnExpression] = {n: self[n] for n in self.column_names()}
+        for n, t in kwargs.items():
+            exprs[n] = DeclareTypeExpression(t, self[n])
+        return self._select_exprs(exprs, universe=self._universe)
+
+    def copy(self) -> "Table":
+        return self._select_exprs(
+            {n: self[n] for n in self.column_names()}, universe=self._universe
+        )
+
+    # -- universe promises (reference: table.py promise_*) --
+    def promise_universes_are_equal(self, other: "Table") -> "Table":
+        self._universe.promise_equal(other._universe)
+        return self
+
+    def promise_universe_is_subset_of(self, other: "Table") -> "Table":
+        self._universe.promise_subset_of(other._universe)
+        return self
+
+    def promise_universes_are_disjoint(self, other: "Table") -> "Table":
+        return self
+
+    def promise_universe_is_equal_to(self, other: "Table") -> "Table":
+        self._universe.promise_equal(other._universe)
+        return self
+
+    # -- temporal sugar (implemented in stdlib.temporal) --
+    def windowby(self, time_expr: Any, *, window: Any, instance: Any = None, behavior: Any = None, origin=None):
+        from ..stdlib.temporal import windowby as _windowby
+
+        return _windowby(self, time_expr, window=window, instance=instance, behavior=behavior)
+
+    def sort(self, key: Any, instance: Any = None) -> "Table":
+        from ..stdlib.indexing.sorting import sort as _sort
+
+        return _sort(self, key=key, instance=instance)
+
+    def diff(self, timestamp: Any, *values: Any, instance: Any = None) -> "Table":
+        from ..stdlib.ordered import diff as _diff
+
+        return _diff(self, timestamp, *values, instance=instance)
+
+    def interpolate(self, timestamp: Any, *values: Any, mode: Any = None) -> "Table":
+        from ..stdlib.statistical import interpolate as _interpolate
+
+        return _interpolate(self, timestamp, *values, mode=mode)
+
+    def asof_join(self, other, self_time, other_time, *on, **kwargs):
+        from ..stdlib.temporal import asof_join as _asof_join
+
+        return _asof_join(self, other, self_time, other_time, *on, **kwargs)
+
+    def asof_now_join(self, other, *on, **kwargs):
+        from ..stdlib.temporal import asof_now_join as _asof_now_join
+
+        return _asof_now_join(self, other, *on, **kwargs)
+
+    def interval_join(self, other, self_time, other_time, interval, *on, **kwargs):
+        from ..stdlib.temporal import interval_join as _interval_join
+
+        return _interval_join(self, other, self_time, other_time, interval, *on, **kwargs)
+
+    def window_join(self, other, self_time, other_time, window, *on, **kwargs):
+        from ..stdlib.temporal import window_join as _window_join
+
+        return _window_join(self, other, self_time, other_time, window, *on, **kwargs)
+
+    def _external_index_as_of_now(self, index_factory, query_table, **kwargs):
+        from ..stdlib.indexing.data_index import _external_index_as_of_now
+
+        return _external_index_as_of_now(self, index_factory, query_table, **kwargs)
+
+
+class TableLike:
+    """Anything with a universe (reference: table.py TableLike)."""
+
+    def __init__(self, universe: Universe):
+        self._universe = universe
+
+
+def groupby(table: Table, *args, **kwargs) -> GroupedTable:
+    return table.groupby(*args, **kwargs)
+
+
+# -- helpers --
+
+def _referenced_tables(
+    exprs: Iterable[ColumnExpression], primary: Table
+) -> list[Table]:
+    """Additional same-universe tables referenced by the expressions."""
+    found: dict[int, Table] = {}
+
+    def walk(e: ColumnExpression):
+        if isinstance(e, ColumnReference) and e.table is not None and e.table is not primary:
+            t = e.table
+            if id(t) not in found:
+                if not t._universe.is_equal_to(primary._universe) and not (
+                    t._universe.is_subset_of(primary._universe)
+                    or primary._universe.is_subset_of(t._universe)
+                ):
+                    raise ValueError(
+                        f"expression references table with a different universe: "
+                        f"column {e.name!r}; use <table>.ix(...) or join instead"
+                    )
+                found[id(t)] = t
+        for d in e._deps():
+            walk(d)
+
+    for e in exprs:
+        walk(e)
+    return list(found.values())
+
+
+def _tables_of(e: Any) -> list[Table]:
+    tables: dict[int, Table] = {}
+
+    def walk(node):
+        if isinstance(node, ColumnReference) and node.table is not None:
+            tables[id(node.table)] = node.table
+        for d in node._deps():
+            walk(d)
+
+    if isinstance(e, ColumnExpression):
+        walk(e)
+    return list(tables.values())
+
+
+def _common_schema(tables: list[Table]) -> SchemaMetaclass:
+    names = tables[0].column_names()
+    for t in tables[1:]:
+        if t.column_names() != names:
+            raise ValueError(
+                f"tables have different columns: {names} vs {t.column_names()}"
+            )
+    columns = {}
+    for n in names:
+        dtype = dt.types_lcm(*[t._schema[n].dtype for t in tables])
+        columns[n] = ColumnSchema(name=n, dtype=dtype)
+    return _schema_from_columns(columns)

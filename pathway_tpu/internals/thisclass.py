@@ -1,0 +1,82 @@
+"""``pw.this`` / ``pw.left`` / ``pw.right`` sentinels.
+
+reference: python/pathway/internals/thisclass.py.  Attribute access on the
+sentinels builds unbound :class:`ThisColumnReference`s that the desugaring
+pass (``internals/desugaring.py``) substitutes with real table references.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .expression import ColumnExpression, ColumnReference
+
+__all__ = ["this", "left", "right", "ThisSentinel", "ThisColumnReference", "ThisWithout"]
+
+
+class ThisColumnReference(ColumnReference):
+    def __init__(self, sentinel: "ThisSentinel", name: str):
+        ColumnExpression.__init__(self)
+        self._table = None  # type: ignore[assignment]
+        self._sentinel = sentinel
+        self._name = name
+
+    @property
+    def sentinel(self) -> "ThisSentinel":
+        return self._sentinel
+
+    def _compute_dtype(self):
+        raise RuntimeError(
+            f"pw.{self._sentinel.kind}.{self._name} used outside of a table context"
+        )
+
+    def __repr__(self):
+        return f"pw.{self._sentinel.kind}.{self._name}"
+
+
+class ThisWithout:
+    """``pw.this.without('a', this.b)`` marker expanded by select desugaring."""
+
+    def __init__(self, sentinel: "ThisSentinel", names: tuple[str, ...]):
+        self.sentinel = sentinel
+        self.names = names
+
+
+class ThisSentinel:
+    __slots__ = ("kind",)
+
+    def __init__(self, kind: str):
+        object.__setattr__(self, "kind", kind)
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        if name == "id":
+            return ThisColumnReference(self, "id")
+        return ThisColumnReference(self, name)
+
+    def __getitem__(self, name) -> Any:
+        if isinstance(name, ColumnReference):
+            name = name.name
+        return ThisColumnReference(self, name)
+
+    def without(self, *names) -> ThisWithout:
+        resolved = tuple(n.name if isinstance(n, ColumnReference) else n for n in names)
+        return ThisWithout(self, resolved)
+
+    def __iter__(self):
+        # ``select(*pw.this)`` — expanded during desugaring; yield the marker
+        yield ThisWithout(self, ())
+
+    def pointer_from(self, *args, **kwargs):
+        from .expression import PointerExpression
+
+        return PointerExpression(None, *args, **kwargs)  # bound at desugar time
+
+    def __repr__(self):
+        return f"pw.{self.kind}"
+
+
+this = ThisSentinel("this")
+left = ThisSentinel("left")
+right = ThisSentinel("right")

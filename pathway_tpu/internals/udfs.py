@@ -1,0 +1,456 @@
+"""UDF system: ``@pw.udf``, executors, retries, caches.
+
+reference: python/pathway/internals/udfs/__init__.py:68 (``UDF`` base),
+executors.py:36,92,132 (auto/sync/async executors w/ capacity+timeout),
+retries.py:58 (ExponentialBackoffRetryStrategy), caches.py:35,120
+(DiskCache/InMemoryCache).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import hashlib
+import inspect
+import os
+import pickle
+import random
+import time
+from typing import Any, Callable
+
+from . import dtype as dt
+from .expression import (
+    ApplyExpression,
+    AsyncApplyExpression,
+    ColumnExpression,
+    smart_wrap,
+)
+
+__all__ = [
+    "UDF",
+    "udf",
+    "auto_executor",
+    "sync_executor",
+    "async_executor",
+    "fully_async_executor",
+    "NoRetryStrategy",
+    "FixedDelayRetryStrategy",
+    "ExponentialBackoffRetryStrategy",
+    "CacheStrategy",
+    "InMemoryCache",
+    "DiskCache",
+    "DefaultCache",
+    "async_options",
+    "coerce_async",
+    "with_cache_strategy",
+    "with_retry_strategy",
+    "with_capacity",
+    "with_timeout",
+]
+
+
+# ---------------------------------------------------------------------------
+# retry strategies (reference: internals/udfs/retries.py)
+# ---------------------------------------------------------------------------
+
+
+class AsyncRetryStrategy:
+    async def invoke(self, fun: Callable, /, *args, **kwargs):
+        raise NotImplementedError
+
+
+class NoRetryStrategy(AsyncRetryStrategy):
+    async def invoke(self, fun, /, *args, **kwargs):
+        return await fun(*args, **kwargs)
+
+
+class FixedDelayRetryStrategy(AsyncRetryStrategy):
+    """reference: retries.py ``FixedDelayRetryStrategy``"""
+
+    def __init__(self, max_retries: int = 3, delay_ms: int = 1000):
+        self.max_retries = max_retries
+        self.delay_ms = delay_ms
+
+    def _next_delay(self, attempt: int) -> float:
+        return self.delay_ms / 1000
+
+    async def invoke(self, fun, /, *args, **kwargs):
+        last: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                return await fun(*args, **kwargs)
+            except Exception as exc:  # noqa: BLE001
+                last = exc
+                if attempt == self.max_retries:
+                    break
+                await asyncio.sleep(self._next_delay(attempt))
+        raise last  # type: ignore[misc]
+
+
+class ExponentialBackoffRetryStrategy(FixedDelayRetryStrategy):
+    """reference: retries.py:58"""
+
+    def __init__(
+        self,
+        max_retries: int = 3,
+        initial_delay: int = 1000,
+        backoff_factor: float = 2.0,
+        jitter_ms: int = 300,
+    ):
+        super().__init__(max_retries=max_retries, delay_ms=initial_delay)
+        self.backoff_factor = backoff_factor
+        self.jitter_ms = jitter_ms
+
+    def _next_delay(self, attempt: int) -> float:
+        base = self.delay_ms * (self.backoff_factor**attempt)
+        return (base + random.uniform(0, self.jitter_ms)) / 1000
+
+
+# ---------------------------------------------------------------------------
+# cache strategies (reference: internals/udfs/caches.py)
+# ---------------------------------------------------------------------------
+
+
+class CacheStrategy:
+    def wrap_async(self, fun: Callable) -> Callable:
+        raise NotImplementedError
+
+    @staticmethod
+    def _cache_key(name: str, args, kwargs) -> str:
+        payload = pickle.dumps((name, args, tuple(sorted(kwargs.items()))))
+        return hashlib.blake2b(payload, digest_size=16).hexdigest()
+
+
+class InMemoryCache(CacheStrategy):
+    """reference: caches.py:120"""
+
+    def __init__(self):
+        self._store: dict[str, Any] = {}
+
+    def wrap_async(self, fun):
+        name = getattr(fun, "__name__", "udf")
+
+        @functools.wraps(fun)
+        async def wrapper(*args, **kwargs):
+            key = self._cache_key(name, args, kwargs)
+            if key in self._store:
+                return self._store[key]
+            result = await fun(*args, **kwargs)
+            self._store[key] = result
+            return result
+
+        return wrapper
+
+
+class DiskCache(CacheStrategy):
+    """Pickle-per-key cache directory
+    (reference: caches.py:35 DiskCache via the diskcache lib; here a plain
+    directory of pickles under PATHWAY_PERSISTENT_STORAGE)."""
+
+    def __init__(self, name: str | None = None, directory: str | None = None):
+        self._name = name
+        self._dir = directory
+
+    def _resolve_dir(self, fun_name: str) -> str:
+        base = self._dir or os.environ.get(
+            "PATHWAY_PERSISTENT_STORAGE", os.path.join(os.getcwd(), ".pathway-cache")
+        )
+        d = os.path.join(base, "udf-cache", self._name or fun_name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def wrap_async(self, fun):
+        name = getattr(fun, "__name__", "udf")
+        directory = self._resolve_dir(name)
+
+        @functools.wraps(fun)
+        async def wrapper(*args, **kwargs):
+            key = self._cache_key(name, args, kwargs)
+            path = os.path.join(directory, key)
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    return pickle.load(f)
+            result = await fun(*args, **kwargs)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(result, f)
+            os.replace(tmp, path)
+            return result
+
+        return wrapper
+
+
+class DefaultCache(DiskCache):
+    """reference: caches.py DefaultCache — uses the persistence layer when
+    enabled, a disk cache otherwise."""
+
+
+# ---------------------------------------------------------------------------
+# executors (reference: internals/udfs/executors.py)
+# ---------------------------------------------------------------------------
+
+
+class Executor:
+    kind = "auto"
+    capacity: int | None = None
+    timeout: float | None = None
+    retry_strategy: AsyncRetryStrategy | None = None
+
+
+class AutoExecutor(Executor):
+    kind = "auto"
+
+
+class SyncExecutor(Executor):
+    kind = "sync"
+
+
+class AsyncExecutor(Executor):
+    kind = "async"
+
+    def __init__(
+        self,
+        *,
+        capacity: int | None = None,
+        timeout: float | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+    ):
+        self.capacity = capacity
+        self.timeout = timeout
+        self.retry_strategy = retry_strategy
+
+
+class FullyAsyncExecutor(AsyncExecutor):
+    kind = "fully_async"
+
+
+def auto_executor() -> Executor:
+    return AutoExecutor()
+
+
+def sync_executor() -> Executor:
+    return SyncExecutor()
+
+
+def async_executor(
+    *,
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy: AsyncRetryStrategy | None = None,
+) -> Executor:
+    return AsyncExecutor(capacity=capacity, timeout=timeout, retry_strategy=retry_strategy)
+
+
+def fully_async_executor(
+    *,
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy: AsyncRetryStrategy | None = None,
+) -> Executor:
+    return FullyAsyncExecutor(capacity=capacity, timeout=timeout, retry_strategy=retry_strategy)
+
+
+# ---------------------------------------------------------------------------
+# function wrappers
+# ---------------------------------------------------------------------------
+
+
+def coerce_async(fun: Callable) -> Callable:
+    """Wrap a sync callable into an async one (reference: udfs/utils.py)."""
+    if asyncio.iscoroutinefunction(fun):
+        return fun
+
+    @functools.wraps(fun)
+    async def wrapper(*args, **kwargs):
+        return fun(*args, **kwargs)
+
+    return wrapper
+
+
+def with_retry_strategy(fun: Callable, retry_strategy: AsyncRetryStrategy) -> Callable:
+    fun = coerce_async(fun)
+
+    @functools.wraps(fun)
+    async def wrapper(*args, **kwargs):
+        return await retry_strategy.invoke(fun, *args, **kwargs)
+
+    return wrapper
+
+
+def with_timeout(fun: Callable, timeout: float) -> Callable:
+    fun = coerce_async(fun)
+
+    @functools.wraps(fun)
+    async def wrapper(*args, **kwargs):
+        return await asyncio.wait_for(fun(*args, **kwargs), timeout=timeout)
+
+    return wrapper
+
+
+def with_capacity(fun: Callable, capacity: int) -> Callable:
+    fun = coerce_async(fun)
+    sem = asyncio.Semaphore(capacity)
+
+    @functools.wraps(fun)
+    async def wrapper(*args, **kwargs):
+        async with sem:
+            return await fun(*args, **kwargs)
+
+    return wrapper
+
+
+def with_cache_strategy(fun: Callable, cache_strategy: CacheStrategy) -> Callable:
+    return cache_strategy.wrap_async(coerce_async(fun))
+
+
+def async_options(
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy: AsyncRetryStrategy | None = None,
+    cache_strategy: CacheStrategy | None = None,
+):
+    """Decorator applying async options to a raw (non-UDF) async callable
+    (reference: udfs/__init__.py ``async_options``)."""
+
+    def decorate(fun):
+        fun = coerce_async(fun)
+        if retry_strategy is not None:
+            fun = with_retry_strategy(fun, retry_strategy)
+        if timeout is not None:
+            fun = with_timeout(fun, timeout)
+        if cache_strategy is not None:
+            fun = with_cache_strategy(fun, cache_strategy)
+        return fun
+
+    return decorate
+
+
+# ---------------------------------------------------------------------------
+# UDF base (reference: internals/udfs/__init__.py:68)
+# ---------------------------------------------------------------------------
+
+
+class UDF:
+    """Subclass and override ``__wrapped__``, or use the ``@pw.udf``
+    decorator.  Calling the UDF on column expressions builds an apply node."""
+
+    func: Callable | None = None
+
+    def __init__(
+        self,
+        *,
+        return_type: Any = None,
+        deterministic: bool = False,
+        propagate_none: bool = False,
+        executor: Executor | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        max_batch_size: int | None = None,
+    ):
+        self.return_type = return_type
+        self.deterministic = deterministic
+        self.propagate_none = propagate_none
+        self.executor = executor or auto_executor()
+        self.cache_strategy = cache_strategy
+        self.max_batch_size = max_batch_size
+
+    def __wrapped__(self, *args, **kwargs):
+        if self.func is None:
+            raise NotImplementedError("override __wrapped__ in a UDF subclass")
+        return self.func(*args, **kwargs)
+
+    def _resolved_return_type(self) -> Any:
+        if self.return_type is not None:
+            return self.return_type
+        target = self.func or type(self).__wrapped__
+        try:
+            hints = inspect.get_annotations(target, eval_str=True)
+        except Exception:
+            hints = getattr(target, "__annotations__", {})
+        if "return" in hints:
+            return hints["return"]
+        return Any
+
+    def _is_async(self) -> bool:
+        target = self.func or type(self).__wrapped__
+        if self.executor.kind in ("async", "fully_async"):
+            return True
+        if self.executor.kind == "sync":
+            return False
+        return asyncio.iscoroutinefunction(target)
+
+    def __call__(self, *args, **kwargs) -> ColumnExpression:
+        fun: Callable = self.__wrapped__
+        return_type = self._resolved_return_type()
+        if self._is_async():
+            afun = coerce_async(fun)
+            if self.executor.retry_strategy is not None:
+                afun = with_retry_strategy(afun, self.executor.retry_strategy)
+            if self.executor.timeout is not None:
+                afun = with_timeout(afun, self.executor.timeout)
+            if self.cache_strategy is not None:
+                afun = with_cache_strategy(afun, self.cache_strategy)
+            expr = AsyncApplyExpression(
+                afun,
+                return_type,
+                *args,
+                propagate_none=self.propagate_none,
+                deterministic=self.deterministic,
+                **kwargs,
+            )
+            expr.capacity = self.executor.capacity  # type: ignore[attr-defined]
+            return expr
+        if self.cache_strategy is not None:
+            cached = with_cache_strategy(fun, self.cache_strategy)
+
+            def fun_sync(*a, **kw):
+                return asyncio.run(cached(*a, **kw))
+
+            fun = fun_sync
+        return ApplyExpression(
+            fun,
+            return_type,
+            *args,
+            propagate_none=self.propagate_none,
+            deterministic=self.deterministic,
+            max_batch_size=self.max_batch_size,
+            **kwargs,
+        )
+
+
+class _FunctionUDF(UDF):
+    def __init__(self, fun: Callable, **kwargs):
+        super().__init__(**kwargs)
+        self.func = fun
+        functools.update_wrapper(self, fun)
+
+    def __wrapped__(self, *args, **kwargs):
+        return self.func(*args, **kwargs)
+
+
+def udf(
+    fun: Callable | None = None,
+    /,
+    *,
+    return_type: Any = None,
+    deterministic: bool = False,
+    propagate_none: bool = False,
+    executor: Executor | None = None,
+    cache_strategy: CacheStrategy | None = None,
+    max_batch_size: int | None = None,
+):
+    """``@pw.udf`` decorator (reference: udfs/__init__.py ``udf``)."""
+
+    def wrap(f: Callable) -> UDF:
+        return _FunctionUDF(
+            f,
+            return_type=return_type,
+            deterministic=deterministic,
+            propagate_none=propagate_none,
+            executor=executor,
+            cache_strategy=cache_strategy,
+            max_batch_size=max_batch_size,
+        )
+
+    if fun is not None:
+        return wrap(fun)
+    return wrap

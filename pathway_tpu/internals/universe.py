@@ -1,0 +1,54 @@
+"""Universes — identity of a table's key set.
+
+reference: python/pathway/internals/universe.py + universe solver.  Here a
+light parent-chain is enough: operations that provably keep or shrink the key
+set link the derived universe to its parent, and ``update_cells`` /
+``update_rows`` / ``with_universe_of`` consult :meth:`is_subset_of` /
+:meth:`is_equal_to`.  ``promise_*`` methods register manual guarantees.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+__all__ = ["Universe"]
+
+_ids = itertools.count()
+
+
+class Universe:
+    __slots__ = ("id", "parent", "_equal_to", "_subset_of", "_superset_of")
+
+    def __init__(self, parent: "Universe | None" = None):
+        self.id = next(_ids)
+        self.parent = parent
+        self._equal_to: set[int] = set()
+        self._subset_of: set[int] = set()
+        self._superset_of: set[int] = set()
+
+    def subuniverse(self) -> "Universe":
+        return Universe(parent=self)
+
+    def is_equal_to(self, other: "Universe") -> bool:
+        return self is other or other.id in self._equal_to or self.id in other._equal_to
+
+    def is_subset_of(self, other: "Universe") -> bool:
+        if self.is_equal_to(other) or other.id in self._subset_of or self.id in other._superset_of:
+            return True
+        u: Universe | None = self
+        while u is not None:
+            if u is other or u.id in other._equal_to:
+                return True
+            u = u.parent
+        return False
+
+    # manual promises (reference: table.py promise_universes_are_*)
+    def promise_equal(self, other: "Universe") -> None:
+        self._equal_to.add(other.id)
+        other._equal_to.add(self.id)
+
+    def promise_subset_of(self, other: "Universe") -> None:
+        self._subset_of.add(other.id)
+
+    def __repr__(self):
+        return f"Universe({self.id})"

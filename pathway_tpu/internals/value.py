@@ -1,0 +1,531 @@
+"""Dynamic value model for pathway_tpu.
+
+TPU-native rebuild of the reference engine's value layer
+(reference: src/engine/value.rs:207 ``Value`` enum, src/engine/time.rs).
+
+Values flowing through the dataflow are plain Python objects drawn from a
+closed set: ``None``, ``bool``, ``int``, ``float``, ``str``, ``bytes``,
+:class:`Pointer` (128-bit keys, value.rs:41), ``numpy.ndarray`` (the
+reference's IntArray/FloatArray), ``tuple``, :class:`Json`,
+:class:`DateTimeNaive`, :class:`DateTimeUtc`, :class:`Duration`, and the
+:data:`ERROR` sentinel (src/engine/error.rs ``Value::Error``).
+
+Unlike the reference there is no boxed enum — the host runtime is Python and
+numeric batches are handed to JAX as arrays, so boxing would only add cost.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json as _json
+from typing import Any, Iterator
+
+import numpy as np
+
+__all__ = [
+    "Json",
+    "Pointer",
+    "DateTimeNaive",
+    "DateTimeUtc",
+    "Duration",
+    "Error",
+    "ERROR",
+    "Pending",
+    "PENDING",
+    "NONE_SENTINEL",
+]
+
+
+class Error:
+    """Singleton error marker (reference: src/engine/error.rs ``Value::Error``).
+
+    Stored in cells when ``terminate_on_error=False`` routes row-level
+    failures into the data plane instead of aborting the run.
+    """
+
+    _instance: "Error | None" = None
+
+    def __new__(cls) -> "Error":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Error"
+
+    def __reduce__(self):
+        return (Error, ())
+
+
+ERROR = Error()
+
+
+class Pending:
+    """Singleton marker for values of ``Future`` dtype that have not resolved
+    yet (reference: python/pathway/internals/dtype.py ``Future``)."""
+
+    _instance: "Pending | None" = None
+
+    def __new__(cls) -> "Pending":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Pending"
+
+    def __reduce__(self):
+        return (Pending, ())
+
+
+PENDING = Pending()
+
+# Marker used internally where ``None`` is a valid payload.
+NONE_SENTINEL = object()
+
+
+class Json:
+    """Thin immutable wrapper marking a value as JSON-typed
+    (reference: src/engine/value.rs ``Value::Json``;
+    python/pathway/internals/json.py).
+
+    Supports ``[]`` access returning nested ``Json`` wrappers and ``.as_*``
+    coercions mirroring the reference's ``pw.Json`` API.
+    """
+
+    __slots__ = ("_value",)
+
+    NULL: "Json"
+
+    def __init__(self, value: Any = None):
+        if isinstance(value, Json):
+            value = value._value
+        self._value = value
+
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    @classmethod
+    def parse(cls, s: str | bytes) -> "Json":
+        return cls(_json.loads(s))
+
+    @classmethod
+    def dumps(cls, obj: Any) -> str:
+        return _json.dumps(obj, default=_json_default)
+
+    def to_string(self) -> str:
+        return _json.dumps(self._value, default=_json_default)
+
+    def __getitem__(self, item: str | int) -> "Json":
+        return Json(self._value[item])
+
+    def get(self, key: str | int, default: Any = None) -> "Json | Any":
+        try:
+            return Json(self._value[key])
+        except (KeyError, IndexError, TypeError):
+            return default
+
+    def __iter__(self) -> Iterator["Json"]:
+        return (Json(v) for v in self._value)
+
+    def __len__(self) -> int:
+        return len(self._value)
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, Json):
+            return self._value == other._value
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(_freeze(self._value))
+
+    def __repr__(self) -> str:
+        return f"pw.Json({self._value!r})"
+
+    def __str__(self) -> str:
+        return self.to_string()
+
+    # -- coercions (reference python/pathway/internals/json.py) --
+    def as_int(self) -> int | None:
+        v = self._value
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if isinstance(v, float):
+            return int(v) if v.is_integer() else None
+        return v
+
+    def as_float(self) -> float | None:
+        v = self._value
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        return float(v)
+
+    def as_str(self) -> str | None:
+        return self._value if isinstance(self._value, str) else None
+
+    def as_bool(self) -> bool | None:
+        return self._value if isinstance(self._value, bool) else None
+
+    def as_list(self) -> list | None:
+        return self._value if isinstance(self._value, list) else None
+
+    def as_dict(self) -> dict | None:
+        return self._value if isinstance(self._value, dict) else None
+
+
+Json.NULL = Json(None)
+
+
+def _json_default(obj: Any):
+    if isinstance(obj, Json):
+        return obj.value
+    if isinstance(obj, Pointer):
+        return str(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (DateTimeNaive, DateTimeUtc, Duration)):
+        return str(obj)
+    if isinstance(obj, bytes):
+        return obj.decode("utf-8", errors="replace")
+    raise TypeError(f"not JSON serializable: {type(obj)}")
+
+
+def _freeze(v: Any):
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, list):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
+class Pointer:
+    """128-bit row key (reference: src/engine/value.rs:41 ``Key``).
+
+    The low 16 bits form the shard field (value.rs:38 ``SHARD_MASK``) used by
+    the ``ShardPolicy::LastKeyColumn`` instance-based co-partitioning — the
+    same field decides which host/device shard owns the row in the TPU build.
+    """
+
+    __slots__ = ("value",)
+
+    SHARD_BITS = 16
+    SHARD_MASK = (1 << SHARD_BITS) - 1
+    _MOD = 1 << 128
+
+    def __init__(self, value: int):
+        self.value = value & (self._MOD - 1)
+
+    @property
+    def shard(self) -> int:
+        return self.value & self.SHARD_MASK
+
+    def with_shard(self, shard: int) -> "Pointer":
+        """reference: value.rs:76 ``with_shard_of``"""
+        return Pointer((self.value & ~self.SHARD_MASK) | (shard & self.SHARD_MASK))
+
+    def with_shard_of(self, other: "Pointer") -> "Pointer":
+        return self.with_shard(other.shard)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Pointer) and self.value == other.value
+
+    def __lt__(self, other: "Pointer") -> bool:
+        return self.value < other.value
+
+    def __le__(self, other: "Pointer") -> bool:
+        return self.value <= other.value
+
+    def __gt__(self, other: "Pointer") -> bool:
+        return self.value > other.value
+
+    def __ge__(self, other: "Pointer") -> bool:
+        return self.value >= other.value
+
+    def __hash__(self) -> int:
+        return hash(self.value)
+
+    def __repr__(self) -> str:
+        return f"^{self.value:032X}"
+
+    def __str__(self) -> str:
+        return f"^{self.value:032X}"
+
+
+class Duration:
+    """Signed time delta with nanosecond resolution
+    (reference: src/engine/time.rs ``Duration``)."""
+
+    __slots__ = ("_ns",)
+
+    def __init__(self, ns: int = 0, **kwargs):
+        if kwargs:
+            td = datetime.timedelta(**kwargs)
+            ns += (td.days * 86400 + td.seconds) * 1_000_000_000 + td.microseconds * 1000
+        self._ns = int(ns)
+
+    # constructors
+    @classmethod
+    def from_timedelta(cls, td: datetime.timedelta) -> "Duration":
+        return cls(
+            (td.days * 86400 + td.seconds) * 1_000_000_000 + td.microseconds * 1000
+        )
+
+    def to_timedelta(self) -> datetime.timedelta:
+        return datetime.timedelta(microseconds=self._ns / 1000)
+
+    # accessors (mirror pw .dt namespace needs)
+    @property
+    def ns(self) -> int:
+        return self._ns
+
+    def nanoseconds(self) -> int:
+        return self._ns
+
+    def microseconds(self) -> int:
+        return self._ns // 1_000
+
+    def milliseconds(self) -> int:
+        return self._ns // 1_000_000
+
+    def seconds(self) -> int:
+        return self._ns // 1_000_000_000
+
+    def minutes(self) -> int:
+        return self._ns // 60_000_000_000
+
+    def hours(self) -> int:
+        return self._ns // 3_600_000_000_000
+
+    def days(self) -> int:
+        return self._ns // 86_400_000_000_000
+
+    def weeks(self) -> int:
+        return self._ns // 604_800_000_000_000
+
+    def __add__(self, other):
+        if isinstance(other, Duration):
+            return Duration(self._ns + other._ns)
+        if isinstance(other, (DateTimeNaive, DateTimeUtc)):
+            return other + self
+        return NotImplemented
+
+    def __sub__(self, other):
+        if isinstance(other, Duration):
+            return Duration(self._ns - other._ns)
+        return NotImplemented
+
+    def __neg__(self):
+        return Duration(-self._ns)
+
+    def __mul__(self, other):
+        if isinstance(other, bool):
+            return NotImplemented
+        if isinstance(other, int):
+            return Duration(self._ns * other)
+        return NotImplemented
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, Duration):
+            return self._ns / other._ns
+        return NotImplemented
+
+    def __floordiv__(self, other):
+        if isinstance(other, Duration):
+            return self._ns // other._ns
+        if isinstance(other, int) and not isinstance(other, bool):
+            return Duration(self._ns // other)
+        return NotImplemented
+
+    def __mod__(self, other):
+        if isinstance(other, Duration):
+            return Duration(self._ns % other._ns)
+        return NotImplemented
+
+    def __eq__(self, other):
+        return isinstance(other, Duration) and self._ns == other._ns
+
+    def __lt__(self, other):
+        return self._ns < other._ns
+
+    def __le__(self, other):
+        return self._ns <= other._ns
+
+    def __gt__(self, other):
+        return self._ns > other._ns
+
+    def __ge__(self, other):
+        return self._ns >= other._ns
+
+    def __hash__(self):
+        return hash(("Duration", self._ns))
+
+    def __repr__(self):
+        return f"Duration({self.to_timedelta()!r})"
+
+    def __str__(self):
+        return str(self.to_timedelta())
+
+
+class _DateTimeBase:
+    __slots__ = ("_ns",)
+    _utc: bool = False
+
+    def __init__(self, value: "str | int | datetime.datetime | None" = None, fmt: str | None = None, ns: int | None = None):
+        if ns is not None:
+            self._ns = int(ns)
+            return
+        if isinstance(value, int):
+            self._ns = value
+            return
+        if isinstance(value, datetime.datetime):
+            self._ns = _dt_to_ns(value, self._utc)
+            return
+        if isinstance(value, str):
+            if fmt is not None:
+                dt = datetime.datetime.strptime(value, _convert_format(fmt))
+            else:
+                dt = datetime.datetime.fromisoformat(value)
+            self._ns = _dt_to_ns(dt, self._utc)
+            return
+        raise TypeError(f"cannot construct datetime from {value!r}")
+
+    @property
+    def ns(self) -> int:
+        return self._ns
+
+    def timestamp_ns(self) -> int:
+        return self._ns
+
+    def timestamp(self, unit: str = "ns") -> float:
+        div = {"ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000}[unit]
+        return self._ns / div
+
+    def to_datetime(self) -> datetime.datetime:
+        tz = datetime.timezone.utc if self._utc else None
+        return datetime.datetime.fromtimestamp(self._ns / 1_000_000_000, tz=tz)
+
+    # components
+    def _dt(self) -> datetime.datetime:
+        return self.to_datetime()
+
+    def year(self) -> int:
+        return self._dt().year
+
+    def month(self) -> int:
+        return self._dt().month
+
+    def day(self) -> int:
+        return self._dt().day
+
+    def hour(self) -> int:
+        return self._dt().hour
+
+    def minute(self) -> int:
+        return self._dt().minute
+
+    def second(self) -> int:
+        return self._dt().second
+
+    def millisecond(self) -> int:
+        return self._dt().microsecond // 1000
+
+    def microsecond(self) -> int:
+        return self._dt().microsecond
+
+    def nanosecond(self) -> int:
+        return self._ns % 1_000_000_000
+
+    def strftime(self, fmt: str) -> str:
+        return self._dt().strftime(_convert_format(fmt))
+
+    def __add__(self, other):
+        if isinstance(other, Duration):
+            return type(self)(ns=self._ns + other.ns)
+        return NotImplemented
+
+    def __sub__(self, other):
+        if isinstance(other, type(self)):
+            return Duration(self._ns - other._ns)
+        if isinstance(other, Duration):
+            return type(self)(ns=self._ns - other.ns)
+        return NotImplemented
+
+    def __eq__(self, other):
+        return type(other) is type(self) and self._ns == other._ns
+
+    def __lt__(self, other):
+        self._check(other)
+        return self._ns < other._ns
+
+    def __le__(self, other):
+        self._check(other)
+        return self._ns <= other._ns
+
+    def __gt__(self, other):
+        self._check(other)
+        return self._ns > other._ns
+
+    def __ge__(self, other):
+        self._check(other)
+        return self._ns >= other._ns
+
+    def _check(self, other):
+        if type(other) is not type(self):
+            raise TypeError(f"cannot compare {type(self).__name__} with {type(other).__name__}")
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._ns))
+
+    def __str__(self):
+        return self._dt().isoformat(sep=" ")
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self})"
+
+
+class DateTimeNaive(_DateTimeBase):
+    """Timezone-naive datetime, ns resolution (reference: src/engine/time.rs
+    ``DateTimeNaive``)."""
+
+    _utc = False
+
+
+class DateTimeUtc(_DateTimeBase):
+    """UTC datetime, ns resolution (reference: src/engine/time.rs
+    ``DateTimeUtc``)."""
+
+    _utc = True
+
+
+def _dt_to_ns(dt: datetime.datetime, utc: bool) -> int:
+    # exact integer arithmetic — float paths lose sub-microsecond precision
+    if utc:
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        epoch = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+    else:
+        if dt.tzinfo is not None:
+            dt = dt.replace(tzinfo=None)
+        epoch = datetime.datetime(1970, 1, 1)
+    td = dt - epoch
+    return (td.days * 86400 + td.seconds) * 1_000_000_000 + td.microseconds * 1000
+
+
+_FORMAT_MAP = {
+    # chrono-style codes used by the reference docs that strptime lacks
+    "%T": "%H:%M:%S",
+    "%F": "%Y-%m-%d",
+}
+
+
+def _convert_format(fmt: str) -> str:
+    for k, v in _FORMAT_MAP.items():
+        fmt = fmt.replace(k, v)
+    return fmt

@@ -1,0 +1,23 @@
+import os
+
+# All tests run on a virtual 8-device CPU mesh so multi-chip sharding paths
+# compile and execute without TPU hardware (the driver separately dry-runs
+# them; bench.py uses the real chip).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    """Each test builds its own dataflow graph."""
+    from pathway_tpu.internals.graph import G
+
+    G.clear()
+    yield
+    G.clear()

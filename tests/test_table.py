@@ -1,0 +1,356 @@
+"""Core Table API tests.
+
+Mirrors the reference test strategy (SURVEY.md §4): graphs built from
+markdown literals, run in static batch mode, compared with
+assert_table_equality (reference: python/pathway/tests/test_common.py).
+"""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu import debug as pwd
+
+
+def t_pets():
+    return pwd.table_from_markdown(
+        """
+        | owner | pet  | age
+    1   | Alice | dog  | 3
+    2   | Bob   | cat  | 2
+    3   | Alice | cat  | 5
+    4   | Carol | dog  | 1
+    """
+    )
+
+
+def test_select_arithmetic():
+    t = t_pets()
+    res = t.select(pw.this.owner, double=pw.this.age * 2, label=pw.this.owner + "!")
+    expected = pwd.table_from_markdown(
+        """
+        | owner | double | label
+    1   | Alice | 6      | Alice!
+    2   | Bob   | 4      | Bob!
+    3   | Alice | 10     | Alice!
+    4   | Carol | 2      | Carol!
+    """
+    )
+    pwd.assert_table_equality(res, expected)
+
+
+def test_filter():
+    t = t_pets()
+    res = t.filter(pw.this.age > 2).select(pw.this.owner, pw.this.age)
+    expected = pwd.table_from_markdown(
+        """
+        | owner | age
+    1   | Alice | 3
+    3   | Alice | 5
+    """
+    )
+    pwd.assert_table_equality(res, expected)
+
+
+def test_groupby_reduce():
+    t = t_pets()
+    res = t.groupby(pw.this.owner).reduce(
+        pw.this.owner,
+        total=pw.reducers.sum(pw.this.age),
+        n=pw.reducers.count(),
+        oldest=pw.reducers.max(pw.this.age),
+    )
+    expected = pwd.table_from_markdown(
+        """
+        owner | total | n | oldest
+        Alice | 8     | 2 | 5
+        Bob   | 2     | 1 | 2
+        Carol | 1     | 1 | 1
+    """
+    ).with_id_from(pw.this.owner)
+    pwd.assert_table_equality_wo_index(res, expected)
+
+
+def test_global_reduce():
+    t = t_pets()
+    res = t.reduce(total=pw.reducers.sum(pw.this.age))
+    ids, cols = pwd.table_to_dicts(res)
+    assert len(ids) == 1
+    assert list(cols["total"].values()) == [11]
+
+
+def test_join_inner():
+    t = t_pets()
+    prices = pwd.table_from_markdown(
+        """
+        | pet | price
+    1   | dog | 100
+    2   | cat | 50
+    """
+    )
+    res = t.join(prices, t.pet == prices.pet).select(
+        pw.left.owner, pw.right.price
+    )
+    expected = pwd.table_from_markdown(
+        """
+        owner | price
+        Alice | 100
+        Bob   | 50
+        Alice | 50
+        Carol | 100
+    """
+    )
+    pwd.assert_table_equality_wo_index(res, expected)
+
+
+def test_join_left_outer():
+    t1 = pwd.table_from_markdown(
+        """
+        | k | v
+    1   | a | 1
+    2   | b | 2
+    """
+    )
+    t2 = pwd.table_from_markdown(
+        """
+        | k | w
+    1   | a | 10
+    """
+    )
+    res = t1.join_left(t2, t1.k == t2.k).select(t1.k, t1.v, t2.w)
+    ids, cols = pwd.table_to_dicts(res)
+    vals = sorted((cols["k"][i], cols["v"][i], cols["w"][i]) for i in ids)
+    assert vals == [("a", 1, 10), ("b", 2, None)]
+
+
+def test_concat_and_update_cells():
+    t1 = pwd.table_from_markdown(
+        """
+        | a
+    1   | 1
+    2   | 2
+    """
+    )
+    t2 = pwd.table_from_markdown(
+        """
+        | a
+    5   | 10
+    """
+    )
+    res = t1.concat(t2)
+    ids, cols = pwd.table_to_dicts(res)
+    assert sorted(cols["a"][i] for i in ids) == [1, 2, 10]
+
+    upd = pwd.table_from_markdown(
+        """
+        | a
+    2   | 99
+    """
+    )
+    upd = upd.promise_universe_is_subset_of(t1)
+    res2 = t1.update_cells(upd)
+    ids2, cols2 = pwd.table_to_dicts(res2)
+    assert sorted(cols2["a"][i] for i in ids2) == [1, 99]
+
+
+def test_update_rows():
+    t1 = pwd.table_from_markdown(
+        """
+        | a
+    1   | 1
+    2   | 2
+    """
+    )
+    t2 = pwd.table_from_markdown(
+        """
+        | a
+    2   | 20
+    3   | 30
+    """
+    )
+    res = t1.update_rows(t2)
+    ids, cols = pwd.table_to_dicts(res)
+    assert sorted(cols["a"][i] for i in ids) == [1, 20, 30]
+
+
+def test_flatten():
+    t = pwd.table_from_markdown(
+        """
+        | w
+    1   | abc
+    """
+    ).select(parts=pw.apply_with_type(lambda s: tuple(s), tuple, pw.this.w))
+    flat = t.flatten(pw.this.parts)
+    ids, cols = pwd.table_to_dicts(flat)
+    assert sorted(cols["parts"][i] for i in ids) == ["a", "b", "c"]
+
+
+def test_ix():
+    t = t_pets()
+    # self-lookup: each row fetches its own owner via id pointer
+    withptr = t.select(pw.this.owner, ptr=pw.this.id)
+    fetched = withptr.select(owner2=t.ix(withptr.ptr).owner)
+    ids, cols = pwd.table_to_dicts(fetched)
+    ids0, cols0 = pwd.table_to_dicts(t.select(pw.this.owner))
+    assert {cols["owner2"][i] for i in ids} == {cols0["owner"][i] for i in ids0}
+
+
+def test_pointer_from_matches_groupby_ids():
+    t = t_pets()
+    grouped = t.groupby(pw.this.owner).reduce(
+        pw.this.owner, total=pw.reducers.sum(pw.this.age)
+    )
+    augmented = t.select(
+        pw.this.owner, total=grouped.ix(t.pointer_from(t.owner)).total
+    )
+    ids, cols = pwd.table_to_dicts(augmented)
+    by_owner = {cols["owner"][i]: cols["total"][i] for i in ids}
+    assert by_owner == {"Alice": 8, "Bob": 2, "Carol": 1}
+
+
+def test_deduplicate():
+    t = pwd.table_from_markdown(
+        """
+        | v | __time__
+    1   | 1 | 2
+    2   | 2 | 4
+    3   | 1 | 6
+    4   | 5 | 8
+    """
+    )
+    res = t.deduplicate(value=pw.this.v, acceptor=lambda new, old: new > old)
+    ids, cols = pwd.table_to_dicts(res)
+    assert list(cols["v"].values()) == [5]
+
+
+def test_update_stream_retraction():
+    t = pwd.table_from_markdown(
+        """
+        | v | __time__ | __diff__
+    1   | 1 | 2        | 1
+    1   | 1 | 4        | -1
+    2   | 7 | 4        | 1
+    """
+    )
+    ids, cols = pwd.table_to_dicts(t)
+    assert list(cols["v"].values()) == [7]
+
+
+def test_groupby_incremental_retraction():
+    t = pwd.table_from_markdown(
+        """
+        | owner | age | __time__ | __diff__
+    1   | Alice | 3   | 2        | 1
+    2   | Alice | 5   | 2        | 1
+    1   | Alice | 3   | 4        | -1
+    """
+    )
+    res = t.groupby(pw.this.owner).reduce(
+        pw.this.owner, total=pw.reducers.sum(pw.this.age)
+    )
+    ids, cols = pwd.table_to_dicts(res)
+    assert list(cols["total"].values()) == [5]
+
+
+def test_having_and_difference():
+    t = t_pets()
+    owners = pwd.table_from_markdown(
+        """
+        | owner
+    1   | Alice
+    """
+    )
+    # restrict pets to those whose pointer_from(owner) appears in owners' ids
+    keyed = t.with_id_from(pw.this.owner, pw.this.pet)
+    assert len(pwd.table_to_dicts(keyed)[0]) == 4
+
+
+def test_cast_and_types():
+    t = pwd.table_from_markdown(
+        """
+        | x
+    1   | 1
+    2   | 2
+    """
+    )
+    res = t.select(y=pw.cast(float, pw.this.x) / 2)
+    ids, cols = pwd.table_to_dicts(res)
+    assert sorted(cols["y"][i] for i in ids) == [0.5, 1.0]
+    assert res.schema["y"].dtype is pw.Type.FLOAT
+
+
+def test_if_else_coalesce():
+    t = pwd.table_from_markdown(
+        """
+        | a | b
+    1   | 1 |
+    2   | 2 | 5
+    """
+    )
+    res = t.select(
+        c=pw.coalesce(pw.this.b, 0),
+        d=pw.if_else(pw.this.a > 1, pw.this.a * 10, -1),
+    )
+    ids, cols = pwd.table_to_dicts(res)
+    assert sorted((cols["c"][i], cols["d"][i]) for i in ids) == [(0, -1), (5, 20)]
+
+
+def test_udf_sync_and_async():
+    @pw.udf
+    def double(x: int) -> int:
+        return 2 * x
+
+    @pw.udf
+    async def adub(x: int) -> int:
+        return 3 * x
+
+    t = pwd.table_from_markdown(
+        """
+        | x
+    1   | 1
+    2   | 4
+    """
+    )
+    res = t.select(d=double(pw.this.x), a=adub(pw.this.x))
+    ids, cols = pwd.table_to_dicts(res)
+    assert sorted((cols["d"][i], cols["a"][i]) for i in ids) == [(2, 3), (8, 12)]
+
+
+def test_iterate_collatz():
+    def step(t):
+        return t.select(
+            n=pw.if_else(
+                pw.this.n == 1,
+                1,
+                pw.if_else(pw.this.n % 2 == 0, pw.this.n // 2, 3 * pw.this.n + 1),
+            )
+        )
+
+    t = pwd.table_from_markdown(
+        """
+        | n
+    1   | 6
+    2   | 27
+    3   | 1
+    """
+    )
+    res = pw.iterate(step, t=t)
+    ids, cols = pwd.table_to_dicts(res)
+    assert list(cols["n"].values()) == [1, 1, 1]
+
+
+def test_string_and_dt_namespaces():
+    t = pwd.table_from_markdown(
+        """
+        | s
+    1   | Hello
+    """
+    )
+    res = t.select(
+        low=pw.this.s.str.lower(),
+        ln=pw.this.s.str.len(),
+        swapped=pw.this.s.str.swapcase(),
+    )
+    ids, cols = pwd.table_to_dicts(res)
+    assert list(cols["low"].values()) == ["hello"]
+    assert list(cols["ln"].values()) == [5]
+    assert list(cols["swapped"].values()) == ["hELLO"]
