@@ -96,6 +96,11 @@ def consolidate(entries: Iterable[Entry]) -> list[Entry]:
 class Node:
     """Runtime dataflow node."""
 
+    # late nodes flush only after the rest of the graph is quiescent for the
+    # timestamp — the global updates-before-queries barrier that the
+    # reference gets from batch_by_time (external_index.rs:129)
+    late: bool = False
+
     def __init__(self, n_inputs: int = 1, name: str = ""):
         self.n_inputs = n_inputs
         self.name = name or type(self).__name__
@@ -818,17 +823,34 @@ class Engine:
         src.downstream.append((dst, port))
 
     def step(self, time: int) -> None:
-        """Process one timestamp to quiescence."""
+        """Process one timestamp to quiescence.
+
+        Two phases per pass: regular nodes run until quiet, then ``late``
+        nodes (as-of-now index serving) get one pass — guaranteeing every
+        index update for this timestamp lands before any query is answered."""
         for _pass in range(100_000):
             progressed = False
             for node in self.nodes:
-                if not node.has_pending(time):
+                if node.late or not node.has_pending(time):
                     continue
                 progressed = True
                 out = node.flush(time)
                 if out:
                     for consumer, port in node.downstream:
                         consumer.receive(port, out)
+            if progressed:
+                continue
+            # one late node per pass: its output must fully propagate (and any
+            # downstream late node's inputs settle) before the next late node
+            # answers — keeps the barrier correct for chained late nodes
+            for node in self.nodes:
+                if node.late and node.has_pending(time):
+                    progressed = True
+                    out = node.flush(time)
+                    if out:
+                        for consumer, port in node.downstream:
+                            consumer.receive(port, out)
+                    break
             if not progressed:
                 break
         else:  # pragma: no cover

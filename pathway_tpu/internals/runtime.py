@@ -608,6 +608,11 @@ class GraphRunner:
 
         lower_sort(self, op)
 
+    def _lower_asof_now_join(self, op: Operator) -> None:
+        from ..stdlib.temporal._asof_now_join import lower_asof_now_join
+
+        lower_asof_now_join(self, op)
+
 
 def _iter_flat(seq):
     import numpy as np

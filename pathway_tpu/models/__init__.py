@@ -1,0 +1,19 @@
+"""JAX/Flax model stack: sentence encoders and cross-encoder rerankers.
+
+TPU replacements for the torch models the reference loads inside UDFs
+(xpacks/llm/embedders.py:270 SentenceTransformerEmbedder,
+rerankers.py:186 CrossEncoderReranker).
+"""
+
+from .tokenizer import HashTokenizer, load_tokenizer
+from .encoder import EncoderConfig, TransformerEncoder, SentenceEncoder
+from .cross_encoder import CrossEncoder
+
+__all__ = [
+    "HashTokenizer",
+    "load_tokenizer",
+    "EncoderConfig",
+    "TransformerEncoder",
+    "SentenceEncoder",
+    "CrossEncoder",
+]

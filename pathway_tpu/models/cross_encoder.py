@@ -1,0 +1,73 @@
+"""Cross-encoder reranker (query, doc) -> relevance score.
+
+TPU replacement for the reference's sentence-transformers CrossEncoder
+(xpacks/llm/rerankers.py:186 ``CrossEncoderReranker``): the pair is packed
+as ``[CLS] q [SEP] d [SEP]`` through the shared transformer encoder and a
+scalar head scores the CLS position; batches are padded to shape buckets and
+jit-compiled once per bucket.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .encoder import EncoderConfig, TransformerEncoder, bucketed_dispatch
+from .tokenizer import load_tokenizer
+
+__all__ = ["CrossEncoder"]
+
+
+class _ScoredEncoder(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        hidden = TransformerEncoder(self.cfg, name="encoder")(ids, mask, pool=False)
+        cls = hidden[:, 0, :].astype(jnp.float32)
+        return nn.Dense(1, name="score_head")(cls)[:, 0]
+
+
+class CrossEncoder:
+    def __init__(
+        self,
+        model_name: str | None = None,
+        cfg: EncoderConfig | None = None,
+        seed: int = 0,
+        max_length: int = 256,
+    ):
+        self.cfg = cfg or EncoderConfig()
+        self.max_length = min(max_length, self.cfg.max_len)
+        self.tokenizer = load_tokenizer(model_name, vocab_size=self.cfg.vocab_size)
+        self.model = _ScoredEncoder(self.cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        self.params = self.model.init(
+            jax.random.PRNGKey(seed), ids, jnp.ones_like(ids)
+        )["params"]
+        self._apply = jax.jit(
+            lambda params, ids, mask: self.model.apply({"params": params}, ids, mask)
+        )
+
+    def predict(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
+        """Scores for (query, doc) pairs, higher = more relevant."""
+        if not pairs:
+            return np.zeros((0,), dtype=np.float32)
+        queries = [q for q, _ in pairs]
+        docs = [d for _, d in pairs]
+        ids_all, mask_all = self.tokenizer.encode_batch(
+            queries, max_length=self.max_length, pair=docs
+        )
+        return bucketed_dispatch(
+            lambda ids, mask: self._apply(self.params, ids, mask),
+            ids_all,
+            mask_all,
+            self.max_length,
+        )
+
+    def __call__(self, query: str, doc: str) -> float:
+        return float(self.predict([(query, doc)])[0])

@@ -1,0 +1,94 @@
+"""Tokenizers for the JAX encoder stack.
+
+In a connected environment ``load_tokenizer`` uses a local HuggingFace
+tokenizer (WordPiece, as the reference's sentence-transformers models do);
+offline it falls back to :class:`HashTokenizer` — a deterministic hashing
+tokenizer producing the same id for the same word across runs, which is
+enough for throughput benchmarking and for tests with fake embedders.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["HashTokenizer", "load_tokenizer"]
+
+_WORD_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+
+
+class HashTokenizer:
+    PAD = 0
+    CLS = 1
+    SEP = 2
+    N_SPECIAL = 4
+
+    def __init__(self, vocab_size: int = 30522, lowercase: bool = True):
+        self.vocab_size = vocab_size
+        self.lowercase = lowercase
+
+    def _token_id(self, word: str) -> int:
+        h = int.from_bytes(
+            hashlib.blake2b(word.encode("utf-8"), digest_size=8).digest(), "little"
+        )
+        return self.N_SPECIAL + h % (self.vocab_size - self.N_SPECIAL)
+
+    def tokenize(self, text: str) -> list[int]:
+        if self.lowercase:
+            text = text.lower()
+        return [self._token_id(w) for w in _WORD_RE.findall(text)]
+
+    def encode_batch(
+        self,
+        texts: Sequence[str],
+        max_length: int = 256,
+        pair: Sequence[str] | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (ids[B,L], mask[B,L]) padded to ``max_length``."""
+        ids_list = []
+        for i, t in enumerate(texts):
+            ids = [self.CLS] + self.tokenize(t)[: max_length - 2] + [self.SEP]
+            if pair is not None:
+                ids = ids[: max_length // 2]
+                ids += self.tokenize(pair[i])[: max_length - len(ids) - 1] + [self.SEP]
+            ids_list.append(ids[:max_length])
+        L = max_length
+        batch = np.zeros((len(texts), L), dtype=np.int32)
+        mask = np.zeros((len(texts), L), dtype=np.int32)
+        for i, ids in enumerate(ids_list):
+            batch[i, : len(ids)] = ids
+            mask[i, : len(ids)] = 1
+        return batch, mask
+
+
+class _HFTokenizerWrapper:
+    def __init__(self, tok):
+        self.tok = tok
+        self.vocab_size = tok.vocab_size
+
+    def encode_batch(self, texts, max_length=256, pair=None):
+        enc = self.tok(
+            list(texts),
+            list(pair) if pair is not None else None,
+            padding="max_length",
+            truncation=True,
+            max_length=max_length,
+            return_tensors="np",
+        )
+        return enc["input_ids"].astype(np.int32), enc["attention_mask"].astype(np.int32)
+
+
+def load_tokenizer(model_name: str | None = None, vocab_size: int = 30522):
+    """Local HF tokenizer when available, hashing fallback otherwise."""
+    if model_name is not None:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(model_name, local_files_only=True)
+            return _HFTokenizerWrapper(tok)
+        except Exception:
+            pass
+    return HashTokenizer(vocab_size=vocab_size)

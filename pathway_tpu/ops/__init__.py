@@ -1,0 +1,12 @@
+"""Device-side numeric ops: distance/top-k kernels, HBM KNN index, LSH.
+
+This is the TPU replacement for the reference's CPU-side index math
+(src/external_integration/brute_force_knn_integration.rs blocked ndarray
+matmuls; stdlib/ml/classifiers/_knn_lsh.py numpy LSH).
+"""
+
+from .topk import masked_topk_scores, topk_search
+from .knn import DeviceKnnIndex
+from .lsh import LshProjector
+
+__all__ = ["masked_topk_scores", "topk_search", "DeviceKnnIndex", "LshProjector"]

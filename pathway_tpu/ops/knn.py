@@ -1,0 +1,193 @@
+"""HBM-resident brute-force KNN index with incremental upsert/delete.
+
+reference semantics: src/external_integration/brute_force_knn_integration.rs
+(dense matrix, grow-by-doubling at :113-120, cos + l2sq, top-k) — redesigned
+for TPU:
+
+* the vector matrix lives on device (HBM) as a padded ``[capacity, dim]``
+  array; rows are recycled through a tombstone ``valid`` mask instead of
+  compaction, so deletes are O(1) mask flips and search stays one fused
+  matmul+top-k on the MXU (``ops/topk.py``);
+* upserts/deletes arriving from the dataflow are staged host-side and
+  applied in one scatter per micro-batch (donated buffers — no reallocation
+  until the capacity doubles);
+* cosine vectors are L2-normalized once at insert, making query scoring a
+  plain dot product.
+
+The multi-device sharded variant lives in ``pathway_tpu/parallel/index.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Hashable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .topk import topk_search
+
+__all__ = ["DeviceKnnIndex"]
+
+
+class DeviceKnnIndex:
+    """Single-device incremental KNN index."""
+
+    def __init__(
+        self,
+        dim: int,
+        metric: str = "cos",
+        capacity: int = 1024,
+        dtype=jnp.float32,
+    ):
+        if metric not in ("cos", "l2sq", "dot"):
+            raise ValueError(f"unknown metric {metric!r}")
+        self.dim = dim
+        self.metric = metric
+        self.dtype = dtype
+        self.capacity = max(int(capacity), 8)
+        self.vectors = jnp.zeros((self.capacity, dim), dtype=dtype)
+        self.valid = jnp.zeros((self.capacity,), dtype=bool)
+        self.key_of_slot: list[Hashable | None] = [None] * self.capacity
+        self.slot_of_key: dict[Hashable, int] = {}
+        self.free: list[int] = list(range(self.capacity - 1, -1, -1))
+        # staged updates applied lazily before the next search
+        self._staged_set: dict[int, np.ndarray] = {}
+        self._staged_valid: dict[int, bool] = {}
+
+    def __len__(self) -> int:
+        return len(self.slot_of_key)
+
+    # -- mutation --
+    def upsert(self, key: Hashable, vector: Any) -> None:
+        vec = np.asarray(vector, dtype=np.float32).reshape(-1)
+        if vec.shape[0] != self.dim:
+            raise ValueError(
+                f"vector dim {vec.shape[0]} != index dim {self.dim}"
+            )
+        if self.metric == "cos":
+            norm = float(np.linalg.norm(vec))
+            if norm > 0:
+                vec = vec / norm
+        slot = self.slot_of_key.get(key)
+        if slot is None:
+            if not self.free:
+                self._grow()
+            slot = self.free.pop()
+            self.slot_of_key[key] = slot
+            self.key_of_slot[slot] = key
+        self._staged_set[slot] = vec
+        self._staged_valid[slot] = True
+
+    def remove(self, key: Hashable) -> None:
+        slot = self.slot_of_key.pop(key, None)
+        if slot is None:
+            return
+        self.key_of_slot[slot] = None
+        self.free.append(slot)
+        self._staged_valid[slot] = False
+        self._staged_set.pop(slot, None)
+
+    def _grow(self) -> None:
+        """Double capacity (reference: brute_force add :113-120)."""
+        old = self.capacity
+        self.capacity = old * 2
+        self.vectors = jnp.concatenate(
+            [self.vectors, jnp.zeros((old, self.dim), dtype=self.dtype)]
+        )
+        self.valid = jnp.concatenate([self.valid, jnp.zeros((old,), dtype=bool)])
+        self.key_of_slot.extend([None] * old)
+        self.free.extend(range(self.capacity - 1, old - 1, -1))
+
+    def _apply_staged(self) -> None:
+        if not self._staged_set and not self._staged_valid:
+            return
+        if self._staged_set:
+            idx = np.fromiter(self._staged_set.keys(), dtype=np.int32)
+            vals = np.stack(list(self._staged_set.values())).astype(self.dtype)
+            self.vectors = _scatter_rows(self.vectors, jnp.asarray(idx), jnp.asarray(vals))
+        if self._staged_valid:
+            vidx = np.fromiter(self._staged_valid.keys(), dtype=np.int32)
+            vvals = np.fromiter(self._staged_valid.values(), dtype=bool)
+            self.valid = _scatter_mask(self.valid, jnp.asarray(vidx), jnp.asarray(vvals))
+        self._staged_set.clear()
+        self._staged_valid.clear()
+
+    # -- search --
+    def search_among(
+        self, query: Any, keys: list[Hashable], k: int
+    ) -> list[tuple[Hashable, float]]:
+        """Exact rescoring restricted to ``keys`` (LSH candidate sets).
+        Gathers candidate rows on device and runs the same fused top-k."""
+        self._apply_staged()
+        slots = [self.slot_of_key[key] for key in keys if key in self.slot_of_key]
+        if not slots:
+            return []
+        q = np.asarray(query, dtype=np.float32).reshape(1, -1)
+        if self.metric == "cos":
+            norm = np.linalg.norm(q)
+            if norm > 0:
+                q = q / norm
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        sub_vectors = self.vectors[idx]
+        sub_valid = self.valid[idx]
+        k_eff = min(k, len(slots))
+        scores, sub_idx = topk_search(
+            jnp.asarray(q, dtype=self.dtype), sub_vectors, sub_valid, k_eff, self.metric
+        )
+        out: list[tuple[Hashable, float]] = []
+        for s, i in zip(np.asarray(scores)[0], np.asarray(sub_idx)[0]):
+            if not np.isfinite(s):
+                continue
+            key = self.key_of_slot[slots[int(i)]]
+            if key is not None:
+                out.append((key, float(s)))
+        return out
+
+    def search(
+        self, queries: Any, k: int
+    ) -> list[list[tuple[Hashable, float]]]:
+        """Top-k per query as (key, score) lists, higher scores better."""
+        self._apply_staged()
+        if len(self.slot_of_key) == 0:
+            q = np.atleast_2d(np.asarray(queries))
+            return [[] for _ in range(q.shape[0])]
+        q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+        if self.metric == "cos":
+            norms = np.linalg.norm(q, axis=1, keepdims=True)
+            norms[norms == 0] = 1.0
+            q = q / norms
+        k_eff = min(k, self.capacity)
+        scores, idx = topk_search(
+            jnp.asarray(q, dtype=self.dtype),
+            self.vectors,
+            self.valid,
+            k_eff,
+            self.metric,
+        )
+        scores = np.asarray(scores)
+        idx = np.asarray(idx)
+        out: list[list[tuple[Hashable, float]]] = []
+        for qi in range(q.shape[0]):
+            row: list[tuple[Hashable, float]] = []
+            for s, i in zip(scores[qi], idx[qi]):
+                if not np.isfinite(s):
+                    continue
+                key = self.key_of_slot[int(i)]
+                if key is None:
+                    continue
+                row.append((key, float(s)))
+                if len(row) == k:
+                    break
+            out.append(row)
+        return out
+
+
+@jax.jit
+def _scatter_rows(matrix: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Array:
+    return matrix.at[idx].set(vals)
+
+
+@jax.jit
+def _scatter_mask(mask: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Array:
+    return mask.at[idx].set(vals)

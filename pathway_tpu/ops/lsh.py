@@ -1,0 +1,48 @@
+"""LSH (random-projection) approximate KNN.
+
+reference semantics: python/pathway/stdlib/ml/classifiers/_knn_lsh.py
+(random projections :50-56, band/bucket grouping :64, candidate generation
+via flatten+groupby :135, numpy rescoring with np.argpartition :219-256).
+
+TPU design: signatures for all vectors are computed on device in one matmul
+(``vectors @ projections > 0`` packed into per-band int64 bucket ids);
+buckets are a host-side dict (pointer sets are tiny); exact rescoring of the
+candidate set runs through the same fused masked top-k as the brute-force
+index.  Cosine and euclidean metrics as in the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Hashable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LshProjector"]
+
+
+@functools.partial(jax.jit, static_argnames=("n_or", "n_and"))
+def _band_signatures(vecs: jax.Array, projections: jax.Array, n_or: int, n_and: int) -> jax.Array:
+    """[B, n_or] int32 bucket ids: sign-bit signatures packed per band."""
+    bits = (jnp.dot(vecs, projections.T) > 0).astype(jnp.int32)  # [B, n_or*n_and]
+    bits = bits.reshape(vecs.shape[0], n_or, n_and)
+    weights = (2 ** jnp.arange(n_and, dtype=jnp.int32))[None, None, :]
+    return jnp.sum(bits * weights, axis=-1)
+
+
+class LshProjector:
+    """Banded random-projection bucketing (reference: _knn_lsh.py
+    ``lsh_projection`` / generate_band_projections)."""
+
+    def __init__(self, dim: int, n_or: int = 8, n_and: int = 10, seed: int = 0):
+        self.dim = dim
+        self.n_or = n_or
+        self.n_and = n_and
+        key = jax.random.PRNGKey(seed)
+        self.projections = jax.random.normal(key, (n_or * n_and, dim), dtype=jnp.float32)
+
+    def signatures(self, vectors) -> np.ndarray:
+        v = jnp.asarray(np.atleast_2d(np.asarray(vectors, dtype=np.float32)))
+        return np.asarray(_band_signatures(v, self.projections, self.n_or, self.n_and))

@@ -1,0 +1,112 @@
+"""Masked distance + top-k search kernels.
+
+reference semantics: src/external_integration/brute_force_knn_integration.rs
+(``fill_cos_distances``:69, ``fill_l2sq_distances``:91, blocked matmul with
+``auxiliary_space`` bound, top-k via OrderedFloat sort).
+
+TPU design: one fused XLA computation — score matrix on the MXU
+(``queries @ vectors.T`` in bf16/f32), tombstone masking fused into the
+matmul epilogue, ``lax.top_k`` on device.  A Pallas variant tiles the score
+computation through VMEM for the case where the index matrix is too large
+for XLA's fusion to stay in VMEM; both produce identical results and the
+index picks per-backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["masked_topk_scores", "topk_search", "pallas_masked_scores"]
+
+NEG_INF = -jnp.inf
+
+
+def _scores(queries: jax.Array, vectors: jax.Array, metric: str) -> jax.Array:
+    """Similarity scores, higher = better.  cos assumes rows pre-normalized."""
+    if metric in ("cos", "dot"):
+        return jnp.dot(
+            queries, vectors.T, preferred_element_type=jnp.float32
+        )
+    if metric == "l2sq":
+        # -||q - v||^2 = 2 q·v - ||q||^2 - ||v||^2 (negated: higher better)
+        dots = jnp.dot(queries, vectors.T, preferred_element_type=jnp.float32)
+        qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+        vn = jnp.sum(vectors.astype(jnp.float32) ** 2, axis=-1)
+        return 2.0 * dots - qn - vn[None, :]
+    raise ValueError(f"unknown metric {metric!r}")
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def masked_topk_scores(
+    queries: jax.Array,  # [Q, D]
+    vectors: jax.Array,  # [N, D]
+    valid: jax.Array,  # [N] bool — tombstone mask (False = deleted/free slot)
+    metric: str = "cos",
+) -> jax.Array:
+    s = _scores(queries, vectors, metric)
+    return jnp.where(valid[None, :], s, NEG_INF)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def topk_search(
+    queries: jax.Array,
+    vectors: jax.Array,
+    valid: jax.Array,
+    k: int,
+    metric: str = "cos",
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (scores[Q,k], indices[Q,k]); deleted slots never surface
+    (their score is -inf — callers drop -inf results host-side)."""
+    s = masked_topk_scores(queries, vectors, valid, metric)
+    return lax.top_k(s, k)
+
+
+# ---------------------------------------------------------------------------
+# Pallas tiled variant (HBM-resident index streamed through VMEM)
+# ---------------------------------------------------------------------------
+
+
+def pallas_masked_scores(
+    queries: jax.Array,  # [Q, D] — Q, D multiples of tile sizes
+    vectors: jax.Array,  # [N, D]
+    valid: jax.Array,  # [N] float32 {0,1}
+    *,
+    block_n: int = 1024,
+) -> jax.Array:
+    """Tiled score kernel: for each (query-block, vector-block) grid cell,
+    compute q·vᵀ on the MXU and apply the tombstone mask in the epilogue.
+
+    Used when the index matrix exceeds what XLA keeps fused in VMEM; grid
+    iterates vector blocks in the minor dimension so each query tile stays
+    resident while index tiles stream from HBM.
+    """
+    from jax.experimental import pallas as pl
+
+    q, d = queries.shape
+    n = vectors.shape[0]
+    block_q = min(q, 256)
+    assert n % block_n == 0 and q % block_q == 0, "pad inputs to block multiples"
+
+    def kernel(q_ref, v_ref, m_ref, o_ref):
+        scores = jnp.dot(
+            q_ref[:], v_ref[:].T, preferred_element_type=jnp.float32
+        )
+        masked = jnp.where(m_ref[:][None, :] > 0, scores, NEG_INF)
+        o_ref[:] = masked
+
+    grid = (q // block_q, n // block_n)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((q, n), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_n,), lambda i, j: (j,)),
+        ],
+        out_specs=pl.BlockSpec((block_q, block_n), lambda i, j: (i, j)),
+    )(queries, vectors, valid.astype(jnp.float32))

@@ -1,0 +1,12 @@
+from . import indexing, ml, temporal, stateful, graphs, utils, statistical, ordered
+
+__all__ = [
+    "indexing",
+    "ml",
+    "temporal",
+    "stateful",
+    "graphs",
+    "utils",
+    "statistical",
+    "ordered",
+]

@@ -1,0 +1,38 @@
+"""Bellman-Ford shortest paths (reference: python/pathway/stdlib/graphs/bellman_ford/)."""
+
+from __future__ import annotations
+
+import math
+
+import pathway_tpu as pw
+from ...internals.table import Table
+
+__all__ = ["bellman_ford"]
+
+
+def bellman_ford(vertices: Table, edges: Table) -> Table:
+    """``vertices`` has column ``is_source`` (bool); ``edges`` has (u, v,
+    dist).  Returns per-vertex ``dist_from_source`` (inf when unreachable)."""
+
+    start = vertices.select(
+        dist_from_source=pw.if_else(vertices.is_source, 0.0, math.inf)
+    )
+
+    def step(state: Table) -> Table:
+        relaxed = edges.select(
+            vertex=edges.v,
+            candidate=state.ix(edges.u).dist_from_source + edges.dist,
+        )
+        best = relaxed.groupby(relaxed.vertex, id=relaxed.vertex).reduce(
+            candidate=pw.reducers.min(relaxed.candidate)
+        )
+        return state.select(
+            dist_from_source=pw.if_else(
+                best.ix(state.id, optional=True).candidate.is_not_none()
+                & (best.ix(state.id, optional=True).candidate < state.dist_from_source),
+                best.ix(state.id, optional=True).candidate.num.fill_na(math.inf),
+                state.dist_from_source,
+            )
+        )
+
+    return pw.iterate(step, state=start)

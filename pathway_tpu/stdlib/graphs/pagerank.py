@@ -1,0 +1,31 @@
+"""PageRank over an edges table (reference: python/pathway/stdlib/graphs/pagerank.py)."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from ...internals.table import Table
+
+__all__ = ["pagerank"]
+
+
+def pagerank(edges: Table, steps: int = 5) -> Table:
+    """``edges`` has columns (u, v) of Pointer; returns table keyed by vertex
+    id with a ``rank`` column (integer fixed-point, like the reference)."""
+    degrees = edges.groupby(edges.u).reduce(u=edges.u, degree=pw.reducers.count())
+    base = edges.groupby(edges.v).reduce(v=edges.v, rank=pw.apply_with_type(lambda *_: 1_000, int))
+
+    def one_step(ranks: Table) -> Table:
+        deg = degrees.with_id_from(degrees.u)
+        r = ranks.with_id_from(ranks.v)
+        flows = edges.select(
+            edges.v,
+            flow=r.ix(edges.pointer_from(edges.u), optional=True).rank.num.fill_na(1000)
+            // deg.ix(edges.pointer_from(edges.u)).degree,
+        )
+        inflow = flows.groupby(flows.v).reduce(
+            v=flows.v, rank=pw.cast(int, pw.reducers.sum(flows.flow) * 83 // 100 + 170)
+        )
+        return inflow
+
+    result = pw.iterate(one_step, iteration_limit=steps, ranks=base)
+    return result

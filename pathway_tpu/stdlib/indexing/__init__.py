@@ -1,0 +1,46 @@
+"""Indexing stdlib: DataIndex facade, retriever factories, sorting.
+
+reference: python/pathway/stdlib/indexing/ (data_index.py, nearest_neighbors.py,
+bm25.py, hybrid_index.py, sorting.py).
+"""
+
+from .data_index import (
+    DataIndex,
+    default_vector_document_index,
+    default_usearch_knn_document_index,
+    default_brute_force_knn_document_index,
+    default_lsh_knn_document_index,
+    default_full_text_document_index,
+)
+from .retrievers import (
+    InnerIndexFactory,
+    BruteForceKnnFactory,
+    UsearchKnnFactory,
+    LshKnnFactory,
+    TantivyBM25Factory,
+    BM25Factory,
+    USearchMetricKind,
+    BruteForceKnnMetricKind,
+)
+from .hybrid_index import HybridIndex, HybridIndexFactory
+from .sorting import sort
+
+__all__ = [
+    "DataIndex",
+    "InnerIndexFactory",
+    "BruteForceKnnFactory",
+    "UsearchKnnFactory",
+    "LshKnnFactory",
+    "TantivyBM25Factory",
+    "BM25Factory",
+    "USearchMetricKind",
+    "BruteForceKnnMetricKind",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "sort",
+    "default_vector_document_index",
+    "default_usearch_knn_document_index",
+    "default_brute_force_knn_document_index",
+    "default_lsh_knn_document_index",
+    "default_full_text_document_index",
+]

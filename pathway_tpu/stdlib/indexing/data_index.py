@@ -1,0 +1,351 @@
+"""DataIndex — retrieval facade over an inner index.
+
+reference: python/pathway/stdlib/indexing/data_index.py:278 (``DataIndex``,
+``query``:349 / ``query_as_of_now``:412, response repacking
+``_extract_data_collapsed_rows``:91) and colnames.py (``_pw_index_reply``,
+``_pw_index_reply_score``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals import dtype as dt
+from ...internals.desugaring import expand_select_args
+from ...internals.expression import ColumnExpression, ColumnReference, smart_wrap
+from ...internals.graph import Operator
+from ...internals.schema import ColumnSchema, _schema_from_columns
+from ...internals.table import Table
+from .retrievers import InnerIndexFactory
+
+__all__ = [
+    "DataIndex",
+    "default_vector_document_index",
+    "default_usearch_knn_document_index",
+    "default_brute_force_knn_document_index",
+    "default_lsh_knn_document_index",
+    "default_full_text_document_index",
+    "_external_index_as_of_now",
+]
+
+_INDEX_REPLY = "_pw_index_reply"
+_SCORE = "_pw_index_reply_score"
+_ID = "_pw_index_reply_id"
+
+
+def _build_index_operator(
+    data_table: Table,
+    query_table: Table,
+    factory: InnerIndexFactory,
+    index_data: ColumnExpression,
+    query_data: ColumnExpression,
+    *,
+    index_metadata: ColumnExpression | None = None,
+    k: Any = 3,
+    query_filter: ColumnExpression | None = None,
+    mode: str = "asof_now",
+) -> Table:
+    """Creates the raw reply table: query columns + ``_pw_index_reply`` of
+    ``((doc_id, score, payload), ...)`` tuples."""
+    payload_exprs = [data_table[n] for n in data_table.column_names()]
+    columns = {
+        n: ColumnSchema(name=n, dtype=c.dtype)
+        for n, c in query_table.schema.columns().items()
+    }
+    columns[_INDEX_REPLY] = ColumnSchema(name=_INDEX_REPLY, dtype=dt.List(dt.ANY))
+    schema = _schema_from_columns(columns)
+    op = Operator(
+        "external_index",
+        [data_table, query_table],
+        params=dict(
+            factory=factory,
+            index_data=index_data,
+            index_metadata=index_metadata,
+            query_data=query_data,
+            k=k,
+            query_filter=query_filter,
+            payload_exprs=payload_exprs,
+            mode=mode,
+        ),
+    )
+    return Table._new(op, schema, query_table._universe)
+
+
+def _external_index_as_of_now(
+    data_table: Table,
+    index_factory,
+    query_table: Table,
+    *,
+    index_column,
+    query_column,
+    query_responses_limit_column=None,
+    index_filter_data_column=None,
+    query_filter_column=None,
+) -> Table:
+    """Low-level parity API (reference: Table._external_index_as_of_now /
+    graph.rs:894 ``use_external_index_as_of_now``)."""
+    return _build_index_operator(
+        data_table,
+        query_table,
+        index_factory,
+        index_column,
+        query_column,
+        index_metadata=index_filter_data_column,
+        k=query_responses_limit_column if query_responses_limit_column is not None else 3,
+        query_filter=query_filter_column,
+        mode="asof_now",
+    )
+
+
+class _IndexJoinResult:
+    """Emulates the reference's JoinResult returned by DataIndex.query*:
+    ``pw.left`` = query table, ``pw.right`` = repacked results (same
+    universe, so the select lowers to a key-aligned zip)."""
+
+    def __init__(self, left: Table, right: Table):
+        self._left = left
+        self._right = right
+
+    def select(self, *args: Any, **kwargs: Any) -> Table:
+        exprs = expand_select_args(
+            args, kwargs, self._left, self._left, self._right
+        )
+        return self._left._select_exprs(exprs, universe=self._left._universe)
+
+    def filter(self, condition):
+        flat = self._flat()
+        from ...internals.desugaring import resolve_expression
+
+        return flat.filter(resolve_expression(condition, flat, flat, flat))
+
+    def _flat(self) -> Table:
+        exprs: dict[str, Any] = {}
+        for n in self._right.column_names():
+            exprs[n] = self._right[n]
+        for n in self._left.column_names():
+            exprs[n] = self._left[n]
+        return self.select(**exprs)
+
+
+class DataIndex:
+    """reference: data_index.py:278"""
+
+    def __init__(
+        self,
+        data_table: Table,
+        inner_index: "InnerIndexFactory",
+        *,
+        data_column: ColumnReference | None = None,
+        metadata_column: ColumnReference | None = None,
+        embedder=None,
+    ):
+        self.data_table = data_table
+        self.factory = inner_index
+        self.data_column = data_column
+        self.metadata_column = metadata_column
+        self.embedder = embedder
+
+    def _query_impl(
+        self,
+        query_column: ColumnReference,
+        number_of_matches,
+        collapse_rows: bool,
+        metadata_filter,
+        mode: str,
+    ):
+        query_table = query_column.table
+        index_data = self.data_column if self.data_column is not None else None
+        if index_data is None:
+            raise ValueError("DataIndex requires data_column")
+        if self.embedder is not None:
+            index_data = self.embedder(index_data)
+            query_column = self.embedder(query_column)
+        raw = _build_index_operator(
+            self.data_table,
+            query_table,
+            self.factory,
+            index_data,
+            query_column,
+            index_metadata=self.metadata_column,
+            k=number_of_matches,
+            query_filter=metadata_filter,
+            mode=mode,
+        )
+        right = self._repack(raw, collapse_rows)
+        return _IndexJoinResult(query_table, right)
+
+    def _repack(self, raw: Table, collapse_rows: bool) -> Table:
+        """reference: data_index.py:46,91 ``_extract_data_*``."""
+        from ...internals.expression import ApplyExpression
+
+        data_cols = self.data_table.column_names()
+        exprs: dict[str, ColumnExpression] = {}
+
+        def unpack(idx: int, dtype):
+            def fn(reply):
+                return tuple(m[2][idx] for m in reply)
+
+            return ApplyExpression(fn, dt.List(dtype), raw[_INDEX_REPLY])
+
+        for i, n in enumerate(data_cols):
+            exprs[n] = unpack(i, self.data_table.schema[n].dtype)
+        exprs[_ID] = ApplyExpression(
+            lambda reply: tuple(m[0] for m in reply), dt.List(dt.POINTER), raw[_INDEX_REPLY]
+        )
+        exprs[_SCORE] = ApplyExpression(
+            lambda reply: tuple(m[1] for m in reply), dt.List(dt.FLOAT), raw[_INDEX_REPLY]
+        )
+        collapsed = raw._select_exprs(exprs, universe=raw._universe)
+        if collapse_rows:
+            return collapsed
+        # flat mode: one row per match
+        packed = collapsed._select_exprs(
+            {
+                "__rows__": ApplyExpression(
+                    lambda *cols: tuple(zip(*cols)) if cols and cols[0] else (),
+                    dt.List(dt.ANY),
+                    *[collapsed[n] for n in (*data_cols, _ID, _SCORE)],
+                )
+            },
+            universe=collapsed._universe,
+        )
+        flat = packed.flatten(packed["__rows__"])
+        out_exprs = {}
+        for i, n in enumerate((*data_cols, _ID, _SCORE)):
+            out_exprs[n] = flat["__rows__"].get(i)
+        return flat._select_exprs(out_exprs, universe=flat._universe)
+
+    def query(
+        self,
+        query_column: ColumnReference,
+        *,
+        number_of_matches=3,
+        collapse_rows: bool = True,
+        metadata_filter=None,
+    ):
+        """Maintained retrieval: answers update when the index changes
+        (reference: data_index.py:349)."""
+        return self._query_impl(
+            query_column, number_of_matches, collapse_rows, metadata_filter, "live"
+        )
+
+    def query_as_of_now(
+        self,
+        query_column: ColumnReference,
+        *,
+        number_of_matches=3,
+        collapse_rows: bool = True,
+        metadata_filter=None,
+    ):
+        """Serve-time retrieval: answer with current state, never revisit
+        (reference: data_index.py:412)."""
+        return self._query_impl(
+            query_column, number_of_matches, collapse_rows, metadata_filter, "asof_now"
+        )
+
+
+# ---------------------------------------------------------------------------
+# default document index constructors
+# (reference: stdlib/indexing/__init__.py default_* helpers)
+# ---------------------------------------------------------------------------
+
+
+def default_vector_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder=None,
+    dimensions: int | None = None,
+    metadata_column: ColumnReference | None = None,
+) -> DataIndex:
+    from .retrievers import BruteForceKnnFactory
+
+    factory = BruteForceKnnFactory(dimensions=dimensions, embedder=embedder)
+    return DataIndex(
+        data_table,
+        factory,
+        data_column=data_column,
+        metadata_column=metadata_column,
+        embedder=embedder,
+    )
+
+
+def default_usearch_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    dimensions: int | None = None,
+    embedder=None,
+    metadata_column: ColumnReference | None = None,
+    **kwargs,
+) -> DataIndex:
+    from .retrievers import UsearchKnnFactory
+
+    factory = UsearchKnnFactory(dimensions=dimensions, embedder=embedder, **kwargs)
+    return DataIndex(
+        data_table,
+        factory,
+        data_column=data_column,
+        metadata_column=metadata_column,
+        embedder=embedder,
+    )
+
+
+def default_brute_force_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    dimensions: int | None = None,
+    embedder=None,
+    metadata_column: ColumnReference | None = None,
+    **kwargs,
+) -> DataIndex:
+    from .retrievers import BruteForceKnnFactory
+
+    factory = BruteForceKnnFactory(dimensions=dimensions, embedder=embedder, **kwargs)
+    return DataIndex(
+        data_table,
+        factory,
+        data_column=data_column,
+        metadata_column=metadata_column,
+        embedder=embedder,
+    )
+
+
+def default_lsh_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    dimensions: int | None = None,
+    embedder=None,
+    metadata_column: ColumnReference | None = None,
+    **kwargs,
+) -> DataIndex:
+    from .retrievers import LshKnnFactory
+
+    factory = LshKnnFactory(dimensions=dimensions, embedder=embedder, **kwargs)
+    return DataIndex(
+        data_table,
+        factory,
+        data_column=data_column,
+        metadata_column=metadata_column,
+        embedder=embedder,
+    )
+
+
+def default_full_text_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    metadata_column: ColumnReference | None = None,
+    **kwargs,
+) -> DataIndex:
+    from .retrievers import TantivyBM25Factory
+
+    factory = TantivyBM25Factory(**kwargs)
+    return DataIndex(
+        data_table,
+        factory,
+        data_column=data_column,
+        metadata_column=metadata_column,
+    )

@@ -1,0 +1,106 @@
+"""HybridIndex: reciprocal-rank fusion over multiple retrievers.
+
+reference: python/pathway/stdlib/indexing/hybrid_index.py:14 (RRF with
+k=60 at :27).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression
+from .data_index import DataIndex, _IndexJoinResult, _ID, _SCORE
+
+__all__ = ["HybridIndex", "HybridIndexFactory"]
+
+
+class HybridIndex:
+    """Fuse rankings from several DataIndex retrievers with RRF."""
+
+    def __init__(self, retrievers: list[DataIndex], k: float = 60.0):
+        self.retrievers = retrievers
+        self.k = k
+
+    def _fuse(self, query_table, results: list, number_of_matches: int):
+        # results: list of collapsed right-tables (same universe as queries)
+        data_cols = self.retrievers[0].data_table.column_names()
+        rrf_k = self.k
+
+        def fuse(*packed):
+            n = len(packed) // (len(data_cols) + 2)
+            # packed groups: per retriever: (*data_cols, ids, scores)
+            stride = len(data_cols) + 2
+            scores: dict[Any, float] = {}
+            payload: dict[Any, tuple] = {}
+            for r in range(n):
+                group = packed[r * stride : (r + 1) * stride]
+                ids = group[len(data_cols)]
+                for rank, key in enumerate(ids):
+                    scores[key] = scores.get(key, 0.0) + 1.0 / (rrf_k + rank + 1)
+                    payload[key] = tuple(group[c][rank] for c in range(len(data_cols)))
+            ranked = sorted(scores.items(), key=lambda kv: -kv[1])[:number_of_matches]
+            return tuple(
+                (key, score, payload[key]) for key, score in ranked
+            )
+
+        args = []
+        for right in results:
+            for n in data_cols:
+                args.append(right[n])
+            args.append(right[_ID])
+            args.append(right[_SCORE])
+        fused = query_table._select_exprs(
+            {"__fused__": ApplyExpression(fuse, dt.List(dt.ANY), *args)},
+            universe=query_table._universe,
+        )
+        out_exprs = {}
+        for i, n in enumerate(data_cols):
+            out_exprs[n] = ApplyExpression(
+                lambda f, _i=i: tuple(m[2][_i] for m in f), dt.List(dt.ANY), fused["__fused__"]
+            )
+        out_exprs[_ID] = ApplyExpression(
+            lambda f: tuple(m[0] for m in f), dt.List(dt.POINTER), fused["__fused__"]
+        )
+        out_exprs[_SCORE] = ApplyExpression(
+            lambda f: tuple(m[1] for m in f), dt.List(dt.FLOAT), fused["__fused__"]
+        )
+        right = fused._select_exprs(out_exprs, universe=fused._universe)
+        return _IndexJoinResult(query_table, right)
+
+    def query_as_of_now(
+        self, query_column, *, number_of_matches=3, collapse_rows=True, metadata_filter=None
+    ):
+        rights = [
+            r.query_as_of_now(
+                query_column,
+                number_of_matches=number_of_matches * 2,
+                collapse_rows=True,
+                metadata_filter=metadata_filter,
+            )._right
+            for r in self.retrievers
+        ]
+        return self._fuse(query_column.table, rights, number_of_matches)
+
+    def query(
+        self, query_column, *, number_of_matches=3, collapse_rows=True, metadata_filter=None
+    ):
+        rights = [
+            r.query(
+                query_column,
+                number_of_matches=number_of_matches * 2,
+                collapse_rows=True,
+                metadata_filter=metadata_filter,
+            )._right
+            for r in self.retrievers
+        ]
+        return self._fuse(query_column.table, rights, number_of_matches)
+
+
+class HybridIndexFactory:
+    """reference: indexing/__init__.py HybridIndexFactory — builds a
+    HybridIndex from retriever factories at DocumentStore build time."""
+
+    def __init__(self, retriever_factories: list, k: float = 60.0):
+        self.retriever_factories = retriever_factories
+        self.k = k

@@ -1,0 +1,259 @@
+"""Runtime node + lowering for the external-index operator and sorting.
+
+reference: src/engine/dataflow/operators/external_index.rs
+(``use_external_index_as_of_now_core``:81 — updates applied before queries
+per time batch :129-160; index stream broadcast :95) and graph.rs:894.
+
+TPU re-design: instead of replicating the index to every worker via
+broadcast, the index lives once in device HBM (see ops/knn.py); the node is
+marked ``late`` so the engine's per-timestamp barrier guarantees globally
+that all index updates for a timestamp land before any query of that
+timestamp is answered — the invariant the reference gets from
+``batch_by_time`` + local operator ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals.engine import Entry, Node, consolidate
+from ...internals.evaluator import compile_expression
+from ...internals.runtime import GraphRunner, _TableLayout
+from ...internals.graph import Operator
+
+__all__ = ["ExternalIndexNode", "lower_external_index", "lower_sort"]
+
+
+class ExternalIndexNode(Node):
+    """Port 0 = index updates (docs), port 1 = queries."""
+
+    late = True
+
+    def __init__(
+        self,
+        index,
+        doc_data_fn,
+        doc_meta_fn,
+        query_data_fn,
+        query_k_fn,
+        query_filter_fn,
+        doc_payload_fn,
+        mode: str = "asof_now",
+        name: str = "external_index",
+    ):
+        super().__init__(n_inputs=2, name=name)
+        self.index = index
+        self.doc_data_fn = doc_data_fn
+        self.doc_meta_fn = doc_meta_fn
+        self.query_data_fn = query_data_fn
+        self.query_k_fn = query_k_fn
+        self.query_filter_fn = query_filter_fn
+        self.doc_payload_fn = doc_payload_fn
+        self.mode = mode
+        # doc payload snapshot for reply enrichment (as-of-answer-time)
+        self.doc_payload: dict[Any, tuple] = {}
+        # live-mode query state: qkey -> (row, last_emitted_row)
+        self.live_queries: dict[Any, list] = {}
+
+    def flush(self, time: int) -> list[Entry]:
+        out: list[Entry] = []
+        index_changed = False
+        # 1. apply index updates (updates-before-queries)
+        for key, row, diff in self.take(0):
+            index_changed = True
+            if diff > 0:
+                ctx = (key, row)
+                self.index.add(key, self.doc_data_fn(ctx), self.doc_meta_fn(ctx))
+                self.doc_payload[key] = self.doc_payload_fn(ctx)
+            else:
+                self.index.remove(key)
+                self.doc_payload.pop(key, None)
+        # 2. answer new queries
+        new_queries: list[tuple[Any, tuple]] = []
+        for key, row, diff in self.take(1):
+            if self.mode == "asof_now":
+                if diff > 0:
+                    new_queries.append((key, row))
+                else:
+                    # reference requires append-only query streams for
+                    # as-of-now operators (external_index.rs asof-now contract)
+                    raise ValueError(
+                        "as-of-now index received a query retraction; the "
+                        "query stream must be append-only (did you mean "
+                        "DataIndex.query instead of query_as_of_now?)"
+                    )
+            else:
+                slot = self.live_queries.get(key)
+                if diff > 0:
+                    self.live_queries[key] = [row, None]
+                    new_queries.append((key, row))
+                elif slot is not None:
+                    if slot[1] is not None:
+                        out.append((key, slot[1], -1))
+                    del self.live_queries[key]
+        if new_queries:
+            replies = self._answer([row for _, row in new_queries])
+            for (key, row), reply in zip(new_queries, replies):
+                out_row = tuple(row) + (reply,)
+                out.append((key, out_row, 1))
+                if self.mode == "live":
+                    self.live_queries[key][1] = out_row
+        # 3. live mode: refresh previously-answered queries on index change
+        if self.mode == "live" and index_changed and self.live_queries:
+            stale = [
+                (key, slot)
+                for key, slot in self.live_queries.items()
+                if slot[1] is not None and not any(key == k for k, _ in new_queries)
+            ]
+            if stale:
+                from ...internals.engine import freeze_row
+
+                replies = self._answer([slot[0] for _, slot in stale])
+                for (key, slot), reply in zip(stale, replies):
+                    new_row = tuple(slot[0]) + (reply,)
+                    if freeze_row(new_row) != freeze_row(slot[1]):
+                        out.append((key, slot[1], -1))
+                        out.append((key, new_row, 1))
+                        slot[1] = new_row
+        return consolidate(out)
+
+    def _answer(self, rows: list[tuple]) -> list[tuple]:
+        queries = []
+        for row in rows:
+            ctx = (None, row)
+            queries.append(
+                (
+                    self.query_data_fn(ctx),
+                    int(self.query_k_fn(ctx)),
+                    self.query_filter_fn(ctx),
+                )
+            )
+        raw = self.index.search(queries)
+        replies = []
+        for matches in raw:
+            replies.append(
+                tuple(
+                    (key, float(score), self.doc_payload.get(key))
+                    for key, score in matches
+                )
+            )
+        return replies
+
+
+def lower_external_index(runner: GraphRunner, op: Operator) -> None:
+    docs_t, query_t = op.inputs
+    dlayout = _TableLayout([docs_t])
+    qlayout = _TableLayout([query_t])
+    dresolve = dlayout.resolver()
+    qresolve = qlayout.resolver()
+
+    p = op.params
+    index = p["factory"].build_inner_index()
+    doc_data_fn = compile_expression(p["index_data"], dresolve)
+    meta = p.get("index_metadata")
+    doc_meta_fn = (
+        compile_expression(meta, dresolve) if meta is not None else (lambda ctx: None)
+    )
+    payload_fns = [
+        compile_expression(e, dresolve) for e in p.get("payload_exprs", [])
+    ]
+
+    def doc_payload_fn(ctx):
+        return tuple(f(ctx) for f in payload_fns)
+
+    query_data_fn = compile_expression(p["query_data"], qresolve)
+    k = p.get("k", 3)
+    if hasattr(k, "_dtype"):
+        query_k_fn = compile_expression(k, qresolve)
+    else:
+        query_k_fn = lambda ctx, _k=k: _k
+    flt = p.get("query_filter")
+    query_filter_fn = (
+        compile_expression(flt, qresolve) if flt is not None else (lambda ctx: None)
+    )
+
+    node = ExternalIndexNode(
+        index,
+        doc_data_fn,
+        doc_meta_fn,
+        query_data_fn,
+        query_k_fn,
+        query_filter_fn,
+        doc_payload_fn,
+        mode=p.get("mode", "asof_now"),
+        name=f"index#{op.id}",
+    )
+    runner.engine.add(node)
+    runner._connect_inputs(op, node)
+    runner._register(op, node)
+
+
+# ---------------------------------------------------------------------------
+# sorting (reference: src/engine/dataflow/operators/prev_next.rs:770
+# add_prev_next_pointers; stdlib/indexing/sorting.py)
+# ---------------------------------------------------------------------------
+
+
+class SortNode(Node):
+    """Maintains per-instance ordering, emits (prev, next) pointer columns."""
+
+    def __init__(self, key_fn, instance_fn, name: str = "sort"):
+        super().__init__(n_inputs=1, name=name)
+        self.key_fn = key_fn
+        self.instance_fn = instance_fn
+        from collections import defaultdict
+
+        self.rows: dict = {}
+        self.instances: dict = defaultdict(dict)  # inst -> {key: sort_val}
+        self.last_out: dict = {}
+
+    def flush(self, time: int) -> list[Entry]:
+        from ...internals.engine import freeze_value
+
+        dirty = set()
+        for key, row, diff in self.take(0):
+            ctx = (key, row)
+            inst = freeze_value(self.instance_fn(ctx))
+            dirty.add(inst)
+            if diff > 0:
+                self.instances[inst][key] = self.key_fn(ctx)
+                self.rows[key] = inst
+            else:
+                self.instances[inst].pop(key, None)
+                self.rows.pop(key, None)
+        out: list[Entry] = []
+        for inst in dirty:
+            ordered = sorted(self.instances[inst].items(), key=lambda kv: (kv[1], kv[0]))
+            n = len(ordered)
+            for i, (key, _val) in enumerate(ordered):
+                prev_key = ordered[i - 1][0] if i > 0 else None
+                next_key = ordered[i + 1][0] if i < n - 1 else None
+                new_row = (prev_key, next_key)
+                old = self.last_out.get(key)
+                if old != new_row:
+                    if old is not None:
+                        out.append((key, old, -1))
+                    out.append((key, new_row, 1))
+                    self.last_out[key] = new_row
+        # rows fully removed
+        gone = [k for k in self.last_out if k not in self.rows]
+        for key in gone:
+            out.append((key, self.last_out.pop(key), -1))
+        return consolidate(out)
+
+
+def lower_sort(runner: GraphRunner, op: Operator) -> None:
+    table = op.inputs[0]
+    layout = _TableLayout([table])
+    resolve = layout.resolver()
+    key_fn = compile_expression(op.params["key"], resolve)
+    instance = op.params.get("instance")
+    inst_fn = (
+        compile_expression(instance, resolve)
+        if instance is not None
+        else (lambda ctx: 0)
+    )
+    node = SortNode(key_fn, inst_fn, name=f"sort#{op.id}")
+    runner.engine.add(node)
+    runner._connect_inputs(op, node)
+    runner._register(op, node)

@@ -1,0 +1,41 @@
+"""Sorting: prev/next pointers per instance.
+
+reference: python/pathway/stdlib/indexing/sorting.py:230 ``sort`` backed by
+src/engine/dataflow/operators/prev_next.rs ``add_prev_next_pointers``.
+"""
+
+from __future__ import annotations
+
+from ...internals import dtype as dt
+from ...internals.desugaring import resolve_expression
+from ...internals.graph import Operator
+from ...internals.schema import ColumnSchema, _schema_from_columns
+from ...internals.table import Table
+
+__all__ = ["sort", "retrieve_prev_next_values"]
+
+
+def sort(table: Table, key=None, instance=None) -> Table:
+    """Returns a table (same universe) with ``prev``/``next`` Pointer cols."""
+    if key is None:
+        key = table[table.column_names()[0]]
+    key_e = resolve_expression(key, table)
+    instance_e = (
+        resolve_expression(instance, table) if instance is not None else None
+    )
+    schema = _schema_from_columns(
+        {
+            "prev": ColumnSchema(name="prev", dtype=dt.Optional(dt.POINTER)),
+            "next": ColumnSchema(name="next", dtype=dt.Optional(dt.POINTER)),
+        }
+    )
+    op = Operator("sort", [table], params=dict(key=key_e, instance=instance_e))
+    return Table._new(op, schema, table._universe)
+
+
+def retrieve_prev_next_values(ordered_table: Table, value=None) -> Table:
+    """reference: sorting.py retrieve_prev_next_values — for each row, the
+    nearest non-None value looking backward/forward along the ordering."""
+    raise NotImplementedError(
+        "retrieve_prev_next_values lands with the statistical interpolate pass"
+    )

@@ -1,0 +1,4 @@
+from . import index
+from .index import KNNIndex
+
+__all__ = ["index", "KNNIndex"]

@@ -1,0 +1,108 @@
+"""Legacy KNNIndex API (the class named in the north star).
+
+reference: python/pathway/stdlib/ml/index.py:9 — LSH-backed there
+(``_knn_lsh.py``); here backed by the HBM brute-force/LSH device indexes via
+DataIndex, keeping the ``get_nearest_items`` / ``get_nearest_items_asof_now``
+surface (index.py:54,194).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals.expression import ColumnReference
+from ...internals.table import Table
+from ..indexing.data_index import DataIndex, _SCORE, _ID
+from ..indexing.retrievers import BruteForceKnnFactory, LshKnnFactory
+
+__all__ = ["KNNIndex"]
+
+
+class KNNIndex:
+    """K-nearest-neighbors index over an embeddings column."""
+
+    def __init__(
+        self,
+        data_embedding: ColumnReference,
+        data: Table,
+        n_dimensions: int,
+        n_or: int = 20,
+        n_and: int = 10,
+        bucket_length: float = 10.0,
+        distance_type: str = "euclidean",
+        metadata: ColumnReference | None = None,
+    ):
+        self.data = data
+        self.data_embedding = data_embedding
+        self._distance_type = distance_type
+        if n_or * n_and <= 64 and distance_type in ("euclidean", "cosine"):
+            # small LSH configs: keep the reference's approximate behavior
+            factory: Any = LshKnnFactory(
+                dimensions=n_dimensions,
+                n_or=n_or,
+                n_and=n_and,
+                bucket_length=bucket_length,
+                distance_type=distance_type,
+            )
+        else:
+            metric = "cos" if distance_type.startswith("cos") else "l2sq"
+            factory = BruteForceKnnFactory(dimensions=n_dimensions, metric=metric)
+        self.index = DataIndex(
+            data,
+            factory,
+            data_column=data_embedding,
+            metadata_column=metadata,
+        )
+
+    def get_nearest_items(
+        self,
+        query_embedding: ColumnReference,
+        k: Any = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter: Any = None,
+    ) -> Table:
+        """reference: ml/index.py:54"""
+        return self._get(
+            query_embedding, k, collapse_rows, with_distances, metadata_filter, live=True
+        )
+
+    def get_nearest_items_asof_now(
+        self,
+        query_embedding: ColumnReference,
+        k: Any = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter: Any = None,
+    ) -> Table:
+        """reference: ml/index.py:194"""
+        return self._get(
+            query_embedding, k, collapse_rows, with_distances, metadata_filter, live=False
+        )
+
+    def _get(self, query_embedding, k, collapse_rows, with_distances, metadata_filter, live):
+        method = self.index.query if live else self.index.query_as_of_now
+        jr = method(
+            query_embedding,
+            number_of_matches=k,
+            collapse_rows=collapse_rows,
+            metadata_filter=metadata_filter,
+        )
+        right = jr._right
+        cols = {}
+        for n in self.data.column_names():
+            cols[n] = right[n]
+        if with_distances:
+            # inner scores are similarities (higher=better); the reference
+            # returns *distances* (ml/index.py) — convert so code ported from
+            # the reference keeps its sort/threshold orientation:
+            # cosine: 1 - cos_sim;  euclidean: ||q-v||^2 = -score
+            if self._distance_type.startswith("cos"):
+                conv = lambda scores: tuple(1.0 - s for s in scores)
+            else:
+                conv = lambda scores: tuple(-s for s in scores)
+            from ...internals.expression import ApplyExpression
+            from ...internals import dtype as dt
+
+            cols["dist"] = ApplyExpression(conv, dt.List(dt.FLOAT), right[_SCORE])
+        return jr._left._select_exprs(cols, universe=jr._left._universe)

@@ -1,0 +1,28 @@
+"""Ordered ops (reference: python/pathway/stdlib/ordered/ ``diff``)."""
+
+from __future__ import annotations
+
+from ...internals.table import Table
+
+__all__ = ["diff"]
+
+
+def diff(table: Table, timestamp, *values, instance=None) -> Table:
+    """Per-row difference vs the previous row in ``timestamp`` order
+    (reference: stdlib/ordered/diff.py)."""
+    import pathway_tpu as pw
+
+    from ..indexing.sorting import sort as _sort
+
+    order = _sort(table, key=timestamp, instance=instance)
+    with_prev = table.with_columns(__prev__=order.prev)
+    exprs = {}
+    for v in values:
+        name = v.name
+        prev_val = table.ix(with_prev["__prev__"], optional=True, context=with_prev)[name]
+        exprs[f"diff_{name}"] = pw.if_else(
+            with_prev["__prev__"].is_none(),
+            None,
+            table[name] - prev_val,
+        )
+    return with_prev._select_exprs(exprs, universe=table._universe)

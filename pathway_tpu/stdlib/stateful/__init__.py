@@ -1,0 +1,14 @@
+"""Stateful ops (reference: python/pathway/stdlib/stateful/).
+
+``deduplicate`` is exposed as a Table method (internals/table.py) and as a
+free function here for parity."""
+
+from ...internals.table import Table
+
+__all__ = ["deduplicate"]
+
+
+def deduplicate(table: Table, *, value, instance=None, acceptor=None, persistent_id=None) -> Table:
+    return table.deduplicate(
+        value=value, instance=instance, acceptor=acceptor, persistent_id=persistent_id
+    )

@@ -1,0 +1,11 @@
+"""Statistical helpers (reference: python/pathway/stdlib/statistical/)."""
+
+from __future__ import annotations
+
+__all__ = ["interpolate"]
+
+
+def interpolate(table, timestamp, *values, mode=None):
+    raise NotImplementedError(
+        "interpolate lands with the temporal/ordered milestone"
+    )

@@ -1,0 +1,36 @@
+"""Temporal stdlib: windows, temporal behaviors, asof-now joins.
+
+reference: python/pathway/stdlib/temporal/ (~5600 LoC: _window.py:863
+``windowby``, _asof_now_join.py:403, _interval_join.py, _asof_join.py,
+_window_join.py, temporal_behavior.py).
+"""
+
+from ._window import (
+    Window,
+    tumbling,
+    sliding,
+    session,
+    windowby,
+)
+from .temporal_behavior import common_behavior, exactly_once_behavior, Behavior
+from ._asof_now_join import asof_now_join, asof_now_join_inner, asof_now_join_left
+from ._joins import asof_join, interval_join, window_join, interval, AsofDirection
+
+__all__ = [
+    "Window",
+    "tumbling",
+    "sliding",
+    "session",
+    "windowby",
+    "common_behavior",
+    "exactly_once_behavior",
+    "Behavior",
+    "asof_now_join",
+    "asof_now_join_inner",
+    "asof_now_join_left",
+    "asof_join",
+    "interval_join",
+    "window_join",
+    "interval",
+    "AsofDirection",
+]

@@ -1,0 +1,178 @@
+"""asof-now join — "join against current state only".
+
+reference: python/pathway/stdlib/temporal/_asof_now_join.py:403 — the
+serving primitive: each left (query) row is joined against the right side's
+state as of the row's arrival time; the result is never revisited when the
+right side later changes.  The engine's ``late`` barrier provides the
+global updates-before-queries ordering per timestamp.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals.engine import Entry, JoinNode, consolidate, freeze_value
+from ...internals.joins import JoinMode, JoinResult
+from ...internals.table import Table
+
+__all__ = ["asof_now_join", "asof_now_join_inner", "asof_now_join_left", "AsofNowJoinNode"]
+
+
+class AsofNowJoinNode(JoinNode):
+    """Port 0 = right (state), port 1 = left (queries, append-only)."""
+
+    late = True
+
+    def flush(self, time: int) -> list[Entry]:
+        out: list[Entry] = []
+        # state updates first
+        for key, row, diff in self.take(0):
+            jk = freeze_value(self.right_key_fn(key, row))
+            self._apply(self.right_state, jk, key, row, diff)
+            self.right_count[jk] += diff
+        # then queries: answered once against current state
+        for key, row, diff in self.take(1):
+            if diff <= 0:
+                raise ValueError(
+                    "asof_now_join received a retraction on its left (query) "
+                    "side; the left stream must be append-only"
+                )
+            jk = freeze_value(self.left_key_fn(key, row))
+            matches = list(self.right_state.get(jk, {}).values()) if jk is not None else []
+            if matches:
+                for cnt, rkey, rrow in matches:
+                    self._emit(key, row, rkey, rrow, diff * cnt, out)
+            elif self.left_outer:
+                self._emit(key, row, None, None, diff, out)
+        return consolidate(out)
+
+
+class AsofNowJoinResult(JoinResult):
+    """Same select surface as JoinResult but lowered to AsofNowJoinNode."""
+
+    def select(self, *args: Any, **kwargs: Any) -> Table:
+        from ...internals.graph import Operator
+        from ...internals.desugaring import expand_select_args
+        from ...internals.schema import ColumnSchema, _schema_from_columns
+        from ...internals import dtype as dt
+        from ...internals.universe import Universe
+
+        exprs = expand_select_args(args, kwargs, self._left, self._left, self._right)
+        columns = {}
+        for name, e in exprs.items():
+            dtype = e._dtype
+            if self._mode in (JoinMode.LEFT,):
+                from ...internals.joins import _refers_to
+
+                if _refers_to(e, self._right):
+                    dtype = dt.Optional(dtype)
+            columns[name] = ColumnSchema(name=name, dtype=dtype)
+        op = Operator(
+            "asof_now_join",
+            [self._left, self._right],
+            params=dict(
+                on=self._on,
+                mode=self._mode,
+                out_exprs=exprs,
+                id_expr=self._id_expr,
+            ),
+        )
+        return Table._new(op, _schema_from_columns(columns), Universe())
+
+
+def asof_now_join(
+    self: Table,
+    other: Table,
+    *on: Any,
+    how: JoinMode = JoinMode.INNER,
+    id: Any = None,
+    left_instance=None,
+    right_instance=None,
+) -> AsofNowJoinResult:
+    """reference: _asof_now_join.py asof_now_join"""
+    if how not in (JoinMode.INNER, JoinMode.LEFT):
+        raise ValueError("asof_now_join supports only INNER and LEFT modes")
+    on = list(on)
+    if left_instance is not None and right_instance is not None:
+        from ...internals.desugaring import resolve_expression
+        from ...internals.expression import smart_wrap
+
+        on.append(
+            smart_wrap(resolve_expression(left_instance, self))
+            == resolve_expression(right_instance, other)
+        )
+    id_expr = None
+    if id is not None:
+        from ...internals.desugaring import resolve_expression
+
+        id_expr = resolve_expression(id, self, self, other)
+    return AsofNowJoinResult(self, other, tuple(on), how, id_expr)
+
+
+def asof_now_join_inner(self: Table, other: Table, *on, **kwargs) -> AsofNowJoinResult:
+    return asof_now_join(self, other, *on, how=JoinMode.INNER, **kwargs)
+
+
+def asof_now_join_left(self: Table, other: Table, *on, **kwargs) -> AsofNowJoinResult:
+    return asof_now_join(self, other, *on, how=JoinMode.LEFT, **kwargs)
+
+
+def lower_asof_now_join(runner, op) -> None:
+    """Lowering mirrors _lower_join but with ports swapped (right=state is
+    port 0 so updates land first) and no revisiting."""
+    from ...internals.evaluator import compile_expression
+    from ...internals.expression import ColumnReference, IdExpression
+    from ...internals.keys import ref_scalar
+    from ...internals.runtime import _TableLayout
+
+    left, right = op.inputs
+    mode: JoinMode = op.params["mode"]
+    on = op.params["on"]
+    out_exprs = op.params["out_exprs"]
+    id_expr = op.params.get("id_expr")
+
+    llayout = _TableLayout([left])
+    rlayout = _TableLayout([right])
+    lfns = [compile_expression(le, llayout.resolver()) for le, _ in on]
+    rfns = [compile_expression(re, rlayout.resolver()) for _, re in on]
+    lcols = {n: i for i, n in enumerate(left.column_names())}
+    rcols = {n: i for i, n in enumerate(right.column_names())}
+
+    def join_resolve(ref: ColumnReference):
+        if ref.name == "id":
+            if ref.table is left:
+                return lambda ctx: ctx[0]
+            if ref.table is right:
+                return lambda ctx: ctx[2]
+            raise ValueError("id reference outside join")
+        if ref.table is left:
+            idx = lcols[ref.name]
+            return lambda ctx: (ctx[1][idx] if ctx[1] is not None else None)
+        if ref.table is right:
+            idx = rcols[ref.name]
+            return lambda ctx: (ctx[3][idx] if ctx[3] is not None else None)
+        raise ValueError(f"asof_now_join select references foreign table: {ref!r}")
+
+    out_fns = [compile_expression(e, join_resolve) for e in out_exprs.values()]
+
+    def out_fn(lkey, lrow, rkey, rrow):
+        return tuple(f((lkey, lrow, rkey, rrow)) for f in out_fns)
+
+    if id_expr is not None and isinstance(id_expr, IdExpression) and id_expr.table is left:
+        out_key_fn = lambda lkey, lrow, rkey, rrow: lkey
+    else:
+        out_key_fn = lambda lkey, lrow, rkey, rrow: ref_scalar(lkey, rkey)
+
+    node = AsofNowJoinNode(
+        left_key_fn=lambda key, row: tuple(f((key, row)) for f in lfns),
+        right_key_fn=lambda key, row: tuple(f((key, row)) for f in rfns),
+        out_fn=out_fn,
+        out_key_fn=out_key_fn,
+        left_outer=mode == JoinMode.LEFT,
+        name=f"asof_now_join#{op.id}",
+    )
+    runner.engine.add(node)
+    # port 0 = right (state), port 1 = left (queries)
+    runner._node_of(right).downstream.append((node, 0))
+    runner._node_of(left).downstream.append((node, 1))
+    runner._register(op, node)

@@ -1,0 +1,306 @@
+"""Temporal joins: interval_join, window_join, asof_join.
+
+reference: python/pathway/stdlib/temporal/_interval_join.py (1619 LoC),
+_window_join.py (1217), _asof_join.py (1107) — all return JoinResult-style
+objects finalized by ``.select(...)``.
+
+Design: all three desugar onto the core incremental engine —
+
+* interval_join: the time axis is bucketed at band width; left rows flatten
+  into candidate buckets, equi-join on (bucket, keys), exact band condition
+  filters (bucketing bounds the candidate set, playing the role of the
+  reference's gradual_broadcast band maintenance);
+* window_join: both sides get window assignments, equi-join on the window;
+* asof_join: per key, both sides merge into one sorted multiset and the
+  match assignment is recomputed per dirty key by the incremental groupby.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Any
+
+import pathway_tpu as pw
+
+from ...internals import dtype as dt
+from ...internals.desugaring import expand_select_args, resolve_expression
+from ...internals.expression import ApplyExpression, ColumnReference
+from ...internals.joins import JoinMode
+from ...internals.table import Table
+from ._window import Window, _num
+
+__all__ = ["interval", "interval_join", "window_join", "asof_join", "AsofDirection"]
+
+
+@dataclass
+class Interval:
+    lower_bound: Any
+    upper_bound: Any
+
+
+def interval(lower_bound, upper_bound) -> Interval:
+    """reference: _interval_join.py interval()"""
+    return Interval(lower_bound, upper_bound)
+
+
+class _PackedJoinResult:
+    """Join result over a base table carrying packed payload tuples
+    ``__lpay__``/``__rpay__``; ``select`` rewrites references to the original
+    left/right tables into tuple projections."""
+
+    def __init__(self, base: Table, left: Table, right: Table, right_optional: bool):
+        self._base = base
+        self._left = left
+        self._right = right
+        self._right_optional = right_optional
+
+    def select(self, *args: Any, **kwargs: Any) -> Table:
+        exprs = expand_select_args(args, kwargs, self._left, self._left, self._right)
+        lnames = self._left.column_names()
+        rnames = self._right.column_names()
+        base = self._base
+        right_optional = self._right_optional
+
+        def mapping(node):
+            if isinstance(node, ColumnReference) and node.table is self._left:
+                i = lnames.index(node.name)
+                return ApplyExpression(
+                    lambda lp, _i=i: lp[_i], node._dtype, base["__lpay__"]
+                )
+            if isinstance(node, ColumnReference) and node.table is self._right:
+                i = rnames.index(node.name)
+                dtype = (
+                    dt.Optional(node._dtype) if right_optional else node._dtype
+                )
+                return ApplyExpression(
+                    lambda rp, _i=i: (rp[_i] if rp is not None else None),
+                    dtype,
+                    base["__rpay__"],
+                )
+            return None
+
+        out = {n: e._substitute(mapping) for n, e in exprs.items()}
+        return base._select_exprs(out, universe=base._universe)
+
+
+def _pack(table: Table) -> Any:
+    return pw.make_tuple(*[table[n] for n in table.column_names()])
+
+
+def interval_join(
+    self: Table,
+    other: Table,
+    self_time,
+    other_time,
+    interval: Interval,
+    *on: Any,
+    how: JoinMode = JoinMode.INNER,
+    behavior=None,
+) -> _PackedJoinResult:
+    """reference: _interval_join.py interval_join — match when
+    ``other_time - self_time ∈ [lb, ub]``."""
+    if behavior is not None:
+        raise NotImplementedError(
+            "interval_join behaviors land with the streaming-behaviors "
+            "milestone; drop the behavior= argument"
+        )
+    lb, ub = _num(interval.lower_bound), _num(interval.upper_bound)
+    if ub < lb:
+        raise ValueError("interval upper bound below lower bound")
+    if how not in (JoinMode.INNER, JoinMode.LEFT):
+        raise ValueError("interval_join supports inner and left modes")
+    width = max(ub - lb, 1)
+
+    lt = resolve_expression(self_time, self)
+    rt = resolve_expression(other_time, other)
+
+    def left_buckets(t):
+        tv = _num(t)
+        return tuple(range(int((tv + lb) // width), int((tv + ub) // width) + 1))
+
+    key_l = [resolve_expression(c.left, self, self, other) for c in on]
+    key_r = [resolve_expression(c.right, self, self, other) for c in on]
+
+    lhs = self.select(
+        __t__=lt,
+        __buckets__=ApplyExpression(left_buckets, dt.List(dt.INT), lt),
+        __k__=pw.make_tuple(*key_l),
+        __lpay__=_pack(self),
+    )
+    lhs = lhs.flatten(lhs["__buckets__"])
+    rhs = other.select(
+        __t__=rt,
+        __bucket__=ApplyExpression(lambda t: int(_num(t) // width), dt.INT, rt),
+        __k__=pw.make_tuple(*key_r),
+        __rpay__=_pack(other),
+    )
+    joined = lhs.join(
+        rhs,
+        lhs["__buckets__"] == rhs["__bucket__"],
+        lhs["__k__"] == rhs["__k__"],
+        how=JoinMode.INNER,
+    ).select(
+        __lt__=lhs["__t__"],
+        __rt__=rhs["__t__"],
+        __lpay__=lhs["__lpay__"],
+        __rpay__=rhs["__rpay__"],
+        __lid__=pw.left.id,
+    )
+    in_band = joined.filter(
+        (joined["__rt__"] - joined["__lt__"] >= interval.lower_bound)
+        & (joined["__rt__"] - joined["__lt__"] <= interval.upper_bound)
+    )
+    if how == JoinMode.LEFT:
+        # left rows with no band match get a None right payload
+        matched_left = in_band.groupby(in_band["__lid__"]).reduce(
+            __lid__=in_band["__lid__"], n=pw.reducers.count()
+        )
+        all_left = self.select(__lpay__=_pack(self), __lid__=pw.this.id)
+        matched_keys = matched_left.with_id(matched_left["__lid__"])
+        unmatched = all_left.with_id(all_left["__lid__"]).difference(matched_keys)
+        unmatched_rows = unmatched.select(
+            __lt__=None, __rt__=None,
+            __lpay__=unmatched["__lpay__"], __rpay__=None, __lid__=unmatched["__lid__"],
+        )
+        in_band = in_band.concat_reindex(unmatched_rows)
+    return _PackedJoinResult(in_band, self, other, right_optional=how == JoinMode.LEFT)
+
+
+def window_join(
+    self: Table,
+    other: Table,
+    self_time,
+    other_time,
+    window: Window,
+    *on: Any,
+    how: JoinMode = JoinMode.INNER,
+) -> _PackedJoinResult:
+    """reference: _window_join.py — join rows landing in the same window."""
+    if how not in (JoinMode.INNER,):
+        raise ValueError("window_join currently supports inner mode")
+    lt = resolve_expression(self_time, self)
+    rt = resolve_expression(other_time, other)
+    key_l = [resolve_expression(c.left, self, self, other) for c in on]
+    key_r = [resolve_expression(c.right, self, self, other) for c in on]
+
+    def wins(t):
+        return window.assign(t)
+
+    lhs = self.select(
+        __wins__=ApplyExpression(wins, dt.List(dt.ANY), lt),
+        __k__=pw.make_tuple(*key_l),
+        __lpay__=_pack(self),
+    )
+    lhs = lhs.flatten(lhs["__wins__"])
+    rhs = other.select(
+        __wins__=ApplyExpression(wins, dt.List(dt.ANY), rt),
+        __k__=pw.make_tuple(*key_r),
+        __rpay__=_pack(other),
+    )
+    rhs = rhs.flatten(rhs["__wins__"])
+    joined = lhs.join(
+        rhs,
+        lhs["__wins__"] == rhs["__wins__"],
+        lhs["__k__"] == rhs["__k__"],
+        how=JoinMode.INNER,
+    ).select(
+        __lpay__=lhs["__lpay__"],
+        __rpay__=rhs["__rpay__"],
+        __window__=lhs["__wins__"],
+    )
+    return _PackedJoinResult(joined, self, other, right_optional=False)
+
+
+class AsofDirection(enum.Enum):
+    BACKWARD = "backward"
+    FORWARD = "forward"
+    NEAREST = "nearest"
+
+
+def asof_join(
+    self: Table,
+    other: Table,
+    self_time,
+    other_time,
+    *on: Any,
+    how: JoinMode = JoinMode.LEFT,
+    defaults: dict | None = None,
+    direction: AsofDirection = AsofDirection.BACKWARD,
+) -> _PackedJoinResult:
+    """reference: _asof_join.py — for each left row, the temporally closest
+    right row (per key) in the given direction."""
+    lt = resolve_expression(self_time, self)
+    rt = resolve_expression(other_time, other)
+    key_l = [resolve_expression(c.left, self, self, other) for c in on]
+    key_r = [resolve_expression(c.right, self, self, other) for c in on]
+
+    l_packed = self.select(
+        __k__=pw.make_tuple(*key_l),
+        __t__=lt,
+        __side__=0,
+        __pay__=_pack(self),
+        __rid__=pw.this.id,
+    )
+    r_packed = other.select(
+        __k__=pw.make_tuple(*key_r),
+        __t__=rt,
+        __side__=1,
+        __pay__=_pack(other),
+        __rid__=pw.this.id,
+    )
+    merged = l_packed.concat_reindex(r_packed)
+    dir_value = direction.value
+
+    def assign(rows):
+        rights = [(t, pay) for t, side, rid, pay in rows if side == 1]
+        out = []
+        for t, side, rid, pay in rows:
+            if side != 0:
+                continue
+            best = None
+            if dir_value in ("backward", "nearest"):
+                for rt_, rpay in rights:
+                    if rt_ <= t:
+                        best = (rt_, rpay)
+                    else:
+                        break
+            if dir_value in ("forward", "nearest"):
+                fwd = next(((rt_, rpay) for rt_, rpay in rights if rt_ >= t), None)
+                if fwd is not None and (
+                    best is None
+                    or (
+                        dir_value == "nearest"
+                        and abs(_num(fwd[0]) - _num(t)) < abs(_num(best[0]) - _num(t))
+                    )
+                    or dir_value == "forward"
+                ):
+                    best = fwd
+            out.append((rid, pay, best[1] if best else None))
+        return tuple(out)
+
+    grouped = merged.groupby(merged["__k__"]).reduce(
+        __matches__=pw.apply_with_type(
+            lambda rows: assign(list(rows)),
+            tuple,
+            pw.reducers.sorted_tuple(
+                pw.make_tuple(
+                    merged["__t__"], merged["__side__"], merged["__rid__"], merged["__pay__"]
+                )
+            ),
+        ),
+    )
+    flat = grouped.flatten(grouped["__matches__"])
+    base = flat._select_exprs(
+        {
+            "__rid__": flat["__matches__"].get(0),
+            "__lpay__": flat["__matches__"].get(1),
+            "__rpay__": flat["__matches__"].get(2),
+        },
+        universe=flat._universe,
+    )
+    base = base.with_id(base["__rid__"])
+    result = _PackedJoinResult(base, self, other, right_optional=True)
+    if defaults:
+        result._defaults = defaults  # applied by callers via coalesce
+    return result

@@ -1,0 +1,39 @@
+"""Temporal behaviors: delay / cutoff / keep_results.
+
+reference: python/pathway/stdlib/temporal/temporal_behavior.py — compiled in
+the reference to engine forget/buffer/freeze (operators/time_column.rs).
+In this build behaviors parameterize the window operator's host-side
+buffering/cutoff (applied in ``_window.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+__all__ = ["Behavior", "CommonBehavior", "common_behavior", "ExactlyOnceBehavior", "exactly_once_behavior"]
+
+
+@dataclass
+class Behavior:
+    pass
+
+
+@dataclass
+class CommonBehavior(Behavior):
+    delay: Any = None
+    cutoff: Any = None
+    keep_results: bool = True
+
+
+def common_behavior(delay=None, cutoff=None, keep_results: bool = True) -> CommonBehavior:
+    return CommonBehavior(delay=delay, cutoff=cutoff, keep_results=keep_results)
+
+
+@dataclass
+class ExactlyOnceBehavior(Behavior):
+    shift: Any = None
+
+
+def exactly_once_behavior(shift=None) -> ExactlyOnceBehavior:
+    return ExactlyOnceBehavior(shift=shift)

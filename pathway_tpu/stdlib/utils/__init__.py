@@ -1,0 +1,11 @@
+from . import col
+
+__all__ = ["col"]
+
+
+def __getattr__(name):
+    if name == "AsyncTransformer":
+        from .async_transformer import AsyncTransformer
+
+        return AsyncTransformer
+    raise AttributeError(name)

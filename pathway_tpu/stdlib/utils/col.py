@@ -1,0 +1,38 @@
+"""Column utilities (reference: python/pathway/stdlib/utils/col.py)."""
+
+from __future__ import annotations
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression, ColumnReference
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+
+__all__ = ["unpack_col", "apply_all_rows", "multiapply_all_rows", "flatten_column"]
+
+
+def unpack_col(column: ColumnReference, *unpacked_columns, schema: SchemaMetaclass | None = None) -> Table:
+    """Unpack a tuple column into named columns (reference: col.py unpack_col)."""
+    table = column.table
+    if schema is not None:
+        names = schema.column_names()
+        dtypes = [schema[n].dtype for n in names]
+    else:
+        names = [c if isinstance(c, str) else c.name for c in unpacked_columns]
+        dtypes = [dt.ANY] * len(names)
+    exprs = {}
+    for i, (n, t) in enumerate(zip(names, dtypes)):
+        exprs[n] = ApplyExpression(lambda v, _i=i: v[_i], t, column)
+    return table._select_exprs(exprs, universe=table._universe)
+
+
+def apply_all_rows(*cols, fun, result_col_name: str) -> Table:
+    """Apply ``fun`` over entire columns at once (reference: col.py)."""
+    raise NotImplementedError("apply_all_rows lands with batched-UDF support")
+
+
+def multiapply_all_rows(*cols, fun, result_col_names) -> Table:
+    raise NotImplementedError("multiapply_all_rows lands with batched-UDF support")
+
+
+def flatten_column(column: ColumnReference, origin_id: str = "origin_id") -> Table:
+    return column.table.flatten(column, origin_id=origin_id)

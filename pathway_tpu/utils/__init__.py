@@ -1,0 +1,3 @@
+from . import jmespath_lite
+
+__all__ = ["jmespath_lite"]

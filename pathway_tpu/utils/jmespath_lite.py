@@ -1,0 +1,211 @@
+"""Mini JMESPath evaluator for metadata filters.
+
+reference: the engine filters metadata with JMESPath + a custom ``globmatch``
+function (src/external_integration/mod.rs:248-310
+``DerivedFilteredSearchIndex``; python side merge_filters
+xpacks/llm/vector_store.py:358).  The jmespath lib is not available in this
+image, so this implements the subset those filters use:
+
+* dotted identifier paths (``modified_at``, ``owner.name``)
+* literals: ``'str'``, `` `json` ``, numbers, ``true/false/null``
+* comparisons ``== != < <= > >=``, boolean ``&& || !``, parentheses
+* functions: ``contains(haystack, needle)``, ``globmatch(pattern, path)``
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import re
+from typing import Any
+
+__all__ = ["compile_filter", "evaluate"]
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:(?P<num>-?\d+(?:\.\d+)?)|(?P<str>'[^']*')|(?P<raw>`[^`]*`)"
+    r"|(?P<ident>[A-Za-z_][A-Za-z0-9_]*)|(?P<op>==|!=|<=|>=|&&|\|\||[!<>().,])|(?P<dot>\.))"
+)
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            if src[pos:].strip() == "":
+                break
+            raise ValueError(f"bad filter syntax at {src[pos:]!r}")
+        pos = m.end()
+        for kind in ("num", "str", "raw", "ident", "op", "dot"):
+            val = m.group(kind)
+            if val is not None:
+                out.append((kind, val))
+                break
+    out.append(("end", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, val):
+        kind, v = self.next()
+        if v != val:
+            raise ValueError(f"expected {val!r}, got {v!r}")
+
+    # or_expr := and_expr ('||' and_expr)*
+    def parse_or(self):
+        node = self.parse_and()
+        while self.peek()[1] == "||":
+            self.next()
+            rhs = self.parse_and()
+            node = ("or", node, rhs)
+        return node
+
+    def parse_and(self):
+        node = self.parse_not()
+        while self.peek()[1] == "&&":
+            self.next()
+            rhs = self.parse_not()
+            node = ("and", node, rhs)
+        return node
+
+    def parse_not(self):
+        if self.peek()[1] == "!":
+            self.next()
+            return ("not", self.parse_not())
+        return self.parse_cmp()
+
+    def parse_cmp(self):
+        node = self.parse_atom()
+        if self.peek()[1] in ("==", "!=", "<", "<=", ">", ">="):
+            op = self.next()[1]
+            rhs = self.parse_atom()
+            return ("cmp", op, node, rhs)
+        return node
+
+    def parse_atom(self):
+        kind, val = self.next()
+        if val == "(":
+            node = self.parse_or()
+            self.expect(")")
+            return node
+        if kind == "num":
+            return ("lit", float(val) if "." in val else int(val))
+        if kind == "str":
+            return ("lit", val[1:-1])
+        if kind == "raw":
+            return ("lit", json.loads(val[1:-1]))
+        if kind == "ident":
+            if val in ("true", "false"):
+                return ("lit", val == "true")
+            if val == "null":
+                return ("lit", None)
+            if self.peek()[1] == "(":
+                self.next()
+                args = []
+                if self.peek()[1] != ")":
+                    args.append(self.parse_or())
+                    while self.peek()[1] == ",":
+                        self.next()
+                        args.append(self.parse_or())
+                self.expect(")")
+                return ("call", val, args)
+            path = [val]
+            while self.peek()[0] == "dot":
+                self.next()
+                k, v = self.next()
+                if k != "ident":
+                    raise ValueError("expected identifier after '.'")
+                path.append(v)
+            return ("path", path)
+        raise ValueError(f"unexpected token {val!r}")
+
+
+def _eval(node, data: Any):
+    tag = node[0]
+    if tag == "lit":
+        return node[1]
+    if tag == "path":
+        cur = data
+        for part in node[1]:
+            if isinstance(cur, dict):
+                cur = cur.get(part)
+            else:
+                cur = getattr(cur, part, None)
+            if cur is None:
+                return None
+        return cur
+    if tag == "cmp":
+        _, op, l, r = node
+        a, b = _eval(l, data), _eval(r, data)
+        try:
+            if op == "==":
+                return a == b
+            if op == "!=":
+                return a != b
+            if a is None or b is None:
+                return False
+            if op == "<":
+                return a < b
+            if op == "<=":
+                return a <= b
+            if op == ">":
+                return a > b
+            if op == ">=":
+                return a >= b
+        except TypeError:
+            return False
+    if tag == "and":
+        return bool(_eval(node[1], data)) and bool(_eval(node[2], data))
+    if tag == "or":
+        return bool(_eval(node[1], data)) or bool(_eval(node[2], data))
+    if tag == "not":
+        return not bool(_eval(node[1], data))
+    if tag == "call":
+        name, args = node[1], node[2]
+        vals = [_eval(a, data) for a in args]
+        if name == "contains":
+            hay, needle = vals
+            if hay is None:
+                return False
+            return needle in hay
+        if name == "globmatch":
+            pattern, path = vals
+            if path is None:
+                return False
+            return fnmatch.fnmatch(str(path), str(pattern))
+        if name == "starts_with":
+            s, prefix = vals
+            return s is not None and str(s).startswith(str(prefix))
+        raise ValueError(f"unknown filter function {name!r}")
+    raise ValueError(f"bad node {node!r}")
+
+
+def compile_filter(expr: str):
+    """Compile a filter string to ``fn(metadata_dict) -> bool``."""
+    ast = _Parser(_tokenize(expr)).parse_or()
+
+    def run(data: Any) -> bool:
+        from ..internals.value import Json
+
+        if isinstance(data, Json):
+            data = data.value
+        return bool(_eval(ast, data or {}))
+
+    return run
+
+
+def evaluate(expr: str, data: Any) -> bool:
+    return compile_filter(expr)(data)

@@ -1,0 +1,48 @@
+"""JAX model stack tests (CPU; small configs for speed)."""
+
+import numpy as np
+
+from pathway_tpu.models import (
+    CrossEncoder,
+    EncoderConfig,
+    HashTokenizer,
+    SentenceEncoder,
+)
+
+SMALL = EncoderConfig(
+    vocab_size=1024, hidden_dim=32, num_layers=2, num_heads=4, mlp_dim=64, max_len=64
+)
+
+
+def test_hash_tokenizer_deterministic():
+    tok = HashTokenizer(vocab_size=1000)
+    a = tok.tokenize("Hello world!")
+    b = tok.tokenize("hello world !")
+    assert a == b  # lowercased, same splits
+    ids, mask = tok.encode_batch(["one two", "three"], max_length=8)
+    assert ids.shape == (2, 8)
+    assert mask[0].sum() == 4  # CLS one two SEP
+    assert mask[1].sum() == 3
+
+
+def test_sentence_encoder_shapes_and_norm():
+    enc = SentenceEncoder(cfg=SMALL, max_length=32)
+    out = enc.encode(["hello world", "a much longer sentence with many words", "x"])
+    assert out.shape == (3, 32)
+    norms = np.linalg.norm(out, axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-4)
+    # determinism
+    out2 = enc.encode(["hello world"])
+    np.testing.assert_allclose(out[0], out2[0], atol=1e-5)
+    # padding must not change results (bucketing invariance)
+    batch = enc.encode(["hello world", "pad pad pad pad pad pad pad pad"])
+    np.testing.assert_allclose(batch[0], out[0], atol=1e-3)
+
+
+def test_cross_encoder_scores():
+    ce = CrossEncoder(cfg=SMALL, max_length=32)
+    scores = ce.predict([("query one", "doc one"), ("query one", "different doc")])
+    assert scores.shape == (2,)
+    # deterministic up to bucket-dependent bf16 rounding
+    scores2 = ce.predict([("query one", "doc one")])
+    np.testing.assert_allclose(scores[0], scores2[0], atol=5e-3)
