@@ -1,0 +1,65 @@
+"""Headline benchmark: document embedding throughput on one TPU chip.
+
+BASELINE.json config #1 — ``SentenceTransformerEmbedder(all-MiniLM-L6-v2)``
+over a static corpus.  The reference runs the torch model inside an async
+UDF one string per call (xpacks/llm/embedders.py:270); here the same
+geometry runs as a jit-compiled flax encoder with bucketed batching
+(models/encoder.py), bf16 on the MXU.
+
+Baseline: the north star is "match A100 embedding throughput on v5e-1"
+(BASELINE.json; no number published in-repo).  We pin the A100 figure at
+4000 docs/sec for all-MiniLM-L6-v2 at seq≈128, fp16, large batch — the
+commonly reported sentence-transformers order of magnitude — and report
+``vs_baseline = docs_per_sec / 4000``.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+A100_BASELINE_DOCS_PER_SEC = 4000.0
+
+
+def main() -> None:
+    import numpy as np
+
+    from pathway_tpu.models.encoder import SentenceEncoder
+
+    enc = SentenceEncoder(max_length=128)
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i:04d}" for i in range(2000)]
+    docs = [
+        " ".join(rng.choice(words, size=96))  # ~128 tokens after wordpiece
+        for _ in range(2048)
+    ]
+
+    enc.encode(docs[:256])  # warmup: compile (batch_bucket, seq_bucket)
+
+    n_docs = 0
+    t0 = time.perf_counter()
+    while True:
+        enc.encode(docs)
+        n_docs += len(docs)
+        elapsed = time.perf_counter() - t0
+        if elapsed > 10.0:
+            break
+    docs_per_sec = n_docs / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "embedding_throughput_minilm_seq128",
+                "value": round(docs_per_sec, 1),
+                "unit": "docs/sec/chip",
+                "vs_baseline": round(docs_per_sec / A100_BASELINE_DOCS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -123,6 +123,10 @@ class GraphRunner:
 
     def _feed_static_sources(self):
         for src, op in self.source_nodes:
+            subject = op.params.get("subject")
+            if subject is not None and getattr(subject, "_mode", None) == "static":
+                subject._run_static(src)
+                continue
             rows = op.params.get("rows")
             if rows is not None:
                 entries = [(key, row, 1) for key, row in rows]

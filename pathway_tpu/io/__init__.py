@@ -1,0 +1,24 @@
+"""``pw.io`` — connectors.
+
+reference: python/pathway/io/ (29 modules).  Implemented natively here:
+fs, csv, jsonlines, plaintext, python, http (REST), null, subscribe.
+Long-tail service connectors (kafka, s3, …) follow the same
+``ConnectorSubject`` protocol (``streaming.py``).
+"""
+
+from . import csv, fs, http, jsonlines, null, plaintext, python
+from ._subscribe import subscribe
+from .streaming import ConnectorSubject, StreamingDriver
+
+__all__ = [
+    "csv",
+    "fs",
+    "http",
+    "jsonlines",
+    "null",
+    "plaintext",
+    "python",
+    "subscribe",
+    "ConnectorSubject",
+    "StreamingDriver",
+]

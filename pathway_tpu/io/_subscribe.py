@@ -1,0 +1,41 @@
+"""``pw.io.subscribe`` — Python callbacks on a table's update stream.
+
+reference: python/pathway/io/_subscribe.py + internals/table_subscription.py
+(engine hook: subscribe_table / SubscribeCallbacks, src/engine/graph.rs:548).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..internals.engine import OutputNode
+from ..internals.graph import G
+from ..internals.table import Table
+
+__all__ = ["subscribe"]
+
+
+def subscribe(
+    table: Table,
+    on_change: Callable[..., None] | None = None,
+    on_end: Callable[[], None] | None = None,
+    on_time_end: Callable[[int], None] | None = None,
+    *,
+    name: str | None = None,
+) -> None:
+    """Invoke ``on_change(key, row: dict, time: int, is_addition: bool)``
+    for every diff, ``on_time_end(time)`` at each closed timestamp, and
+    ``on_end()`` when the stream finishes."""
+    names = table.column_names()
+
+    def wrapped(key, row, time, is_addition):
+        if on_change is not None:
+            on_change(key, dict(zip(names, row)), time, is_addition)
+
+    node = OutputNode(
+        on_change=wrapped if on_change is not None else None,
+        on_time_end=on_time_end,
+        on_end=on_end,
+        name=name or "subscribe",
+    )
+    G.sinks.append((table, node))

@@ -1,0 +1,73 @@
+"""Shared io helpers: dtype coercion, input-table construction.
+
+reference: python/pathway/io/_utils.py (RawDataSchema, MetadataSchema,
+construct_schema_and_data_format) — collapsed, since parsing happens in
+the Python subjects here rather than in Rust data_format.rs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..internals import dtype as dt
+from ..internals.graph import Operator
+from ..internals.schema import SchemaMetaclass, schema_from_types
+from ..internals.table import Table
+from ..internals.universe import Universe
+from ..internals.value import Json
+
+__all__ = [
+    "RawDataSchema",
+    "MetadataSchema",
+    "coerce_row",
+    "input_table",
+    "with_metadata_schema",
+]
+
+RawDataSchema = schema_from_types(data=bytes)
+PlaintextDataSchema = schema_from_types(data=str)
+MetadataSchema = schema_from_types(_metadata=Json)
+
+
+def with_metadata_schema(schema: SchemaMetaclass) -> SchemaMetaclass:
+    if "_metadata" in schema.column_names():
+        return schema
+    types = {n: schema[n].dtype for n in schema.column_names()}
+    types["_metadata"] = Json
+    return schema_from_types(**types)
+
+
+def coerce_value(v: Any, dtype) -> Any:
+    if v is None:
+        return None
+    base = dt.unoptionalize(dtype) if hasattr(dt, "unoptionalize") else dtype
+    try:
+        if base is dt.INT:
+            return int(v)
+        if base is dt.FLOAT:
+            return float(v)
+        if base is dt.BOOL:
+            if isinstance(v, str):
+                return v.strip().lower() in ("true", "1", "t", "yes")
+            return bool(v)
+        if base is dt.STR:
+            return v if isinstance(v, str) else str(v)
+        if base is dt.BYTES:
+            return v if isinstance(v, bytes) else str(v).encode()
+    except (TypeError, ValueError):
+        return v
+    return v
+
+
+def coerce_row(schema: SchemaMetaclass, raw: dict) -> dict:
+    return {
+        n: coerce_value(raw.get(n), schema[n].dtype) for n in schema.column_names()
+    }
+
+
+def input_table(schema: SchemaMetaclass, subject=None, **params: Any) -> Table:
+    """Create an input operator + table fed by ``subject``."""
+    op = Operator(
+        "input", [], params=dict(schema=schema, subject=subject, **params)
+    )
+    return Table._new(op, schema, Universe())

@@ -1,0 +1,55 @@
+"""``pw.io.csv`` — CSV read/write.
+
+reference: python/pathway/io/csv/__init__.py (read, write) over the Rust
+dsv format (src/connectors/data_format.rs) and FileWriter
+(data_storage.rs:649).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+from pathlib import Path
+from typing import Any
+
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["read", "write"]
+
+
+def read(
+    path: str | Path,
+    *,
+    schema: SchemaMetaclass,
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    **kwargs: Any,
+) -> Table:
+    from .. import fs
+
+    return fs.read(
+        path,
+        format="csv",
+        schema=schema,
+        mode=mode,
+        with_metadata=with_metadata,
+        autocommit_duration_ms=autocommit_duration_ms,
+        **kwargs,
+    )
+
+
+def write(table: Table, filename: str | Path) -> None:
+    """Append the update stream as CSV rows + ``time``/``diff`` columns
+    (reference dsv formatter writes the same trailer columns)."""
+    names = table.column_names()
+    f = open(filename, "w", newline="")
+    writer = _csv.writer(f)
+    writer.writerow(names + ["time", "diff"])
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        writer.writerow([row[n] for n in names] + [time, 1 if is_addition else -1])
+        f.flush()
+
+    subscribe(table, on_change=on_change, on_end=f.close, name=f"csv:{filename}")

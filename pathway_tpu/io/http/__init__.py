@@ -1,0 +1,8 @@
+"""``pw.io.http`` — REST endpoints served from the dataflow.
+
+reference: python/pathway/io/http/ (rest_connector:624, PathwayWebserver:329).
+"""
+
+from ._server import EndpointDocumentation, PathwayWebserver, rest_connector
+
+__all__ = ["EndpointDocumentation", "PathwayWebserver", "rest_connector"]

@@ -1,0 +1,275 @@
+"""aiohttp REST server connector.
+
+reference: python/pathway/io/http/_server.py — ``PathwayWebserver``:329,
+``rest_connector``:624, ``RestServerSubject``:490 (requests become input
+rows; responses resolved by an ``internal_subscribe`` callback setting a
+per-request asyncio event, :778-806), OpenAPI docs (``EndpointDocumentation``
+:126).
+
+The aiohttp loop runs on its own thread; the engine loop (StreamingDriver)
+delivers response diffs via ``pw.io.subscribe`` and wakes the waiting
+handler with ``loop.call_soon_threadsafe`` — same two-plane split as the
+reference (webserver thread ↔ engine workers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Callable, Sequence
+
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+from ...internals.value import Json, Pointer
+from .._subscribe import subscribe
+from .._utils import coerce_row, input_table
+from ..streaming import ConnectorSubject, next_autogen_key
+
+__all__ = ["PathwayWebserver", "rest_connector", "EndpointDocumentation"]
+
+
+class EndpointDocumentation:
+    """OpenAPI metadata for one route (reference _server.py:126)."""
+
+    def __init__(
+        self,
+        *,
+        summary: str | None = None,
+        description: str | None = None,
+        tags: Sequence[str] = (),
+        method_types: Sequence[str] | None = None,
+    ):
+        self.summary = summary
+        self.description = description
+        self.tags = list(tags)
+        self.method_types = method_types
+
+
+class PathwayWebserver:
+    """Shared aiohttp server hosting any number of rest_connector routes
+    (reference _server.py:329)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080, with_cors: bool = False):
+        self.host = host
+        self.port = port
+        self.with_cors = with_cors
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._routes: list[tuple[str, Sequence[str], Callable]] = []
+        self._openapi_routes: dict[str, dict] = {}
+        self._started = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+
+    def _register(self, route: str, methods: Sequence[str], handler, doc) -> None:
+        with self._lock:
+            if self._thread is not None:
+                raise RuntimeError("cannot add routes after the server started")
+            self._routes.append((route, methods, handler))
+            entry: dict[str, Any] = {}
+            for m in methods:
+                entry[m.lower()] = {
+                    "summary": getattr(doc, "summary", None) or route,
+                    "description": getattr(doc, "description", None) or "",
+                    "tags": list(getattr(doc, "tags", []) or []),
+                    "responses": {"200": {"description": "OK"}},
+                }
+            self._openapi_routes[route] = entry
+
+    def openapi_description_json(self) -> dict:
+        return {
+            "openapi": "3.0.3",
+            "info": {"title": "Pathway-TPU API", "version": "1.0"},
+            "paths": self._openapi_routes,
+        }
+
+    def _ensure_started(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=self._serve, daemon=True, name="pw-webserver"
+            )
+            self._thread.start()
+        self._started.wait()
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        app = web.Application()
+        for route, methods, handler in self._routes:
+            for m in methods:
+                app.router.add_route(m, route, handler)
+
+        async def openapi_handler(_request):
+            return web.json_response(self.openapi_description_json())
+
+        app.router.add_get("/_schema", openapi_handler)
+        if self.with_cors:
+
+            @web.middleware
+            async def cors_mw(request, handler):
+                if request.method == "OPTIONS":
+                    resp = web.Response()
+                else:
+                    resp = await handler(request)
+                resp.headers["Access-Control-Allow-Origin"] = "*"
+                resp.headers["Access-Control-Allow-Headers"] = "*"
+                resp.headers["Access-Control-Allow-Methods"] = "*"
+                return resp
+
+            app.middlewares.append(cors_mw)
+
+        runner = web.AppRunner(app)
+        self._loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        self._loop.run_until_complete(site.start())
+        self._started.set()
+        self._loop.run_forever()
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, Pointer):
+        return str(v)
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    return v
+
+
+class RestServerSubject(ConnectorSubject):
+    """Ingests HTTP requests as rows (reference _server.py:490)."""
+
+    def __init__(
+        self,
+        webserver: PathwayWebserver,
+        route: str,
+        methods: Sequence[str],
+        schema: SchemaMetaclass,
+        delete_completed_queries: bool,
+        request_validator: Callable | None = None,
+        documentation: EndpointDocumentation | None = None,
+    ):
+        super().__init__(datasource_name=f"rest:{route}")
+        self.webserver = webserver
+        self.route = route
+        self.methods = methods
+        self.schema = schema
+        self.delete_completed_queries = delete_completed_queries
+        self.request_validator = request_validator
+        self._awaiting: dict[Any, tuple[asyncio.Event, list]] = {}
+        self._awaiting_lock = threading.Lock()
+        webserver._register(route, methods, self._handle, documentation)
+
+    def run(self) -> None:
+        self.webserver._ensure_started()
+        self._closed.wait()
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        if request.method in ("POST", "PUT", "PATCH"):
+            try:
+                payload = await request.json()
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                return web.json_response(
+                    {"detail": "request body is not valid JSON"}, status=400
+                )
+        else:
+            payload = dict(request.query)
+        if self.request_validator is not None:
+            err = self.request_validator(payload)
+            if err is not None:
+                return web.json_response({"detail": str(err)}, status=400)
+        row = coerce_row(self.schema, payload)
+        values = tuple(row.get(n) for n in self._column_names)
+        key = next_autogen_key("rest")
+        event = asyncio.Event()
+        holder: list = []
+        with self._awaiting_lock:
+            self._awaiting[key] = (event, holder)
+        self._add_inner(key, values)
+        self.commit()
+        await event.wait()
+        with self._awaiting_lock:
+            self._awaiting.pop(key, None)
+        if self.delete_completed_queries:
+            self._remove(key, values)
+            self.commit()
+        result = holder[0] if holder else None
+        return web.json_response(_jsonable(result))
+
+    def _resolve(self, key, result) -> None:
+        """Called from the engine thread when the response row lands."""
+        with self._awaiting_lock:
+            slot = self._awaiting.get(key)
+        if slot is None:
+            return
+        event, holder = slot
+        holder.append(result)
+        loop = self.webserver._loop
+        if loop is not None:
+            loop.call_soon_threadsafe(event.set)
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    schema: SchemaMetaclass | None = None,
+    methods: Sequence[str] = ("POST",),
+    autocommit_duration_ms: int | None = 1500,
+    keep_queries: bool | None = None,
+    delete_completed_queries: bool = False,
+    request_validator: Callable | None = None,
+    documentation: EndpointDocumentation | None = None,
+) -> tuple[Table, Callable[[Table], None]]:
+    """HTTP endpoint as a (query table, response writer) pair
+    (reference _server.py:624).
+
+    The returned ``response_writer`` must be called with a table keyed by
+    the query table's ids and holding a ``result`` column; each request
+    blocks until its row arrives.
+    """
+    if webserver is None:
+        if host is None or port is None:
+            raise ValueError("provide either webserver= or host= and port=")
+        webserver = PathwayWebserver(host=host, port=port)
+    if schema is None:
+        raise ValueError("rest_connector requires schema=")
+    if keep_queries is not None:
+        delete_completed_queries = not keep_queries
+
+    subject = RestServerSubject(
+        webserver,
+        route,
+        methods,
+        schema,
+        delete_completed_queries,
+        request_validator,
+        documentation,
+    )
+    subject._configure(schema, None)
+    table = input_table(schema, subject=subject)
+
+    def response_writer(response_table: Table) -> None:
+        names = response_table.column_names()
+        if "result" not in names:
+            raise ValueError("response table must have a 'result' column")
+
+        def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+            if is_addition:
+                subject._resolve(key, row["result"])
+
+        subscribe(response_table, on_change=on_change, name=f"rest_resp:{subject.route}")
+
+    return table, response_writer

@@ -1,0 +1,66 @@
+"""``pw.io.jsonlines`` — JSON-lines read/write.
+
+reference: python/pathway/io/jsonlines/__init__.py over the Rust json
+format (src/connectors/data_format.rs).
+"""
+
+from __future__ import annotations
+
+import json as _json
+from pathlib import Path
+from typing import Any
+
+from ...internals.schema import SchemaMetaclass
+from ...internals.value import Json, Pointer
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["read", "write"]
+
+
+def read(
+    path: str | Path,
+    *,
+    schema: SchemaMetaclass,
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    **kwargs: Any,
+) -> Table:
+    from .. import fs
+
+    return fs.read(
+        path,
+        format="json",
+        schema=schema,
+        mode=mode,
+        with_metadata=with_metadata,
+        autocommit_duration_ms=autocommit_duration_ms,
+        **kwargs,
+    )
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, Pointer):
+        return str(v)
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+def write(table: Table, filename: str | Path) -> None:
+    names = table.column_names()
+    f = open(filename, "w")
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        obj = {n: _jsonable(row[n]) for n in names}
+        obj["time"] = time
+        obj["diff"] = 1 if is_addition else -1
+        f.write(_json.dumps(obj) + "\n")
+        f.flush()
+
+    subscribe(table, on_change=on_change, on_end=f.close, name=f"jsonl:{filename}")

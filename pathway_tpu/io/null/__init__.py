@@ -1,0 +1,16 @@
+"""``pw.io.null`` — sink that discards output but still drives the graph.
+
+reference: python/pathway/io/null/__init__.py (Rust NullWriter,
+src/connectors/data_storage.rs:1395).
+"""
+
+from __future__ import annotations
+
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["write"]
+
+
+def write(table: Table) -> None:
+    subscribe(table, on_change=lambda *a: None, name="null")

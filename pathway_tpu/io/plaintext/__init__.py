@@ -1,0 +1,27 @@
+"""``pw.io.plaintext`` — one row per line of text.
+
+reference: python/pathway/io/plaintext/__init__.py.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from ...internals.table import Table
+
+__all__ = ["read"]
+
+
+def read(
+    path: str | Path,
+    *,
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    **kwargs: Any,
+) -> Table:
+    from .. import fs
+
+    return fs.read(
+        path, format="plaintext", mode=mode, with_metadata=with_metadata, **kwargs
+    )

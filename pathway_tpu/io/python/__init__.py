@@ -1,0 +1,33 @@
+"""``pw.io.python`` — custom Python connector subjects.
+
+reference: python/pathway/io/python/__init__.py (``ConnectorSubject``:49,
+``read``:432).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+from .._utils import input_table
+from ..streaming import ConnectorSubject
+
+__all__ = ["ConnectorSubject", "read"]
+
+
+def read(
+    subject: ConnectorSubject,
+    *,
+    schema: SchemaMetaclass,
+    autocommit_duration_ms: int | None = 1500,
+    primary_key: list[str] | None = None,
+    **kwargs: Any,
+) -> Table:
+    """Read from a custom ``ConnectorSubject`` (reference
+    io/python/__init__.py:432).  The subject runs on its own thread under
+    ``pw.run``; rows become visible at each ``commit()``."""
+    pk = primary_key or schema.primary_key_columns()
+    subject._configure(schema, pk)
+    subject._autocommit_ms = autocommit_duration_ms
+    return input_table(schema, subject=subject)

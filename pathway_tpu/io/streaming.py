@@ -1,0 +1,264 @@
+"""Connector subjects + the streaming run loop.
+
+reference: src/connectors/mod.rs (``Connector::run`` reader thread :427,
+commit ticks every ``commit_duration`` :207-217, ``SessionType`` adaptors)
+and python/pathway/io/python/__init__.py:49 (``ConnectorSubject``).
+
+TPU-era shape: connectors stay host-side threads exactly like the
+reference's reader threads, but instead of feeding timely input sessions
+over crossbeam channels they buffer diffs that the ``StreamingDriver``
+stamps with a micro-batch timestamp and pushes through the engine — one
+``engine.step(t)`` per commit is the analogue of a timely epoch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time as _time
+from typing import Any, Callable, Iterable
+
+from ..internals.engine import Engine, Entry, SourceNode
+from ..internals.keys import ref_scalar
+from ..internals.value import Json, Pointer
+
+__all__ = ["ConnectorSubject", "StreamingDriver", "next_autogen_key"]
+
+_autogen_lock = threading.Lock()
+_autogen_counter = 0
+
+
+def next_autogen_key(salt: Any = "io") -> Pointer:
+    global _autogen_counter
+    with _autogen_lock:
+        _autogen_counter += 1
+        return ref_scalar("__io_autogen__", salt, _autogen_counter)
+
+
+class ConnectorSubject:
+    """Base class for custom Python input connectors.
+
+    Subclass and implement :meth:`run`, emitting rows via :meth:`next` /
+    :meth:`next_json` / :meth:`next_str` / :meth:`next_bytes`; call
+    :meth:`commit` to make emitted rows visible atomically and
+    :meth:`close` when the stream ends (reference
+    io/python/__init__.py:49-214).
+    """
+
+    #: "streaming" subjects run on their own thread under pw.run;
+    #: "static" subjects are drained synchronously at build time so batch
+    #: graphs (pw.debug helpers) see their data without a driver.
+    _mode: str = "streaming"
+    #: "native" = emitted diffs pass through; "upsert" = a second row with
+    #: the same key replaces the first (reference SessionType::Upsert)
+    _session_type: str = "native"
+    #: commit pending rows automatically every N ms even without an
+    #: explicit commit() (reference: connector commit_duration ticks,
+    #: src/connectors/mod.rs:207-217); None = explicit commits only
+    _autocommit_ms: int | None = None
+
+    def __init__(self, datasource_name: str = "python") -> None:
+        self._datasource_name = datasource_name
+        self._lock = threading.Lock()
+        self._pending: list[tuple[str, Any, tuple | None]] = []  # op, key, values
+        self._committed: list[list[tuple[str, Any, tuple | None]]] = []
+        self._closed = threading.Event()
+        self._started = False
+        self._schema = None
+        self._column_names: list[str] = []
+        self._primary_key: list[str] | None = None
+        self._last_by_key: dict[Any, tuple] = {}
+        self._data_event: threading.Event | None = None
+
+    # -- to be implemented by subclasses --
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def on_stop(self) -> None:
+        """Called once the subject is done (reference: on_stop hook)."""
+
+    @property
+    def _deletions_enabled(self) -> bool:
+        return True
+
+    # -- emission API --
+    def next(self, **kwargs: Any) -> None:
+        values = tuple(kwargs.get(name) for name in self._column_names)
+        key = self._derive_key(kwargs)
+        self._push("insert", key, values)
+
+    def next_json(self, message: dict | str | bytes) -> None:
+        if isinstance(message, (str, bytes)):
+            message = json.loads(message)
+        self.next(**message)
+
+    def next_str(self, message: str) -> None:
+        self.next(data=message)
+
+    def next_bytes(self, message: bytes) -> None:
+        self.next(data=message)
+
+    def delete(self, **kwargs: Any) -> None:
+        if not self._deletions_enabled:
+            raise RuntimeError("deletions not enabled on this subject")
+        values = tuple(kwargs.get(name) for name in self._column_names)
+        key = self._derive_key(kwargs)
+        self._push("delete", key, values)
+
+    def _remove(self, key: Any, values: tuple) -> None:
+        self._push("delete", key, values)
+
+    def _add_inner(self, key: Any, values: tuple) -> None:
+        self._push("insert", key, values)
+
+    def commit(self) -> None:
+        with self._lock:
+            if self._pending:
+                self._committed.append(self._pending)
+                self._pending = []
+        if self._data_event is not None:
+            self._data_event.set()
+
+    def close(self) -> None:
+        self.commit()
+        self._closed.set()
+        if self._data_event is not None:
+            self._data_event.set()
+
+    # -- plumbing --
+    def _derive_key(self, kwargs: dict) -> Any:
+        if self._primary_key:
+            return ref_scalar(*[kwargs.get(c) for c in self._primary_key])
+        return next_autogen_key(self._datasource_name)
+
+    def _push(self, op: str, key: Any, values: tuple | None) -> None:
+        with self._lock:
+            self._pending.append((op, key, values))
+
+    def _configure(self, schema, primary_key: list[str] | None) -> None:
+        self._schema = schema
+        self._column_names = list(schema.column_names())
+        self._primary_key = primary_key
+
+    def _attach(self, src: SourceNode, engine: Engine) -> None:
+        self._src = src
+        self._engine = engine
+
+    def _drain(self) -> list[Entry]:
+        """Convert committed batches to engine entries (upsert-aware)."""
+        with self._lock:
+            batches, self._committed = self._committed, []
+        entries: list[Entry] = []
+        for batch in batches:
+            for op, key, values in batch:
+                if self._session_type == "upsert":
+                    old = self._last_by_key.pop(key, None)
+                    if old is not None:
+                        entries.append((key, old, -1))
+                    if op == "insert":
+                        entries.append((key, values, 1))
+                        self._last_by_key[key] = values
+                else:
+                    entries.append((key, values, 1 if op == "insert" else -1))
+        return entries
+
+    _static_entries: list[Entry] | None = None
+
+    def _run_static(self, src: SourceNode) -> None:
+        """Drain a static subject synchronously at time 0 (build time).
+
+        The drained entries are cached so the same table can be
+        materialized more than once (pw.debug preview + pw.run)."""
+        if self._static_entries is None:
+            self.run()
+            self.close()
+            self._static_entries = self._drain()
+            self.on_stop()
+        if self._static_entries:
+            src.push(0, list(self._static_entries))
+
+
+class StreamingDriver:
+    """The run loop behind ``pw.run`` (reference: timely's
+    ``worker.step_or_park`` pump, dataflow.rs:5689-5731, with connector
+    pollers and commit flushers folded in).
+
+    Starts one thread per streaming subject, then repeatedly drains
+    committed batches, stamps them with the next micro-batch timestamp and
+    advances the engine.  Terminates when every subject has closed and all
+    buffers are empty; runs forever if any subject never closes.
+    """
+
+    def __init__(
+        self,
+        engine: Engine,
+        runner,
+        *,
+        persistence_config: Any = None,
+        monitoring_level: Any = None,
+        with_http_server: bool = False,
+        autocommit_ms: int = 20,
+    ) -> None:
+        self.engine = engine
+        self.runner = runner
+        self.autocommit_ms = autocommit_ms
+        self.persistence_config = persistence_config
+        self.subject_src: list[tuple[ConnectorSubject, SourceNode]] = []
+        for src, op in runner.source_nodes:
+            subject = op.params.get("subject")
+            if subject is not None and subject._mode == "streaming":
+                self.subject_src.append((subject, src))
+
+    def run(self) -> None:
+        if not self.subject_src:
+            self.engine.run_all()
+            return
+        data_event = threading.Event()
+        threads = []
+        for subject, _src in self.subject_src:
+            subject._data_event = data_event
+
+            def runner(s=subject):
+                try:
+                    s.run()
+                finally:
+                    s.close()
+                    s.on_stop()
+
+            th = threading.Thread(target=runner, daemon=True, name="pw-connector")
+            th.start()
+            threads.append(th)
+
+        t = 1
+        last_autocommit = {id(s): _time.monotonic() for s, _ in self.subject_src}
+        while True:
+            data_event.wait(timeout=self.autocommit_ms / 1000.0)
+            data_event.clear()
+            now = _time.monotonic()
+            for subject, _src in self.subject_src:
+                ac = subject._autocommit_ms
+                if ac is not None and (now - last_autocommit[id(subject)]) * 1000 >= ac:
+                    subject.commit()
+                    last_autocommit[id(subject)] = now
+            pushed = False
+            for subject, src in self.subject_src:
+                entries = subject._drain()
+                if entries:
+                    src.push(t, entries)
+                    pushed = True
+            if pushed:
+                self.engine.step(t)
+                t += 1
+                continue
+            if all(s._closed.is_set() for s, _ in self.subject_src):
+                # final drain to catch a close() racing the check
+                for subject, src in self.subject_src:
+                    entries = subject._drain()
+                    if entries:
+                        src.push(t, entries)
+                        pushed = True
+                if pushed:
+                    self.engine.step(t)
+                    t += 1
+                break
+        self.engine.finish()

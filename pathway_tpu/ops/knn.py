@@ -54,6 +54,9 @@ class DeviceKnnIndex:
         # staged updates applied lazily before the next search
         self._staged_set: dict[int, np.ndarray] = {}
         self._staged_valid: dict[int, bool] = {}
+        # scatter fns — subclasses swap in sharding-preserving variants
+        self._scatter_rows_fn = _scatter_rows
+        self._scatter_mask_fn = _scatter_mask
 
     def __len__(self) -> int:
         return len(self.slot_of_key)
@@ -105,11 +108,15 @@ class DeviceKnnIndex:
         if self._staged_set:
             idx = np.fromiter(self._staged_set.keys(), dtype=np.int32)
             vals = np.stack(list(self._staged_set.values())).astype(self.dtype)
-            self.vectors = _scatter_rows(self.vectors, jnp.asarray(idx), jnp.asarray(vals))
+            self.vectors = self._scatter_rows_fn(
+                self.vectors, jnp.asarray(idx), jnp.asarray(vals)
+            )
         if self._staged_valid:
             vidx = np.fromiter(self._staged_valid.keys(), dtype=np.int32)
             vvals = np.fromiter(self._staged_valid.values(), dtype=bool)
-            self.valid = _scatter_mask(self.valid, jnp.asarray(vidx), jnp.asarray(vvals))
+            self.valid = self._scatter_mask_fn(
+                self.valid, jnp.asarray(vidx), jnp.asarray(vvals)
+            )
         self._staged_set.clear()
         self._staged_valid.clear()
 
@@ -144,6 +151,17 @@ class DeviceKnnIndex:
                 out.append((key, float(s)))
         return out
 
+    def _device_search(self, q: np.ndarray, k: int) -> tuple[jax.Array, jax.Array]:
+        """(scores, slot indices) for normalized queries — subclasses
+        override with the mesh-sharded path."""
+        return topk_search(
+            jnp.asarray(q, dtype=self.dtype),
+            self.vectors,
+            self.valid,
+            min(k, self.capacity),
+            self.metric,
+        )
+
     def search(
         self, queries: Any, k: int
     ) -> list[list[tuple[Hashable, float]]]:
@@ -157,14 +175,7 @@ class DeviceKnnIndex:
             norms = np.linalg.norm(q, axis=1, keepdims=True)
             norms[norms == 0] = 1.0
             q = q / norms
-        k_eff = min(k, self.capacity)
-        scores, idx = topk_search(
-            jnp.asarray(q, dtype=self.dtype),
-            self.vectors,
-            self.valid,
-            k_eff,
-            self.metric,
-        )
+        scores, idx = self._device_search(q, k)
         scores = np.asarray(scores)
         idx = np.asarray(idx)
         out: list[list[tuple[Hashable, float]]] = []

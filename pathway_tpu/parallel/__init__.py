@@ -1,0 +1,28 @@
+"""Multi-chip plane: device meshes, sharding rules, distributed index.
+
+reference counterpart: timely's TCP ``CommunicationConfig::Cluster``
+transport + worker sharding (src/engine/dataflow/config.rs:63-120,
+value.rs:38-99 shard field) and the index-replica-per-worker broadcast
+(src/engine/dataflow/operators/external_index.rs:95-98).
+
+TPU redesign: no record-level TCP exchange between workers — the numeric
+plane (embeddings, index matrices, scores) lives in HBM sharded over a
+``jax.sharding.Mesh``; queries fan out as one ``shard_map``-compiled
+program whose cross-device traffic is XLA collectives on ICI
+(all-gather of per-shard top-k, psum for stats) instead of the
+reference's per-worker replica search.
+"""
+
+from .mesh import make_mesh, data_axis, model_axis
+from .sharding import encoder_param_specs, shard_params, batch_spec
+from .index import ShardedKnnIndex
+
+__all__ = [
+    "make_mesh",
+    "data_axis",
+    "model_axis",
+    "encoder_param_specs",
+    "shard_params",
+    "batch_spec",
+    "ShardedKnnIndex",
+]

@@ -1,0 +1,122 @@
+"""Mesh-sharded KNN index: per-device shards + ICI top-k merge.
+
+reference: src/engine/dataflow/operators/external_index.rs:95-98 keeps a
+FULL index replica on every timely worker (index stream ``.broadcast()``)
+and shards only the queries.  That replication cannot fit TPU HBM at scale,
+so the TPU design inverts it: the vector matrix is sharded row-wise over
+the mesh's ``data`` axis (NamedSharding ``P("data", None)``), queries are
+replicated, and one ``shard_map``-compiled program computes each shard's
+local scores on its MXU, takes a local top-k, then merges across chips
+with ``lax.all_gather`` over ICI followed by a final top-k — the classic
+distributed-top-k recipe.  Per query the wire cost is ``S·k`` floats+ints
+instead of shipping any index rows.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.knn import DeviceKnnIndex
+from .mesh import data_axis
+
+__all__ = ["ShardedKnnIndex"]
+
+NEG_INF = -jnp.inf
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_search_fn(mesh: Mesh, k: int, metric: str, n_local: int):
+    """Compile the per-shard search + ICI merge for one (mesh, k, metric)."""
+
+    def local_search(q, vecs, valid):
+        # q: [Q, D] replicated; vecs: [n_local, D]; valid: [n_local]
+        if metric in ("cos", "dot"):
+            s = jnp.dot(q, vecs.T, preferred_element_type=jnp.float32)
+        else:  # l2sq, negated so higher = better
+            dots = jnp.dot(q, vecs.T, preferred_element_type=jnp.float32)
+            qn = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+            vn = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=-1)
+            s = 2.0 * dots - qn - vn[None, :]
+        s = jnp.where(valid[None, :], s, NEG_INF)
+        k_local = min(k, n_local)
+        scores, idx = lax.top_k(s, k_local)
+        # local slot -> global slot
+        shard = lax.axis_index(data_axis)
+        gidx = idx + shard * n_local
+        # merge over ICI: all-gather per-shard candidates, final top-k
+        all_s = lax.all_gather(scores, data_axis)  # [S, Q, k_local]
+        all_i = lax.all_gather(gidx, data_axis)
+        n_shards = all_s.shape[0]
+        all_s = jnp.transpose(all_s, (1, 0, 2)).reshape(q.shape[0], n_shards * k_local)
+        all_i = jnp.transpose(all_i, (1, 0, 2)).reshape(q.shape[0], n_shards * k_local)
+        k_out = min(k, n_shards * k_local)
+        ms, pos = lax.top_k(all_s, k_out)
+        mi = jnp.take_along_axis(all_i, pos, axis=1)
+        return ms, mi
+
+    specs = dict(
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, None), P(data_axis)),
+        out_specs=(P(), P()),
+    )
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is not None:
+        mapped = shard_map(local_search, check_vma=False, **specs)
+    else:  # older jax: same API but the kwarg is named check_rep
+        from jax.experimental.shard_map import shard_map  # type: ignore
+
+        mapped = shard_map(local_search, check_rep=False, **specs)
+    return jax.jit(mapped)
+
+
+class ShardedKnnIndex(DeviceKnnIndex):
+    """KNN index whose vector matrix is sharded over a device mesh.
+
+    Drop-in for :class:`DeviceKnnIndex` — host-side bookkeeping (slots,
+    tombstones, staging) is inherited; only array placement and the search
+    path change.  Works on any mesh with a ``data`` axis; arrays are
+    replicated over other mesh axes.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        mesh: Mesh,
+        metric: str = "cos",
+        capacity: int = 1024,
+        dtype=jnp.float32,
+    ):
+        self.mesh = mesh
+        self.n_shards = mesh.shape[data_axis]
+        capacity = max(int(capacity), 8 * self.n_shards)
+        # keep capacity divisible by the shard count through every doubling
+        rem = capacity % self.n_shards
+        if rem:
+            capacity += self.n_shards - rem
+        super().__init__(dim, metric=metric, capacity=capacity, dtype=dtype)
+        self._vec_sharding = NamedSharding(mesh, P(data_axis, None))
+        self._mask_sharding = NamedSharding(mesh, P(data_axis))
+        self.vectors = jax.device_put(self.vectors, self._vec_sharding)
+        self.valid = jax.device_put(self.valid, self._mask_sharding)
+        self._scatter_rows_fn = jax.jit(
+            lambda m, i, v: m.at[i].set(v), out_shardings=self._vec_sharding
+        )
+        self._scatter_mask_fn = jax.jit(
+            lambda m, i, v: m.at[i].set(v), out_shardings=self._mask_sharding
+        )
+
+    def _grow(self) -> None:
+        super()._grow()
+        self.vectors = jax.device_put(self.vectors, self._vec_sharding)
+        self.valid = jax.device_put(self.valid, self._mask_sharding)
+
+    def _device_search(self, q: np.ndarray, k: int):
+        n_local = self.capacity // self.n_shards
+        fn = _sharded_search_fn(self.mesh, int(k), self.metric, n_local)
+        return fn(jnp.asarray(q, dtype=self.dtype), self.vectors, self.valid)

@@ -1,0 +1,46 @@
+"""Device-mesh construction.
+
+The reference scales by ``PATHWAY_THREADS × PATHWAY_PROCESSES`` timely
+workers over TCP (src/engine/dataflow/config.rs:88-120).  Here the unit of
+scale-out is a TPU mesh: axis ``data`` shards rows/batches (the analogue of
+the reference's key-hash worker sharding), axis ``model`` shards model
+weights (tensor parallelism — no reference analogue; the reference has no
+on-device model at all).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "data_axis", "model_axis"]
+
+data_axis = "data"
+model_axis = "model"
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    *,
+    model_parallel: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh over the first ``n_devices`` devices.
+
+    ``model_parallel`` splits off a tensor-parallel axis; the rest is data
+    parallel.  ``PATHWAY_MODEL_PARALLEL`` env overrides (mirroring the
+    reference's env-driven worker config, dataflow/config.rs:88).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    mp = int(os.environ.get("PATHWAY_MODEL_PARALLEL", model_parallel))
+    if n_devices % mp != 0:
+        raise ValueError(f"n_devices={n_devices} not divisible by model_parallel={mp}")
+    grid = np.array(devices).reshape(n_devices // mp, mp)
+    return Mesh(grid, (data_axis, model_axis))

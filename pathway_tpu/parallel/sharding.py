@@ -1,0 +1,68 @@
+"""Tensor/data-parallel partition specs for the JAX model stack.
+
+The reference has no on-device model (its embedders call torch inside UDFs,
+xpacks/llm/embedders.py:270), so these rules have no reference counterpart
+to translate — they are the standard Megatron-style TP split expressed as
+``jax.sharding`` annotations, letting XLA insert the psum/all-gathers:
+
+* attention q/k/v kernels ``(D, H, Hd)`` split over heads → ``model``;
+* attention out kernel ``(H, Hd, D)`` split over heads → ``model`` (row
+  parallel — XLA emits one psum after it);
+* MLP in ``(D, M)`` column-split, MLP out ``(M, D)`` row-split;
+* embeddings/layernorms replicated; activations sharded over ``data``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import data_axis, model_axis
+
+__all__ = ["encoder_param_specs", "shard_params", "batch_spec"]
+
+
+def batch_spec() -> P:
+    """Activations: batch dim over ``data``, everything else replicated."""
+    return P(data_axis, None)
+
+
+def _spec_for(path: tuple, leaf) -> P:
+    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+    joined = "/".join(str(n) for n in names)
+    ndim = getattr(leaf, "ndim", 0)
+    if "attention" in joined:
+        if names[-1] == "kernel":
+            if "out" in joined and ndim == 3:  # (H, Hd, D) row-parallel
+                return P(model_axis, None, None)
+            if ndim == 3:  # q/k/v (D, H, Hd) column-parallel over heads
+                return P(None, model_axis, None)
+        if names[-1] == "bias" and ndim == 2:  # (H, Hd)
+            return P(model_axis, None)
+        return P(*([None] * ndim))
+    if names[-1] == "kernel" and ndim == 2:
+        if "mlp_in" in joined or "pooler" in joined:
+            return P(None, model_axis)  # (D, M) column-parallel
+        if "mlp_out" in joined:
+            return P(model_axis, None)  # (M, D) row-parallel
+        return P(None, None)
+    if names[-1] == "bias" and ndim == 1 and "mlp_in" in joined:
+        return P(model_axis)
+    return P(*([None] * ndim))
+
+
+def encoder_param_specs(params: Any) -> Any:
+    """PartitionSpec pytree matching ``TransformerEncoder`` params."""
+    return jax.tree_util.tree_map_with_path(_spec_for, params)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a param pytree onto ``mesh`` with the TP specs above."""
+    specs = encoder_param_specs(params)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params,
+        specs,
+    )

@@ -1,0 +1,158 @@
+"""io connector tests: fs static/streaming, python subjects, csv/jsonlines
+write, subscribe, REST connector end-to-end over real HTTP."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+
+
+def test_fs_read_csv_static(tmp_path):
+    (tmp_path / "a.csv").write_text("name,age\nalice,30\nbob,25\n")
+
+    class S(pw.Schema):
+        name: str
+        age: int
+
+    t = pw.io.fs.read(tmp_path, format="csv", schema=S, mode="static")
+    df = pw.debug.table_to_pandas(t)
+    assert sorted(zip(df["name"], df["age"])) == [("alice", 30), ("bob", 25)]
+
+
+def test_fs_read_plaintext_and_binary_static(tmp_path):
+    (tmp_path / "x.txt").write_text("hello\nworld\n")
+    t = pw.io.plaintext.read(tmp_path, mode="static")
+    df = pw.debug.table_to_pandas(t)
+    assert sorted(df["data"]) == ["hello", "world"]
+
+    pw.global_graph.clear()
+    t2 = pw.io.fs.read(tmp_path, format="binary", mode="static", with_metadata=True)
+    df2 = pw.debug.table_to_pandas(t2)
+    assert df2["data"].tolist() == [b"hello\nworld\n"]
+    meta = df2["_metadata"].tolist()[0]
+    assert meta["path"].value.endswith("x.txt")
+
+
+def test_python_connector_streaming_subscribe():
+    class Numbers(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(5):
+                self.next(value=i)
+                if i % 2 == 1:
+                    self.commit()
+
+    class S(pw.Schema):
+        value: int
+
+    t = pw.io.python.read(Numbers(), schema=S)
+    total = t.reduce(s=pw.reducers.sum(t.value))
+    seen = []
+    pw.io.subscribe(
+        total, on_change=lambda key, row, time, add: seen.append((row["s"], add))
+    )
+    pw.run()
+    # final state: sum = 0+1+2+3+4 = 10
+    adds = [s for s, add in seen if add]
+    assert adds[-1] == 10
+
+
+def test_fs_streaming_upsert_delete(tmp_path):
+    """Changed files retract old rows; deleted files retract everything."""
+    (tmp_path / "d.txt").write_text("v1")
+    t = pw.io.fs.read(
+        tmp_path, format="plaintext_by_file", mode="streaming", refresh_interval=0.05
+    )
+    states = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, add: states.append((row["data"], add))
+    )
+    subject = t._operator.params["subject"]
+
+    def mutate():
+        time.sleep(0.4)
+        f = tmp_path / "d.txt"
+        f.write_text("v2-longer")  # size change forces re-read
+        time.sleep(0.4)
+        f.unlink()
+        time.sleep(0.4)
+        subject.close()
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run()
+    th.join()
+    assert ("v1", True) in states
+    assert ("v1", False) in states
+    assert ("v2-longer", True) in states
+    assert ("v2-longer", False) in states
+
+
+def test_csv_and_jsonlines_write(tmp_path):
+    class S(pw.Schema):
+        a: int
+
+    rows = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    out_csv = tmp_path / "out.csv"
+    out_jl = tmp_path / "out.jsonl"
+    pw.io.csv.write(rows, out_csv)
+    pw.io.jsonlines.write(rows, out_jl)
+    pw.run()
+    lines = out_csv.read_text().strip().splitlines()
+    assert lines[0] == "a,time,diff"
+    assert len(lines) == 3
+    recs = [json.loads(l) for l in out_jl.read_text().strip().splitlines()]
+    assert sorted(r["a"] for r in recs) == [1, 2]
+    assert all(r["diff"] == 1 for r in recs)
+
+
+def test_rest_connector_echo():
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    class QuerySchema(pw.Schema):
+        query: str
+
+    queries, response_writer = pw.io.http.rest_connector(
+        host="127.0.0.1",
+        port=port,
+        schema=QuerySchema,
+        delete_completed_queries=False,
+    )
+    results = queries.select(result=pw.apply_with_type(lambda q: q + "!", str, pw.this.query))
+    response_writer(results)
+
+    subject = queries._operator.params["subject"]
+    th = threading.Thread(target=pw.run, daemon=True)
+    th.start()
+    try:
+        deadline = time.time() + 10
+        resp = None
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/",
+                    data=json.dumps({"query": "hi"}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = json.loads(urllib.request.urlopen(req, timeout=5).read())
+                break
+            except (ConnectionError, urllib.error.URLError):
+                time.sleep(0.1)
+        assert resp == "hi!"
+    finally:
+        subject.close()
+        th.join(timeout=10)
