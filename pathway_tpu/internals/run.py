@@ -41,23 +41,29 @@ def run(
 
     EvalContext.terminate_on_error = terminate_on_error
 
+    from .. import persistence as _persistence
+
     sinks = list(getattr(G, "sinks", []))
     if not sinks:
         return
 
-    runner = GraphRunner()
-    engine = runner.build([(table, node) for table, node in sinks])
+    _persistence.activate(persistence_config)
+    try:
+        runner = GraphRunner()
+        engine = runner.build([(table, node) for table, node in sinks])
 
-    from ..io.streaming import StreamingDriver
+        from ..io.streaming import StreamingDriver
 
-    driver = StreamingDriver(
-        engine,
-        runner,
-        persistence_config=persistence_config,
-        monitoring_level=monitoring_level,
-        with_http_server=with_http_server,
-    )
-    driver.run()
+        driver = StreamingDriver(
+            engine,
+            runner,
+            persistence_config=persistence_config,
+            monitoring_level=monitoring_level,
+            with_http_server=with_http_server,
+        )
+        driver.run()
+    finally:
+        _persistence.deactivate(persistence_config)
 
 
 def run_all(**kwargs: Any) -> None:

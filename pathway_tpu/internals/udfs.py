@@ -182,7 +182,30 @@ class DiskCache(CacheStrategy):
 
 class DefaultCache(DiskCache):
     """reference: caches.py DefaultCache — uses the persistence layer when
-    enabled, a disk cache otherwise."""
+    a run with UDF_CACHING is active (vector_store.py:564-567), a disk
+    cache otherwise.  The backend is looked up per call so the same UDF
+    object works across runs with different persistence configs."""
+
+    def wrap_async(self, fun):
+        name = getattr(fun, "__name__", "udf")
+        disk_wrapped = super().wrap_async(fun)
+
+        @functools.wraps(fun)
+        async def wrapper(*args, **kwargs):
+            from ..persistence import udf_cache_storage
+
+            storage = udf_cache_storage()
+            if storage is None:
+                return await disk_wrapped(*args, **kwargs)
+            key = "udfcache/" + self._cache_key(self._name or name, args, kwargs)
+            hit = storage.get(key)
+            if hit is not None:
+                return pickle.loads(hit)
+            result = await fun(*args, **kwargs)
+            storage.put(key, pickle.dumps(result))
+            return result
+
+        return wrapper
 
 
 # ---------------------------------------------------------------------------

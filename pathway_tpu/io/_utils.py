@@ -60,9 +60,14 @@ def coerce_value(v: Any, dtype) -> Any:
 
 
 def coerce_row(schema: SchemaMetaclass, raw: dict) -> dict:
-    return {
-        n: coerce_value(raw.get(n), schema[n].dtype) for n in schema.column_names()
-    }
+    out = {}
+    for n in schema.column_names():
+        col = schema[n]
+        if n not in raw and getattr(col, "has_default_value", False):
+            out[n] = col.default_value
+        else:
+            out[n] = coerce_value(raw.get(n), col.dtype)
+    return out
 
 
 def input_table(schema: SchemaMetaclass, subject=None, **params: Any) -> Table:

@@ -1,0 +1,318 @@
+"""``pw.persistence`` — checkpoint/resume + UDF caching.
+
+reference: python/pathway/persistence/__init__.py (``Backend.filesystem/
+s3/mock``:13-86, ``Config.simple_config``:107) over the Rust KV trait
+``PersistenceBackend`` (src/persistence/backends/mod.rs:50), input
+snapshots (input_snapshot.rs), operator snapshots (operator_snapshot.rs)
+and metadata (state.rs:35).
+
+Host-plane design: persistence stays on the host (the HBM index is derived
+state — rebuilt by replaying the snapshot through the jit pipeline, or
+restored from its own device-array dump).  Three cooperating pieces:
+
+* a KV backend (filesystem / memory / mock — same trait shape as the
+  reference);
+* input snapshots: committed connector entries + per-subject offsets
+  written per micro-batch, replayed before live reading on restart
+  (``Entry::{Snapshot,RewindFinishSentinel}`` semantics,
+  src/connectors/mod.rs:100-104);
+* UDF caching: ``PersistenceMode.UDF_CACHING`` routes ``DefaultCache``
+  through the configured backend (reference: vector_store.py:564-567).
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import json
+import os
+import pickle
+import threading
+from typing import Any, Iterable
+
+__all__ = ["Backend", "Config", "PersistenceMode", "KVStorage"]
+
+
+class PersistenceMode(enum.Enum):
+    """reference: src/connectors/mod.rs:107 ``PersistenceMode``"""
+
+    BATCH = "batch"
+    PERSISTING = "persisting"
+    OPERATOR_PERSISTING = "operator_persisting"
+    UDF_CACHING = "udf_caching"
+    SELECTIVE_PERSISTING = "selective_persisting"
+    SPEEDRUN_REPLAY = "speedrun_replay"
+
+
+class KVStorage:
+    """KV trait (reference: persistence/backends/mod.rs:50 — get/put/
+    list_keys/remove over fs, S3 or memory)."""
+
+    def get(self, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def remove(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        raise NotImplementedError
+
+
+class FilesystemKV(KVStorage):
+    # keys are percent-encoded into flat filenames: injective (unlike a bare
+    # '/'→'__' swap) and reversible via unquote
+    def __init__(self, root: str):
+        self.root = os.fspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    @staticmethod
+    def _escape(key: str) -> str:
+        from urllib.parse import quote
+
+        return quote(key, safe="")
+
+    @staticmethod
+    def _unescape(name: str) -> str:
+        from urllib.parse import unquote
+
+        return unquote(name)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, self._escape(key))
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def put(self, key: str, value: bytes) -> None:
+        path = self._path(key)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, path)
+
+    def remove(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        keys = (
+            self._unescape(name)
+            for name in os.listdir(self.root)
+            if not name.endswith(".tmp")
+        )
+        return sorted(k for k in keys if k.startswith(prefix))
+
+
+class MemoryKV(KVStorage):
+    def __init__(self):
+        self._store: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            return self._store.get(key)
+
+    def put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._store[key] = value
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        with self._lock:
+            return sorted(k for k in self._store if k.startswith(prefix))
+
+
+class Backend:
+    """Factory wrapper (reference: persistence/__init__.py:13)."""
+
+    def __init__(self, storage: KVStorage, fs_path: str | None = None):
+        self._storage = storage
+        self.fs_path = fs_path
+
+    @classmethod
+    def filesystem(cls, path: str) -> "Backend":
+        return cls(FilesystemKV(os.fspath(path)), fs_path=os.fspath(path))
+
+    @classmethod
+    def memory(cls) -> "Backend":
+        return cls(MemoryKV())
+
+    @classmethod
+    def mock(cls, events: Any = None) -> "Backend":
+        """reference: persistence/__init__.py:71 / backends/mock.rs"""
+        return cls(MemoryKV())
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+        try:
+            import boto3  # noqa: F401 — optional dependency
+        except ImportError as exc:
+            raise ImportError(
+                "S3 persistence backend requires boto3; use Backend.filesystem"
+            ) from exc
+        raise NotImplementedError("S3 backend: boto3 client wiring pending")
+
+    @classmethod
+    def azure(cls, *args, **kwargs) -> "Backend":
+        raise NotImplementedError("Azure persistence backend is not available")
+
+    @property
+    def storage(self) -> KVStorage:
+        return self._storage
+
+
+class Config:
+    """reference: persistence/__init__.py:88 ``Config`` +
+    ``simple_config``:107."""
+
+    def __init__(
+        self,
+        backend: Backend,
+        *,
+        persistence_mode: "PersistenceMode | str" = PersistenceMode.PERSISTING,
+        snapshot_interval_ms: int = 0,
+        continue_after_replay: bool = True,
+    ):
+        if isinstance(persistence_mode, str):
+            persistence_mode = PersistenceMode[persistence_mode.upper()]
+        self.backend = backend
+        self.persistence_mode = persistence_mode
+        self.snapshot_interval_ms = snapshot_interval_ms
+        self.continue_after_replay = continue_after_replay
+
+    @classmethod
+    def simple_config(cls, backend: Backend, **kwargs) -> "Config":
+        return cls(backend, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# active-run context: set by pw.run, consulted by DefaultCache and the
+# streaming driver's snapshot writer
+# ---------------------------------------------------------------------------
+
+_active_stack: list["Config"] = []
+_active_lock = threading.Lock()
+
+
+def activate(config: "Config | None") -> None:
+    """Push a run's config; ``deactivate`` removes exactly that config, so a
+    run ending never clears a concurrently-running server's config (runs can
+    overlap when servers run on threads — the top of the stack wins while
+    they do)."""
+    if config is not None:
+        with _active_lock:
+            _active_stack.append(config)
+
+
+def deactivate(config: "Config | None") -> None:
+    if config is not None:
+        with _active_lock:
+            for i in range(len(_active_stack) - 1, -1, -1):
+                if _active_stack[i] is config:
+                    del _active_stack[i]
+                    break
+
+
+def active_config() -> "Config | None":
+    with _active_lock:
+        return _active_stack[-1] if _active_stack else None
+
+
+def udf_cache_storage() -> KVStorage | None:
+    """Backend KV for UDF caching when a config with UDF_CACHING (or full
+    persistence) is active."""
+    cfg = active_config()
+    if cfg is None:
+        return None
+    if cfg.persistence_mode in (
+        PersistenceMode.UDF_CACHING,
+        PersistenceMode.PERSISTING,
+        PersistenceMode.OPERATOR_PERSISTING,
+    ):
+        return cfg.backend.storage
+    return None
+
+
+# ---------------------------------------------------------------------------
+# input snapshots (reference: persistence/input_snapshot.rs:56-283)
+# ---------------------------------------------------------------------------
+
+
+class InputSnapshotWriter:
+    """Per-subject event log + offset frontier, chunked per micro-batch."""
+
+    def __init__(self, storage: KVStorage, persistent_id: str):
+        self.storage = storage
+        self.pid = persistent_id
+        self._chunk = 0
+        existing = storage.list_keys(f"snap/{persistent_id}/chunk-")
+        if existing:
+            self._chunk = (
+                max(int(k.rsplit("-", 1)[1]) for k in existing) + 1
+            )
+
+    def write_batch(self, entries: list, offsets: Any) -> None:
+        payload = pickle.dumps({"entries": entries, "offsets": offsets})
+        self.storage.put(f"snap/{self.pid}/chunk-{self._chunk:08d}", payload)
+        self._chunk += 1
+
+    def frontier(self) -> Any:
+        """Latest stored offsets, or None if no snapshot exists."""
+        keys = self.storage.list_keys(f"snap/{self.pid}/chunk-")
+        if not keys:
+            return None
+        data = self.storage.get(keys[-1])
+        return pickle.loads(data)["offsets"] if data else None
+
+
+class InputSnapshotReader:
+    """Replays all stored chunks (``Entry::Snapshot`` …
+    ``RewindFinishSentinel`` replay, src/connectors/mod.rs:100-104)."""
+
+    def __init__(self, storage: KVStorage, persistent_id: str):
+        self.storage = storage
+        self.pid = persistent_id
+
+    def replay(self) -> Iterable[list]:
+        for key in self.storage.list_keys(f"snap/{self.pid}/chunk-"):
+            data = self.storage.get(key)
+            if data:
+                yield pickle.loads(data)["entries"]
+
+    def last_offsets(self) -> Any:
+        keys = self.storage.list_keys(f"snap/{self.pid}/chunk-")
+        if not keys:
+            return None
+        data = self.storage.get(keys[-1])
+        return pickle.loads(data)["offsets"] if data else None
+
+
+# ---------------------------------------------------------------------------
+# operator snapshots (reference: persistence/operator_snapshot.rs:21-37)
+# ---------------------------------------------------------------------------
+
+
+class OperatorSnapshot:
+    """State dump for stateful operators keyed by persistent_id."""
+
+    def __init__(self, storage: KVStorage):
+        self.storage = storage
+
+    def save(self, persistent_id: str, state: Any) -> None:
+        self.storage.put(f"opstate/{persistent_id}", pickle.dumps(state))
+
+    def load(self, persistent_id: str) -> Any:
+        data = self.storage.get(f"opstate/{persistent_id}")
+        return pickle.loads(data) if data else None

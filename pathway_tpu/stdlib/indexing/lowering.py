@@ -54,6 +54,12 @@ class ExternalIndexNode(Node):
         self.doc_payload: dict[Any, tuple] = {}
         # live-mode query state: qkey -> (row, last_emitted_row)
         self.live_queries: dict[Any, list] = {}
+        # asof_now: answered replies kept so a query retraction (REST
+        # delete_completed_queries) retracts its reply and frees the state —
+        # the reference's ForgetImmediately cleanup on asof-now queries.
+        # For keep-queries streams this grows with total queries, the same
+        # asymptotics as the downstream reply table those queries requested.
+        self.answered: dict[Any, tuple] = {}
 
     def flush(self, time: int) -> list[Entry]:
         out: list[Entry] = []
@@ -75,13 +81,9 @@ class ExternalIndexNode(Node):
                 if diff > 0:
                     new_queries.append((key, row))
                 else:
-                    # reference requires append-only query streams for
-                    # as-of-now operators (external_index.rs asof-now contract)
-                    raise ValueError(
-                        "as-of-now index received a query retraction; the "
-                        "query stream must be append-only (did you mean "
-                        "DataIndex.query instead of query_as_of_now?)"
-                    )
+                    answered = self.answered.pop(key, None)
+                    if answered is not None:
+                        out.append((key, answered, -1))
             else:
                 slot = self.live_queries.get(key)
                 if diff > 0:
@@ -98,6 +100,8 @@ class ExternalIndexNode(Node):
                 out.append((key, out_row, 1))
                 if self.mode == "live":
                     self.live_queries[key][1] = out_row
+                else:
+                    self.answered[key] = out_row
         # 3. live mode: refresh previously-answered queries on index change
         if self.mode == "live" and index_changed and self.live_queries:
             stale = [

@@ -1,0 +1,8 @@
+"""``pw.xpacks`` — extension packs.
+
+reference: python/pathway/xpacks/ (llm xpack + gated connectors).
+"""
+
+from . import llm
+
+__all__ = ["llm"]

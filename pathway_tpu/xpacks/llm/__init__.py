@@ -1,0 +1,43 @@
+"""``pw.xpacks.llm`` — the live LLM/RAG toolkit, TPU-native.
+
+reference: python/pathway/xpacks/llm/__init__.py.  The component families
+(embedders / llms / rerankers / parsers / splitters / prompts) are
+``pw.UDF`` subclasses exactly like the reference; the local-model ones
+(SentenceTransformerEmbedder, CrossEncoderReranker) run as jit-compiled
+JAX modules on the TPU instead of torch-on-CPU/GPU inside the UDF.
+"""
+
+from . import (
+    embedders,
+    llms,
+    mocks,
+    parsers,
+    prompts,
+    rerankers,
+    splitters,
+)
+
+__all__ = [
+    "embedders",
+    "llms",
+    "mocks",
+    "parsers",
+    "prompts",
+    "rerankers",
+    "splitters",
+    "vector_store",
+    "document_store",
+    "question_answering",
+    "servers",
+]
+
+
+def __getattr__(name: str):
+    # heavier modules (servers pull in aiohttp) load lazily
+    if name in ("vector_store", "document_store", "question_answering", "servers"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,131 @@
+"""Shared document pipeline: sources → parse → flatten → post-process →
+split → flatten (+ stats reduce).
+
+One implementation behind both ``VectorStoreServer`` (vector_store.py:227
+in the reference) and ``DocumentStore`` (document_store.py:286) — the
+reference duplicates this pipeline across the two classes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+from ...internals import dtype as dt
+from ...internals import reducers
+from ...internals.expression import ApplyExpression
+from ...internals.table import Table
+from ...internals.udfs import UDF
+from ...internals.value import Json
+from ._utils import coerce_str
+
+__all__ = ["build_document_pipeline", "component_expr", "merge_meta"]
+
+
+def component_expr(component: Callable, *args):
+    """Parser/splitter slot: a UDF builds its own apply expression; a plain
+    callable becomes a deterministic row-wise apply returning chunk lists."""
+    if isinstance(component, UDF):
+        return component(*args)
+    return ApplyExpression(component, dt.List(dt.ANY), *args)
+
+
+def merge_meta(pair, file_meta) -> Json:
+    """Chunk metadata overlaid on the source file's metadata."""
+    chunk_meta = pair[1]
+    meta = (
+        dict(file_meta.value) if isinstance(file_meta, Json) else dict(file_meta or {})
+    )
+    if isinstance(chunk_meta, Json):
+        chunk_meta = chunk_meta.value
+    meta.update(chunk_meta or {})
+    return Json(meta)
+
+
+def _post_process_chain(post_processors: Iterable[Callable]):
+    def process(text, metadata):
+        if isinstance(metadata, Json):
+            metadata = dict(metadata.value)
+        for pp in post_processors:
+            text, metadata = pp(text, metadata)
+        return text, metadata
+
+    return process
+
+
+def build_document_pipeline(
+    docs_tables: list[Table],
+    parser: Callable,
+    splitter: Callable,
+    doc_post_processors: list[Callable],
+) -> dict:
+    if not docs_tables:
+        raise ValueError(
+            "Please provide at least one data source, e.g. read files from disk"
+        )
+    docs = docs_tables[0]
+    if len(docs_tables) > 1:
+        docs = docs.concat_reindex(*docs_tables[1:])
+    if "_metadata" not in docs.column_names():
+        docs = docs.select(
+            data=docs.data,
+            _metadata=ApplyExpression(lambda d: Json({}), Json, docs.data),
+        )
+
+    parsed = docs.select(
+        _parsed=component_expr(parser, docs.data), _metadata=docs["_metadata"]
+    )
+    parsed = parsed.flatten(parsed["_parsed"])
+    parsed_docs = parsed.select(
+        text=ApplyExpression(lambda p: coerce_str(p[0]), dt.STR, parsed["_parsed"]),
+        metadata=ApplyExpression(
+            merge_meta, Json, parsed["_parsed"], parsed["_metadata"]
+        ),
+    )
+
+    if doc_post_processors:
+        chain = _post_process_chain(doc_post_processors)
+
+        def post(text, metadata):
+            new_text, new_meta = chain(text, metadata)
+            return (coerce_str(new_text), Json(new_meta))
+
+        pp = parsed_docs.select(
+            _pair=ApplyExpression(
+                post, dt.Tuple(dt.STR, dt.JSON), parsed_docs.text, parsed_docs.metadata
+            )
+        )
+        parsed_docs = pp.select(
+            text=ApplyExpression(lambda p: p[0], dt.STR, pp["_pair"]),
+            metadata=ApplyExpression(lambda p: p[1], dt.JSON, pp["_pair"]),
+        )
+
+    chunked = parsed_docs.select(
+        _chunks=component_expr(splitter, parsed_docs.text),
+        metadata=parsed_docs.metadata,
+    )
+    chunked = chunked.flatten(chunked["_chunks"])
+    chunked_docs = chunked.select(
+        text=ApplyExpression(lambda c: coerce_str(c[0]), dt.STR, chunked["_chunks"]),
+        metadata=ApplyExpression(
+            merge_meta, Json, chunked["_chunks"], chunked.metadata
+        ),
+    )
+
+    stats = parsed_docs.reduce(
+        count=reducers.count(),
+        last_modified=reducers.max(
+            ApplyExpression(
+                lambda m: (m.value or {}).get("modified_at"), dt.Optional(dt.INT),
+                parsed_docs.metadata,
+            )
+        ),
+        last_indexed=reducers.max(
+            ApplyExpression(
+                lambda m: (m.value or {}).get("seen_at"), dt.Optional(dt.INT),
+                parsed_docs.metadata,
+            )
+        ),
+    )
+    return dict(
+        docs=docs, parsed_docs=parsed_docs, chunked_docs=chunked_docs, stats=stats
+    )

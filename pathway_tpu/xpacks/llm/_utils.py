@@ -1,0 +1,155 @@
+"""Shared helpers for the LLM xpack.
+
+reference: python/pathway/xpacks/llm/_utils.py (coerce helpers) — the
+``_AsyncMicroBatcher`` is new here: it is the device-batching half of the
+TPU design.  The reference embeds one string per async-UDF call and gets
+concurrency from the executor only (embedders.py async UDF w/ capacity);
+here all calls that are in flight on the same event loop coalesce into one
+padded device batch, so a micro-batch of N chunks costs one jit dispatch
+instead of N model calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Callable, Sequence
+
+__all__ = [
+    "coerce_str",
+    "AsyncMicroBatcher",
+    "RestClientBase",
+    "run_with_cache",
+    "_check_model_accepts_arg",
+]
+
+
+def coerce_str(value: Any) -> str:
+    if isinstance(value, bytes):
+        return value.decode("utf-8", errors="replace")
+    return str(value)
+
+
+def _check_model_accepts_arg(model_cls_or_fn: Any, arg: str) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(model_cls_or_fn)
+    except (TypeError, ValueError):
+        return False
+    return arg in sig.parameters
+
+
+class RestClientBase:
+    """Shared urllib JSON client (VectorStoreClient / RAGClient)."""
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: float = 30.0,
+        additional_headers: dict | None = None,
+    ):
+        if url is None:
+            if host is None or port is None:
+                raise ValueError("provide url= or host= and port=")
+            url = f"http://{host}:{port}"
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self.additional_headers = additional_headers or {}
+
+    def _post(self, route: str, payload: dict):
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url + route,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **self.additional_headers},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read().decode())
+
+
+def run_with_cache(
+    threaded: bool = False,
+    with_cache: bool = True,
+    cache_backend: Any = None,
+    terminate_on_error: bool = True,
+):
+    """Start ``pw.run`` with UDF_CACHING persistence wired (reference:
+    vector_store.py:558-582 / servers.py run) — shared by every xpack
+    ``run_server``.  Returns the thread when ``threaded=True``."""
+    from ...internals.run import run
+
+    persistence_config = None
+    if with_cache:
+        from ...persistence import Backend, Config
+
+        backend = cache_backend or Backend.mock()
+        persistence_config = Config(backend, persistence_mode="UDF_CACHING")
+
+    def target():
+        run(
+            persistence_config=persistence_config,
+            terminate_on_error=terminate_on_error,
+        )
+
+    if threaded:
+        th = threading.Thread(target=target, daemon=True, name="pw-server")
+        th.start()
+        return th
+    target()
+
+
+class AsyncMicroBatcher:
+    """Coalesces concurrent async calls into one batched device call.
+
+    ``batch_fn(list_of_items) -> list_of_results`` is invoked once per
+    scheduling round of the event loop (or when ``max_batch`` items are
+    pending).  The engine's AsyncMapNode fans out every row of a micro-batch
+    as a concurrent task on one loop, so all rows of the timestamp land in
+    the same device batch — the bucketed-padding path of
+    ``models/encoder.py`` then compiles once per shape bucket.
+    """
+
+    def __init__(self, batch_fn: Callable[[list], Sequence], max_batch: int = 1024):
+        self.batch_fn = batch_fn
+        self.max_batch = max_batch
+        # device dispatch is serialized; the model call itself is not
+        # thread-safe across loops
+        self._dispatch_lock = threading.Lock()
+        self._pending: dict[int, list[tuple[Any, asyncio.Future]]] = {}
+
+    async def call(self, item: Any) -> Any:
+        loop = asyncio.get_running_loop()
+        lid = id(loop)
+        lst = self._pending.setdefault(lid, [])
+        fut: asyncio.Future = loop.create_future()
+        lst.append((item, fut))
+        if len(lst) >= self.max_batch:
+            self._flush(lid)
+        elif len(lst) == 1:
+            # flush after the current scheduling round: every concurrent
+            # task gets to append before the callback runs
+            loop.call_soon(self._flush, lid)
+        return await fut
+
+    def _flush(self, lid: int) -> None:
+        lst = self._pending.get(lid)
+        if not lst:
+            return
+        self._pending[lid] = []
+        items = [it for it, _ in lst]
+        try:
+            with self._dispatch_lock:
+                results = self.batch_fn(items)
+            for (_, fut), res in zip(lst, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as exc:  # noqa: BLE001 — propagate to every waiter
+            for _, fut in lst:
+                if not fut.done():
+                    fut.set_exception(exc)

@@ -1,0 +1,214 @@
+"""DocumentStore — the retriever-pluggable document pipeline.
+
+reference: python/pathway/xpacks/llm/document_store.py —
+``DocumentStore``:32 (pluggable ``retriever_factory``:52-64,
+``build_pipeline``:286, ``retrieve_query``:426 via
+``DataIndex.query_as_of_now``), ``SlidesDocumentStore``:471.
+
+Same pipeline as VectorStoreServer but the index is built from any
+``InnerIndexFactory`` (brute-force/usearch-parity HBM KNN, LSH, BM25,
+hybrid) — so full-text and hybrid retrieval serve from the same engine
+graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ...internals import dtype as dt
+from ...internals import reducers
+from ...internals.expression import ApplyExpression
+from ...internals.schema import Schema, column_definition
+from ...internals.table import Table
+from ...internals.thisclass import right
+from ...internals.udfs import udf
+from ...internals.value import Json
+from ...stdlib.indexing.data_index import DataIndex
+from ._utils import coerce_str
+from .parsers import Utf8Parser
+from .splitters import null_splitter
+from ._pipeline import build_document_pipeline
+from .vector_store import (
+    InputsQuerySchema,
+    RetrieveQuerySchema,
+    StatisticsQuerySchema,
+    _merge_filters,
+)
+
+__all__ = ["DocumentStore", "SlidesDocumentStore"]
+
+
+class DocumentStore:
+    """reference: document_store.py:32"""
+
+    class RetrieveQuerySchema(RetrieveQuerySchema):
+        pass
+
+    class StatisticsQuerySchema(StatisticsQuerySchema):
+        pass
+
+    class InputsQuerySchema(InputsQuerySchema):
+        pass
+
+    class QueryResultSchema(Schema):
+        result: Json
+
+    class InputResultSchema(Schema):
+        result: Json
+
+    def __init__(
+        self,
+        docs: Table | list[Table],
+        retriever_factory: Any,
+        parser: Callable | None = None,
+        splitter: Callable | None = None,
+        doc_post_processors: list[Callable] | None = None,
+    ):
+        self.docs = [docs] if isinstance(docs, Table) else list(docs)
+        self.retriever_factory = retriever_factory
+        self.parser = parser if parser is not None else Utf8Parser()
+        self.splitter = splitter if splitter is not None else null_splitter
+        self.doc_post_processors = [
+            p for p in (doc_post_processors or []) if p is not None
+        ]
+        self.build_pipeline()
+
+    def build_pipeline(self) -> None:
+        """reference: document_store.py:286 — shared pipeline + pluggable
+        retriever factory."""
+        graph = build_document_pipeline(
+            self.docs, self.parser, self.splitter, self.doc_post_processors
+        )
+        self.input_docs = graph["docs"]
+        self.parsed_docs = graph["parsed_docs"]
+        self.chunked_docs = graph["chunked_docs"]
+        self.stats = graph["stats"]
+        self._retriever = DataIndex(
+            self.chunked_docs,
+            self.retriever_factory,
+            data_column=self.chunked_docs.text,
+            metadata_column=self.chunked_docs.metadata,
+            embedder=getattr(self.retriever_factory, "embedder", None),
+        )
+
+    @property
+    def index(self) -> DataIndex:
+        return self._retriever
+
+    # -- queries (reference: document_store.py:426 retrieve_query) --
+    def retrieve_query(self, retrieval_queries: Table) -> Table:
+        queries = retrieval_queries.select(
+            query=retrieval_queries.query,
+            k=retrieval_queries.k,
+            metadata_filter=_merge_filters(
+                retrieval_queries.metadata_filter,
+                retrieval_queries.filepath_globpattern,
+            ),
+        )
+        res = self._retriever.query_as_of_now(
+            queries.query,
+            number_of_matches=queries.k,
+            metadata_filter=queries.metadata_filter,
+            collapse_rows=True,
+        )
+
+        def pack(texts, metas, scores) -> Json:
+            return Json(
+                [
+                    {
+                        "text": coerce_str(t),
+                        "metadata": m.value if isinstance(m, Json) else m,
+                        "score": float(s),
+                        "dist": -float(s),
+                    }
+                    for t, m, s in zip(texts or (), metas or (), scores or ())
+                ]
+            )
+
+        return res.select(
+            result=ApplyExpression(
+                pack,
+                Json,
+                right.text,
+                right.metadata,
+                right["_pw_index_reply_score"],
+            )
+        )
+
+    def statistics_query(self, info_queries: Table) -> Table:
+        def pack_stats(count, last_modified, last_indexed) -> Json:
+            return Json(
+                {
+                    "file_count": int(count or 0),
+                    "last_modified": last_modified,
+                    "last_indexed": last_indexed,
+                }
+            )
+
+        stats = self.stats
+        return info_queries.join_left(stats, id=info_queries.id).select(
+            result=ApplyExpression(
+                pack_stats, Json, stats.count, stats.last_modified, stats.last_indexed
+            )
+        )
+
+    def inputs_query(self, input_queries: Table) -> Table:
+        docs = self.parsed_docs
+        all_meta = docs.reduce(metadatas=reducers.tuple(docs.metadata))
+
+        @udf
+        def format_inputs(metadatas, metadata_filter: str | None) -> Json:
+            from ...utils.jmespath_lite import compile_filter
+
+            metas = [m.value if isinstance(m, Json) else m for m in (metadatas or ())]
+            if metadata_filter:
+                flt = compile_filter(metadata_filter)
+                metas = [m for m in metas if flt(m)]
+            return Json(metas)
+
+        queries = input_queries.select(
+            metadata_filter=_merge_filters(
+                input_queries.metadata_filter, input_queries.filepath_globpattern
+            )
+        )
+        return queries.join_left(all_meta, id=queries.id).select(
+            result=format_inputs(all_meta.metadatas, queries.metadata_filter)
+        )
+
+
+class SlidesDocumentStore(DocumentStore):
+    """Slide-deck flavor exposing the parsed-slides dump
+    (reference: document_store.py:471)."""
+
+    excluded_response_metadata = ["b64_image"]
+
+    def parsed_documents_query(self, parse_docs_queries: Table) -> Table:
+        docs = self.parsed_docs
+        all_docs = docs.reduce(
+            docs=reducers.tuple(
+                ApplyExpression(
+                    lambda t, m: Json(
+                        {
+                            "text": coerce_str(t),
+                            "metadata": {
+                                k: v
+                                for k, v in (
+                                    m.value if isinstance(m, Json) else m or {}
+                                ).items()
+                                if k not in self.excluded_response_metadata
+                            },
+                        }
+                    ),
+                    Json,
+                    docs.text,
+                    docs.metadata,
+                )
+            )
+        )
+        return parse_docs_queries.join_left(all_docs, id=parse_docs_queries.id).select(
+            result=ApplyExpression(
+                lambda ds: Json([d.value if isinstance(d, Json) else d for d in (ds or ())]),
+                Json,
+                all_docs.docs,
+            )
+        )

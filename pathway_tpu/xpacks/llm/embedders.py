@@ -1,0 +1,202 @@
+"""Embedder UDFs.
+
+reference: python/pathway/xpacks/llm/embedders.py — ``BaseEmbedder``:64
+(with ``get_embedding_dimension``:72), ``OpenAIEmbedder``:85,
+``LiteLLMEmbedder``:180, ``SentenceTransformerEmbedder``:270,
+``GeminiEmbedder``:330.
+
+TPU design: ``SentenceTransformerEmbedder`` runs the MiniLM-class flax
+encoder (models/encoder.py) jit-compiled on the TPU.  Calls arriving
+concurrently within one engine micro-batch coalesce into a single padded
+device batch via :class:`AsyncMicroBatcher` — the reference's per-string
+torch calls become one MXU matmul chain per timestamp.  API embedders
+(OpenAI/LiteLLM/Gemini) keep the reference's async-UDF shape (capacity,
+retries, cache) and need the respective client libraries at call time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ...internals import udfs
+from ...internals.udfs import UDF
+from ._utils import AsyncMicroBatcher, coerce_str
+
+__all__ = [
+    "BaseEmbedder",
+    "SentenceTransformerEmbedder",
+    "OpenAIEmbedder",
+    "LiteLLMEmbedder",
+    "GeminiEmbedder",
+]
+
+
+class BaseEmbedder(UDF):
+    """reference: embedders.py:64"""
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        """Dimension learned by probing with ".", like the reference
+        (embedders.py:72 / nearest_neighbors.py:411)."""
+        return len(_call_sync(self.__wrapped__, ".", **kwargs))
+
+
+def _call_sync(fn: Callable, *args, **kwargs):
+    import asyncio
+    import inspect
+
+    if inspect.iscoroutinefunction(fn):
+        return asyncio.run(fn(*args, **kwargs))
+    res = fn(*args, **kwargs)
+    if inspect.iscoroutine(res):
+        return asyncio.run(res)
+    return res
+
+
+class SentenceTransformerEmbedder(BaseEmbedder):
+    """JAX/flax sentence encoder on TPU
+    (reference: embedders.py:270 — sentence-transformers torch model with a
+    ``device`` param; here device placement is XLA's and the model is the
+    bucketed-batch jit encoder of models/encoder.py).
+
+    ``model`` accepts an all-MiniLM-L6-v2-style name (geometry + wordpiece
+    vocab are resolved by models/tokenizer.py), or pass ``encoder=`` with a
+    ready :class:`pathway_tpu.models.encoder.SentenceEncoder`.
+    """
+
+    def __init__(
+        self,
+        model: str = "all-MiniLM-L6-v2",
+        *,
+        call_kwargs: dict = {},
+        device: str = "tpu",  # accepted for API parity; placement is XLA's
+        encoder: Any = None,
+        max_batch: int = 1024,
+        **init_kwargs,
+    ):
+        super().__init__(executor=udfs.async_executor(), deterministic=True)
+        self.model = model
+        self.kwargs = dict(call_kwargs)
+        self._encoder = encoder
+        self._batcher: AsyncMicroBatcher | None = None
+        self._max_batch = max_batch
+        self._init_kwargs = init_kwargs
+
+    def _ensure_encoder(self):
+        if self._encoder is None:
+            from ...models.encoder import SentenceEncoder
+
+            self._encoder = SentenceEncoder(self.model, **self._init_kwargs)
+        if self._batcher is None:
+            enc = self._encoder
+
+            def batch_encode(texts: list[str]) -> list[np.ndarray]:
+                return list(enc.encode([coerce_str(t) for t in texts]))
+
+            self._batcher = AsyncMicroBatcher(batch_encode, max_batch=self._max_batch)
+        return self._encoder
+
+    async def __wrapped__(self, input: str, **kwargs) -> np.ndarray:
+        self._ensure_encoder()
+        return await self._batcher.call(input)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._ensure_encoder().dim
+
+
+class OpenAIEmbedder(BaseEmbedder):
+    """reference: embedders.py:85 — async UDF calling the OpenAI embeddings
+    API; capacity/retry/cache strategies as in the reference."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = "text-embedding-3-small",
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        **openai_kwargs,
+    ):
+        super().__init__(
+            executor=udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(openai_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+        self._client = None
+
+    def _ensure_client(self):
+        if self._client is None:
+            import openai  # noqa: F401 — optional dependency
+
+            self._client = openai.AsyncOpenAI(
+                **{
+                    k: self.kwargs.pop(k)
+                    for k in ("api_key", "base_url", "organization")
+                    if k in self.kwargs
+                }
+            )
+        return self._client
+
+    async def __wrapped__(self, input, **kwargs) -> np.ndarray:
+        client = self._ensure_client()
+        kwargs = {**self.kwargs, **kwargs}
+        input = coerce_str(input) or "."
+        ret = await client.embeddings.create(input=[input], **kwargs)
+        return np.array(ret.data[0].embedding)
+
+
+class LiteLLMEmbedder(BaseEmbedder):
+    """reference: embedders.py:180"""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        **llmlite_kwargs,
+    ):
+        super().__init__(
+            executor=udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.kwargs = dict(llmlite_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    async def __wrapped__(self, input, **kwargs) -> np.ndarray:
+        import litellm  # optional dependency
+
+        ret = await litellm.aembedding(
+            input=[coerce_str(input) or "."], **{**self.kwargs, **kwargs}
+        )
+        return np.array(ret.data[0]["embedding"])
+
+
+class GeminiEmbedder(BaseEmbedder):
+    """reference: embedders.py:330"""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = "models/text-embedding-004",
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        **genai_kwargs,
+    ):
+        super().__init__(
+            executor=udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.kwargs = dict(genai_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    async def __wrapped__(self, input, **kwargs) -> np.ndarray:
+        import google.generativeai as genai  # optional dependency
+
+        ret = genai.embed_content(content=coerce_str(input) or ".", **{**self.kwargs, **kwargs})
+        return np.array(ret["embedding"])

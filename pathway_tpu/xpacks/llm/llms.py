@@ -1,0 +1,241 @@
+"""Chat-model UDFs.
+
+reference: python/pathway/xpacks/llm/llms.py — ``BaseChat``:27,
+``OpenAIChat``:84, ``LiteLLMChat``:313, ``HFPipelineChat``:441,
+``CohereChat``:544, ``prompt_chat_single_qa``:686.
+
+Chats take a tuple/list of ``{"role": ..., "content": ...}`` dicts (or a
+Json of the same) and return the completion string.  API chats are async
+UDFs with capacity/retry/cache; ``HFPipelineChat`` runs a local
+transformers pipeline (torch CPU in this image — a flax causal-LM serving
+path is the models/ roadmap item).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals import udfs
+from ...internals.expression import ColumnExpression, MakeTupleExpression
+from ...internals.udfs import UDF
+from ...internals.value import Json
+from ._utils import coerce_str
+
+__all__ = [
+    "BaseChat",
+    "OpenAIChat",
+    "LiteLLMChat",
+    "HFPipelineChat",
+    "CohereChat",
+    "prompt_chat_single_qa",
+]
+
+
+def _messages_to_list(messages: Any) -> list[dict]:
+    if isinstance(messages, Json):
+        messages = messages.value
+    if isinstance(messages, (dict, str)):
+        messages = [messages]
+    out = []
+    for m in messages:
+        if isinstance(m, Json):
+            m = m.value
+        if isinstance(m, str):
+            m = {"role": "user", "content": m}
+        out.append(dict(m))
+    return out
+
+
+class BaseChat(UDF):
+    """reference: llms.py:27"""
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        """Whether the underlying provider accepts ``arg_name`` as a call
+        kwarg (reference: llms.py BaseChat._accepts_call_arg)."""
+        return False
+
+
+class OpenAIChat(BaseChat):
+    """reference: llms.py:84"""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = "gpt-4o-mini",
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        **openai_kwargs,
+    ):
+        super().__init__(
+            executor=udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.kwargs = dict(openai_kwargs)
+        self.model = model
+        if model is not None:
+            self.kwargs["model"] = model
+        self._client = None
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return arg_name in (
+            "model",
+            "temperature",
+            "max_tokens",
+            "top_p",
+            "logit_bias",
+            "stop",
+            "seed",
+            "response_format",
+        )
+
+    def _ensure_client(self):
+        if self._client is None:
+            import openai  # optional dependency
+
+            self._client = openai.AsyncOpenAI(
+                **{
+                    k: self.kwargs.pop(k)
+                    for k in ("api_key", "base_url", "organization")
+                    if k in self.kwargs
+                }
+            )
+        return self._client
+
+    async def __wrapped__(self, messages, **kwargs) -> str | None:
+        client = self._ensure_client()
+        kwargs = {**self.kwargs, **kwargs}
+        ret = await client.chat.completions.create(
+            messages=_messages_to_list(messages), **kwargs
+        )
+        return ret.choices[0].message.content
+
+
+class LiteLLMChat(BaseChat):
+    """reference: llms.py:313"""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        **litellm_kwargs,
+    ):
+        super().__init__(
+            executor=udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.kwargs = dict(litellm_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return arg_name in ("model", "temperature", "max_tokens", "top_p", "stop")
+
+    async def __wrapped__(self, messages, **kwargs) -> str | None:
+        import litellm  # optional dependency
+
+        ret = await litellm.acompletion(
+            messages=_messages_to_list(messages), **{**self.kwargs, **kwargs}
+        )
+        return ret.choices[0]["message"]["content"]
+
+
+class HFPipelineChat(BaseChat):
+    """Local transformers text-generation pipeline
+    (reference: llms.py:441 — the pipeline is built once and shared; calls
+    run on the sync executor since the model itself is the bottleneck)."""
+
+    def __init__(
+        self,
+        model: str | None = "gpt2",
+        call_kwargs: dict = {},
+        device: str = "cpu",
+        **pipeline_kwargs,
+    ):
+        super().__init__(executor=udfs.async_executor())
+        self.model = model
+        self.call_kwargs = dict(call_kwargs)
+        self.device = device
+        self.pipeline_kwargs = dict(pipeline_kwargs)
+        self._pipeline = None
+
+    def _ensure_pipeline(self):
+        if self._pipeline is None:
+            import transformers
+
+            self._pipeline = transformers.pipeline(
+                "text-generation", model=self.model, device=self.device,
+                **self.pipeline_kwargs,
+            )
+        return self._pipeline
+
+    def crop_to_max_length(self, input_string: str, max_prompt_length: int = 500) -> str:
+        tokenizer = self._ensure_pipeline().tokenizer
+        tokens = tokenizer.tokenize(input_string)
+        if len(tokens) > max_prompt_length:
+            tokens = tokens[-max_prompt_length:]
+        return tokenizer.convert_tokens_to_string(tokens)
+
+    async def __wrapped__(self, messages, **kwargs) -> str | None:
+        pipe = self._ensure_pipeline()
+        msgs = _messages_to_list(messages)
+        kwargs = {**self.call_kwargs, **kwargs}
+        if getattr(pipe.tokenizer, "chat_template", None) is not None:
+            output = pipe(msgs, return_full_text=False, **kwargs)
+            result = output[0]["generated_text"]
+        else:
+            prompt = "\n".join(m["content"] for m in msgs)
+            output = pipe(prompt, return_full_text=False, **kwargs)
+            result = output[0]["generated_text"]
+        return coerce_str(result)
+
+
+class CohereChat(BaseChat):
+    """reference: llms.py:544 — returns (response, cited docs) tuple."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = "command",
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        **cohere_kwargs,
+    ):
+        super().__init__(
+            executor=udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.kwargs = dict(cohere_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return arg_name in ("model", "temperature", "max_tokens")
+
+    async def __wrapped__(self, messages, docs, **kwargs) -> tuple:
+        import cohere  # optional dependency
+
+        msgs = _messages_to_list(messages)
+        if isinstance(docs, Json):
+            docs = docs.value
+        client = cohere.AsyncClient()
+        message = msgs[-1]["content"]
+        chat_history = msgs[:-1]
+        ret = await client.chat(
+            message=message, chat_history=chat_history, documents=docs,
+            **{**self.kwargs, **kwargs},
+        )
+        cited_docs = [dict(c.__dict__) for c in (ret.citations or [])]
+        return ret.text, cited_docs
+
+
+def prompt_chat_single_qa(question: ColumnExpression) -> ColumnExpression:
+    """Wrap a question column into a single-message chat tuple
+    (reference: llms.py:686)."""
+    from ...internals.expression import ApplyExpression, smart_wrap
+
+    def to_msg(q) -> Json:
+        return Json([{"role": "user", "content": coerce_str(q)}])
+
+    return ApplyExpression(to_msg, Json, smart_wrap(question))

@@ -1,0 +1,80 @@
+"""Deterministic fakes for xpack tests — no model, no network.
+
+reference: python/pathway/xpacks/llm/tests/mocks.py
+(``fake_embeddings_model``:5, ``IdentityMockChat``:16) plus the
+``FakeChatModel`` used across xpack tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ...internals import udfs
+from ...internals.udfs import UDF, udf
+from ...internals.value import Json
+from ._utils import coerce_str
+from .embedders import BaseEmbedder
+from .llms import BaseChat
+
+__all__ = [
+    "fake_embeddings_model",
+    "FakeEmbedder",
+    "IdentityMockChat",
+    "FakeChatModel",
+]
+
+
+def _fake_embedding(text: str, dim: int = 3) -> np.ndarray:
+    """Deterministic pseudo-embedding: hash-seeded unit vector.  Identical
+    strings map to identical vectors, so exact-match retrieval is testable."""
+    h = hashlib.blake2b(coerce_str(text).encode(), digest_size=8).digest()
+    rng = np.random.default_rng(int.from_bytes(h, "little"))
+    v = rng.standard_normal(dim).astype(np.float32)
+    return v / np.linalg.norm(v)
+
+
+@udf
+def fake_embeddings_model(x: str) -> np.ndarray:
+    """reference: tests/mocks.py:5"""
+    return _fake_embedding(x)
+
+
+class FakeEmbedder(BaseEmbedder):
+    """Class-form fake with a configurable dimension."""
+
+    def __init__(self, dim: int = 8):
+        super().__init__(deterministic=True)
+        self.dim = dim
+
+    def __wrapped__(self, input: str, **kwargs) -> np.ndarray:
+        return _fake_embedding(input, self.dim)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self.dim
+
+
+class IdentityMockChat(BaseChat):
+    """Echoes "model::last user message" (reference: tests/mocks.py:16)."""
+
+    def __init__(self, model: str = "mock"):
+        super().__init__(deterministic=True)
+        self.model = model
+
+    def __wrapped__(self, messages, model: str | None = None, **kwargs) -> str:
+        from .llms import _messages_to_list
+
+        msgs = _messages_to_list(messages)
+        return f"{model or self.model}::{msgs[-1]['content']}"
+
+
+class FakeChatModel(BaseChat):
+    """Returns a canned answer regardless of the prompt."""
+
+    def __init__(self, response: str = "Text"):
+        super().__init__(deterministic=True)
+        self.response = response
+
+    def __wrapped__(self, messages, **kwargs) -> str:
+        return self.response

@@ -1,0 +1,202 @@
+"""Parser UDFs — bytes -> list[(text, metadata)].
+
+reference: python/pathway/xpacks/llm/parsers.py — ``ParseUtf8``:53,
+``ParseUnstructured``:79, ``OpenParse``:235, ``ImageParser``:396,
+``SlideParser``:569, ``PypdfParser``:746.
+
+``Utf8Parser`` is the native default; the library-backed ones import their
+dependency lazily and raise a clear error when the library is missing from
+the image (no network installs here).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ...internals import udfs
+from ...internals.udfs import UDF
+
+__all__ = [
+    "Utf8Parser",
+    "ParseUtf8",
+    "UnstructuredParser",
+    "ParseUnstructured",
+    "PypdfParser",
+    "ImageParser",
+    "SlideParser",
+]
+
+
+class Utf8Parser(UDF):
+    """Decode UTF-8 bytes into one chunk (reference: parsers.py:53)."""
+
+    def __init__(self):
+        super().__init__(deterministic=True)
+
+    async def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        if isinstance(contents, str):
+            docs = contents
+        else:
+            docs = bytes(contents).decode("utf-8", errors="replace")
+        return [(docs, {})]
+
+
+ParseUtf8 = Utf8Parser  # reference keeps both names across versions
+
+
+class UnstructuredParser(UDF):
+    """unstructured-io partitioner (reference: parsers.py:79) — chunking
+    modes: single / elements / paged / basic / by_title."""
+
+    def __init__(
+        self,
+        mode: str = "single",
+        post_processors: list[Callable] | None = None,
+        **unstructured_kwargs,
+    ):
+        if mode not in ("single", "elements", "paged", "basic", "by_title"):
+            raise ValueError(
+                f"mode '{mode}' not supported; use single/elements/paged/basic/by_title"
+            )
+        super().__init__()
+        self.mode = mode
+        self.post_processors = post_processors or []
+        self.unstructured_kwargs = unstructured_kwargs
+
+    async def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        import io
+
+        import unstructured.partition.auto  # optional dependency
+
+        elements = unstructured.partition.auto.partition(
+            file=io.BytesIO(bytes(contents)), **{**self.unstructured_kwargs, **kwargs}
+        )
+        for el in elements:
+            for pp in self.post_processors:
+                el.apply(pp)
+
+        if self.mode == "single":
+            meta: dict = {}
+            text = "\n\n".join(str(el) for el in elements)
+            return [(text, meta)]
+        if self.mode in ("elements", "basic"):
+            out = []
+            for el in elements:
+                m = el.metadata.to_dict() if hasattr(el, "metadata") else {}
+                m["category"] = getattr(el, "category", None)
+                out.append((str(el), m))
+            return out
+        # paged / by_title: group elements by page / section
+        groups: dict[Any, list] = {}
+        for el in elements:
+            m = el.metadata.to_dict() if hasattr(el, "metadata") else {}
+            gk = m.get("page_number", 1)
+            groups.setdefault(gk, []).append(str(el))
+        return [
+            ("\n\n".join(parts), {"page_number": page})
+            for page, parts in sorted(groups.items(), key=lambda kv: str(kv[0]))
+        ]
+
+
+ParseUnstructured = UnstructuredParser
+
+
+class PypdfParser(UDF):
+    """pypdf text extraction, one chunk per page
+    (reference: parsers.py:746 w/ optional de-hyphenation cleanup)."""
+
+    def __init__(self, apply_text_cleanup: bool = True):
+        super().__init__()
+        self.apply_text_cleanup = apply_text_cleanup
+
+    async def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        import io
+
+        from pypdf import PdfReader  # optional dependency
+
+        reader = PdfReader(io.BytesIO(bytes(contents)))
+        out = []
+        for page_num, page in enumerate(reader.pages):
+            text = page.extract_text() or ""
+            if self.apply_text_cleanup:
+                text = _cleanup_pdf_text(text)
+            if text.strip():
+                out.append((text, {"page_number": page_num + 1}))
+        return out
+
+
+def _cleanup_pdf_text(text: str) -> str:
+    import re
+
+    text = re.sub(r"-\n(\w)", r"\1", text)  # de-hyphenate line breaks
+    text = re.sub(r"(?<!\n)\n(?!\n)", " ", text)  # unwrap soft newlines
+    return re.sub(r" {2,}", " ", text).strip()
+
+
+class _VisionParserBase(UDF):
+    """Shared shape of the LLM-vision parsers (reference: parsers.py:396
+    ImageParser / :569 SlideParser): describe each image/slide with a
+    multimodal chat UDF and emit the description as the chunk text."""
+
+    def __init__(self, llm, prompt: str, **kwargs):
+        super().__init__(executor=udfs.async_executor())
+        self.llm = llm
+        self.prompt = prompt
+        self.kwargs = kwargs
+
+    async def _describe(self, b64_image: str) -> str:
+        fn = getattr(self.llm, "__wrapped__", self.llm)
+        messages = (
+            {
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": self.prompt},
+                    {
+                        "type": "image_url",
+                        "image_url": {"url": f"data:image/jpeg;base64,{b64_image}"},
+                    },
+                ],
+            },
+        )
+        res = fn(messages)
+        import inspect
+
+        if inspect.iscoroutine(res):
+            res = await res
+        return str(res)
+
+
+class ImageParser(_VisionParserBase):
+    """reference: parsers.py:396"""
+
+    def __init__(self, llm, prompt: str = "Describe the image contents.", **kwargs):
+        super().__init__(llm, prompt, **kwargs)
+
+    async def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        import base64
+
+        b64 = base64.b64encode(bytes(contents)).decode()
+        return [(await self._describe(b64), {})]
+
+
+class SlideParser(_VisionParserBase):
+    """reference: parsers.py:569 — renders pdf slides to images first
+    (needs pdf2image in the environment)."""
+
+    def __init__(self, llm, prompt: str = "Describe the slide contents.", **kwargs):
+        super().__init__(llm, prompt, **kwargs)
+
+    async def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        import base64
+        import io
+
+        from pdf2image import convert_from_bytes  # optional dependency
+
+        pages = convert_from_bytes(bytes(contents))
+        out = []
+        for i, img in enumerate(pages):
+            buf = io.BytesIO()
+            img.save(buf, format="JPEG")
+            b64 = base64.b64encode(buf.getvalue()).decode()
+            out.append((await self._describe(b64), {"slide_number": i + 1}))
+        return out

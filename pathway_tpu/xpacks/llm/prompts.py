@@ -1,0 +1,189 @@
+"""Prompt-template UDFs.
+
+reference: python/pathway/xpacks/llm/prompts.py — ``prompt_qa``:141,
+``prompt_qa_geometric_rag``:194, citing QA + cited-response parsing
+:268/:316, ``prompt_summarize``:359, query rewrites / HyDE :382/:401,
+``RAGPromptTemplate`` protocol :61.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable
+
+from ...internals.udfs import udf
+from ...internals.value import Json
+from ._utils import coerce_str
+
+__all__ = [
+    "prompt_qa",
+    "prompt_short_qa",
+    "prompt_citing_qa",
+    "parse_cited_response",
+    "prompt_summarize",
+    "prompt_query_rewrite",
+    "prompt_query_rewrite_hyde",
+    "prompt_qa_geometric_rag",
+]
+
+
+def _docs_to_context(docs) -> str:
+    if isinstance(docs, Json):
+        docs = docs.value
+    parts: list[str] = []
+    for d in docs or ():
+        if isinstance(d, Json):
+            d = d.value
+        if isinstance(d, dict):
+            parts.append(coerce_str(d.get("text", d)))
+        else:
+            parts.append(coerce_str(d))
+    return "\n\n".join(parts)
+
+
+@udf
+def prompt_qa(
+    query: str,
+    docs,
+    information_not_found_response: str = "No information found.",
+    additional_rules: str = "",
+) -> str:
+    """reference: prompts.py:141"""
+    context = _docs_to_context(docs)
+    return (
+        "Please provide an answer based solely on the provided sources. "
+        "Keep your answer concise and accurate. Make sure that it starts "
+        "with an expression in standalone form.\n"
+        f"If you cannot answer from the sources, say: {information_not_found_response}\n"
+        f"{additional_rules}\n"
+        f"Sources:\n{context}\n"
+        f"Question: {query}\n"
+        "Answer:"
+    )
+
+
+@udf
+def prompt_short_qa(
+    query: str,
+    docs,
+    additional_rules: str = "",
+) -> str:
+    """Few-word answer variant (reference: prompts.py short-qa template)."""
+    context = _docs_to_context(docs)
+    return (
+        "Please provide an answer in a few words based solely on the "
+        "provided sources.\n"
+        f"{additional_rules}\n"
+        f"Sources:\n{context}\n"
+        f"Question: {query}\n"
+        "Answer:"
+    )
+
+
+def prompt_qa_geometric_rag(
+    query: str,
+    docs: Iterable,
+    information_not_found_response: str = "No information found.",
+    additional_rules: str = "",
+    strict_prompt: bool = False,
+) -> str:
+    """Plain function used inside the adaptive-RAG loop
+    (reference: prompts.py:194; called from
+    question_answering.answer_with_geometric_rag_strategy)."""
+    docs_str = "\n".join(
+        f"Source {i + 1}: {coerce_str(d)}" for i, d in enumerate(docs)
+    )
+    if strict_prompt:
+        rule = (
+            "Only answer with a short phrase taken from the sources, or "
+            f'exactly "{information_not_found_response}".'
+        )
+    else:
+        rule = f"If you cannot answer, reply: {information_not_found_response}"
+    return (
+        "Use the below articles to answer the subsequent question. "
+        f"{rule}\n{additional_rules}\n"
+        f"{docs_str}\n"
+        f"Question: {query}\n"
+        "Answer:"
+    )
+
+
+@udf
+def prompt_citing_qa(
+    query: str,
+    docs,
+    additional_rules: str = "",
+) -> str:
+    """reference: prompts.py:268"""
+    context = _docs_to_context(docs)
+    return (
+        "Please provide an answer based solely on the provided sources. "
+        "When referencing information from a source, cite the appropriate "
+        "source(s) using their corresponding numbers like [1], [2]. Every "
+        "answer should include at least one source citation.\n"
+        f"{additional_rules}\n"
+        f"Sources:\n{context}\n"
+        f"Question: {query}\n"
+        "Answer:"
+    )
+
+
+@udf
+def parse_cited_response(response: str, docs) -> Json:
+    """Split a cited answer into (answer, cited source indices)
+    (reference: prompts.py:316)."""
+    text = coerce_str(response)
+    cited = sorted({int(m) - 1 for m in re.findall(r"\[(\d+)\]", text)})
+    if isinstance(docs, Json):
+        docs = docs.value
+    docs = list(docs or ())
+    cited_docs = [
+        (d.value if isinstance(d, Json) else d)
+        for i, d in enumerate(docs)
+        if i in cited
+    ]
+    return Json(
+        {
+            "response": re.sub(r"\s*\[\d+\]", "", text).strip(),
+            "citations": cited,
+            "cited_docs": cited_docs,
+        }
+    )
+
+
+@udf
+def prompt_summarize(text_list) -> str:
+    """reference: prompts.py:359"""
+    if isinstance(text_list, Json):
+        text_list = text_list.value
+    text = "\n".join(coerce_str(t) for t in (text_list or ()))
+    return (
+        "Summarize the given texts, make sure the summary covers all the "
+        "texts:\n"
+        f"{text}\n"
+        "Summary:"
+    )
+
+
+@udf
+def prompt_query_rewrite(query: str, additional_rules: str = "") -> str:
+    """reference: prompts.py:382"""
+    return (
+        "Rewrite the following search query to be cleaner and more likely "
+        "to match relevant documents. Keep all the named entities.\n"
+        f"{additional_rules}\n"
+        f"Query: {coerce_str(query)}\n"
+        "Rewritten query:"
+    )
+
+
+@udf
+def prompt_query_rewrite_hyde(query: str) -> str:
+    """reference: prompts.py:401 (HyDE)"""
+    return (
+        "Write a short passage that plausibly answers the question below — "
+        "it will be used to search for relevant documents.\n"
+        f"Question: {coerce_str(query)}\n"
+        "Passage:"
+    )

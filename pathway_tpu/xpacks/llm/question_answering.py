@@ -1,0 +1,499 @@
+"""RAG question-answering pipelines.
+
+reference: python/pathway/xpacks/llm/question_answering.py —
+``BaseRAGQuestionAnswerer``:314 (``answer_query``:451 retrieve → context →
+prompt → LLM; ``summarize_query``:491; ``build_server``/``run_server``),
+``AdaptiveRAGQuestionAnswerer``:620 over
+``answer_with_geometric_rag_strategy[_from_index]``:97/:162 (geometric
+2,4,8,… document escalation), ``DeckRetriever``:736, ``RAGClient``:854.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression
+from ...internals.schema import Schema, column_definition
+from ...internals.table import Table
+from ...internals.thisclass import right
+from ...internals.udfs import udf
+from ...internals.value import Json
+from ._utils import RestClientBase, coerce_str
+from .llms import BaseChat, prompt_chat_single_qa
+from . import prompts
+from .vector_store import (
+    InputsQuerySchema,
+    RetrieveQuerySchema,
+    StatisticsQuerySchema,
+    _merge_filters,
+)
+
+__all__ = [
+    "BaseQuestionAnswerer",
+    "SummaryQuestionAnswerer",
+    "BaseRAGQuestionAnswerer",
+    "AdaptiveRAGQuestionAnswerer",
+    "answer_with_geometric_rag_strategy",
+    "answer_with_geometric_rag_strategy_from_index",
+    "DeckRetriever",
+    "RAGClient",
+]
+
+
+class AIResponseType:
+    SHORT = "short"
+    LONG = "long"
+
+
+# ---------------------------------------------------------------------------
+# abstract surface consumed by QARestServer (reference: question_answering.py
+# BaseQuestionAnswerer / SummaryQuestionAnswerer protocols)
+# ---------------------------------------------------------------------------
+
+
+class BaseQuestionAnswerer:
+    RetrieveQuerySchema = RetrieveQuerySchema
+    StatisticsQuerySchema = StatisticsQuerySchema
+    InputsQuerySchema = InputsQuerySchema
+
+    class AnswerQuerySchema(Schema):
+        prompt: str
+        filters: str | None = column_definition(default_value=None)
+        model: str | None = column_definition(default_value=None)
+        return_context_docs: bool = column_definition(default_value=False)
+        response_type: str = column_definition(default_value=AIResponseType.SHORT)
+
+    def answer_query(self, pw_ai_queries: Table) -> Table: ...
+
+    def retrieve(self, queries: Table) -> Table: ...
+
+    def statistics(self, queries: Table) -> Table: ...
+
+    def list_documents(self, queries: Table) -> Table: ...
+
+
+class SummaryQuestionAnswerer(BaseQuestionAnswerer):
+    class SummarizeQuerySchema(Schema):
+        text_list: Json
+        model: str | None = column_definition(default_value=None)
+
+    def summarize_query(self, summarize_queries: Table) -> Table: ...
+
+
+class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
+    """reference: question_answering.py:314"""
+
+    def __init__(
+        self,
+        llm: BaseChat,
+        indexer,  # VectorStoreServer | DocumentStore
+        *,
+        default_llm_name: str | None = None,
+        short_prompt_template=prompts.prompt_short_qa,
+        long_prompt_template=prompts.prompt_qa,
+        summarize_template=prompts.prompt_summarize,
+        search_topk: int = 6,
+    ):
+        self.llm = llm
+        self.indexer = indexer
+        self.default_llm_name = default_llm_name or getattr(llm, "model", None)
+        self.short_prompt_template = short_prompt_template
+        self.long_prompt_template = long_prompt_template
+        self.summarize_template = summarize_template
+        self.search_topk = search_topk
+        self.server: Any = None
+        self._pending_endpoints: list = []
+
+    # -- the 4-select answer pipeline (reference: :451-482) --
+    def answer_query(self, pw_ai_queries: Table) -> Table:
+        queries = pw_ai_queries.select(
+            prompt=pw_ai_queries.prompt,
+            filters=pw_ai_queries.filters,
+            model=ApplyExpression(
+                lambda m: m or self.default_llm_name,
+                dt.Optional(dt.STR),
+                pw_ai_queries.model,
+            ),
+            return_context_docs=pw_ai_queries.return_context_docs,
+            response_type=pw_ai_queries.response_type,
+        )
+        retrieve_table = queries.select(
+            query=queries.prompt,
+            k=ApplyExpression(lambda p: self.search_topk, dt.INT, queries.prompt),
+            metadata_filter=queries.filters,
+            filepath_globpattern=ApplyExpression(
+                lambda p: None, dt.Optional(dt.STR), queries.prompt
+            ),
+        )
+        docs_result = self.indexer.retrieve_query(retrieve_table)
+        with_docs = queries.with_universe_of(docs_result).select(
+            prompt=queries.prompt,
+            model=queries.model,
+            return_context_docs=queries.return_context_docs,
+            response_type=queries.response_type,
+            docs=ApplyExpression(
+                lambda r: tuple(
+                    d.get("text") if isinstance(d, dict) else d
+                    for d in (r.value if isinstance(r, Json) else r or ())
+                ),
+                dt.List(dt.STR),
+                docs_result.result,
+            ),
+        )
+
+        def pick_template(response_type):
+            if response_type == AIResponseType.LONG:
+                return self.long_prompt_template
+            return self.short_prompt_template
+
+        # both templates are UDFs; response_type is per-row, so build both
+        # and pick row-wise (the reference dispatches the same way)
+        prompted = with_docs.select(
+            prompt_short=self.short_prompt_template(
+                with_docs.prompt, with_docs.docs
+            ),
+            prompt_long=self.long_prompt_template(with_docs.prompt, with_docs.docs),
+            response_type=with_docs.response_type,
+            model=with_docs.model,
+            return_context_docs=with_docs.return_context_docs,
+            docs=with_docs.docs,
+        )
+        chosen = prompted.select(
+            rag_prompt=ApplyExpression(
+                lambda rt, s, l: l if rt == AIResponseType.LONG else s,
+                dt.STR,
+                prompted.response_type,
+                prompted.prompt_short,
+                prompted.prompt_long,
+            ),
+            model=prompted.model,
+            return_context_docs=prompted.return_context_docs,
+            docs=prompted.docs,
+        )
+        answered = chosen.select(
+            response=self.llm(
+                prompt_chat_single_qa(chosen.rag_prompt), model=chosen.model
+            ),
+            return_context_docs=chosen.return_context_docs,
+            docs=chosen.docs,
+        )
+
+        def pack(response, return_context_docs, docs) -> Json:
+            out: dict = {"response": coerce_str(response)}
+            if return_context_docs:
+                out["context_docs"] = [coerce_str(d) for d in (docs or ())]
+            return Json(out)
+
+        return answered.select(
+            result=ApplyExpression(
+                pack, Json, answered.response, answered.return_context_docs,
+                answered.docs,
+            )
+        )
+
+    # -- summarize (reference: :491) --
+    def summarize_query(self, summarize_queries: Table) -> Table:
+        queries = summarize_queries.select(
+            text_list=summarize_queries.text_list,
+            model=ApplyExpression(
+                lambda m: m or self.default_llm_name,
+                dt.Optional(dt.STR),
+                summarize_queries.model,
+            ),
+        )
+        prompted = queries.select(
+            prompt=self.summarize_template(queries.text_list),
+            model=queries.model,
+        )
+        return prompted.select(
+            result=self.llm(prompt_chat_single_qa(prompted.prompt), model=prompted.model)
+        )
+
+    # -- passthrough endpoints --
+    def retrieve(self, queries: Table) -> Table:
+        return self.indexer.retrieve_query(queries)
+
+    def statistics(self, queries: Table) -> Table:
+        return self.indexer.statistics_query(queries)
+
+    def list_documents(self, queries: Table) -> Table:
+        return self.indexer.inputs_query(queries)
+
+    # -- serving (reference: build_server/run_server) --
+    def build_server(self, host: str, port: int, **rest_kwargs) -> None:
+        from .servers import QASummaryRestServer
+
+        self.server = QASummaryRestServer(host, port, self, **rest_kwargs)
+
+    def run_server(self, host: str = "0.0.0.0", port: int = 8000, **kwargs):
+        if self.server is None:
+            self.build_server(host=host, port=port)
+        return self.server.run(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# adaptive RAG (reference: :97-162, :620)
+# ---------------------------------------------------------------------------
+
+_NO_INFO = "No information found."
+
+
+def answer_with_geometric_rag_strategy(
+    questions: Table,
+    documents,  # ColumnReference to a list-of-docs column on `questions`
+    llm_chat_model: BaseChat,
+    n_starting_documents: int = 2,
+    factor: int = 2,
+    max_iterations: int = 4,
+    strict_prompt: bool = False,
+) -> Table:
+    """Ask with 2, 4, 8, … context documents until the model answers
+    (reference: question_answering.py:97).  Each escalation round runs only
+    for the still-unanswered questions — chained filters, no fixpoint
+    operator needed, exactly like the reference."""
+    base = questions.select(question=questions.prompt, docs=documents)
+    n_documents = n_starting_documents
+    answered_tables: list[Table] = []
+    remaining = base
+    def make_prompt_udf(n: int):
+        @udf
+        def build_prompt(question: str, docs) -> str:
+            doc_list = [coerce_str(d) for d in (docs or ())][:n]
+            return prompts.prompt_qa_geometric_rag(
+                question, doc_list,
+                information_not_found_response=_NO_INFO,
+                strict_prompt=strict_prompt,
+            )
+
+        return build_prompt
+
+    for _ in range(max_iterations):
+        build_prompt = make_prompt_udf(n_documents)
+        asked = remaining.select(
+            question=remaining.question,
+            docs=remaining.docs,
+            answer=llm_chat_model(
+                prompt_chat_single_qa(build_prompt(remaining.question, remaining.docs))
+            ),
+        )
+        found = asked.filter(
+            ApplyExpression(
+                lambda a: a is not None and coerce_str(a).strip() != _NO_INFO
+                and coerce_str(a).strip() != "",
+                dt.BOOL,
+                asked.answer,
+            )
+        )
+        answered_tables.append(found.select(result=found.answer))
+        remaining = asked.filter(
+            ApplyExpression(
+                lambda a: a is None or coerce_str(a).strip() == _NO_INFO
+                or coerce_str(a).strip() == "",
+                dt.BOOL,
+                asked.answer,
+            )
+        ).select(question=asked.question, docs=asked.docs)
+        n_documents *= factor
+    giving_up = remaining.select(
+        result=ApplyExpression(lambda q: _NO_INFO, dt.STR, remaining.question)
+    )
+    result = answered_tables[0]
+    return result.concat(*answered_tables[1:], giving_up)
+
+
+def answer_with_geometric_rag_strategy_from_index(
+    questions: Table,
+    index,  # DataIndex
+    documents_column: str,
+    llm_chat_model: BaseChat,
+    n_starting_documents: int = 2,
+    factor: int = 2,
+    max_iterations: int = 4,
+    metadata_filter=None,
+    strict_prompt: bool = False,
+) -> Table:
+    """reference: question_answering.py:162 — one index query fetches the
+    max escalation depth, the strategy then slices locally."""
+    max_docs = n_starting_documents * factor ** (max_iterations - 1)
+    res = index.query_as_of_now(
+        questions.prompt,
+        number_of_matches=max_docs,
+        metadata_filter=metadata_filter,
+        collapse_rows=True,
+    )
+    with_docs = res.select(prompt=questions.prompt, docs=right[documents_column])
+    return answer_with_geometric_rag_strategy(
+        with_docs.select(prompt=with_docs.prompt),
+        with_docs.docs,
+        llm_chat_model,
+        n_starting_documents=n_starting_documents,
+        factor=factor,
+        max_iterations=max_iterations,
+        strict_prompt=strict_prompt,
+    )
+
+
+class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
+    """reference: question_answering.py:620"""
+
+    def __init__(
+        self,
+        llm: BaseChat,
+        indexer,
+        *,
+        default_llm_name: str | None = None,
+        summarize_template=prompts.prompt_summarize,
+        n_starting_documents: int = 2,
+        factor: int = 2,
+        max_iterations: int = 4,
+        strict_prompt: bool = False,
+    ):
+        super().__init__(
+            llm, indexer,
+            default_llm_name=default_llm_name,
+            summarize_template=summarize_template,
+        )
+        self.n_starting_documents = n_starting_documents
+        self.factor = factor
+        self.max_iterations = max_iterations
+        self.strict_prompt = strict_prompt
+
+    def answer_query(self, pw_ai_queries: Table) -> Table:
+        max_docs = self.n_starting_documents * self.factor ** (
+            self.max_iterations - 1
+        )
+        retrieve_table = pw_ai_queries.select(
+            query=pw_ai_queries.prompt,
+            k=ApplyExpression(lambda p: max_docs, dt.INT, pw_ai_queries.prompt),
+            metadata_filter=pw_ai_queries.filters,
+            filepath_globpattern=ApplyExpression(
+                lambda p: None, dt.Optional(dt.STR), pw_ai_queries.prompt
+            ),
+        )
+        docs_result = self.indexer.retrieve_query(retrieve_table)
+        with_docs = pw_ai_queries.with_universe_of(docs_result).select(
+            prompt=pw_ai_queries.prompt,
+            docs=ApplyExpression(
+                lambda r: tuple(
+                    d.get("text") if isinstance(d, dict) else d
+                    for d in (r.value if isinstance(r, Json) else r or ())
+                ),
+                dt.List(dt.STR),
+                docs_result.result,
+            ),
+        )
+        answers = answer_with_geometric_rag_strategy(
+            with_docs,
+            with_docs.docs,
+            self.llm,
+            n_starting_documents=self.n_starting_documents,
+            factor=self.factor,
+            max_iterations=self.max_iterations,
+            strict_prompt=self.strict_prompt,
+        )
+        # restore the query universe for the response writer
+        packed = answers.select(
+            result=ApplyExpression(
+                lambda a: Json({"response": coerce_str(a)}), Json, answers.result
+            )
+        )
+        return pw_ai_queries.with_universe_of(packed).select(result=packed.result)
+
+
+class DeckRetriever(BaseRAGQuestionAnswerer):
+    """Slide-deck retrieval app (reference: question_answering.py:736)."""
+
+    excluded_response_metadata = ["b64_image"]
+
+    def answer_query(self, pw_ai_queries: Table) -> Table:
+        retrieve_table = pw_ai_queries.select(
+            query=pw_ai_queries.prompt,
+            k=ApplyExpression(lambda p: self.search_topk, dt.INT, pw_ai_queries.prompt),
+            metadata_filter=pw_ai_queries.filters,
+            filepath_globpattern=ApplyExpression(
+                lambda p: None, dt.Optional(dt.STR), pw_ai_queries.prompt
+            ),
+        )
+        docs = self.indexer.retrieve_query(retrieve_table)
+
+        def strip_meta(r) -> Json:
+            out = []
+            for d in r.value if isinstance(r, Json) else (r or ()):
+                if isinstance(d, dict):
+                    d = dict(d)
+                    meta = d.get("metadata") or {}
+                    d["metadata"] = {
+                        k: v for k, v in meta.items()
+                        if k not in self.excluded_response_metadata
+                    }
+                out.append(d)
+            return Json(out)
+
+        return docs.select(
+            result=ApplyExpression(strip_meta, Json, docs.result)
+        )
+
+
+# ---------------------------------------------------------------------------
+# client (reference: question_answering.py:854)
+# ---------------------------------------------------------------------------
+
+
+class RAGClient(RestClientBase):
+    """HTTP client for QARestServer/QASummaryRestServer."""
+
+    def __init__(self, *args, timeout: float = 90.0, **kwargs):
+        super().__init__(*args, timeout=timeout, **kwargs)
+
+    def retrieve(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ):
+        return self._post(
+            "/v1/retrieve",
+            {
+                "query": query,
+                "k": k,
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+        )
+
+    def statistics(self):
+        return self._post("/v1/statistics", {})
+
+    def pw_list_documents(self, filters: str | None = None, keys: list | None = None):
+        return self._post("/v1/pw_list_documents", {"metadata_filter": filters})
+
+    def pw_ai_answer(
+        self,
+        prompt: str,
+        filters: str | None = None,
+        model: str | None = None,
+        return_context_docs: bool = False,
+        response_type: str = AIResponseType.SHORT,
+    ):
+        payload: dict = {
+            "prompt": prompt,
+            "return_context_docs": return_context_docs,
+            "response_type": response_type,
+        }
+        if filters is not None:
+            payload["filters"] = filters
+        if model is not None:
+            payload["model"] = model
+        return self._post("/v1/pw_ai_answer", payload)
+
+    answer = pw_ai_answer
+
+    def pw_ai_summary(self, text_list: list[str], model: str | None = None):
+        payload: dict = {"text_list": text_list}
+        if model is not None:
+            payload["model"] = model
+        return self._post("/v1/pw_ai_summary", payload)
+
+    summarize = pw_ai_summary

@@ -1,0 +1,200 @@
+"""Reranker UDFs.
+
+reference: python/pathway/xpacks/llm/rerankers.py —
+``rerank_topk_filter``:14, ``LLMReranker``:58 (1–5 scoring),
+``CrossEncoderReranker``:186 (sentence-transformers CrossEncoder — the
+north-star config), ``EncoderReranker``:251, ``FlashRankReranker``:319.
+
+TPU design: ``CrossEncoderReranker`` runs the flax cross-encoder
+(models/cross_encoder.py) — (query, doc) pairs arriving concurrently in one
+micro-batch coalesce into one padded device batch, same pattern as the
+embedder.  ``EncoderReranker`` scores with the sentence encoder's dot
+products (bi-encoder rescoring).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+
+from ...internals import udfs
+from ...internals.udfs import UDF, udf
+from ...internals.value import Json
+from ._utils import AsyncMicroBatcher, coerce_str
+
+__all__ = [
+    "rerank_topk_filter",
+    "LLMReranker",
+    "CrossEncoderReranker",
+    "EncoderReranker",
+    "FlashRankReranker",
+]
+
+
+@udf
+def rerank_topk_filter(docs, scores, k: int = 5) -> tuple:
+    """Keep the k best (doc, score) pairs (reference: rerankers.py:14).
+    Returns (docs_tuple, scores_tuple)."""
+    if isinstance(docs, Json):
+        docs = docs.value
+    if isinstance(scores, Json):
+        scores = scores.value
+    docs = list(docs or ())
+    scores = [float(s) for s in (scores or ())]
+    order = sorted(range(len(docs)), key=lambda i: -scores[i])[:k]
+    return tuple(docs[i] for i in order), tuple(scores[i] for i in order)
+
+
+class LLMReranker(UDF):
+    """Ask a chat model to rate relevance 1-5 (reference: rerankers.py:58;
+    there the score is extracted via logit_bias + single-token decoding —
+    provider-specific, so the parse here accepts any leading number)."""
+
+    def __init__(
+        self,
+        llm,
+        *,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        use_logit_bias: bool | None = None,
+    ):
+        super().__init__(
+            executor=udfs.async_executor(retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.llm = llm
+        self.use_logit_bias = use_logit_bias
+
+    async def __wrapped__(self, doc: str, query: str, **kwargs) -> float:
+        prompt = (
+            "Given a query and a document, rate on a scale from 1 to 5 how "
+            "relevant the document is to the query. Respond with only the "
+            "number.\n"
+            f"Document: {coerce_str(doc)}\n"
+            f"Query: {coerce_str(query)}\n"
+            "Score:"
+        )
+        fn = getattr(self.llm, "__wrapped__", self.llm)
+        res = fn(({"role": "user", "content": prompt},))
+        import inspect
+
+        if inspect.iscoroutine(res):
+            res = await res
+        import re
+
+        m = re.search(r"[1-5](\.\d+)?", coerce_str(res))
+        if m is None:
+            raise ValueError(f"reranker LLM returned unparsable score: {res!r}")
+        return float(m.group(0))
+
+
+class CrossEncoderReranker(UDF):
+    """Pointwise cross-encoder scoring on TPU (reference: rerankers.py:186).
+
+    ``model_name`` keeps the reference's signature; the geometry is the
+    MiniLM-class flax cross-encoder.  Pass ``cross_encoder=`` to supply a
+    ready :class:`pathway_tpu.models.cross_encoder.CrossEncoder`.
+    """
+
+    def __init__(
+        self,
+        model_name: str = "cross-encoder/ms-marco-MiniLM-L-6-v2",
+        *,
+        cross_encoder: Any = None,
+        max_batch: int = 1024,
+        **init_kwargs,
+    ):
+        super().__init__(executor=udfs.async_executor(), deterministic=True)
+        self.model_name = model_name
+        self._model = cross_encoder
+        self._batcher: AsyncMicroBatcher | None = None
+        self._max_batch = max_batch
+        self._init_kwargs = init_kwargs
+
+    def _ensure_model(self):
+        if self._model is None:
+            from ...models.cross_encoder import CrossEncoder
+
+            self._model = CrossEncoder(self.model_name, **self._init_kwargs)
+        if self._batcher is None:
+            model = self._model
+
+            def batch_score(pairs: list[tuple[str, str]]) -> list[float]:
+                return [float(s) for s in model.predict(pairs)]
+
+            self._batcher = AsyncMicroBatcher(batch_score, max_batch=self._max_batch)
+        return self._model
+
+    async def __wrapped__(self, doc: str, query: str, **kwargs) -> float:
+        self._ensure_model()
+        return await self._batcher.call((coerce_str(query), coerce_str(doc)))
+
+
+class EncoderReranker(UDF):
+    """Bi-encoder rescoring with the sentence encoder's embeddings
+    (reference: rerankers.py:251)."""
+
+    def __init__(
+        self,
+        model_name: str = "all-MiniLM-L6-v2",
+        *,
+        encoder: Any = None,
+        max_batch: int = 1024,
+        **init_kwargs,
+    ):
+        super().__init__(executor=udfs.async_executor(), deterministic=True)
+        self.model_name = model_name
+        self._encoder = encoder
+        self._batcher: AsyncMicroBatcher | None = None
+        self._max_batch = max_batch
+        self._init_kwargs = init_kwargs
+
+    def _ensure(self):
+        if self._encoder is None:
+            from ...models.encoder import SentenceEncoder
+
+            self._encoder = SentenceEncoder(self.model_name, **self._init_kwargs)
+        if self._batcher is None:
+            enc = self._encoder
+
+            def batch_score(pairs: list[tuple[str, str]]) -> list[float]:
+                # embeddings are L2-normalized: dot = cosine similarity
+                queries = enc.encode([q for q, _ in pairs])
+                docs = enc.encode([d for _, d in pairs])
+                return [float(np.dot(q, d)) for q, d in zip(queries, docs)]
+
+            self._batcher = AsyncMicroBatcher(batch_score, max_batch=self._max_batch)
+
+    async def __wrapped__(self, doc: str, query: str, **kwargs) -> float:
+        self._ensure()
+        return await self._batcher.call((coerce_str(query), coerce_str(doc)))
+
+
+class FlashRankReranker(UDF):
+    """flashrank listwise reranker (reference: rerankers.py:319) — needs the
+    flashrank library in the image."""
+
+    def __init__(self, model: str = "ms-marco-TinyBERT-L-2-v2", **kwargs):
+        super().__init__(executor=udfs.async_executor())
+        self.model = model
+        self.kwargs = kwargs
+        self._ranker = None
+
+    def _ensure(self):
+        if self._ranker is None:
+            from flashrank import Ranker  # optional dependency
+
+            self._ranker = Ranker(model_name=self.model, **self.kwargs)
+        return self._ranker
+
+    async def __wrapped__(self, doc: str, query: str, **kwargs) -> float:
+        from flashrank import RerankRequest  # optional dependency
+
+        ranker = self._ensure()
+        req = RerankRequest(
+            query=coerce_str(query), passages=[{"text": coerce_str(doc)}]
+        )
+        results = ranker.rerank(req)
+        return float(results[0]["score"])

@@ -1,0 +1,106 @@
+"""Splitter UDFs — document chunking.
+
+reference: python/pathway/xpacks/llm/splitters.py — ``null_splitter``:12,
+``TokenCountSplitter``:34 (tiktoken-based, min/max token window with
+punctuation-aware cut points).
+
+The chunker works over *character spans* of the original text: tiktoken
+provides them via ``decode_with_offsets`` when importable; otherwise a
+regex word tokenizer supplies the spans.  Either way the emitted chunks are
+exact substrings of the input (the reference re-decodes token slices, which
+can mangle e.g. split multi-byte sequences).
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+
+from ...internals.udfs import UDF
+from ._utils import coerce_str
+
+__all__ = ["NullSplitter", "null_splitter", "TokenCountSplitter"]
+
+_WORD_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+_CUT_RE = re.compile(r"[.?!\n]")
+
+
+def null_splitter(txt: str) -> list[tuple[str, dict]]:
+    """One chunk per document, no metadata (reference: splitters.py:12)."""
+    return [(coerce_str(txt), {})]
+
+
+class NullSplitter(UDF):
+    """UDF form of :func:`null_splitter`."""
+
+    def __init__(self):
+        super().__init__(deterministic=True)
+
+    def __wrapped__(self, txt: str, **kwargs) -> list[tuple[str, dict]]:
+        return null_splitter(txt)
+
+
+def _token_spans(text: str, encoding_name: str) -> list[tuple[int, int]]:
+    """(start, end) character span per token."""
+    try:
+        import tiktoken
+
+        enc = tiktoken.get_encoding(encoding_name)
+        tokens = enc.encode(text)
+        _, offsets = enc.decode_with_offsets(tokens)
+        spans = []
+        for i, start in enumerate(offsets):
+            end = offsets[i + 1] if i + 1 < len(offsets) else len(text)
+            spans.append((start, end))
+        return spans
+    except Exception:
+        return [(m.start(), m.end()) for m in _WORD_RE.finditer(text)]
+
+
+class TokenCountSplitter(UDF):
+    """Split text into chunks of [min_tokens, max_tokens] tokens, preferring
+    to cut just after sentence punctuation (reference: splitters.py:34)."""
+
+    def __init__(
+        self,
+        min_tokens: int = 50,
+        max_tokens: int = 500,
+        encoding_name: str = "cl100k_base",
+    ):
+        super().__init__(deterministic=True)
+        self.min_tokens = min_tokens
+        self.max_tokens = max_tokens
+        self.encoding_name = encoding_name
+
+    def __wrapped__(self, txt: str, **kwargs) -> list[tuple[str, dict]]:
+        text = _normalize(coerce_str(txt))
+        spans = _token_spans(text, self.encoding_name)
+        if not spans:
+            return []
+        ends = [e for _, e in spans]
+        output: list[tuple[str, dict]] = []
+        i = 0
+        while i < len(spans):
+            window = spans[i : i + self.max_tokens]
+            chunk_start = window[0][0]
+            chunk_end = window[-1][1]
+            cut = chunk_end
+            if i + self.max_tokens < len(spans):
+                # last punctuation cut point keeping >= min_tokens tokens
+                best = -1
+                for m in _CUT_RE.finditer(text, chunk_start, chunk_end):
+                    n_tokens = bisect.bisect_right(ends, m.end()) - i
+                    if n_tokens >= self.min_tokens:
+                        best = m.end()
+                if best > 0:
+                    cut = best
+            piece = text[chunk_start:cut].strip()
+            if piece:
+                output.append((piece, {}))
+            consumed = bisect.bisect_right(ends, cut) - i
+            i += max(consumed, 1)
+        return output
+
+
+def _normalize(text: str) -> str:
+    return re.sub(r"\n{3,}", "\n\n", text.replace("\r\n", "\n"))

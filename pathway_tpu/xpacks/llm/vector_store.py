@@ -1,0 +1,361 @@
+"""VectorStoreServer — live document indexing + retrieval serving.
+
+reference: python/pathway/xpacks/llm/vector_store.py —
+``VectorStoreServer``:39 (pipeline ``_build_graph``:227: sources → parse →
+flatten → post-process → split → flatten → index:289; stats reduce :303;
+REST endpoints ``/v1/retrieve|statistics|inputs`` :523-556;
+``run_server``:558), ``VectorStoreClient``:651, LangChain :92 /
+LlamaIndex :136 adapters.
+
+TPU shape: chunks stream through the jit-compiled embedder (one padded
+device batch per engine micro-batch) into the HBM-resident KNN index
+(ops/knn.py); queries ride the same as-of-now external-index operator the
+reference uses (updates-before-queries per timestamp, lowering.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ...internals import dtype as dt
+from ...internals import reducers
+from ...internals.expression import ApplyExpression
+from ...internals.schema import Schema, column_definition
+from ...internals.table import Table
+from ...internals.udfs import udf
+from ...internals.value import Json
+from ...stdlib.indexing.data_index import DataIndex
+from ...stdlib.indexing.retrievers import UsearchKnnFactory
+from ._utils import RestClientBase, coerce_str, run_with_cache
+from .parsers import Utf8Parser
+from .splitters import null_splitter
+
+__all__ = ["VectorStoreServer", "VectorStoreClient", "SlidesVectorStoreServer"]
+
+
+# ---------------------------------------------------------------------------
+# query schemas (reference: vector_store.py RetrieveQuerySchema et al.)
+# ---------------------------------------------------------------------------
+
+
+class RetrieveQuerySchema(Schema):
+    query: str
+    k: int = column_definition(default_value=3)
+    metadata_filter: str | None = column_definition(default_value=None)
+    filepath_globpattern: str | None = column_definition(default_value=None)
+
+
+class StatisticsQuerySchema(Schema):
+    req: str | None = column_definition(default_value=None)
+
+
+class InputsQuerySchema(Schema):
+    metadata_filter: str | None = column_definition(default_value=None)
+    filepath_globpattern: str | None = column_definition(default_value=None)
+
+
+class QueryResultSchema(Schema):
+    result: Json
+
+
+@udf
+def _merge_filters(metadata_filter: str | None, filepath_globpattern: str | None) -> str | None:
+    """Combine the two request filters into one expression
+    (reference: vector_store.py:358 ``merge_filters``)."""
+    parts = []
+    if metadata_filter:
+        parts.append(f"({metadata_filter})")
+    if filepath_globpattern:
+        parts.append(f"globmatch('{filepath_globpattern}', path)")
+    return " && ".join(parts) if parts else None
+
+
+from ._pipeline import build_document_pipeline, component_expr as _component_expr
+
+
+class VectorStoreServer:
+    """reference: vector_store.py:39"""
+
+    def __init__(
+        self,
+        *docs: Table,
+        embedder: Callable | None = None,
+        parser: Callable | None = None,
+        splitter: Callable | None = None,
+        doc_post_processors: list[Callable] | None = None,
+        index_factory: Any = None,
+    ):
+        self.docs = list(docs)
+        self.embedder = embedder
+        self.parser = parser if parser is not None else Utf8Parser()
+        self.splitter = splitter if splitter is not None else null_splitter
+        self.doc_post_processors = [p for p in (doc_post_processors or []) if p is not None]
+        if index_factory is None:
+            if embedder is None:
+                raise ValueError("provide embedder= or index_factory=")
+            index_factory = UsearchKnnFactory(embedder=embedder)
+        self.index_factory = index_factory
+        self._graph = self._build_graph()
+
+    # -- classmethod adapters (reference: vector_store.py:92,136) --
+    @classmethod
+    def from_langchain_components(
+        cls, *docs, embedder, parser=None, splitter=None, **kwargs
+    ) -> "VectorStoreServer":
+        """Wrap LangChain embeddings + text splitter."""
+
+        @udf
+        async def generic_embedder(x: str):
+            import numpy as np
+
+            res = await embedder.aembed_query(coerce_str(x))
+            return np.asarray(res)
+
+        generic_splitter = None
+        if splitter is not None:
+            generic_splitter = lambda x: [  # noqa: E731
+                (c, {}) for c in splitter.split_text(coerce_str(x))
+            ]
+        return cls(
+            *docs, embedder=generic_embedder, parser=parser,
+            splitter=generic_splitter, **kwargs,
+        )
+
+    @classmethod
+    def from_llamaindex_components(
+        cls, *docs, transformations: list, parser=None, **kwargs
+    ) -> "VectorStoreServer":
+        """Wrap a LlamaIndex embedding + node-parser transformation chain."""
+        try:
+            from llama_index.core.base.embeddings.base import BaseEmbedding
+            from llama_index.core.node_parser.interface import TextSplitter
+        except ImportError as exc:  # pragma: no cover - optional dependency
+            raise ImportError("llama-index-core is required") from exc
+
+        embedders_ = [t for t in transformations if isinstance(t, BaseEmbedding)]
+        if len(embedders_) != 1:
+            raise ValueError("transformations must include exactly one embedder")
+        embedder = embedders_[0]
+
+        @udf
+        async def generic_embedder(x: str):
+            import numpy as np
+
+            return np.asarray(await embedder.aget_text_embedding(coerce_str(x)))
+
+        splitters_ = [t for t in transformations if isinstance(t, TextSplitter)]
+        generic_splitter = None
+        if splitters_:
+            sp = splitters_[0]
+            generic_splitter = lambda x: [(c, {}) for c in sp.split_text(coerce_str(x))]  # noqa: E731
+        return cls(
+            *docs, embedder=generic_embedder, parser=parser,
+            splitter=generic_splitter, **kwargs,
+        )
+
+    # -- pipeline (reference: vector_store.py:227 _build_graph) --
+    def _build_graph(self) -> dict:
+        graph = build_document_pipeline(
+            self.docs, self.parser, self.splitter, self.doc_post_processors
+        )
+        graph["index"] = DataIndex(
+            graph["chunked_docs"],
+            self.index_factory,
+            data_column=graph["chunked_docs"].text,
+            metadata_column=graph["chunked_docs"].metadata,
+            embedder=self.embedder,
+        )
+        return graph
+
+    # -- embedding dimension probe (reference: vector_store.py embedder probe) --
+    @property
+    def embedding_dimension(self) -> int:
+        factory = self.index_factory
+        return factory._resolve_dim(getattr(factory, "dimensions", None), self.embedder)
+
+    # -- query pipelines --
+    def retrieve_query(self, retrieval_queries: Table) -> Table:
+        """reference: vector_store.py:439"""
+        queries = retrieval_queries.select(
+            query=retrieval_queries.query,
+            k=retrieval_queries.k,
+            metadata_filter=_merge_filters(
+                retrieval_queries.metadata_filter,
+                retrieval_queries.filepath_globpattern,
+            ),
+        )
+        index: DataIndex = self._graph["index"]
+        res = index.query_as_of_now(
+            queries.query,
+            number_of_matches=queries.k,
+            metadata_filter=queries.metadata_filter,
+            collapse_rows=True,
+        )
+
+        def pack(texts, metas, scores) -> Json:
+            out = []
+            for t, m, s in zip(texts or (), metas or (), scores or ()):
+                out.append(
+                    {
+                        "text": coerce_str(t),
+                        "metadata": m.value if isinstance(m, Json) else m,
+                        "dist": -float(s),
+                    }
+                )
+            return Json(out)
+
+        from ...internals.thisclass import right
+
+        return res.select(
+            result=ApplyExpression(
+                pack,
+                Json,
+                right.text,
+                right.metadata,
+                right["_pw_index_reply_score"],
+            )
+        )
+
+    def statistics_query(self, info_queries: Table) -> Table:
+        """reference: vector_store.py statistics endpoint"""
+        stats = self._graph["stats"]
+
+        def pack_stats(count, last_modified, last_indexed) -> Json:
+            return Json(
+                {
+                    "file_count": int(count or 0),
+                    "last_modified": last_modified,
+                    "last_indexed": last_indexed,
+                }
+            )
+
+        joined = info_queries.join_left(stats, id=info_queries.id).select(
+            result=ApplyExpression(
+                pack_stats, Json, stats.count, stats.last_modified, stats.last_indexed
+            )
+        )
+        return joined
+
+    def inputs_query(self, input_queries: Table) -> Table:
+        """reference: vector_store.py inputs endpoint"""
+        docs = self._graph["parsed_docs"]
+        all_meta = docs.reduce(
+            metadatas=reducers.tuple(docs.metadata),
+        )
+
+        @udf
+        def format_inputs(metadatas, metadata_filter: str | None) -> Json:
+            from ...utils.jmespath_lite import compile_filter
+
+            metas = [m.value if isinstance(m, Json) else m for m in (metadatas or ())]
+            if metadata_filter:
+                flt = compile_filter(metadata_filter)
+                metas = [m for m in metas if flt(m)]
+            return Json(metas)
+
+        queries = input_queries.select(
+            metadata_filter=_merge_filters(
+                input_queries.metadata_filter, input_queries.filepath_globpattern
+            )
+        )
+        return queries.join_left(all_meta, id=queries.id).select(
+            result=format_inputs(all_meta.metadatas, queries.metadata_filter)
+        )
+
+    # -- serving (reference: vector_store.py:523-582) --
+    def build_server(self, host: str, port: int, **rest_kwargs) -> None:
+        from ...io.http import PathwayWebserver, rest_connector
+
+        webserver = PathwayWebserver(host=host, port=port)
+        self._webserver = webserver
+
+        retrieval_queries, retrieval_writer = rest_connector(
+            webserver=webserver,
+            route="/v1/retrieve",
+            schema=RetrieveQuerySchema,
+            methods=("GET", "POST"),
+            delete_completed_queries=True,
+        )
+        retrieval_writer(self.retrieve_query(retrieval_queries))
+
+        stats_queries, stats_writer = rest_connector(
+            webserver=webserver,
+            route="/v1/statistics",
+            schema=StatisticsQuerySchema,
+            methods=("GET", "POST"),
+            delete_completed_queries=True,
+        )
+        stats_writer(self.statistics_query(stats_queries))
+
+        input_queries, inputs_writer = rest_connector(
+            webserver=webserver,
+            route="/v1/inputs",
+            schema=InputsQuerySchema,
+            methods=("GET", "POST"),
+            delete_completed_queries=True,
+        )
+        inputs_writer(self.inputs_query(input_queries))
+
+    def run_server(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        threaded: bool = False,
+        with_cache: bool = True,
+        cache_backend: Any = None,
+        terminate_on_error: bool = True,
+    ):
+        """Start serving; ``threaded=True`` runs the engine loop on a daemon
+        thread and returns it (reference: vector_store.py:558-582)."""
+        self.build_server(host=host, port=port)
+        return run_with_cache(
+            threaded=threaded,
+            with_cache=with_cache,
+            cache_backend=cache_backend,
+            terminate_on_error=terminate_on_error,
+        )
+
+
+class SlidesVectorStoreServer(VectorStoreServer):
+    """Parity alias for the slide-deck flavor (reference:
+    vector_store.py SlidesVectorStoreServer)."""
+
+
+class VectorStoreClient(RestClientBase):
+    """HTTP client for :class:`VectorStoreServer`
+    (reference: vector_store.py:651)."""
+
+    def __init__(self, *args, timeout: float = 15.0, **kwargs):
+        super().__init__(*args, timeout=timeout, **kwargs)
+
+    def query(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list[dict]:
+        payload = {"query": query, "k": k}
+        if metadata_filter is not None:
+            payload["metadata_filter"] = metadata_filter
+        if filepath_globpattern is not None:
+            payload["filepath_globpattern"] = filepath_globpattern
+        return self._post("/v1/retrieve", payload)
+
+    __call__ = query
+
+    def get_vectorstore_statistics(self) -> dict:
+        return self._post("/v1/statistics", {})
+
+    def get_input_files(
+        self,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list:
+        return self._post(
+            "/v1/inputs",
+            {
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+        )

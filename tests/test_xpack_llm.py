@@ -1,0 +1,227 @@
+"""LLM xpack component tests.
+
+Modeled on the reference's xpack unit tier (xpacks/llm/tests/) — fakes
+only, no network, no real models (tests/mocks.py pattern).
+"""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+from pathway_tpu.xpacks.llm import llms, mocks, prompts, rerankers, splitters
+from pathway_tpu.xpacks.llm._utils import AsyncMicroBatcher
+from pathway_tpu.xpacks.llm.parsers import Utf8Parser
+
+
+def _col(table, name="result"):
+    _, cols = dbg.table_to_dicts(table)
+    return list(cols[name].values())
+
+
+# ---------------------------------------------------------------------------
+# splitters
+# ---------------------------------------------------------------------------
+
+
+def test_null_splitter():
+    assert splitters.null_splitter("abc") == [("abc", {})]
+
+
+def test_token_count_splitter_bounds():
+    sp = splitters.TokenCountSplitter(min_tokens=5, max_tokens=20)
+    text = "Hello world. " * 40
+    chunks = sp.__wrapped__(text)
+    assert len(chunks) > 1
+    # chunks are exact substrings and respect the max budget approximately
+    for chunk, meta in chunks:
+        assert chunk in text
+        assert meta == {}
+        assert len(chunk.split()) <= 2 * 20
+
+
+def test_token_count_splitter_short_text_single_chunk():
+    sp = splitters.TokenCountSplitter(min_tokens=2, max_tokens=100)
+    assert len(sp.__wrapped__("only a few words here.")) == 1
+
+
+def test_splitter_in_pipeline_flatten():
+    t = dbg.table_from_rows(
+        pw.schema_from_types(data=str), [("One sentence. " * 30,)]
+    )
+    sp = splitters.TokenCountSplitter(min_tokens=3, max_tokens=10)
+    chunks = t.select(c=sp(t.data)).flatten(pw.this.c)
+    _, cols = dbg.table_to_dicts(chunks)
+    assert len(cols["c"]) > 1
+
+
+# ---------------------------------------------------------------------------
+# parsers
+# ---------------------------------------------------------------------------
+
+
+def test_utf8_parser_in_pipeline():
+    t = dbg.table_from_rows(pw.schema_from_types(data=bytes), [(b"hello bytes",)])
+    parser = Utf8Parser()
+    out = t.select(parsed=parser(t.data))
+    _, cols = dbg.table_to_dicts(out)
+    [(text, meta)] = list(cols["parsed"].values())[0]
+    assert text == "hello bytes"
+
+
+# ---------------------------------------------------------------------------
+# embedders + chats (mocks)
+# ---------------------------------------------------------------------------
+
+
+def test_fake_embedder_deterministic_and_normalized():
+    emb = mocks.FakeEmbedder(dim=16)
+    t = dbg.table_from_markdown(
+        """
+        data
+        alpha
+        beta
+        alpha
+        """
+    )
+    _, cols = dbg.table_to_dicts(t.select(v=emb(t.data)))
+    vecs = {tuple(np.round(v, 6)) for v in cols["v"].values()}
+    assert len(vecs) == 2  # alpha rows collide, beta differs
+    for v in cols["v"].values():
+        assert np.linalg.norm(v) == pytest.approx(1.0, abs=1e-5)
+    assert emb.get_embedding_dimension() == 16
+
+
+def test_identity_mock_chat_roundtrip():
+    chat = mocks.IdentityMockChat()
+    t = dbg.table_from_markdown(
+        """
+        q
+        hello
+        """
+    )
+    out = t.select(a=chat(llms.prompt_chat_single_qa(t.q), model="m9"))
+    _, cols = dbg.table_to_dicts(out)
+    assert list(cols["a"].values()) == ["m9::hello"]
+
+
+def test_messages_to_list_accepts_json_and_str():
+    msgs = llms._messages_to_list(pw.Json([{"role": "user", "content": "x"}]))
+    assert msgs == [{"role": "user", "content": "x"}]
+    assert llms._messages_to_list("plain")[0]["content"] == "plain"
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher: concurrent calls coalesce into one device batch
+# ---------------------------------------------------------------------------
+
+
+def test_async_micro_batcher_coalesces():
+    calls = []
+
+    def batch_fn(items):
+        calls.append(list(items))
+        return [i * 2 for i in items]
+
+    batcher = AsyncMicroBatcher(batch_fn)
+
+    import asyncio
+
+    async def main():
+        return await asyncio.gather(*[batcher.call(i) for i in range(10)])
+
+    results = asyncio.run(main())
+    assert results == [i * 2 for i in range(10)]
+    assert len(calls) == 1  # one flush served all ten concurrent calls
+
+
+def test_async_micro_batcher_propagates_errors():
+    def batch_fn(items):
+        raise RuntimeError("boom")
+
+    batcher = AsyncMicroBatcher(batch_fn)
+    import asyncio
+
+    with pytest.raises(RuntimeError):
+        asyncio.run(batcher.call(1))
+
+
+# ---------------------------------------------------------------------------
+# prompts
+# ---------------------------------------------------------------------------
+
+
+def test_prompt_qa_includes_docs_and_query():
+    t = dbg.table_from_rows(
+        pw.schema_from_types(q=str),
+        [("what?",)],
+    )
+    out = t.select(
+        p=prompts.prompt_qa(t.q, pw.make_tuple("docA", "docB"))
+    )
+    _, cols = dbg.table_to_dicts(out)
+    p = list(cols["p"].values())[0]
+    assert "docA" in p and "docB" in p and "what?" in p
+
+
+def test_parse_cited_response():
+    t = dbg.table_from_rows(pw.schema_from_types(r=str), [("Answer [1] and [2].",)])
+    out = t.select(
+        c=prompts.parse_cited_response(t.r, pw.make_tuple("d0", "d1", "d2"))
+    )
+    _, cols = dbg.table_to_dicts(out)
+    parsed = list(cols["c"].values())[0].value
+    assert parsed["citations"] == [0, 1]
+    assert parsed["cited_docs"] == ["d0", "d1"]
+    assert "[1]" not in parsed["response"]
+
+
+def test_prompt_qa_geometric_rag_strict():
+    p = prompts.prompt_qa_geometric_rag("q?", ["a", "b"], strict_prompt=True)
+    assert "Source 1: a" in p and "Source 2: b" in p
+
+
+# ---------------------------------------------------------------------------
+# rerankers
+# ---------------------------------------------------------------------------
+
+
+def test_rerank_topk_filter():
+    t = dbg.table_from_rows(pw.schema_from_types(x=int), [(1,)])
+    out = t.select(
+        r=rerankers.rerank_topk_filter(
+            pw.make_tuple("a", "b", "c"), pw.make_tuple(1.0, 3.0, 2.0), 2
+        )
+    )
+    _, cols = dbg.table_to_dicts(out)
+    docs, scores = list(cols["r"].values())[0]
+    assert docs == ("b", "c")
+    assert scores == (3.0, 2.0)
+
+
+def test_llm_reranker_parses_score():
+    reranker = rerankers.LLMReranker(mocks.FakeChatModel("4"))
+    t = dbg.table_from_rows(pw.schema_from_types(d=str, q=str), [("doc", "query")])
+    out = t.select(s=reranker(t.d, t.q))
+    _, cols = dbg.table_to_dicts(out)
+    assert list(cols["s"].values()) == [4.0]
+
+
+def test_encoder_reranker_tiny_model():
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+
+    cfg = EncoderConfig(
+        vocab_size=128, hidden_dim=16, num_layers=1, num_heads=2, mlp_dim=32,
+        max_len=32,
+    )
+    reranker = rerankers.EncoderReranker(
+        encoder=SentenceEncoder(cfg=cfg, max_length=16)
+    )
+    t = dbg.table_from_rows(
+        pw.schema_from_types(d=str, q=str),
+        [("same words here", "same words here"), ("other thing", "same words here")],
+    )
+    _, cols = dbg.table_to_dicts(t.select(s=reranker(t.d, t.q)))
+    scores = {r: s for r, s in zip(["a", "b"], cols["s"].values())}
+    # identical (query, doc) pair scores as perfect cosine
+    assert max(cols["s"].values()) == pytest.approx(1.0, abs=1e-4)

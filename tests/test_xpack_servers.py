@@ -1,0 +1,148 @@
+"""REST serving tests over real HTTP (threaded engine loop + aiohttp).
+
+Modeled on the reference's integration_tests/webserver + xpack server
+tests, shrunk to localhost with fake models.
+"""
+
+import pathlib
+import socket
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm import mocks
+from pathway_tpu.xpacks.llm.question_answering import (
+    BaseRAGQuestionAnswerer,
+    RAGClient,
+)
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient, VectorStoreServer
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_http(client_call, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return client_call()
+        except Exception as exc:  # noqa: BLE001 — server still starting
+            last = exc
+            time.sleep(0.25)
+    raise TimeoutError(f"server did not come up: {last}")
+
+
+@pytest.fixture
+def corpus_dir(tmp_path):
+    (tmp_path / "doc1.txt").write_text("Berlin is the capital of Germany.")
+    (tmp_path / "doc2.txt").write_text("Paris is the capital of France.")
+    return tmp_path
+
+
+def test_vector_store_server_http_roundtrip(corpus_dir):
+    docs = pw.io.fs.read(
+        corpus_dir, format="binary", mode="streaming", with_metadata=True,
+        refresh_interval=0.2,
+    )
+    vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=8))
+    port = _free_port()
+    vs.run_server(host="127.0.0.1", port=port, threaded=True, with_cache=True)
+    client = VectorStoreClient(host="127.0.0.1", port=port)
+
+    res = _wait_http(lambda: client.query("Paris is the capital of France.", k=1))
+    assert res[0]["text"] == "Paris is the capital of France."
+
+    stats = client.get_vectorstore_statistics()
+    assert stats["file_count"] == 2
+
+    inputs = client.get_input_files()
+    assert len(inputs) == 2
+
+    # live ingestion: drop a new file, it becomes retrievable
+    (corpus_dir / "doc3.txt").write_text("Madrid is the capital of Spain.")
+
+    def updated():
+        r = client.query("Madrid is the capital of Spain.", k=1)
+        assert r[0]["text"] == "Madrid is the capital of Spain."
+        return r
+
+    _wait_http(updated)
+
+    # deletion: removing the file drops it from the index
+    (corpus_dir / "doc3.txt").unlink()
+
+    def deleted():
+        r = client.query("Madrid is the capital of Spain.", k=3)
+        assert all(x["text"] != "Madrid is the capital of Spain." for x in r)
+        return r
+
+    _wait_http(deleted)
+
+
+def test_qa_rest_server_http_roundtrip(corpus_dir):
+    docs = pw.io.fs.read(
+        corpus_dir, format="binary", mode="streaming", with_metadata=True,
+        refresh_interval=0.2,
+    )
+    vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=8))
+    qa = BaseRAGQuestionAnswerer(llm=mocks.IdentityMockChat(), indexer=vs)
+    port = _free_port()
+    qa.build_server(host="127.0.0.1", port=port)
+    qa.server.run(threaded=True, with_cache=False)
+    client = RAGClient(host="127.0.0.1", port=port)
+
+    ans = _wait_http(lambda: client.pw_ai_answer("What is the capital of France?"))
+    assert ans["response"].startswith("mock::")
+
+    docs_list = client.pw_list_documents()
+    assert len(docs_list) == 2
+
+    summary = client.pw_ai_summary(["alpha text", "beta text"])
+    assert "alpha text" in summary
+
+    retrieved = client.retrieve("Berlin is the capital of Germany.", k=1)
+    assert retrieved[0]["text"] == "Berlin is the capital of Germany."
+
+
+def test_udf_caching_via_persistence(corpus_dir):
+    """UDF_CACHING persistence: the second identical call hits the cache."""
+    calls = []
+
+    class CountingEmbedder(mocks.FakeEmbedder):
+        def __wrapped__(self, input: str, **kwargs):
+            calls.append(input)
+            return super().__wrapped__(input, **kwargs)
+
+    from pathway_tpu.internals import udfs
+    from pathway_tpu.persistence import Backend, Config, activate, deactivate
+
+    emb = CountingEmbedder(dim=4)
+    emb.cache_strategy = udfs.DefaultCache()
+
+    backend = Backend.memory()
+    cfg = Config(backend, persistence_mode="UDF_CACHING")
+    activate(cfg)
+    try:
+        import pathway_tpu.debug as dbg
+
+        t = dbg.table_from_rows(pw.schema_from_types(data=str), [("abc",)])
+        _, cols = dbg.table_to_dicts(t.select(v=emb(t.data)))
+        first = list(cols["v"].values())[0]
+
+        pw.global_graph.clear()
+        t2 = dbg.table_from_rows(pw.schema_from_types(data=str), [("abc",)])
+        _, cols2 = dbg.table_to_dicts(t2.select(v=emb(t2.data)))
+        second = list(cols2["v"].values())[0]
+    finally:
+        deactivate(cfg)
+
+    assert (first == second).all()
+    assert calls == ["abc"]  # second run served from the persistence cache
+    assert backend.storage.list_keys("udfcache/")

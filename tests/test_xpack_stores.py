@@ -1,0 +1,229 @@
+"""VectorStoreServer / DocumentStore / QA pipeline tests (batch mode).
+
+Modeled on reference xpacks/llm/tests/test_vector_store.py +
+test_question_answering.py, using fake embedders/chats.
+"""
+
+import pathlib
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+from pathway_tpu.stdlib.indexing.retrievers import (
+    BruteForceKnnFactory,
+    LshKnnFactory,
+    TantivyBM25Factory,
+)
+from pathway_tpu.xpacks.llm import mocks
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+    BaseRAGQuestionAnswerer,
+    answer_with_geometric_rag_strategy,
+)
+from pathway_tpu.xpacks.llm.vector_store import (
+    InputsQuerySchema,
+    RetrieveQuerySchema,
+    StatisticsQuerySchema,
+    VectorStoreServer,
+)
+
+
+@pytest.fixture
+def corpus_dir(tmp_path):
+    (tmp_path / "doc1.txt").write_text("Berlin is the capital of Germany.")
+    (tmp_path / "doc2.txt").write_text("Paris is the capital of France.")
+    (tmp_path / "doc3.txt").write_text("The quick brown fox jumps over the lazy dog.")
+    return tmp_path
+
+
+def _docs(corpus_dir):
+    return pw.io.fs.read(
+        corpus_dir, format="binary", mode="static", with_metadata=True
+    )
+
+
+def _first_result(table):
+    _, cols = dbg.table_to_dicts(table)
+    return list(cols["result"].values())[0].value
+
+
+def test_vector_store_retrieve(corpus_dir):
+    vs = VectorStoreServer(_docs(corpus_dir), embedder=mocks.FakeEmbedder(dim=8))
+    queries = dbg.table_from_rows(
+        RetrieveQuerySchema, [("Paris is the capital of France.", 2, None, None)]
+    )
+    results = _first_result(vs.retrieve_query(queries))
+    assert len(results) == 2
+    # identical text embeds identically -> exact match first with dist ~ -1
+    assert results[0]["text"] == "Paris is the capital of France."
+    assert results[0]["dist"] == pytest.approx(-1.0, abs=1e-4)
+    assert results[0]["metadata"]["path"].endswith("doc2.txt")
+
+
+def test_vector_store_statistics_and_inputs(corpus_dir):
+    vs = VectorStoreServer(_docs(corpus_dir), embedder=mocks.FakeEmbedder(dim=8))
+    stats = _first_result(
+        vs.statistics_query(dbg.table_from_rows(StatisticsQuerySchema, [(None,)]))
+    )
+    assert stats["file_count"] == 3
+    assert stats["last_modified"] is not None
+
+    inputs = _first_result(
+        vs.inputs_query(dbg.table_from_rows(InputsQuerySchema, [(None, "*doc1*")]))
+    )
+    assert len(inputs) == 1
+    assert inputs[0]["path"].endswith("doc1.txt")
+
+
+def test_vector_store_metadata_filter(corpus_dir):
+    vs = VectorStoreServer(_docs(corpus_dir), embedder=mocks.FakeEmbedder(dim=8))
+    queries = dbg.table_from_rows(
+        RetrieveQuerySchema,
+        [("anything at all", 3, None, "*doc3*")],
+    )
+    results = _first_result(vs.retrieve_query(queries))
+    assert len(results) == 1
+    assert results[0]["metadata"]["path"].endswith("doc3.txt")
+
+
+def test_vector_store_requires_source():
+    with pytest.raises(ValueError, match="at least one data source"):
+        VectorStoreServer(embedder=mocks.FakeEmbedder(dim=4))
+
+
+def test_vector_store_with_splitter_chunks(corpus_dir):
+    from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+    (corpus_dir / "long.txt").write_text("A sentence here. " * 100)
+    vs = VectorStoreServer(
+        _docs(corpus_dir),
+        embedder=mocks.FakeEmbedder(dim=8),
+        splitter=TokenCountSplitter(min_tokens=5, max_tokens=20),
+    )
+    _, cols = dbg.table_to_dicts(vs._graph["chunked_docs"])
+    # the long doc must have produced several chunks
+    assert len(cols["text"]) > 6
+
+
+def test_document_store_bm25(corpus_dir):
+    ds = DocumentStore(_docs(corpus_dir), retriever_factory=TantivyBM25Factory())
+    queries = dbg.table_from_rows(
+        DocumentStore.RetrieveQuerySchema, [("quick brown fox", 1, None, None)]
+    )
+    results = _first_result(ds.retrieve_query(queries))
+    assert len(results) == 1
+    assert "fox" in results[0]["text"]
+    assert results[0]["score"] > 0
+
+
+def test_document_store_lsh(corpus_dir):
+    ds = DocumentStore(
+        _docs(corpus_dir),
+        retriever_factory=LshKnnFactory(
+            embedder=mocks.FakeEmbedder(dim=8), n_or=4, n_and=2
+        ),
+    )
+    queries = dbg.table_from_rows(
+        DocumentStore.RetrieveQuerySchema,
+        [("Berlin is the capital of Germany.", 1, None, None)],
+    )
+    results = _first_result(ds.retrieve_query(queries))
+    # LSH with identical query text must find the identical doc
+    assert results and results[0]["text"] == "Berlin is the capital of Germany."
+
+
+def test_document_store_statistics(corpus_dir):
+    ds = DocumentStore(_docs(corpus_dir), retriever_factory=TantivyBM25Factory())
+    stats = _first_result(
+        ds.statistics_query(
+            dbg.table_from_rows(DocumentStore.StatisticsQuerySchema, [(None,)])
+        )
+    )
+    assert stats["file_count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# QA pipelines
+# ---------------------------------------------------------------------------
+
+
+def test_base_rag_answer_query(corpus_dir):
+    vs = VectorStoreServer(_docs(corpus_dir), embedder=mocks.FakeEmbedder(dim=8))
+    qa = BaseRAGQuestionAnswerer(llm=mocks.IdentityMockChat(), indexer=vs)
+    queries = dbg.table_from_rows(
+        qa.AnswerQuerySchema,
+        [("What is the capital of France?", None, "m7", True, "short")],
+    )
+    result = _first_result(qa.answer_query(queries))
+    assert result["response"].startswith("m7::")
+    assert "What is the capital of France?" in result["response"]
+    assert len(result["context_docs"]) > 0
+
+
+def test_base_rag_long_response_type(corpus_dir):
+    vs = VectorStoreServer(_docs(corpus_dir), embedder=mocks.FakeEmbedder(dim=8))
+    qa = BaseRAGQuestionAnswerer(llm=mocks.IdentityMockChat(), indexer=vs)
+    queries = dbg.table_from_rows(
+        qa.AnswerQuerySchema, [("q?", None, None, False, "long")]
+    )
+    result = _first_result(qa.answer_query(queries))
+    # the long template mentions standalone form; short template does not
+    assert "standalone" in result["response"]
+
+
+def test_summarize_query(corpus_dir):
+    vs = VectorStoreServer(_docs(corpus_dir), embedder=mocks.FakeEmbedder(dim=8))
+    qa = BaseRAGQuestionAnswerer(llm=mocks.IdentityMockChat(), indexer=vs)
+    sq = dbg.table_from_rows(
+        qa.SummarizeQuerySchema, [(pw.Json(["text one", "text two"]), None)]
+    )
+    _, cols = dbg.table_to_dicts(qa.summarize_query(sq))
+    res = list(cols["result"].values())[0]
+    assert "text one" in res and "text two" in res
+
+
+def test_geometric_strategy_escalates():
+    """The model refuses until it sees >= 4 docs, then answers."""
+
+    class CountingChat(mocks.BaseChat):
+        def __init__(self):
+            super().__init__(deterministic=True)
+            self.seen = []
+
+        def __wrapped__(self, messages, **kwargs):
+            from pathway_tpu.xpacks.llm.llms import _messages_to_list
+
+            content = _messages_to_list(messages)[-1]["content"]
+            n_docs = content.count("Source ")
+            self.seen.append(n_docs)
+            if n_docs >= 4:
+                return "the answer"
+            return "No information found."
+
+    chat = CountingChat()
+    questions = dbg.table_from_rows(
+        pw.schema_from_types(prompt=str, docs=tuple),
+        [("q?", ("d1", "d2", "d3", "d4", "d5"))],
+    )
+    out = answer_with_geometric_rag_strategy(
+        questions, questions.docs, chat,
+        n_starting_documents=2, factor=2, max_iterations=3,
+    )
+    _, cols = dbg.table_to_dicts(out)
+    assert list(cols["result"].values()) == ["the answer"]
+    assert chat.seen == [2, 4]  # refused at 2 docs, answered at 4
+
+
+def test_adaptive_rag_no_information(corpus_dir):
+    vs = VectorStoreServer(_docs(corpus_dir), embedder=mocks.FakeEmbedder(dim=8))
+    qa = AdaptiveRAGQuestionAnswerer(
+        llm=mocks.FakeChatModel("No information found."), indexer=vs,
+        max_iterations=2,
+    )
+    queries = dbg.table_from_rows(
+        qa.AnswerQuerySchema, [("unknown?", None, None, False, "short")]
+    )
+    result = _first_result(qa.answer_query(queries))
+    assert result["response"] == "No information found."
