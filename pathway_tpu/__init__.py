@@ -200,6 +200,14 @@ _LAZY_SUBMODULES = {
 }
 
 
+def load_yaml(stream):
+    """Load a declarative ``!pw`` app template
+    (reference: internals/yaml_loader.py:74)."""
+    from .internals.yaml_loader import load_yaml as _load
+
+    return _load(stream)
+
+
 def __getattr__(name: str):
     if name in _LAZY_SUBMODULES:
         import importlib
@@ -259,4 +267,5 @@ __all__ = [
     "assert_table_has_schema",
     "universes",
     "unsafe_make_pointer",
+    "load_yaml",
 ]

@@ -1,0 +1,8 @@
+"""``python -m pathway_tpu`` — CLI entry (reference: pathway console
+script → cli.main)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

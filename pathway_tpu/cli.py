@@ -1,0 +1,105 @@
+"""``pathway spawn`` — multi-process launcher.
+
+reference: python/pathway/cli.py (320 LoC) — ``spawn --threads --processes``
+(:60-110 setting PATHWAY_* envs + one subprocess.Popen per process) and
+``spawn-from-env``.
+
+Usage::
+
+    python -m pathway_tpu spawn --threads 2 --processes 2 python app.py
+    python -m pathway_tpu spawn-from-env python app.py   # reads PATHWAY_SPAWN_ARGS
+
+Each spawned process gets PATHWAY_PROCESS_ID/PATHWAY_PROCESSES/
+PATHWAY_THREADS/PATHWAY_FIRST_PORT; process 0 inherits stdio.  The host
+plane shards sources by these (internals/config.py); the device plane
+sizes its mesh from jax.device_count, not from the env.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+__all__ = ["main", "spawn_program"]
+
+
+def spawn_program(
+    threads: int,
+    processes: int,
+    first_port: int,
+    program: str,
+    arguments: list[str],
+    env: dict | None = None,
+) -> int:
+    """reference: cli.py:92-109 — N processes, shared env, wait for all."""
+    base_env = dict(env or os.environ)
+    base_env.update(
+        {
+            "PATHWAY_THREADS": str(threads),
+            "PATHWAY_PROCESSES": str(processes),
+            "PATHWAY_FIRST_PORT": str(first_port),
+        }
+    )
+    procs: list[subprocess.Popen] = []
+    try:
+        for pid in range(processes):
+            penv = dict(base_env)
+            penv["PATHWAY_PROCESS_ID"] = str(pid)
+            procs.append(subprocess.Popen([program, *arguments], env=penv))
+        exit_code = 0
+        for p in procs:
+            code = p.wait()
+            if code:
+                exit_code = code
+        return exit_code
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait()
+        return 130
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="pathway", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("spawn", help="run a program over N processes x M threads")
+    sp.add_argument("--threads", "-t", type=int, default=1)
+    sp.add_argument("--processes", "-n", type=int, default=1)
+    sp.add_argument("--first-port", type=int, default=10000)
+    sp.add_argument("--record", action="store_true", help="persist inputs while running")
+    sp.add_argument("--record-path", default="record")
+    sp.add_argument("program")
+    sp.add_argument("arguments", nargs=argparse.REMAINDER)
+
+    se = sub.add_parser(
+        "spawn-from-env",
+        help="like spawn, with arguments taken from PATHWAY_SPAWN_ARGS",
+    )
+    se.add_argument("program")
+    se.add_argument("arguments", nargs=argparse.REMAINDER)
+
+    args = parser.parse_args(argv)
+
+    if args.command == "spawn":
+        env = dict(os.environ)
+        if args.record:
+            env["PATHWAY_PERSISTENT_STORAGE"] = args.record_path
+        return spawn_program(
+            args.threads, args.processes, args.first_port,
+            args.program, args.arguments, env,
+        )
+    if args.command == "spawn-from-env":
+        spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "").split()
+        ns = parser.parse_args(["spawn", *spawn_args, args.program, *args.arguments])
+        return spawn_program(
+            ns.threads, ns.processes, ns.first_port, ns.program, ns.arguments
+        )
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

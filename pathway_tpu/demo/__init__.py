@@ -1,0 +1,136 @@
+"""``pw.demo`` — synthetic input streams for examples and tests.
+
+reference: python/pathway/demo/__init__.py —
+``generate_custom_stream``:28, ``noisy_linear_stream``:118,
+``range_stream``:165, ``replay_csv``.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import random
+import time
+from typing import Any, Callable
+
+from ..internals.schema import SchemaMetaclass, schema_from_types
+from ..internals.table import Table
+from ..io._utils import coerce_row, input_table
+from ..io.streaming import ConnectorSubject
+
+__all__ = [
+    "generate_custom_stream",
+    "noisy_linear_stream",
+    "range_stream",
+    "replay_csv",
+]
+
+
+class _StreamSubject(ConnectorSubject):
+    """Emits ``nb_rows`` generated rows at ``input_rate`` rows/sec
+    (unbounded when ``nb_rows`` is None)."""
+
+    def __init__(
+        self,
+        value_generators: dict[str, Callable[[int], Any]],
+        nb_rows: int | None,
+        input_rate: float,
+        autocommit_ms: int | None,
+    ):
+        super().__init__(datasource_name="demo")
+        self.value_generators = value_generators
+        self.nb_rows = nb_rows
+        self.input_rate = input_rate
+        self._autocommit_ms = autocommit_ms
+        if nb_rows is not None:
+            # bounded demo streams behave like static sources in batch mode
+            self._mode = "streaming"
+
+    def run(self) -> None:
+        i = 0
+        period = 1.0 / self.input_rate if self.input_rate > 0 else 0.0
+        while self.nb_rows is None or i < self.nb_rows:
+            if self._closed.is_set():
+                return
+            row = {
+                name: gen(i) for name, gen in self.value_generators.items()
+            }
+            self.next(**row)
+            self.commit()
+            i += 1
+            if period:
+                time.sleep(period)
+
+
+def generate_custom_stream(
+    value_generators: dict[str, Callable[[int], Any]],
+    *,
+    schema: SchemaMetaclass,
+    nb_rows: int | None = None,
+    autocommit_duration_ms: int = 20,
+    input_rate: float = 1.0,
+    persistent_id: str | None = None,
+) -> Table:
+    """reference: demo/__init__.py:28"""
+    subject = _StreamSubject(
+        value_generators, nb_rows, input_rate, autocommit_duration_ms
+    )
+    subject.persistent_id = persistent_id
+    subject._configure(schema, schema.primary_key_columns())
+    return input_table(schema, subject=subject)
+
+
+def noisy_linear_stream(nb_rows: int = 10, input_rate: float = 1.0) -> Table:
+    """y ≈ x plus uniform noise (reference: demo/__init__.py:118)."""
+    rng = random.Random(0)
+    schema = schema_from_types(x=float, y=float)
+    return generate_custom_stream(
+        {
+            "x": lambda i: float(i),
+            "y": lambda i: float(i) + (2.0 * rng.random() - 1.0),
+        },
+        schema=schema,
+        nb_rows=nb_rows,
+        input_rate=input_rate,
+    )
+
+
+def range_stream(
+    nb_rows: int = 30, offset: int = 0, input_rate: float = 1.0
+) -> Table:
+    """Consecutive integers in a ``value`` column
+    (reference: demo/__init__.py:165)."""
+    schema = schema_from_types(value=int)
+    return generate_custom_stream(
+        {"value": lambda i: i + offset},
+        schema=schema,
+        nb_rows=nb_rows,
+        input_rate=input_rate,
+    )
+
+
+class _CsvReplaySubject(ConnectorSubject):
+    def __init__(self, path: str, schema: SchemaMetaclass, input_rate: float):
+        super().__init__(datasource_name=f"replay_csv:{path}")
+        self.path = path
+        self.row_schema = schema
+        self.input_rate = input_rate
+
+    def run(self) -> None:
+        period = 1.0 / self.input_rate if self.input_rate > 0 else 0.0
+        with open(self.path, newline="") as f:
+            for rec in _csv.DictReader(f):
+                if self._closed.is_set():
+                    return
+                self.next(**coerce_row(self.row_schema, rec))
+                self.commit()
+                if period:
+                    time.sleep(period)
+
+
+def replay_csv(
+    path: str, *, schema: SchemaMetaclass, input_rate: float = 1.0
+) -> Table:
+    """Stream an existing CSV row-by-row (reference: demo replay_csv)."""
+    subject = _CsvReplaySubject(path, schema, input_rate)
+    subject._configure(schema, schema.primary_key_columns())
+    return input_table(schema, subject=subject)

@@ -1,0 +1,74 @@
+"""Env-driven runtime config.
+
+reference: python/pathway/internals/config.py (``PathwayConfig``) +
+src/engine/dataflow/config.rs:88 (``Config::from_env`` — PATHWAY_THREADS /
+PATHWAY_PROCESSES / PATHWAY_PROCESS_ID / PATHWAY_FIRST_PORT; free tier
+caps 8 workers, config.rs:7-11).
+
+The same variables drive this runtime: threads size the host-side engine
+pools, processes/process_id shard sources across cooperating processes
+(``pathway spawn``, cli.py), and on the device plane the mesh shape comes
+from ``jax.device_count`` (parallel/mesh.py) rather than env vars.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+__all__ = ["PathwayConfig", "get_pathway_config", "MAX_WORKERS"]
+
+# reference caps non-enterprise runs at 8 workers (config.rs:7-11); kept as
+# a constant for parity, not enforced as a license gate
+MAX_WORKERS = 8
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclass
+class PathwayConfig:
+    threads: int = 1
+    processes: int = 1
+    process_id: int = 0
+    first_port: int = 10000
+    run_id: str | None = None
+    persistent_storage: str | None = None
+    monitoring_http_port: int | None = None
+    ignore_asserts: bool = False
+    skip_start_log: bool = False
+
+    @classmethod
+    def from_env(cls) -> "PathwayConfig":
+        port = os.environ.get("PATHWAY_MONITORING_HTTP_PORT")
+        return cls(
+            threads=_env_int("PATHWAY_THREADS", 1),
+            processes=_env_int("PATHWAY_PROCESSES", 1),
+            process_id=_env_int("PATHWAY_PROCESS_ID", 0),
+            first_port=_env_int("PATHWAY_FIRST_PORT", 10000),
+            run_id=os.environ.get("PATHWAY_RUN_ID"),
+            persistent_storage=os.environ.get("PATHWAY_PERSISTENT_STORAGE"),
+            monitoring_http_port=int(port) if port else None,
+            ignore_asserts=os.environ.get("PATHWAY_IGNORE_ASSERTS", "").lower()
+            in ("1", "true", "yes"),
+            skip_start_log=os.environ.get("PATHWAY_SKIP_START_LOG", "").lower()
+            in ("1", "true", "yes"),
+        )
+
+    @property
+    def total_workers(self) -> int:
+        return self.threads * self.processes
+
+
+_config: PathwayConfig | None = None
+
+
+def get_pathway_config(refresh: bool = False) -> PathwayConfig:
+    global _config
+    if _config is None or refresh:
+        _config = PathwayConfig.from_env()
+    return _config

@@ -635,6 +635,10 @@ class DeduplicateNode(Node):
         self.acceptor = acceptor
         self.persistent_id = persistent_id
         self.state: dict[Any, tuple[Pointer, tuple]] = {}
+        # operator-snapshot hook attached by the streaming driver when full
+        # persistence is on (reference: persistence/operator_snapshot.rs)
+        self._op_snapshot = None
+        self._dirty = False
 
     def flush(self, time: int) -> list[Entry]:
         out: list[Entry] = []
@@ -654,8 +658,14 @@ class DeduplicateNode(Node):
                 if current is not None:
                     out.append((out_key, current[1], -1))
                 self.state[inst] = (key, row)
+                self._dirty = True
                 out.append((out_key, row, 1))
         return consolidate(out)
+
+    def end_of_step(self, time: int) -> None:
+        if self._dirty and self._op_snapshot is not None and self.persistent_id:
+            self._op_snapshot.save(self.persistent_id, self.state)
+            self._dirty = False
 
 
 class BufferNode(Node):
@@ -811,6 +821,8 @@ class Engine:
         self.nodes: list[Node] = []
         self.sources: list[SourceNode] = []
         self.frontier: int = -1
+        # attached by pw.run when monitoring is on (internals/monitoring.py)
+        self.monitor = None
 
     def add(self, node: Node) -> Node:
         node.id = len(self.nodes)
@@ -834,7 +846,7 @@ class Engine:
                 if node.late or not node.has_pending(time):
                     continue
                 progressed = True
-                out = node.flush(time)
+                out = self._flush_node(node, time)
                 if out:
                     for consumer, port in node.downstream:
                         consumer.receive(port, out)
@@ -846,7 +858,7 @@ class Engine:
             for node in self.nodes:
                 if node.late and node.has_pending(time):
                     progressed = True
-                    out = node.flush(time)
+                    out = self._flush_node(node, time)
                     if out:
                         for consumer, port in node.downstream:
                             consumer.receive(port, out)
@@ -858,6 +870,20 @@ class Engine:
         for node in self.nodes:
             node.end_of_step(time)
         self.frontier = time
+        if self.monitor is not None:
+            self.monitor.record_step(time)
+
+    def _flush_node(self, node: Node, time: int) -> list[Entry]:
+        if self.monitor is None:
+            return node.flush(time)
+        import time as _time_mod
+
+        t0 = _time_mod.perf_counter()
+        out = node.flush(time)
+        self.monitor.record_flush(
+            node.name, len(out), _time_mod.perf_counter() - t0
+        )
+        return out
 
     def run_all(self) -> None:
         """Batch mode: drain all queued source times, then close."""

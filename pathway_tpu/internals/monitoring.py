@@ -1,0 +1,142 @@
+"""Operator stats, the monitoring dashboard, and the OpenMetrics endpoint.
+
+reference: python/pathway/internals/monitoring.py:165 (``StatsMonitor``
+rich TUI), src/engine/http_server.rs:21-83 (Prometheus/OpenMetrics HTTP
+server on ``127.0.0.1:(20000+process_id)/status``), src/engine/
+progress_reporter.rs + ``ProberStats`` (graph.rs:533).
+
+The engine calls :meth:`StatsMonitor.record_flush` per node per
+micro-batch; the HTTP thread renders the same counters as OpenMetrics
+gauges (input/output latency + per-node rows processed), and the rich
+table view mirrors the reference's live dashboard.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+__all__ = ["StatsMonitor", "start_http_server_thread", "MonitoringLevel"]
+
+
+class StatsMonitor:
+    """Per-node counters: rows, flush latency, last activity."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows: dict[str, int] = defaultdict(int)
+        self.flushes: dict[str, int] = defaultdict(int)
+        self.busy_s: dict[str, float] = defaultdict(float)
+        self.last_time: dict[str, float] = {}
+        self.current_timestamp: int = -1
+        self.started_at = time.time()
+
+    def record_flush(self, node_name: str, n_rows: int, elapsed_s: float) -> None:
+        with self._lock:
+            self.rows[node_name] += n_rows
+            self.flushes[node_name] += 1
+            self.busy_s[node_name] += elapsed_s
+            self.last_time[node_name] = time.time()
+
+    def record_step(self, timestamp: int) -> None:
+        with self._lock:
+            self.current_timestamp = timestamp
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "uptime_s": time.time() - self.started_at,
+                "timestamp": self.current_timestamp,
+                "nodes": {
+                    name: {
+                        "rows": self.rows[name],
+                        "flushes": self.flushes[name],
+                        "busy_s": round(self.busy_s[name], 6),
+                    }
+                    for name in self.rows
+                },
+            }
+
+    # -- OpenMetrics rendering (reference: http_server.rs:25
+    # ``metrics_from_stats``) --
+    def openmetrics(self) -> str:
+        snap = self.snapshot()
+        lines = [
+            "# TYPE pathway_uptime_seconds gauge",
+            f"pathway_uptime_seconds {snap['uptime_s']:.3f}",
+            "# TYPE pathway_current_timestamp gauge",
+            f"pathway_current_timestamp {snap['timestamp']}",
+            "# TYPE pathway_operator_rows_total counter",
+        ]
+        for name, st in snap["nodes"].items():
+            safe = name.replace('"', "")
+            lines.append(
+                f'pathway_operator_rows_total{{operator="{safe}"}} {st["rows"]}'
+            )
+        lines.append("# TYPE pathway_operator_busy_seconds counter")
+        for name, st in snap["nodes"].items():
+            safe = name.replace('"', "")
+            lines.append(
+                f'pathway_operator_busy_seconds{{operator="{safe}"}} {st["busy_s"]}'
+            )
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    # -- rich dashboard (reference: monitoring.py:165 StatsMonitor TUI) --
+    def render_table(self):
+        from rich.table import Table as RichTable
+
+        snap = self.snapshot()
+        table = RichTable(title=f"pathway_tpu — t={snap['timestamp']}")
+        table.add_column("operator")
+        table.add_column("rows", justify="right")
+        table.add_column("flushes", justify="right")
+        table.add_column("busy (s)", justify="right")
+        for name, st in sorted(snap["nodes"].items()):
+            table.add_row(
+                name, str(st["rows"]), str(st["flushes"]), f"{st['busy_s']:.3f}"
+            )
+        return table
+
+
+def start_http_server_thread(
+    monitor: StatsMonitor, port: int | None = None, process_id: int = 0
+) -> ThreadingHTTPServer:
+    """Serve ``/status`` OpenMetrics on 127.0.0.1:(20000+process_id)
+    (reference: http_server.rs:76-83; PATHWAY_MONITORING_HTTP_PORT
+    overrides)."""
+    if port is None:
+        import os
+
+        env_port = os.environ.get("PATHWAY_MONITORING_HTTP_PORT")
+        port = int(env_port) if env_port else 20000 + process_id
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — stdlib API
+            if self.path not in ("/status", "/metrics"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = monitor.openmetrics().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "application/openmetrics-text; version=1.0.0"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # silence request logging
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    th = threading.Thread(target=server.serve_forever, daemon=True, name="pw-metrics")
+    th.start()
+    return server
+
+
+# re-exported for parity with reference run.py imports
+from .run import MonitoringLevel  # noqa: E402  (cycle-safe: run has no monitoring import at module level)

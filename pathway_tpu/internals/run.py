@@ -48,9 +48,25 @@ def run(
         return
 
     _persistence.activate(persistence_config)
+    http_server = None
     try:
         runner = GraphRunner()
         engine = runner.build([(table, node) for table, node in sinks])
+
+        if with_http_server or monitoring_level in (
+            MonitoringLevel.IN_OUT,
+            MonitoringLevel.ALL,
+            MonitoringLevel.AUTO_ALL,
+        ):
+            from .config import get_pathway_config
+            from .monitoring import StatsMonitor, start_http_server_thread
+
+            engine.monitor = StatsMonitor()
+            if with_http_server:
+                http_server = start_http_server_thread(
+                    engine.monitor,
+                    process_id=get_pathway_config().process_id,
+                )
 
         from ..io.streaming import StreamingDriver
 
@@ -64,6 +80,8 @@ def run(
         driver.run()
     finally:
         _persistence.deactivate(persistence_config)
+        if http_server is not None:
+            http_server.shutdown()
 
 
 def run_all(**kwargs: Any) -> None:

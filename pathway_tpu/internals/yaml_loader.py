@@ -1,0 +1,120 @@
+"""Declarative YAML app templates with ``!pw`` tags.
+
+reference: python/pathway/internals/yaml_loader.py:74
+(``PathwayYamlLoader``) — app templates like the reference's
+``integration_tests/rag_evals/app.yaml`` instantiate framework classes
+straight from YAML::
+
+    $llm: !pw.xpacks.llm.mocks.IdentityMockChat {}
+    store: !pw.xpacks.llm.vector_store.VectorStoreServer
+      docs: ...
+      embedder: !pw.xpacks.llm.mocks.FakeEmbedder
+        dim: 8
+
+Tags: ``!pw.<dotted.path>`` resolves inside the ``pathway_tpu`` package
+(``!pw.io.fs.read`` etc.); a mapping node calls the object with kwargs, a
+sequence node with positional args, a scalar node with the single value
+(empty scalar = attribute access only).  ``$name`` keys define reusable
+anchored values referenced as ``$name`` (reference's variable convention).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, IO
+
+import yaml
+
+__all__ = ["PathwayYamlLoader", "load_yaml"]
+
+
+def _resolve(dotted: str) -> Any:
+    """Resolve ``io.fs.read``-style paths inside pathway_tpu, importing
+    submodules as needed."""
+    import pathway_tpu as pw
+
+    obj: Any = pw
+    parts = dotted.split(".")
+    for i, part in enumerate(parts):
+        try:
+            obj = getattr(obj, part)
+        except AttributeError:
+            module_path = "pathway_tpu." + ".".join(parts[: i + 1])
+            try:
+                obj = importlib.import_module(module_path)
+            except ImportError as exc:
+                raise ValueError(
+                    f"cannot resolve !pw.{dotted}: no attribute or module "
+                    f"{part!r}"
+                ) from exc
+    return obj
+
+
+class _DeferredCall:
+    """A ``!pw`` node parsed but not yet instantiated — construction happens
+    after ``$var`` substitution so variables can reference earlier objects."""
+
+    def __init__(self, dotted: str, args: list, kwargs: dict):
+        self.dotted = dotted
+        self.args = args
+        self.kwargs = kwargs
+
+    def materialize(self, variables: dict[str, Any]) -> Any:
+        target = _resolve(self.dotted)
+        args = [_materialize(a, variables) for a in self.args]
+        kwargs = {k: _materialize(v, variables) for k, v in self.kwargs.items()}
+        if not args and not kwargs and not callable(target):
+            return target
+        if args and len(args) == 1 and args[0] in (None, "") and not kwargs:
+            return target()
+        return target(*args, **kwargs)
+
+
+class PathwayYamlLoader(yaml.SafeLoader):
+    """reference: yaml_loader.py:74"""
+
+
+def _pw_multi_constructor(loader: PathwayYamlLoader, tag_suffix: str, node):
+    dotted = tag_suffix.lstrip(".")
+    if isinstance(node, yaml.MappingNode):
+        return _DeferredCall(dotted, [], loader.construct_mapping(node, deep=True))
+    if isinstance(node, yaml.SequenceNode):
+        return _DeferredCall(dotted, loader.construct_sequence(node, deep=True), {})
+    value = loader.construct_scalar(node)
+    if value in (None, ""):
+        return _DeferredCall(dotted, [None], {})
+    return _DeferredCall(dotted, [value], {})
+
+
+PathwayYamlLoader.add_multi_constructor("!pw", _pw_multi_constructor)
+
+
+def _materialize(obj: Any, variables: dict[str, Any]) -> Any:
+    if isinstance(obj, _DeferredCall):
+        return obj.materialize(variables)
+    if isinstance(obj, str) and obj.startswith("$") and obj[1:] in variables:
+        return variables[obj[1:]]
+    if isinstance(obj, dict):
+        return {k: _materialize(v, variables) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_materialize(v, variables) for v in obj]
+    return obj
+
+
+def load_yaml(stream: str | IO) -> Any:
+    """Parse a template; ``$name:`` entries become variables usable as
+    ``$name`` in later entries (reference: yaml_loader variables).
+    Instantiation order follows document order, so a variable can hold a
+    table/component consumed by later components."""
+    data = yaml.load(stream, Loader=PathwayYamlLoader)
+    if not isinstance(data, dict):
+        return _materialize(data, {})
+    variables: dict[str, Any] = {}
+    out: dict[str, Any] = {}
+    for key, value in data.items():
+        value = _materialize(value, variables)
+        if isinstance(key, str) and key.startswith("$"):
+            variables[key[1:]] = value
+        else:
+            out[key] = value
+    return out

@@ -68,6 +68,17 @@ class _FsSubject(ConnectorSubject):
         # path -> (mtime, size, [row keys])
         self._seen: dict[str, tuple[float, int, list]] = {}
 
+    # offsets = the whole scan state: restoring it suppresses re-emission of
+    # unchanged files and lets later modifications retract the exact rows the
+    # pre-restart run produced (reference: OffsetAntichain FilePosition
+    # offsets + seek, src/connectors/offset.rs / data_storage.rs:398)
+    def current_offsets(self):
+        return dict(self._seen)
+
+    def seek(self, offsets) -> None:
+        if offsets:
+            self._seen = dict(offsets)
+
     def _list_files(self) -> list[str]:
         p = self.path
         if os.path.isfile(p):
@@ -179,6 +190,7 @@ def read(
     object_pattern: str = "*",
     autocommit_duration_ms: int | None = 1500,
     refresh_interval: float = 1.0,
+    persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
     """Read files under ``path`` (reference io/fs/__init__.py:369).
@@ -205,6 +217,7 @@ def read(
         refresh_interval,
         autocommit_duration_ms,
     )
+    subject.persistent_id = persistent_id
     subject._configure(out_schema, schema.primary_key_columns())
     return input_table(out_schema, subject=subject)
 

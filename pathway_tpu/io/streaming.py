@@ -56,6 +56,10 @@ class ConnectorSubject:
     #: explicit commit() (reference: connector commit_duration ticks,
     #: src/connectors/mod.rs:207-217); None = explicit commits only
     _autocommit_ms: int | None = None
+    #: key under which this subject's input snapshot + offsets persist
+    #: (reference: persistent_id on connectors); defaults to the
+    #: datasource name, which is deterministic for fs/kafka-style sources
+    persistent_id: str | None = None
 
     def __init__(self, datasource_name: str = "python") -> None:
         self._datasource_name = datasource_name
@@ -124,6 +128,19 @@ class ConnectorSubject:
         self._closed.set()
         if self._data_event is not None:
             self._data_event.set()
+
+    # -- persistence hooks (reference: Reader::seek data_storage.rs:398 +
+    # OffsetAntichain offsets; overridden by offset-aware subjects) --
+    def current_offsets(self) -> Any:
+        """Source position to persist with each snapshot chunk."""
+        return None
+
+    def seek(self, offsets: Any) -> None:
+        """Restore the source position after snapshot replay."""
+
+    @property
+    def effective_persistent_id(self) -> str:
+        return self.persistent_id or self._datasource_name
 
     # -- plumbing --
     def _derive_key(self, kwargs: dict) -> Any:
@@ -208,12 +225,72 @@ class StreamingDriver:
             subject = op.params.get("subject")
             if subject is not None and subject._mode == "streaming":
                 self.subject_src.append((subject, src))
+        self._snapshot_writers: dict[int, Any] = {}
+        self._op_snapshot = None
+
+    def _snapshot_storage(self):
+        """KV storage when full persistence is on (not UDF-caching-only)."""
+        cfg = self.persistence_config
+        if cfg is None:
+            return None
+        from ..persistence import PersistenceMode
+
+        if cfg.persistence_mode in (
+            PersistenceMode.PERSISTING,
+            PersistenceMode.OPERATOR_PERSISTING,
+        ):
+            return cfg.backend.storage
+        return None
+
+    def _setup_persistence(self, t: int) -> int:
+        """Replay input snapshots, seek subjects, restore operator state
+        (reference: Entry::{Snapshot,RewindFinishSentinel} replay,
+        src/connectors/mod.rs:100-104; reader seek data_storage.rs:398;
+        operator_snapshot.rs)."""
+        storage = self._snapshot_storage()
+        if storage is None:
+            return t
+        from ..persistence import (
+            InputSnapshotReader,
+            InputSnapshotWriter,
+            OperatorSnapshot,
+        )
+
+        self._op_snapshot = OperatorSnapshot(storage)
+        pushed = False
+        for subject, src in self.subject_src:
+            pid = subject.effective_persistent_id
+            reader = InputSnapshotReader(storage, pid)
+            replayed: list[Entry] = []
+            for entries in reader.replay():
+                replayed.extend(entries)
+            if replayed:
+                src.push(t, replayed)
+                pushed = True
+            offsets = reader.last_offsets()
+            if offsets is not None:
+                subject.seek(offsets)
+            self._snapshot_writers[id(subject)] = InputSnapshotWriter(storage, pid)
+        # restore stateful-operator snapshots before any replayed data flows
+        from ..internals.engine import DeduplicateNode
+
+        for node in self.engine.nodes:
+            if isinstance(node, DeduplicateNode) and node.persistent_id:
+                state = self._op_snapshot.load(node.persistent_id)
+                if state is not None:
+                    node.state = state
+                node._op_snapshot = self._op_snapshot
+        if pushed:
+            self.engine.step(t)
+            t += 1
+        return t
 
     def run(self) -> None:
         if not self.subject_src:
             self.engine.run_all()
             return
         data_event = threading.Event()
+        t = self._setup_persistence(1)
         threads = []
         for subject, _src in self.subject_src:
             subject._data_event = data_event
@@ -229,7 +306,6 @@ class StreamingDriver:
             th.start()
             threads.append(th)
 
-        t = 1
         last_autocommit = {id(s): _time.monotonic() for s, _ in self.subject_src}
         while True:
             data_event.wait(timeout=self.autocommit_ms / 1000.0)
@@ -245,6 +321,7 @@ class StreamingDriver:
                 entries = subject._drain()
                 if entries:
                     src.push(t, entries)
+                    self._write_snapshot(subject, entries)
                     pushed = True
             if pushed:
                 self.engine.step(t)
@@ -256,9 +333,15 @@ class StreamingDriver:
                     entries = subject._drain()
                     if entries:
                         src.push(t, entries)
+                        self._write_snapshot(subject, entries)
                         pushed = True
                 if pushed:
                     self.engine.step(t)
                     t += 1
                 break
         self.engine.finish()
+
+    def _write_snapshot(self, subject: ConnectorSubject, entries: list[Entry]) -> None:
+        writer = self._snapshot_writers.get(id(subject))
+        if writer is not None:
+            writer.write_batch(entries, subject.current_offsets())

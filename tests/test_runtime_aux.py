@@ -1,0 +1,220 @@
+"""Auxiliary runtime subsystems: demo streams, CLI spawn, env config,
+monitoring/OpenMetrics endpoint, YAML app templates.
+
+reference test models: python/pathway/tests/ (demo + monitoring), cli
+spawn smoke, yaml_loader tests.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+
+
+# ---------------------------------------------------------------------------
+# demo
+# ---------------------------------------------------------------------------
+
+
+def test_range_stream_batch():
+    t = pw.demo.range_stream(nb_rows=5, offset=10, input_rate=0)
+    total = t.reduce(s=pw.reducers.sum(t.value), c=pw.reducers.count())
+    collected = {}
+
+    def on_change(key, row, time_, is_addition):
+        if is_addition:
+            collected.update(row)
+
+    pw.io.subscribe(total, on_change=on_change)
+    pw.run()
+    assert collected == {"s": 10 + 11 + 12 + 13 + 14, "c": 5}
+
+
+def test_noisy_linear_stream():
+    t = pw.demo.noisy_linear_stream(nb_rows=4, input_rate=0)
+    rows = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time_, add: rows.append(row) if add else None
+    )
+    pw.run()
+    assert len(rows) == 4
+    for row in rows:
+        assert abs(row["y"] - row["x"]) <= 1.0
+
+
+def test_generate_custom_stream():
+    schema = pw.schema_from_types(number=int, name=str)
+    t = pw.demo.generate_custom_stream(
+        {"number": lambda i: i * i, "name": lambda i: f"s{i}"},
+        schema=schema,
+        nb_rows=3,
+        input_rate=0,
+    )
+    rows = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time_, add: rows.append(row) if add else None
+    )
+    pw.run()
+    assert sorted(r["number"] for r in rows) == [0, 1, 4]
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+def test_pathway_config_from_env(monkeypatch):
+    from pathway_tpu.internals.config import PathwayConfig
+
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    cfg = PathwayConfig.from_env()
+    assert cfg.threads == 4
+    assert cfg.processes == 2
+    assert cfg.process_id == 1
+    assert cfg.total_workers == 8
+
+
+# ---------------------------------------------------------------------------
+# CLI spawn
+# ---------------------------------------------------------------------------
+
+
+def test_cli_spawn_sets_process_envs(tmp_path):
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import os, sys, pathlib\n"
+        "out = pathlib.Path(sys.argv[1]) / ('p' + os.environ['PATHWAY_PROCESS_ID'])\n"
+        "out.write_text(os.environ['PATHWAY_THREADS'] + ',' + os.environ['PATHWAY_PROCESSES'])\n"
+    )
+    from pathway_tpu.cli import main
+
+    code = main(
+        [
+            "spawn", "--threads", "2", "--processes", "2",
+            sys.executable, str(prog), str(tmp_path),
+        ]
+    )
+    assert code == 0
+    assert (tmp_path / "p0").read_text() == "2,2"
+    assert (tmp_path / "p1").read_text() == "2,2"
+
+
+# ---------------------------------------------------------------------------
+# monitoring
+# ---------------------------------------------------------------------------
+
+
+def test_stats_monitor_and_openmetrics():
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    mon = StatsMonitor()
+    mon.record_flush("select#1", 10, 0.002)
+    mon.record_flush("select#1", 5, 0.001)
+    mon.record_step(7)
+    snap = mon.snapshot()
+    assert snap["nodes"]["select#1"]["rows"] == 15
+    text = mon.openmetrics()
+    assert 'pathway_operator_rows_total{operator="select#1"} 15' in text
+    assert "pathway_current_timestamp 7" in text
+    assert text.rstrip().endswith("# EOF")
+
+
+def test_monitoring_http_endpoint():
+    from pathway_tpu.internals.monitoring import (
+        StatsMonitor,
+        start_http_server_thread,
+    )
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    mon = StatsMonitor()
+    mon.record_flush("groupby#3", 42, 0.01)
+    server = start_http_server_thread(mon, port=port)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=5
+        ).read().decode()
+        assert 'operator="groupby#3"' in body
+    finally:
+        server.shutdown()
+
+
+def test_engine_monitor_records_during_run():
+    from pathway_tpu.internals.monitoring import StatsMonitor
+    from pathway_tpu.internals.runtime import GraphRunner
+    from pathway_tpu.internals.engine import OutputNode
+
+    t = dbg.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    out_table = t.select(b=t.a + 1)
+    runner = GraphRunner()
+    out_node = OutputNode()
+    engine = runner.build([(out_table, out_node)])
+    engine.monitor = StatsMonitor()
+    engine.run_all()
+    snap = engine.monitor.snapshot()
+    assert any("select" in name for name in snap["nodes"])
+    assert sum(st["rows"] for st in snap["nodes"].values()) > 0
+
+
+# ---------------------------------------------------------------------------
+# YAML templates
+# ---------------------------------------------------------------------------
+
+
+def test_load_yaml_instantiates_components():
+    template = """
+$embedder: !pw.xpacks.llm.mocks.FakeEmbedder
+  dim: 8
+chat: !pw.xpacks.llm.mocks.IdentityMockChat {}
+embedder: $embedder
+splitter: !pw.xpacks.llm.splitters.TokenCountSplitter
+  min_tokens: 3
+  max_tokens: 10
+"""
+    app = pw.load_yaml(template)
+    from pathway_tpu.xpacks.llm import mocks, splitters
+
+    assert isinstance(app["chat"], mocks.IdentityMockChat)
+    assert isinstance(app["embedder"], mocks.FakeEmbedder)
+    assert app["embedder"].dim == 8
+    assert isinstance(app["splitter"], splitters.TokenCountSplitter)
+    assert app["splitter"].max_tokens == 10
+
+
+def test_load_yaml_variable_passed_into_component():
+    template = """
+$llm: !pw.xpacks.llm.mocks.FakeChatModel
+  response: canned
+reranker: !pw.xpacks.llm.rerankers.LLMReranker
+  llm: $llm
+"""
+    app = pw.load_yaml(template)
+    from pathway_tpu.xpacks.llm import mocks, rerankers
+
+    assert isinstance(app["reranker"], rerankers.LLMReranker)
+    assert isinstance(app["reranker"].llm, mocks.FakeChatModel)
+    assert app["reranker"].llm.response == "canned"
+
+
+def test_load_yaml_bad_tag_raises():
+    with pytest.raises(ValueError, match="cannot resolve"):
+        pw.load_yaml("x: !pw.totally.bogus.path {}")
