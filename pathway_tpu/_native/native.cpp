@@ -1,0 +1,209 @@
+// pathway_tpu host-runtime native core.
+//
+// TPU-era equivalent of the reference's Rust hot paths: 128-bit key
+// derivation (src/engine/value.rs Key::for_values — SipHash there,
+// BLAKE2b-128 here to match the Python hashlib fallback bit-for-bit) and
+// the hashing tokenizer's batch encode (models/tokenizer.py), which
+// dominates host time in the embedding ingest path.
+//
+// Built by pathway_tpu/_native/__init__.py with g++ -O3 -shared -fPIC;
+// every exported function has a pure-Python fallback with identical
+// semantics, so the library is an accelerator, never a requirement.
+
+#include <cstdint>
+#include <cstring>
+
+// ---------------------------------------------------------------------------
+// BLAKE2b (RFC 7693), fixed 16-byte digest, no key — matches
+// hashlib.blake2b(data, digest_size=16).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint64_t kIV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+};
+
+constexpr uint8_t kSigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+};
+
+inline uint64_t rotr64(uint64_t x, int n) { return (x >> n) | (x << (64 - n)); }
+
+inline uint64_t load64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);  // little-endian hosts only (x86_64/aarch64)
+  return v;
+}
+
+struct Blake2bState {
+  uint64_t h[8];
+  uint64_t t[2];
+  uint8_t buf[128];
+  size_t buflen;
+};
+
+void g(uint64_t* v, int a, int b, int c, int d, uint64_t x, uint64_t y) {
+  v[a] = v[a] + v[b] + x;
+  v[d] = rotr64(v[d] ^ v[a], 32);
+  v[c] = v[c] + v[d];
+  v[b] = rotr64(v[b] ^ v[c], 24);
+  v[a] = v[a] + v[b] + y;
+  v[d] = rotr64(v[d] ^ v[a], 16);
+  v[c] = v[c] + v[d];
+  v[b] = rotr64(v[b] ^ v[c], 63);
+}
+
+void compress(Blake2bState* s, const uint8_t block[128], bool last) {
+  uint64_t m[16];
+  for (int i = 0; i < 16; i++) m[i] = load64(block + 8 * i);
+  uint64_t v[16];
+  for (int i = 0; i < 8; i++) v[i] = s->h[i];
+  for (int i = 0; i < 8; i++) v[i + 8] = kIV[i];
+  v[12] ^= s->t[0];
+  v[13] ^= s->t[1];
+  if (last) v[14] = ~v[14];
+  for (int r = 0; r < 12; r++) {
+    const uint8_t* sg = kSigma[r];
+    g(v, 0, 4, 8, 12, m[sg[0]], m[sg[1]]);
+    g(v, 1, 5, 9, 13, m[sg[2]], m[sg[3]]);
+    g(v, 2, 6, 10, 14, m[sg[4]], m[sg[5]]);
+    g(v, 3, 7, 11, 15, m[sg[6]], m[sg[7]]);
+    g(v, 0, 5, 10, 15, m[sg[8]], m[sg[9]]);
+    g(v, 1, 6, 11, 12, m[sg[10]], m[sg[11]]);
+    g(v, 2, 7, 8, 13, m[sg[12]], m[sg[13]]);
+    g(v, 3, 4, 9, 14, m[sg[14]], m[sg[15]]);
+  }
+  for (int i = 0; i < 8; i++) s->h[i] ^= v[i] ^ v[i + 8];
+}
+
+}  // namespace
+
+extern "C" void pw_blake2b128(const uint8_t* data, uint64_t len,
+                              uint8_t out[16]) {
+  Blake2bState s;
+  for (int i = 0; i < 8; i++) s.h[i] = kIV[i];
+  s.h[0] ^= 0x01010000ULL ^ 16ULL;  // digest_length=16, fanout=depth=1
+  s.t[0] = s.t[1] = 0;
+  s.buflen = 0;
+
+  // full blocks (keep the final block, even if full, for the last-flag pass)
+  while (len > 128) {
+    std::memcpy(s.buf, data, 128);
+    s.t[0] += 128;
+    if (s.t[0] < 128) s.t[1]++;
+    compress(&s, s.buf, false);
+    data += 128;
+    len -= 128;
+  }
+  std::memset(s.buf, 0, 128);
+  if (len > 0) std::memcpy(s.buf, data, len);
+  s.t[0] += len;
+  if (s.t[0] < len) s.t[1]++;
+  compress(&s, s.buf, true);
+  std::memcpy(out, s.h, 16);
+}
+
+// ---------------------------------------------------------------------------
+// Hashing tokenizer batch encode — byte-level, exact mirror of
+// models/tokenizer.HashTokenizer:
+//   word bytes: [A-Za-z0-9_] or >= 0x80; whitespace splits; any other
+//   byte is a single punctuation token.  Token id =
+//   N_SPECIAL + fnv1a64(bytes) % (vocab - N_SPECIAL).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr int32_t kPad = 0, kCls = 1, kSep = 2, kNSpecial = 4;
+
+inline bool is_ws(uint8_t c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+         c == '\v';
+}
+
+inline bool is_word(uint8_t c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_' || c >= 0x80;
+}
+
+inline uint64_t fnv1a64(const uint8_t* p, size_t n, bool lowercase) {
+  uint64_t h = 1469598103934665603ULL;
+  for (size_t i = 0; i < n; i++) {
+    uint8_t c = p[i];
+    if (lowercase && c >= 'A' && c <= 'Z') c += 32;
+    h ^= c;
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// emits up to max_out token ids, returns count
+int64_t tokenize(const uint8_t* text, int64_t len, int64_t vocab_size,
+                 bool lowercase, int32_t* out, int64_t max_out) {
+  const uint64_t mod = (uint64_t)(vocab_size - kNSpecial);
+  int64_t n_out = 0;
+  int64_t i = 0;
+  while (i < len && n_out < max_out) {
+    uint8_t c = text[i];
+    if (is_ws(c)) {
+      i++;
+      continue;
+    }
+    int64_t start = i;
+    if (is_word(c)) {
+      while (i < len && is_word(text[i])) i++;
+    } else {
+      i++;  // single punctuation byte
+    }
+    uint64_t h = fnv1a64(text + start, (size_t)(i - start), lowercase);
+    out[n_out++] = (int32_t)(kNSpecial + (int64_t)(h % mod));
+  }
+  return n_out;
+}
+
+}  // namespace
+
+extern "C" void pw_tokenize_batch(
+    const uint8_t** texts, const int64_t* text_lens, int64_t n,
+    const uint8_t** pairs, const int64_t* pair_lens,  // nullable
+    int64_t max_length, int64_t vocab_size, int lowercase,
+    int32_t* out_ids, int32_t* out_mask) {
+  for (int64_t row = 0; row < n; row++) {
+    int32_t* ids = out_ids + row * max_length;
+    int32_t* mask = out_mask + row * max_length;
+    std::memset(ids, 0, sizeof(int32_t) * (size_t)max_length);
+    std::memset(mask, 0, sizeof(int32_t) * (size_t)max_length);
+
+    int64_t pos = 0;
+    ids[pos++] = kCls;
+    pos += tokenize(texts[row], text_lens[row], vocab_size, lowercase,
+                    ids + pos, max_length - 2 - (pos - 1));
+    ids[pos++] = kSep;
+    if (pairs != nullptr) {
+      if (pos > max_length / 2) pos = max_length / 2;
+      pos += tokenize(pairs[row], pair_lens[row], vocab_size, lowercase,
+                      ids + pos, max_length - pos - 1);
+      if (pos < max_length) ids[pos++] = kSep;
+    }
+    for (int64_t j = 0; j < pos; j++) mask[j] = 1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// version stamp so the loader can invalidate stale cached builds
+// ---------------------------------------------------------------------------
+
+extern "C" int pw_native_abi_version() { return 1; }

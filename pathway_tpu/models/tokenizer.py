@@ -5,19 +5,51 @@ tokenizer (WordPiece, as the reference's sentence-transformers models do);
 offline it falls back to :class:`HashTokenizer` — a deterministic hashing
 tokenizer producing the same id for the same word across runs, which is
 enough for throughput benchmarking and for tests with fake embedders.
+
+The hash tokenizer is byte-level (whitespace splits; ``[A-Za-z0-9_]`` and
+all bytes >= 0x80 are word bytes; any other byte is a single punctuation
+token; FNV-1a 64 per token) so the C++ batch encoder
+(``_native/native.cpp pw_tokenize_batch``) and this Python fallback
+produce identical ids bit-for-bit.
 """
 
 from __future__ import annotations
 
-import hashlib
-import re
 from typing import Sequence
 
 import numpy as np
 
+try:  # hot-path C++ batch encoder
+    from pathway_tpu import _native
+except Exception:  # pragma: no cover - fallback always works
+    _native = None
+
 __all__ = ["HashTokenizer", "load_tokenizer"]
 
-_WORD_RE = re.compile(r"\w+|[^\w\s]", re.UNICODE)
+_FNV_OFFSET = 1469598103934665603
+_FNV_PRIME = 1099511628211
+_U64 = (1 << 64) - 1
+
+_WS = frozenset(b" \t\n\r\f\v")
+
+
+def _is_word_byte(c: int) -> bool:
+    return (
+        0x61 <= c <= 0x7A  # a-z
+        or 0x41 <= c <= 0x5A  # A-Z
+        or 0x30 <= c <= 0x39  # 0-9
+        or c == 0x5F  # _
+        or c >= 0x80
+    )
+
+
+def _fnv1a64(data: bytes, lowercase: bool) -> int:
+    h = _FNV_OFFSET
+    for c in data:
+        if lowercase and 0x41 <= c <= 0x5A:
+            c += 32
+        h = ((h ^ c) * _FNV_PRIME) & _U64
+    return h
 
 
 class HashTokenizer:
@@ -30,16 +62,26 @@ class HashTokenizer:
         self.vocab_size = vocab_size
         self.lowercase = lowercase
 
-    def _token_id(self, word: str) -> int:
-        h = int.from_bytes(
-            hashlib.blake2b(word.encode("utf-8"), digest_size=8).digest(), "little"
-        )
-        return self.N_SPECIAL + h % (self.vocab_size - self.N_SPECIAL)
-
     def tokenize(self, text: str) -> list[int]:
-        if self.lowercase:
-            text = text.lower()
-        return [self._token_id(w) for w in _WORD_RE.findall(text)]
+        data = text.encode("utf-8")
+        mod = self.vocab_size - self.N_SPECIAL
+        out: list[int] = []
+        i = 0
+        n = len(data)
+        while i < n:
+            c = data[i]
+            if c in _WS:
+                i += 1
+                continue
+            start = i
+            if _is_word_byte(c):
+                while i < n and _is_word_byte(data[i]):
+                    i += 1
+            else:
+                i += 1
+            h = _fnv1a64(data[start:i], self.lowercase)
+            out.append(self.N_SPECIAL + h % mod)
+        return out
 
     def encode_batch(
         self,
@@ -48,6 +90,14 @@ class HashTokenizer:
         pair: Sequence[str] | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Returns (ids[B,L], mask[B,L]) padded to ``max_length``."""
+        if _native is not None:
+            return _native.tokenize_batch(
+                [t.encode("utf-8") for t in texts],
+                max_length,
+                self.vocab_size,
+                self.lowercase,
+                [p.encode("utf-8") for p in pair] if pair is not None else None,
+            )
         ids_list = []
         for i, t in enumerate(texts):
             ids = [self.CLS] + self.tokenize(t)[: max_length - 2] + [self.SEP]
