@@ -85,19 +85,32 @@ class ParseGraph:
         return op_id
 
     def relevant_operators(self, outputs: "Iterable[Operator]") -> list[Operator]:
-        """Tree-shake: all transitive inputs of ``outputs``, in topo (id) order
-        (reference: parse_graph.py:27-103 ``relevant_nodes``)."""
-        seen: set[int] = set()
-        stack = list(outputs)
+        """Tree-shake: all transitive inputs of ``outputs``, topologically
+        ordered (reference: parse_graph.py:27-103 ``relevant_nodes``).
+
+        Ordered by object-identity DFS, not by op id: ids restart inside
+        ``scoped()`` graphs, so an iterate body referencing outer-scope
+        tables would otherwise collide with same-id scoped ops."""
+        order: list[Operator] = []
+        done: set[int] = set()
+        # iterative DFS postorder: (op, expanded) entries
+        stack: list[tuple[Operator, bool]] = [(op, False) for op in outputs]
         while stack:
-            op = stack.pop()
-            if op.id in seen:
+            op, expanded = stack.pop()
+            if id(op) in done:
                 continue
-            seen.add(op.id)
-            stack.extend(op.input_operators())
+            if expanded:
+                done.add(id(op))
+                order.append(op)
+                continue
+            stack.append((op, True))
+            for dep in op.input_operators():
+                if id(dep) not in done:
+                    stack.append((dep, False))
             for extra in op.params.get("extra_input_tables", ()):  # iterate bodies
-                stack.append(extra._operator)
-        return [self.operators[i] for i in sorted(seen)]
+                if id(extra._operator) not in done:
+                    stack.append((extra._operator, False))
+        return order
 
     def scoped(self):
         """Context manager: run graph-building code in an isolated scope

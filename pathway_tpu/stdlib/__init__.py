@@ -1,4 +1,4 @@
-from . import indexing, ml, temporal, stateful, graphs, utils, statistical, ordered
+from . import indexing, ml, temporal, stateful, graphs, utils, statistical, ordered, viz
 
 __all__ = [
     "indexing",
@@ -9,4 +9,5 @@ __all__ = [
     "utils",
     "statistical",
     "ordered",
+    "viz",
 ]

@@ -1,6 +1,6 @@
 """Graph algorithms (reference: python/pathway/stdlib/graphs/: pagerank,
 bellman_ford, louvain — all built on pw.iterate)."""
 
-from . import pagerank, bellman_ford
+from . import pagerank, bellman_ford, louvain
 
-__all__ = ["pagerank", "bellman_ford"]
+__all__ = ["pagerank", "bellman_ford", "louvain"]

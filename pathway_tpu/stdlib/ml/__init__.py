@@ -1,4 +1,11 @@
-from . import index
+from . import index, smart_table_ops
 from .index import KNNIndex
+from .smart_table_ops import fuzzy_match_tables, fuzzy_self_match
 
-__all__ = ["index", "KNNIndex"]
+__all__ = [
+    "index",
+    "KNNIndex",
+    "smart_table_ops",
+    "fuzzy_match_tables",
+    "fuzzy_self_match",
+]

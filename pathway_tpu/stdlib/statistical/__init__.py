@@ -1,11 +1,92 @@
-"""Statistical helpers (reference: python/pathway/stdlib/statistical/)."""
+"""Statistical helpers (reference: python/pathway/stdlib/statistical/
+``interpolate`` with ``InterpolateMode.LINEAR``)."""
 
 from __future__ import annotations
 
-__all__ = ["interpolate"]
+import enum
+
+from ...internals import dtype as dt
+from ...internals.desugaring import resolve_expression
+from ...internals.expression import ApplyExpression
+from ...internals.table import Table
+
+__all__ = ["interpolate", "InterpolateMode"]
 
 
-def interpolate(table, timestamp, *values, mode=None):
-    raise NotImplementedError(
-        "interpolate lands with the temporal/ordered milestone"
+class InterpolateMode(enum.Enum):
+    LINEAR = "linear"
+
+
+def interpolate(
+    table: Table, timestamp, *values, mode: InterpolateMode | None = None
+) -> Table:
+    """Fill None cells by linear interpolation along ``timestamp`` order;
+    edge gaps take the nearest known value (reference:
+    stdlib/statistical/__init__.py interpolate).
+
+    Implemented as a packed reduce + per-row rescan: the whole series is
+    gathered once per micro-batch and each row looks up its neighbors in
+    the packed copy — the diff engine re-runs this only when the series
+    changes.
+    """
+    import pathway_tpu as pw
+
+    if mode is not None and mode is not InterpolateMode.LINEAR:
+        raise ValueError(f"unsupported interpolate mode {mode!r}")
+    ts_e = resolve_expression(timestamp, table)
+    value_refs = [resolve_expression(v, table) for v in values]
+    names = [v.name for v in value_refs]
+
+    packed = table.reduce(
+        series=pw.reducers.tuple(pw.make_tuple(ts_e, *value_refs)),
     )
+
+    def interp(ts, row_vals, series):
+        pts = sorted(series or (), key=lambda p: p[0])
+        out = []
+        for i, v in enumerate(row_vals):
+            if v is not None:
+                out.append(v)
+                continue
+            known = [(p[0], p[1 + i]) for p in pts if p[1 + i] is not None]
+            prev = next_ = None
+            for t, kv in known:
+                if t <= ts:
+                    prev = (t, kv)
+                elif next_ is None:
+                    next_ = (t, kv)
+                    break
+            if prev is None and next_ is None:
+                out.append(None)
+            elif prev is None:
+                out.append(next_[1])
+            elif next_ is None:
+                out.append(prev[1])
+            elif next_[0] == prev[0]:
+                out.append(prev[1])
+            else:
+                frac = (ts - prev[0]) / (next_[0] - prev[0])
+                out.append(prev[1] + (next_[1] - prev[1]) * frac)
+        return tuple(out)
+
+    joined = table.join_left(packed, id=table.id)
+    with_filled = joined.select(
+        *[table[n] for n in table.column_names()],
+        _filled=ApplyExpression(
+            interp,
+            dt.ANY,
+            ts_e,
+            pw.make_tuple(*value_refs),
+            packed.series,
+        ),
+    )
+    out_exprs = {}
+    for n in table.column_names():
+        if n in names:
+            i = names.index(n)
+            out_exprs[n] = ApplyExpression(
+                lambda f, i=i: f[i], dt.Optional(dt.FLOAT), with_filled["_filled"]
+            )
+        else:
+            out_exprs[n] = with_filled[n]
+    return with_filled._select_exprs(out_exprs, universe=with_filled._universe)

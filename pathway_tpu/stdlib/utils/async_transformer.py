@@ -1,0 +1,154 @@
+"""AsyncTransformer — non-row-wise async table transformation.
+
+reference: python/pathway/stdlib/utils/async_transformer.py:282
+(``AsyncTransformer`` with its own input/output streaming session,
+``successful``/``failed``/``finished`` result views, ``with_options``).
+
+Here the transformer rides the engine's AsyncMapNode (the same bounded
+fan-out path as async UDFs): every input row awaits ``invoke`` concurrently
+within a micro-batch; failures become rows of ``failed`` instead of
+aborting the run.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals import dtype as dt
+from ...internals.expression import ApplyExpression, AsyncApplyExpression
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+from ...internals.udfs import (
+    AsyncRetryStrategy,
+    CacheStrategy,
+    with_cache_strategy,
+    with_retry_strategy,
+)
+
+__all__ = ["AsyncTransformer"]
+
+
+class AsyncTransformer:
+    """Subclass with ``output_schema`` and an async ``invoke``::
+
+        class Upper(pw.AsyncTransformer, output_schema=OutSchema):
+            async def invoke(self, text: str) -> dict:
+                return {"result": text.upper()}
+
+        out = Upper(input_table).successful
+    """
+
+    output_schema: SchemaMetaclass | None = None
+
+    def __init_subclass__(cls, /, output_schema: SchemaMetaclass | None = None, **kw):
+        super().__init_subclass__(**kw)
+        if output_schema is not None:
+            cls.output_schema = output_schema
+
+    def __init__(self, input_table: Table, *, instance: Any = None):
+        if self.output_schema is None:
+            raise ValueError(
+                "AsyncTransformer subclass must declare output_schema"
+            )
+        self.input_table = input_table
+        self._capacity: int | None = None
+        self._retry_strategy: AsyncRetryStrategy | None = None
+        self._cache_strategy: CacheStrategy | None = None
+        self._built: dict[str, Table] | None = None
+
+    def with_options(
+        self,
+        capacity: int | None = None,
+        retry_strategy: AsyncRetryStrategy | None = None,
+        cache_strategy: CacheStrategy | None = None,
+    ) -> "AsyncTransformer":
+        """reference: async_transformer.py ``with_options``"""
+        self._capacity = capacity
+        self._retry_strategy = retry_strategy
+        self._cache_strategy = cache_strategy
+        self._built = None
+        return self
+
+    async def invoke(self, *args, **kwargs) -> dict:
+        raise NotImplementedError
+
+    # -- wiring --
+    def _build(self) -> dict[str, Table]:
+        if self._built is not None:
+            return self._built
+        table = self.input_table
+        in_cols = table.column_names()
+        out_cols = list(self.output_schema.column_names())
+
+        inner = self.invoke
+        if self._retry_strategy is not None:
+            inner = with_retry_strategy(inner, self._retry_strategy)
+        if self._cache_strategy is not None:
+            inner = with_cache_strategy(inner, self._cache_strategy)
+
+        async def call(*vals):
+            try:
+                result = await inner(**dict(zip(in_cols, vals)))
+                return ("ok", tuple(result.get(n) for n in out_cols))
+            except Exception as exc:  # noqa: BLE001 — routed to .failed
+                return ("error", str(exc))
+
+        expr = AsyncApplyExpression(
+            call, dt.ANY, *[table[c] for c in in_cols]
+        )
+        expr.capacity = self._capacity  # type: ignore[attr-defined]
+        raw = table.select(_result=expr)
+
+        ok = raw.filter(
+            ApplyExpression(lambda r: r[0] == "ok", dt.BOOL, raw["_result"])
+        )
+        successful = ok._select_exprs(
+            {
+                n: ApplyExpression(
+                    lambda r, i=i: r[1][i],
+                    self.output_schema[n].dtype,
+                    ok["_result"],
+                )
+                for i, n in enumerate(out_cols)
+            },
+            universe=ok._universe,
+        )
+        failed = raw.filter(
+            ApplyExpression(lambda r: r[0] == "error", dt.BOOL, raw["_result"])
+        )
+        failed = failed._select_exprs(
+            {
+                "error": ApplyExpression(
+                    lambda r: r[1], dt.STR, failed["_result"]
+                )
+            },
+            universe=failed._universe,
+        )
+        finished = raw._select_exprs(
+            {
+                "ok": ApplyExpression(lambda r: r[0] == "ok", dt.BOOL, raw["_result"]),
+            },
+            universe=raw._universe,
+        )
+        self._built = dict(successful=successful, failed=failed, finished=finished)
+        return self._built
+
+    @property
+    def successful(self) -> Table:
+        """Rows whose ``invoke`` completed, with ``output_schema`` columns."""
+        return self._build()["successful"]
+
+    @property
+    def failed(self) -> Table:
+        """Rows whose ``invoke`` raised, with the error string."""
+        return self._build()["failed"]
+
+    @property
+    def finished(self) -> Table:
+        """All processed rows with an ``ok`` flag."""
+        return self._build()["finished"]
+
+    @property
+    def output_table(self) -> Table:
+        """reference: async_transformer.py:477 ``output_table``"""
+        return self.successful

@@ -1,0 +1,68 @@
+"""Live visualization (reference: python/pathway/stdlib/viz/ — bokeh/panel
+plots over streaming tables, ``table.plot`` / ``table.show``).
+
+The bokeh/panel stack is optional; without it the helpers degrade to a
+textual snapshot so notebooks in this image still get output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ...internals.table import Table
+
+__all__ = ["plot", "show", "table_viz"]
+
+
+def _try_panel():
+    try:
+        import bokeh  # noqa: F401
+        import panel  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def plot(table: Table, plotting_function: Callable, sorting_col=None) -> Any:
+    """Live bokeh plot of a streaming table
+    (reference: stdlib/viz plot — updates as diffs arrive)."""
+    if not _try_panel():
+        raise ImportError(
+            "table.plot requires bokeh + panel; neither is installed in "
+            "this image — use pw.debug.compute_and_print or pw.io.subscribe"
+        )
+    import bokeh.models
+    import panel as pn
+
+    source = bokeh.models.ColumnDataSource(data={n: [] for n in table.column_names()})
+    fig = plotting_function(source)
+    import pathway_tpu as pw
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            source.stream({n: [row[n]] for n in table.column_names()})
+
+    pw.io.subscribe(table, on_change=on_change)
+    return pn.pane.Bokeh(fig)
+
+
+def show(table: Table, *, include_id: bool = True, short_pointers: bool = True) -> Any:
+    """Notebook widget of the table's current state; plain print fallback
+    (reference: stdlib/viz show / table_viz)."""
+    if _try_panel():
+        import panel as pn
+
+        import pathway_tpu.debug as dbg
+
+        df = dbg.table_to_pandas(table)
+        return pn.widgets.DataFrame(df)
+    import pathway_tpu.debug as dbg
+
+    dbg.compute_and_print(
+        table, include_id=include_id, short_pointers=short_pointers
+    )
+    return None
+
+
+table_viz = show

@@ -1,0 +1,190 @@
+"""stdlib breadth: AsyncTransformer, louvain, interpolate, fuzzy join.
+
+reference test models: python/pathway/tests/test_utils (async transformer),
+stdlib/graphs tests, statistical interpolate doctests, ml fuzzy join tests.
+"""
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+
+
+# ---------------------------------------------------------------------------
+# AsyncTransformer
+# ---------------------------------------------------------------------------
+
+
+class _UpperSchema(pw.Schema):
+    result: str
+    length: int
+
+
+def test_async_transformer_successful():
+    from pathway_tpu.stdlib.utils import AsyncTransformer
+
+    class Upper(AsyncTransformer, output_schema=_UpperSchema):
+        async def invoke(self, text: str) -> dict:
+            return {"result": text.upper(), "length": len(text)}
+
+    t = dbg.table_from_markdown(
+        """
+        text
+        hello
+        world
+        """
+    )
+    out = Upper(t).successful
+    _, cols = dbg.table_to_dicts(out)
+    assert sorted(cols["result"].values()) == ["HELLO", "WORLD"]
+    assert sorted(cols["length"].values()) == [5, 5]
+
+
+def test_async_transformer_failures_routed():
+    from pathway_tpu.stdlib.utils import AsyncTransformer
+
+    class Picky(AsyncTransformer, output_schema=_UpperSchema):
+        async def invoke(self, text: str) -> dict:
+            if text == "bad":
+                raise ValueError("nope")
+            return {"result": text.upper(), "length": len(text)}
+
+    t = dbg.table_from_markdown(
+        """
+        text
+        ok
+        bad
+        """
+    )
+    transformer = Picky(t)
+    _, ok_cols = dbg.table_to_dicts(transformer.successful)
+    pw.global_graph  # keep graph alive across both materializations
+    assert list(ok_cols["result"].values()) == ["OK"]
+
+
+def test_async_transformer_requires_schema():
+    from pathway_tpu.stdlib.utils import AsyncTransformer
+
+    class NoSchema(AsyncTransformer):
+        async def invoke(self, text: str) -> dict:
+            return {}
+
+    t = dbg.table_from_markdown(
+        """
+        text
+        x
+        """
+    )
+    with pytest.raises(ValueError, match="output_schema"):
+        NoSchema(t)
+
+
+# ---------------------------------------------------------------------------
+# louvain
+# ---------------------------------------------------------------------------
+
+
+def test_louvain_two_cliques():
+    from pathway_tpu.stdlib.graphs.louvain import louvain_level
+
+    # two triangles joined by one weak edge
+    rows = [
+        ("a", "b"), ("b", "c"), ("a", "c"),
+        ("x", "y"), ("y", "z"), ("x", "z"),
+        ("c", "x"),
+    ]
+    edges = dbg.table_from_rows(
+        pw.schema_from_types(un=str, vn=str), rows
+    )
+    edges = edges.select(
+        u=edges.pointer_from(edges.un), v=edges.pointer_from(edges.vn),
+        un=edges.un,
+    )
+    result = louvain_level(edges, iteration_limit=10)
+    # map vertex name -> community
+    named = edges.groupby(edges.u).reduce(u=edges.u, name=pw.reducers.unique(edges.un))
+    _, cols = dbg.table_to_dicts(result)
+    _, name_cols = dbg.table_to_dicts(named.with_id_from(named.u))
+    comm_by_vertex = {}
+    for key, comm in cols["community"].items():
+        comm_by_vertex[key] = comm
+    # vertices of each triangle should agree internally
+    def communities(names):
+        out = set()
+        for key, nm in name_cols["name"].items():
+            if nm in names:
+                out.add(comm_by_vertex[key])
+        return out
+
+    assert len(communities({"a", "b", "c"})) == 1
+    assert len(communities({"x", "y", "z"})) == 1
+
+
+# ---------------------------------------------------------------------------
+# interpolate
+# ---------------------------------------------------------------------------
+
+
+def test_interpolate_linear():
+    t = dbg.table_from_markdown(
+        """
+        ts | v
+        0  | 0.0
+        2  |
+        4  | 4.0
+        6  |
+        """
+    )
+    out = t.interpolate(t.ts, t.v)
+    _, cols = dbg.table_to_dicts(out)
+    by_ts = {}
+    _, ts_cols = dbg.table_to_dicts(out)
+    for key in cols["v"]:
+        by_ts[ts_cols["ts"][key]] = cols["v"][key]
+    assert by_ts[0] == 0.0
+    assert by_ts[2] == pytest.approx(2.0)  # midpoint of 0 and 4
+    assert by_ts[4] == 4.0
+    assert by_ts[6] == 4.0  # trailing gap takes nearest known
+
+
+# ---------------------------------------------------------------------------
+# fuzzy join
+# ---------------------------------------------------------------------------
+
+
+def test_fuzzy_match_tables():
+    left = dbg.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("Apple Inc",), ("Alphabet Google",)],
+    )
+    right = dbg.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("apple incorporated",), ("google llc",), ("unrelated corp",)],
+    )
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    matches = fuzzy_match_tables(left, right)
+    _, cols = dbg.table_to_dicts(matches)
+    _, lcols = dbg.table_to_dicts(left)
+    _, rcols = dbg.table_to_dicts(right)
+    left_names = {k: v for k, v in lcols["name"].items()}
+    right_names = {k: v for k, v in rcols["name"].items()}
+    pairs = {
+        (left_names[row_l], right_names[row_r])
+        for row_l, row_r in zip(cols["left"].values(), cols["right"].values())
+    }
+    assert ("Apple Inc", "apple incorporated") in pairs
+    assert ("Alphabet Google", "google llc") in pairs
+
+
+def test_fuzzy_self_match():
+    t = dbg.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("data pipeline",), ("data pipelines",), ("totally different",)],
+    )
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_self_match
+
+    matches = fuzzy_self_match(t, t.name, threshold=0.1)
+    _, cols = dbg.table_to_dicts(matches)
+    assert len(cols["weight"]) >= 1
+    assert all(w > 0.1 for w in cols["weight"].values())
