@@ -1,24 +1,62 @@
 """``pw.io`` — connectors.
 
-reference: python/pathway/io/ (29 modules).  Implemented natively here:
-fs, csv, jsonlines, plaintext, python, http (REST), null, subscribe.
-Long-tail service connectors (kafka, s3, …) follow the same
-``ConnectorSubject`` protocol (``streaming.py``).
+reference: python/pathway/io/ (29 modules).  Zero-dependency connectors
+(fs, csv, jsonlines, plaintext, python, http, sqlite, null, slack,
+logstash, subscribe) are fully live; service connectors (kafka, redpanda,
+debezium, postgres, elasticsearch, mongodb, nats, pubsub, bigquery,
+deltalake, s3/s3_csv/minio, gdrive, airbyte, pyfilesystem) follow the same
+``ConnectorSubject`` protocol and import their client library at call
+time (none are baked into this image).
 """
 
-from . import csv, fs, http, jsonlines, null, plaintext, python
+from . import csv, fs, http, jsonlines, null, plaintext, python, sqlite
 from ._subscribe import subscribe
 from .streaming import ConnectorSubject, StreamingDriver
 
-__all__ = [
-    "csv",
-    "fs",
-    "http",
-    "jsonlines",
-    "null",
-    "plaintext",
-    "python",
-    "subscribe",
-    "ConnectorSubject",
-    "StreamingDriver",
-]
+_LAZY = {
+    "kafka",
+    "redpanda",
+    "debezium",
+    "postgres",
+    "elasticsearch",
+    "logstash",
+    "mongodb",
+    "nats",
+    "pubsub",
+    "bigquery",
+    "deltalake",
+    "s3",
+    "s3_csv",
+    "minio",
+    "gdrive",
+    "slack",
+    "airbyte",
+    "pyfilesystem",
+}
+
+__all__ = sorted(
+    [
+        "csv",
+        "fs",
+        "http",
+        "jsonlines",
+        "null",
+        "plaintext",
+        "python",
+        "sqlite",
+        "subscribe",
+        "ConnectorSubject",
+        "StreamingDriver",
+        *_LAZY,
+    ]
+)
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,79 @@
+"""``pw.io.airbyte`` — Airbyte-sourced streams.
+
+reference: python/pathway/io/airbyte (341 LoC + vendored
+airbyte_serverless) — runs an Airbyte source connector (docker or pypi
+flavor) and ingests its record messages.  This port drives a
+locally-installed ``airbyte`` pypi source package at call time; the
+docker flavor needs a docker runtime and is not wired in this image.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+from ...internals.schema import schema_from_types
+from ...internals.table import Table
+from .._utils import input_table
+from ...internals.keys import ref_scalar
+from ...internals.value import Json
+from ..streaming import ConnectorSubject
+
+__all__ = ["read"]
+
+
+class _AirbyteSubject(ConnectorSubject):
+    def __init__(self, source, streams, mode, refresh_s, autocommit_ms):
+        super().__init__(datasource_name=f"airbyte:{streams}")
+        self.source = source
+        self.streams = streams
+        self._mode = "static" if mode == "static" else "streaming"
+        self.refresh_s = refresh_s
+        self._autocommit_ms = autocommit_ms
+        self._counter = 0
+
+    def _sync_once(self) -> None:
+        for record in self.source.extract(self.streams):
+            data = getattr(record, "record", record)
+            payload = getattr(data, "data", data)
+            self._counter += 1
+            key = ref_scalar("__airbyte__", self._counter)
+            self._add_inner(key, (Json(payload),))
+        self.commit()
+
+    def run(self) -> None:
+        self._sync_once()
+        if self._mode == "static":
+            return
+        while not self._closed.is_set():
+            _time.sleep(self.refresh_s)
+            self._sync_once()
+
+
+def read(
+    config_file_path: str | None = None,
+    streams: list[str] | None = None,
+    *,
+    source: Any = None,
+    mode: str = "streaming",
+    refresh_interval_ms: int = 60_000,
+    autocommit_duration_ms: int | None = 1500,
+    **kwargs: Any,
+) -> Table:
+    """Each record becomes one row with a ``data`` Json column
+    (reference: io/airbyte read)."""
+    if source is None:
+        import yaml
+
+        from airbyte_serverless.sources import Source  # optional dependency
+
+        with open(config_file_path) as f:
+            config = yaml.safe_load(f)
+        source = Source(**config.get("source", config))
+    schema = schema_from_types(data=Json)
+    subject = _AirbyteSubject(
+        source, streams or [], mode, refresh_interval_ms / 1000.0,
+        autocommit_duration_ms,
+    )
+    subject._configure(schema, None)
+    return input_table(schema, subject=subject)

@@ -1,0 +1,31 @@
+"""``pw.io.bigquery`` — BigQuery sink
+(reference: python/pathway/io/bigquery).  Needs ``google-cloud-bigquery``.
+"""
+
+from __future__ import annotations
+
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["write"]
+
+
+def write(table: Table, dataset_name: str, table_name: str, service_user_credentials_file: str | None = None, **kwargs) -> None:
+    from google.cloud import bigquery  # optional dependency
+
+    if service_user_credentials_file is not None:
+        client = bigquery.Client.from_service_account_json(service_user_credentials_file)
+    else:
+        client = bigquery.Client()
+    names = table.column_names()
+    target = f"{dataset_name}.{table_name}"
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        doc = {n: row[n] for n in names}
+        doc["time"] = time
+        doc["diff"] = 1 if is_addition else -1
+        errors = client.insert_rows_json(target, [doc])
+        if errors:
+            raise RuntimeError(f"bigquery insert failed: {errors}")
+
+    subscribe(table, on_change=on_change, name=f"bq:{target}")

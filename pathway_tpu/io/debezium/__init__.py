@@ -1,0 +1,65 @@
+"""``pw.io.debezium`` — CDC ingestion from Debezium-formatted Kafka topics.
+
+reference: python/pathway/io/debezium over the Rust debezium format
+(src/connectors/data_format.rs: DebeziumMessageParser — envelope ``op``
+c/r/u/d becomes insert / insert / retract+insert / retract diffs).
+Needs ``confluent_kafka`` at call time.
+"""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any
+
+from ...internals.schema import SchemaMetaclass
+from .._utils import coerce_row, input_table
+from ...internals.keys import ref_scalar
+from ...internals.table import Table
+from ..kafka import _KafkaSubject
+
+__all__ = ["read"]
+
+
+class _DebeziumSubject(_KafkaSubject):
+    def _emit(self, payload: bytes, msg_key: bytes | None) -> None:
+        envelope = _json.loads(payload)
+        body = envelope.get("payload", envelope)
+        op = body.get("op", "c")
+        before = body.get("before")
+        after = body.get("after")
+
+        def to_entry(rec):
+            row = coerce_row(self.row_schema, rec)
+            values = tuple(row.get(n) for n in self._column_names)
+            if self._primary_key:
+                key = ref_scalar(*[row.get(c) for c in self._primary_key])
+            else:
+                key = ref_scalar("__dbz__", self.topic, _json.dumps(rec, sort_keys=True, default=str))
+            return key, values
+
+        if op in ("c", "r") and after is not None:
+            self._add_inner(*to_entry(after))
+        elif op == "u":
+            if before is not None:
+                self._remove(*to_entry(before))
+            if after is not None:
+                self._add_inner(*to_entry(after))
+        elif op == "d" and before is not None:
+            self._remove(*to_entry(before))
+
+
+def read(
+    rdkafka_settings: dict,
+    topic_name: str,
+    *,
+    schema: SchemaMetaclass,
+    autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    subject = _DebeziumSubject(
+        rdkafka_settings, topic_name, "json", schema, autocommit_duration_ms
+    )
+    subject.persistent_id = persistent_id
+    subject._configure(schema, schema.primary_key_columns())
+    return input_table(schema, subject=subject)

@@ -1,0 +1,102 @@
+"""``pw.io.deltalake`` — Delta Lake connector.
+
+reference: python/pathway/io/deltalake over the Rust
+``DeltaTableWriter``/``DeltaTableReader`` (src/connectors/
+data_storage.rs:1621/1924, DeltaVersion offsets).  Needs ``deltalake``.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+from .._subscribe import subscribe
+from .._utils import coerce_row, input_table
+from ...internals.keys import ref_scalar
+from ..streaming import ConnectorSubject, next_autogen_key
+
+__all__ = ["read", "write"]
+
+
+class _DeltaSubject(ConnectorSubject):
+    def __init__(self, uri, schema, mode, refresh_s, autocommit_ms):
+        super().__init__(datasource_name=f"delta:{uri}")
+        self.uri = uri
+        self.row_schema = schema
+        self._mode = "static" if mode == "static" else "streaming"
+        self.refresh_s = refresh_s
+        self._autocommit_ms = autocommit_ms
+        self._version = -1
+
+    def _load(self) -> bool:
+        from deltalake import DeltaTable  # optional dependency
+
+        dt = DeltaTable(self.uri)
+        version = dt.version()
+        if version == self._version:
+            return False
+        records = dt.to_pyarrow_table().to_pylist()
+        emitted = False
+        for rec in records[self._count if hasattr(self, "_count") else 0:]:
+            row = coerce_row(self.row_schema, rec)
+            values = tuple(row.get(n) for n in self._column_names)
+            if self._primary_key:
+                key = ref_scalar(*[row.get(c) for c in self._primary_key])
+            else:
+                key = next_autogen_key("delta")
+            self._add_inner(key, values)
+            emitted = True
+        self._count = len(records)
+        self._version = version
+        if emitted:
+            self.commit()
+        return emitted
+
+    def run(self) -> None:
+        self._load()
+        if self._mode == "static":
+            return
+        while not self._closed.is_set():
+            _time.sleep(self.refresh_s)
+            self._load()
+
+    def current_offsets(self):
+        return {"version": self._version, "count": getattr(self, "_count", 0)}
+
+    def seek(self, offsets) -> None:
+        if offsets:
+            self._version = offsets.get("version", -1)
+            self._count = offsets.get("count", 0)
+
+
+def read(uri: str, *, schema: SchemaMetaclass, mode: str = "streaming", refresh_interval: float = 5.0, autocommit_duration_ms: int | None = 1500, persistent_id: str | None = None, **kwargs: Any) -> Table:
+    subject = _DeltaSubject(uri, schema, mode, refresh_interval, autocommit_duration_ms)
+    subject.persistent_id = persistent_id
+    subject._configure(schema, schema.primary_key_columns())
+    return input_table(schema, subject=subject)
+
+
+def write(table: Table, uri: str, *, min_commit_frequency: int | None = 60_000, **kwargs) -> None:
+    import pyarrow as pa  # optional dependency
+    from deltalake import write_deltalake  # optional dependency
+
+    names = table.column_names()
+    buffer: list[dict] = []
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        doc = {n: row[n] for n in names}
+        doc["time"] = time
+        doc["diff"] = 1 if is_addition else -1
+        buffer.append(doc)
+
+    def flush() -> None:
+        if buffer:
+            write_deltalake(uri, pa.Table.from_pylist(buffer), mode="append")
+            buffer.clear()
+
+    def on_time_end(time: int) -> None:
+        flush()
+
+    subscribe(table, on_change=on_change, on_time_end=on_time_end, on_end=flush, name=f"delta:{uri}")

@@ -1,0 +1,33 @@
+"""``pw.io.elasticsearch`` — Elasticsearch sink.
+
+reference: python/pathway/io/elasticsearch over the Rust
+``ElasticSearchWriter`` (src/connectors/data_storage.rs:1336).
+Needs the ``elasticsearch`` client at call time.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["write"]
+
+
+def write(table: Table, host: str, auth: Any = None, index_name: str = "pathway", **kwargs) -> None:
+    from elasticsearch import Elasticsearch  # optional dependency
+
+    client_kwargs: dict = {"hosts": [host], **kwargs}
+    if auth is not None:
+        client_kwargs["basic_auth"] = auth
+    client = Elasticsearch(**client_kwargs)
+    names = table.column_names()
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        doc = {n: row[n] for n in names}
+        doc["time"] = time
+        doc["diff"] = 1 if is_addition else -1
+        client.index(index=index_name, document=doc)
+
+    subscribe(table, on_change=on_change, name=f"es:{index_name}")

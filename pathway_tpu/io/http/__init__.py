@@ -3,6 +3,13 @@
 reference: python/pathway/io/http/ (rest_connector:624, PathwayWebserver:329).
 """
 
+from ._client import read, write
 from ._server import EndpointDocumentation, PathwayWebserver, rest_connector
 
-__all__ = ["EndpointDocumentation", "PathwayWebserver", "rest_connector"]
+__all__ = [
+    "EndpointDocumentation",
+    "PathwayWebserver",
+    "read",
+    "rest_connector",
+    "write",
+]

@@ -1,0 +1,14 @@
+"""``pw.io.logstash`` — Logstash HTTP-input sink
+(reference: python/pathway/io/logstash — a thin wrapper over the HTTP
+sink pointed at logstash's http input plugin)."""
+
+from __future__ import annotations
+
+from ...internals.table import Table
+from ..http._client import write as _http_write
+
+__all__ = ["write"]
+
+
+def write(table: Table, endpoint: str, n_retries: int = 0, **kwargs) -> None:
+    _http_write(table, endpoint, **kwargs)

@@ -1,0 +1,38 @@
+"""``pw.io.minio`` — MinIO via the S3 protocol (reference: python/pathway/io/minio)."""
+
+from __future__ import annotations
+
+from ..s3 import AwsS3Settings
+from ..s3 import read as _s3_read
+
+__all__ = ["read", "MinIOSettings"]
+
+
+class MinIOSettings:
+    def __init__(self, endpoint, bucket_name, access_key, secret_access_key, *, with_path_style=True, region=None):
+        self.endpoint = endpoint
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.with_path_style = with_path_style
+        self.region = region
+
+    def create_aws_settings(self) -> AwsS3Settings:
+        endpoint = self.endpoint
+        if not endpoint.startswith("http"):
+            endpoint = "https://" + endpoint
+        return AwsS3Settings(
+            bucket_name=self.bucket_name,
+            access_key=self.access_key,
+            secret_access_key=self.secret_access_key,
+            region=self.region,
+            endpoint=endpoint,
+            with_path_style=self.with_path_style,
+        )
+
+
+def read(path, minio_settings: MinIOSettings, *, format="csv", schema=None, mode="streaming", **kwargs):
+    return _s3_read(
+        path, aws_s3_settings=minio_settings.create_aws_settings(),
+        format=format, schema=schema, mode=mode, **kwargs,
+    )

@@ -1,0 +1,28 @@
+"""``pw.io.mongodb`` — MongoDB sink.
+
+reference: python/pathway/io/mongodb over the Rust ``MongoWriter``
+(src/connectors/data_storage.rs:2232).  Needs ``pymongo`` at call time.
+"""
+
+from __future__ import annotations
+
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["write"]
+
+
+def write(table: Table, connection_string: str, database: str, collection: str, **kwargs) -> None:
+    import pymongo  # optional dependency
+
+    client = pymongo.MongoClient(connection_string)
+    coll = client[database][collection]
+    names = table.column_names()
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        doc = {n: row[n] for n in names}
+        doc["time"] = time
+        doc["diff"] = 1 if is_addition else -1
+        coll.insert_one(doc)
+
+    subscribe(table, on_change=on_change, on_end=client.close, name=f"mongo:{collection}")

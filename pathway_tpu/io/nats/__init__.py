@@ -1,0 +1,110 @@
+"""``pw.io.nats`` — NATS connector.
+
+reference: python/pathway/io/nats over the Rust ``NatsReader``/``NatsWriter``
+(src/connectors/data_storage.rs:2271/2345).  Needs ``nats-py`` at call time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json as _json
+from typing import Any
+
+from ...internals.schema import SchemaMetaclass, schema_from_types
+from ...internals.table import Table
+from .._subscribe import subscribe
+from .._utils import coerce_row, input_table
+from ...internals.keys import ref_scalar
+from ..streaming import ConnectorSubject, next_autogen_key
+
+__all__ = ["read", "write"]
+
+
+class _NatsSubject(ConnectorSubject):
+    def __init__(self, uri, topic, fmt, schema, autocommit_ms):
+        super().__init__(datasource_name=f"nats:{topic}")
+        self.uri = uri
+        self.topic = topic
+        self.fmt = fmt
+        self.row_schema = schema
+        self._autocommit_ms = autocommit_ms
+
+    def run(self) -> None:
+        import nats  # optional dependency
+
+        async def consume():
+            nc = await nats.connect(self.uri)
+            sub = await nc.subscribe(self.topic)
+            try:
+                while not self._closed.is_set():
+                    try:
+                        msg = await sub.next_msg(timeout=0.5)
+                    except Exception:
+                        continue
+                    payload = msg.data
+                    if self.fmt == "raw":
+                        row = {"data": payload}
+                    elif self.fmt == "plaintext":
+                        row = {"data": payload.decode(errors="replace")}
+                    else:
+                        row = coerce_row(self.row_schema, _json.loads(payload))
+                    values = tuple(row.get(n) for n in self._column_names)
+                    if self._primary_key:
+                        key = ref_scalar(*[row.get(c) for c in self._primary_key])
+                    else:
+                        key = next_autogen_key("nats")
+                    self._add_inner(key, values)
+                    self.commit()
+            finally:
+                await nc.close()
+
+        asyncio.run(consume())
+
+
+def read(
+    uri: str,
+    topic: str,
+    *,
+    schema: SchemaMetaclass | None = None,
+    format: str = "json",
+    autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    if format == "raw":
+        schema = schema_from_types(data=bytes)
+    elif format == "plaintext":
+        schema = schema_from_types(data=str)
+    elif schema is None:
+        raise ValueError(f"format {format!r} requires schema=")
+    subject = _NatsSubject(uri, topic, format, schema, autocommit_duration_ms)
+    subject.persistent_id = persistent_id
+    subject._configure(schema, schema.primary_key_columns())
+    return input_table(schema, subject=subject)
+
+
+def write(table: Table, uri: str, topic: str, *, format: str = "json", **kwargs) -> None:
+    import nats  # optional dependency
+
+    names = table.column_names()
+    loop = asyncio.new_event_loop()
+    nc_holder: list = []
+
+    def _ensure_nc():
+        if not nc_holder:
+            nc_holder.append(loop.run_until_complete(nats.connect(uri)))
+        return nc_holder[0]
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        payload = {n: row[n] for n in names}
+        payload["time"] = time
+        payload["diff"] = 1 if is_addition else -1
+        nc = _ensure_nc()
+        loop.run_until_complete(nc.publish(topic, _json.dumps(payload, default=str).encode()))
+
+    def on_end() -> None:
+        if nc_holder:
+            loop.run_until_complete(nc_holder[0].close())
+        loop.close()
+
+    subscribe(table, on_change=on_change, on_end=on_end, name=f"nats:{topic}")

@@ -1,0 +1,68 @@
+"""``pw.io.postgres`` — PostgreSQL sink.
+
+reference: python/pathway/io/postgres over the Rust ``PsqlWriter``
+(src/connectors/data_storage.rs:1080) — ``write`` appends the diff stream
+with time/diff columns, ``write_snapshot`` maintains the latest row per
+primary key.  Needs ``psycopg2`` (or psycopg) at call time.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["write", "write_snapshot"]
+
+
+def _connect(postgres_settings: dict):
+    try:
+        import psycopg2 as pg  # optional dependency
+    except ImportError:
+        import psycopg as pg  # optional dependency (v3)
+    return pg.connect(**postgres_settings)
+
+
+def write(table: Table, postgres_settings: dict, table_name: str, *, max_batch_size: int | None = None) -> None:
+    con = _connect(postgres_settings)
+    con.autocommit = True
+    names = table.column_names()
+    cols = ", ".join(names + ["time", "diff"])
+    ph = ", ".join(["%s"] * (len(names) + 2))
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        with con.cursor() as cur:
+            cur.execute(
+                f"INSERT INTO {table_name} ({cols}) VALUES ({ph})",
+                [row[n] for n in names] + [time, 1 if is_addition else -1],
+            )
+
+    subscribe(table, on_change=on_change, on_end=con.close, name=f"psql:{table_name}")
+
+
+def write_snapshot(table: Table, postgres_settings: dict, table_name: str, primary_key: list[str], *, max_batch_size: int | None = None) -> None:
+    con = _connect(postgres_settings)
+    con.autocommit = True
+    names = table.column_names()
+    cols = ", ".join(names)
+    ph = ", ".join(["%s"] * len(names))
+    conflict = ", ".join(primary_key)
+    updates = ", ".join(f"{n} = EXCLUDED.{n}" for n in names if n not in primary_key)
+    where = " AND ".join(f"{k} = %s" for k in primary_key)
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        with con.cursor() as cur:
+            if is_addition:
+                cur.execute(
+                    f"INSERT INTO {table_name} ({cols}) VALUES ({ph}) "
+                    f"ON CONFLICT ({conflict}) DO UPDATE SET {updates}",
+                    [row[n] for n in names],
+                )
+            else:
+                cur.execute(
+                    f"DELETE FROM {table_name} WHERE {where}",
+                    [row[k] for k in primary_key],
+                )
+
+    subscribe(table, on_change=on_change, on_end=con.close, name=f"psql:{table_name}")

@@ -1,0 +1,25 @@
+"""``pw.io.pubsub`` — Google Cloud Pub/Sub sink
+(reference: python/pathway/io/pubsub).  Needs ``google-cloud-pubsub``.
+"""
+
+from __future__ import annotations
+
+import json as _json
+
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["write"]
+
+
+def write(table: Table, publisher, project_id: str, topic_id: str) -> None:
+    names = table.column_names()
+    topic_path = publisher.topic_path(project_id, topic_id)
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        payload = {n: row[n] for n in names}
+        payload["time"] = time
+        payload["diff"] = 1 if is_addition else -1
+        publisher.publish(topic_path, _json.dumps(payload, default=str).encode())
+
+    subscribe(table, on_change=on_change, name=f"pubsub:{topic_id}")

@@ -1,0 +1,85 @@
+"""``pw.io.pyfilesystem`` — sources over PyFilesystem2 URLs
+(reference: python/pathway/io/pyfilesystem).  Needs the ``fs`` package.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+from ...internals.schema import schema_from_types
+from ...internals.table import Table
+from .._utils import input_table, with_metadata_schema
+from ...internals.keys import ref_scalar
+from ...internals.value import Json
+from ..streaming import ConnectorSubject
+
+__all__ = ["read"]
+
+
+class _PyFsSubject(ConnectorSubject):
+    def __init__(self, source, path, mode, refresh_s, with_metadata, autocommit_ms):
+        super().__init__(datasource_name=f"pyfs:{path}")
+        self.source = source
+        self.path = path
+        self._mode = "static" if mode == "static" else "streaming"
+        self.refresh_s = refresh_s
+        self.with_metadata = with_metadata
+        self._autocommit_ms = autocommit_ms
+        self._seen: dict[str, tuple] = {}
+
+    def _scan(self) -> None:
+        current = {}
+        for p in self.source.walk.files(self.path or "/"):
+            info = self.source.getinfo(p, namespaces=["details"])
+            current[p] = info.modified.isoformat() if info.modified else ""
+        for p in list(self._seen):
+            if p not in current:
+                stamp, key, values = self._seen.pop(p)
+                self._remove(key, values)
+        for p, stamp in current.items():
+            old = self._seen.get(p)
+            if old is not None and old[0] == stamp:
+                continue
+            if old is not None:
+                self._remove(old[1], old[2])
+            data = self.source.readbytes(p)
+            key = ref_scalar("__pyfs__", p)
+            row = {"data": data}
+            if self.with_metadata:
+                row["_metadata"] = Json({"path": p, "modified_at": stamp})
+            values = tuple(row.get(n) for n in self._column_names)
+            self._add_inner(key, values)
+            self._seen[p] = (stamp, key, values)
+        self.commit()
+
+    def run(self) -> None:
+        self._scan()
+        if self._mode == "static":
+            return
+        while not self._closed.is_set():
+            _time.sleep(self.refresh_s)
+            self._scan()
+
+
+def read(
+    source: Any,
+    path: str = "",
+    *,
+    mode: str = "streaming",
+    refresh_interval: float = 30.0,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    **kwargs: Any,
+) -> Table:
+    if isinstance(source, str):
+        import fs  # optional dependency
+
+        source = fs.open_fs(source)
+    schema = schema_from_types(data=bytes)
+    out_schema = with_metadata_schema(schema) if with_metadata else schema
+    subject = _PyFsSubject(
+        source, path, mode, refresh_interval, with_metadata, autocommit_duration_ms
+    )
+    subject._configure(out_schema, None)
+    return input_table(out_schema, subject=subject)

@@ -1,0 +1,7 @@
+"""``pw.io.redpanda`` — Redpanda speaks the Kafka protocol; this module is
+the kafka connector under the reference's alias (python/pathway/io/redpanda).
+"""
+
+from ..kafka import read, simple_read, write
+
+__all__ = ["read", "simple_read", "write"]

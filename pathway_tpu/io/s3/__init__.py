@@ -1,0 +1,155 @@
+"""``pw.io.s3`` — S3/object-store source.
+
+reference: python/pathway/io/s3 (570 LoC) over the Rust S3 scanner
+(src/connectors/scanner/s3.rs) — bucket listing with prefix, per-object
+parsing, polling for new objects, etag-based change detection.
+Needs ``boto3`` at call time.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io as _io
+import json as _json
+import time as _time
+from typing import Any
+
+from ...internals.schema import SchemaMetaclass, schema_from_types
+from ...internals.table import Table
+from .._utils import coerce_row, input_table
+from ...internals.keys import ref_scalar
+from ..streaming import ConnectorSubject
+
+__all__ = ["read", "AwsS3Settings"]
+
+
+class AwsS3Settings:
+    """reference: io/s3 AwsS3Settings"""
+
+    def __init__(self, bucket_name: str | None = None, access_key: str | None = None,
+                 secret_access_key: str | None = None, region: str | None = None,
+                 endpoint: str | None = None, with_path_style: bool = False):
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.region = region
+        self.endpoint = endpoint
+        self.with_path_style = with_path_style
+
+    def client(self):
+        import boto3  # optional dependency
+
+        kwargs: dict = {}
+        if self.access_key:
+            kwargs["aws_access_key_id"] = self.access_key
+        if self.secret_access_key:
+            kwargs["aws_secret_access_key"] = self.secret_access_key
+        if self.region:
+            kwargs["region_name"] = self.region
+        if self.endpoint:
+            kwargs["endpoint_url"] = self.endpoint
+        return boto3.client("s3", **kwargs)
+
+
+class _S3Subject(ConnectorSubject):
+    def __init__(self, path, settings, fmt, schema, mode, refresh_s, autocommit_ms):
+        super().__init__(datasource_name=f"s3:{path}")
+        self.path = path
+        self.settings = settings
+        self.fmt = fmt
+        self.row_schema = schema
+        self._mode = "static" if mode == "static" else "streaming"
+        self.refresh_s = refresh_s
+        self._autocommit_ms = autocommit_ms
+        self._seen: dict[str, tuple] = {}  # key -> (etag, [entries])
+
+    def _rows_of_object(self, body: bytes, obj_key: str):
+        if self.fmt == "binary":
+            yield (obj_key,), {"data": body}
+        elif self.fmt == "plaintext":
+            for i, line in enumerate(body.decode(errors="replace").splitlines()):
+                yield (obj_key, i), {"data": line}
+        elif self.fmt == "csv":
+            for i, rec in enumerate(_csv.DictReader(_io.StringIO(body.decode(errors="replace")))):
+                yield (obj_key, i), coerce_row(self.row_schema, rec)
+        elif self.fmt in ("json", "jsonlines"):
+            for i, line in enumerate(body.decode(errors="replace").splitlines()):
+                if line.strip():
+                    yield (obj_key, i), coerce_row(self.row_schema, _json.loads(line))
+        else:
+            raise ValueError(f"unknown format {self.fmt!r}")
+
+    def _scan(self) -> bool:
+        client = self.settings.client()
+        bucket = self.settings.bucket_name
+        changed = False
+        paginator = client.get_paginator("list_objects_v2")
+        current = {}
+        for page in paginator.paginate(Bucket=bucket, Prefix=self.path):
+            for obj in page.get("Contents", []):
+                current[obj["Key"]] = obj["ETag"]
+        for obj_key in list(self._seen):
+            if obj_key not in current:
+                _, entries = self._seen.pop(obj_key)
+                for key, values in entries:
+                    self._remove(key, values)
+                changed = True
+        for obj_key, etag in current.items():
+            old = self._seen.get(obj_key)
+            if old is not None and old[0] == etag:
+                continue
+            if old is not None:
+                for key, values in old[1]:
+                    self._remove(key, values)
+            body = client.get_object(Bucket=bucket, Key=obj_key)["Body"].read()
+            entries = []
+            for key_material, row in self._rows_of_object(body, obj_key):
+                values = tuple(row.get(n) for n in self._column_names)
+                key = ref_scalar("__s3__", bucket, *key_material)
+                self._add_inner(key, values)
+                entries.append((key, values))
+            self._seen[obj_key] = (etag, entries)
+            changed = True
+        if changed:
+            self.commit()
+        return changed
+
+    def run(self) -> None:
+        self._scan()
+        if self._mode == "static":
+            return
+        while not self._closed.is_set():
+            _time.sleep(self.refresh_s)
+            self._scan()
+
+    def current_offsets(self):
+        return dict(self._seen)
+
+    def seek(self, offsets) -> None:
+        if offsets:
+            self._seen = dict(offsets)
+
+
+def read(
+    path: str,
+    *,
+    aws_s3_settings: AwsS3Settings | None = None,
+    format: str = "csv",
+    schema: SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    refresh_interval: float = 5.0,
+    autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    if format == "binary":
+        schema = schema_from_types(data=bytes)
+    elif format == "plaintext":
+        schema = schema_from_types(data=str)
+    elif schema is None:
+        raise ValueError(f"format {format!r} requires schema=")
+    settings = aws_s3_settings or AwsS3Settings()
+    subject = _S3Subject(path, settings, format, schema, mode, refresh_interval, autocommit_duration_ms)
+    subject.persistent_id = persistent_id
+    subject._configure(schema, schema.primary_key_columns())
+    return input_table(schema, subject=subject)

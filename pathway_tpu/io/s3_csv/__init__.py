@@ -1,0 +1,15 @@
+"""``pw.io.s3_csv`` — CSV-over-S3 shorthand (reference: python/pathway/io/s3_csv)."""
+
+from __future__ import annotations
+
+from ..s3 import AwsS3Settings
+from ..s3 import read as _s3_read
+
+__all__ = ["read", "AwsS3Settings"]
+
+
+def read(path, *, aws_s3_settings=None, schema=None, mode="streaming", **kwargs):
+    return _s3_read(
+        path, aws_s3_settings=aws_s3_settings, format="csv", schema=schema,
+        mode=mode, **kwargs,
+    )

@@ -1,0 +1,34 @@
+"""``pw.io.slack`` — Slack alert sink
+(reference: python/pathway/xpacks/io/slack ``send_alerts`` — one chat
+message per added row via the Web API; urllib, no client lib needed)."""
+
+from __future__ import annotations
+
+import json as _json
+import urllib.request
+
+from ...internals.table import Table
+from .._subscribe import subscribe
+
+__all__ = ["send_alerts"]
+
+
+def send_alerts(alerts: Table, slack_channel_id: str, slack_token: str) -> None:
+    names = alerts.column_names()
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        if not is_addition:
+            return
+        text = str(row[names[0]]) if len(names) == 1 else _json.dumps(row, default=str)
+        req = urllib.request.Request(
+            "https://slack.com/api/chat.postMessage",
+            data=_json.dumps({"channel": slack_channel_id, "text": text}).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {slack_token}",
+            },
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=30).read()
+
+    subscribe(alerts, on_change=on_change, name=f"slack:{slack_channel_id}")

@@ -1,0 +1,167 @@
+"""``pw.io.sqlite`` — SQLite connector.
+
+reference: python/pathway/io/sqlite + ``SqliteReader``
+(src/connectors/data_storage.rs:1415, tracked via sqlite's
+``data_version`` pragma).  Fully functional here (sqlite3 is stdlib):
+streaming mode polls ``PRAGMA data_version`` + content diffing, so row
+updates/deletes become retractions exactly like the Rust reader.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import time as _time
+from pathlib import Path
+from typing import Any
+
+from ...internals.schema import SchemaMetaclass
+from ...internals.table import Table
+from .._subscribe import subscribe
+from .._utils import coerce_row, input_table
+from ...internals.keys import ref_scalar
+from ..streaming import ConnectorSubject
+
+__all__ = ["read", "write"]
+
+
+class _SqliteSubject(ConnectorSubject):
+    def __init__(self, path, table_name, schema, mode, refresh_s, autocommit_ms):
+        super().__init__(datasource_name=f"sqlite:{path}:{table_name}")
+        self.path = str(path)
+        self.table_name = table_name
+        self.row_schema = schema
+        self._mode = "static" if mode == "static" else "streaming"
+        self.refresh_s = refresh_s
+        self._autocommit_ms = autocommit_ms
+        self._emitted: dict[Any, tuple] = {}
+
+    def _snapshot(self) -> dict[Any, tuple]:
+        con = sqlite3.connect(self.path)
+        con.row_factory = sqlite3.Row
+        try:
+            cols = list(self.row_schema.column_names())
+            pk = self._primary_key or []
+            rows = con.execute(
+                f'SELECT rowid AS _rowid_, * FROM "{self.table_name}"'
+            ).fetchall()
+            out = {}
+            for r in rows:
+                rec = coerce_row(self.row_schema, dict(r))
+                if pk:
+                    key = ref_scalar(*[rec[c] for c in pk])
+                else:
+                    key = ref_scalar("__sqlite__", self.table_name, r["_rowid_"])
+                out[key] = tuple(rec.get(n) for n in cols)
+            return out
+        finally:
+            con.close()
+
+    def _sync(self) -> bool:
+        current = self._snapshot()
+        changed = False
+        for key, values in list(self._emitted.items()):
+            if key not in current:
+                self._remove(key, values)
+                del self._emitted[key]
+                changed = True
+        for key, values in current.items():
+            old = self._emitted.get(key)
+            if old == values:
+                continue
+            if old is not None:
+                self._remove(key, old)
+            self._add_inner(key, values)
+            self._emitted[key] = values
+            changed = True
+        if changed:
+            self.commit()
+        return changed
+
+    def _data_version(self) -> int:
+        con = sqlite3.connect(self.path)
+        try:
+            return con.execute("PRAGMA data_version").fetchone()[0]
+        finally:
+            con.close()
+
+    def run(self) -> None:
+        self._sync()
+        if self._mode == "static":
+            return
+        last_version = self._data_version()
+        while not self._closed.is_set():
+            _time.sleep(self.refresh_s)
+            version = self._data_version()
+            # data_version only changes for *other* connections' writes;
+            # re-diff content either way to also catch same-process writes
+            self._sync()
+            last_version = version
+
+    def current_offsets(self):
+        return dict(self._emitted)
+
+    def seek(self, offsets) -> None:
+        if offsets:
+            self._emitted = dict(offsets)
+
+
+def read(
+    path: str | Path,
+    table_name: str,
+    schema: SchemaMetaclass,
+    *,
+    mode: str = "streaming",
+    refresh_interval: float = 1.0,
+    autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
+) -> Table:
+    subject = _SqliteSubject(
+        path, table_name, schema, mode, refresh_interval, autocommit_duration_ms
+    )
+    subject.persistent_id = persistent_id
+    subject._configure(schema, schema.primary_key_columns())
+    return input_table(schema, subject=subject)
+
+
+def write(table: Table, path: str | Path, table_name: str) -> None:
+    """Maintain a sqlite table mirroring the stream (insert on +1 diff,
+    delete on -1; reference pattern of PsqlWriter's snapshot mode)."""
+    names = table.column_names()
+    con = sqlite3.connect(str(path), check_same_thread=False)
+    col_defs = ", ".join(f'"{n}"' for n in names)
+    con.execute(
+        f'CREATE TABLE IF NOT EXISTS "{table_name}" ({col_defs})'
+    )
+    con.commit()
+
+    placeholders = ", ".join("?" for _ in names)
+    where = " AND ".join(f'"{n}" IS ?' for n in names)
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        vals = [_sql_value(row[n]) for n in names]
+        if is_addition:
+            con.execute(
+                f'INSERT INTO "{table_name}" VALUES ({placeholders})', vals
+            )
+        else:
+            cur = con.execute(
+                f'SELECT rowid FROM "{table_name}" WHERE {where} LIMIT 1', vals
+            ).fetchone()
+            if cur is not None:
+                con.execute(
+                    f'DELETE FROM "{table_name}" WHERE rowid = ?', (cur[0],)
+                )
+        con.commit()
+
+    def _sql_value(v):
+        from ...internals.value import Json, Pointer
+
+        if isinstance(v, Json):
+            return v.to_string()
+        if isinstance(v, Pointer):
+            return str(v)
+        return v
+
+    subscribe(
+        table, on_change=on_change, on_end=con.close, name=f"sqlite:{table_name}"
+    )
