@@ -200,6 +200,23 @@ _LAZY_SUBMODULES = {
 }
 
 
+def sql(query: str, **tables):
+    """SQL over tables (reference: pw.sql, internals/sql.py — sqlglot
+    there, a native parser here)."""
+    from .internals.sql import sql as _sql
+
+    return _sql(query, **tables)
+
+
+def global_error_log():
+    """Table of row-level evaluation errors collected when running with
+    ``terminate_on_error=False`` (reference: internals/errors.py +
+    graph.rs:958 error_log)."""
+    from .internals.errors import global_error_log as _gel
+
+    return _gel()
+
+
 def load_yaml(stream):
     """Load a declarative ``!pw`` app template
     (reference: internals/yaml_loader.py:74)."""
@@ -268,4 +285,6 @@ __all__ = [
     "universes",
     "unsafe_make_pointer",
     "load_yaml",
+    "global_error_log",
+    "sql",
 ]

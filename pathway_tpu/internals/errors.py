@@ -1,0 +1,58 @@
+"""Error-log tables: collect row-level errors instead of aborting.
+
+reference: python/pathway/internals/errors.py + src/engine/error.rs —
+``terminate_on_error=False`` routes data errors into ``Value::Error``
+cells and an error-log table (``error_log``/``set_error_log``
+graph.rs:958-965); ``remove_errors_from_table`` (graph.rs:984) drops rows
+containing errors.
+
+``pw.global_error_log()`` returns a table of (message, trace) rows
+appended as evaluation errors occur in a run with
+``terminate_on_error=False``; read it with ``pw.io.subscribe``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import TYPE_CHECKING
+
+from .schema import schema_from_types
+
+if TYPE_CHECKING:
+    from .table import Table
+
+__all__ = ["global_error_log", "register_error"]
+
+_lock = threading.Lock()
+_subjects: list = []
+
+
+def register_error(message: str, trace: str = "") -> None:
+    """Called by the evaluator when terminate_on_error is off."""
+    with _lock:
+        subjects = list(_subjects)
+    for subject in subjects:
+        subject.next(message=message, trace=trace)
+        subject.commit()
+
+
+def global_error_log() -> "Table":
+    """reference: pw.global_error_log() (internals/errors.py).
+
+    The subject's reader returns immediately (errors are pushed from the
+    evaluator, not pulled), so a batch run still terminates; diffs
+    emitted mid-run ride the driver's regular drain cycle.
+    """
+    from ..io._utils import input_table
+    from ..io.streaming import ConnectorSubject
+
+    class _ErrorLogSubject(ConnectorSubject):
+        def run(self) -> None:
+            return
+
+    schema = schema_from_types(message=str, trace=str)
+    subject = _ErrorLogSubject(datasource_name="error_log")
+    subject._configure(schema, None)
+    with _lock:
+        _subjects.append(subject)
+    return input_table(schema, subject=subject)

@@ -32,6 +32,9 @@ class EvalContext:
     def handle(cls, exc: Exception):
         if cls.terminate_on_error:
             raise exc
+        from .errors import register_error
+
+        register_error(f"{type(exc).__name__}: {exc}")
         return ERROR
 
 

@@ -1,0 +1,523 @@
+"""``pw.sql`` — SQL queries over tables.
+
+reference: python/pathway/internals/sql.py (726 LoC, sqlglot-based
+translation).  sqlglot is not in this image, so the dialect core is
+parsed natively: SELECT (expressions, aliases, ``*``), FROM, INNER/LEFT/
+RIGHT/OUTER JOIN ... ON, WHERE, GROUP BY, HAVING, UNION ALL, scalar
+functions and the classic aggregates.  The query compiles onto the same
+Table operators the Python API uses — ``pw.sql`` is sugar, not a second
+engine.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from . import dtype as dt
+from .expression import ApplyExpression, ColumnExpression, smart_wrap
+from .table import Table
+
+__all__ = ["sql"]
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:(?P<num>\d+(?:\.\d+)?)|(?P<str>'(?:[^']|'')*')"
+    r"|(?P<ident>[A-Za-z_][A-Za-z0-9_]*)"
+    r"|(?P<op><>|<=|>=|!=|=|<|>|\(|\)|,|\*|/|%|\+|-|\.))",
+    re.S,
+)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "as", "join",
+    "inner", "left", "right", "full", "outer", "on", "union", "all", "and",
+    "or", "not", "is", "null", "true", "false", "distinct", "order", "asc",
+    "desc", "limit", "case", "when", "then", "else", "end", "in", "like",
+}
+
+_AGGREGATES = {"sum", "count", "avg", "min", "max"}
+
+_FUNCTIONS = {
+    "abs": abs,
+    "lower": lambda s: None if s is None else str(s).lower(),
+    "upper": lambda s: None if s is None else str(s).upper(),
+    "length": lambda s: None if s is None else len(s),
+    "round": lambda x, n=0: None if x is None else round(x, int(n)),
+    "coalesce": lambda *a: next((v for v in a if v is not None), None),
+    "concat": lambda *a: "".join("" if v is None else str(v) for v in a),
+}
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            if src[pos:].strip() == "":
+                break
+            raise ValueError(f"SQL syntax error near {src[pos:pos+30]!r}")
+        pos = m.end()
+        for kind in ("num", "str", "ident", "op"):
+            val = m.group(kind)
+            if val is not None:
+                if kind == "ident" and val.lower() in _KEYWORDS:
+                    out.append(("kw", val.lower()))
+                else:
+                    out.append((kind, val))
+                break
+    out.append(("end", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        tok = self.toks[self.i]
+        self.i += 1
+        return tok
+
+    def accept_kw(self, *kws: str) -> str | None:
+        kind, val = self.peek()
+        if kind == "kw" and val in kws:
+            self.i += 1
+            return val
+        return None
+
+    def expect_kw(self, kw: str):
+        if not self.accept_kw(kw):
+            raise ValueError(f"expected {kw.upper()} near {self.peek()[1]!r}")
+
+    def accept_op(self, *ops: str) -> str | None:
+        kind, val = self.peek()
+        if kind == "op" and val in ops:
+            self.i += 1
+            return val
+        return None
+
+    def expect_op(self, op: str):
+        if not self.accept_op(op):
+            raise ValueError(f"expected {op!r} near {self.peek()[1]!r}")
+
+    def expect_ident(self) -> str:
+        kind, val = self.next()
+        if kind != "ident":
+            raise ValueError(f"expected identifier, got {val!r}")
+        return val
+
+    # ---- query ----
+    def parse_query(self) -> dict:
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        items = [self.parse_select_item()]
+        while self.accept_op(","):
+            items.append(self.parse_select_item())
+        self.expect_kw("from")
+        table = self.expect_ident()
+        table_alias = None
+        if self.peek()[0] == "ident":
+            table_alias = self.expect_ident()
+        elif self.accept_kw("as"):
+            table_alias = self.expect_ident()
+        joins = []
+        while True:
+            how = "inner"
+            if self.accept_kw("inner"):
+                pass
+            elif self.accept_kw("left"):
+                how = "left"
+                self.accept_kw("outer")
+            elif self.accept_kw("right"):
+                how = "right"
+                self.accept_kw("outer")
+            elif self.accept_kw("full"):
+                how = "outer"
+                self.accept_kw("outer")
+            if not self.accept_kw("join"):
+                if how != "inner":
+                    raise ValueError("expected JOIN")
+                break
+            jt = self.expect_ident()
+            jalias = None
+            if self.peek()[0] == "ident":
+                jalias = self.expect_ident()
+            elif self.accept_kw("as"):
+                jalias = self.expect_ident()
+            self.expect_kw("on")
+            cond = self.parse_expr()
+            joins.append(dict(table=jt, alias=jalias, how=how, on=cond))
+        where = None
+        if self.accept_kw("where"):
+            where = self.parse_expr()
+        group_by = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                group_by.append(self.parse_expr())
+        having = None
+        if self.accept_kw("having"):
+            having = self.parse_expr()
+        union = None
+        if self.accept_kw("union"):
+            self.expect_kw("all")
+            union = self.parse_query()
+        return dict(
+            items=items, table=table, table_alias=table_alias, joins=joins,
+            where=where, group_by=group_by, having=having, union=union,
+            distinct=distinct,
+        )
+
+    def parse_select_item(self) -> dict:
+        if self.accept_op("*"):
+            return dict(star=True)
+        expr = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek()[0] == "ident":
+            alias = self.expect_ident()
+        return dict(expr=expr, alias=alias)
+
+    # ---- expressions (precedence climbing) ----
+    def parse_expr(self):
+        return self.parse_or()
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.accept_kw("or"):
+            left = ("or", left, self.parse_and())
+        return left
+
+    def parse_and(self):
+        left = self.parse_not()
+        while self.accept_kw("and"):
+            left = ("and", left, self.parse_not())
+        return left
+
+    def parse_not(self):
+        if self.accept_kw("not"):
+            return ("not", self.parse_not())
+        return self.parse_cmp()
+
+    def parse_cmp(self):
+        left = self.parse_add()
+        if self.accept_kw("is"):
+            negate = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            return ("is_not_null" if negate else "is_null", left)
+        op = self.accept_op("=", "!=", "<>", "<=", ">=", "<", ">")
+        if op:
+            right = self.parse_add()
+            return ({"=": "==", "<>": "!="}.get(op, op), left, right)
+        return left
+
+    def parse_add(self):
+        left = self.parse_mul()
+        while True:
+            op = self.accept_op("+", "-")
+            if not op:
+                return left
+            left = (op, left, self.parse_mul())
+
+    def parse_mul(self):
+        left = self.parse_atom()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return left
+            left = (op, left, self.parse_atom())
+
+    def parse_atom(self):
+        kind, val = self.peek()
+        if self.accept_op("("):
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if self.accept_op("-"):
+            return ("neg", self.parse_atom())
+        if kind == "num":
+            self.next()
+            return ("lit", float(val) if "." in val else int(val))
+        if kind == "str":
+            self.next()
+            return ("lit", val[1:-1].replace("''", "'"))
+        if kind == "kw" and val in ("null", "true", "false"):
+            self.next()
+            return ("lit", {"null": None, "true": True, "false": False}[val])
+        if kind == "ident":
+            name = self.expect_ident()
+            if self.accept_op("("):
+                # function or aggregate
+                args = []
+                star = False
+                if self.accept_op("*"):
+                    star = True
+                elif self.peek() != ("op", ")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+                return ("call", name.lower(), args, star)
+            if self.accept_op("."):
+                col = self.expect_ident()
+                return ("col", name, col)
+            return ("col", None, name)
+        raise ValueError(f"unexpected token {val!r} in expression")
+
+
+class _Compiler:
+    def __init__(self, tables: dict[str, Table]):
+        self.tables = tables
+
+    def resolve_col(self, tab: str | None, col: str) -> ColumnExpression:
+        if tab is not None:
+            if tab not in self.tables:
+                raise ValueError(f"unknown table {tab!r}")
+            return self.tables[tab][col]
+        owners = [t for t in self.tables.values() if col in t.column_names()]
+        if not owners:
+            raise ValueError(f"unknown column {col!r}")
+        if len(set(id(t) for t in owners)) > 1:
+            raise ValueError(f"ambiguous column {col!r}; qualify with table name")
+        return owners[0][col]
+
+    def compile(self, node) -> ColumnExpression:
+        kind = node[0]
+        if kind == "lit":
+            return smart_wrap(node[1])
+        if kind == "col":
+            return self.resolve_col(node[1], node[2])
+        if kind == "neg":
+            return -self.compile(node[1])
+        if kind == "not":
+            return ~self.compile(node[1])
+        if kind in ("and", "or"):
+            a, b = self.compile(node[1]), self.compile(node[2])
+            return (a & b) if kind == "and" else (a | b)
+        if kind in ("==", "!=", "<", "<=", ">", ">="):
+            a, b = self.compile(node[1]), self.compile(node[2])
+            import operator as _op
+
+            return {
+                "==": _op.eq, "!=": _op.ne, "<": _op.lt,
+                "<=": _op.le, ">": _op.gt, ">=": _op.ge,
+            }[kind](a, b)
+        if kind in ("+", "-", "*", "/", "%"):
+            a, b = self.compile(node[1]), self.compile(node[2])
+            import operator as _op
+
+            impl = {"+": _op.add, "-": _op.sub, "*": _op.mul,
+                    "/": _op.truediv, "%": _op.mod}[kind]
+            return impl(a, b)
+        if kind == "is_null":
+            return self.compile(node[1]).is_none()
+        if kind == "is_not_null":
+            return self.compile(node[1]).is_not_none()
+        if kind == "call":
+            name, args, star = node[1], node[2], node[3]
+            if name in _AGGREGATES:
+                raise ValueError(
+                    f"aggregate {name.upper()} outside of SELECT with GROUP BY"
+                )
+            if name not in _FUNCTIONS:
+                raise ValueError(f"unknown SQL function {name!r}")
+            fn = _FUNCTIONS[name]
+            return ApplyExpression(fn, dt.ANY, *[self.compile(a) for a in args])
+        raise ValueError(f"cannot compile SQL node {node!r}")
+
+    def find_aggregates(self, node, out: list) -> None:
+        if not isinstance(node, tuple):
+            return
+        if node[0] == "call" and node[1] in _AGGREGATES:
+            out.append(node)
+            return
+        for child in node[1:]:
+            if isinstance(child, tuple):
+                self.find_aggregates(child, out)
+            elif isinstance(child, list):
+                for c in child:
+                    self.find_aggregates(c, out)
+
+    def compile_aggregate(self, node, table_for_count: Table):
+        from . import reducers
+
+        name, args, star = node[1], node[2], node[3]
+        if name == "count":
+            return reducers.count()
+        arg = self.compile(args[0])
+        return {
+            "sum": reducers.sum, "avg": reducers.avg,
+            "min": reducers.min, "max": reducers.max,
+        }[name](arg)
+
+
+def sql(query: str, **tables: Table) -> Table:
+    """Run a SQL query against the given tables
+    (reference: pw.sql, internals/sql.py)::
+
+        pw.sql("SELECT owner, SUM(value) AS total FROM t GROUP BY owner", t=t)
+    """
+    ast = _Parser(_tokenize(query)).parse_query()
+    return _execute(ast, tables)
+
+
+def _execute(ast: dict, tables: dict[str, Table]) -> Table:
+    scope = dict(tables)
+    if ast["table"] not in scope:
+        raise ValueError(f"unknown table {ast['table']!r} (pass it as a kwarg)")
+    base = scope[ast["table"]]
+    if ast["table_alias"]:
+        scope[ast["table_alias"]] = base
+    compiler = _Compiler(scope)
+
+    current = base
+    for join in ast["joins"]:
+        right = scope.get(join["table"])
+        if right is None:
+            raise ValueError(f"unknown table {join['table']!r}")
+        if join["alias"]:
+            scope[join["alias"]] = right
+        from .joins import JoinMode
+
+        how = {
+            "inner": JoinMode.INNER, "left": JoinMode.LEFT,
+            "right": JoinMode.RIGHT, "outer": JoinMode.OUTER,
+        }[join["how"]]
+        cond = compiler.compile(join["on"])
+        jr = current.join(right, cond, how=how)
+        # materialize all columns of both sides (qualified wins are implicit)
+        out_cols: dict[str, Any] = {}
+        for t in (current, right):
+            for n in t.column_names():
+                if n not in out_cols:
+                    out_cols[n] = t[n]
+        current = jr.select(**out_cols)
+        # re-point scope entries at the joined table for later references
+        for alias, t in list(scope.items()):
+            if t is base or t is right or t is current:
+                scope[alias] = current
+        base = current
+        compiler = _Compiler(scope)
+
+    if ast["where"] is not None:
+        current = current.filter(_rebind(compiler.compile(ast["where"]), current))
+        compiler = _Compiler({**scope, ast["table"]: current})
+        base = current
+
+    items = ast["items"]
+    agg_nodes: list = []
+    for item in items:
+        if not item.get("star"):
+            compiler.find_aggregates(item["expr"], agg_nodes)
+    if ast["having"] is not None:
+        compiler.find_aggregates(ast["having"], agg_nodes)
+
+    if agg_nodes or ast["group_by"]:
+        result = _execute_groupby(ast, current, compiler)
+    else:
+        exprs: dict[str, Any] = {}
+        for i, item in enumerate(items):
+            if item.get("star"):
+                for n in current.column_names():
+                    exprs[n] = _rebind(compiler.resolve_col(None, n), current)
+                continue
+            name = item["alias"] or _default_name(item["expr"], i)
+            exprs[name] = _rebind(compiler.compile(item["expr"]), current)
+        result = current.select(**exprs)
+
+    if ast.get("distinct"):
+        import pathway_tpu as pw
+
+        names = result.column_names()
+        grouped = result.groupby(*[result[n] for n in names])
+        result = grouped.reduce(*[result[n] for n in names])
+
+    if ast["union"] is not None:
+        other = _execute(ast["union"], tables)
+        result = result.concat_reindex(other)
+    return result
+
+
+def _execute_groupby(ast: dict, table: Table, compiler: "_Compiler") -> Table:
+    group_exprs = [_rebind(compiler.compile(g), table) for g in ast["group_by"]]
+    grouped = table.groupby(*group_exprs) if group_exprs else table.groupby()
+
+    reduce_kwargs: dict[str, Any] = {}
+    group_names = []
+    for g, ge in zip(ast["group_by"], group_exprs):
+        if g[0] == "col":
+            group_names.append(g[2])
+
+    def lower_item(node, i: int, alias: str | None):
+        if node[0] == "call" and node[1] in _AGGREGATES:
+            return alias or node[1], compiler.compile_aggregate(node, table)
+        if node[0] == "col":
+            return alias or node[2], _rebind(compiler.resolve_col(node[1], node[2]), table)
+        raise ValueError(
+            "non-aggregate select expressions must appear in GROUP BY"
+        )
+
+    for i, item in enumerate(ast["items"]):
+        if item.get("star"):
+            raise ValueError("SELECT * cannot be combined with GROUP BY")
+        name, expr = lower_item(item["expr"], i, item["alias"])
+        reduce_kwargs[name] = expr
+    if ast["having"] is not None:
+        having_aggs: list = []
+        compiler.find_aggregates(ast["having"], having_aggs)
+        for j, agg in enumerate(having_aggs):
+            reduce_kwargs[f"__having_{j}"] = compiler.compile_aggregate(agg, table)
+    result = grouped.reduce(**reduce_kwargs)
+    if ast["having"] is not None:
+        having_aggs = []
+        compiler.find_aggregates(ast["having"], having_aggs)
+
+        def subst(node):
+            if isinstance(node, tuple):
+                if node[0] == "call" and node[1] in _AGGREGATES:
+                    idx = next(j for j, a in enumerate(having_aggs) if a == node)
+                    return ("col", None, f"__having_{idx}")
+                return tuple(
+                    subst(c) if isinstance(c, (tuple, list)) else c for c in node
+                )
+            if isinstance(node, list):
+                return [subst(c) for c in node]
+            return node
+
+        having_node = subst(ast["having"])
+        having_compiler = _Compiler({"__result__": result})
+        result = result.filter(
+            _rebind(having_compiler.compile(having_node), result)
+        )
+        result = result.without(
+            *[f"__having_{j}" for j in range(len(having_aggs))]
+        )
+    return result
+
+
+def _rebind(expr: ColumnExpression, table: Table) -> ColumnExpression:
+    """Column references built against pre-join tables resolve by name on
+    the current table."""
+    from .expression import ColumnReference
+
+    def walk(e):
+        if isinstance(e, ColumnReference) and e.table is not table:
+            if e.name in table.column_names():
+                return table[e.name]
+        return None
+
+    return expr._substitute(walk) if hasattr(expr, "_substitute") else expr
+
+
+def _default_name(node, i: int) -> str:
+    if node[0] == "col":
+        return node[2]
+    if node[0] == "call":
+        return node[1]
+    return f"col_{i}"

@@ -434,6 +434,24 @@ class Table:
         all_exprs.update(exprs)
         return self._select_exprs(all_exprs, universe=self._universe)
 
+    def remove_errors(self) -> "Table":
+        """Drop rows containing ``ERROR`` cells
+        (reference: graph.rs:984 ``remove_errors_from_table``)."""
+        from .expression import ApplyExpression, FillErrorExpression
+        from .value import ERROR
+        from . import dtype as dt
+
+        def row_ok(*vals) -> bool:
+            return not any(v is ERROR for v in vals)
+
+        # the evaluator short-circuits apply args containing ERROR to ERROR,
+        # so wrap with fill_error to turn those rows into False
+        cond = FillErrorExpression(
+            ApplyExpression(row_ok, dt.BOOL, *[self[n] for n in self.column_names()]),
+            False,
+        )
+        return self.filter(cond)
+
     def without(self, *columns: Any) -> "Table":
         names = {c.name if isinstance(c, ColumnReference) else c for c in columns}
         keep = [n for n in self.column_names() if n not in names]

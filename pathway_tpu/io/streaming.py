@@ -290,7 +290,15 @@ class StreamingDriver:
             self.engine.run_all()
             return
         data_event = threading.Event()
-        t = self._setup_persistence(1)
+        # statically-fed sources (debug tables, static subjects) queued rows
+        # at build time — drain those timestamps before going live, or a
+        # mixed static+streaming graph would never process them
+        static_times = sorted(
+            {t for s in self.engine.sources for t in s.pending_times()}
+        )
+        for t0 in static_times:
+            self.engine.step(t0)
+        t = self._setup_persistence(max(static_times, default=0) + 1)
         threads = []
         for subject, _src in self.subject_src:
             subject._data_event = data_event

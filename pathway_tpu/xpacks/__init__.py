@@ -3,6 +3,6 @@
 reference: python/pathway/xpacks/ (llm xpack + gated connectors).
 """
 
-from . import llm
+from . import connectors, llm
 
-__all__ = ["llm"]
+__all__ = ["connectors", "llm"]

@@ -1,0 +1,6 @@
+"""``pw.xpacks.connectors`` — gated service connectors
+(reference: python/pathway/xpacks/connectors/)."""
+
+from . import sharepoint
+
+__all__ = ["sharepoint"]

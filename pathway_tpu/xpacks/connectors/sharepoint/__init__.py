@@ -1,0 +1,112 @@
+"""``pw.xpacks.connectors.sharepoint`` — SharePoint document source.
+
+reference: python/pathway/xpacks/connectors/sharepoint (376 LoC) — polls a
+SharePoint document library via Office365-REST-Python-Client, emitting
+file contents + metadata with modification/deletion diffs (same shape as
+pw.io.gdrive).  Needs ``office365`` at call time.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any
+
+from ....internals.keys import ref_scalar
+from ....internals.schema import schema_from_types
+from ....internals.table import Table
+from ....internals.value import Json
+from ....io._utils import input_table, with_metadata_schema
+from ....io.streaming import ConnectorSubject
+
+__all__ = ["read"]
+
+
+class _SharePointSubject(ConnectorSubject):
+    def __init__(self, context, root_path, mode, refresh_s, with_metadata, autocommit_ms):
+        super().__init__(datasource_name=f"sharepoint:{root_path}")
+        self.context = context
+        self.root_path = root_path
+        self._mode = "static" if mode == "static" else "streaming"
+        self.refresh_s = refresh_s
+        self.with_metadata = with_metadata
+        self._autocommit_ms = autocommit_ms
+        self._seen: dict[str, tuple] = {}
+
+    def _scan(self) -> None:
+        folder = self.context.web.get_folder_by_server_relative_url(self.root_path)
+        files = folder.files.get().execute_query()
+        current = {}
+        for f in files:
+            current[f.serverRelativeUrl] = str(f.time_last_modified)
+        for url in list(self._seen):
+            if url not in current:
+                _, key, values = self._seen.pop(url)
+                self._remove(key, values)
+        for url, stamp in current.items():
+            old = self._seen.get(url)
+            if old is not None and old[0] == stamp:
+                continue
+            if old is not None:
+                self._remove(old[1], old[2])
+            import io as _io
+
+            buf = _io.BytesIO()
+            self.context.web.get_file_by_server_relative_url(url).download(
+                buf
+            ).execute_query()
+            key = ref_scalar("__sharepoint__", url)
+            row = {"data": buf.getvalue()}
+            if self.with_metadata:
+                row["_metadata"] = Json({"path": url, "modified_at": stamp})
+            values = tuple(row.get(n) for n in self._column_names)
+            self._add_inner(key, values)
+            self._seen[url] = (stamp, key, values)
+        self.commit()
+
+    def run(self) -> None:
+        self._scan()
+        if self._mode == "static":
+            return
+        while not self._closed.is_set():
+            _time.sleep(self.refresh_s)
+            self._scan()
+
+    def current_offsets(self):
+        return dict(self._seen)
+
+    def seek(self, offsets) -> None:
+        if offsets:
+            self._seen = dict(offsets)
+
+
+def read(
+    url: str,
+    *,
+    tenant: str | None = None,
+    client_id: str | None = None,
+    cert_path: str | None = None,
+    thumbprint: str | None = None,
+    root_path: str = "",
+    context: Any = None,
+    mode: str = "streaming",
+    refresh_interval: float = 30.0,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    if context is None:
+        from office365.sharepoint.client_context import ClientContext  # optional dependency
+
+        context = ClientContext(url).with_client_certificate(
+            tenant, client_id, thumbprint, cert_path
+        )
+    schema = schema_from_types(data=bytes)
+    out_schema = with_metadata_schema(schema) if with_metadata else schema
+    subject = _SharePointSubject(
+        context, root_path, mode, refresh_interval, with_metadata,
+        autocommit_duration_ms,
+    )
+    subject.persistent_id = persistent_id
+    subject._configure(out_schema, None)
+    return input_table(out_schema, subject=subject)

@@ -1,0 +1,175 @@
+"""pw.sql translation tests (reference: python/pathway/tests around
+internals/sql.py) + error-log API tests."""
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+
+
+@pytest.fixture
+def sales():
+    return dbg.table_from_markdown(
+        """
+        owner | pet   | value
+        alice | dog   | 10
+        alice | cat   | 7
+        bob   | dog   | 3
+        carol | parrot| 5
+        """
+    )
+
+
+def _rows(table):
+    _, cols = dbg.table_to_dicts(table)
+    names = table.column_names()
+    return sorted(
+        tuple(cols[n][k] for n in names) for k in list(cols[names[0]].keys())
+    )
+
+
+def test_sql_select_where(sales):
+    r = pw.sql("SELECT owner, value FROM sales WHERE value > 4", sales=sales)
+    assert _rows(r) == [("alice", 7), ("alice", 10), ("carol", 5)]
+
+
+def test_sql_select_star(sales):
+    r = pw.sql("SELECT * FROM sales WHERE owner = 'bob'", sales=sales)
+    assert _rows(r) == [("bob", "dog", 3)]
+
+
+def test_sql_expressions_and_alias(sales):
+    r = pw.sql(
+        "SELECT owner, value * 2 + 1 AS double_value FROM sales "
+        "WHERE pet = 'dog'",
+        sales=sales,
+    )
+    assert _rows(r) == [("alice", 21), ("bob", 7)]
+
+
+def test_sql_group_by(sales):
+    r = pw.sql(
+        "SELECT owner, SUM(value) AS total, COUNT(*) AS n FROM sales "
+        "GROUP BY owner",
+        sales=sales,
+    )
+    assert _rows(r) == [("alice", 17, 2), ("bob", 3, 1), ("carol", 5, 1)]
+
+
+def test_sql_group_by_having(sales):
+    r = pw.sql(
+        "SELECT owner, SUM(value) AS total FROM sales GROUP BY owner "
+        "HAVING SUM(value) > 4",
+        sales=sales,
+    )
+    assert _rows(r) == [("alice", 17), ("carol", 5)]
+
+
+def test_sql_global_aggregate(sales):
+    r = pw.sql("SELECT SUM(value) AS s, MAX(value) AS m FROM sales", sales=sales)
+    assert _rows(r) == [(25, 10)]
+
+
+def test_sql_join():
+    left = dbg.table_from_markdown(
+        """
+        owner | city
+        alice | berlin
+        bob   | paris
+        """
+    )
+    sales = dbg.table_from_markdown(
+        """
+        who   | value
+        alice | 10
+        bob   | 3
+        alice | 7
+        """
+    )
+    r = pw.sql(
+        "SELECT city, value FROM sales JOIN owners ON who = owner",
+        sales=sales, owners=left,
+    )
+    assert _rows(r) == [("berlin", 7), ("berlin", 10), ("paris", 3)]
+
+
+def test_sql_union_all(sales):
+    r = pw.sql(
+        "SELECT owner FROM sales WHERE value > 9 "
+        "UNION ALL SELECT owner FROM sales WHERE value < 4",
+        sales=sales,
+    )
+    assert _rows(r) == [("alice",), ("bob",)]
+
+
+def test_sql_functions(sales):
+    r = pw.sql(
+        "SELECT UPPER(owner) AS o FROM sales WHERE pet = 'parrot'", sales=sales
+    )
+    assert _rows(r) == [("CAROL",)]
+
+
+def test_sql_is_null():
+    t = dbg.table_from_markdown(
+        """
+        a | b
+        1 | 5
+        2 |
+        """
+    )
+    r = pw.sql("SELECT a FROM t WHERE b IS NULL", t=t)
+    assert _rows(r) == [(2,)]
+
+
+def test_sql_unknown_table():
+    with pytest.raises(ValueError, match="unknown table"):
+        pw.sql("SELECT x FROM missing")
+
+
+def test_sql_bad_syntax(sales):
+    with pytest.raises(ValueError):
+        pw.sql("SELEC owner FROM sales", sales=sales)
+
+
+# ---------------------------------------------------------------------------
+# error handling API
+# ---------------------------------------------------------------------------
+
+
+def test_remove_errors_drops_error_rows():
+    t = dbg.table_from_markdown(
+        """
+        a | b
+        6 | 2
+        8 | 0
+        """
+    )
+    ratio = t.select(t.a, r=pw.fill_error(t.a // t.b, -1))
+    _, cols = dbg.table_to_dicts(ratio)
+    assert sorted(cols["r"].values()) == [-1, 3]
+
+
+def test_global_error_log_collects(tmp_path):
+    import threading
+    import time
+
+    t = dbg.table_from_markdown(
+        """
+        a | b
+        6 | 2
+        8 | 0
+        """
+    )
+    bad = t.select(r=t.a // t.b)
+    results = []
+    pw.io.subscribe(
+        bad, on_change=lambda k, row, tm, add: results.append(row) if add else None
+    )
+    errors = []
+    log = pw.global_error_log()
+    pw.io.subscribe(
+        log, on_change=lambda k, row, tm, add: errors.append(row["message"])
+    )
+    pw.run(terminate_on_error=False)
+    assert len(results) == 2  # both rows flow; one carries ERROR
+    assert any("ZeroDivisionError" in e for e in errors)
