@@ -617,6 +617,11 @@ class GraphRunner:
 
         lower_asof_now_join(self, op)
 
+    def _lower_window_behavior(self, op: Operator) -> None:
+        from ..stdlib.temporal._behavior_node import lower_window_behavior
+
+        lower_window_behavior(self, op)
+
 
 def _iter_flat(seq):
     import numpy as np

@@ -1,0 +1,161 @@
+"""Runtime node applying window behaviors to assigned window rows.
+
+reference: src/engine/dataflow/operators/time_column.rs — ``buffer``
+(delay: hold rows until the event-time watermark passes
+window_start + delay), ``forget`` (cutoff: drop late rows and, with
+``keep_results=False``, retract whole windows once the watermark passes
+window_end + cutoff) and ``freeze`` — parameterized by
+``common_behavior`` / ``exactly_once_behavior``
+(stdlib/temporal/temporal_behavior.py).
+
+The event-time watermark is the max time value observed across the
+stream, advanced at micro-batch boundaries — the same "watermark follows
+the data" model the reference's time_column operator uses on the totally
+ordered outer scope.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...internals.engine import Entry, Node, consolidate, freeze_row
+from ...internals.graph import Operator
+from ...internals.runtime import GraphRunner
+
+__all__ = ["WindowBehaviorNode", "lower_window_behavior"]
+
+
+def _num(v):
+    from ...internals.value import DateTimeNaive, DateTimeUtc, Duration
+
+    if isinstance(v, (Duration, DateTimeNaive, DateTimeUtc)):
+        return v.ns
+    return v
+
+
+class WindowBehaviorNode(Node):
+    """Port 0: assigned window rows carrying (time, window_start,
+    window_end) at known positions."""
+
+    def __init__(
+        self,
+        time_idx: int,
+        start_idx: int,
+        end_idx: int,
+        delay: Any = None,
+        cutoff: Any = None,
+        keep_results: bool = True,
+        delay_from_end: bool = False,
+        name: str = "window_behavior",
+    ):
+        super().__init__(n_inputs=1, name=name)
+        self.time_idx = time_idx
+        self.start_idx = start_idx
+        self.end_idx = end_idx
+        self.delay_from_end = delay_from_end  # exactly-once: ready at end+shift
+        self.delay = _num(delay) if delay is not None else None
+        self.cutoff = _num(cutoff) if cutoff is not None else None
+        self.keep_results = keep_results
+        self.watermark: Any = None
+        self.held: list[Entry] = []
+        # window_end -> released entries (for keep_results=False retraction)
+        self.released: dict[Any, list[Entry]] = {}
+        self.closed: set = set()
+
+    def _window_closed(self, end) -> bool:
+        return (
+            self.cutoff is not None
+            and self.watermark is not None
+            and _num(end) + self.cutoff <= self.watermark
+        )
+
+    def _ready(self, row) -> bool:
+        if self.delay is None:
+            return True
+        ref = row[self.end_idx if self.delay_from_end else self.start_idx]
+        return (
+            self.watermark is not None
+            and _num(ref) + self.delay <= self.watermark
+        )
+
+    def _release(self, entry: Entry, out: list[Entry]) -> None:
+        end_key = _num(entry[1][self.end_idx])
+        if not self.keep_results:
+            self.released.setdefault(end_key, []).append(entry)
+        out.append(entry)
+
+    def flush(self, time: int) -> list[Entry]:
+        out: list[Entry] = []
+        incoming = self.take(0)
+        # watermark advances at the batch boundary: rows of this batch are
+        # admitted against the watermark of the *previous* batch, then the
+        # clock moves (time_column.rs applies the same batch-edge semantics)
+        for key, row, diff in incoming:
+            end = row[self.end_idx]
+            if diff > 0 and self._window_closed(end):
+                continue  # late data for a closed window: forgotten
+            if diff < 0:
+                # retraction: cancel a matching held entry first
+                target = (key, freeze_row(row))
+                for i, (hk, hr, hd) in enumerate(self.held):
+                    if hd > 0 and (hk, freeze_row(hr)) == target:
+                        del self.held[i]
+                        break
+                else:
+                    self._release((key, row, diff), out)
+                continue
+            if self._ready(row):
+                self._release((key, row, diff), out)
+            else:
+                self.held.append((key, row, diff))
+        # advance the watermark
+        for _, row, _ in incoming:
+            tv = _num(row[self.time_idx])
+            if self.watermark is None or tv > self.watermark:
+                self.watermark = tv
+        # release newly-ready held rows; cutoff is admission control for
+        # *incoming* rows — anything already held was on time, so a window
+        # closing while its rows sat in the buffer still emits them
+        still: list[Entry] = []
+        for entry in self.held:
+            if self._ready(entry[1]):
+                self._release(entry, out)
+            else:
+                still.append(entry)
+        self.held = still
+        # keep_results=False: retract every row of windows that just closed
+        if not self.keep_results:
+            for end_key in list(self.released):
+                if (
+                    self.cutoff is not None
+                    and self.watermark is not None
+                    and end_key + self.cutoff <= self.watermark
+                ):
+                    for key, row, diff in self.released.pop(end_key):
+                        out.append((key, row, -diff))
+        return consolidate(out)
+
+    def on_end(self) -> list[Entry]:
+        # stream close: flush everything still buffered (batch-mode windows
+        # must still appear even if the watermark never passed their delay)
+        out: list[Entry] = []
+        held, self.held = self.held, []
+        for entry in held:
+            out.append(entry)
+        return consolidate(out)
+
+
+def lower_window_behavior(runner: GraphRunner, op: Operator) -> None:
+    node = WindowBehaviorNode(
+        time_idx=op.params["time_idx"],
+        start_idx=op.params["start_idx"],
+        end_idx=op.params["end_idx"],
+        delay=op.params.get("delay"),
+        cutoff=op.params.get("cutoff"),
+        keep_results=op.params.get("keep_results", True),
+        delay_from_end=op.params.get("delay_from_end", False),
+        name=f"window_behavior#{op.id}",
+    )
+    runner.engine.add(node)
+    runner._connect_inputs(op, node)
+    runner._register(op, node)

@@ -168,16 +168,15 @@ def windowby(
     origin=None,
 ) -> WindowGroupedTable:
     """reference: _window.py:863"""
-    if behavior is not None:
-        raise NotImplementedError(
-            "window behaviors (delay/cutoff/keep_results) land with the "
-            "streaming-behaviors milestone; drop the behavior= argument to "
-            "get always-updating windows"
-        )
     time_e = resolve_expression(time_expr, table)
     instance_e = resolve_expression(instance, table) if instance is not None else None
 
     if isinstance(window, SessionWindow):
+        if behavior is not None:
+            raise NotImplementedError(
+                "behaviors on session windows are not supported yet "
+                "(sessions merge retroactively; cutoff would be unsound)"
+            )
         assigned = _assign_session(table, time_e, instance_e, window)
     else:
         win_dtype = time_e._dtype
@@ -204,7 +203,56 @@ def windowby(
             },
             universe=flat._universe,
         )
+    if behavior is not None:
+        assigned = _apply_behavior(assigned, table, time_e, behavior)
     return WindowGroupedTable(assigned, instance_e is not None)
+
+
+def _apply_behavior(assigned: Table, source: Table, time_e, behavior: Behavior) -> Table:
+    """Insert the buffering/cutoff node between window assignment and the
+    grouped reduction (reference: behaviors compiled onto time_column.rs
+    forget/buffer in the window operator)."""
+    from ...internals.expression import ColumnReference
+    from ...internals.graph import Operator
+    from ...internals.universe import Universe
+
+    # rebind the time expression onto the assigned table (same column
+    # names survive assignment)
+    def rebind(node):
+        if isinstance(node, ColumnReference) and node.table is source:
+            return assigned[node.name]
+        return None
+
+    time_on_assigned = time_e._substitute(rebind)
+    with_t = assigned.with_columns(__behavior_t__=time_on_assigned)
+    names = with_t.column_names()
+    if isinstance(behavior, ExactlyOnceBehavior):
+        params = dict(
+            delay=behavior.shift or 0,
+            cutoff=behavior.shift or 0,
+            keep_results=True,
+            delay_from_end=True,
+        )
+    elif isinstance(behavior, CommonBehavior):
+        params = dict(
+            delay=behavior.delay,
+            cutoff=behavior.cutoff,
+            keep_results=behavior.keep_results,
+            delay_from_end=False,
+        )
+    else:
+        raise TypeError(f"unknown behavior {behavior!r}")
+    op = Operator(
+        "window_behavior",
+        [with_t],
+        params=dict(
+            time_idx=names.index("__behavior_t__"),
+            start_idx=names.index("_pw_window_start"),
+            end_idx=names.index("_pw_window_end"),
+            **params,
+        ),
+    )
+    return Table._new(op, with_t.schema, Universe())
 
 
 def _assign_session(table: Table, time_e, instance_e, window: SessionWindow) -> Table:

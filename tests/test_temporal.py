@@ -1,0 +1,269 @@
+"""Temporal stdlib: windows, behaviors, interval/asof/window joins.
+
+reference test model: python/pathway/tests/temporal/.
+"""
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+
+
+def _rows(table):
+    _, cols = dbg.table_to_dicts(table)
+    names = table.column_names()
+    keys = list(cols[names[0]].keys()) if names else []
+    return sorted(tuple(cols[n][k] for n in names) for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# windows
+# ---------------------------------------------------------------------------
+
+
+def test_tumbling_window_counts():
+    t = dbg.table_from_markdown(
+        """
+        t  | v
+        1  | 10
+        3  | 20
+        5  | 30
+        6  | 40
+        """
+    )
+    result = t.windowby(t.t, window=pw.temporal.tumbling(duration=4)).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+        total=pw.reducers.sum(pw.this.v),
+    )
+    assert _rows(result) == [(0, 2, 30), (4, 2, 70)]
+
+
+def test_sliding_window_overlap():
+    t = dbg.table_from_markdown(
+        """
+        t
+        0
+        3
+        """
+    )
+    result = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, duration=4)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    # t=0 lands in windows starting -2, 0; t=3 in 0, 2
+    assert _rows(result) == [(-2, 1), (0, 2), (2, 1)]
+
+
+def test_session_window_max_gap():
+    t = dbg.table_from_markdown(
+        """
+        t
+        1
+        2
+        3
+        10
+        11
+        """
+    )
+    result = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=2)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        n=pw.reducers.count(),
+    )
+    assert _rows(result) == [(1, 3, 3), (10, 11, 2)]
+
+
+def test_window_instance_grouping():
+    t = dbg.table_from_markdown(
+        """
+        t | shard
+        1 | a
+        2 | a
+        1 | b
+        """
+    )
+    result = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=4), instance=t.shard
+    ).reduce(
+        shard=pw.this._pw_instance,
+        n=pw.reducers.count(),
+    )
+    assert _rows(result) == [("a", 2), ("b", 1)]
+
+
+# ---------------------------------------------------------------------------
+# behaviors
+# ---------------------------------------------------------------------------
+
+
+def test_common_behavior_delay_buffers_until_watermark():
+    # rows arrive over three processing-time batches
+    t = dbg.table_from_markdown(
+        """
+        t | __time__ | __diff__
+        0 | 2        | 1
+        1 | 4        | 1
+        4 | 6        | 1
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=2),
+        behavior=pw.temporal.common_behavior(delay=2),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    (out,) = dbg.materialize(result)
+    # window [0,2) may only appear once the event-time watermark reached 2,
+    # i.e. not before the engine time that carried t=4
+    first_emit_time = min(tm for _, row, tm, d in out.history if d > 0)
+    t4_time = 6
+    assert first_emit_time >= t4_time
+    assert sorted(r for r in [tuple(row) for _, row, _, d in out.history if d > 0]) == [
+        (0, 2), (4, 1),
+    ]
+
+
+def test_common_behavior_cutoff_drops_late_rows():
+    t = dbg.table_from_markdown(
+        """
+        t  | __time__ | __diff__
+        1  | 2        | 1
+        10 | 4        | 1
+        2  | 6        | 1
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=4),
+        behavior=pw.temporal.common_behavior(cutoff=2),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    # the t=2 row arrives when the watermark is 10; its window [0,4) closed
+    # at watermark 4+2=6, so it is forgotten
+    assert _rows(result) == [(0, 1), (8, 1)]
+
+
+def test_common_behavior_keep_results_false_retracts_closed_windows():
+    t = dbg.table_from_markdown(
+        """
+        t  | __time__ | __diff__
+        1  | 2        | 1
+        10 | 4        | 1
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=4),
+        behavior=pw.temporal.common_behavior(cutoff=2, keep_results=False),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    # window [0,4) closes once the watermark passes 6 -> its result is
+    # withdrawn; only the live window survives
+    assert _rows(result) == [(8, 1)]
+
+
+def test_exactly_once_behavior_single_emission():
+    t = dbg.table_from_markdown(
+        """
+        t | __time__ | __diff__
+        1 | 2        | 1
+        3 | 4        | 1
+        5 | 6        | 1
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=2),
+        behavior=pw.temporal.exactly_once_behavior(),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    (out,) = dbg.materialize(result)
+    # window [0,2): emitted exactly once (no retract/re-add churn)
+    w0_events = [
+        (tm, d) for _, row, tm, d in out.history if row[0] == 0
+    ]
+    assert len(w0_events) == 1 and w0_events[0][1] == 1
+
+
+# ---------------------------------------------------------------------------
+# temporal joins
+# ---------------------------------------------------------------------------
+
+
+def test_interval_join():
+    left = dbg.table_from_markdown(
+        """
+        lt | a
+        0  | x
+        5  | y
+        """
+    )
+    right = dbg.table_from_markdown(
+        """
+        rt | b
+        1  | p
+        4  | q
+        9  | r
+        """
+    )
+    joined = left.interval_join(
+        right, left.lt, right.rt, pw.temporal.interval(-1, 2)
+    ).select(left.a, right.b)
+    assert _rows(joined) == [("x", "p"), ("y", "q")]
+
+
+def test_window_join():
+    left = dbg.table_from_markdown(
+        """
+        lt | a
+        1  | x
+        5  | y
+        """
+    )
+    right = dbg.table_from_markdown(
+        """
+        rt | b
+        2  | p
+        6  | q
+        """
+    )
+    joined = left.window_join(
+        right, left.lt, right.rt, pw.temporal.tumbling(duration=4)
+    ).select(left.a, right.b)
+    assert _rows(joined) == [("x", "p"), ("y", "q")]
+
+
+def test_asof_join():
+    trades = dbg.table_from_markdown(
+        """
+        t | px
+        2 | 100
+        7 | 200
+        """
+    )
+    quotes = dbg.table_from_markdown(
+        """
+        t | bid
+        1 | 99
+        5 | 198
+        9 | 205
+        """
+    )
+    joined = trades.asof_join(quotes, trades.t, quotes.t).select(
+        trades.px, quotes.bid
+    )
+    # each trade matches the latest quote at-or-before its time
+    assert _rows(joined) == [(100, 99), (200, 198)]
