@@ -290,7 +290,8 @@ class GroupByNode(Node):
             instance = self.group_instance.get(gfrozen)
             rows = list(group_state.values())  # [count, args, key, sort_key, seq]
             if self.sort_by_fn is not None:
-                rows.sort(key=lambda s: s[3])
+                # None sort keys (outer-join padding rows) order last
+                rows.sort(key=lambda s: (s[3] is None, s[3]))
             values = [
                 red.compute(
                     [(s[1][i], s[0], s[2], s[4]) for s in rows]

@@ -7,6 +7,7 @@ _window_join.py, temporal_behavior.py).
 
 from ._window import (
     Window,
+    intervals_over,
     tumbling,
     sliding,
     session,
@@ -18,6 +19,7 @@ from ._joins import asof_join, interval_join, window_join, interval, AsofDirecti
 
 __all__ = [
     "Window",
+    "intervals_over",
     "tumbling",
     "sliding",
     "session",

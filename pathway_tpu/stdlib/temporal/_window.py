@@ -119,6 +119,24 @@ class SessionWindow(Window):
         return out
 
 
+@dataclass
+class IntervalsOverWindow(Window):
+    """Windows anchored at probe times from another table
+    (reference: _window.py:793 ``intervals_over``)."""
+
+    at: Any
+    lower_bound: Any
+    upper_bound: Any
+    is_outer: bool = True
+
+
+def intervals_over(*, at, lower_bound, upper_bound, is_outer: bool = True) -> Window:
+    """For each probe time t in ``at``, group rows whose time lies in
+    ``[t+lower_bound, t+upper_bound]``; ``_pw_window_location`` carries t
+    (reference: _window.py:793)."""
+    return IntervalsOverWindow(at, lower_bound, upper_bound, is_outer)
+
+
 def tumbling(duration=None, origin=None, length=None) -> Window:
     """reference: _window.py tumbling()"""
     return TumblingWindow(duration=duration if duration is not None else length, origin=origin)
@@ -148,12 +166,17 @@ class WindowGroupedTable:
         self._assigned = assigned
         self._instance_given = instance_given
 
+    _sort_by_name: str | None = None
+
     def reduce(self, *args: Any, **kwargs: Any) -> Table:
         t = self._assigned
         grouping = [t["_pw_window"], t["_pw_window_start"], t["_pw_window_end"]]
+        if "_pw_window_location" in t.column_names():
+            grouping.append(t["_pw_window_location"])
         if self._instance_given:
             grouping.append(t["_pw_instance"])
-        gt = t.groupby(*grouping)
+        sort_by = t[self._sort_by_name] if self._sort_by_name else None
+        gt = t.groupby(*grouping, sort_by=sort_by)
         # rebind pw.this refs against the assigned table
         return gt.reduce(*args, **kwargs)
 
@@ -171,6 +194,15 @@ def windowby(
     time_e = resolve_expression(time_expr, table)
     instance_e = resolve_expression(instance, table) if instance is not None else None
 
+    if isinstance(window, IntervalsOverWindow):
+        if behavior is not None:
+            raise NotImplementedError(
+                "behaviors on intervals_over windows are not supported"
+            )
+        assigned = _assign_intervals_over(table, time_e, instance_e, window)
+        wgt = WindowGroupedTable(assigned, instance_e is not None)
+        wgt._sort_by_name = "__iv_time__"
+        return wgt
     if isinstance(window, SessionWindow):
         if behavior is not None:
             raise NotImplementedError(
@@ -253,6 +285,35 @@ def _apply_behavior(assigned: Table, source: Table, time_e, behavior: Behavior) 
         ),
     )
     return Table._new(op, with_t.schema, Universe())
+
+
+def _assign_intervals_over(
+    table: Table, time_e, instance_e, window: IntervalsOverWindow
+) -> Table:
+    """One assigned row per (probe, matching data row); probes without
+    matches survive as empty windows when ``is_outer`` (the reference's
+    outer interval join, _window.py:793)."""
+    from ...internals.joins import JoinMode
+    from ._joins import interval, interval_join
+
+    probes = window.at.table
+    how = JoinMode.LEFT if window.is_outer else JoinMode.INNER
+    res = interval_join(
+        probes, table, window.at, time_e,
+        interval(window.lower_bound, window.upper_bound), how=how,
+    )
+    at_ref = window.at
+    lb, ub = window.lower_bound, window.upper_bound
+    exprs: dict[str, Any] = {n: table[n] for n in table.column_names()}
+    exprs["__iv_time__"] = time_e
+    exprs["_pw_window_location"] = at_ref
+    exprs["_pw_window"] = at_ref
+    exprs["_pw_window_start"] = at_ref + lb
+    exprs["_pw_window_end"] = at_ref + ub
+    exprs["_pw_instance"] = (
+        instance_e if instance_e is not None else ApplyExpression(lambda v: 0, dt.INT, at_ref)
+    )
+    return res.select(**exprs)
 
 
 def _assign_session(table: Table, time_e, instance_e, window: SessionWindow) -> Table:

@@ -267,3 +267,51 @@ def test_asof_join():
     )
     # each trade matches the latest quote at-or-before its time
     assert _rows(joined) == [(100, 99), (200, 198)]
+
+
+def test_intervals_over_matches_reference_doctest():
+    t = dbg.table_from_markdown(
+        """
+            | t |  v
+        1   | 1 |  10
+        2   | 2 |  1
+        3   | 4 |  3
+        4   | 8 |  2
+        5   | 9 |  4
+        6   | 10|  8
+        7   | 1 |  9
+        8   | 2 |  16
+        """
+    )
+    probes = dbg.table_from_markdown(
+        """
+        t
+        2
+        4
+        6
+        8
+        10
+        """
+    )
+    result = pw.temporal.windowby(
+        t, t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.t, lower_bound=-2, upper_bound=1
+        ),
+    ).reduce(
+        pw.this._pw_window_location,
+        v=pw.reducers.sorted_tuple(pw.this.v, skip_nones=True),
+    )
+    ids, cols = dbg.table_to_dicts(result)
+    out = sorted(
+        (cols["_pw_window_location"][k], cols["v"][k]) for k in ids
+    )
+    # exact expected output of the reference's intervals_over doctest
+    # (_window.py:793)
+    assert out == [
+        (2, (1, 9, 10, 16)),
+        (4, (1, 3, 16)),
+        (6, (3,)),
+        (8, (2, 4)),
+        (10, (2, 4, 8)),
+    ]
