@@ -775,12 +775,16 @@ class OutputNode(Node):
         on_change: Callable | None = None,
         on_time_end: Callable | None = None,
         on_end: Callable | None = None,
+        keep_history: bool = True,
         name: str = "output",
     ):
         super().__init__(n_inputs=1, name=name)
         self.on_change = on_change
         self.on_time_end_cb = on_time_end
         self.on_end_cb = on_end
+        # debug/materialize needs the full update stream; long-running
+        # subscribe sinks must not accumulate it (unbounded growth)
+        self.keep_history = keep_history
         self.current: dict[Pointer, tuple] = {}
         self.history: list[tuple[Pointer, tuple, int, int]] = []  # key,row,time,diff
 
@@ -788,7 +792,8 @@ class OutputNode(Node):
         entries = consolidate(self.take(0))
         self._step_touched = self._step_touched or bool(entries)
         for key, row, diff in sorted(entries, key=lambda e: e[2]):
-            self.history.append((key, row, time, diff))
+            if self.keep_history:
+                self.history.append((key, row, time, diff))
             if diff > 0:
                 self.current[key] = row
             else:

@@ -1,0 +1,110 @@
+"""Cross-graph table handoff.
+
+reference: src/engine/dataflow/export.rs (``ExportedTable``:205,
+``export_table`` dataflow.rs:3871) + the Python ``Table._export`` /
+``Scope.import_table`` pair — one running graph exposes a table, another
+graph (typically a second ``pw.run`` loop in the same process) consumes
+it live, snapshot first, then diffs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from .schema import SchemaMetaclass
+from .table import Table
+
+__all__ = ["ExportedTable", "export_table", "import_table"]
+
+
+class ExportedTable:
+    """Thread-safe snapshot + diff fan-out between engine loops."""
+
+    def __init__(self, schema: SchemaMetaclass):
+        self.schema = schema
+        self._lock = threading.Lock()
+        self._snapshot: dict[Any, tuple] = {}
+        self._subscribers: list = []  # ConnectorSubjects of importing graphs
+        self._closed = False
+
+    # -- producer side --
+    def _push(self, key, values: tuple, is_addition: bool) -> None:
+        # notification stays under the lock: otherwise a subscriber attaching
+        # between the snapshot mutation and the notify would see the row
+        # twice (once replayed, once as a live diff)
+        with self._lock:
+            if is_addition:
+                self._snapshot[key] = values
+            else:
+                self._snapshot.pop(key, None)
+            for subject in self._subscribers:
+                if is_addition:
+                    subject._add_inner(key, values)
+                else:
+                    subject._remove(key, values)
+                subject.commit()
+
+    def _close(self) -> None:
+        with self._lock:
+            self._closed = True
+            subscribers = list(self._subscribers)
+        for subject in subscribers:
+            subject.close()
+
+    # -- consumer side --
+    def _attach_and_replay(self, subject) -> None:
+        """Replay the snapshot into ``subject`` and register it for live
+        diffs — atomically, so no diff is seen twice or out of order."""
+        with self._lock:
+            for key, values in self._snapshot.items():
+                subject._add_inner(key, values)
+            subject.commit()
+            closed = self._closed
+            if not closed:
+                self._subscribers.append(subject)
+        if closed:
+            subject.close()
+
+    @property
+    def failed(self) -> bool:  # reference: ExportedTable::failed
+        return False
+
+    def snapshot_at_now(self) -> list[tuple[Any, tuple]]:
+        with self._lock:
+            return list(self._snapshot.items())
+
+
+def export_table(table: Table) -> ExportedTable:
+    """Register ``table`` for export; drive the graph with ``pw.run``
+    (threaded for live handoff)."""
+    from ..io._subscribe import subscribe
+
+    exported = ExportedTable(table.schema)
+    names = table.column_names()
+
+    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
+        exported._push(key, tuple(row[n] for n in names), is_addition)
+
+    subscribe(
+        table, on_change=on_change, on_end=exported._close,
+        name="export_table",
+    )
+    return exported
+
+
+def import_table(exported: ExportedTable) -> Table:
+    """Materialize an exported table in the current graph: snapshot replay,
+    then live diffs until the exporting graph closes."""
+    from ..io._utils import input_table
+    from ..io.streaming import ConnectorSubject
+
+    class _ImportSubject(ConnectorSubject):
+        def run(self) -> None:
+            exported._attach_and_replay(self)
+            # live diffs arrive via _push; block until the exporter closes
+            self._closed.wait()
+
+    subject = _ImportSubject(datasource_name="import_table")
+    subject._configure(exported.schema, None)
+    return input_table(exported.schema, subject=subject)

@@ -434,6 +434,14 @@ class Table:
         all_exprs.update(exprs)
         return self._select_exprs(all_exprs, universe=self._universe)
 
+    def _export(self):
+        """Expose this table to other graphs in the process
+        (reference: export.rs ExportedTable / dataflow.rs:3871); import
+        with ``internals.export.import_table``."""
+        from .export import export_table
+
+        return export_table(self)
+
     def remove_errors(self) -> "Table":
         """Drop rows containing ``ERROR`` cells
         (reference: graph.rs:984 ``remove_errors_from_table``)."""

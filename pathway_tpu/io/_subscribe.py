@@ -36,6 +36,7 @@ def subscribe(
         on_change=wrapped if on_change is not None else None,
         on_time_end=on_time_end,
         on_end=on_end,
+        keep_history=False,  # long-running sinks must not accumulate diffs
         name=name or "subscribe",
     )
     G.sinks.append((table, node))

@@ -153,7 +153,23 @@ class DeviceKnnIndex:
 
     def _device_search(self, q: np.ndarray, k: int) -> tuple[jax.Array, jax.Array]:
         """(scores, slot indices) for normalized queries — subclasses
-        override with the mesh-sharded path."""
+        override with the mesh-sharded path.  Large cos/dot indexes take
+        the tiled Pallas kernel (score tiles streamed through VMEM); small
+        ones stay on the plain fused XLA path."""
+        from .topk import PALLAS_MIN_ROWS, pallas_topk_search
+
+        if (
+            self.metric in ("cos", "dot")
+            and self.capacity >= PALLAS_MIN_ROWS
+            and self.capacity % 1024 == 0
+        ):
+            return pallas_topk_search(
+                jnp.asarray(q, dtype=self.dtype),
+                self.vectors,
+                self.valid,
+                min(k, self.capacity),
+                self.metric,
+            )
         return topk_search(
             jnp.asarray(q, dtype=self.dtype),
             self.vectors,

@@ -76,6 +76,7 @@ def pallas_masked_scores(
     valid: jax.Array,  # [N] float32 {0,1}
     *,
     block_n: int = 1024,
+    interpret: bool | None = None,
 ) -> jax.Array:
     """Tiled score kernel: for each (query-block, vector-block) grid cell,
     compute q·vᵀ on the MXU and apply the tombstone mask in the epilogue.
@@ -85,6 +86,11 @@ def pallas_masked_scores(
     resident while index tiles stream from HBM.
     """
     from jax.experimental import pallas as pl
+
+    if interpret is None:
+        # the Mosaic backend exists on TPU only; elsewhere (CPU mesh in
+        # tests) the interpreter executes the same kernel
+        interpret = jax.default_backend() != "tpu"
 
     q, d = queries.shape
     n = vectors.shape[0]
@@ -109,4 +115,30 @@ def pallas_masked_scores(
             pl.BlockSpec((block_n,), lambda i, j: (j,)),
         ],
         out_specs=pl.BlockSpec((block_q, block_n), lambda i, j: (i, j)),
+        interpret=interpret,
     )(queries, vectors, valid.astype(jnp.float32))
+
+
+#: index sizes from which the tiled Pallas path pays for itself (smaller
+#: matrices stay fused in VMEM by XLA on their own)
+PALLAS_MIN_ROWS = 4096
+
+
+def pallas_topk_search(
+    queries: jax.Array,
+    vectors: jax.Array,
+    valid: jax.Array,
+    k: int,
+    metric: str = "cos",
+) -> tuple[jax.Array, jax.Array]:
+    """Tiled-score variant of :func:`topk_search` (cos/dot only — l2sq
+    falls back).  Queries are padded to the query-block multiple."""
+    q = queries.shape[0]
+    block_q = 256
+    if q > block_q and q % block_q:
+        pad = block_q - q % block_q
+        queries = jnp.concatenate(
+            [queries, jnp.zeros((pad, queries.shape[1]), queries.dtype)]
+        )
+    scores = pallas_masked_scores(queries, vectors, valid)[:q]
+    return lax.top_k(scores, k)

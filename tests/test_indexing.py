@@ -338,3 +338,44 @@ def test_sort_prev_next():
     assert cols["prev"][k2] is None and cols["next"][k2] == k3
     assert cols["prev"][k3] == k2 and cols["next"][k3] == k1
     assert cols["prev"][k1] == k3 and cols["next"][k1] is None
+
+
+# ---------------------------------------------------------------------------
+# Pallas tiled score kernel (interpret mode on the CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+def test_pallas_masked_scores_matches_xla():
+    import numpy as np
+    import jax.numpy as jnp
+    from pathway_tpu.ops.topk import masked_topk_scores, pallas_masked_scores
+
+    rng = np.random.default_rng(0)
+    queries = jnp.asarray(rng.standard_normal((8, 32)), jnp.float32)
+    vectors = jnp.asarray(rng.standard_normal((2048, 32)), jnp.float32)
+    valid = jnp.asarray(rng.random(2048) > 0.3)
+    ref = masked_topk_scores(queries, vectors, valid, "cos")
+    got = pallas_masked_scores(queries, vectors, valid, block_n=1024)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+
+def test_device_knn_pallas_path_matches_results():
+    import numpy as np
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    rng = np.random.default_rng(1)
+    # capacity 4096: crosses PALLAS_MIN_ROWS, multiple of 1024
+    index = DeviceKnnIndex(dim=16, metric="cos", capacity=4096)
+    vecs = rng.standard_normal((300, 16)).astype(np.float32)
+    for i, v in enumerate(vecs):
+        index.upsert(f"k{i}", v)
+    for i in range(0, 300, 7):
+        index.remove(f"k{i}")
+    queries = vecs[:5]
+    results = index.search(queries, k=3)
+    for qi, row in enumerate(results):
+        # deleted keys never surface; self-match first when not deleted
+        assert all(int(key[1:]) % 7 != 0 for key, _ in row)
+        if qi % 7 != 0:
+            assert row[0][0] == f"k{qi}"
+            assert row[0][1] == pytest.approx(1.0, abs=1e-4)

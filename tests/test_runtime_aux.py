@@ -218,3 +218,35 @@ reranker: !pw.xpacks.llm.rerankers.LLMReranker
 def test_load_yaml_bad_tag_raises():
     with pytest.raises(ValueError, match="cannot resolve"):
         pw.load_yaml("x: !pw.totally.bogus.path {}")
+
+
+# ---------------------------------------------------------------------------
+# cross-graph export/import
+# ---------------------------------------------------------------------------
+
+
+def test_export_import_between_graphs():
+    from pathway_tpu.internals.export import import_table
+
+    t = dbg.table_from_markdown(
+        """
+        name  | v
+        alice | 1
+        bob   | 2
+        """
+    )
+    exported = t._export()
+    pw.run()  # first graph: populates the exported snapshot
+
+    pw.global_graph.clear()
+    imported = import_table(exported)
+    doubled = imported.select(imported.name, w=imported.v * 10)
+    rows = {}
+    pw.io.subscribe(
+        doubled,
+        on_change=lambda k, row, tm, add: rows.__setitem__(row["name"], row["w"])
+        if add
+        else None,
+    )
+    pw.run()
+    assert rows == {"alice": 10, "bob": 20}
