@@ -63,6 +63,11 @@ class _DeferredCall:
         target = _resolve(self.dotted)
         args = [_materialize(a, variables) for a in self.args]
         kwargs = {k: _materialize(v, variables) for k, v in self.kwargs.items()}
+        # mapping nodes can pass positionals through the __args__ key
+        # (star-arg constructors like VectorStoreServer(*docs))
+        extra = kwargs.pop("__args__", None)
+        if extra is not None:
+            args = [*args, *(extra if isinstance(extra, list) else [extra])]
         if not args and not kwargs and not callable(target):
             return target
         if args and len(args) == 1 and args[0] in (None, "") and not kwargs:

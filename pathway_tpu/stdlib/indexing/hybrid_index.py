@@ -22,12 +22,14 @@ class HybridIndex:
         self.retrievers = retrievers
         self.k = k
 
-    def _fuse(self, query_table, results: list, number_of_matches: int):
+    def _fuse(self, query_table, results: list, number_of_matches):
         # results: list of collapsed right-tables (same universe as queries)
+        from ...internals.expression import smart_wrap
+
         data_cols = self.retrievers[0].data_table.column_names()
         rrf_k = self.k
 
-        def fuse(*packed):
+        def fuse(nm, *packed):
             n = len(packed) // (len(data_cols) + 2)
             # packed groups: per retriever: (*data_cols, ids, scores)
             stride = len(data_cols) + 2
@@ -39,12 +41,12 @@ class HybridIndex:
                 for rank, key in enumerate(ids):
                     scores[key] = scores.get(key, 0.0) + 1.0 / (rrf_k + rank + 1)
                     payload[key] = tuple(group[c][rank] for c in range(len(data_cols)))
-            ranked = sorted(scores.items(), key=lambda kv: -kv[1])[:number_of_matches]
+            ranked = sorted(scores.items(), key=lambda kv: -kv[1])[: int(nm)]
             return tuple(
                 (key, score, payload[key]) for key, score in ranked
             )
 
-        args = []
+        args = [smart_wrap(number_of_matches)]
         for right in results:
             for n in data_cols:
                 args.append(right[n])

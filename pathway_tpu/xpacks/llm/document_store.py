@@ -83,13 +83,24 @@ class DocumentStore:
         self.parsed_docs = graph["parsed_docs"]
         self.chunked_docs = graph["chunked_docs"]
         self.stats = graph["stats"]
-        self._retriever = DataIndex(
-            self.chunked_docs,
-            self.retriever_factory,
-            data_column=self.chunked_docs.text,
-            metadata_column=self.chunked_docs.metadata,
-            embedder=getattr(self.retriever_factory, "embedder", None),
-        )
+        from ...stdlib.indexing.hybrid_index import HybridIndex, HybridIndexFactory
+
+        def make_index(factory):
+            return DataIndex(
+                self.chunked_docs,
+                factory,
+                data_column=self.chunked_docs.text,
+                metadata_column=self.chunked_docs.metadata,
+                embedder=getattr(factory, "embedder", None),
+            )
+
+        if isinstance(self.retriever_factory, HybridIndexFactory):
+            self._retriever = HybridIndex(
+                [make_index(f) for f in self.retriever_factory.retriever_factories],
+                k=self.retriever_factory.k,
+            )
+        else:
+            self._retriever = make_index(self.retriever_factory)
 
     @property
     def index(self) -> DataIndex:

@@ -250,3 +250,26 @@ def test_export_import_between_graphs():
     )
     pw.run()
     assert rows == {"alice": 10, "bob": 20}
+
+
+def test_rag_example_app_end_to_end():
+    """The declarative example app (examples/rag_app) serves and scores
+    100% context hit rate with the mock embedder."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "examples/rag_app/run.py", "--mock-embedder",
+         "--port", str(port)],
+        cwd=repo_root, env=env, capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["value"] == 1.0
+    assert result["n_questions"] == 3

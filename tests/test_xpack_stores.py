@@ -227,3 +227,24 @@ def test_adaptive_rag_no_information(corpus_dir):
     )
     result = _first_result(qa.answer_query(queries))
     assert result["response"] == "No information found."
+
+
+def test_document_store_hybrid_index(corpus_dir):
+    from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndexFactory
+    from pathway_tpu.stdlib.indexing.retrievers import BruteForceKnnFactory
+
+    factory = HybridIndexFactory(
+        [
+            TantivyBM25Factory(),
+            BruteForceKnnFactory(embedder=mocks.FakeEmbedder(dim=8)),
+        ]
+    )
+    ds = DocumentStore(_docs(corpus_dir), retriever_factory=factory)
+    queries = dbg.table_from_rows(
+        DocumentStore.RetrieveQuerySchema,
+        [("The quick brown fox jumps over the lazy dog.", 2, None, None)],
+    )
+    results = _first_result(ds.retrieve_query(queries))
+    assert results
+    # the fox doc ranks first: exact text match wins in BOTH retrievers
+    assert "fox" in results[0]["text"]
