@@ -1,0 +1,321 @@
+"""Multi-process data plane: record exchange at stateful operator
+boundaries.
+
+reference: timely's ``CommunicationConfig::Cluster`` TCP transport
+(vendored external/timely-dataflow/communication, wired by
+src/engine/dataflow/config.rs:71-120 from PATHWAY_PROCESSES/PROCESS_ID/
+FIRST_PORT) and its Exchange pacts hashing ``Key`` to a worker
+(value.rs:38-99 shard semantics).
+
+Design here: every process runs the identical engine graph on its shard
+of records.  Shared sources (fs/kafka/s3 scanners that every process can
+see) apply an ownership filter at ingestion — a record enters the system
+on exactly one process — and :class:`ExchangeNode`s spliced before every
+stateful operator re-partition records by that operator's key (group key,
+join key, instance, …) over a TCP full mesh.  One exchange is a barrier
+per (channel, timestamp): processes step timestamps in lockstep, which is
+what makes the per-timestamp consistency of the engine hold globally (the
+role timely's progress protocol plays in the reference).
+
+TPU mapping: this is the host/DCN plane.  Device-plane collectives
+(all-gather top-k of the sharded HBM index, psum stats) ride ICI inside
+jit — see ``pathway_tpu/parallel``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import socket
+import struct
+import threading
+import time as _time
+from typing import Any, Callable
+
+from .engine import Entry, Node, consolidate, freeze_value
+
+__all__ = ["ExchangePlane", "ExchangeNode", "owner_of", "insert_exchanges"]
+
+_HDR = struct.Struct("<I")
+
+
+def owner_of(value: Any, n: int) -> int:
+    """Deterministic shard owner of a (frozen) key value."""
+    payload = pickle.dumps(freeze_value(value))
+    h = int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "little")
+    return h % n
+
+
+class ExchangePlane:
+    """TCP full mesh between the PATHWAY_PROCESSES processes on one host
+    (reference cluster addresses are 127.0.0.1:first_port+id within a
+    node, config.rs:113-116; pod DNS in k8s)."""
+
+    def __init__(self, processes: int, process_id: int, first_port: int,
+                 host: str = "127.0.0.1"):
+        self.n = processes
+        self.me = process_id
+        self.first_port = first_port
+        self.host = host
+        self._send: dict[int, socket.socket] = {}
+        self._inbox: dict[tuple, list] = {}  # (channel, time, from) -> payload
+        self._cv = threading.Condition()
+        #: max seconds a barrier waits for a peer before declaring it dead —
+        #: generous, because a peer may legitimately sit in long local
+        #: compute (first jit compile) between barriers
+        self.barrier_timeout = 600.0
+        self._server: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._closed = False
+
+    # -- wiring --
+    def start(self, timeout: float = 30.0) -> None:
+        self._server = socket.create_server(
+            (self.host, self.first_port + self.me), backlog=self.n
+        )
+        accept_th = threading.Thread(target=self._accept_loop, daemon=True)
+        accept_th.start()
+        self._threads.append(accept_th)
+        deadline = _time.monotonic() + timeout
+        for peer in range(self.n):
+            if peer == self.me:
+                continue
+            while True:
+                try:
+                    s = socket.create_connection(
+                        (self.host, self.first_port + peer), timeout=2.0
+                    )
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    self._send[peer] = s
+                    break
+                except OSError:
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"process {self.me}: peer {peer} did not come up"
+                        )
+                    _time.sleep(0.1)
+
+    def _accept_loop(self) -> None:
+        for _ in range(self.n - 1):
+            try:
+                conn, _addr = self._server.accept()
+            except OSError:
+                return
+            th = threading.Thread(
+                target=self._recv_loop, args=(conn,), daemon=True
+            )
+            th.start()
+            self._threads.append(th)
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = self._recv_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                (length,) = _HDR.unpack(hdr)
+                body = self._recv_exact(conn, length)
+                if body is None:
+                    return
+                channel, time, sender, entries = pickle.loads(body)
+                with self._cv:
+                    # a queue per key: identical schedules may exchange the
+                    # same (channel, time) more than once back-to-back, and
+                    # both batches must survive until popped
+                    self._inbox.setdefault((channel, time, sender), []).append(
+                        entries
+                    )
+                    self._cv.notify_all()
+        except OSError:
+            return
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # -- the barrier exchange --
+    def exchange(
+        self,
+        channel: str,
+        time: int,
+        outgoing: dict[int, list],
+    ) -> list:
+        """Send per-destination batches, receive this channel's batches
+        from every peer for ``time``; returns the merged remote entries.
+        A barrier: blocks until all peers have sent for (channel, time)."""
+        for peer in range(self.n):
+            if peer == self.me:
+                continue
+            payload = pickle.dumps(
+                (channel, time, self.me, outgoing.get(peer, []))
+            )
+            sock = self._send[peer]
+            sock.sendall(_HDR.pack(len(payload)) + payload)
+        merged: list = []
+        deadline = _time.monotonic() + self.barrier_timeout
+        with self._cv:
+            for peer in range(self.n):
+                if peer == self.me:
+                    continue
+                key = (channel, time, peer)
+                while not self._inbox.get(key):
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0 or not self._cv.wait(timeout=remaining):
+                        raise TimeoutError(
+                            f"exchange {channel}@{time}: no data from peer "
+                            f"{peer} within {self.barrier_timeout}s"
+                        )
+                queue = self._inbox[key]
+                merged.extend(queue.pop(0))
+                if not queue:
+                    del self._inbox[key]
+        return merged
+
+    def close(self) -> None:
+        self._closed = True
+        for s in self._send.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+
+
+class ExchangeNode(Node):
+    """Re-partitions its input by ``key_fn`` across the plane; spliced in
+    front of stateful operators (timely's Exchange pact)."""
+
+    def __init__(
+        self,
+        plane: ExchangePlane,
+        channel: str,
+        key_fn: Callable[[Any, tuple], Any] | None,
+        broadcast: bool = False,
+        name: str = "exchange",
+    ):
+        super().__init__(n_inputs=1, name=name)
+        self.plane = plane
+        self.channel = channel
+        self.key_fn = key_fn  # None = partition by row key
+        self.broadcast = broadcast
+        self._exchanged_time: int | None = None
+
+    # participates in every timestamp: peers may send even when this
+    # process has nothing local
+    late = True
+
+    def has_pending(self, time: int) -> bool:
+        # exactly one exchange per timestamp, *independent of local data* —
+        # peers run identical schedules, so a data-dependent flush count
+        # would deadlock the barrier.  Node-list position is topological,
+        # so all local inputs have settled by the time this node fires.
+        return self._exchanged_time != time
+
+    def flush(self, time: int) -> list[Entry]:
+        local = self.take(0)
+        outgoing: dict[int, list] = {}
+        mine: list[Entry] = []
+        if self.broadcast:
+            for peer in range(self.plane.n):
+                if peer != self.plane.me:
+                    outgoing[peer] = local
+            mine = list(local)
+        else:
+            for key, row, diff in local:
+                part_key = self.key_fn(key, row) if self.key_fn else key
+                dest = owner_of(part_key, self.plane.n)
+                if dest == self.plane.me:
+                    mine.append((key, row, diff))
+                else:
+                    outgoing.setdefault(dest, []).append((key, row, diff))
+        remote = self.plane.exchange(self.channel, time, outgoing)
+        self._exchanged_time = time
+        return consolidate(mine + list(remote))
+
+
+def insert_exchanges(engine, plane: ExchangePlane) -> None:
+    """Splice ExchangeNodes before every stateful node's keyed inputs —
+    the post-pass equivalent of timely's per-operator Exchange pacts."""
+    from .engine import (
+        ConcatNode,
+        DeduplicateNode,
+        GroupByNode,
+        JoinNode,
+        SemiJoinNode,
+        UpdateCellsNode,
+        UpdateRowsNode,
+        ZipNode,
+    )
+
+    def key_fns_for(node) -> dict[int, Callable | None] | None:
+        if isinstance(node, GroupByNode):
+            return {0: lambda key, row: node.group_fn(key, row)}
+        if isinstance(node, JoinNode):
+            return {
+                0: lambda key, row: node.left_key_fn(key, row),
+                1: lambda key, row: node.right_key_fn(key, row),
+            }
+        if isinstance(node, SemiJoinNode):
+            return {
+                0: lambda key, row: node.mask_key_fn(key, row),
+                1: lambda key, row: node.right_key_fn(key, row),
+            }
+        if isinstance(node, DeduplicateNode):
+            return {0: lambda key, row: node.instance_fn(key, row)}
+        if isinstance(node, (ZipNode, UpdateRowsNode, UpdateCellsNode, ConcatNode)):
+            return {port: None for port in range(node.n_inputs)}
+        return None
+
+    # index serving: docs broadcast to every process (each keeps a full
+    # replica, reference external_index.rs:95-98); queries stay local
+    from ..stdlib.indexing.lowering import ExternalIndexNode
+
+    counter = 0
+    for node in list(engine.nodes):
+        broadcast_ports: set[int] = set()
+        if isinstance(node, ExternalIndexNode):
+            key_map: dict[int, Callable | None] | None = {0: None}
+            broadcast_ports = {0}
+        else:
+            key_map = key_fns_for(node)
+        if key_map is None:
+            continue
+        exchange_of_port: dict[int, ExchangeNode] = {}
+        for port, key_fn in key_map.items():
+            counter += 1
+            ex = ExchangeNode(
+                plane,
+                channel=f"ch{counter}",
+                key_fn=key_fn,
+                broadcast=port in broadcast_ports,
+                name=f"exchange#{counter}->{node.name}.{port}",
+            )
+            engine.add(ex)
+            # late nodes run in list order: the exchange must fire before
+            # its consumer (e.g. the index node's updates-before-queries
+            # barrier depends on the docs broadcast landing first)
+            engine.nodes.remove(ex)
+            engine.nodes.insert(engine.nodes.index(node), ex)
+            ex.downstream.append((node, port))
+            exchange_of_port[port] = ex
+        # rewire producers that fed the node directly
+        for producer in engine.nodes:
+            if producer in exchange_of_port.values():
+                continue
+            new_edges = []
+            for consumer, port in producer.downstream:
+                if consumer is node and port in exchange_of_port:
+                    new_edges.append((exchange_of_port[port], 0))
+                else:
+                    new_edges.append((consumer, port))
+            producer.downstream = new_edges

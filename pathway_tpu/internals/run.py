@@ -10,6 +10,7 @@ from __future__ import annotations
 import enum
 from typing import Any
 
+from .config import get_pathway_config
 from .graph import G
 from .runtime import GraphRunner
 
@@ -58,7 +59,6 @@ def run(
             MonitoringLevel.ALL,
             MonitoringLevel.AUTO_ALL,
         ):
-            from .config import get_pathway_config
             from .monitoring import StatsMonitor, start_http_server_thread
 
             engine.monitor = StatsMonitor()
@@ -68,6 +68,17 @@ def run(
                     process_id=get_pathway_config().process_id,
                 )
 
+        exchange_plane = None
+        pw_config = get_pathway_config(refresh=True)
+        if pw_config.processes > 1:
+            from .exchange import ExchangePlane, insert_exchanges
+
+            exchange_plane = ExchangePlane(
+                pw_config.processes, pw_config.process_id, pw_config.first_port
+            )
+            exchange_plane.start()
+            insert_exchanges(engine, exchange_plane)
+
         from ..io.streaming import StreamingDriver
 
         driver = StreamingDriver(
@@ -76,6 +87,7 @@ def run(
             persistence_config=persistence_config,
             monitoring_level=monitoring_level,
             with_http_server=with_http_server,
+            exchange_plane=exchange_plane,
         )
         driver.run()
     finally:

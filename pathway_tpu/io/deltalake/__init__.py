@@ -21,6 +21,8 @@ __all__ = ["read", "write"]
 
 
 class _DeltaSubject(ConnectorSubject):
+    _shared_source = True
+
     def __init__(self, uri, schema, mode, refresh_s, autocommit_ms):
         super().__init__(datasource_name=f"delta:{uri}")
         self.uri = uri

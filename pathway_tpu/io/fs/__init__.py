@@ -45,6 +45,10 @@ class _FsSubject(ConnectorSubject):
     """Scans ``path`` (file, dir, or glob), emitting one row per file
     (binary/plaintext) or per record (csv/json/plaintext-by-line)."""
 
+    # every process sees the same directory: multi-process runs keep only
+    # each process's owned shard of keys (io/streaming.py ownership filter)
+    _shared_source = True
+
     def __init__(
         self,
         path: str | Path,

@@ -21,6 +21,8 @@ __all__ = ["read"]
 
 
 class _GDriveSubject(ConnectorSubject):
+    _shared_source = True
+
     def __init__(self, object_id, credentials, mode, refresh_s, with_metadata, autocommit_ms):
         super().__init__(datasource_name=f"gdrive:{object_id}")
         self.object_id = object_id

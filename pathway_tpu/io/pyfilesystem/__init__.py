@@ -18,6 +18,8 @@ __all__ = ["read"]
 
 
 class _PyFsSubject(ConnectorSubject):
+    _shared_source = True
+
     def __init__(self, source, path, mode, refresh_s, with_metadata, autocommit_ms):
         super().__init__(datasource_name=f"pyfs:{path}")
         self.source = source

@@ -52,6 +52,8 @@ class AwsS3Settings:
 
 
 class _S3Subject(ConnectorSubject):
+    _shared_source = True
+
     def __init__(self, path, settings, fmt, schema, mode, refresh_s, autocommit_ms):
         super().__init__(datasource_name=f"s3:{path}")
         self.path = path

@@ -25,6 +25,8 @@ __all__ = ["read", "write"]
 
 
 class _SqliteSubject(ConnectorSubject):
+    _shared_source = True
+
     def __init__(self, path, table_name, schema, mode, refresh_s, autocommit_ms):
         super().__init__(datasource_name=f"sqlite:{path}:{table_name}")
         self.path = str(path)

@@ -60,6 +60,11 @@ class ConnectorSubject:
     #: (reference: persistent_id on connectors); defaults to the
     #: datasource name, which is deterministic for fs/kafka-style sources
     persistent_id: str | None = None
+    #: True for sources every process can see identically (fs/s3/sqlite
+    #: scanners): in multi-process runs each process keeps only the keys it
+    #: owns, so a record enters the system exactly once globally.  False
+    #: for process-local subjects (REST requests, custom python sources).
+    _shared_source: bool = False
 
     def __init__(self, datasource_name: str = "python") -> None:
         self._datasource_name = datasource_name
@@ -215,11 +220,13 @@ class StreamingDriver:
         monitoring_level: Any = None,
         with_http_server: bool = False,
         autocommit_ms: int = 20,
+        exchange_plane: Any = None,
     ) -> None:
         self.engine = engine
         self.runner = runner
         self.autocommit_ms = autocommit_ms
         self.persistence_config = persistence_config
+        self.exchange_plane = exchange_plane
         self.subject_src: list[tuple[ConnectorSubject, SourceNode]] = []
         for src, op in runner.source_nodes:
             subject = op.params.get("subject")
@@ -242,11 +249,12 @@ class StreamingDriver:
             return cfg.backend.storage
         return None
 
-    def _setup_persistence(self, t: int) -> int:
+    def _setup_persistence(self, t: int, step: bool = True) -> int:
         """Replay input snapshots, seek subjects, restore operator state
         (reference: Entry::{Snapshot,RewindFinishSentinel} replay,
         src/connectors/mod.rs:100-104; reader seek data_storage.rs:398;
-        operator_snapshot.rs)."""
+        operator_snapshot.rs).  ``step=False`` leaves the replayed rows
+        queued for the caller's own (barrier-synchronized) stepping."""
         storage = self._snapshot_storage()
         if storage is None:
             return t
@@ -280,12 +288,15 @@ class StreamingDriver:
                 if state is not None:
                     node.state = state
                 node._op_snapshot = self._op_snapshot
-        if pushed:
+        if pushed and step:
             self.engine.step(t)
             t += 1
         return t
 
     def run(self) -> None:
+        if self.exchange_plane is not None:
+            self._run_distributed()
+            return
         if not self.subject_src:
             self.engine.run_all()
             return
@@ -299,20 +310,7 @@ class StreamingDriver:
         for t0 in static_times:
             self.engine.step(t0)
         t = self._setup_persistence(max(static_times, default=0) + 1)
-        threads = []
-        for subject, _src in self.subject_src:
-            subject._data_event = data_event
-
-            def runner(s=subject):
-                try:
-                    s.run()
-                finally:
-                    s.close()
-                    s.on_stop()
-
-            th = threading.Thread(target=runner, daemon=True, name="pw-connector")
-            th.start()
-            threads.append(th)
+        threads = self._start_connector_threads(data_event)
 
         last_autocommit = {id(s): _time.monotonic() for s, _ in self.subject_src}
         while True:
@@ -353,3 +351,89 @@ class StreamingDriver:
         writer = self._snapshot_writers.get(id(subject))
         if writer is not None:
             writer.write_batch(entries, subject.current_offsets())
+
+    def _start_connector_threads(self, data_event=None) -> list:
+        threads = []
+        for subject, _src in self.subject_src:
+            if data_event is not None:
+                subject._data_event = data_event
+
+            def runner(s=subject):
+                try:
+                    s.run()
+                finally:
+                    s.close()
+                    s.on_stop()
+
+            th = threading.Thread(target=runner, daemon=True, name="pw-connector")
+            th.start()
+            threads.append(th)
+        return threads
+
+    # -- multi-process run loop (reference: timely Cluster workers stepping
+    # in lockstep; dataflow/config.rs:71-120 + worker-architecture doc) --
+    def _run_distributed(self) -> None:
+        from ..internals.exchange import owner_of
+
+        plane = self.exchange_plane
+        threads = self._start_connector_threads()
+
+        # statically-fed sources (debug rows, static subjects): keep only
+        # this process's shard of keys when every process sees identical
+        # data, and lift time-0 rows to round 1 (rounds start at 1); later
+        # explicit __time__ stamps align with their round number natively
+        for src, op in self.runner.source_nodes:
+            subject = op.params.get("subject")
+            is_static = subject is None or getattr(subject, "_mode", None) == "static"
+            if not is_static:
+                continue
+            if subject is None or subject._shared_source:
+                for t0, entries in list(src.queue.items()):
+                    src.queue[t0] = [
+                        e for e in entries if owner_of(e[0], plane.n) == plane.me
+                    ]
+            if 0 in src.queue:
+                src.queue[1] = src.queue.pop(0) + src.queue.get(1, [])
+        # rounds may not stop before the last statically-stamped timestamp
+        # (identical on every process, so the bound is symmetric)
+        max_static = max(
+            (x for s in self.engine.sources for x in s.pending_times()),
+            default=0,
+        )
+        self._setup_persistence(1, step=False)
+
+        t = 1
+        while True:
+            _time.sleep(self.autocommit_ms / 1000.0)
+            for subject, _src in self.subject_src:
+                if subject._autocommit_ms is not None:
+                    subject.commit()
+            # read the closed flags BEFORE draining: close() commits its
+            # final rows first, so a True flag means this round's drain saw
+            # everything
+            local_closed = all(
+                s._closed.is_set() for s, _ in self.subject_src
+            ) if self.subject_src else True
+            for subject, src in self.subject_src:
+                entries = subject._drain()
+                if subject._shared_source:
+                    entries = [
+                        e for e in entries if owner_of(e[0], plane.n) == plane.me
+                    ]
+                if entries:
+                    src.push(t, entries)
+                    self._write_snapshot(subject, entries)
+            # control barrier: carries this process's end-of-stream flag;
+            # every process sees the same flag set for round t, so all exit
+            # after stepping the same final round
+            done = local_closed and t >= max_static
+            peer_flags = plane.exchange(
+                "__ctl__", t,
+                {p: [done] for p in range(plane.n) if p != plane.me},
+            )
+            self.engine.step(t)
+            if done and all(f for f in peer_flags):
+                break
+            t += 1
+        self.engine.finish()
+        plane.close()

@@ -22,6 +22,8 @@ __all__ = ["read"]
 
 
 class _SharePointSubject(ConnectorSubject):
+    _shared_source = True
+
     def __init__(self, context, root_path, mode, refresh_s, with_metadata, autocommit_ms):
         super().__init__(datasource_name=f"sharepoint:{root_path}")
         self.context = context

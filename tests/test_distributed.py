@@ -1,0 +1,175 @@
+"""Multi-process distributed runs: record exchange at stateful boundaries.
+
+reference test model: tests/utils.py:599-640 — multi-node simulated as
+multi-process on localhost (timely Cluster addresses are always
+127.0.0.1:first_port+i, dataflow/config.rs:113-116).
+"""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from pathway_tpu.internals.exchange import owner_of
+
+
+def _free_port_block(n: int = 2) -> int:
+    """A base port with ``n`` consecutive bindable ports (the plane binds
+    first_port..first_port+n-1)."""
+    for _ in range(50):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        others = []
+        try:
+            for i in range(1, n):
+                o = socket.socket()
+                o.bind(("127.0.0.1", base + i))
+                others.append(o)
+            return base
+        except OSError:
+            continue
+        finally:
+            s.close()
+            for o in others:
+                o.close()
+    raise RuntimeError("no consecutive free port block found")
+
+
+def test_owner_of_deterministic_and_balanced():
+    owners = [owner_of(f"key{i}", 4) for i in range(400)]
+    assert owners == [owner_of(f"key{i}", 4) for i in range(400)]
+    counts = [owners.count(p) for p in range(4)]
+    assert all(c > 50 for c in counts)  # roughly balanced
+
+
+_WORDCOUNT = r"""
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+input_dir, out_path = sys.argv[1:3]
+
+t = pw.io.fs.read(input_dir, format="plaintext", mode="static")
+words = t.select(w=pw.apply(lambda line: line.split(), t.data)).flatten(pw.this.w)
+counts = words.groupby(words.w).reduce(words.w, c=pw.reducers.count())
+
+state = {}
+def on_change(key, row, time_, add):
+    if add:
+        state[row["w"]] = row["c"]
+    elif state.get(row["w"]) == row["c"]:
+        del state[row["w"]]
+
+pw.io.subscribe(counts, on_change=on_change)
+pw.run()
+with open(out_path, "w") as f:
+    json.dump(state, f)
+"""
+
+
+def test_two_process_wordcount_exchange(tmp_path):
+    """Each process ingests its shard of rows; group counts are complete
+    and partitioned (not duplicated) across processes."""
+    input_dir = tmp_path / "in"
+    input_dir.mkdir()
+    (input_dir / "a.txt").write_text(
+        "apple banana apple\ncherry apple banana\n" * 3
+    )
+    (input_dir / "b.txt").write_text("banana date\n" * 2)
+    prog = tmp_path / "prog.py"
+    prog.write_text(_WORDCOUNT)
+
+    port = _free_port_block()
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            PYTHONPATH=repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+            JAX_PLATFORMS="cpu",
+            PATHWAY_PROCESSES="2",
+            PATHWAY_PROCESS_ID=str(pid),
+            PATHWAY_FIRST_PORT=str(port),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(prog), str(input_dir),
+                 str(tmp_path / f"out{pid}.json")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err[-3000:]
+
+    shard0 = json.loads((tmp_path / "out0.json").read_text())
+    shard1 = json.loads((tmp_path / "out1.json").read_text())
+    # shards are disjoint and their union is the full, correct count
+    assert not (set(shard0) & set(shard1))
+    merged = {**shard0, **shard1}
+    assert merged == {"apple": 9, "banana": 8, "cherry": 3, "date": 2}
+    # the exchange actually moved records: with >1 distinct word, at least
+    # one group lives on each process for this dataset
+    assert shard0 and shard1
+
+
+_TIMED_STREAM = r"""
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+
+out_path = sys.argv[1]
+
+t = dbg.table_from_markdown('''
+    v | __time__ | __diff__
+    1 | 2        | 1
+    2 | 4        | 1
+    3 | 4        | 1
+''')
+total = t.reduce(s=pw.reducers.sum(t.v))
+state = {}
+pw.io.subscribe(total, on_change=lambda k, row, tm, add: state.update(row) if add else None)
+pw.run()
+with open(out_path, "w") as f:
+    json.dump(state, f)
+"""
+
+
+def test_two_process_static_update_stream(tmp_path):
+    """Static rows stamped beyond round 1 still process before shutdown."""
+    prog = tmp_path / "prog.py"
+    prog.write_text(_TIMED_STREAM)
+    port = _free_port_block()
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            PYTHONPATH=repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+            JAX_PLATFORMS="cpu",
+            PATHWAY_PROCESSES="2",
+            PATHWAY_PROCESS_ID=str(pid),
+            PATHWAY_FIRST_PORT=str(port),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(prog), str(tmp_path / f"out{pid}.json")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err[-3000:]
+    shard0 = json.loads((tmp_path / "out0.json").read_text())
+    shard1 = json.loads((tmp_path / "out1.json").read_text())
+    # the global sum lives on whichever process owns the reduce group
+    totals = [s.get("s") for s in (shard0, shard1) if s]
+    assert totals == [6]
