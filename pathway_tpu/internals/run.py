@@ -48,11 +48,16 @@ def run(
     if not sinks:
         return
 
+    from .telemetry import get_telemetry
+
+    telemetry = get_telemetry()
+
     _persistence.activate(persistence_config)
     http_server = None
     try:
-        runner = GraphRunner()
-        engine = runner.build([(table, node) for table, node in sinks])
+        with telemetry.span("graph_runner.build", n_sinks=len(sinks)):
+            runner = GraphRunner()
+            engine = runner.build([(table, node) for table, node in sinks])
 
         if with_http_server or monitoring_level in (
             MonitoringLevel.IN_OUT,
@@ -89,7 +94,8 @@ def run(
             with_http_server=with_http_server,
             exchange_plane=exchange_plane,
         )
-        driver.run()
+        with telemetry.span("graph_runner.run"):
+            driver.run()
     finally:
         _persistence.deactivate(persistence_config)
         if http_server is not None:

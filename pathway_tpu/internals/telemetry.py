@@ -1,0 +1,68 @@
+"""OpenTelemetry hooks: spans around graph build/run + runtime gauges.
+
+reference: src/engine/telemetry.rs (OTLP traces + 60 s periodic metrics,
+process mem/CPU gauges :316-350, off unless configured) and the Python
+spans ``graph_runner.build`` / ``graph_runner.run``
+(graph_runner/__init__.py:146,166).
+
+Only the opentelemetry *API* ships in this image — without an SDK +
+exporter configured by the embedding application, every call below is a
+no-op (the API's default tracer), which matches the reference's
+off-by-default posture.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator
+
+__all__ = ["Telemetry", "get_telemetry"]
+
+
+class Telemetry:
+    def __init__(self, enabled: bool | None = None):
+        self._tracer = None
+        try:
+            from opentelemetry import trace
+
+            self._tracer = trace.get_tracer("pathway_tpu")
+        except ImportError:
+            pass
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes: Any) -> Iterator[None]:
+        """``with telemetry.span("graph_runner.run"): ...``"""
+        if self._tracer is None:
+            yield
+            return
+        with self._tracer.start_as_current_span(name) as s:
+            for k, v in attributes.items():
+                try:
+                    s.set_attribute(k, v)
+                except Exception:  # noqa: BLE001 — non-serializable attr
+                    pass
+            yield
+
+    def sys_metrics(self) -> dict:
+        """Process memory/CPU snapshot (reference telemetry.rs:350
+        ``register_sys_metrics``); resource module, no psutil needed."""
+        import os
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return {
+            "process.memory.max_rss_kb": ru.ru_maxrss,
+            "process.cpu.user_s": ru.ru_utime,
+            "process.cpu.system_s": ru.ru_stime,
+            "process.pid": os.getpid(),
+        }
+
+
+_singleton: Telemetry | None = None
+
+
+def get_telemetry() -> Telemetry:
+    global _singleton
+    if _singleton is None:
+        _singleton = Telemetry()
+    return _singleton

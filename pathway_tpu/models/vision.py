@@ -1,0 +1,192 @@
+"""Flax vision encoder (ViT-class) + CLIP-style joint image/text space.
+
+BASELINE.json benchmark config #5 names a multimodal RAG pipeline (CLIP
+image embedder + text embedder over a hybrid index); the reference itself
+has no local image embedder — its multimodal path describes images with a
+vision LLM (xpacks/llm/parsers.py:396 ImageParser).  Both shapes are
+supported here: this module provides the on-TPU embedder, and ImageParser
+remains for LLM-description pipelines.
+
+Design mirrors models/encoder.py: static shape buckets (one compile per
+batch bucket at a fixed image size), bf16 matmuls with f32
+layernorm/pooling, L2-normalized outputs so image and text vectors score
+with plain dot products in the shared HBM KNN index.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .encoder import BATCH_BUCKETS, EncoderConfig, TransformerEncoder
+
+__all__ = ["VisionConfig", "VisionTransformer", "ImageEncoder", "ClipEncoder"]
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    """ViT-Tiny-class geometry by default."""
+
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_dim: int = 192
+    num_layers: int = 6
+    num_heads: int = 3
+    mlp_dim: int = 768
+    emb_dim: int = 384  # shared space dim (matches the text encoder)
+    dtype: Any = jnp.bfloat16
+
+
+class _Block(nn.Module):
+    cfg: VisionConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.num_heads, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="attention",
+        )(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlp_out")(h)
+        return x + h
+
+
+class VisionTransformer(nn.Module):
+    """Patchify -> transformer -> CLS projection, L2-normalized."""
+
+    cfg: VisionConfig
+
+    @nn.compact
+    def __call__(self, images):  # [B, H, W, 3] float32 in [0, 1]
+        cfg = self.cfg
+        x = nn.Conv(
+            cfg.hidden_dim,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        b, gh, gw, c = x.shape
+        x = x.reshape(b, gh * gw, c)
+        cls = self.param(
+            "cls", nn.initializers.normal(0.02), (1, 1, cfg.hidden_dim), jnp.float32
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, c)).astype(cfg.dtype), x], axis=1)
+        pos = self.param(
+            "pos_emb", nn.initializers.normal(0.02),
+            (1, gh * gw + 1, cfg.hidden_dim), jnp.float32,
+        )
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = _Block(cfg, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_out")(x)
+        pooled = x[:, 0, :].astype(jnp.float32)
+        pooled = nn.Dense(cfg.emb_dim, dtype=jnp.float32, name="proj")(pooled)
+        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        return pooled / jnp.maximum(norm, 1e-12)
+
+
+def _decode_image(data: Any, size: int) -> np.ndarray:
+    """bytes/array -> [H, W, 3] float32 in [0, 1] at the model size."""
+    if isinstance(data, np.ndarray):
+        arr = data.astype(np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+    else:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(bytes(data))).convert("RGB")
+        img = img.resize((size, size))
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+    if arr.shape[:2] != (size, size):
+        from PIL import Image
+
+        img = Image.fromarray((arr * 255).astype(np.uint8)).resize((size, size))
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+    return arr
+
+
+class ImageEncoder:
+    """Host-facing image embedder: decode + bucketed jit dispatch."""
+
+    def __init__(self, cfg: VisionConfig | None = None, seed: int = 0):
+        self.cfg = cfg or VisionConfig()
+        self.model = VisionTransformer(self.cfg)
+        dummy = jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3))
+        self.params = self.model.init(jax.random.PRNGKey(seed), dummy)["params"]
+        self._apply = jax.jit(
+            lambda params, images: self.model.apply({"params": params}, images)
+        )
+
+    @property
+    def dim(self) -> int:
+        return self.cfg.emb_dim
+
+    def get_embedding_dimension(self) -> int:
+        return self.dim
+
+    def encode(self, images: Sequence[Any]) -> np.ndarray:
+        if not len(images):
+            return np.zeros((0, self.dim), dtype=np.float32)
+        size = self.cfg.image_size
+        batch = np.stack([_decode_image(im, size) for im in images])
+        b = batch.shape[0]
+        bucket = next((bb for bb in BATCH_BUCKETS if b <= bb), BATCH_BUCKETS[-1])
+        outs = []
+        start = 0
+        while start < b:
+            chunk = min(bucket, b - start)
+            padded = np.zeros((bucket, size, size, 3), np.float32)
+            padded[:chunk] = batch[start : start + chunk]
+            res = np.asarray(self._apply(self.params, jnp.asarray(padded)))
+            outs.append(res[:chunk])
+            start += chunk
+        return np.concatenate(outs, axis=0).astype(np.float32)
+
+    def __call__(self, image: Any) -> np.ndarray:
+        return self.encode([image])[0]
+
+
+class ClipEncoder:
+    """Joint image/text embedding space: a vision tower + the sentence
+    encoder projected to the same dimension (CLIP's contract; weights here
+    are the local stack's, load pretrained params for production quality)."""
+
+    def __init__(
+        self,
+        vision_cfg: VisionConfig | None = None,
+        text_cfg: EncoderConfig | None = None,
+        seed: int = 0,
+        max_length: int = 77,
+    ):
+        from .encoder import SentenceEncoder
+
+        self.vision = ImageEncoder(vision_cfg, seed=seed)
+        tcfg = text_cfg or EncoderConfig(emb_dim=self.vision.dim)
+        if (tcfg.emb_dim or tcfg.hidden_dim) != self.vision.dim:
+            tcfg = dataclasses.replace(tcfg, emb_dim=self.vision.dim)
+        self.text = SentenceEncoder(cfg=tcfg, seed=seed, max_length=max_length)
+
+    @property
+    def dim(self) -> int:
+        return self.vision.dim
+
+    def encode_images(self, images: Sequence[Any]) -> np.ndarray:
+        return self.vision.encode(images)
+
+    def encode_texts(self, texts: Sequence[str]) -> np.ndarray:
+        return self.text.encode(list(texts))

@@ -27,6 +27,7 @@ from ._utils import AsyncMicroBatcher, coerce_str
 __all__ = [
     "BaseEmbedder",
     "SentenceTransformerEmbedder",
+    "ImageEmbedder",
     "OpenAIEmbedder",
     "LiteLLMEmbedder",
     "GeminiEmbedder",
@@ -98,6 +99,47 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         return self._encoder
 
     async def __wrapped__(self, input: str, **kwargs) -> np.ndarray:
+        self._ensure_encoder()
+        return await self._batcher.call(input)
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._ensure_encoder().dim
+
+
+class ImageEmbedder(BaseEmbedder):
+    """JAX vision-transformer image embedder for multimodal RAG
+    (BASELINE config #5: CLIP image + text embedders over a hybrid index;
+    models/vision.py).  Takes image bytes (or arrays); concurrent calls
+    batch into one padded device dispatch like the text embedder."""
+
+    def __init__(
+        self,
+        *,
+        encoder: Any = None,
+        max_batch: int = 256,
+        **init_kwargs,
+    ):
+        super().__init__(executor=udfs.async_executor(), deterministic=True)
+        self._encoder = encoder
+        self._batcher: AsyncMicroBatcher | None = None
+        self._max_batch = max_batch
+        self._init_kwargs = init_kwargs
+
+    def _ensure_encoder(self):
+        if self._encoder is None:
+            from ...models.vision import ImageEncoder as _ImageEncoder
+
+            self._encoder = _ImageEncoder(**self._init_kwargs)
+        if self._batcher is None:
+            enc = self._encoder
+
+            def batch_encode(images: list) -> list[np.ndarray]:
+                return list(enc.encode(images))
+
+            self._batcher = AsyncMicroBatcher(batch_encode, max_batch=self._max_batch)
+        return self._encoder
+
+    async def __wrapped__(self, input, **kwargs) -> np.ndarray:
         self._ensure_encoder()
         return await self._batcher.call(input)
 

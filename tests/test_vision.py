@@ -1,0 +1,93 @@
+"""Vision/CLIP encoder stack (tiny configs on the CPU mesh)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+from pathway_tpu.models.vision import ClipEncoder, ImageEncoder, VisionConfig
+
+TINY = VisionConfig(
+    image_size=32, patch_size=8, hidden_dim=16, num_layers=1, num_heads=2,
+    mlp_dim=32, emb_dim=24,
+)
+
+
+def _png_bytes(color) -> bytes:
+    from PIL import Image
+
+    img = Image.new("RGB", (40, 40), color)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_image_encoder_shapes_and_norm():
+    enc = ImageEncoder(TINY)
+    vecs = enc.encode([_png_bytes("red"), _png_bytes("blue")])
+    assert vecs.shape == (2, 24)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, atol=1e-5)
+    # deterministic: same image, same vector
+    again = enc.encode([_png_bytes("red")])[0]
+    np.testing.assert_allclose(again, vecs[0], atol=1e-5)
+    # different images map to different points
+    assert not np.allclose(vecs[0], vecs[1], atol=1e-3)
+
+
+def test_image_encoder_accepts_arrays():
+    enc = ImageEncoder(TINY)
+    arr = np.zeros((32, 32, 3), np.float32)
+    vec = enc(arr)
+    assert vec.shape == (24,)
+
+
+def test_clip_encoder_shared_space():
+    from pathway_tpu.models.encoder import EncoderConfig
+
+    clip = ClipEncoder(
+        vision_cfg=TINY,
+        text_cfg=EncoderConfig(
+            vocab_size=128, hidden_dim=16, num_layers=1, num_heads=2,
+            mlp_dim=32, max_len=32,
+        ),
+        max_length=16,
+    )
+    iv = clip.encode_images([_png_bytes("green")])
+    tv = clip.encode_texts(["a green square"])
+    assert iv.shape[1] == tv.shape[1] == clip.dim
+    # both live on the unit sphere: dot products are valid cosine scores
+    assert np.linalg.norm(iv[0]) == pytest.approx(1.0, abs=1e-4)
+    assert np.linalg.norm(tv[0]) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_image_embedder_udf_pipeline():
+    from pathway_tpu.xpacks.llm.embedders import ImageEmbedder
+    from pathway_tpu.models.vision import ImageEncoder as _Enc
+
+    emb = ImageEmbedder(encoder=_Enc(TINY))
+    t = dbg.table_from_rows(
+        pw.schema_from_types(data=bytes),
+        [(_png_bytes("red"),), (_png_bytes("blue"),)],
+    )
+    _, cols = dbg.table_to_dicts(t.select(v=emb(t.data)))
+    vecs = list(cols["v"].values())
+    assert all(v.shape == (24,) for v in vecs)
+    assert emb.get_embedding_dimension() == 24
+
+
+def test_image_embeddings_in_knn_index():
+    """Multimodal shape of BASELINE config #5: image vectors in the HBM
+    KNN index, retrieved by image query."""
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    enc = ImageEncoder(TINY)
+    colors = ["red", "blue", "green", "yellow"]
+    vecs = enc.encode([_png_bytes(c) for c in colors])
+    index = DeviceKnnIndex(dim=24, metric="cos", capacity=16)
+    for c, v in zip(colors, vecs):
+        index.upsert(c, v)
+    results = index.search(enc.encode([_png_bytes("blue")]), k=1)
+    assert results[0][0][0] == "blue"
+    assert results[0][0][1] == pytest.approx(1.0, abs=1e-4)
