@@ -61,6 +61,14 @@ from .internals.joins import JoinMode, JoinResult
 from .internals import reducers
 from .internals import udfs
 from .internals.udfs import UDF, udf
+from .internals.row_transformer import (
+    ClassArg,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 from .internals.run import run, run_all, MonitoringLevel
 from .internals.graph import G as global_graph
 from .internals.iterate import iterate, iterate_universe
@@ -287,4 +295,10 @@ __all__ = [
     "load_yaml",
     "global_error_log",
     "sql",
+    "ClassArg",
+    "input_attribute",
+    "input_method",
+    "method",
+    "output_attribute",
+    "transformer",
 ]

@@ -1,0 +1,303 @@
+"""Row transformers — the legacy class-transformer system.
+
+reference: python/pathway/internals/row_transformer.py (313 LoC,
+``RowTransformer``/``ClassArg``/input_attribute/output_attribute/method)
++ graph_runner/row_transformer_operator_handler.py (``RowReference``
+lazy evaluation with memoization).
+
+Usage (reference API)::
+
+    @pw.transformer
+    class my_transformer:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def b(self) -> float:
+                return self.a + 1
+
+    result = my_transformer(table=t).table   # columns: b
+
+Cross-row/cross-table access works through ``self.transformer.<arg>[ptr]``
+returning another row reference; output attributes memoize per (row,
+attribute) within a recomputation, so chains and recursion over pointers
+evaluate lazily exactly like the reference's RowReference machinery.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Any, Callable
+
+from . import dtype as dt
+from .engine import Entry, Node, consolidate, freeze_row
+from .graph import Operator
+from .schema import ColumnSchema, _schema_from_columns
+from .table import Table
+from .universe import Universe
+
+__all__ = [
+    "ClassArg",
+    "input_attribute",
+    "input_method",
+    "output_attribute",
+    "method",
+    "transformer",
+]
+
+
+class _InputAttribute:
+    def __init__(self, dtype: Any = dt.ANY):
+        self.dtype = dtype
+        self.name: str | None = None
+
+
+class _OutputAttribute:
+    is_method = False
+
+    def __init__(self, fn: Callable, dtype: Any = dt.ANY):
+        self.fn = fn
+        self.dtype = dtype
+        self.name = fn.__name__
+
+
+class _Method(_OutputAttribute):
+    is_method = True
+
+
+def input_attribute(type: Any = dt.ANY):  # noqa: A002 — reference signature
+    return _InputAttribute(type)
+
+
+def input_method(type: Any = dt.ANY):  # noqa: A002
+    marker = _InputAttribute(type)
+    marker.is_method = True  # type: ignore[attr-defined]
+    return marker
+
+
+def output_attribute(fn: Callable | None = None, **kwargs):
+    if fn is None:
+        return lambda f: _OutputAttribute(f, **kwargs)
+    return _OutputAttribute(fn)
+
+
+def method(fn: Callable | None = None, **kwargs):
+    if fn is None:
+        return lambda f: _Method(f, **kwargs)
+    return _Method(fn)
+
+
+class ClassArg:
+    """Base marker for transformer table arguments (reference:
+    row_transformer.py:148).  At runtime instances are row references."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        cls._inputs = {}
+        cls._outputs = {}
+        for name, value in list(vars(cls).items()):
+            if isinstance(value, _InputAttribute):
+                value.name = name
+                cls._inputs[name] = value
+            elif isinstance(value, _OutputAttribute):
+                cls._outputs[name] = value
+
+
+class _RowRef:
+    """Lazy row reference with per-(row, attribute) memoization."""
+
+    __slots__ = ("_ctx", "_arg_name", "_key")
+
+    def __init__(self, ctx: "_EvalContext", arg_name: str, key):
+        self._ctx = ctx
+        self._arg_name = arg_name
+        self._key = key
+
+    @property
+    def id(self):
+        return self._key
+
+    @property
+    def transformer(self) -> SimpleNamespace:
+        return self._ctx.namespace
+
+    def pointer_from(self, *args):
+        from .keys import ref_scalar
+
+        return ref_scalar(*args)
+
+    def __getattr__(self, name: str):
+        return self._ctx.attr(self._arg_name, self._key, name)
+
+
+class _EvalContext:
+    def __init__(self, spec: "_TransformerSpec", snapshots: dict[str, dict]):
+        self.spec = spec
+        self.snapshots = snapshots  # arg -> {key: row tuple}
+        self.memo: dict[tuple, Any] = {}
+        self.namespace = SimpleNamespace(
+            **{
+                arg: _TableRef(self, arg) for arg in spec.class_args
+            }
+        )
+
+    def attr(self, arg_name: str, key, name: str):
+        cls = self.spec.class_args[arg_name]
+        if name in cls._inputs:
+            row = self.snapshots[arg_name].get(key)
+            if row is None:
+                raise KeyError(f"{arg_name}[{key}] not found")
+            idx = self.spec.input_index[arg_name][name]
+            return row[idx]
+        if name in cls._outputs:
+            out = cls._outputs[name]
+            memo_key = (arg_name, key, name)
+            if out.is_method:
+                def call(*args):
+                    mk = (arg_name, key, name, args)
+                    if mk not in self.memo:
+                        self.memo[mk] = out.fn(_RowRef(self, arg_name, key), *args)
+                    return self.memo[mk]
+
+                return call
+            if memo_key not in self.memo:
+                self.memo[memo_key] = out.fn(_RowRef(self, arg_name, key))
+            return self.memo[memo_key]
+        raise AttributeError(
+            f"transformer arg {arg_name!r} has no attribute {name!r}"
+        )
+
+
+class _TableRef:
+    __slots__ = ("_ctx", "_arg_name")
+
+    def __init__(self, ctx: _EvalContext, arg_name: str):
+        self._ctx = ctx
+        self._arg_name = arg_name
+
+    def __getitem__(self, key) -> _RowRef:
+        return _RowRef(self._ctx, self._arg_name, key)
+
+
+class _TransformerSpec:
+    def __init__(self, name: str, class_args: dict[str, type[ClassArg]]):
+        self.name = name
+        self.class_args = class_args
+        self.input_index: dict[str, dict[str, int]] = {}
+
+    def bind_tables(self, tables: dict[str, Table]) -> None:
+        for arg, cls in self.class_args.items():
+            names = tables[arg].column_names()
+            self.input_index[arg] = {}
+            for in_name in cls._inputs:
+                if in_name not in names:
+                    raise ValueError(
+                        f"table for {arg!r} lacks input attribute {in_name!r}"
+                    )
+                self.input_index[arg][in_name] = names.index(in_name)
+
+
+class RowTransformer:
+    def __init__(self, spec: _TransformerSpec):
+        self.spec = spec
+
+    def __call__(self, **tables: Table) -> SimpleNamespace:
+        spec = self.spec
+        missing = set(spec.class_args) - set(tables)
+        if missing:
+            raise ValueError(f"transformer {spec.name}: missing tables {missing}")
+        spec.bind_tables(tables)
+        ordered = [tables[arg] for arg in spec.class_args]
+        outs = {}
+        for arg, cls in spec.class_args.items():
+            out_attrs = {
+                n: o for n, o in cls._outputs.items() if not o.is_method
+            }
+            columns = {
+                n: ColumnSchema(name=n, dtype=_annotation_dtype(o.fn))
+                for n, o in out_attrs.items()
+            }
+            op = Operator(
+                "row_transformer",
+                ordered,
+                params=dict(spec=spec, out_arg=arg, out_names=list(out_attrs)),
+            )
+            outs[arg] = Table._new(
+                op, _schema_from_columns(columns), tables[arg]._universe
+            )
+        return SimpleNamespace(**outs)
+
+
+def _annotation_dtype(fn: Callable) -> Any:
+    hint = getattr(fn, "__annotations__", {}).get("return")
+    try:
+        return dt.wrap(hint) if hint is not None else dt.ANY
+    except Exception:
+        return dt.ANY
+
+
+def transformer(cls) -> RowTransformer:
+    """``@pw.transformer`` (reference: decorators.py transformer)."""
+    class_args = {
+        name: value
+        for name, value in vars(cls).items()
+        if isinstance(value, type) and issubclass(value, ClassArg)
+    }
+    if not class_args:
+        raise ValueError("transformer class must contain ClassArg tables")
+    return RowTransformer(_TransformerSpec(cls.__name__, class_args))
+
+
+# ---------------------------------------------------------------------------
+# runtime (reference: graph_runner/row_transformer_operator_handler.py —
+# whole-table lazy recomputation per epoch, diffs vs the previous output)
+# ---------------------------------------------------------------------------
+
+
+class RowTransformerNode(Node):
+    def __init__(self, spec: _TransformerSpec, out_arg: str, out_names: list[str],
+                 name: str = "row_transformer"):
+        super().__init__(n_inputs=len(spec.class_args), name=name)
+        self.spec = spec
+        self.out_arg = out_arg
+        self.out_names = out_names
+        self.arg_order = list(spec.class_args)
+        self.snapshots: dict[str, dict] = {arg: {} for arg in self.arg_order}
+        self.last_out: dict = {}
+
+    def flush(self, time: int) -> list[Entry]:
+        changed = False
+        for port, arg in enumerate(self.arg_order):
+            for key, row, diff in self.take(port):
+                changed = True
+                if diff > 0:
+                    self.snapshots[arg][key] = row
+                else:
+                    self.snapshots[arg].pop(key, None)
+        if not changed:
+            return []
+        ctx = _EvalContext(self.spec, self.snapshots)
+        new_out: dict = {}
+        for key in self.snapshots[self.out_arg]:
+            new_out[key] = tuple(
+                ctx.attr(self.out_arg, key, n) for n in self.out_names
+            )
+        out: list[Entry] = []
+        for key, row in self.last_out.items():
+            if key not in new_out or freeze_row(new_out[key]) != freeze_row(row):
+                out.append((key, row, -1))
+        for key, row in new_out.items():
+            if key not in self.last_out or freeze_row(self.last_out[key]) != freeze_row(row):
+                out.append((key, row, 1))
+        self.last_out = new_out
+        return consolidate(out)
+
+
+def lower_row_transformer(runner, op: Operator) -> None:
+    node = RowTransformerNode(
+        op.params["spec"], op.params["out_arg"], op.params["out_names"],
+        name=f"row_transformer#{op.id}",
+    )
+    runner.engine.add(node)
+    runner._connect_inputs(op, node)
+    runner._register(op, node)

@@ -622,6 +622,11 @@ class GraphRunner:
 
         lower_window_behavior(self, op)
 
+    def _lower_row_transformer(self, op: Operator) -> None:
+        from .row_transformer import lower_row_transformer
+
+        lower_row_transformer(self, op)
+
 
 def _iter_flat(seq):
     import numpy as np

@@ -1,0 +1,163 @@
+"""Row transformers (legacy class-transformer system).
+
+reference test model: python/pathway/tests around row_transformer.py —
+simple output attributes, cross-row pointer access, two-table
+transformers, method attributes.
+"""
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+
+
+def _col(table, name):
+    _, cols = dbg.table_to_dicts(table)
+    return cols[name]
+
+
+def test_simple_output_attribute():
+    @pw.transformer
+    class inc:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def b(self) -> int:
+                return self.a + 1
+
+    t = dbg.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    out = inc(table=t).table
+    assert sorted(_col(out, "b").values()) == [2, 3]
+
+
+def test_output_attribute_chains_memoized():
+    calls = []
+
+    @pw.transformer
+    class chain:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def double(self) -> int:
+                calls.append(self.id)
+                return self.a * 2
+
+            @pw.output_attribute
+            def quad(self) -> int:
+                return self.double * 2
+
+    t = dbg.table_from_markdown(
+        """
+        a
+        5
+        """
+    )
+    out = chain(table=t).table
+    _, cols = dbg.table_to_dicts(out)
+    [(d, q)] = list(zip(cols["double"].values(), cols["quad"].values()))
+    assert (d, q) == (10, 20)
+    assert len(calls) == 1  # double computed once, reused by quad
+
+
+def test_cross_row_pointer_access():
+    @pw.transformer
+    class follow:
+        class table(pw.ClassArg):
+            val = pw.input_attribute()
+            next_ptr = pw.input_attribute()
+
+            @pw.output_attribute
+            def next_val(self):
+                return self.transformer.table[self.next_ptr].val
+
+    t = dbg.table_from_markdown(
+        """
+          | val | next_name
+        1 | 10  | b
+        2 | 20  | a
+        """
+    )
+    # build pointers from names: row "a"=explicit id 1, "b"=id 2
+    withptr = t.select(
+        val=t.val,
+        next_ptr=pw.apply(
+            lambda n: pw.unsafe_make_pointer(2 if n == "b" else 1), t.next_name
+        ),
+    )
+    out = follow(table=withptr).table
+    assert sorted(_col(out, "next_val").values()) == [10, 20]
+
+
+def test_two_table_transformer():
+    @pw.transformer
+    class join_like:
+        class left(pw.ClassArg):
+            ptr = pw.input_attribute()
+
+            @pw.output_attribute
+            def other_val(self):
+                return self.transformer.right[self.ptr].val
+
+        class right(pw.ClassArg):
+            val = pw.input_attribute()
+
+    right = dbg.table_from_markdown(
+        """
+          | val
+        7 | 70
+        """
+    )
+    left = dbg.table_from_markdown(
+        """
+        x
+        1
+        """
+    ).select(ptr=pw.apply(lambda _: pw.unsafe_make_pointer(7), pw.this.x))
+    out = join_like(left=left, right=right).left
+    assert list(_col(out, "other_val").values()) == [70]
+
+
+def test_method_attribute():
+    @pw.transformer
+    class calc:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.method
+            def add(self, x) -> int:
+                return self.a + x
+
+            @pw.output_attribute
+            def plus_ten(self) -> int:
+                return self.add(10)
+
+    t = dbg.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    out = calc(table=t).table
+    assert list(_col(out, "plus_ten").values()) == [11]
+
+
+def test_missing_table_raises():
+    @pw.transformer
+    class needs:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def b(self):
+                return self.a
+
+    with pytest.raises(ValueError, match="missing tables"):
+        needs()
