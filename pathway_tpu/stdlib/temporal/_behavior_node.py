@@ -145,6 +145,48 @@ class WindowBehaviorNode(Node):
         return consolidate(out)
 
 
+def apply_temporal_behavior(table, time_expr, behavior):
+    """Buffer/forget a plain stream by its event-time column (reference:
+    interval-join behaviors compiled onto time_column.rs forget/buffer).
+
+    delay holds rows until the watermark passes t+delay; cutoff drops rows
+    arriving after the watermark passed t+cutoff; keep_results=False
+    retracts rows once their time falls behind the cutoff — which is what
+    bounds join state for interval joins.  Returns a table with the same
+    columns.
+    """
+    from ...internals.desugaring import resolve_expression
+    from ...internals.table import Table
+    from ...internals.universe import Universe
+    from .temporal_behavior import CommonBehavior, ExactlyOnceBehavior
+
+    time_e = resolve_expression(time_expr, table)
+    with_t = table.with_columns(__behavior_t__=time_e)
+    names = with_t.column_names()
+    idx = names.index("__behavior_t__")
+    if isinstance(behavior, ExactlyOnceBehavior):
+        params = dict(
+            delay=behavior.shift or 0, cutoff=behavior.shift or 0,
+            keep_results=True, delay_from_end=True,
+        )
+    elif isinstance(behavior, CommonBehavior):
+        params = dict(
+            delay=behavior.delay, cutoff=behavior.cutoff,
+            keep_results=behavior.keep_results, delay_from_end=False,
+        )
+    else:
+        raise TypeError(f"unknown behavior {behavior!r}")
+    op = Operator(
+        "window_behavior",
+        [with_t],
+        params=dict(time_idx=idx, start_idx=idx, end_idx=idx, **params),
+    )
+    out = Table._new(op, with_t.schema, Universe())
+    return out._select_exprs(
+        {n: out[n] for n in table.column_names()}, universe=out._universe
+    )
+
+
 def lower_window_behavior(runner: GraphRunner, op: Operator) -> None:
     node = WindowBehaviorNode(
         time_idx=op.params["time_idx"],

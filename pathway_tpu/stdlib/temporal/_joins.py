@@ -99,12 +99,9 @@ def interval_join(
     behavior=None,
 ) -> _PackedJoinResult:
     """reference: _interval_join.py interval_join — match when
-    ``other_time - self_time ∈ [lb, ub]``."""
-    if behavior is not None:
-        raise NotImplementedError(
-            "interval_join behaviors land with the streaming-behaviors "
-            "milestone; drop the behavior= argument"
-        )
+    ``other_time - self_time ∈ [lb, ub]``.  ``behavior`` buffers/forgets
+    both input streams by their time columns before joining (late rows
+    dropped, old state retracted with keep_results=False)."""
     lb, ub = _num(interval.lower_bound), _num(interval.upper_bound)
     if ub < lb:
         raise ValueError("interval upper bound below lower bound")
@@ -135,6 +132,14 @@ def interval_join(
         __k__=pw.make_tuple(*key_r),
         __rpay__=_pack(other),
     )
+    if behavior is not None:
+        # buffer/forget both sides by event time before the join: late rows
+        # beyond the cutoff are dropped, keep_results=False retracts old
+        # rows and bounds the join state (time_column.rs forget semantics)
+        from ._behavior_node import apply_temporal_behavior
+
+        lhs = apply_temporal_behavior(lhs, lhs["__t__"], behavior)
+        rhs = apply_temporal_behavior(rhs, rhs["__t__"], behavior)
     joined = lhs.join(
         rhs,
         lhs["__buckets__"] == rhs["__bucket__"],
