@@ -193,7 +193,13 @@ extern "C" void pw_tokenize_batch(
                     ids + pos, max_length - 2 - (pos - 1));
     ids[pos++] = kSep;
     if (pairs != nullptr) {
-      if (pos > max_length / 2) pos = max_length / 2;
+      if (pos > max_length / 2) {
+        // truncating the first segment leaves its stale ids beyond the new
+        // pos; re-zero so a shorter pair text matches the Python fallback
+        // bit-for-bit even for consumers that ignore the mask
+        pos = max_length / 2;
+        std::memset(ids + pos, 0, sizeof(int32_t) * (size_t)(max_length - pos));
+      }
       pos += tokenize(pairs[row], pair_lens[row], vocab_size, lowercase,
                       ids + pos, max_length - pos - 1);
       if (pos < max_length) ids[pos++] = kSep;

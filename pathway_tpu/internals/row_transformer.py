@@ -185,16 +185,25 @@ class _TransformerSpec:
         self.class_args = class_args
         self.input_index: dict[str, dict[str, int]] = {}
 
-    def bind_tables(self, tables: dict[str, Table]) -> None:
+    def bind_tables(self, tables: dict[str, Table]) -> "_TransformerSpec":
+        """Return a bound copy with ``input_index`` resolved against *tables*.
+
+        The shared spec stays immutable so one ``@pw.transformer`` can be
+        applied to several table sets whose input-attribute columns sit at
+        different positions (the reference binds per-application operator
+        state).
+        """
+        bound = _TransformerSpec(self.name, self.class_args)
         for arg, cls in self.class_args.items():
             names = tables[arg].column_names()
-            self.input_index[arg] = {}
+            bound.input_index[arg] = {}
             for in_name in cls._inputs:
                 if in_name not in names:
                     raise ValueError(
                         f"table for {arg!r} lacks input attribute {in_name!r}"
                     )
-                self.input_index[arg][in_name] = names.index(in_name)
+                bound.input_index[arg][in_name] = names.index(in_name)
+        return bound
 
 
 class RowTransformer:
@@ -206,7 +215,7 @@ class RowTransformer:
         missing = set(spec.class_args) - set(tables)
         if missing:
             raise ValueError(f"transformer {spec.name}: missing tables {missing}")
-        spec.bind_tables(tables)
+        spec = spec.bind_tables(tables)
         ordered = [tables[arg] for arg in spec.class_args]
         outs = {}
         for arg, cls in spec.class_args.items():

@@ -54,6 +54,7 @@ def run(
 
     _persistence.activate(persistence_config)
     http_server = None
+    exchange_plane = None
     try:
         with telemetry.span("graph_runner.build", n_sinks=len(sinks)):
             runner = GraphRunner()
@@ -73,7 +74,6 @@ def run(
                     process_id=get_pathway_config().process_id,
                 )
 
-        exchange_plane = None
         pw_config = get_pathway_config(refresh=True)
         if pw_config.processes > 1:
             from .exchange import ExchangePlane, insert_exchanges
@@ -97,6 +97,11 @@ def run(
         with telemetry.span("graph_runner.run"):
             driver.run()
     finally:
+        # idempotent close (double-close after a successful _run_distributed
+        # is a no-op): on failure the peers see the socket drop and abort
+        # their exchange barrier promptly instead of waiting out the timeout
+        if exchange_plane is not None:
+            exchange_plane.close()
         _persistence.deactivate(persistence_config)
         if http_server is not None:
             http_server.shutdown()

@@ -57,8 +57,12 @@ class ConnectorSubject:
     #: src/connectors/mod.rs:207-217); None = explicit commits only
     _autocommit_ms: int | None = None
     #: key under which this subject's input snapshot + offsets persist
-    #: (reference: persistent_id on connectors); defaults to the
-    #: datasource name, which is deterministic for fs/kafka-style sources
+    #: (reference: persistent_id on connectors).  Snapshotting is opt-in:
+    #: subjects that neither set an explicit persistent_id nor override
+    #: current_offsets()/seek() are not persisted (replaying them would
+    #: double records).  The default key for offset-tracking subjects is
+    #: "{datasource_name}-{occurrence}" (occurrence among same-named
+    #: sources in graph order), process-scoped in multi-process runs.
     persistent_id: str | None = None
     #: True for sources every process can see identically (fs/s3/sqlite
     #: scanners): in multi-process runs each process keeps only the keys it
@@ -143,9 +147,28 @@ class ConnectorSubject:
     def seek(self, offsets: Any) -> None:
         """Restore the source position after snapshot replay."""
 
-    @property
-    def effective_persistent_id(self) -> str:
-        return self.persistent_id or self._datasource_name
+    def effective_persistent_id(self, occurrence: int | None = None) -> str | None:
+        """Key for this subject's snapshot keyspace.
+
+        An explicit ``persistent_id`` wins.  Otherwise a default is derived
+        from the datasource name plus this subject's *occurrence number
+        among same-named sources* (graph order), so two subjects with the
+        same datasource name (two ``fs.read`` of one path, two custom
+        python subjects) never share a keyspace, while adding an unrelated
+        differently-named source does not shift existing keys.  Without an
+        occurrence number no safe default exists and ``None`` is returned
+        (persistence stays off for the subject)."""
+        if self.persistent_id is not None:
+            return self.persistent_id
+        if occurrence is None:
+            return None
+        return f"{self._datasource_name}-{occurrence}"
+
+    def _tracks_offsets(self) -> bool:
+        """True when the subclass overrides offset tracking (capability, not
+        the runtime value — a seek-capable source legitimately reports no
+        offset before its first record)."""
+        return type(self).current_offsets is not ConnectorSubject.current_offsets
 
     # -- plumbing --
     def _derive_key(self, kwargs: dict) -> Any:
@@ -228,10 +251,17 @@ class StreamingDriver:
         self.persistence_config = persistence_config
         self.exchange_plane = exchange_plane
         self.subject_src: list[tuple[ConnectorSubject, SourceNode]] = []
+        #: subject -> occurrence number among same-named sources in graph
+        #: order, used to derive unique yet stable default persistent ids
+        self._pid_occurrence: dict[int, int] = {}
+        name_counts: dict[str, int] = {}
         for src, op in runner.source_nodes:
             subject = op.params.get("subject")
             if subject is not None and subject._mode == "streaming":
                 self.subject_src.append((subject, src))
+                n = name_counts.get(subject._datasource_name, 0)
+                name_counts[subject._datasource_name] = n + 1
+                self._pid_occurrence[id(subject)] = n
         self._snapshot_writers: dict[int, Any] = {}
         self._op_snapshot = None
 
@@ -267,7 +297,22 @@ class StreamingDriver:
         self._op_snapshot = OperatorSnapshot(storage)
         pushed = False
         for subject, src in self.subject_src:
-            pid = subject.effective_persistent_id
+            # Opt-in contract (reference: persistent_id on connectors):
+            # snapshotting a subject that cannot seek would replay its
+            # snapshot AND let run() re-produce the same rows from scratch,
+            # doubling every record — so gate on offset tracking or an
+            # explicit persistent_id.
+            if subject.persistent_id is None and not subject._tracks_offsets():
+                continue
+            pid = subject.effective_persistent_id(self._pid_occurrence.get(id(subject)))
+            if pid is None:
+                continue
+            # multi-process runs share one backend storage: scope each
+            # process's snapshot keyspace so shard-filtered batches don't
+            # clobber each other's chunk counters (reference: worker-keyed
+            # snapshots, src/persistence/input_snapshot.rs:56-283)
+            if self.exchange_plane is not None:
+                pid = f"{pid}-p{self.exchange_plane.me}"
             reader = InputSnapshotReader(storage, pid)
             replayed: list[Entry] = []
             for entries in reader.replay():
@@ -376,7 +421,6 @@ class StreamingDriver:
         from ..internals.exchange import owner_of
 
         plane = self.exchange_plane
-        threads = self._start_connector_threads()
 
         # statically-fed sources (debug rows, static subjects): keep only
         # this process's shard of keys when every process sees identical
@@ -400,7 +444,11 @@ class StreamingDriver:
             (x for s in self.engine.sources for x in s.pending_times()),
             default=0,
         )
+        # snapshot replay + seek must complete before connector threads run
+        # (seek after a source began scanning would double records; and the
+        # startup current_offsets() probe may not race the reader thread)
         self._setup_persistence(1, step=False)
+        threads = self._start_connector_threads()
 
         t = 1
         while True:

@@ -82,6 +82,22 @@ def test_tokenize_batch_pair_parity():
     np.testing.assert_array_equal(mask_n, mask_p)
 
 
+def test_tokenize_batch_pair_truncation_zero_pads():
+    # long first text forces the truncate-to-max_length/2 path; a shorter
+    # pair must leave zeros (not stale first-segment ids) beyond its end
+    from pathway_tpu.models.tokenizer import HashTokenizer
+
+    tok = HashTokenizer(vocab_size=500)
+    queries = ["word " * 100]  # >> max_length/2 tokens
+    docs = ["tiny"]
+    ids_n, mask_n = tok.encode_batch(queries, max_length=32, pair=docs)
+    ids_p, mask_p = _python_encode_batch(tok, queries, 32, pair=docs)
+    np.testing.assert_array_equal(ids_n, ids_p)
+    np.testing.assert_array_equal(mask_n, mask_p)
+    # and the unmasked tail is genuinely zero
+    assert (ids_n[0][mask_n[0] == 0] == 0).all()
+
+
 def test_tokenize_deterministic_same_word_same_id():
     from pathway_tpu.models.tokenizer import HashTokenizer
 

@@ -161,3 +161,34 @@ def test_missing_table_raises():
 
     with pytest.raises(ValueError, match="missing tables"):
         needs()
+
+
+def test_transformer_reused_on_different_column_layouts():
+    # one @pw.transformer applied to two tables whose input-attribute
+    # column sits at different positions: each application must bind its
+    # own column indices (the spec may not be mutated in place)
+    @pw.transformer
+    class double:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def b(self) -> int:
+                return self.a * 2
+
+    t1 = dbg.table_from_markdown(
+        """
+        a | z
+        1 | 100
+        """
+    )
+    t2 = dbg.table_from_markdown(
+        """
+        z   | a
+        100 | 7
+        """
+    )
+    out2 = double(table=t2).table
+    out1 = double(table=t1).table
+    assert list(_col(out1, "b").values()) == [2]
+    assert list(_col(out2, "b").values()) == [14]
