@@ -584,7 +584,10 @@ class IsNoneExpression(ColumnExpression):
 
 
 class IsNotNoneExpression(IsNoneExpression):
-    pass
+    def _rebuild(self, mapping):
+        # must NOT inherit IsNoneExpression._rebuild — a substitution pass
+        # would silently flip is_not_none into is_none
+        return IsNotNoneExpression(self.expr._substitute(mapping))
 
 
 class MakeTupleExpression(ColumnExpression):

@@ -567,6 +567,30 @@ class Table:
         return _external_index_as_of_now(self, index_factory, query_table, **kwargs)
 
 
+# named temporal-join modes (reference: Table.interval_join_left etc.) —
+# thin delegates to the stdlib wrappers so each mode exists in one place
+def _bind_temporal_mode_methods():
+    names = [
+        "asof_join_left", "asof_join_right", "asof_join_outer",
+        "interval_join_inner", "interval_join_left",
+        "interval_join_right", "interval_join_outer",
+        "window_join_inner", "window_join_left",
+        "window_join_right", "window_join_outer",
+    ]
+    for name in names:
+        def method(self, other, *args, _name=name, **kwargs):
+            from ..stdlib import temporal as _t
+
+            return getattr(_t, _name)(self, other, *args, **kwargs)
+
+        method.__name__ = name
+        method.__qualname__ = f"Table.{name}"
+        setattr(Table, name, method)
+
+
+_bind_temporal_mode_methods()
+
+
 class TableLike:
     """Anything with a universe (reference: table.py TableLike)."""
 

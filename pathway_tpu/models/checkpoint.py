@@ -1,0 +1,236 @@
+"""HF checkpoint → flax params converters for the JAX model stack.
+
+The reference serves real pretrained models through sentence-transformers
+(xpacks/llm/embedders.py:270-330 ``SentenceTransformerEmbedder``,
+rerankers.py:186 ``CrossEncoderReranker``).  Here the same weights run on
+the TPU encoder (models/encoder.py): this module reads a local HF
+checkpoint — a ``model.safetensors`` / ``pytorch_model.bin`` file, a model
+directory, or a cached ``transformers`` model name — and remaps the BERT
+parameterization onto :class:`TransformerEncoder`'s flax tree.
+
+Mapping notes (torch ``Linear`` stores [out, in]; flax ``Dense`` kernels
+are [in, out], so every kernel is transposed):
+
+* ``embeddings.{word,position,token_type}_embeddings`` → ``tok_emb`` /
+  ``pos_emb`` / ``type_emb``; ``embeddings.LayerNorm`` → ``ln_emb``.
+* per layer: ``attention.self.{query,key,value}`` → heads-split
+  ``attention.{query,key,value}`` ([H, heads, head_dim]);
+  ``attention.output.dense`` → ``attention.out`` ([heads, head_dim, H]);
+  ``attention.output.LayerNorm`` → ``ln1``; ``intermediate.dense`` →
+  ``mlp_in``; ``output.dense`` → ``mlp_out``; ``output.LayerNorm`` → ``ln2``.
+* classification checkpoints: ``bert.pooler.dense`` → ``pooler``,
+  ``classifier`` → ``score_head`` (cross_encoder.py ``_ScoredEncoder``).
+
+No network access is ever attempted: everything is ``local_files_only``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Mapping
+
+import numpy as np
+
+__all__ = [
+    "load_state_dict",
+    "bert_config_from_hf",
+    "bert_to_flax",
+    "classifier_to_flax",
+    "load_encoder",
+    "load_cross_encoder",
+]
+
+_PREFIXES = ("bert.", "model.", "0.auto_model.", "auto_model.")
+
+
+def _strip_prefix(sd: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Normalize key prefixes (plain BertModel, BertForSequenceClassification,
+    sentence-transformers module dirs)."""
+    out: dict[str, np.ndarray] = {}
+    for key, val in sd.items():
+        for pref in _PREFIXES:
+            if key.startswith(pref):
+                key = key[len(pref):]
+                break
+        out[key] = val
+    return out
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor without importing torch at module scope
+    return t.detach().cpu().numpy()
+
+
+def load_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Read a checkpoint file or model directory into {name: ndarray}."""
+    if os.path.isdir(path):
+        for name in ("model.safetensors", "pytorch_model.bin", "pytorch_model.pt"):
+            cand = os.path.join(path, name)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise FileNotFoundError(f"no checkpoint file found under {path}")
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        sd = dict(load_file(path))
+    else:
+        import torch
+
+        raw = torch.load(path, map_location="cpu", weights_only=True)
+        sd = {k: _to_numpy(v) for k, v in raw.items()}
+    return _strip_prefix(sd)
+
+
+def bert_config_from_hf(path_or_dict: str | Mapping[str, Any]):
+    """Build an EncoderConfig from an HF ``config.json`` (path to a model
+    dir, the json file, or an already-parsed dict)."""
+    from .encoder import EncoderConfig
+
+    if isinstance(path_or_dict, str):
+        cfg_path = path_or_dict
+        if os.path.isdir(cfg_path):
+            cfg_path = os.path.join(cfg_path, "config.json")
+        with open(cfg_path) as f:
+            raw = json.load(f)
+    else:
+        raw = dict(path_or_dict)
+    return EncoderConfig(
+        vocab_size=raw["vocab_size"],
+        hidden_dim=raw["hidden_size"],
+        num_layers=raw["num_hidden_layers"],
+        num_heads=raw["num_attention_heads"],
+        mlp_dim=raw["intermediate_size"],
+        max_len=raw.get("max_position_embeddings", 512),
+        ln_eps=raw.get("layer_norm_eps", 1e-12),
+        type_vocab_size=raw.get("type_vocab_size", 2),
+    )
+
+
+def _dense(sd: Mapping[str, np.ndarray], key: str) -> dict[str, np.ndarray]:
+    return {
+        "kernel": sd[f"{key}.weight"].astype(np.float32).T,
+        "bias": sd[f"{key}.bias"].astype(np.float32),
+    }
+
+
+def _layer_norm(sd: Mapping[str, np.ndarray], key: str) -> dict[str, np.ndarray]:
+    return {
+        "scale": sd[f"{key}.weight"].astype(np.float32),
+        "bias": sd[f"{key}.bias"].astype(np.float32),
+    }
+
+
+def bert_to_flax(sd: Mapping[str, np.ndarray], cfg) -> dict:
+    """HF BertModel state dict → params for ``TransformerEncoder``."""
+    heads = cfg.num_heads
+    hd = cfg.hidden_dim // heads
+
+    params: dict[str, Any] = {
+        "tok_emb": {
+            "embedding": sd["embeddings.word_embeddings.weight"].astype(np.float32)
+        },
+        "pos_emb": {
+            "embedding": sd["embeddings.position_embeddings.weight"].astype(np.float32)
+        },
+        "ln_emb": _layer_norm(sd, "embeddings.LayerNorm"),
+    }
+    if cfg.type_vocab_size and "embeddings.token_type_embeddings.weight" in sd:
+        params["type_emb"] = {
+            "embedding": sd["embeddings.token_type_embeddings.weight"].astype(
+                np.float32
+            )
+        }
+
+    for i in range(cfg.num_layers):
+        pref = f"encoder.layer.{i}"
+        attn: dict[str, Any] = {}
+        for name in ("query", "key", "value"):
+            lin = _dense(sd, f"{pref}.attention.self.{name}")
+            attn[name] = {
+                "kernel": lin["kernel"].reshape(cfg.hidden_dim, heads, hd),
+                "bias": lin["bias"].reshape(heads, hd),
+            }
+        out = _dense(sd, f"{pref}.attention.output.dense")
+        attn["out"] = {
+            "kernel": out["kernel"].reshape(heads, hd, cfg.hidden_dim),
+            "bias": out["bias"],
+        }
+        params[f"layer_{i}"] = {
+            "attention": attn,
+            "ln1": _layer_norm(sd, f"{pref}.attention.output.LayerNorm"),
+            "mlp_in": _dense(sd, f"{pref}.intermediate.dense"),
+            "mlp_out": _dense(sd, f"{pref}.output.dense"),
+            "ln2": _layer_norm(sd, f"{pref}.output.LayerNorm"),
+        }
+    return params
+
+
+def classifier_to_flax(sd: Mapping[str, np.ndarray], cfg) -> dict:
+    """HF BertForSequenceClassification state dict → ``_ScoredEncoder``
+    params (encoder + pooler + scalar head)."""
+    params = {
+        "encoder": bert_to_flax(sd, cfg),
+        "pooler": _dense(sd, "pooler.dense"),
+        "score_head": _dense(sd, "classifier"),
+    }
+    if params["score_head"]["kernel"].shape[-1] != 1:
+        # multi-label head: keep the first logit (cross-encoder rerankers
+        # ship num_labels=1; anything else has no scalar-score semantics)
+        params["score_head"] = {
+            "kernel": params["score_head"]["kernel"][:, :1],
+            "bias": params["score_head"]["bias"][:1],
+        }
+    return params
+
+
+def _resolve_local(model_name: str) -> str | None:
+    """Resolve a model name/path to a local directory without any network
+    traffic: an existing path wins; otherwise look in the HF cache."""
+    if os.path.exists(model_name):
+        return model_name
+    candidates = [model_name]
+    if "/" not in model_name:
+        # the reference accepts bare sentence-transformers names
+        # (embedders.py:283 "model (str): model name or path")
+        candidates.append(f"sentence-transformers/{model_name}")
+    for cand in candidates:
+        try:
+            from huggingface_hub import snapshot_download
+
+            return snapshot_download(cand, local_files_only=True)
+        except Exception:
+            continue
+    return None
+
+
+def load_encoder(model_name: str):
+    """(cfg, params) for ``TransformerEncoder`` from a local checkpoint,
+    or None if the model cannot be found locally."""
+    local = _resolve_local(model_name)
+    if local is None:
+        return None
+    try:
+        cfg = bert_config_from_hf(local)
+        sd = load_state_dict(local)
+        return cfg, bert_to_flax(sd, cfg)
+    except (FileNotFoundError, KeyError):
+        return None
+
+
+def load_cross_encoder(model_name: str):
+    """(cfg, params) for ``_ScoredEncoder`` from a local classification
+    checkpoint, or None if unavailable."""
+    local = _resolve_local(model_name)
+    if local is None:
+        return None
+    try:
+        cfg = bert_config_from_hf(local)
+        sd = load_state_dict(local)
+        return cfg, classifier_to_flax(sd, cfg)
+    except (FileNotFoundError, KeyError):
+        return None

@@ -27,10 +27,16 @@ class _ScoredEncoder(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, ids, mask):
-        hidden = TransformerEncoder(self.cfg, name="encoder")(ids, mask, pool=False)
+    def __call__(self, ids, mask, type_ids=None):
+        hidden = TransformerEncoder(self.cfg, name="encoder")(
+            ids, mask, type_ids=type_ids, pool=False
+        )
         cls = hidden[:, 0, :].astype(jnp.float32)
-        return nn.Dense(1, name="score_head")(cls)[:, 0]
+        # BERT pooler (tanh dense on CLS) then the classifier head — the
+        # exact stack BertForSequenceClassification scores with, so
+        # converted HF cross-encoder checkpoints are weight-compatible
+        pooled = jnp.tanh(nn.Dense(self.cfg.hidden_dim, name="pooler")(cls))
+        return nn.Dense(1, name="score_head")(pooled)[:, 0]
 
 
 class CrossEncoder:
@@ -41,16 +47,35 @@ class CrossEncoder:
         seed: int = 0,
         max_length: int = 256,
     ):
+        import dataclasses
+
+        self.pretrained = False
+        params = None
+        if model_name is not None:
+            from . import checkpoint
+
+            loaded = checkpoint.load_cross_encoder(model_name)
+            if loaded is not None:
+                loaded_cfg, params = loaded
+                cfg = dataclasses.replace(
+                    loaded_cfg, dtype=(cfg or EncoderConfig()).dtype
+                )
+                self.pretrained = True
         self.cfg = cfg or EncoderConfig()
         self.max_length = min(max_length, self.cfg.max_len)
         self.tokenizer = load_tokenizer(model_name, vocab_size=self.cfg.vocab_size)
         self.model = _ScoredEncoder(self.cfg)
-        ids = jnp.zeros((1, 8), jnp.int32)
-        self.params = self.model.init(
-            jax.random.PRNGKey(seed), ids, jnp.ones_like(ids)
-        )["params"]
+        if params is not None:
+            self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        else:
+            ids = jnp.zeros((1, 8), jnp.int32)
+            self.params = self.model.init(
+                jax.random.PRNGKey(seed), ids, jnp.ones_like(ids)
+            )["params"]
         self._apply = jax.jit(
-            lambda params, ids, mask: self.model.apply({"params": params}, ids, mask)
+            lambda params, ids, mask, tids: self.model.apply(
+                {"params": params}, ids, mask, tids
+            )
         )
 
     def predict(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
@@ -59,14 +84,15 @@ class CrossEncoder:
             return np.zeros((0,), dtype=np.float32)
         queries = [q for q, _ in pairs]
         docs = [d for _, d in pairs]
-        ids_all, mask_all = self.tokenizer.encode_batch(
-            queries, max_length=self.max_length, pair=docs
+        ids_all, mask_all, type_ids_all = self.tokenizer.encode_batch(
+            queries, max_length=self.max_length, pair=docs, return_type_ids=True
         )
         return bucketed_dispatch(
-            lambda ids, mask: self._apply(self.params, ids, mask),
+            lambda ids, mask, tids: self._apply(self.params, ids, mask, tids),
             ids_all,
             mask_all,
             self.max_length,
+            type_ids_all=type_ids_all,
         )
 
     def __call__(self, query: str, doc: str) -> float:

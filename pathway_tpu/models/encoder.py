@@ -44,6 +44,10 @@ class EncoderConfig:
     max_len: int = 512
     dtype: Any = jnp.bfloat16
     emb_dim: int | None = None  # pooled output dim; defaults to hidden_dim
+    #: BERT checkpoint conventions (exact values matter for weight parity
+    #: with converted HF checkpoints, models/checkpoint.py)
+    ln_eps: float = 1e-12
+    type_vocab_size: int = 2
 
 
 class Block(nn.Module):
@@ -58,11 +62,11 @@ class Block(nn.Module):
             param_dtype=jnp.float32,
             name="attention",
         )(x, x, mask=mask)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x + h)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln1")(x + h)
         h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlp_in")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # BERT's erf gelu (HF ACT2FN["gelu"])
         h = nn.Dense(cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlp_out")(h)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x + h)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln2")(x + h)
         return x
 
 
@@ -72,7 +76,7 @@ class TransformerEncoder(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, ids, mask, pool: bool = True):
+    def __call__(self, ids, mask, type_ids=None, pool: bool = True):
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_dim, param_dtype=jnp.float32, name="tok_emb"
@@ -81,7 +85,14 @@ class TransformerEncoder(nn.Module):
             cfg.max_len, cfg.hidden_dim, param_dtype=jnp.float32, name="pos_emb"
         )(jnp.arange(ids.shape[1])[None, :]).astype(cfg.dtype)
         x = x + pos
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
+        if cfg.type_vocab_size:
+            if type_ids is None:
+                type_ids = jnp.zeros_like(ids)
+            x = x + nn.Embed(
+                cfg.type_vocab_size, cfg.hidden_dim, param_dtype=jnp.float32,
+                name="type_emb",
+            )(type_ids).astype(cfg.dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln_emb")(x)
         attn_mask = mask[:, None, None, :].astype(bool)
         for i in range(cfg.num_layers):
             x = Block(cfg, name=f"layer_{i}")(x, attn_mask)
@@ -105,13 +116,17 @@ def _bucket(value: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
-def bucketed_dispatch(apply_fn, ids_all, mask_all, max_length: int) -> np.ndarray:
+def bucketed_dispatch(
+    apply_fn, ids_all, mask_all, max_length: int, type_ids_all=None
+) -> np.ndarray:
     """Pad (batch, seq) to buckets and dispatch chunks through a jitted
-    ``apply_fn(ids, mask)`` — one compilation per (batch_bucket, seq_bucket).
-    Shared by SentenceEncoder and CrossEncoder."""
+    ``apply_fn(ids, mask[, type_ids])`` — one compilation per
+    (batch_bucket, seq_bucket).  Shared by SentenceEncoder and CrossEncoder."""
     longest = int(mask_all.sum(axis=1).max())
     seq = min(_bucket(longest, SEQ_BUCKETS), max_length)
     ids_all, mask_all = ids_all[:, :seq], mask_all[:, :seq]
+    if type_ids_all is not None:
+        type_ids_all = type_ids_all[:, :seq]
     b = ids_all.shape[0]
     bb = _bucket(b, BATCH_BUCKETS)
     outs = []
@@ -123,9 +138,12 @@ def bucketed_dispatch(apply_fn, ids_all, mask_all, max_length: int) -> np.ndarra
         ids[:chunk] = ids_all[start : start + chunk]
         mask[:chunk] = mask_all[start : start + chunk]
         mask[chunk:, 0] = 1  # avoid 0/0 in pooling for pad rows
-        res = np.asarray(
-            apply_fn(jnp.asarray(ids), jnp.asarray(mask)), dtype=np.float32
-        )
+        args = [jnp.asarray(ids), jnp.asarray(mask)]
+        if type_ids_all is not None:
+            tids = np.zeros((bb, seq), np.int32)
+            tids[:chunk] = type_ids_all[start : start + chunk]
+            args.append(jnp.asarray(tids))
+        res = np.asarray(apply_fn(*args), dtype=np.float32)
         outs.append(res[:chunk])
         start += chunk
     return np.concatenate(outs, axis=0)
@@ -146,14 +164,34 @@ class SentenceEncoder:
         seed: int = 0,
         max_length: int = 256,
     ):
+        self.pretrained = False
+        params = None
+        if model_name is not None:
+            from . import checkpoint
+
+            loaded = checkpoint.load_encoder(model_name)
+            if loaded is not None:
+                loaded_cfg, params = loaded
+                # keep the caller's compute dtype (bf16 default) — the
+                # checkpoint only pins geometry + norm conventions
+                loaded_cfg = dataclasses.replace(
+                    loaded_cfg,
+                    dtype=(cfg or EncoderConfig()).dtype,
+                    emb_dim=(cfg.emb_dim if cfg is not None else None),
+                )
+                cfg = loaded_cfg
+                self.pretrained = True
         self.cfg = cfg or EncoderConfig()
         self.max_length = min(max_length, self.cfg.max_len)
         self.tokenizer = load_tokenizer(model_name, vocab_size=self.cfg.vocab_size)
         self.model = TransformerEncoder(self.cfg)
-        ids = jnp.zeros((1, 8), jnp.int32)
-        self.params = self.model.init(jax.random.PRNGKey(seed), ids, jnp.ones_like(ids))[
-            "params"
-        ]
+        if params is not None:
+            self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        else:
+            ids = jnp.zeros((1, 8), jnp.int32)
+            self.params = self.model.init(
+                jax.random.PRNGKey(seed), ids, jnp.ones_like(ids)
+            )["params"]
         self._apply = functools.partial(jax.jit(self._forward))
 
     def _forward(self, params, ids, mask):

@@ -88,30 +88,43 @@ class HashTokenizer:
         texts: Sequence[str],
         max_length: int = 256,
         pair: Sequence[str] | None = None,
-    ) -> tuple[np.ndarray, np.ndarray]:
-        """Returns (ids[B,L], mask[B,L]) padded to ``max_length``."""
+        return_type_ids: bool = False,
+    ) -> tuple[np.ndarray, ...]:
+        """Returns (ids[B,L], mask[B,L]) padded to ``max_length``; with
+        ``return_type_ids`` also the BERT segment ids (0 for
+        ``[CLS] A [SEP]``, 1 for ``B [SEP]``)."""
         if _native is not None:
-            return _native.tokenize_batch(
+            batch, mask = _native.tokenize_batch(
                 [t.encode("utf-8") for t in texts],
                 max_length,
                 self.vocab_size,
                 self.lowercase,
                 [p.encode("utf-8") for p in pair] if pair is not None else None,
             )
-        ids_list = []
-        for i, t in enumerate(texts):
-            ids = [self.CLS] + self.tokenize(t)[: max_length - 2] + [self.SEP]
-            if pair is not None:
-                ids = ids[: max_length // 2]
-                ids += self.tokenize(pair[i])[: max_length - len(ids) - 1] + [self.SEP]
-            ids_list.append(ids[:max_length])
-        L = max_length
-        batch = np.zeros((len(texts), L), dtype=np.int32)
-        mask = np.zeros((len(texts), L), dtype=np.int32)
-        for i, ids in enumerate(ids_list):
-            batch[i, : len(ids)] = ids
-            mask[i, : len(ids)] = 1
-        return batch, mask
+        else:
+            ids_list = []
+            for i, t in enumerate(texts):
+                ids = [self.CLS] + self.tokenize(t)[: max_length - 2] + [self.SEP]
+                if pair is not None:
+                    ids = ids[: max_length // 2]
+                    ids += self.tokenize(pair[i])[: max_length - len(ids) - 1] + [self.SEP]
+                ids_list.append(ids[:max_length])
+            L = max_length
+            batch = np.zeros((len(texts), L), dtype=np.int32)
+            mask = np.zeros((len(texts), L), dtype=np.int32)
+            for i, ids in enumerate(ids_list):
+                batch[i, : len(ids)] = ids
+                mask[i, : len(ids)] = 1
+        if not return_type_ids:
+            return batch, mask
+        if pair is None:
+            return batch, mask, np.zeros_like(batch)
+        # segment 1 starts strictly after the first SEP (special id, cannot
+        # collide with hashed word ids).  If truncation dropped segment A's
+        # SEP the row degrades to all-zeros — harmless for hashed vocab.
+        is_sep = batch == self.SEP
+        type_ids = ((np.cumsum(is_sep, axis=1) - is_sep) > 0).astype(np.int32) * mask
+        return batch, mask, type_ids
 
 
 class _HFTokenizerWrapper:
@@ -119,7 +132,7 @@ class _HFTokenizerWrapper:
         self.tok = tok
         self.vocab_size = tok.vocab_size
 
-    def encode_batch(self, texts, max_length=256, pair=None):
+    def encode_batch(self, texts, max_length=256, pair=None, return_type_ids=False):
         enc = self.tok(
             list(texts),
             list(pair) if pair is not None else None,
@@ -128,7 +141,15 @@ class _HFTokenizerWrapper:
             max_length=max_length,
             return_tensors="np",
         )
-        return enc["input_ids"].astype(np.int32), enc["attention_mask"].astype(np.int32)
+        ids = enc["input_ids"].astype(np.int32)
+        mask = enc["attention_mask"].astype(np.int32)
+        if not return_type_ids:
+            return ids, mask
+        type_ids = enc.get("token_type_ids")
+        type_ids = (
+            type_ids.astype(np.int32) if type_ids is not None else np.zeros_like(ids)
+        )
+        return ids, mask, type_ids
 
 
 def load_tokenizer(model_name: str | None = None, vocab_size: int = 30522):

@@ -15,7 +15,27 @@ from ._window import (
 )
 from .temporal_behavior import common_behavior, exactly_once_behavior, Behavior
 from ._asof_now_join import asof_now_join, asof_now_join_inner, asof_now_join_left
-from ._joins import asof_join, interval_join, window_join, interval, AsofDirection
+from ._joins import (
+    AsofDirection,
+    AsofJoinResult,
+    IntervalJoinResult,
+    WindowJoinResult,
+    asof_join,
+    asof_join_left,
+    asof_join_outer,
+    asof_join_right,
+    interval,
+    interval_join,
+    interval_join_inner,
+    interval_join_left,
+    interval_join_outer,
+    interval_join_right,
+    window_join,
+    window_join_inner,
+    window_join_left,
+    window_join_outer,
+    window_join_right,
+)
 
 __all__ = [
     "Window",
@@ -31,8 +51,22 @@ __all__ = [
     "asof_now_join_inner",
     "asof_now_join_left",
     "asof_join",
+    "asof_join_left",
+    "asof_join_right",
+    "asof_join_outer",
+    "AsofJoinResult",
+    "IntervalJoinResult",
+    "WindowJoinResult",
     "interval_join",
+    "interval_join_inner",
+    "interval_join_left",
+    "interval_join_right",
+    "interval_join_outer",
     "window_join",
+    "window_join_inner",
+    "window_join_left",
+    "window_join_right",
+    "window_join_outer",
     "interval",
     "AsofDirection",
 ]

@@ -102,15 +102,24 @@ class WindowBehaviorNode(Node):
                         del self.held[i]
                         break
                 else:
+                    if self.keep_results and self._window_closed(end):
+                        # closed windows are frozen: a late upstream
+                        # recompute (e.g. a session re-merge triggered by a
+                        # forgotten row) may not retract their emitted rows
+                        continue
                     self._release((key, row, diff), out)
                 continue
             if self._ready(row):
                 self._release((key, row, diff), out)
             else:
                 self.held.append((key, row, diff))
-        # advance the watermark
+        # advance the watermark (probe-only intervals_over rows carry a
+        # None event time and do not move the clock)
         for _, row, _ in incoming:
-            tv = _num(row[self.time_idx])
+            tv = row[self.time_idx]
+            if tv is None:
+                continue
+            tv = _num(tv)
             if self.watermark is None or tv > self.watermark:
                 self.watermark = tv
         # release newly-ready held rows; cutoff is admission control for

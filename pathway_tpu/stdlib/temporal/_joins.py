@@ -49,11 +49,19 @@ class _PackedJoinResult:
     ``__lpay__``/``__rpay__``; ``select`` rewrites references to the original
     left/right tables into tuple projections."""
 
-    def __init__(self, base: Table, left: Table, right: Table, right_optional: bool):
+    def __init__(
+        self,
+        base: Table,
+        left: Table,
+        right: Table,
+        right_optional: bool,
+        left_optional: bool = False,
+    ):
         self._base = base
         self._left = left
         self._right = right
         self._right_optional = right_optional
+        self._left_optional = left_optional
 
     def select(self, *args: Any, **kwargs: Any) -> Table:
         exprs = expand_select_args(args, kwargs, self._left, self._left, self._right)
@@ -61,12 +69,16 @@ class _PackedJoinResult:
         rnames = self._right.column_names()
         base = self._base
         right_optional = self._right_optional
+        left_optional = self._left_optional
 
         def mapping(node):
             if isinstance(node, ColumnReference) and node.table is self._left:
                 i = lnames.index(node.name)
+                dtype = dt.Optional(node._dtype) if left_optional else node._dtype
                 return ApplyExpression(
-                    lambda lp, _i=i: lp[_i], node._dtype, base["__lpay__"]
+                    lambda lp, _i=i: (lp[_i] if lp is not None else None),
+                    dtype,
+                    base["__lpay__"],
                 )
             if isinstance(node, ColumnReference) and node.table is self._right:
                 i = rnames.index(node.name)
@@ -105,8 +117,6 @@ def interval_join(
     lb, ub = _num(interval.lower_bound), _num(interval.upper_bound)
     if ub < lb:
         raise ValueError("interval upper bound below lower bound")
-    if how not in (JoinMode.INNER, JoinMode.LEFT):
-        raise ValueError("interval_join supports inner and left modes")
     width = max(ub - lb, 1)
 
     lt = resolve_expression(self_time, self)
@@ -124,6 +134,7 @@ def interval_join(
         __buckets__=ApplyExpression(left_buckets, dt.List(dt.INT), lt),
         __k__=pw.make_tuple(*key_l),
         __lpay__=_pack(self),
+        __lorig__=pw.this.id,  # original row id survives the flatten
     )
     lhs = lhs.flatten(lhs["__buckets__"])
     rhs = other.select(
@@ -150,26 +161,51 @@ def interval_join(
         __rt__=rhs["__t__"],
         __lpay__=lhs["__lpay__"],
         __rpay__=rhs["__rpay__"],
-        __lid__=pw.left.id,
+        __lid__=lhs["__lorig__"],
+        __rid__=pw.right.id,
     )
     in_band = joined.filter(
         (joined["__rt__"] - joined["__lt__"] >= interval.lower_bound)
         & (joined["__rt__"] - joined["__lt__"] <= interval.upper_bound)
     )
-    if how == JoinMode.LEFT:
+    if how in (JoinMode.LEFT, JoinMode.OUTER):
         # left rows with no band match get a None right payload
-        matched_left = in_band.groupby(in_band["__lid__"]).reduce(
-            __lid__=in_band["__lid__"], n=pw.reducers.count()
+        # (reference: _interval_join.py interval_join_left :40-120)
+        in_band = in_band.concat_reindex(
+            _antijoin_side(self, in_band, "__lid__").select(
+                __lt__=None, __rt__=None,
+                __lpay__=pw.this["__pay__"], __rpay__=None,
+                __lid__=pw.this["__sid__"], __rid__=None,
+            )
         )
-        all_left = self.select(__lpay__=_pack(self), __lid__=pw.this.id)
-        matched_keys = matched_left.with_id(matched_left["__lid__"])
-        unmatched = all_left.with_id(all_left["__lid__"]).difference(matched_keys)
-        unmatched_rows = unmatched.select(
-            __lt__=None, __rt__=None,
-            __lpay__=unmatched["__lpay__"], __rpay__=None, __lid__=unmatched["__lid__"],
+    if how in (JoinMode.RIGHT, JoinMode.OUTER):
+        in_band = in_band.concat_reindex(
+            _antijoin_side(other, in_band, "__rid__").select(
+                __lt__=None, __rt__=None,
+                __lpay__=None, __rpay__=pw.this["__pay__"],
+                __lid__=None, __rid__=pw.this["__sid__"],
+            )
         )
-        in_band = in_band.concat_reindex(unmatched_rows)
-    return _PackedJoinResult(in_band, self, other, right_optional=how == JoinMode.LEFT)
+    return _PackedJoinResult(
+        in_band,
+        self,
+        other,
+        right_optional=how in (JoinMode.LEFT, JoinMode.OUTER),
+        left_optional=how in (JoinMode.RIGHT, JoinMode.OUTER),
+    )
+
+
+def _antijoin_side(side: Table, matched: Table, id_col: str) -> Table:
+    """Rows of ``side`` whose id never appears in ``matched[id_col]``,
+    packed as (__sid__, __pay__)."""
+    present = matched.filter(matched[id_col].is_not_none())
+    keys = present.groupby(present[id_col]).reduce(
+        __sid__=present[id_col], __n__=pw.reducers.count()
+    )
+    all_rows = side.select(__pay__=_pack(side), __sid__=pw.this.id)
+    return all_rows.with_id(all_rows["__sid__"]).difference(
+        keys.with_id(keys["__sid__"])
+    )
 
 
 def window_join(
@@ -181,9 +217,9 @@ def window_join(
     *on: Any,
     how: JoinMode = JoinMode.INNER,
 ) -> _PackedJoinResult:
-    """reference: _window_join.py — join rows landing in the same window."""
-    if how not in (JoinMode.INNER,):
-        raise ValueError("window_join currently supports inner mode")
+    """reference: _window_join.py — join rows landing in the same window;
+    left/right/outer modes emit unmatched (row, window) instances with a
+    None payload for the absent side (window_join_left/right/outer)."""
     lt = resolve_expression(self_time, self)
     rt = resolve_expression(other_time, other)
     key_l = [resolve_expression(c.left, self, self, other) for c in on]
@@ -213,8 +249,50 @@ def window_join(
         __lpay__=lhs["__lpay__"],
         __rpay__=rhs["__rpay__"],
         __window__=lhs["__wins__"],
+        __lid__=pw.left.id,
+        __rid__=pw.right.id,
     )
-    return _PackedJoinResult(joined, self, other, right_optional=False)
+    if how in (JoinMode.LEFT, JoinMode.OUTER):
+        # unmatched (left row, window) instances keep their window
+        joined = joined.concat_reindex(
+            _antijoin_window_side(lhs, joined, "__lid__", "__lpay__").select(
+                __lpay__=pw.this["__pay__"], __rpay__=None,
+                __window__=pw.this["__win__"],
+                __lid__=pw.this["__sid__"], __rid__=None,
+            )
+        )
+    if how in (JoinMode.RIGHT, JoinMode.OUTER):
+        joined = joined.concat_reindex(
+            _antijoin_window_side(rhs, joined, "__rid__", "__rpay__").select(
+                __lpay__=None, __rpay__=pw.this["__pay__"],
+                __window__=pw.this["__win__"],
+                __lid__=None, __rid__=pw.this["__sid__"],
+            )
+        )
+    return _PackedJoinResult(
+        joined,
+        self,
+        other,
+        right_optional=how in (JoinMode.LEFT, JoinMode.OUTER),
+        left_optional=how in (JoinMode.RIGHT, JoinMode.OUTER),
+    )
+
+
+def _antijoin_window_side(
+    flat_side: Table, matched: Table, id_col: str, pay_col: str
+) -> Table:
+    """Flattened (row, window) instances of one side that matched nothing,
+    packed as (__sid__, __pay__, __win__)."""
+    present = matched.filter(matched[id_col].is_not_none())
+    keys = present.groupby(present[id_col]).reduce(
+        __sid__=present[id_col], __n__=pw.reducers.count()
+    )
+    all_rows = flat_side.select(
+        __pay__=flat_side[pay_col], __win__=flat_side["__wins__"], __sid__=pw.this.id
+    )
+    return all_rows.with_id(all_rows["__sid__"]).difference(
+        keys.with_id(keys["__sid__"])
+    )
 
 
 class AsofDirection(enum.Enum):
@@ -233,8 +311,11 @@ def asof_join(
     defaults: dict | None = None,
     direction: AsofDirection = AsofDirection.BACKWARD,
 ) -> _PackedJoinResult:
-    """reference: _asof_join.py — for each left row, the temporally closest
-    right row (per key) in the given direction."""
+    """reference: _asof_join.py — for each row, the temporally closest
+    counterpart row (per key) in the given direction.  LEFT matches every
+    left row, RIGHT every right row, OUTER both perspectives."""
+    if how not in (JoinMode.LEFT, JoinMode.RIGHT, JoinMode.OUTER):
+        raise ValueError("asof_join supports left, right, and outer modes")
     lt = resolve_expression(self_time, self)
     rt = resolve_expression(other_time, other)
     key_l = [resolve_expression(c.left, self, self, other) for c in on]
@@ -256,32 +337,44 @@ def asof_join(
     )
     merged = l_packed.concat_reindex(r_packed)
     dir_value = direction.value
+    mode = how
+
+    def best_match(t, cands):
+        """Closest (time, pay) among time-sorted ``cands`` per direction."""
+        best = None
+        if dir_value in ("backward", "nearest"):
+            for ct, cpay in cands:
+                if ct <= t:
+                    best = (ct, cpay)
+                else:
+                    break
+        if dir_value in ("forward", "nearest"):
+            fwd = next(((ct, cpay) for ct, cpay in cands if ct >= t), None)
+            if fwd is not None and (
+                best is None
+                or (
+                    dir_value == "nearest"
+                    and abs(_num(fwd[0]) - _num(t)) < abs(_num(best[0]) - _num(t))
+                )
+                or dir_value == "forward"
+            ):
+                best = fwd
+        return best
 
     def assign(rows):
-        rights = [(t, pay) for t, side, rid, pay in rows if side == 1]
+        lefts = [(t, rid, pay) for t, side, rid, pay in rows if side == 0]
+        rights = [(t, rid, pay) for t, side, rid, pay in rows if side == 1]
         out = []
-        for t, side, rid, pay in rows:
-            if side != 0:
-                continue
-            best = None
-            if dir_value in ("backward", "nearest"):
-                for rt_, rpay in rights:
-                    if rt_ <= t:
-                        best = (rt_, rpay)
-                    else:
-                        break
-            if dir_value in ("forward", "nearest"):
-                fwd = next(((rt_, rpay) for rt_, rpay in rights if rt_ >= t), None)
-                if fwd is not None and (
-                    best is None
-                    or (
-                        dir_value == "nearest"
-                        and abs(_num(fwd[0]) - _num(t)) < abs(_num(best[0]) - _num(t))
-                    )
-                    or dir_value == "forward"
-                ):
-                    best = fwd
-            out.append((rid, pay, best[1] if best else None))
+        if mode in (JoinMode.LEFT, JoinMode.OUTER):
+            r_cands = [(t, pay) for t, _rid, pay in rights]
+            for t, rid, pay in lefts:
+                best = best_match(t, r_cands)
+                out.append((0, rid, pay, best[1] if best else None))
+        if mode in (JoinMode.RIGHT, JoinMode.OUTER):
+            l_cands = [(t, pay) for t, _rid, pay in lefts]
+            for t, rid, pay in rights:
+                best = best_match(t, l_cands)
+                out.append((1, rid, best[1] if best else None, pay))
         return tuple(out)
 
     grouped = merged.groupby(merged["__k__"]).reduce(
@@ -296,16 +389,71 @@ def asof_join(
         ),
     )
     flat = grouped.flatten(grouped["__matches__"])
+    from ...internals.keys import ref_scalar
+
     base = flat._select_exprs(
         {
-            "__rid__": flat["__matches__"].get(0),
-            "__lpay__": flat["__matches__"].get(1),
-            "__rpay__": flat["__matches__"].get(2),
+            "__side__": flat["__matches__"].get(0),
+            "__rid__": flat["__matches__"].get(1),
+            "__lpay__": flat["__matches__"].get(2),
+            "__rpay__": flat["__matches__"].get(3),
         },
         universe=flat._universe,
     )
-    base = base.with_id(base["__rid__"])
-    result = _PackedJoinResult(base, self, other, right_optional=True)
+    if how == JoinMode.OUTER:
+        # OUTER emits both perspectives: row ids from the two source tables
+        # share one key space, so salt keys by side to keep a left id that
+        # collides with a right id from overwriting its row
+        base = base.with_id(
+            ApplyExpression(
+                lambda side, rid: ref_scalar("__asof__", side, rid),
+                dt.ANY,
+                base["__side__"],
+                base["__rid__"],
+            )
+        )
+    else:
+        base = base.with_id(base["__rid__"])
+    result = _PackedJoinResult(
+        base,
+        self,
+        other,
+        right_optional=how in (JoinMode.LEFT, JoinMode.OUTER),
+        left_optional=how in (JoinMode.RIGHT, JoinMode.OUTER),
+    )
     if defaults:
         result._defaults = defaults  # applied by callers via coalesce
     return result
+
+
+# -- named mode wrappers (reference surface: _interval_join.py
+# interval_join_{inner,left,right,outer} etc.) --
+
+
+def _mode_wrapper(fn, mode: JoinMode):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(self, other, *args, **kwargs):
+        kwargs["how"] = mode
+        return fn(self, other, *args, **kwargs)
+
+    return wrapped
+
+
+interval_join_inner = _mode_wrapper(interval_join, JoinMode.INNER)
+interval_join_left = _mode_wrapper(interval_join, JoinMode.LEFT)
+interval_join_right = _mode_wrapper(interval_join, JoinMode.RIGHT)
+interval_join_outer = _mode_wrapper(interval_join, JoinMode.OUTER)
+window_join_inner = _mode_wrapper(window_join, JoinMode.INNER)
+window_join_left = _mode_wrapper(window_join, JoinMode.LEFT)
+window_join_right = _mode_wrapper(window_join, JoinMode.RIGHT)
+window_join_outer = _mode_wrapper(window_join, JoinMode.OUTER)
+asof_join_left = _mode_wrapper(asof_join, JoinMode.LEFT)
+asof_join_right = _mode_wrapper(asof_join, JoinMode.RIGHT)
+asof_join_outer = _mode_wrapper(asof_join, JoinMode.OUTER)
+
+#: reference result-class names (surface parity; one packed implementation)
+IntervalJoinResult = _PackedJoinResult
+WindowJoinResult = _PackedJoinResult
+AsofJoinResult = _PackedJoinResult

@@ -195,20 +195,52 @@ def windowby(
     instance_e = resolve_expression(instance, table) if instance is not None else None
 
     if isinstance(window, IntervalsOverWindow):
-        if behavior is not None:
-            raise NotImplementedError(
-                "behaviors on intervals_over windows are not supported"
-            )
         assigned = _assign_intervals_over(table, time_e, instance_e, window)
+        if behavior is not None:
+            # probe-anchored windows buffer/forget by the data-row time
+            # already materialized as __iv_time__ (probe-only rows have a
+            # None time and ride the window bounds instead)
+            assigned = _apply_behavior(
+                assigned, table, time_e, behavior, time_col="__iv_time__"
+            )
         wgt = WindowGroupedTable(assigned, instance_e is not None)
         wgt._sort_by_name = "__iv_time__"
         return wgt
     if isinstance(window, SessionWindow):
+        # Sessions merge retroactively, so behaviors compile onto the INPUT
+        # stream (the reference applies time_column forget/buffer before the
+        # session operator): late rows are forgotten before they can merge
+        # into already-emitted sessions, buffered rows enter the merge only
+        # once the watermark passes t+delay, and keep_results=False retracts
+        # input rows (hence their sessions) once behind the cutoff.
         if behavior is not None:
-            raise NotImplementedError(
-                "behaviors on session windows are not supported yet "
-                "(sessions merge retroactively; cutoff would be unsound)"
-            )
+            from ...internals.expression import ColumnReference
+            from ._behavior_node import apply_temporal_behavior
+            from .temporal_behavior import ExactlyOnceBehavior
+
+            if isinstance(behavior, ExactlyOnceBehavior):
+                # emit-once: forget rows later than shift, and hold inputs
+                # until the watermark passes t + shift + max_gap so no
+                # further merge can touch the session once it appears
+                gap = _num(window.max_gap) if window.max_gap is not None else 0
+                shift = _num(behavior.shift or 0)
+                input_behavior = CommonBehavior(
+                    delay=shift + gap, cutoff=shift, keep_results=True
+                )
+            else:
+                input_behavior = behavior
+            gated = apply_temporal_behavior(table, time_e, input_behavior)
+
+            def onto_gated(node):
+                if isinstance(node, ColumnReference) and node.table is table:
+                    return gated[node.name]
+                return None
+
+            time_e = time_e._substitute(onto_gated)
+            if instance_e is not None:
+                instance_e = instance_e._substitute(onto_gated)
+            table = gated
+            behavior = None  # fully compiled onto the input stream
         assigned = _assign_session(table, time_e, instance_e, window)
     else:
         win_dtype = time_e._dtype
@@ -240,23 +272,33 @@ def windowby(
     return WindowGroupedTable(assigned, instance_e is not None)
 
 
-def _apply_behavior(assigned: Table, source: Table, time_e, behavior: Behavior) -> Table:
+def _apply_behavior(
+    assigned: Table,
+    source: Table,
+    time_e,
+    behavior: Behavior,
+    time_col: str | None = None,
+) -> Table:
     """Insert the buffering/cutoff node between window assignment and the
     grouped reduction (reference: behaviors compiled onto time_column.rs
-    forget/buffer in the window operator)."""
+    forget/buffer in the window operator).  ``time_col`` names an existing
+    time column on ``assigned``; otherwise ``time_e`` is rebound onto it."""
     from ...internals.expression import ColumnReference
     from ...internals.graph import Operator
     from ...internals.universe import Universe
 
-    # rebind the time expression onto the assigned table (same column
-    # names survive assignment)
-    def rebind(node):
-        if isinstance(node, ColumnReference) and node.table is source:
-            return assigned[node.name]
-        return None
+    if time_col is not None:
+        with_t = assigned.with_columns(__behavior_t__=assigned[time_col])
+    else:
+        # rebind the time expression onto the assigned table (same column
+        # names survive assignment)
+        def rebind(node):
+            if isinstance(node, ColumnReference) and node.table is source:
+                return assigned[node.name]
+            return None
 
-    time_on_assigned = time_e._substitute(rebind)
-    with_t = assigned.with_columns(__behavior_t__=time_on_assigned)
+        time_on_assigned = time_e._substitute(rebind)
+        with_t = assigned.with_columns(__behavior_t__=time_on_assigned)
     names = with_t.column_names()
     if isinstance(behavior, ExactlyOnceBehavior):
         params = dict(

@@ -315,3 +315,292 @@ def test_intervals_over_matches_reference_doctest():
         (8, (2, 4)),
         (10, (2, 4, 8)),
     ]
+
+
+# ---------------------------------------------------------------------------
+# interval/window join modes (ported from the reference's parametrized
+# test_interval_join_time_only, tests/temporal/test_interval_joins.py:24-141)
+# ---------------------------------------------------------------------------
+
+
+def _rows_n(table):
+    """None-safe sorted rows (outer-join outputs contain None cells)."""
+    _, cols = dbg.table_to_dicts(table)
+    names = table.column_names()
+    keys = list(cols[names[0]].keys()) if names else []
+    rows = [tuple(cols[n][k] for n in names) for k in keys]
+    return sorted(rows, key=lambda r: tuple((x is None, x if x is not None else 0) for x in r))
+
+
+def _sorted_n(rows):
+    return sorted(rows, key=lambda r: tuple((x is None, x if x is not None else 0) for x in r))
+
+
+def _mode_tables():
+    t1 = dbg.table_from_markdown(
+        """
+        a | t
+        1 | -1
+        2 | 0
+        3 | 2
+        4 | 3
+        5 | 7
+        6 | 13
+        """
+    )
+    t2 = dbg.table_from_markdown(
+        """
+        b | t
+        1 | 2
+        2 | 5
+        3 | 6
+        4 | 10
+        5 | 15
+        """
+    )
+    return t1, t2
+
+
+def test_interval_join_modes_window1():
+    t1, t2 = _mode_tables()
+    inner = [(3, 1), (4, 1), (5, 3)]
+    left_extra = [(1, None), (2, None), (6, None)]
+    right_extra = [(None, 2), (None, 4), (None, 5)]
+    iv = pw.temporal.interval(-1, 1)
+
+    res = t1.interval_join_inner(t2, t1.t, t2.t, iv).select(t1.a, t2.b)
+    assert _rows_n(res) == sorted(inner)
+    res = t1.interval_join_left(t2, t1.t, t2.t, iv).select(t1.a, t2.b)
+    assert _rows_n(res) == _sorted_n(inner + left_extra)
+    res = t1.interval_join_right(t2, t1.t, t2.t, iv).select(t1.a, t2.b)
+    assert _rows_n(res) == _sorted_n(inner + right_extra)
+    res = t1.interval_join_outer(t2, t1.t, t2.t, iv).select(t1.a, t2.b)
+    assert _rows_n(res) == _sorted_n(inner + left_extra + right_extra)
+
+
+def test_interval_join_modes_window2():
+    t1, t2 = _mode_tables()
+    inner = [(2, 1), (3, 1), (4, 1), (4, 2), (5, 2), (5, 3), (6, 5)]
+    iv = pw.temporal.interval(-2, 2)
+    res = t1.interval_join_outer(t2, t1.t, t2.t, iv).select(t1.a, t2.b)
+    assert _rows_n(res) == _sorted_n(inner + [(1, None), (None, 4)])
+
+
+def test_window_join_modes():
+    left = dbg.table_from_markdown(
+        """
+        lt | a
+        1  | x
+        5  | y
+        9  | z
+        """
+    )
+    right = dbg.table_from_markdown(
+        """
+        rt | b
+        2  | p
+        6  | q
+        14 | r
+        """
+    )
+    w = pw.temporal.tumbling(duration=4)
+    res = left.window_join_left(right, left.lt, right.rt, w).select(left.a, right.b)
+    assert _rows_n(res) == _sorted_n([("x", "p"), ("y", "q"), ("z", None)])
+    res = left.window_join_right(right, left.lt, right.rt, w).select(left.a, right.b)
+    assert _rows_n(res) == _sorted_n([(None, "r"), ("x", "p"), ("y", "q")])
+    res = left.window_join_outer(right, left.lt, right.rt, w).select(left.a, right.b)
+    assert _rows_n(res) == _sorted_n([(None, "r"), ("x", "p"), ("y", "q"), ("z", None)])
+
+
+def test_asof_join_modes():
+    trades = dbg.table_from_markdown(
+        """
+        t | px
+        2 | 100
+        7 | 200
+        """
+    )
+    quotes = dbg.table_from_markdown(
+        """
+        t | bid
+        1 | 99
+        5 | 198
+        9 | 205
+        """
+    )
+    res = trades.asof_join_right(quotes, trades.t, quotes.t).select(
+        trades.px, quotes.bid
+    )
+    # each quote matches the latest trade at-or-before its time
+    assert _rows_n(res) == _sorted_n([(None, 99), (100, 198), (200, 205)])
+    res = trades.asof_join_outer(quotes, trades.t, quotes.t).select(
+        trades.px, quotes.bid
+    )
+    assert _rows_n(res) == _sorted_n([(None, 99), (100, 99), (100, 198), (200, 198), (200, 205)])
+
+
+# ---------------------------------------------------------------------------
+# behaviors on session and intervals_over windows (reference:
+# temporal_behavior.py compiled onto time_column.rs forget/buffer —
+# VERDICT r1 gap #4)
+# ---------------------------------------------------------------------------
+
+
+def test_session_window_with_cutoff_drops_late_rows():
+    t = dbg.table_from_markdown(
+        """
+        t  | __time__ | __diff__
+        1  | 2        | 1
+        2  | 2        | 1
+        20 | 4        | 1
+        3  | 6        | 1
+        """
+    )
+    # session {1,2,3} would merge if t=3 weren't late: by the time it
+    # arrives the watermark (20) has passed session_end(2)+cutoff(5)
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.session(max_gap=2),
+        behavior=pw.temporal.common_behavior(cutoff=5),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    (out,) = dbg.materialize(result)
+    rows = sorted(tuple(r) for r in out.current.values())
+    assert rows == [(1, 2), (20, 1)]
+
+
+def test_session_window_with_delay_buffers_then_emits():
+    t = dbg.table_from_markdown(
+        """
+        t  | __time__ | __diff__
+        1  | 2        | 1
+        2  | 4        | 1
+        9  | 6        | 1
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.session(max_gap=2),
+        behavior=pw.temporal.common_behavior(delay=3),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    (out,) = dbg.materialize(result)
+    rows = sorted(tuple(r) for r in out.current.values())
+    assert rows == [(1, 2), (9, 1)]
+    # session [1,2] may not appear before the watermark passed 1+3=4,
+    # i.e. not before the batch carrying t=9
+    first_emit = min(tm for _, row, tm, d in out.history if d > 0 and row[0] == 1)
+    assert first_emit >= 6
+
+
+def test_intervals_over_with_behavior_compiles_and_runs():
+    data = dbg.table_from_markdown(
+        """
+        t | v
+        1 | 10
+        2 | 20
+        5 | 50
+        """
+    )
+    probes = dbg.table_from_markdown(
+        """
+        t
+        2
+        6
+        """
+    )
+    result = data.windowby(
+        data.t,
+        window=pw.temporal.intervals_over(
+            at=probes.t, lower_bound=-2, upper_bound=0, is_outer=False
+        ),
+        behavior=pw.temporal.common_behavior(cutoff=100),
+    ).reduce(
+        loc=pw.this._pw_window_location,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    (out,) = dbg.materialize(result)
+    rows = sorted(tuple(r) for r in out.current.values())
+    assert rows == [(2, 30), (6, 50)]
+
+
+def test_asof_join_outer_survives_id_collisions():
+    # explicit markdown ids collide across the two tables; OUTER emits both
+    # perspectives so keys must be side-salted, not raw row ids
+    trades = dbg.table_from_markdown(
+        """
+          | t | px
+        1 | 2 | 100
+        2 | 7 | 200
+        """
+    )
+    quotes = dbg.table_from_markdown(
+        """
+          | t | bid
+        1 | 1 | 99
+        2 | 5 | 198
+        3 | 9 | 205
+        """
+    )
+    res = trades.asof_join_outer(quotes, trades.t, quotes.t).select(
+        trades.px, quotes.bid
+    )
+    assert _rows_n(res) == _sorted_n(
+        [(None, 99), (100, 99), (100, 198), (200, 198), (200, 205)]
+    )
+
+
+def test_session_cutoff_merge_does_not_double_count():
+    # a NON-late row merging a recent session must retract the superseded
+    # session (no overlapping double-counted sessions)
+    t = dbg.table_from_markdown(
+        """
+        t | __time__ | __diff__
+        1 | 2        | 1
+        2 | 2        | 1
+        7 | 4        | 1
+        3 | 6        | 1
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.session(max_gap=2),
+        behavior=pw.temporal.common_behavior(cutoff=5),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    (out,) = dbg.materialize(result)
+    rows = sorted(tuple(r) for r in out.current.values())
+    # t=3 (watermark 7, threshold 2) is on time: sessions partition as
+    # {1,2,3} and {7}
+    assert rows == [(1, 3), (7, 1)]
+
+
+def test_session_exactly_once_behavior():
+    t = dbg.table_from_markdown(
+        """
+        t  | __time__ | __diff__
+        1  | 2        | 1
+        2  | 4        | 1
+        10 | 6        | 1
+        """
+    )
+    result = t.windowby(
+        t.t,
+        window=pw.temporal.session(max_gap=2),
+        behavior=pw.temporal.exactly_once_behavior(),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    (out,) = dbg.materialize(result)
+    rows = sorted(tuple(r) for r in out.current.values())
+    assert rows == [(1, 2), (10, 1)]
+    # session [1,2] emitted exactly once (no retraction/re-emit churn)
+    emits = [d for _, row, _, d in out.history if row[0] == 1]
+    assert emits == [1]
