@@ -1,0 +1,89 @@
+"""Exact brute-force vs LSH-bucketed KNN: latency + recall crossover.
+
+VERDICT r1 weak #5: the "one MXU matmul beats a graph walk" stance
+(STATUS.md §2.5) was asserted, not measured.  This script measures it:
+for corpus sizes N, query the same corpus through
+
+  * DeviceKnnIndex       — exact fused matmul + top-k (the design bet)
+  * LshKnnIndex          — banded LSH candidate buckets + exact rescoring
+                           of candidates (the reference's _knn_lsh.py shape)
+
+and report p50 query-batch latency plus recall@10 of LSH against the exact
+result.  Run on TPU for the real numbers; on CPU it still produces the
+relative shape (recorded in benchmarks/KNN_CROSSOVER.md with platform).
+
+Usage: python benchmarks/knn_crossover.py [N ...]   (default 10k 100k)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run(n: int, dim: int = 384, n_queries: int = 64, k: int = 10) -> dict:
+    import jax
+
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+    from pathway_tpu.stdlib.indexing.retrievers import LshKnnIndex
+
+    rng = np.random.default_rng(0)
+    # clustered corpus (mixture of gaussians) — embedding-like structure;
+    # i.i.d. gaussian vectors would starve LSH of any bucket locality and
+    # overstate the exact index's quality advantage
+    n_centers = max(n // 100, 10)
+    centers = rng.standard_normal((n_centers, dim)).astype(np.float32)
+    assign = rng.integers(0, n_centers, size=n)
+    corpus = (centers[assign] + 0.3 * rng.standard_normal((n, dim))).astype(
+        np.float32
+    )
+    q_assign = rng.integers(0, n_centers, size=n_queries)
+    queries = (
+        centers[q_assign] + 0.3 * rng.standard_normal((n_queries, dim))
+    ).astype(np.float32)
+
+    exact = DeviceKnnIndex(dim=dim, metric="cos", capacity=n)
+    for i in range(n):
+        exact.upsert(i, corpus[i])
+    exact._apply_staged()
+
+    lsh = LshKnnIndex(dim=dim, metric="cos", capacity=n)
+    for i in range(n):
+        lsh.add(i, corpus[i], None)
+
+    def timed(fn, reps=3):
+        fn()  # warmup/compile
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            times.append(time.perf_counter() - t0)
+        return out, sorted(times)[len(times) // 2]
+
+    exact_res, exact_t = timed(lambda: exact.search(queries, k))
+    lsh_res, lsh_t = timed(
+        lambda: lsh.search([(q, k, None) for q in queries])
+    )
+
+    hits = total = 0
+    for qi in range(n_queries):
+        truth = {key for key, _ in exact_res[qi]}
+        got = {key for key, _ in lsh_res[qi][:k]}  # noqa: E501
+        hits += len(truth & got)
+        total += len(truth)
+    return {
+        "n": n,
+        "platform": jax.devices()[0].platform,
+        "exact_ms_per_query": round(exact_t / n_queries * 1000, 3),
+        "lsh_ms_per_query": round(lsh_t / n_queries * 1000, 3),
+        "lsh_recall_at_10": round(hits / max(total, 1), 4),
+    }
+
+
+if __name__ == "__main__":
+    sizes = [int(x) for x in sys.argv[1:]] or [10_000, 100_000]
+    for n in sizes:
+        print(json.dumps(run(n)), flush=True)

@@ -33,6 +33,13 @@ class StatsMonitor:
         self.last_time: dict[str, float] = {}
         self.current_timestamp: int = -1
         self.started_at = time.time()
+        # per-connector progress (reference: connectors/monitoring.rs
+        # ConnectorStats — messages from start / last minute / recently
+        # committed / finished flag)
+        self.connector_total: dict[str, int] = defaultdict(int)
+        self.connector_recent: dict[str, list] = defaultdict(list)
+        self.connector_last_commit: dict[str, int] = defaultdict(int)
+        self.connector_finished: dict[str, bool] = {}
 
     def record_flush(self, node_name: str, n_rows: int, elapsed_s: float) -> None:
         with self._lock:
@@ -45,8 +52,51 @@ class StatsMonitor:
         with self._lock:
             self.current_timestamp = timestamp
 
-    def snapshot(self) -> dict[str, Any]:
+    def record_connector_commit(self, name: str, n_messages: int) -> None:
+        """One committed micro-batch of ``n_messages`` from connector
+        ``name`` (reference: ConnectorMonitor::increment + on_commit)."""
+        now = time.time()
         with self._lock:
+            self.connector_total[name] += n_messages
+            recent = self.connector_recent[name]
+            recent.append((now, n_messages))
+            cutoff = now - 60.0
+            while recent and recent[0][0] < cutoff:
+                recent.pop(0)
+            self.connector_last_commit[name] = n_messages
+            self.connector_finished.setdefault(name, False)
+
+    def record_connector_finished(self, name: str) -> None:
+        with self._lock:
+            self.connector_finished[name] = True
+
+    def _connector_stats_locked(self, name: str, now: float) -> dict[str, Any]:
+        """reference: ConnectorStats fields.  Caller holds the lock."""
+        recent = [
+            n for t, n in self.connector_recent.get(name, []) if t >= now - 60.0
+        ]
+        return {
+            "num_messages_from_start": self.connector_total.get(name, 0),
+            "num_messages_in_last_minute": sum(recent),
+            "num_messages_recently_committed": self.connector_last_commit.get(
+                name, 0
+            ),
+            "finished": self.connector_finished.get(name, False),
+        }
+
+    def connector_stats(self, name: str) -> dict[str, Any]:
+        with self._lock:
+            return self._connector_stats_locked(name, time.time())
+
+    def snapshot(self) -> dict[str, Any]:
+        now = time.time()
+        with self._lock:
+            # union: a source that finished without ever committing a
+            # message must still appear (finished=True, zero counts)
+            names = set(self.connector_total) | set(self.connector_finished)
+            connectors = {
+                name: self._connector_stats_locked(name, now) for name in names
+            }
             return {
                 "uptime_s": time.time() - self.started_at,
                 "timestamp": self.current_timestamp,
@@ -58,6 +108,7 @@ class StatsMonitor:
                     }
                     for name in self.rows
                 },
+                "connectors": connectors,
             }
 
     # -- OpenMetrics rendering (reference: http_server.rs:25
@@ -81,6 +132,20 @@ class StatsMonitor:
             safe = name.replace('"', "")
             lines.append(
                 f'pathway_operator_busy_seconds{{operator="{safe}"}} {st["busy_s"]}'
+            )
+        lines.append("# TYPE pathway_connector_messages_total counter")
+        for name, st in snap.get("connectors", {}).items():
+            safe = name.replace('"', "")
+            lines.append(
+                f'pathway_connector_messages_total{{connector="{safe}"}} '
+                f'{st["num_messages_from_start"]}'
+            )
+        lines.append("# TYPE pathway_connector_finished gauge")
+        for name, st in snap.get("connectors", {}).items():
+            safe = name.replace('"', "")
+            lines.append(
+                f'pathway_connector_finished{{connector="{safe}"}} '
+                f'{1 if st["finished"] else 0}'
             )
         lines.append("# EOF")
         return "\n".join(lines) + "\n"

@@ -373,7 +373,11 @@ class StreamingDriver:
                 if entries:
                     src.push(t, entries)
                     self._write_snapshot(subject, entries)
+                    self._record_connector(subject, len(entries))
                     pushed = True
+            # a finite source next to an unbounded one must report finished
+            # while the run continues (reference: ConnectorMonitor finish)
+            self._record_finished_connectors()
             if pushed:
                 self.engine.step(t)
                 t += 1
@@ -385,17 +389,36 @@ class StreamingDriver:
                     if entries:
                         src.push(t, entries)
                         self._write_snapshot(subject, entries)
+                        self._record_connector(subject, len(entries))
                         pushed = True
                 if pushed:
                     self.engine.step(t)
                     t += 1
                 break
+        self._record_finished_connectors()
         self.engine.finish()
 
     def _write_snapshot(self, subject: ConnectorSubject, entries: list[Entry]) -> None:
         writer = self._snapshot_writers.get(id(subject))
         if writer is not None:
             writer.write_batch(entries, subject.current_offsets())
+
+    # -- per-connector progress (reference: connectors/monitoring.rs) --
+    def _connector_label(self, subject: ConnectorSubject) -> str:
+        idx = self._pid_occurrence.get(id(subject), 0)
+        return f"{subject._datasource_name}-{idx}"
+
+    def _record_connector(self, subject: ConnectorSubject, n: int) -> None:
+        monitor = getattr(self.engine, "monitor", None)
+        if monitor is not None:
+            monitor.record_connector_commit(self._connector_label(subject), n)
+
+    def _record_finished_connectors(self) -> None:
+        monitor = getattr(self.engine, "monitor", None)
+        if monitor is not None:
+            for subject, _src in self.subject_src:
+                if subject._closed.is_set():
+                    monitor.record_connector_finished(self._connector_label(subject))
 
     def _start_connector_threads(self, data_event=None) -> list:
         threads = []
@@ -471,6 +494,7 @@ class StreamingDriver:
                 if entries:
                     src.push(t, entries)
                     self._write_snapshot(subject, entries)
+                    self._record_connector(subject, len(entries))
             # control barrier: carries this process's end-of-stream flag;
             # every process sees the same flag set for round t, so all exit
             # after stepping the same final round
@@ -483,5 +507,6 @@ class StreamingDriver:
             if done and all(f for f in peer_flags):
                 break
             t += 1
+        self._record_finished_connectors()
         self.engine.finish()
         plane.close()

@@ -33,6 +33,14 @@ __all__ = ["DeviceKnnIndex"]
 class DeviceKnnIndex:
     """Single-device incremental KNN index."""
 
+    #: dead-slot fraction beyond which the matrix is rebuilt smaller —
+    #: a churny corpus (steady upsert+delete) keeps matmul cost bounded at
+    #: O(live) instead of paying for every slot it ever touched (the
+    #: reference's HNSW actually removes points, usearch_integration.rs:60-90;
+    #: brute-force here compacts instead)
+    COMPACT_DEAD_FRACTION = 0.75
+    MIN_CAPACITY = 8
+
     def __init__(
         self,
         dim: int,
@@ -45,7 +53,7 @@ class DeviceKnnIndex:
         self.dim = dim
         self.metric = metric
         self.dtype = dtype
-        self.capacity = max(int(capacity), 8)
+        self.capacity = self._round_capacity(int(capacity))
         self.vectors = jnp.zeros((self.capacity, dim), dtype=dtype)
         self.valid = jnp.zeros((self.capacity,), dtype=bool)
         self.key_of_slot: list[Hashable | None] = [None] * self.capacity
@@ -57,6 +65,21 @@ class DeviceKnnIndex:
         # scatter fns — subclasses swap in sharding-preserving variants
         self._scatter_rows_fn = _scatter_rows
         self._scatter_mask_fn = _scatter_mask
+
+    def _round_capacity(self, capacity: int) -> int:
+        """Capacities at/above the Pallas threshold are kept at multiples
+        of its 1024-row tile so every large index takes the tiled path
+        (doubling preserves the invariant)."""
+        from .topk import PALLAS_MIN_ROWS
+
+        capacity = max(capacity, self.MIN_CAPACITY)
+        if capacity >= PALLAS_MIN_ROWS and capacity % 1024:
+            capacity += 1024 - capacity % 1024
+        return capacity
+
+    def _place(self) -> None:
+        """Re-establish array placement after a rebuild (sharded subclasses
+        re-pin to the mesh)."""
 
     def __len__(self) -> int:
         return len(self.slot_of_key)
@@ -94,16 +117,55 @@ class DeviceKnnIndex:
     def _grow(self) -> None:
         """Double capacity (reference: brute_force add :113-120)."""
         old = self.capacity
-        self.capacity = old * 2
+        self.capacity = self._round_capacity(old * 2)
+        extra = self.capacity - old
         self.vectors = jnp.concatenate(
-            [self.vectors, jnp.zeros((old, self.dim), dtype=self.dtype)]
+            [self.vectors, jnp.zeros((extra, self.dim), dtype=self.dtype)]
         )
-        self.valid = jnp.concatenate([self.valid, jnp.zeros((old,), dtype=bool)])
-        self.key_of_slot.extend([None] * old)
+        self.valid = jnp.concatenate([self.valid, jnp.zeros((extra,), dtype=bool)])
+        self.key_of_slot.extend([None] * extra)
         self.free.extend(range(self.capacity - 1, old - 1, -1))
+        self._place()
+
+    def _maybe_compact(self) -> None:
+        """Shrink the matrix once dead slots dominate (amortized: a rebuild
+        moves O(live) rows and at least halves capacity, so its cost is
+        charged to the deletes that created the slack)."""
+        live = len(self.slot_of_key)
+        if self.capacity <= self.MIN_CAPACITY:
+            return
+        if live > self.capacity * (1.0 - self.COMPACT_DEAD_FRACTION):
+            return
+        new_capacity = self._round_capacity(max(2 * live, self.MIN_CAPACITY))
+        if new_capacity >= self.capacity:
+            return
+        live_slots = sorted(self.slot_of_key.values())
+        idx = jnp.asarray(np.asarray(live_slots, dtype=np.int32))
+        gathered = self.vectors[idx] if live_slots else jnp.zeros(
+            (0, self.dim), dtype=self.dtype
+        )
+        pad = new_capacity - len(live_slots)
+        self.vectors = jnp.concatenate(
+            [gathered, jnp.zeros((pad, self.dim), dtype=self.dtype)]
+        )
+        self.valid = jnp.concatenate(
+            [
+                jnp.ones((len(live_slots),), dtype=bool),
+                jnp.zeros((pad,), dtype=bool),
+            ]
+        )
+        remap = {old: new for new, old in enumerate(live_slots)}
+        self.slot_of_key = {k: remap[s] for k, s in self.slot_of_key.items()}
+        self.key_of_slot = [None] * new_capacity
+        for key, slot in self.slot_of_key.items():
+            self.key_of_slot[slot] = key
+        self.capacity = new_capacity
+        self.free = list(range(new_capacity - 1, len(live_slots) - 1, -1))
+        self._place()
 
     def _apply_staged(self) -> None:
         if not self._staged_set and not self._staged_valid:
+            self._maybe_compact()
             return
         if self._staged_set:
             idx = np.fromiter(self._staged_set.keys(), dtype=np.int32)
@@ -119,6 +181,7 @@ class DeviceKnnIndex:
             )
         self._staged_set.clear()
         self._staged_valid.clear()
+        self._maybe_compact()
 
     # -- search --
     def search_among(

@@ -94,16 +94,10 @@ class ShardedKnnIndex(DeviceKnnIndex):
     ):
         self.mesh = mesh
         self.n_shards = mesh.shape[data_axis]
-        capacity = max(int(capacity), 8 * self.n_shards)
-        # keep capacity divisible by the shard count through every doubling
-        rem = capacity % self.n_shards
-        if rem:
-            capacity += self.n_shards - rem
-        super().__init__(dim, metric=metric, capacity=capacity, dtype=dtype)
+        super().__init__(dim, metric=metric, capacity=int(capacity), dtype=dtype)
         self._vec_sharding = NamedSharding(mesh, P(data_axis, None))
         self._mask_sharding = NamedSharding(mesh, P(data_axis))
-        self.vectors = jax.device_put(self.vectors, self._vec_sharding)
-        self.valid = jax.device_put(self.valid, self._mask_sharding)
+        self._place()
         self._scatter_rows_fn = jax.jit(
             lambda m, i, v: m.at[i].set(v), out_shardings=self._vec_sharding
         )
@@ -111,10 +105,22 @@ class ShardedKnnIndex(DeviceKnnIndex):
             lambda m, i, v: m.at[i].set(v), out_shardings=self._mask_sharding
         )
 
-    def _grow(self) -> None:
-        super()._grow()
-        self.vectors = jax.device_put(self.vectors, self._vec_sharding)
-        self.valid = jax.device_put(self.valid, self._mask_sharding)
+    def _round_capacity(self, capacity: int) -> int:
+        """Also keep capacity divisible by the shard count through every
+        doubling/compaction so row-sharding stays balanced."""
+        capacity = super()._round_capacity(max(capacity, 8 * self.n_shards))
+        rem = capacity % self.n_shards
+        if rem:
+            capacity += self.n_shards - rem
+        return capacity
+
+    def _place(self) -> None:
+        # __init__ ordering: the base constructor builds the arrays before
+        # the shardings exist; the explicit _place() call after they do
+        # pins both arrays to the mesh
+        if hasattr(self, "_vec_sharding"):
+            self.vectors = jax.device_put(self.vectors, self._vec_sharding)
+            self.valid = jax.device_put(self.valid, self._mask_sharding)
 
     def _device_search(self, q: np.ndarray, k: int):
         n_local = self.capacity // self.n_shards

@@ -134,6 +134,114 @@ class MemoryKV(KVStorage):
             return sorted(k for k in self._store if k.startswith(prefix))
 
 
+class S3KV(KVStorage):
+    """Object-store KV over a boto3-style S3 client (reference:
+    src/persistence/backends/s3.rs — put_object/get_object/delete_object/
+    list_objects under one key prefix).  The client is injectable so tests
+    (and minio/moto deployments) can supply their own."""
+
+    def __init__(self, client: Any, bucket: str, prefix: str = ""):
+        self.client = client
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    @staticmethod
+    def _is_missing(exc: Exception) -> bool:
+        name = type(exc).__name__
+        if name in ("NoSuchKey", "NoSuchBucket", "KeyError", "FileNotFoundError"):
+            return True
+        code = getattr(exc, "response", {}) or {}
+        code = code.get("Error", {}).get("Code") if isinstance(code, dict) else None
+        return code in ("NoSuchKey", "404", "NotFound")
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            obj = self.client.get_object(Bucket=self.bucket, Key=self._key(key))
+        except Exception as exc:  # noqa: BLE001 — classify boto3 error codes
+            if self._is_missing(exc):
+                return None
+            raise
+        body = obj["Body"]
+        return body.read() if hasattr(body, "read") else body
+
+    def put(self, key: str, value: bytes) -> None:
+        self.client.put_object(Bucket=self.bucket, Key=self._key(key), Body=value)
+
+    def remove(self, key: str) -> None:
+        try:
+            self.client.delete_object(Bucket=self.bucket, Key=self._key(key))
+        except Exception as exc:  # noqa: BLE001
+            if not self._is_missing(exc):
+                raise
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        full = self._key(prefix)
+        out: list[str] = []
+        token: str | None = None
+        while True:
+            kwargs = dict(Bucket=self.bucket, Prefix=full)
+            if token:
+                kwargs["ContinuationToken"] = token
+            resp = self.client.list_objects_v2(**kwargs)
+            for item in resp.get("Contents", []):
+                key = item["Key"]
+                if self.prefix:
+                    key = key[len(self.prefix) + 1 :]
+                out.append(key)
+            if not resp.get("IsTruncated"):
+                break
+            token = resp.get("NextContinuationToken")
+        return sorted(out)
+
+
+class AzureBlobKV(KVStorage):
+    """KV over an azure-storage-blob ContainerClient (reference:
+    persistence/__init__.py azure backend); client injectable for tests."""
+
+    def __init__(self, container_client: Any, prefix: str = ""):
+        self.container = container_client
+        self.prefix = prefix.strip("/")
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    @staticmethod
+    def _is_missing(exc: Exception) -> bool:
+        # a transient network/auth failure must NOT look like a missing
+        # blob — that would silently restart recovery from scratch
+        if type(exc).__name__ in ("ResourceNotFoundError", "FileNotFoundError"):
+            return True
+        return getattr(exc, "status_code", None) == 404
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            return self.container.download_blob(self._key(key)).readall()
+        except Exception as exc:  # noqa: BLE001 — classify Azure error kinds
+            if self._is_missing(exc):
+                return None
+            raise
+
+    def put(self, key: str, value: bytes) -> None:
+        self.container.upload_blob(self._key(key), value, overwrite=True)
+
+    def remove(self, key: str) -> None:
+        try:
+            self.container.delete_blob(self._key(key))
+        except Exception as exc:  # noqa: BLE001
+            if not self._is_missing(exc):
+                raise
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        full = self._key(prefix)
+        names = [b.name for b in self.container.list_blobs(name_starts_with=full)]
+        if self.prefix:
+            names = [n[len(self.prefix) + 1 :] for n in names]
+        return sorted(names)
+
+
 class Backend:
     """Factory wrapper (reference: persistence/__init__.py:13)."""
 
@@ -155,18 +263,48 @@ class Backend:
         return cls(MemoryKV())
 
     @classmethod
-    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
-        try:
-            import boto3  # noqa: F401 — optional dependency
-        except ImportError as exc:
-            raise ImportError(
-                "S3 persistence backend requires boto3; use Backend.filesystem"
-            ) from exc
-        raise NotImplementedError("S3 backend: boto3 client wiring pending")
+    def s3(
+        cls,
+        root_path: str,
+        bucket_settings: Any = None,
+        *,
+        client: Any = None,
+    ) -> "Backend":
+        """``root_path`` is ``s3://bucket/prefix`` or a bare prefix when
+        ``bucket_settings``/``client`` carries the bucket (reference:
+        persistence/__init__.py:40-66 Backend.s3 + backends/s3.rs).
+        Pass ``client`` to inject a boto3-compatible client (minio, moto)."""
+        bucket = None
+        prefix = root_path or ""
+        if prefix.startswith("s3://"):
+            rest = prefix[len("s3://"):]
+            bucket, _, prefix = rest.partition("/")
+        if bucket_settings is not None:
+            bucket = getattr(bucket_settings, "bucket_name", None) or bucket
+            if client is None and hasattr(bucket_settings, "client"):
+                client = bucket_settings.client()
+        if client is None:
+            try:
+                import boto3
+            except ImportError as exc:
+                raise ImportError(
+                    "S3 persistence backend requires boto3 (or pass client=)"
+                ) from exc
+            client = boto3.client("s3")
+        if not bucket:
+            raise ValueError("S3 backend: bucket name missing (s3://bucket/... )")
+        return cls(S3KV(client, bucket, prefix))
 
     @classmethod
-    def azure(cls, *args, **kwargs) -> "Backend":
-        raise NotImplementedError("Azure persistence backend is not available")
+    def azure(
+        cls, root_path: str = "", *, container_client: Any = None, **kwargs
+    ) -> "Backend":
+        if container_client is None:
+            raise ImportError(
+                "Azure persistence backend requires an azure-storage-blob "
+                "ContainerClient (pass container_client=)"
+            )
+        return cls(AzureBlobKV(container_client, root_path))
 
     @property
     def storage(self) -> KVStorage:

@@ -33,9 +33,37 @@ def sort(table: Table, key=None, instance=None) -> Table:
     return Table._new(op, schema, table._universe)
 
 
-def retrieve_prev_next_values(ordered_table: Table, value=None) -> Table:
-    """reference: sorting.py retrieve_prev_next_values — for each row, the
-    nearest non-None value looking backward/forward along the ordering."""
-    raise NotImplementedError(
-        "retrieve_prev_next_values lands with the statistical interpolate pass"
+def _retrieving_prev_next_value(tab: Table) -> Table:
+    import pathway_tpu as pw
+
+    return tab.with_columns(
+        prev_value=pw.coalesce(
+            pw.this.prev_value,
+            tab.ix(pw.this.prev, optional=True, context=tab).prev_value,
+        ),
+        next_value=pw.coalesce(
+            pw.this.next_value,
+            tab.ix(pw.this.next, optional=True, context=tab).next_value,
+        ),
     )
+
+
+def retrieve_prev_next_values(ordered_table: Table, value=None) -> Table:
+    """For each row of a prev/next-linked ordering, the id of the nearest
+    row (backward via ``prev_value``, forward via ``next_value``) holding a
+    non-None value — a pointer-chasing fixpoint, exactly the reference's
+    ``pw.iterate`` formulation (sorting.py:195-230)."""
+    import pathway_tpu as pw
+
+    if value is None:
+        value = ordered_table.value
+    else:
+        value = ordered_table[value.name if hasattr(value, "name") else value]
+
+    tab = ordered_table.select(pw.this.prev, pw.this.next, value=value)
+    tab = tab.with_columns(
+        prev_value=pw.require(pw.this.id, pw.this.value),
+        next_value=pw.require(pw.this.id, pw.this.value),
+    )
+    result = pw.iterate(_retrieving_prev_next_value, tab=tab)
+    return result[["prev_value", "next_value"]]

@@ -25,13 +25,63 @@ def unpack_col(column: ColumnReference, *unpacked_columns, schema: SchemaMetacla
     return table._select_exprs(exprs, universe=table._universe)
 
 
-def apply_all_rows(*cols, fun, result_col_name: str) -> Table:
-    """Apply ``fun`` over entire columns at once (reference: col.py)."""
-    raise NotImplementedError("apply_all_rows lands with batched-UDF support")
-
-
 def multiapply_all_rows(*cols, fun, result_col_names) -> Table:
-    raise NotImplementedError("multiapply_all_rows lands with batched-UDF support")
+    """Apply ``fun`` to all the data of the selected columns at once,
+    returning several output columns re-keyed by the original row ids
+    (reference: col.py:211-274 — gather whole columns into one group,
+    apply, scatter back).  Meant for infrequent runs on small tables."""
+    import pathway_tpu as pw
+
+    assert len(cols) > 0
+    table = cols[0].table
+    names = [c if isinstance(c, str) else c.name for c in result_col_names]
+
+    packed = table.select(
+        __one__=0,
+        __rid__=pw.this.id,
+        __vals__=pw.make_tuple(*cols),
+    )
+
+    def compute(rows):
+        rows = list(rows)
+        ids = [r[0] for r in rows]
+        col_lists = [list(c) for c in zip(*(r[1] for r in rows))] or [
+            [] for _ in cols
+        ]
+        outs = fun(*col_lists)
+        return tuple(
+            (rid,) + tuple(out[i] for out in outs) for i, rid in enumerate(ids)
+        )
+
+    grouped = packed.groupby(packed["__one__"]).reduce(
+        __rows__=pw.apply_with_type(
+            compute,
+            tuple,
+            # sorted for a deterministic id<->value pairing across recomputes
+            pw.reducers.sorted_tuple(
+                pw.make_tuple(packed["__rid__"], packed["__vals__"])
+            ),
+        ),
+    )
+    flat = grouped.flatten(grouped["__rows__"])
+    exprs = {"__rid__": flat["__rows__"].get(0)}
+    for i, n in enumerate(names):
+        exprs[n] = flat["__rows__"].get(i + 1)
+    out = flat._select_exprs(exprs, universe=flat._universe)
+    out = out.with_id(out["__rid__"])
+    return out[names]
+
+
+def apply_all_rows(*cols, fun, result_col_name) -> Table:
+    """Single-output-column variant of :func:`multiapply_all_rows`
+    (reference: col.py:276-318)."""
+
+    def fun_wrapped(*col_lists):
+        return (fun(*col_lists),)
+
+    return multiapply_all_rows(
+        *cols, fun=fun_wrapped, result_col_names=[result_col_name]
+    )
 
 
 def flatten_column(column: ColumnReference, origin_id: str = "origin_id") -> Table:

@@ -173,3 +173,108 @@ def test_two_process_static_update_stream(tmp_path):
     # the global sum lives on whichever process owns the reduce group
     totals = [s.get("s") for s in (shard0, shard1) if s]
     assert totals == [6]
+
+
+# ---------------------------------------------------------------------------
+# persistence × multi-process (VERDICT r1 gap #6): sudden-death restart
+# with the same process count recovers globally — per-process snapshot
+# keyspaces replay each shard without duplication (reference: worker-keyed
+# snapshots, src/persistence/input_snapshot.rs:56-283)
+# ---------------------------------------------------------------------------
+
+_PERSISTENT_WORDCOUNT = r"""
+import json, os, sys, threading, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+input_dir, pstore, out_path = sys.argv[1:4]
+
+t = pw.io.fs.read(input_dir, format="plaintext", mode="streaming",
+                  refresh_interval=0.1, persistent_id="wordsrc")
+words = t.select(w=pw.apply(lambda line: line.split(), t.data)).flatten(pw.this.w)
+counts = words.groupby(words.w).reduce(words.w, c=pw.reducers.count())
+
+state = {}
+last_change = [time.monotonic()]
+def on_change(key, row, time_, add):
+    if add:
+        state[row["w"]] = row["c"]
+    elif state.get(row["w"]) == row["c"]:
+        del state[row["w"]]
+    last_change[0] = time.monotonic()
+
+pw.io.subscribe(counts, on_change=on_change)
+
+cfg = pw.persistence.Config(pw.persistence.Backend.filesystem(pstore))
+th = threading.Thread(target=lambda: pw.run(persistence_config=cfg), daemon=True)
+th.start()
+
+# exit suddenly once this shard has settled (quiescent for 4s after first data)
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    if state and time.monotonic() - last_change[0] > 4.0:
+        break
+    time.sleep(0.1)
+with open(out_path, "w") as f:
+    json.dump(state, f)
+os._exit(9)
+"""
+
+
+def test_two_process_kill_restart_recovery(tmp_path):
+    input_dir = tmp_path / "in"
+    input_dir.mkdir()
+    (input_dir / "a.txt").write_text(
+        "apple banana apple\ncherry apple date\napple cherry\n"
+        "banana banana\ncherry apple\napple date\n"
+    )
+    pstore = tmp_path / "pstore"
+    prog = tmp_path / "prog.py"
+    prog.write_text(_PERSISTENT_WORDCOUNT)
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+
+    def launch(round_tag):
+        port = _free_port_block()
+        procs = []
+        for pid in range(2):
+            env = dict(os.environ)
+            env.update(
+                PYTHONPATH=repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+                JAX_PLATFORMS="cpu",
+                PATHWAY_PROCESSES="2",
+                PATHWAY_PROCESS_ID=str(pid),
+                PATHWAY_FIRST_PORT=str(port),
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(prog), str(input_dir),
+                     str(pstore), str(tmp_path / f"{round_tag}-out{pid}.json")],
+                    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True,
+                )
+            )
+        outs = []
+        for p in procs:
+            _, err = p.communicate(timeout=120)
+            assert p.returncode == 9, err[-3000:]
+        for pid in range(2):
+            outs.append(json.loads(
+                (tmp_path / f"{round_tag}-out{pid}.json").read_text()))
+        return outs
+
+    s0, s1 = launch("r1")
+    assert not (set(s0) & set(s1))
+    assert {**s0, **s1} == {"apple": 6, "banana": 3, "cherry": 3, "date": 2}
+    # per-process snapshot keyspaces exist
+    from pathway_tpu.persistence import Backend
+    keys = Backend.filesystem(str(pstore)).storage.list_keys()
+    assert any("-p0" in k for k in keys), keys
+    assert any("-p1" in k for k in keys), keys
+
+    # restart with one more file: replayed shards + new data, no doubling
+    (input_dir / "b.txt").write_text("banana elder")
+    s0b, s1b = launch("r2")
+    assert not (set(s0b) & set(s1b))
+    assert {**s0b, **s1b} == {
+        "apple": 6, "banana": 4, "cherry": 3, "date": 2, "elder": 1,
+    }

@@ -164,3 +164,174 @@ def test_kill_restart_recovery(tmp_path):
     (input_dir / "b.txt").write_text("banana cherry")
     second = run("out2.json", 5)
     assert second == {"apple": 2, "banana": 2, "cherry": 1}
+
+
+# ---------------------------------------------------------------------------
+# S3 persistence backend (VERDICT r1 gap #5) — boto3-style client injected;
+# reference: src/persistence/backends/s3.rs, python persistence Backend.s3
+# ---------------------------------------------------------------------------
+
+
+class _FakeS3Client:
+    """Minimal boto3-compatible S3 client over a local directory (survives
+    process restarts, like minio would)."""
+
+    def __init__(self, root):
+        import pathlib
+
+        self.root = pathlib.Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _path(self, key):
+        from urllib.parse import quote
+
+        return self.root / quote(key, safe="")
+
+    def put_object(self, Bucket, Key, Body):
+        self._path(Key).write_bytes(Body)
+
+    def get_object(self, Bucket, Key):
+        p = self._path(Key)
+        if not p.exists():
+            raise FileNotFoundError(Key)
+        return {"Body": p.read_bytes()}
+
+    def delete_object(self, Bucket, Key):
+        p = self._path(Key)
+        if p.exists():
+            p.unlink()
+
+    def list_objects_v2(self, Bucket, Prefix="", **kw):
+        from urllib.parse import unquote
+
+        keys = sorted(
+            unquote(f.name) for f in self.root.iterdir() if f.is_file()
+        )
+        return {
+            "Contents": [{"Key": k} for k in keys if k.startswith(Prefix)],
+            "IsTruncated": False,
+        }
+
+
+def test_s3_kv_roundtrip(tmp_path):
+    backend = Backend.s3("s3://bucket/pfx", client=_FakeS3Client(tmp_path))
+    kv = backend.storage
+    assert kv.get("missing") is None
+    kv.put("snap/x/chunk-0", b"abc")
+    kv.put("snap/x/chunk-1", b"def")
+    kv.put("other", b"zzz")
+    assert kv.get("snap/x/chunk-0") == b"abc"
+    assert kv.list_keys("snap/x/") == ["snap/x/chunk-0", "snap/x/chunk-1"]
+    kv.remove("snap/x/chunk-0")
+    assert kv.get("snap/x/chunk-0") is None
+    kv.remove("snap/x/chunk-0")  # idempotent
+
+
+def test_s3_input_snapshot_roundtrip(tmp_path):
+    from pathway_tpu.persistence import InputSnapshotReader, InputSnapshotWriter
+
+    backend = Backend.s3("s3://b/root", client=_FakeS3Client(tmp_path))
+    w = InputSnapshotWriter(backend.storage, "src")
+    w.write_batch([("k1", ("a",), 1)], {"off": 1})
+    w.write_batch([("k2", ("b",), 1)], {"off": 2})
+    r = InputSnapshotReader(backend.storage, "src")
+    chunks = list(r.replay())
+    assert chunks == [[("k1", ("a",), 1)], [("k2", ("b",), 1)]]
+    assert r.last_offsets() == {"off": 2}
+    # writer restart appends after existing chunks (no clobbering)
+    w2 = InputSnapshotWriter(backend.storage, "src")
+    w2.write_batch([("k3", ("c",), 1)], {"off": 3})
+    assert len(list(InputSnapshotReader(backend.storage, "src").replay())) == 3
+
+
+_S3_WORDCOUNT_PROGRAM = r"""
+import json, os, pathlib, sys, threading, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from urllib.parse import quote, unquote
+
+input_dir, s3_dir, out_path, expected_total = sys.argv[1:5]
+
+
+class FakeS3:
+    def __init__(self, root):
+        self.root = pathlib.Path(root); self.root.mkdir(parents=True, exist_ok=True)
+    def _p(self, k):
+        return self.root / quote(k, safe="")
+    def put_object(self, Bucket, Key, Body):
+        self._p(Key).write_bytes(Body)
+    def get_object(self, Bucket, Key):
+        p = self._p(Key)
+        if not p.exists():
+            raise FileNotFoundError(Key)
+        return {"Body": p.read_bytes()}
+    def delete_object(self, Bucket, Key):
+        p = self._p(Key)
+        if p.exists(): p.unlink()
+    def list_objects_v2(self, Bucket, Prefix="", **kw):
+        ks = sorted(unquote(f.name) for f in self.root.iterdir() if f.is_file())
+        return {"Contents": [{"Key": k} for k in ks if k.startswith(Prefix)],
+                "IsTruncated": False}
+
+
+t = pw.io.fs.read(input_dir, format="plaintext", mode="streaming",
+                  refresh_interval=0.1, persistent_id="wordsrc")
+words = t.select(w=pw.apply(lambda line: line.split(), t.data)).flatten(pw.this.w)
+counts = words.groupby(words.w).reduce(words.w, c=pw.reducers.count())
+
+state = {}
+def on_change(key, row, time_, is_addition):
+    if is_addition:
+        state[row["w"]] = row["c"]
+    elif state.get(row["w"]) == row["c"]:
+        del state[row["w"]]
+
+pw.io.subscribe(counts, on_change=on_change)
+
+cfg = pw.persistence.Config(
+    pw.persistence.Backend.s3("s3://bkt/app", client=FakeS3(s3_dir)))
+th = threading.Thread(target=lambda: pw.run(persistence_config=cfg), daemon=True)
+th.start()
+
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    if sum(state.values()) >= int(expected_total):
+        break
+    time.sleep(0.1)
+with open(out_path, "w") as f:
+    json.dump(state, f)
+os._exit(9)
+"""
+
+
+def test_kill_restart_recovery_s3_backend(tmp_path):
+    import pathlib
+    import subprocess
+    import sys as _sys
+
+    input_dir = tmp_path / "in"
+    input_dir.mkdir()
+    s3_dir = tmp_path / "fake-s3"
+    program = tmp_path / "prog.py"
+    program.write_text(_S3_WORDCOUNT_PROGRAM)
+    (input_dir / "a.txt").write_text("apple banana apple")
+
+    def run(out_name, expected_total):
+        out = tmp_path / out_name
+        env = dict(os.environ)
+        repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [_sys.executable, str(program), str(input_dir), str(s3_dir),
+             str(out), str(expected_total)],
+            timeout=120, capture_output=True, text=True, env=env,
+        )
+        assert proc.returncode == 9, proc.stderr[-2000:]
+        return json.loads(out.read_text())
+
+    first = run("out1.json", 3)
+    assert first == {"apple": 2, "banana": 1}
+    (input_dir / "b.txt").write_text("banana cherry")
+    second = run("out2.json", 5)
+    # replay through the object store: apple stays 2 (no re-read)
+    assert second == {"apple": 2, "banana": 2, "cherry": 1}

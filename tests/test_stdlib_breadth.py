@@ -188,3 +188,133 @@ def test_fuzzy_self_match():
     _, cols = dbg.table_to_dicts(matches)
     assert len(cols["weight"]) >= 1
     assert all(w > 0.1 for w in cols["weight"].values())
+
+
+# ---------------------------------------------------------------------------
+# stubs filled in round 2 (VERDICT gap #7): retrieve_prev_next_values,
+# apply_all_rows/multiapply_all_rows, per-connector monitoring
+# ---------------------------------------------------------------------------
+
+
+def test_apply_all_rows_matches_reference_doctest():
+    t = dbg.table_from_markdown(
+        """
+          | colA | colB
+        1 | 1    | 10
+        2 | 2    | 20
+        3 | 3    | 30
+        """
+    )
+
+    def add_total_sum(col1, col2):
+        s = sum(col1) + sum(col2)
+        return [x + s for x in col1]
+
+    from pathway_tpu.stdlib.utils import col as col_utils
+
+    res = col_utils.apply_all_rows(
+        t.colA, t.colB, fun=add_total_sum, result_col_name="res"
+    )
+    _, c = dbg.table_to_dicts(res)
+    assert sorted(c["res"].values()) == [67, 68, 69]
+    # re-keyed by the original row ids
+    _, tc = dbg.table_to_dicts(t)
+    assert set(c["res"]) == set(tc["colA"])
+
+
+def test_multiapply_all_rows_matches_reference_doctest():
+    t = dbg.table_from_markdown(
+        """
+        colA | colB
+        1    | 10
+        2    | 20
+        3    | 30
+        """
+    )
+
+    def add_total_sum(col1, col2):
+        s = sum(col1) + sum(col2)
+        return [x + s for x in col1], [x + s for x in col2]
+
+    from pathway_tpu.stdlib.utils import col as col_utils
+
+    res = col_utils.multiapply_all_rows(
+        t.colA, t.colB, fun=add_total_sum, result_col_names=["r1", "r2"]
+    )
+    _, c = dbg.table_to_dicts(res)
+    assert sorted(c["r1"].values()) == [67, 68, 69]
+    assert sorted(c["r2"].values()) == [76, 86, 96]
+
+
+def test_retrieve_prev_next_values():
+    t = dbg.table_from_markdown(
+        """
+        t | value
+        1 | 10
+        2 |
+        3 |
+        4 | 40
+        """
+    )
+    from pathway_tpu.stdlib.indexing.sorting import (
+        retrieve_prev_next_values,
+        sort,
+    )
+
+    s = sort(t, key=t.t)
+    ordered = t.with_universe_of(s).select(value=t.value, prev=s.prev, next=s.next)
+    r = retrieve_prev_next_values(ordered)
+    _, rc = dbg.table_to_dicts(r)
+    _, tcols = dbg.table_to_dicts(t)
+    val, tv = tcols["value"], tcols["t"]
+
+    def deref(p):
+        return None if p is None else val.get(p)
+
+    out = {
+        tv[k]: (deref(rc["prev_value"].get(k)), deref(rc["next_value"].get(k)))
+        for k in tv
+    }
+    # rows with a None value point to the nearest non-None neighbours
+    assert out[2] == (10, 40)
+    assert out[3] == (10, 40)
+    assert out[1] == (10, 10)
+    assert out[4] == (40, 40)
+
+
+def test_connector_monitoring_entries():
+    # reference: connectors/monitoring.rs ConnectorStats — per-connector
+    # message counts + finished flag surfaced through StatsMonitor
+    import pathway_tpu.io as io
+    from pathway_tpu.internals.graph import G
+    from pathway_tpu.internals.monitoring import StatsMonitor
+    from pathway_tpu.internals.runtime import GraphRunner
+    from pathway_tpu.io.streaming import StreamingDriver
+
+    class Src(io.python.ConnectorSubject):
+        def run(self):
+            for i in range(5):
+                self.next(v=i)
+            self.commit()
+
+    class S(pw.Schema):
+        v: int
+
+    t = io.python.read(Src(), schema=S)
+    seen = []
+    io.subscribe(t, on_change=lambda *a, **kw: seen.append(1))
+
+    runner = GraphRunner()
+    engine = runner.build([(table, node) for table, node in G.sinks])
+    engine.monitor = StatsMonitor()
+    StreamingDriver(engine, runner).run()
+
+    assert len(seen) == 5
+    stats = engine.monitor.connector_stats("python-0")
+    assert stats["num_messages_from_start"] == 5
+    assert stats["num_messages_in_last_minute"] == 5
+    assert stats["finished"] is True
+    # and the OpenMetrics rendering carries the connector series
+    metrics = engine.monitor.openmetrics()
+    assert 'pathway_connector_messages_total{connector="python-0"} 5' in metrics
+    assert 'pathway_connector_finished{connector="python-0"} 1' in metrics
