@@ -36,6 +36,11 @@ class PathwayConfig:
     processes: int = 1
     process_id: int = 0
     first_port: int = 10000
+    #: multi-host cluster address list "host:port,host:port,..." — one entry
+    #: per process in id order (timely Cluster hostfile,
+    #: reference src/engine/dataflow/config.rs:108-120); None = single host
+    #: at 127.0.0.1:first_port+id
+    addresses: str | None = None
     run_id: str | None = None
     persistent_storage: str | None = None
     monitoring_http_port: int | None = None
@@ -50,6 +55,7 @@ class PathwayConfig:
             processes=_env_int("PATHWAY_PROCESSES", 1),
             process_id=_env_int("PATHWAY_PROCESS_ID", 0),
             first_port=_env_int("PATHWAY_FIRST_PORT", 10000),
+            addresses=os.environ.get("PATHWAY_ADDRESSES") or None,
             run_id=os.environ.get("PATHWAY_RUN_ID"),
             persistent_storage=os.environ.get("PATHWAY_PERSISTENT_STORAGE"),
             monitoring_http_port=int(port) if port else None,

@@ -25,6 +25,7 @@ jit — see ``pathway_tpu/parallel``.
 from __future__ import annotations
 
 import hashlib
+import hmac
 import pickle
 import socket
 import struct
@@ -33,10 +34,35 @@ import time as _time
 from typing import Any, Callable
 
 from .engine import Entry, Node, consolidate, freeze_value
+from .wire import decode_frame, encode_frame
 
-__all__ = ["ExchangePlane", "ExchangeNode", "owner_of", "insert_exchanges"]
+__all__ = [
+    "ExchangePlane",
+    "ExchangeNode",
+    "owner_of",
+    "insert_exchanges",
+    "parse_addresses",
+]
 
-_HDR = struct.Struct("<I")
+_HDR = struct.Struct("<Q")
+
+_digest_eq = hmac.compare_digest
+
+
+def parse_addresses(spec: str) -> list[tuple[str, int]]:
+    """Parse a ``host:port,host:port,...`` cluster address list
+    (reference: timely ``CommunicationConfig::Cluster`` hostfile entries,
+    src/engine/dataflow/config.rs:108-120)."""
+    out: list[tuple[str, int]] = []
+    for part in spec.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        if not host:
+            raise ValueError(f"address {part!r} must be host:port")
+        out.append((host, int(port)))
+    return out
 
 
 def owner_of(value: Any, n: int) -> int:
@@ -47,16 +73,57 @@ def owner_of(value: Any, n: int) -> int:
 
 
 class ExchangePlane:
-    """TCP full mesh between the PATHWAY_PROCESSES processes on one host
-    (reference cluster addresses are 127.0.0.1:first_port+id within a
-    node, config.rs:113-116; pod DNS in k8s)."""
+    """TCP full mesh between the PATHWAY_PROCESSES processes.
+
+    Addressing: by default processes live on one host at
+    ``127.0.0.1:first_port+id`` (reference single-node cluster,
+    config.rs:113-116); pass ``addresses`` (or set ``PATHWAY_ADDRESSES``
+    to ``host:port,host:port,...``, one entry per process in id order) to
+    span hosts — the multi-host form of timely's
+    ``CommunicationConfig::Cluster`` hostfile.
+
+    Frames are the length-prefixed binary wire format of
+    :mod:`pathway_tpu.internals.wire`, not pickle.  Flow control is
+    end-to-end by protocol: every ``exchange`` is a barrier per
+    (channel, time), so a peer cannot race more than one unpopped batch
+    ahead on any (channel, sender) queue and the whole inbox is bounded
+    by the channel count of one engine round — no unbounded buffering is
+    reachable from a well-behaved peer, the role timely's progress
+    tracking plays in the reference.
+
+    Peers authenticate on connect with a magic preamble + a BLAKE2b
+    digest of ``PATHWAY_EXCHANGE_TOKEN`` (empty default).  Stray
+    connections (port scanners, wrong cluster) are dropped without
+    consuming a peer slot and without ever reaching frame decoding — set
+    the token on any shared network.
+    """
+
+    #: connection preamble: magic + sender id + token digest
+    _HELLO_MAGIC = b"PWXCHG01"
 
     def __init__(self, processes: int, process_id: int, first_port: int,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 addresses: list[tuple[str, int]] | None = None,
+                 token: str | None = None):
         self.n = processes
         self.me = process_id
         self.first_port = first_port
         self.host = host
+        if addresses is not None and len(addresses) != processes:
+            raise ValueError(
+                f"PATHWAY_ADDRESSES lists {len(addresses)} entries for "
+                f"{processes} processes"
+            )
+        self.addresses = addresses or [
+            (host, first_port + i) for i in range(processes)
+        ]
+        if token is None:
+            import os
+
+            token = os.environ.get("PATHWAY_EXCHANGE_TOKEN", "")
+        self._token_digest = hashlib.blake2b(
+            token.encode("utf-8"), digest_size=16
+        ).digest()
         self._send: dict[int, socket.socket] = {}
         self._inbox: dict[tuple, list] = {}  # (channel, time, from) -> payload
         self._cv = threading.Condition()
@@ -67,15 +134,32 @@ class ExchangePlane:
         self._server: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._closed = False
+        #: sender ids whose inbound connection dropped (peer crashed or
+        #: closed): barriers abort promptly instead of timing out
+        self._down: set[int] = set()
 
     # -- wiring --
     def start(self, timeout: float = 30.0) -> None:
-        self._server = socket.create_server(
-            (self.host, self.first_port + self.me), backlog=self.n
-        )
+        my_host, my_port = self.addresses[self.me]
+        # bind the advertised name when it resolves locally (pod DNS
+        # resolves to the pod's own ip); fall back to all interfaces only
+        # if it doesn't — never silently for loopback setups
+        try:
+            self._server = socket.create_server(
+                (my_host, my_port), backlog=self.n
+            )
+        except OSError:
+            if my_host in ("127.0.0.1", "localhost"):
+                raise
+            self._server = socket.create_server(("", my_port), backlog=self.n)
         accept_th = threading.Thread(target=self._accept_loop, daemon=True)
         accept_th.start()
         self._threads.append(accept_th)
+        hello = (
+            self._HELLO_MAGIC
+            + struct.pack("<H", self.me)
+            + self._token_digest
+        )
         deadline = _time.monotonic() + timeout
         for peer in range(self.n):
             if peer == self.me:
@@ -83,9 +167,24 @@ class ExchangePlane:
             while True:
                 try:
                     s = socket.create_connection(
-                        (self.host, self.first_port + peer), timeout=2.0
+                        self.addresses[peer], timeout=2.0
                     )
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.sendall(hello)
+                    # wait for the acceptor's 1-byte ack: a token mismatch
+                    # fails fast at startup, not as a barrier timeout later
+                    s.settimeout(5.0)
+                    ack = self._recv_exact(s, 1)
+                    s.settimeout(None)
+                    if ack != b"\x01":
+                        s.close()
+                        # deliberately not an OSError: must escape the
+                        # connect-retry loop below
+                        raise RuntimeError(
+                            f"process {self.me}: peer {peer} rejected the "
+                            "exchange handshake (PATHWAY_EXCHANGE_TOKEN "
+                            "mismatch?)"
+                        )
                     self._send[peer] = s
                     break
                 except OSError:
@@ -95,39 +194,73 @@ class ExchangePlane:
                         )
                     _time.sleep(0.1)
 
+    _HELLO_LEN = len(_HELLO_MAGIC) + 2 + 16
+
     def _accept_loop(self) -> None:
-        for _ in range(self.n - 1):
+        accepted = 0
+        while accepted < self.n - 1 and not self._closed:
             try:
                 conn, _addr = self._server.accept()
             except OSError:
                 return
+            # authenticate before this connection counts as a peer — a
+            # stray connection is closed and its slot stays available
+            try:
+                conn.settimeout(5.0)
+                hello = self._recv_exact(conn, self._HELLO_LEN)
+                conn.settimeout(None)
+            except OSError:
+                hello = None
+            magic_len = len(self._HELLO_MAGIC)
+            if (
+                hello is None
+                or hello[:magic_len] != self._HELLO_MAGIC
+                or not _digest_eq(hello[magic_len + 2 :], self._token_digest)
+            ):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            (peer_id,) = struct.unpack_from("<H", hello, magic_len)
+            try:
+                conn.sendall(b"\x01")  # handshake ack — peer fails fast if absent
+            except OSError:
+                continue
+            accepted += 1
             th = threading.Thread(
-                target=self._recv_loop, args=(conn,), daemon=True
+                target=self._recv_loop, args=(conn, peer_id), daemon=True
             )
             th.start()
             self._threads.append(th)
 
-    def _recv_loop(self, conn: socket.socket) -> None:
+    def _recv_loop(self, conn: socket.socket, peer_id: int) -> None:
         try:
             while True:
                 hdr = self._recv_exact(conn, _HDR.size)
                 if hdr is None:
-                    return
+                    break
                 (length,) = _HDR.unpack(hdr)
                 body = self._recv_exact(conn, length)
                 if body is None:
-                    return
-                channel, time, sender, entries = pickle.loads(body)
+                    break
+                channel, time, sender, entries = decode_frame(body)
                 with self._cv:
                     # a queue per key: identical schedules may exchange the
                     # same (channel, time) more than once back-to-back, and
-                    # both batches must survive until popped
+                    # both batches must survive until popped (depth stays
+                    # ≤2 by the barrier protocol — see class docstring)
                     self._inbox.setdefault((channel, time, sender), []).append(
                         entries
                     )
                     self._cv.notify_all()
         except OSError:
-            return
+            pass
+        # EOF / socket error: the peer is gone — wake any barrier blocked
+        # on it so failures abort promptly instead of timing out
+        with self._cv:
+            self._down.add(peer_id)
+            self._cv.notify_all()
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> bytes | None:
@@ -152,11 +285,11 @@ class ExchangePlane:
         for peer in range(self.n):
             if peer == self.me:
                 continue
-            payload = pickle.dumps(
-                (channel, time, self.me, outgoing.get(peer, []))
-            )
-            sock = self._send[peer]
-            sock.sendall(_HDR.pack(len(payload)) + payload)
+            payload = encode_frame(channel, time, self.me, outgoing.get(peer, []))
+            # single sender thread (engine + driver barriers share it), so
+            # no send lock: a lock shared across peer sockets would let one
+            # stalled peer's TCP window block sends to every other peer
+            self._send[peer].sendall(_HDR.pack(len(payload)) + payload)
         merged: list = []
         deadline = _time.monotonic() + self.barrier_timeout
         with self._cv:
@@ -165,6 +298,16 @@ class ExchangePlane:
                     continue
                 key = (channel, time, peer)
                 while not self._inbox.get(key):
+                    if self._closed:
+                        raise RuntimeError(
+                            f"exchange {channel}@{time}: plane closed while "
+                            f"waiting for peer {peer}"
+                        )
+                    if peer in self._down:
+                        raise ConnectionError(
+                            f"exchange {channel}@{time}: peer {peer} "
+                            "disconnected (crashed or shut down)"
+                        )
                     remaining = deadline - _time.monotonic()
                     if remaining <= 0 or not self._cv.wait(timeout=remaining):
                         raise TimeoutError(
@@ -179,6 +322,8 @@ class ExchangePlane:
 
     def close(self) -> None:
         self._closed = True
+        with self._cv:
+            self._cv.notify_all()
         for s in self._send.values():
             try:
                 s.close()

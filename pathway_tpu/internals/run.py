@@ -76,10 +76,15 @@ def run(
 
         pw_config = get_pathway_config(refresh=True)
         if pw_config.processes > 1:
-            from .exchange import ExchangePlane, insert_exchanges
+            from .exchange import ExchangePlane, insert_exchanges, parse_addresses
 
             exchange_plane = ExchangePlane(
-                pw_config.processes, pw_config.process_id, pw_config.first_port
+                pw_config.processes, pw_config.process_id, pw_config.first_port,
+                addresses=(
+                    parse_addresses(pw_config.addresses)
+                    if pw_config.addresses
+                    else None
+                ),
             )
             exchange_plane.start()
             insert_exchanges(engine, exchange_plane)

@@ -1,0 +1,365 @@
+"""Length-prefixed binary wire format for the exchange plane.
+
+reference: timely's serialized channel allocators
+(external/timely-dataflow/communication/src/allocator/zero_copy/) move
+``Message<T: Serialize>`` frames over TCP with explicit length headers —
+never Python pickle.  This module is the equivalent contract for the
+host exchange plane: a self-describing, versioned binary encoding of the
+engine value model (src/engine/value.rs:207 ``Value`` enum parity —
+see :mod:`pathway_tpu.internals.value`), with a tagged pickle escape
+hatch only for exotic UDF-produced objects.
+
+Layout of one frame body (the transport adds a ``<Q`` total-length
+prefix):
+
+    u8   version
+    u16  channel-name length | channel utf-8 bytes
+    i64  timestamp
+    u16  sender process id
+    u32  entry count
+    entries: key(u128 little) | diff(i32) | row  (row = value encoding)
+
+Value encoding is one tag byte then a tag-specific payload; containers
+nest.  Integers outside i64 use a length-prefixed big-int payload, so
+arbitrary-precision Python ints survive the trip bit-exactly.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any
+
+import numpy as np
+
+from .value import (
+    ERROR,
+    PENDING,
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+    Json,
+    Pointer,
+)
+
+__all__ = ["encode_frame", "decode_frame", "encode_value", "decode_value"]
+
+WIRE_VERSION = 1
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+# value tags
+_T_NONE = 0x00
+_T_FALSE = 0x01
+_T_TRUE = 0x02
+_T_I64 = 0x03
+_T_BIGINT = 0x04
+_T_F64 = 0x05
+_T_STR = 0x06
+_T_BYTES = 0x07
+_T_POINTER = 0x08
+_T_TUPLE = 0x09
+_T_LIST = 0x0A
+_T_DICT = 0x0B
+_T_NDARRAY = 0x0C
+_T_JSON = 0x0D
+_T_DT_NAIVE = 0x0E
+_T_DT_UTC = 0x0F
+_T_DURATION = 0x10
+_T_ERROR = 0x11
+_T_PENDING = 0x12
+_T_SET = 0x13
+_T_PICKLE = 0xFF
+
+_I64_MIN = -(1 << 63)
+_I64_MAX = (1 << 63) - 1
+
+#: per-value payload limit (u32 length fields); the frame header is u64 so
+#: a batch may exceed this, but one value may not
+_MAX_VALUE_BYTES = (1 << 32) - 1
+
+
+def _check_len(n: int, what: str) -> int:
+    if n > _MAX_VALUE_BYTES:
+        raise ValueError(
+            f"wire format: a single {what} of {n} bytes exceeds the 4 GiB "
+            "per-value limit; split the payload across rows"
+        )
+    return n
+
+
+def encode_value(v: Any, out: bytearray) -> None:
+    """Append the tagged encoding of one value to ``out``."""
+    if v is None:
+        out.append(_T_NONE)
+    elif v is ERROR:
+        out.append(_T_ERROR)
+    elif v is PENDING:
+        out.append(_T_PENDING)
+    elif isinstance(v, bool):
+        out.append(_T_TRUE if v else _T_FALSE)
+    elif isinstance(v, int):
+        if _I64_MIN <= v <= _I64_MAX:
+            out.append(_T_I64)
+            out += _I64.pack(v)
+        else:
+            raw = v.to_bytes((v.bit_length() + 8) // 8, "little", signed=True)
+            out.append(_T_BIGINT)
+            out += _U32.pack(len(raw))
+            out += raw
+    elif isinstance(v, float):
+        out.append(_T_F64)
+        out += _F64.pack(v)
+    elif isinstance(v, str):
+        raw = v.encode("utf-8")
+        out.append(_T_STR)
+        out += _U32.pack(_check_len(len(raw), "string"))
+        out += raw
+    elif isinstance(v, bytes):
+        out.append(_T_BYTES)
+        out += _U32.pack(_check_len(len(v), "bytes value"))
+        out += v
+    elif isinstance(v, Pointer):
+        out.append(_T_POINTER)
+        out += v.value.to_bytes(16, "little")
+    elif isinstance(v, tuple):
+        out.append(_T_TUPLE)
+        out += _U32.pack(len(v))
+        for item in v:
+            encode_value(item, out)
+    elif isinstance(v, list):
+        out.append(_T_LIST)
+        out += _U32.pack(len(v))
+        for item in v:
+            encode_value(item, out)
+    elif isinstance(v, dict):
+        out.append(_T_DICT)
+        out += _U32.pack(len(v))
+        for k, item in v.items():
+            encode_value(k, out)
+            encode_value(item, out)
+    elif isinstance(v, frozenset):
+        out.append(_T_SET)
+        out += _U32.pack(len(v))
+        # deterministic order so identical sets encode identically
+        for item in sorted(v, key=repr):
+            encode_value(item, out)
+    elif isinstance(v, np.ndarray):
+        if v.dtype.hasobject:
+            # object arrays hold pointers — tobytes() would serialize raw
+            # addresses; route through the tagged pickle escape hatch
+            raw = pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
+            out.append(_T_PICKLE)
+            out += _U32.pack(_check_len(len(raw), "object array"))
+            out += raw
+            return
+        data = np.ascontiguousarray(v)
+        dt = str(data.dtype).encode()
+        out.append(_T_NDARRAY)
+        out += _U16.pack(len(dt))
+        out += dt
+        out.append(data.ndim)
+        for d in data.shape:
+            out += _U32.pack(d)
+        raw = data.tobytes()
+        out += _U32.pack(_check_len(len(raw), "ndarray"))
+        out += raw
+    elif isinstance(v, Json):
+        raw = v.to_string().encode("utf-8")
+        out.append(_T_JSON)
+        out += _U32.pack(_check_len(len(raw), "json value"))
+        out += raw
+    elif isinstance(v, DateTimeNaive):
+        out.append(_T_DT_NAIVE)
+        out += v.ns.to_bytes(16, "little", signed=True)
+    elif isinstance(v, DateTimeUtc):
+        out.append(_T_DT_UTC)
+        out += v.ns.to_bytes(16, "little", signed=True)
+    elif isinstance(v, Duration):
+        out.append(_T_DURATION)
+        out += v.ns.to_bytes(16, "little", signed=True)
+    elif isinstance(v, np.integer):
+        encode_value(int(v), out)
+    elif isinstance(v, np.floating):
+        encode_value(float(v), out)
+    elif isinstance(v, np.bool_):
+        encode_value(bool(v), out)
+    else:
+        # exotic UDF output — tagged escape hatch, still length-prefixed
+        raw = pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
+        out.append(_T_PICKLE)
+        out += _U32.pack(_check_len(len(raw), "pickled value"))
+        out += raw
+
+
+def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
+    """Decode one value at ``pos``; returns (value, next_pos)."""
+    tag = buf[pos]
+    pos += 1
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_I64:
+        return _I64.unpack_from(buf, pos)[0], pos + 8
+    if tag == _T_BIGINT:
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        return int.from_bytes(buf[pos : pos + n], "little", signed=True), pos + n
+    if tag == _T_F64:
+        return _F64.unpack_from(buf, pos)[0], pos + 8
+    if tag == _T_STR:
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        return str(buf[pos : pos + n], "utf-8"), pos + n
+    if tag == _T_BYTES:
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        return bytes(buf[pos : pos + n]), pos + n
+    if tag == _T_POINTER:
+        return Pointer(int.from_bytes(buf[pos : pos + 16], "little")), pos + 16
+    if tag in (_T_TUPLE, _T_LIST, _T_SET):
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            item, pos = decode_value(buf, pos)
+            items.append(item)
+        if tag == _T_TUPLE:
+            return tuple(items), pos
+        if tag == _T_SET:
+            return frozenset(items), pos
+        return items, pos
+    if tag == _T_DICT:
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = decode_value(buf, pos)
+            v, pos = decode_value(buf, pos)
+            d[k] = v
+        return d, pos
+    if tag == _T_NDARRAY:
+        (dt_len,) = _U16.unpack_from(buf, pos)
+        pos += 2
+        dtype = np.dtype(str(buf[pos : pos + dt_len], "ascii"))
+        pos += dt_len
+        ndim = buf[pos]
+        pos += 1
+        shape = []
+        for _ in range(ndim):
+            (d,) = _U32.unpack_from(buf, pos)
+            shape.append(d)
+            pos += 4
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        arr = np.frombuffer(buf[pos : pos + n], dtype=dtype).reshape(shape).copy()
+        return arr, pos + n
+    if tag == _T_JSON:
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        return Json.parse(str(buf[pos : pos + n], "utf-8")), pos + n
+    if tag == _T_DT_NAIVE:
+        return (
+            DateTimeNaive(
+                ns=int.from_bytes(buf[pos : pos + 16], "little", signed=True)
+            ),
+            pos + 16,
+        )
+    if tag == _T_DT_UTC:
+        return (
+            DateTimeUtc(
+                ns=int.from_bytes(buf[pos : pos + 16], "little", signed=True)
+            ),
+            pos + 16,
+        )
+    if tag == _T_DURATION:
+        return (
+            Duration(int.from_bytes(buf[pos : pos + 16], "little", signed=True)),
+            pos + 16,
+        )
+    if tag == _T_ERROR:
+        return ERROR, pos
+    if tag == _T_PENDING:
+        return PENDING, pos
+    if tag == _T_PICKLE:
+        (n,) = _U32.unpack_from(buf, pos)
+        pos += 4
+        return pickle.loads(buf[pos : pos + n]), pos + n
+    raise ValueError(f"unknown wire tag 0x{tag:02x} at offset {pos - 1}")
+
+
+def encode_frame(
+    channel: str, time: int, sender: int, entries: list
+) -> bytes:
+    """Encode one exchange batch (without the transport length prefix).
+
+    Items are either engine entries ``(Pointer, row, diff)`` — the data
+    plane — or arbitrary values (the driver's control barriers exchange
+    bare flags on ``__ctl__`` channels); a per-item marker byte keeps the
+    entry fast path while letting control payloads ride the same frames.
+    """
+    out = bytearray()
+    out.append(WIRE_VERSION)
+    ch = channel.encode("utf-8")
+    out += _U16.pack(len(ch))
+    out += ch
+    out += _I64.pack(time)
+    out += _U16.pack(sender)
+    out += _U32.pack(len(entries))
+    for item in entries:
+        if (
+            isinstance(item, tuple)
+            and len(item) == 3
+            and isinstance(item[0], Pointer)
+            and isinstance(item[2], int)
+        ):
+            key, row, diff = item
+            out.append(0x01)
+            out += key.value.to_bytes(16, "little")
+            out += _I32.pack(diff)
+            encode_value(row, out)
+        else:
+            out.append(0x00)
+            encode_value(item, out)
+    return bytes(out)
+
+
+def decode_frame(body: bytes | memoryview) -> tuple[str, int, int, list[tuple]]:
+    """Decode a frame body into (channel, time, sender, entries)."""
+    buf = memoryview(body)
+    version = buf[0]
+    if version != WIRE_VERSION:
+        raise ValueError(f"wire version mismatch: got {version}, expect {WIRE_VERSION}")
+    pos = 1
+    (ch_len,) = _U16.unpack_from(buf, pos)
+    pos += 2
+    channel = str(buf[pos : pos + ch_len], "utf-8")
+    pos += ch_len
+    (time,) = _I64.unpack_from(buf, pos)
+    pos += 8
+    (sender,) = _U16.unpack_from(buf, pos)
+    pos += 2
+    (count,) = _U32.unpack_from(buf, pos)
+    pos += 4
+    entries: list = []
+    for _ in range(count):
+        marker = buf[pos]
+        pos += 1
+        if marker == 0x01:
+            key = Pointer(int.from_bytes(buf[pos : pos + 16], "little"))
+            pos += 16
+            (diff,) = _I32.unpack_from(buf, pos)
+            pos += 4
+            row, pos = decode_value(buf, pos)
+            entries.append((key, row, diff))
+        else:
+            item, pos = decode_value(buf, pos)
+            entries.append(item)
+    return channel, time, sender, entries

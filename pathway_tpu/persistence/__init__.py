@@ -150,8 +150,10 @@ class S3KV(KVStorage):
 
     @staticmethod
     def _is_missing(exc: Exception) -> bool:
-        name = type(exc).__name__
-        if name in ("NoSuchKey", "NoSuchBucket", "KeyError", "FileNotFoundError"):
+        # only a key-level absence reads as "no snapshot"; bucket
+        # misconfiguration or transient/client failures must surface, not
+        # silently recover-from-scratch (duplicating side effects)
+        if type(exc).__name__ == "NoSuchKey":
             return True
         code = getattr(exc, "response", {}) or {}
         code = code.get("Error", {}).get("Code") if isinstance(code, dict) else None

@@ -193,7 +193,10 @@ class _FakeS3Client:
     def get_object(self, Bucket, Key):
         p = self._path(Key)
         if not p.exists():
-            raise FileNotFoundError(Key)
+            # boto3 shape: ClientError carrying an Error.Code of NoSuchKey
+            err = Exception(f"NoSuchKey: {Key}")
+            err.response = {"Error": {"Code": "NoSuchKey"}}
+            raise err
         return {"Body": p.read_bytes()}
 
     def delete_object(self, Bucket, Key):
@@ -263,7 +266,9 @@ class FakeS3:
     def get_object(self, Bucket, Key):
         p = self._p(Key)
         if not p.exists():
-            raise FileNotFoundError(Key)
+            err = Exception("NoSuchKey: " + Key)
+            err.response = {"Error": {"Code": "NoSuchKey"}}
+            raise err
         return {"Body": p.read_bytes()}
     def delete_object(self, Bucket, Key):
         p = self._p(Key)
