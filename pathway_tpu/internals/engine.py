@@ -708,62 +708,113 @@ class AsyncMapNode(Node):
     internals/udfs/executors.py AsyncExecutor: capacity/timeout/retries).
 
     Results are memoized by frozen input so retractions replay identically —
-    the same contract the reference enforces for non-deterministic UDFs."""
+    the same contract the reference enforces for non-deterministic UDFs.
+
+    Batches run on the process's persistent event loop (internals/aio.py).
+    With ``pipelined=True`` (the ``fully_async`` executor contract:
+    reference python/pathway/internals/udfs/executors.py
+    ``FullyAsyncExecutor`` — results land at a *later* engine time) the
+    node is double-buffered: flush(t) dispatches batch t to the loop and
+    emits the now-resolved batch t-1, so device work for one micro-batch
+    overlaps host ingest/parse of the next — the host/device overlap a
+    TPU framework needs."""
 
     def __init__(
         self,
         async_fn: Callable,  # async (row) -> out_row
         capacity: int | None = None,
+        pipelined: bool = False,
         name: str = "async_map",
     ):
         super().__init__(n_inputs=1, name=name)
         self.async_fn = async_fn
         self.capacity = capacity
+        self.pipelined = pipelined
         self._memo: dict[tuple, tuple] = {}
+        # pipelined mode: (dispatch_time, future, frozen_keys, entries)
+        self._in_flight: list[tuple] = []
+        #: inputs dispatched but possibly unresolved — a retraction whose
+        #: addition is still in flight must NOT recompute (it could differ
+        #: for a non-deterministic fn and unpair the add/retract)
+        self._scheduled: set[tuple] = set()
+
+    def _dispatch(self, rows: list):
+        from .aio import submit
+
+        async def runner():
+            sem = asyncio.Semaphore(self.capacity) if self.capacity else None
+
+            async def one(row):
+                if sem is None:
+                    return await self.async_fn(row)
+                async with sem:
+                    return await self.async_fn(row)
+
+            return await asyncio.gather(*[one(r) for r in rows])
+
+        return submit(runner())
 
     def flush(self, time: int) -> list[Entry]:
         entries = self.take(0)
         to_compute: dict[tuple, tuple] = {}
         for key, row, diff in entries:
             fk = freeze_row(row)
-            if fk not in self._memo and fk not in to_compute:
+            if (
+                fk not in self._memo
+                and fk not in to_compute
+                and fk not in self._scheduled
+            ):
                 to_compute[fk] = row
-        if to_compute:
-            results = _run_async_batch(
-                self.async_fn, list(to_compute.values()), self.capacity
+        if not self.pipelined:
+            if to_compute:
+                results = self._dispatch(list(to_compute.values())).result()
+                for fk, res in zip(to_compute.keys(), results):
+                    self._memo[fk] = res
+            out: list[Entry] = []
+            for key, row, diff in entries:
+                out.append((key, self._memo[freeze_row(row)], diff))
+            return consolidate(out)
+        # pipelined: dispatch this batch, emit batches dispatched at
+        # earlier timestamps (their device work ran while the host was
+        # parsing/ingesting this one)
+        if entries:
+            fut = (
+                self._dispatch(list(to_compute.values())) if to_compute else None
             )
-            for fk, res in zip(to_compute.keys(), results):
-                self._memo[fk] = res
+            self._scheduled.update(to_compute.keys())
+            self._in_flight.append((time, fut, list(to_compute.keys()), entries))
+        return self._drain(lambda t: t < time)
+
+    def _drain(self, ready) -> list[Entry]:
         out: list[Entry] = []
-        for key, row, diff in entries:
-            out.append((key, self._memo[freeze_row(row)], diff))
+        rest: list[tuple] = []
+        for t, fut, fks, batch in self._in_flight:
+            if not ready(t):
+                rest.append((t, fut, fks, batch))
+                continue
+            if fut is not None:
+                for fk, res in zip(fks, fut.result()):
+                    self._memo[fk] = res
+            for key, row, diff in batch:
+                out.append((key, self._memo[freeze_row(row)], diff))
+        self._in_flight = rest
         return consolidate(out)
 
+    def has_pending(self, time: int) -> bool:
+        if super().has_pending(time):
+            return True
+        return self.pipelined and any(t < time for t, *_ in self._in_flight)
 
-def _run_async_batch(async_fn, rows: list, capacity: int | None) -> list:
-    async def runner():
-        sem = asyncio.Semaphore(capacity) if capacity else None
+    def async_ready(self) -> bool:
+        """True when a dispatched batch has resolved and only needs an
+        engine step to emit — lets an idle streaming driver drain results
+        promptly instead of waiting for the next input."""
+        return self.pipelined and any(
+            fut is None or fut.done() for _, fut, *_ in self._in_flight
+        )
 
-        async def one(row):
-            if sem is None:
-                return await async_fn(row)
-            async with sem:
-                return await async_fn(row)
-
-        return await asyncio.gather(*[one(r) for r in rows])
-
-    try:
-        loop = asyncio.get_running_loop()
-    except RuntimeError:
-        loop = None
-    if loop is not None:
-        # called from within an event loop (e.g. aiohttp handler thread):
-        # run in a private loop on a helper thread
-        import concurrent.futures
-
-        with concurrent.futures.ThreadPoolExecutor(1) as pool:
-            return pool.submit(asyncio.run, runner()).result()
-    return asyncio.run(runner())
+    def on_end(self) -> list[Entry]:
+        return self._drain(lambda t: True) if self.pipelined else []
 
 
 class OutputNode(Node):
@@ -890,6 +941,12 @@ class Engine:
             node.name, len(out), _time_mod.perf_counter() - t0
         )
         return out
+
+    def has_async_ready(self) -> bool:
+        """Any pipelined async node holding resolved, unemitted results."""
+        return any(
+            isinstance(n, AsyncMapNode) and n.async_ready() for n in self.nodes
+        )
 
     def run_all(self) -> None:
         """Batch mode: drain all queued source times, then close."""

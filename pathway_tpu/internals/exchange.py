@@ -140,6 +140,20 @@ class ExchangePlane:
 
     # -- wiring --
     def start(self, timeout: float = 30.0) -> None:
+        # the wire format's tagged pickle escape hatch means an
+        # authenticated frame can execute code: spanning real hosts
+        # without a shared secret would leave the port open to anyone who
+        # can compute blake2b("") — refuse instead of warn
+        if self._token_digest == hashlib.blake2b(
+            b"", digest_size=16
+        ).digest() and any(
+            h not in ("127.0.0.1", "localhost", "::1")
+            for h, _ in self.addresses
+        ):
+            raise ValueError(
+                "PATHWAY_ADDRESSES spans non-loopback hosts: set "
+                "PATHWAY_EXCHANGE_TOKEN (shared secret) on every process"
+            )
         my_host, my_port = self.addresses[self.me]
         # bind the advertised name when it resolves locally (pod DNS
         # resolves to the pod's own ip); fall back to all interfaces only
@@ -197,42 +211,54 @@ class ExchangePlane:
     _HELLO_LEN = len(_HELLO_MAGIC) + 2 + 16
 
     def _accept_loop(self) -> None:
-        accepted = 0
-        while accepted < self.n - 1 and not self._closed:
+        # handshakes run per-connection so a byte-dribbling stray cannot
+        # stall acceptance of legitimate peers behind it
+        while not self._closed:
             try:
                 conn, _addr = self._server.accept()
             except OSError:
                 return
-            # authenticate before this connection counts as a peer — a
-            # stray connection is closed and its slot stays available
-            try:
-                conn.settimeout(5.0)
-                hello = self._recv_exact(conn, self._HELLO_LEN)
-                conn.settimeout(None)
-            except OSError:
-                hello = None
-            magic_len = len(self._HELLO_MAGIC)
-            if (
-                hello is None
-                or hello[:magic_len] != self._HELLO_MAGIC
-                or not _digest_eq(hello[magic_len + 2 :], self._token_digest)
-            ):
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-                continue
-            (peer_id,) = struct.unpack_from("<H", hello, magic_len)
-            try:
-                conn.sendall(b"\x01")  # handshake ack — peer fails fast if absent
-            except OSError:
-                continue
-            accepted += 1
             th = threading.Thread(
-                target=self._recv_loop, args=(conn, peer_id), daemon=True
+                target=self._handshake, args=(conn,), daemon=True
             )
             th.start()
             self._threads.append(th)
+
+    def _handshake(self, conn: socket.socket) -> None:
+        """Authenticate one inbound connection; a stray connection is
+        closed without ever reaching frame decoding."""
+        try:
+            # overall deadline for the whole hello, not per recv call
+            conn.settimeout(5.0)
+            deadline = _time.monotonic() + 5.0
+            hello = b""
+            while len(hello) < self._HELLO_LEN:
+                if _time.monotonic() > deadline:
+                    raise OSError("handshake deadline")
+                chunk = conn.recv(self._HELLO_LEN - len(hello))
+                if not chunk:
+                    raise OSError("handshake EOF")
+                hello += chunk
+            conn.settimeout(None)
+        except OSError:
+            hello = None
+        magic_len = len(self._HELLO_MAGIC)
+        if (
+            hello is None
+            or hello[:magic_len] != self._HELLO_MAGIC
+            or not _digest_eq(hello[magic_len + 2 :], self._token_digest)
+        ):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        (peer_id,) = struct.unpack_from("<H", hello, magic_len)
+        try:
+            conn.sendall(b"\x01")  # handshake ack — peer fails fast if absent
+        except OSError:
+            return
+        self._recv_loop(conn, peer_id)
 
     def _recv_loop(self, conn: socket.socket, peer_id: int) -> None:
         try:
@@ -254,13 +280,16 @@ class ExchangePlane:
                         entries
                     )
                     self._cv.notify_all()
-        except OSError:
+        except Exception:
+            # decode errors (version mismatch, corrupt frame) count as a
+            # dead peer too — never die silently leaving barriers to hang
             pass
-        # EOF / socket error: the peer is gone — wake any barrier blocked
-        # on it so failures abort promptly instead of timing out
-        with self._cv:
-            self._down.add(peer_id)
-            self._cv.notify_all()
+        finally:
+            # EOF / socket error / decode error: the peer is gone — wake
+            # any barrier blocked on it so failures abort promptly
+            with self._cv:
+                self._down.add(peer_id)
+                self._cv.notify_all()
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> bytes | None:

@@ -201,6 +201,13 @@ class GraphRunner:
 
         extra = 0
         if async_slots:
+            from .expression import FullyAsyncApplyExpression
+
+            # any fully_async slot makes the whole node pipelined (results
+            # land one engine step later; device work overlaps host ingest)
+            pipelined = any(
+                isinstance(s, FullyAsyncApplyExpression) for s in async_slots
+            )
             resolve = layout.resolver()
             slot_fns = []
             capacity = None
@@ -239,7 +246,10 @@ class GraphRunner:
             else:
                 upstream.downstream.append((wrap_in, 0))
             amap = AsyncMapNode(
-                lambda row: async_fn(row[0]), capacity=capacity, name=f"async#{op.id}"
+                lambda row: async_fn(row[0]),
+                capacity=capacity,
+                pipelined=pipelined,
+                name=f"async#{op.id}",
             )
             self.engine.add(amap)
             wrap_in.downstream.append((amap, 0))

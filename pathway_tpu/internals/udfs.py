@@ -22,6 +22,7 @@ from . import dtype as dt
 from .expression import (
     ApplyExpression,
     AsyncApplyExpression,
+    FullyAsyncApplyExpression,
     ColumnExpression,
     smart_wrap,
 )
@@ -412,7 +413,12 @@ class UDF:
                 afun = with_timeout(afun, self.executor.timeout)
             if self.cache_strategy is not None:
                 afun = with_cache_strategy(afun, self.cache_strategy)
-            expr = AsyncApplyExpression(
+            expr_cls = (
+                FullyAsyncApplyExpression
+                if self.executor.kind == "fully_async"
+                else AsyncApplyExpression
+            )
+            expr = expr_cls(
                 afun,
                 return_type,
                 *args,

@@ -382,6 +382,12 @@ class StreamingDriver:
                 self.engine.step(t)
                 t += 1
                 continue
+            if self.engine.has_async_ready():
+                # a pipelined async batch resolved while sources are idle:
+                # step once so its results emit now, not at the next input
+                self.engine.step(t)
+                t += 1
+                continue
             if all(s._closed.is_set() for s, _ in self.subject_src):
                 # final drain to catch a close() racing the check
                 for subject, src in self.subject_src:

@@ -74,9 +74,18 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         device: str = "tpu",  # accepted for API parity; placement is XLA's
         encoder: Any = None,
         max_batch: int = 1024,
+        pipelined: bool = False,
         **init_kwargs,
     ):
-        super().__init__(executor=udfs.async_executor(), deterministic=True)
+        # pipelined: fully-async dispatch — the device encode of micro-batch
+        # t overlaps host ingest/parse of t+1, embeddings land one engine
+        # step later (the FullyAsyncExecutor contract)
+        super().__init__(
+            executor=(
+                udfs.fully_async_executor() if pipelined else udfs.async_executor()
+            ),
+            deterministic=True,
+        )
         self.model = model
         self.kwargs = dict(call_kwargs)
         self._encoder = encoder

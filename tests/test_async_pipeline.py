@@ -1,0 +1,163 @@
+"""Pipelined async dispatch: device work overlaps host dataflow.
+
+reference: graph.rs:723 ``async_apply_table`` capacity +
+python/pathway/internals/udfs/executors.py ``FullyAsyncExecutor`` (results
+land at a later engine time).  VERDICT r1 next-step #6: ingest/parse of
+micro-batch t+1 must overlap the device step of t.
+"""
+
+import asyncio
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.internals import aio
+
+
+def test_persistent_loop_reused():
+    l1 = aio.get_loop()
+    l2 = aio.get_loop()
+    assert l1 is l2 and l1.is_running()
+    fut = aio.submit(asyncio.sleep(0.01, result=42))
+    assert fut.result(timeout=5) == 42
+
+
+def _markdown_rows(n, start_time=2, stride=2):
+    lines = ["    x | __time__"]
+    for i in range(n):
+        lines.append(f"    w{i} | {start_time + i * stride}")
+    return "\n".join(lines)
+
+
+def test_fully_async_correctness():
+    """All rows get results; fully_async results may land at a later
+    engine time than their input (the FullyAsyncExecutor contract)."""
+
+    @pw.udf(executor=pw.udfs.fully_async_executor())
+    async def slow_upper(x: str) -> str:
+        await asyncio.sleep(0.005)
+        return x.upper()
+
+    t = pw.debug.table_from_markdown(_markdown_rows(5))
+    r = t.select(y=slow_upper(t.x))
+    (out,) = pw.debug.materialize(r)
+    pw.run()
+    got = sorted(row[0] for row in out.current.values())
+    assert got == ["W0", "W1", "W2", "W3", "W4"]
+    # delayed emission: at least one result lands after its input time
+    emit_time = {row[0]: tm for _, row, tm, diff in out.history if diff > 0}
+    assert emit_time["W0"] > 2
+
+
+def test_fully_async_retraction_pairing():
+    """A retraction whose addition is still in flight must reuse the same
+    memoized result (no recompute → add/retract stay paired)."""
+    calls = []
+
+    @pw.udf(executor=pw.udfs.fully_async_executor())
+    async def tag(x: str) -> str:
+        calls.append(x)
+        await asyncio.sleep(0.005)
+        return f"{x}!"
+
+    t = pw.debug.table_from_markdown(
+        """
+          | x | __time__ | __diff__
+        1 | a | 2        | 1
+        1 | a | 4        | -1
+        2 | b | 4        | 1
+        """
+    )
+    r = t.select(y=tag(t.x))
+    (out,) = pw.debug.materialize(r)
+    pw.run()
+    # the retraction of 'a' reused the in-flight result: one call per input
+    assert sorted(calls) == ["a", "b"]
+    rows = sorted(row[0] for row in out.current.values())
+    assert rows == ["b!"]
+    # history pairs: a! added then a! retracted (same value both times)
+    a_events = [(row[0], diff) for _, row, _, diff in out.history if row[0] == "a!"]
+    assert a_events == [("a!", 1), ("a!", -1)]
+
+
+def test_pipelined_overlaps_host_work():
+    """Ingest→parse(host)→embed(device) pipeline: with fully_async the
+    device batch of step t runs while the host parses step t+1, so wall
+    clock approaches max(host, device) per step instead of their sum."""
+    n_steps = 10
+    host_s = 0.03
+    device_s = 0.03
+
+    def build(executor):
+        @pw.udf
+        def parse(x: str) -> str:  # host-side work per micro-batch
+            time.sleep(host_s)
+            return x + "|parsed"
+
+        @pw.udf(executor=executor)
+        async def embed(x: str) -> str:  # device-side latency
+            await asyncio.sleep(device_s)
+            return x + "|embedded"
+
+        t = pw.debug.table_from_markdown(_markdown_rows(n_steps))
+        return t.select(y=embed(parse(t.x)))
+
+    def timed_run(executor):
+        pw.internals.graph.G.clear()
+        r = build(executor)
+        t0 = time.perf_counter()
+        (out,) = pw.debug.materialize(r)  # materialize drives the graph
+        elapsed = time.perf_counter() - t0
+        assert len(out.current) == n_steps
+        return elapsed
+
+    serialized = timed_run(pw.udfs.async_executor())
+    pipelined = timed_run(pw.udfs.fully_async_executor())
+    # ideal: serialized = n*(host+device), pipelined ≈ n*max(host, device)
+    speedup = serialized / pipelined
+    assert speedup >= 1.5, (
+        f"pipelined {pipelined:.3f}s vs serialized {serialized:.3f}s "
+        f"(speedup {speedup:.2f}x < 1.5x)"
+    )
+
+
+def test_pipelined_drains_on_idle_stream():
+    """A pipelined batch whose device work resolves while the source is
+    idle must emit promptly — not wait for the next input or stream end."""
+    import threading
+
+    from pathway_tpu.io.python import ConnectorSubject
+
+    got = []
+    got_at = {}
+
+    class Subject(ConnectorSubject):
+        def run(self):
+            self.next(x="early")
+            self.commit()
+            # stay open (idle) long enough that only the idle-drain path
+            # can deliver the result before close
+            time.sleep(3.0)
+            self.close()
+
+    class Schema(pw.Schema):
+        x: str
+
+    @pw.udf(executor=pw.udfs.fully_async_executor())
+    async def embed(x: str) -> str:
+        await asyncio.sleep(0.05)
+        return x + "|e"
+
+    t = pw.io.python.read(Subject(), schema=Schema, autocommit_duration_ms=50)
+    r = t.select(y=embed(t.x))
+    t0 = time.perf_counter()
+    pw.io.subscribe(
+        r,
+        on_change=lambda key, row, tm, add: (
+            got.append(row["y"]),
+            got_at.setdefault(row["y"], time.perf_counter() - t0),
+        ),
+    )
+    pw.run()
+    assert got == ["early|e"]
+    # emitted while the source idled (~3s): well before stream close
+    assert got_at["early|e"] < 2.0, got_at

@@ -514,8 +514,8 @@ def test_wrong_token_peer_rejected():
         # barrier timeout later
         with pytest.raises(RuntimeError, match="rejected the exchange handshake"):
             bad.start(timeout=6)
-        # and good never spawned a recv loop for it (only the accept thread)
-        assert len(good._threads) == 1, good._threads
+        # and good never authenticated it: no inbound frames, no peer state
+        assert not good._inbox and not good._down
     finally:
         good.close()
         bad.close()
