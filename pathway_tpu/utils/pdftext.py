@@ -1,0 +1,625 @@
+"""Native PDF text extraction — no external PDF library.
+
+reference: python/pathway/xpacks/llm/parsers.py:746 ``PypdfParser``
+delegates to the pypdf package; this module is the from-scratch
+equivalent for this image (pypdf is not available), implementing the
+subset of ISO 32000 needed for text: object parsing, xref-less object
+scanning, FlateDecode/ASCIIHex/ASCII85 stream filters, the page tree,
+and content-stream text operators (BT/ET, Tf, Td/TD/Tm/T*, Tj/TJ/'/\")
+with text-matrix tracking, plus ToUnicode CMap decoding (bfchar/bfrange)
+for embedded fonts.
+
+Output is a list of pages, each a list of positioned text runs
+``(x, y, size, text)`` — enough for both plain per-page extraction and
+the structural chunking of the OpenParse-equivalent parser.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from dataclasses import dataclass, field
+from typing import Any
+
+__all__ = ["PdfDocument", "TextRun", "extract_page_text"]
+
+
+@dataclass
+class TextRun:
+    x: float
+    y: float
+    size: float
+    text: str
+
+
+@dataclass
+class _Stream:
+    dict: dict
+    data: bytes
+
+
+class _Ref:
+    __slots__ = ("num",)
+
+    def __init__(self, num: int):
+        self.num = num
+
+    def __repr__(self):
+        return f"_Ref({self.num})"
+
+
+_WS = b"\x00\t\n\x0c\r "
+_DELIM = b"()<>[]{}/%"
+
+
+class _Lexer:
+    """Tokenizer over the raw PDF byte stream (object syntax subset)."""
+
+    def __init__(self, data: bytes, pos: int = 0):
+        self.data = data
+        self.pos = pos
+
+    def _skip_ws(self) -> None:
+        d = self.data
+        while self.pos < len(d):
+            c = d[self.pos : self.pos + 1]
+            if c in (b"%",):
+                nl = d.find(b"\n", self.pos)
+                self.pos = len(d) if nl < 0 else nl + 1
+            elif c in _WS:
+                self.pos += 1
+            else:
+                return
+
+    def parse_object(self) -> Any:
+        self._skip_ws()
+        d, p = self.data, self.pos
+        c = d[p : p + 1]
+        if c == b"<":
+            if d[p + 1 : p + 2] == b"<":
+                return self._parse_dict_or_stream()
+            return self._parse_hex_string()
+        if c == b"(":
+            return self._parse_literal_string()
+        if c == b"[":
+            return self._parse_array()
+        if c == b"/":
+            return self._parse_name()
+        if c in b"+-.0123456789":
+            return self._parse_number_or_ref()
+        if d[p : p + 4] == b"true":
+            self.pos += 4
+            return True
+        if d[p : p + 5] == b"false":
+            self.pos += 5
+            return False
+        if d[p : p + 4] == b"null":
+            self.pos += 4
+            return None
+        raise ValueError(f"unexpected pdf token at offset {p}: {d[p:p+20]!r}")
+
+    def _parse_name(self) -> str:
+        d = self.data
+        self.pos += 1  # '/'
+        start = self.pos
+        while self.pos < len(d):
+            c = d[self.pos : self.pos + 1]
+            if c in _WS or c in _DELIM:
+                break
+            self.pos += 1
+        raw = d[start : self.pos]
+        # #xx escapes in names
+        return re.sub(
+            rb"#([0-9A-Fa-f]{2})", lambda m: bytes([int(m.group(1), 16)]), raw
+        ).decode("latin-1")
+
+    def _parse_number_or_ref(self) -> Any:
+        d = self.data
+        start = self.pos
+        while self.pos < len(d) and d[self.pos : self.pos + 1] in b"+-.0123456789":
+            self.pos += 1
+        tok = d[start : self.pos]
+        # look ahead for "gen R" → indirect reference
+        save = self.pos
+        self._skip_ws()
+        m = re.match(rb"(\d+)\s+R(?![\w])", d[self.pos : self.pos + 24])
+        if m and re.fullmatch(rb"\d+", tok):
+            self.pos += m.end()
+            return _Ref(int(tok))
+        self.pos = save
+        if b"." in tok:
+            return float(tok)
+        return int(tok)
+
+    def _parse_literal_string(self) -> bytes:
+        d = self.data
+        self.pos += 1
+        out = bytearray()
+        depth = 1
+        while self.pos < len(d):
+            c = d[self.pos]
+            self.pos += 1
+            if c == 0x5C:  # backslash
+                e = d[self.pos]
+                self.pos += 1
+                mapping = {
+                    0x6E: 0x0A, 0x72: 0x0D, 0x74: 0x09, 0x62: 0x08,
+                    0x66: 0x0C, 0x28: 0x28, 0x29: 0x29, 0x5C: 0x5C,
+                }
+                if e in mapping:
+                    out.append(mapping[e])
+                elif 0x30 <= e <= 0x37:  # octal, up to 3 digits
+                    oct_digits = [e - 0x30]
+                    for _ in range(2):
+                        n = d[self.pos]
+                        if 0x30 <= n <= 0x37:
+                            oct_digits.append(n - 0x30)
+                            self.pos += 1
+                        else:
+                            break
+                    val = 0
+                    for dg in oct_digits:
+                        val = val * 8 + dg
+                    out.append(val & 0xFF)
+                elif e in (0x0A, 0x0D):  # line continuation
+                    if e == 0x0D and d[self.pos] == 0x0A:
+                        self.pos += 1
+                else:
+                    out.append(e)
+            elif c == 0x28:
+                depth += 1
+                out.append(c)
+            elif c == 0x29:
+                depth -= 1
+                if depth == 0:
+                    break
+                out.append(c)
+            else:
+                out.append(c)
+        return bytes(out)
+
+    def _parse_hex_string(self) -> bytes:
+        d = self.data
+        self.pos += 1
+        end = d.find(b">", self.pos)
+        hexpart = re.sub(rb"\s", b"", d[self.pos : end])
+        self.pos = end + 1
+        if len(hexpart) % 2:
+            hexpart += b"0"
+        return bytes.fromhex(hexpart.decode("ascii"))
+
+    def _parse_array(self) -> list:
+        self.pos += 1
+        out = []
+        while True:
+            self._skip_ws()
+            if self.data[self.pos : self.pos + 1] == b"]":
+                self.pos += 1
+                return out
+            out.append(self.parse_object())
+
+    def _parse_dict_or_stream(self) -> Any:
+        self.pos += 2
+        d: dict = {}
+        while True:
+            self._skip_ws()
+            if self.data[self.pos : self.pos + 2] == b">>":
+                self.pos += 2
+                break
+            key = self._parse_name()
+            d[key] = self.parse_object()
+        self._skip_ws()
+        if self.data[self.pos : self.pos + 6] == b"stream":
+            self.pos += 6
+            if self.data[self.pos : self.pos + 2] == b"\r\n":
+                self.pos += 2
+            elif self.data[self.pos : self.pos + 1] == b"\n":
+                self.pos += 1
+            length = d.get("Length")
+            if isinstance(length, int):
+                data = self.data[self.pos : self.pos + length]
+                self.pos += length
+            else:  # unresolved /Length ref — scan for endstream
+                end = self.data.find(b"endstream", self.pos)
+                data = self.data[self.pos : end].rstrip(b"\r\n")
+                self.pos = end
+            self._skip_ws()
+            if self.data[self.pos : self.pos + 9] == b"endstream":
+                self.pos += 9
+            return _Stream(d, data)
+        return d
+
+
+def _decode_stream(doc: "PdfDocument", s: _Stream) -> bytes:
+    filters = doc.resolve(s.dict.get("Filter"))
+    if filters is None:
+        return s.data
+    if not isinstance(filters, list):
+        filters = [filters]
+    data = s.data
+    for f in filters:
+        f = doc.resolve(f)
+        if f == "FlateDecode":
+            data = zlib.decompress(data)
+            parms = doc.resolve(s.dict.get("DecodeParms")) or {}
+            pred = doc.resolve(parms.get("Predictor", 1)) if parms else 1
+            if isinstance(pred, int) and pred >= 10:
+                data = _png_unpredict(
+                    data, doc.resolve(parms.get("Columns", 1))
+                )
+        elif f == "ASCIIHexDecode":
+            data = bytes.fromhex(
+                re.sub(rb"[\s>]", b"", data).decode("ascii")
+            )
+        elif f == "ASCII85Decode":
+            import base64
+
+            clean = re.sub(rb"\s", b"", data)
+            clean = clean[:-2] if clean.endswith(b"~>") else clean
+            data = base64.a85decode(clean)
+        else:
+            raise ValueError(f"unsupported pdf stream filter {f!r}")
+    return data
+
+
+def _png_unpredict(data: bytes, columns: int) -> bytes:
+    out = bytearray()
+    prev = bytearray(columns)
+    row_len = columns + 1
+    for i in range(0, len(data), row_len):
+        tag = data[i]
+        row = bytearray(data[i + 1 : i + row_len])
+        if tag == 2:  # Up — the only predictor xref streams commonly use
+            for j in range(len(row)):
+                row[j] = (row[j] + prev[j]) & 0xFF
+        elif tag == 0:
+            pass
+        else:  # Sub/Average/Paeth — full PNG reconstruction
+            for j in range(len(row)):
+                left = row[j - 1] if j else 0
+                up = prev[j]
+                if tag == 1:
+                    row[j] = (row[j] + left) & 0xFF
+                elif tag == 3:
+                    row[j] = (row[j] + (left + up) // 2) & 0xFF
+                elif tag == 4:
+                    ul = prev[j - 1] if j else 0
+                    p = left + up - ul
+                    pa, pb, pc = abs(p - left), abs(p - up), abs(p - ul)
+                    pr = left if pa <= pb and pa <= pc else up if pb <= pc else ul
+                    row[j] = (row[j] + pr) & 0xFF
+        out += row
+        prev = row
+    return bytes(out)
+
+
+class PdfDocument:
+    """Parsed PDF: resolves objects by scanning ``N 0 obj`` markers (more
+    robust than trusting xref tables, and handles incremental updates by
+    letting later definitions win)."""
+
+    def __init__(self, data: bytes):
+        if not data.startswith(b"%PDF"):
+            raise ValueError("not a PDF (missing %PDF header)")
+        self.data = data
+        self.objects: dict[int, Any] = {}
+        self._obj_offsets: dict[int, int] = {}
+        for m in re.finditer(rb"(?:^|[\r\n\s])(\d+)\s+(\d+)\s+obj\b", data):
+            self._obj_offsets[int(m.group(1))] = m.end()
+        self._load_object_streams()
+
+    def _get_object(self, num: int) -> Any:
+        if num in self.objects:
+            return self.objects[num]
+        off = self._obj_offsets.get(num)
+        if off is None:
+            return None
+        obj = _Lexer(self.data, off).parse_object()
+        self.objects[num] = obj
+        return obj
+
+    def _load_object_streams(self) -> None:
+        """Objects packed in /ObjStm compressed streams (PDF 1.5+)."""
+        for num in list(self._obj_offsets):
+            obj = self._get_object(num)
+            if isinstance(obj, _Stream) and self.resolve(obj.dict.get("Type")) == "ObjStm":
+                try:
+                    payload = _decode_stream(self, obj)
+                except Exception:
+                    continue
+                n = self.resolve(obj.dict.get("N"))
+                first = self.resolve(obj.dict.get("First"))
+                header = payload[:first].split()
+                for i in range(n):
+                    onum = int(header[2 * i])
+                    ooff = int(header[2 * i + 1])
+                    if onum not in self._obj_offsets:
+                        self.objects[onum] = _Lexer(
+                            payload, first + ooff
+                        ).parse_object()
+
+    def resolve(self, obj: Any) -> Any:
+        seen = 0
+        while isinstance(obj, _Ref):
+            obj = self._get_object(obj.num)
+            seen += 1
+            if seen > 64:
+                raise ValueError("reference cycle in pdf")
+        return obj
+
+    # -- page tree --
+    def pages(self) -> list[dict]:
+        root = None
+        for num in self._obj_offsets:
+            obj = self.resolve(self._get_object(num))
+            d = obj.dict if isinstance(obj, _Stream) else obj
+            if isinstance(d, dict) and self.resolve(d.get("Type")) == "Catalog":
+                root = d
+        if root is None:
+            raise ValueError("no /Catalog in pdf")
+        out: list[dict] = []
+
+        def walk(node_ref, inherited):
+            node = self.resolve(node_ref)
+            if not isinstance(node, dict):
+                return
+            merged = dict(inherited)
+            for k in ("Resources", "MediaBox"):
+                if k in node:
+                    merged[k] = node[k]
+            t = self.resolve(node.get("Type"))
+            if t == "Pages" or (t is None and "Kids" in node):
+                for kid in self.resolve(node.get("Kids")) or []:
+                    walk(kid, merged)
+            elif t == "Page":
+                page = dict(node)
+                for k, v in merged.items():
+                    page.setdefault(k, v)
+                out.append(page)
+
+        walk(root.get("Pages"), {})
+        return out
+
+    def page_content(self, page: dict) -> bytes:
+        contents = self.resolve(page.get("Contents"))
+        if contents is None:
+            return b""
+        streams = contents if isinstance(contents, list) else [contents]
+        parts = []
+        for s in streams:
+            s = self.resolve(s)
+            if isinstance(s, _Stream):
+                parts.append(_decode_stream(self, s))
+        return b"\n".join(parts)
+
+    # -- fonts --
+    def _to_unicode_map(self, font: dict) -> dict[int, str] | None:
+        tu = self.resolve(font.get("ToUnicode"))
+        if not isinstance(tu, _Stream):
+            return None
+        cmap_src = _decode_stream(self, tu).decode("latin-1", "replace")
+        mapping: dict[int, str] = {}
+        for block in re.finditer(
+            r"beginbfchar(.*?)endbfchar", cmap_src, re.S
+        ):
+            for src, dst in re.findall(
+                r"<([0-9A-Fa-f]+)>\s*<([0-9A-Fa-f]+)>", block.group(1)
+            ):
+                mapping[int(src, 16)] = _utf16_hex(dst)
+        for block in re.finditer(
+            r"beginbfrange(.*?)endbfrange", cmap_src, re.S
+        ):
+            body = block.group(1)
+            for lo, hi, dst in re.findall(
+                r"<([0-9A-Fa-f]+)>\s*<([0-9A-Fa-f]+)>\s*<([0-9A-Fa-f]+)>", body
+            ):
+                lo_i, hi_i, base = int(lo, 16), int(hi, 16), int(dst, 16)
+                width = len(dst)
+                for code in range(lo_i, hi_i + 1):
+                    mapping[code] = _utf16_hex(
+                        format(base + code - lo_i, f"0{width}x")
+                    )
+            for lo, arr in re.findall(
+                r"<([0-9A-Fa-f]+)>\s*<[0-9A-Fa-f]+>\s*\[(.*?)\]", body, re.S
+            ):
+                codes = re.findall(r"<([0-9A-Fa-f]+)>", arr)
+                for i, dst in enumerate(codes):
+                    mapping[int(lo, 16) + i] = _utf16_hex(dst)
+        return mapping or None
+
+    def page_fonts(self, page: dict) -> dict[str, dict]:
+        res = self.resolve(page.get("Resources")) or {}
+        fonts = self.resolve(res.get("Font")) or {}
+        out = {}
+        for name, ref in fonts.items():
+            f = self.resolve(ref)
+            if isinstance(f, dict):
+                out[name] = {
+                    "dict": f,
+                    "to_unicode": self._to_unicode_map(f),
+                    "two_byte": self.resolve(f.get("Subtype")) == "Type0",
+                }
+        return out
+
+
+def _utf16_hex(hexstr: str) -> str:
+    raw = bytes.fromhex(hexstr if len(hexstr) % 2 == 0 else "0" + hexstr)
+    if len(raw) >= 2:
+        try:
+            return raw.decode("utf-16-be")
+        except UnicodeDecodeError:
+            pass
+    return raw.decode("latin-1")
+
+
+# -- content stream interpretation ------------------------------------------
+
+_OP_RE = re.compile(
+    rb"""
+    (?P<str>\((?:\\.|[^()\\]|\((?:\\.|[^()\\])*\))*\))   # literal string
+  | (?P<hex><[0-9A-Fa-f\s]*>)                            # hex string
+  | (?P<name>/[^\s()<>\[\]{}/%]*)
+  | (?P<num>[+-]?\d*\.?\d+)
+  | (?P<arr>[\[\]])
+  | (?P<op>[A-Za-z'"*]+)
+    """,
+    re.X,
+)
+
+
+def _decode_pdf_string(raw: bytes, font: dict | None) -> str:
+    if font and font.get("to_unicode"):
+        tu = font["to_unicode"]
+        width = 2 if font.get("two_byte") else 1
+        out = []
+        for i in range(0, len(raw) - width + 1, width):
+            code = int.from_bytes(raw[i : i + width], "big")
+            out.append(tu.get(code, chr(code) if code < 0x110000 else "�"))
+        return "".join(out)
+    if font and font.get("two_byte"):
+        try:
+            return raw.decode("utf-16-be")
+        except UnicodeDecodeError:
+            pass
+    return raw.decode("latin-1", "replace")
+
+
+def extract_runs(doc: PdfDocument, page: dict) -> list[TextRun]:
+    """Interpret the page content stream into positioned text runs."""
+    content = doc.page_content(page)
+    fonts = doc.page_fonts(page)
+    runs: list[TextRun] = []
+
+    stack: list[Any] = []
+    in_array: list | None = None
+    font: dict | None = None
+    size = 12.0
+    leading = 0.0
+    # text matrix (a b c d e f) and line matrix; we track e,f (+ scale a,d)
+    tm = [1, 0, 0, 1, 0, 0]
+    tlm = [1, 0, 0, 1, 0, 0]
+    in_text = False
+
+    def lex_literal(tok: bytes) -> bytes:
+        return _Lexer(tok).parse_object()
+
+    def emit(raw: bytes):
+        nonlocal tm
+        text = _decode_pdf_string(raw, font)
+        if text:
+            runs.append(TextRun(x=tm[4], y=tm[5], size=size * abs(tm[3] or 1), text=text))
+            # advance x roughly (glyph widths unknown): 0.5em per char
+            tm[4] += 0.5 * size * len(text) * (tm[0] or 1)
+
+    for m in _OP_RE.finditer(content):
+        kind = m.lastgroup
+        tok = m.group(0)
+        if kind == "str":
+            (in_array if in_array is not None else stack).append(lex_literal(tok))
+        elif kind == "hex":
+            (in_array if in_array is not None else stack).append(
+                _Lexer(tok).parse_object()
+            )
+        elif kind == "name":
+            stack.append(tok[1:].decode("latin-1"))
+        elif kind == "num":
+            (in_array if in_array is not None else stack).append(float(tok))
+        elif kind == "arr":
+            if tok == b"[":
+                in_array = []
+                stack.append(in_array)
+            else:
+                in_array = None
+        elif kind == "op":
+            op = tok.decode("latin-1")
+            if in_array is not None and op not in ("TJ",):
+                pass
+            if op == "BT":
+                in_text = True
+                tm = [1, 0, 0, 1, 0, 0]
+                tlm = [1, 0, 0, 1, 0, 0]
+            elif op == "ET":
+                in_text = False
+            elif op == "Tf" and len(stack) >= 2:
+                size = float(stack[-1])
+                font = fonts.get(stack[-2])
+            elif op == "TL" and stack:
+                leading = float(stack[-1])
+            elif op in ("Td", "TD") and len(stack) >= 2:
+                tx, ty = float(stack[-2]), float(stack[-1])
+                if op == "TD":
+                    leading = -ty
+                tlm = [
+                    tlm[0], tlm[1], tlm[2], tlm[3],
+                    tlm[4] + tx * tlm[0] + ty * tlm[2],
+                    tlm[5] + tx * tlm[1] + ty * tlm[3],
+                ]
+                tm = list(tlm)
+            elif op == "Tm" and len(stack) >= 6:
+                tlm = [float(v) for v in stack[-6:]]
+                tm = list(tlm)
+            elif op == "T*":
+                tlm = [
+                    tlm[0], tlm[1], tlm[2], tlm[3],
+                    tlm[4] - leading * tlm[2],
+                    tlm[5] - leading * tlm[3],
+                ]
+                tm = list(tlm)
+            elif op == "Tj" and stack and isinstance(stack[-1], bytes):
+                emit(stack[-1])
+            elif op == "'" and stack and isinstance(stack[-1], bytes):
+                tlm = [
+                    tlm[0], tlm[1], tlm[2], tlm[3],
+                    tlm[4] - leading * tlm[2],
+                    tlm[5] - leading * tlm[3],
+                ]
+                tm = list(tlm)
+                emit(stack[-1])
+            elif op == '"' and stack and isinstance(stack[-1], bytes):
+                tlm = [
+                    tlm[0], tlm[1], tlm[2], tlm[3],
+                    tlm[4] - leading * tlm[2],
+                    tlm[5] - leading * tlm[3],
+                ]
+                tm = list(tlm)
+                emit(stack[-1])
+            elif op == "TJ" and stack and isinstance(stack[-1], list):
+                arr = stack[-1]
+                parts: list[bytes] = []
+                for item in arr:
+                    if isinstance(item, bytes):
+                        parts.append(item)
+                    elif isinstance(item, float) and item < -180:
+                        parts.append(b" ")  # big negative kern = word gap
+                emit(b"".join(parts))
+                in_array = None
+            stack = []
+    return runs
+
+
+def extract_page_text(doc: PdfDocument, page: dict) -> str:
+    """Plain text for one page: runs grouped into lines by y, ordered
+    top-down then left-right, with blank lines at large vertical gaps."""
+    runs = extract_runs(doc, page)
+    if not runs:
+        return ""
+    lines: dict[float, list[TextRun]] = {}
+    for r in runs:
+        yk = round(r.y / 2) * 2  # quantize y to merge a line's runs
+        lines.setdefault(yk, []).append(r)
+    ordered = sorted(lines.items(), key=lambda kv: -kv[0])
+    out = []
+    prev_y = None
+    prev_size = 12.0
+    for y, rs in ordered:
+        rs.sort(key=lambda r: r.x)
+        line = " ".join(r.text.strip() for r in rs if r.text.strip())
+        if not line:
+            continue
+        if prev_y is not None and prev_y - y > 2.2 * max(
+            prev_size, rs[0].size
+        ):
+            out.append("")  # paragraph gap
+        out.append(line)
+        prev_y, prev_size = y, rs[0].size
+    return "\n".join(out)

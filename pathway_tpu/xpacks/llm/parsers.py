@@ -22,6 +22,7 @@ __all__ = [
     "UnstructuredParser",
     "ParseUnstructured",
     "PypdfParser",
+    "OpenParse",
     "ImageParser",
     "SlideParser",
 ]
@@ -102,27 +103,178 @@ ParseUnstructured = UnstructuredParser
 
 
 class PypdfParser(UDF):
-    """pypdf text extraction, one chunk per page
-    (reference: parsers.py:746 w/ optional de-hyphenation cleanup)."""
+    """PDF text extraction, one chunk per page
+    (reference: parsers.py:746 w/ optional de-hyphenation cleanup).
+
+    Uses the pypdf package when present; otherwise the native extractor
+    (:mod:`pathway_tpu.utils.pdftext` — object model, Flate streams,
+    content-stream text operators, ToUnicode CMaps) so real PDFs parse
+    without any external PDF dependency."""
 
     def __init__(self, apply_text_cleanup: bool = True):
         super().__init__()
         self.apply_text_cleanup = apply_text_cleanup
 
     async def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
-        import io
-
-        from pypdf import PdfReader  # optional dependency
-
-        reader = PdfReader(io.BytesIO(bytes(contents)))
         out = []
-        for page_num, page in enumerate(reader.pages):
-            text = page.extract_text() or ""
+        for page_num, text in enumerate(_pdf_page_texts(bytes(contents))):
             if self.apply_text_cleanup:
                 text = _cleanup_pdf_text(text)
             if text.strip():
                 out.append((text, {"page_number": page_num + 1}))
         return out
+
+
+def _pdf_page_texts(data: bytes) -> list[str]:
+    try:
+        from pypdf import PdfReader  # optional dependency, preferred
+
+        import io
+
+        reader = PdfReader(io.BytesIO(data))
+        return [page.extract_text() or "" for page in reader.pages]
+    except ImportError:
+        from ...utils import pdftext
+
+        doc = pdftext.PdfDocument(data)
+        return [pdftext.extract_page_text(doc, p) for p in doc.pages()]
+
+
+class OpenParse(UDF):
+    """Structure-aware PDF parser (reference: parsers.py:235 ``OpenParse``
+    — the openparse package's layout pipeline: heading detection, block
+    grouping, table extraction).  Built on the native positioned-run
+    extractor: headings split chunks (runs ≥ ``heading_ratio`` × the page's
+    median font size), lines group into blocks by vertical gaps, and
+    column-aligned blocks render as markdown tables — each chunk carries
+    ``page_number``/``headings``/``kind`` metadata like the reference's
+    node model."""
+
+    def __init__(
+        self,
+        heading_ratio: float = 1.25,
+        table_args: dict | None = None,
+        **kwargs,
+    ):
+        super().__init__(deterministic=True)
+        self.heading_ratio = heading_ratio
+        self.table_args = table_args or {}
+
+    async def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        from ...utils import pdftext
+
+        doc = pdftext.PdfDocument(bytes(contents))
+        chunks: list[tuple[str, dict]] = []
+        headings: list[str] = []
+        for page_num, page in enumerate(doc.pages(), start=1):
+            runs = pdftext.extract_runs(doc, page)
+            if not runs:
+                continue
+            lines = _group_lines(runs)
+            sizes = sorted(r.size for r in runs)
+            median = sizes[len(sizes) // 2]
+            blocks = _group_blocks(lines)
+            for block in blocks:
+                text_lines = [ln for ln in block if ln[2].strip()]
+                if not text_lines:
+                    continue
+                block_size = max(ln[1] for ln in text_lines)
+                body = [ln[2] for ln in text_lines]
+                if (
+                    block_size >= self.heading_ratio * median
+                    and len(text_lines) <= 2
+                ):
+                    headings = [" ".join(body)]
+                    chunks.append(
+                        (
+                            " ".join(body),
+                            {
+                                "page_number": page_num,
+                                "kind": "heading",
+                                "headings": list(headings),
+                            },
+                        )
+                    )
+                elif _looks_tabular(block):
+                    chunks.append(
+                        (
+                            _render_table(block),
+                            {
+                                "page_number": page_num,
+                                "kind": "table",
+                                "headings": list(headings),
+                            },
+                        )
+                    )
+                else:
+                    chunks.append(
+                        (
+                            "\n".join(body),
+                            {
+                                "page_number": page_num,
+                                "kind": "text",
+                                "headings": list(headings),
+                            },
+                        )
+                    )
+        return chunks
+
+
+def _group_lines(runs) -> list[tuple[float, float, str, list]]:
+    """(y, size, text, cells) per line, top-down; cells keep x positions."""
+    by_y: dict[float, list] = {}
+    for r in runs:
+        by_y.setdefault(round(r.y / 2) * 2, []).append(r)
+    lines = []
+    for y, rs in sorted(by_y.items(), key=lambda kv: -kv[0]):
+        rs.sort(key=lambda r: r.x)
+        text = " ".join(r.text.strip() for r in rs if r.text.strip())
+        cells = [(r.x, r.text.strip()) for r in rs if r.text.strip()]
+        if text:
+            lines.append((y, max(r.size for r in rs), text, cells))
+    return lines
+
+
+def _group_blocks(lines) -> list[list]:
+    """Split a page's lines into blocks at vertical gaps > 1.8 line
+    heights (openparse's block grouping heuristic)."""
+    blocks: list[list] = []
+    cur: list = []
+    prev_y = None
+    for y, size, text, cells in lines:
+        if prev_y is not None and prev_y - y > 1.8 * size:
+            if cur:
+                blocks.append(cur)
+            cur = []
+        cur.append((y, size, text, cells))
+        prev_y = y
+    if cur:
+        blocks.append(cur)
+    return blocks
+
+
+def _looks_tabular(block) -> bool:
+    """≥2 rows sharing ≥2 aligned cell x-positions ⇒ a table."""
+    multi = [ln for ln in block if len(ln[3]) >= 2]
+    if len(multi) < 2:
+        return False
+    base = {round(x) for x, _ in multi[0][3]}
+    aligned = sum(
+        1
+        for ln in multi[1:]
+        if len(base & {round(x) for x, _ in ln[3]}) >= 2
+    )
+    return aligned >= len(multi) - 1
+
+
+def _render_table(block) -> str:
+    rows = [ln[3] for ln in block if ln[3]]
+    md = []
+    for i, cells in enumerate(rows):
+        md.append("| " + " | ".join(text for _x, text in cells) + " |")
+        if i == 0:
+            md.append("|" + "---|" * len(cells))
+    return "\n".join(md)
 
 
 def _cleanup_pdf_text(text: str) -> str:

@@ -1,0 +1,243 @@
+"""Native PDF extraction + OpenParse-equivalent structural chunking.
+
+reference: python/pathway/xpacks/llm/parsers.py:235 (OpenParse) and :746
+(PypdfParser).  VERDICT r1 next-step #10: parse a real multi-page PDF
+fixture into chunks with reference-quality segmentation.
+"""
+
+import asyncio
+import zlib
+
+import pytest
+
+from pathway_tpu.utils import pdftext
+from pathway_tpu.xpacks.llm.parsers import OpenParse, PypdfParser
+
+
+def _pdf_escape(s: str) -> str:
+    return s.replace("\\", r"\\").replace("(", r"\(").replace(")", r"\)")
+
+
+def build_pdf(pages: list[bytes], compress_pages=()) -> bytes:
+    """Assemble a minimal but valid multi-page PDF (Helvetica, xref
+    table, optional FlateDecode per page)."""
+    objs: list[bytes] = []
+
+    def add(body: bytes) -> int:
+        objs.append(body)
+        return len(objs)  # 1-indexed object number
+
+    font = add(
+        b"<< /Type /Font /Subtype /Type1 /BaseFont /Helvetica >>"
+    )
+    content_ids = []
+    for i, content in enumerate(pages):
+        if i in compress_pages:
+            data = zlib.compress(content)
+            content_ids.append(
+                add(
+                    b"<< /Length %d /Filter /FlateDecode >>\nstream\n%s\nendstream"
+                    % (len(data), data)
+                )
+            )
+        else:
+            content_ids.append(
+                add(
+                    b"<< /Length %d >>\nstream\n%s\nendstream"
+                    % (len(content), content)
+                )
+            )
+    pages_id = len(objs) + len(pages) + 1
+    page_ids = []
+    for cid in content_ids:
+        page_ids.append(
+            add(
+                b"<< /Type /Page /Parent %d 0 R /MediaBox [0 0 612 792] "
+                b"/Resources << /Font << /F1 %d 0 R >> >> /Contents %d 0 R >>"
+                % (pages_id, font, cid)
+            )
+        )
+    kids = b" ".join(b"%d 0 R" % p for p in page_ids)
+    assert add(
+        b"<< /Type /Pages /Kids [%s] /Count %d >>" % (kids, len(page_ids))
+    ) == pages_id
+    catalog = add(b"<< /Type /Catalog /Pages %d 0 R >>" % pages_id)
+
+    out = bytearray(b"%PDF-1.4\n")
+    offsets = []
+    for num, body in enumerate(objs, start=1):
+        offsets.append(len(out))
+        out += b"%d 0 obj\n" % num + body + b"\nendobj\n"
+    xref_at = len(out)
+    out += b"xref\n0 %d\n" % (len(objs) + 1)
+    out += b"0000000000 65535 f \n"
+    for off in offsets:
+        out += b"%010d 00000 n \n" % off
+    out += (
+        b"trailer\n<< /Size %d /Root %d 0 R >>\nstartxref\n%d\n%%%%EOF"
+        % (len(objs) + 1, catalog, xref_at)
+    )
+    return bytes(out)
+
+
+def text_ops(items, size=11, start=(72, 720), leading=14) -> bytes:
+    """BT..ET block: items are strings (lines) or (x, y, size, text)."""
+    ops = [b"BT", b"/F1 %d Tf" % size, b"%d %d Td" % start, b"%d TL" % leading]
+    first = True
+    for item in items:
+        if isinstance(item, tuple):
+            x, y, sz, text = item
+            ops.append(b"/F1 %d Tf" % sz)
+            ops.append(b"1 0 0 1 %d %d Tm" % (x, y))
+            ops.append(b"(%s) Tj" % _pdf_escape(text).encode("latin-1"))
+        else:
+            if not first:
+                ops.append(b"T*")
+            ops.append(b"(%s) Tj" % _pdf_escape(item).encode("latin-1"))
+        first = False
+    ops.append(b"ET")
+    return b"\n".join(ops)
+
+
+@pytest.fixture
+def fixture_pdf() -> bytes:
+    page1 = b"\n".join(
+        [
+            text_ops([(72, 720, 20, "Quarterly Report")]),
+            text_ops(
+                [
+                    "Revenue grew twelve percent over the prior",
+                    "quarter, driven by the new search product.",
+                ],
+                start=(72, 680),
+            ),
+            text_ops(
+                [
+                    "Costs stayed flat while headcount rose,",
+                    "reflecting infrastructure efficiency gains.",
+                ],
+                start=(72, 600),
+            ),
+        ]
+    )
+    page2 = b"\n".join(
+        [
+            text_ops([(72, 720, 18, "Segment Results")]),
+            # table: three rows with aligned columns at x=72/220/380
+            text_ops([(72, 660, 11, "Segment")]),
+            text_ops([(220, 660, 11, "Revenue")]),
+            text_ops([(380, 660, 11, "Margin")]),
+            text_ops([(72, 644, 11, "Search")]),
+            text_ops([(220, 644, 11, "120")]),
+            text_ops([(380, 644, 11, "31%")]),
+            text_ops([(72, 628, 11, "Cloud")]),
+            text_ops([(220, 628, 11, "84")]),
+            text_ops([(380, 628, 11, "19%")]),
+        ]
+    )
+    page3 = b"\n".join(
+        [
+            text_ops([(72, 720, 18, "Outlook")]),
+            text_ops(
+                [
+                    "We expect continued growth next quarter",
+                    "with stable operating margins.",
+                ],
+                start=(72, 680),
+            ),
+        ]
+    )
+    return build_pdf([page1, page2, page3], compress_pages={2})
+
+
+def test_native_page_texts(fixture_pdf):
+    doc = pdftext.PdfDocument(fixture_pdf)
+    pages = doc.pages()
+    assert len(pages) == 3
+    t1 = pdftext.extract_page_text(doc, pages[0])
+    assert "Quarterly Report" in t1
+    assert "Revenue grew twelve percent" in t1
+    # paragraph gap between the two body blocks
+    assert "\n\n" in t1
+    t3 = pdftext.extract_page_text(doc, pages[2])  # FlateDecode page
+    assert "stable operating margins" in t3
+
+
+def test_pypdf_parser_native_fallback(fixture_pdf):
+    parser = PypdfParser()
+    chunks = asyncio.run(parser.__wrapped__(fixture_pdf))
+    assert len(chunks) == 3
+    texts = [c for c, _m in chunks]
+    metas = [m for _c, m in chunks]
+    assert [m["page_number"] for m in metas] == [1, 2, 3]
+    assert "Quarterly Report" in texts[0]
+    assert "Search" in texts[1] and "120" in texts[1]
+    # soft newlines unwrapped by cleanup
+    assert "prior quarter" in texts[0].replace("\n", " ")
+
+
+def test_openparse_structural_chunks(fixture_pdf):
+    parser = OpenParse()
+    chunks = asyncio.run(parser.__wrapped__(fixture_pdf))
+    kinds = [(m["kind"], m["page_number"]) for _t, m in chunks]
+    # page 1: heading + two text blocks
+    assert ("heading", 1) in kinds and ("text", 1) in kinds
+    # page 2: heading + a table block rendered as markdown
+    tables = [t for t, m in chunks if m["kind"] == "table"]
+    assert tables, kinds
+    table = tables[0]
+    assert table.splitlines()[0].startswith("| Segment | Revenue | Margin |")
+    assert "| Search | 120 | 31% |" in table
+    # chunks carry their section heading context
+    outlook = [
+        m for t, m in chunks if m["kind"] == "text" and "continued growth" in t
+    ]
+    assert outlook and outlook[0]["headings"] == ["Outlook"]
+
+
+def test_hex_strings_and_escapes():
+    content = b"\n".join(
+        [
+            b"BT /F1 12 Tf 72 700 Td",
+            b"(Paren \\(escaped\\) and octal: \\101\\102) Tj",
+            b"1 0 0 1 72 680 Tm",
+            b"<48656C6C6F> Tj",
+            b"ET",
+        ]
+    )
+    pdf = build_pdf([content])
+    doc = pdftext.PdfDocument(pdf)
+    text = pdftext.extract_page_text(doc, doc.pages()[0])
+    assert "Paren (escaped) and octal: AB" in text
+    assert "Hello" in text
+
+
+def test_tj_array_spacing():
+    content = (
+        b"BT /F1 12 Tf 72 700 Td "
+        b"[(Hel) -50 (lo) -400 (world)] TJ ET"
+    )
+    pdf = build_pdf([content])
+    doc = pdftext.PdfDocument(pdf)
+    text = pdftext.extract_page_text(doc, doc.pages()[0])
+    # small kern joins, large kern becomes a word gap
+    assert "Hello world" in text
+
+
+def test_real_producer_matplotlib_pdf(tmp_path):
+    """Extraction from a PDF written by a real third-party producer
+    (matplotlib's PDF backend: embedded Type1 fonts, Flate streams)."""
+    matplotlib = pytest.importorskip("matplotlib")
+    matplotlib.use("pdf")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.set_title("Throughput versus batch size")
+    ax.text(0.1, 0.5, "The quick brown fox jumps over the lazy dog")
+    fig.savefig(tmp_path / "plot.pdf")
+    plt.close(fig)
+
+    doc = pdftext.PdfDocument((tmp_path / "plot.pdf").read_bytes())
+    text = pdftext.extract_page_text(doc, doc.pages()[0])
+    assert "quick brown fox" in text
+    assert "Throughput versus batch size" in text
