@@ -1,31 +1,49 @@
 """``pw.io.bigquery`` — BigQuery sink
-(reference: python/pathway/io/bigquery).  Needs ``google-cloud-bigquery``.
+(reference: python/pathway/io/bigquery over the buffered Rust writer,
+src/connectors/data_storage.rs:1080+).  Needs ``google-cloud-bigquery``.
 """
 
 from __future__ import annotations
 
+from typing import Any
+
 from ...internals.table import Table
-from .._subscribe import subscribe
+from .._buffered import buffered_subscribe
 
 __all__ = ["write"]
 
 
-def write(table: Table, dataset_name: str, table_name: str, service_user_credentials_file: str | None = None, **kwargs) -> None:
-    from google.cloud import bigquery  # optional dependency
+def write(
+    table: Table,
+    dataset_name: str,
+    table_name: str,
+    service_user_credentials_file: str | None = None,
+    *,
+    max_batch_size: int = 500,  # BigQuery's insert_rows_json soft limit
+    max_retries: int = 3,
+    client: Any = None,
+    **kwargs,
+) -> None:
+    if client is None:
+        from google.cloud import bigquery  # optional dependency
 
-    if service_user_credentials_file is not None:
-        client = bigquery.Client.from_service_account_json(service_user_credentials_file)
-    else:
-        client = bigquery.Client()
-    names = table.column_names()
+        if service_user_credentials_file is not None:
+            client = bigquery.Client.from_service_account_json(
+                service_user_credentials_file
+            )
+        else:
+            client = bigquery.Client()
     target = f"{dataset_name}.{table_name}"
 
-    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
-        doc = {n: row[n] for n in names}
-        doc["time"] = time
-        doc["diff"] = 1 if is_addition else -1
-        errors = client.insert_rows_json(target, [doc])
+    def flush_batch(batch: list[dict]) -> None:
+        errors = client.insert_rows_json(target, batch)
         if errors:
             raise RuntimeError(f"bigquery insert failed: {errors}")
 
-    subscribe(table, on_change=on_change, name=f"bq:{target}")
+    buffered_subscribe(
+        table,
+        flush_batch,
+        name=f"bq:{target}",
+        max_batch=max_batch_size,
+        max_retries=max_retries,
+    )

@@ -1,8 +1,9 @@
 """``pw.io.elasticsearch`` — Elasticsearch sink.
 
 reference: python/pathway/io/elasticsearch over the Rust
-``ElasticSearchWriter`` (src/connectors/data_storage.rs:1336).
-Needs the ``elasticsearch`` client at call time.
+``ElasticSearchWriter`` (src/connectors/data_storage.rs:1336 — the bulk
+API with buffered batches).  Needs the ``elasticsearch`` client at call
+time.
 """
 
 from __future__ import annotations
@@ -10,24 +11,45 @@ from __future__ import annotations
 from typing import Any
 
 from ...internals.table import Table
-from .._subscribe import subscribe
+from .._buffered import buffered_subscribe
 
 __all__ = ["write"]
 
 
-def write(table: Table, host: str, auth: Any = None, index_name: str = "pathway", **kwargs) -> None:
-    from elasticsearch import Elasticsearch  # optional dependency
+def write(
+    table: Table,
+    host: str,
+    auth: Any = None,
+    index_name: str = "pathway",
+    *,
+    max_batch_size: int = 512,
+    max_retries: int = 3,
+    client: Any = None,
+    **kwargs,
+) -> None:
+    if client is None:
+        from elasticsearch import Elasticsearch  # optional dependency
 
-    client_kwargs: dict = {"hosts": [host], **kwargs}
-    if auth is not None:
-        client_kwargs["basic_auth"] = auth
-    client = Elasticsearch(**client_kwargs)
-    names = table.column_names()
+        client_kwargs: dict = {"hosts": [host], **kwargs}
+        if auth is not None:
+            client_kwargs["basic_auth"] = auth
+        client = Elasticsearch(**client_kwargs)
 
-    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
-        doc = {n: row[n] for n in names}
-        doc["time"] = time
-        doc["diff"] = 1 if is_addition else -1
-        client.index(index=index_name, document=doc)
+    def flush_batch(batch: list[dict]) -> None:
+        # bulk API: action line + document line per row (data_storage.rs
+        # ElasticSearchWriter uses the same index-action bulk layout)
+        ops: list[dict] = []
+        for doc in batch:
+            ops.append({"index": {"_index": index_name}})
+            ops.append(doc)
+        resp = client.bulk(operations=ops, index=index_name)
+        if isinstance(resp, dict) and resp.get("errors"):
+            raise RuntimeError(f"elasticsearch bulk failed: {resp}")
 
-    subscribe(table, on_change=on_change, name=f"es:{index_name}")
+    buffered_subscribe(
+        table,
+        flush_batch,
+        name=f"es:{index_name}",
+        max_batch=max_batch_size,
+        max_retries=max_retries,
+    )

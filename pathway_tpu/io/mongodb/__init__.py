@@ -1,28 +1,44 @@
 """``pw.io.mongodb`` — MongoDB sink.
 
 reference: python/pathway/io/mongodb over the Rust ``MongoWriter``
-(src/connectors/data_storage.rs:2232).  Needs ``pymongo`` at call time.
+(src/connectors/data_storage.rs:2232 — insert_many batches).
+Needs ``pymongo`` at call time.
 """
 
 from __future__ import annotations
 
+from typing import Any
+
 from ...internals.table import Table
-from .._subscribe import subscribe
+from .._buffered import buffered_subscribe
 
 __all__ = ["write"]
 
 
-def write(table: Table, connection_string: str, database: str, collection: str, **kwargs) -> None:
-    import pymongo  # optional dependency
+def write(
+    table: Table,
+    connection_string: str,
+    database: str,
+    collection: str,
+    *,
+    max_batch_size: int = 512,
+    max_retries: int = 3,
+    client: Any = None,
+    **kwargs,
+) -> None:
+    close = None
+    if client is None:
+        import pymongo  # optional dependency
 
-    client = pymongo.MongoClient(connection_string)
+        client = pymongo.MongoClient(connection_string)
+        close = client.close
     coll = client[database][collection]
-    names = table.column_names()
 
-    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
-        doc = {n: row[n] for n in names}
-        doc["time"] = time
-        doc["diff"] = 1 if is_addition else -1
-        coll.insert_one(doc)
-
-    subscribe(table, on_change=on_change, on_end=client.close, name=f"mongo:{collection}")
+    buffered_subscribe(
+        table,
+        coll.insert_many,
+        name=f"mongo:{collection}",
+        max_batch=max_batch_size,
+        max_retries=max_retries,
+        on_close=close,
+    )

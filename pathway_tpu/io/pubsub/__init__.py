@@ -7,19 +7,37 @@ from __future__ import annotations
 import json as _json
 
 from ...internals.table import Table
-from .._subscribe import subscribe
+from .._buffered import buffered_subscribe
 
 __all__ = ["write"]
 
 
-def write(table: Table, publisher, project_id: str, topic_id: str) -> None:
-    names = table.column_names()
+def write(
+    table: Table,
+    publisher,
+    project_id: str,
+    topic_id: str,
+    *,
+    max_batch_size: int = 256,
+    max_retries: int = 3,
+) -> None:
     topic_path = publisher.topic_path(project_id, topic_id)
 
-    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
-        payload = {n: row[n] for n in names}
-        payload["time"] = time
-        payload["diff"] = 1 if is_addition else -1
-        publisher.publish(topic_path, _json.dumps(payload, default=str).encode())
+    def flush_batch(batch: list[dict]) -> None:
+        futures = [
+            publisher.publish(
+                topic_path, _json.dumps(doc, default=str).encode()
+            )
+            for doc in batch
+        ]
+        for f in futures:  # publish() is async — confirm the whole batch
+            if hasattr(f, "result"):
+                f.result(timeout=60)
 
-    subscribe(table, on_change=on_change, name=f"pubsub:{topic_id}")
+    buffered_subscribe(
+        table,
+        flush_batch,
+        name=f"pubsub:{topic_id}",
+        max_batch=max_batch_size,
+        max_retries=max_retries,
+    )
