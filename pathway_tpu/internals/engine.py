@@ -171,9 +171,20 @@ class RowwiseNode(Node):
         self.memoize = memoize
         self._memo: dict[tuple, list] = {}
 
+    #: below this batch size the pool's dispatch overhead beats the win
+    PARALLEL_MIN_ROWS = 64
+
     def flush(self, time: int) -> list[Entry]:
+        entries = self.take(0)
+        pool = getattr(getattr(self, "engine", None), "host_pool", None)
+        if (
+            pool is not None
+            and not self.memoize
+            and len(entries) >= self.PARALLEL_MIN_ROWS
+        ):
+            return consolidate(self._flush_parallel(pool, entries))
         out: list[Entry] = []
-        for key, row, diff in self.take(0):
+        for key, row, diff in entries:
             if self.memoize:
                 mk = (key, freeze_row(row))
                 if mk in self._memo:
@@ -187,6 +198,30 @@ class RowwiseNode(Node):
                     (k, r, d * diff) for k, r, d in self.fn(key, row, 1)
                 )
         return consolidate(out)
+
+    def _flush_parallel(self, pool, entries: list[Entry]) -> list[Entry]:
+        """Split the batch across the host worker pool; chunk order is
+        preserved so output is identical to the serial path (timely's
+        worker shards, but within one operator's batch)."""
+        n = self.engine.threads
+        chunk_size = (len(entries) + n - 1) // n
+        chunks = [
+            entries[i : i + chunk_size]
+            for i in range(0, len(entries), chunk_size)
+        ]
+
+        def run_chunk(chunk):
+            part: list[Entry] = []
+            for key, row, diff in chunk:
+                part.extend(
+                    (k, r, d * diff) for k, r, d in self.fn(key, row, 1)
+                )
+            return part
+
+        out: list[Entry] = []
+        for part in pool.map(run_chunk, chunks):
+            out.extend(part)
+        return out
 
 
 class ZipNode(Node):
@@ -880,9 +915,26 @@ class Engine:
         self.frontier: int = -1
         # attached by pw.run when monitoring is on (internals/monitoring.py)
         self.monitor = None
+        #: host worker pool (PATHWAY_THREADS, reference timely
+        #: Config::process(threads), dataflow/config.rs:63-70): row-wise
+        #: operator batches split across threads.  Pure Python mappers are
+        #: GIL-bound, but UDFs doing IO or native work (numpy, JAX
+        #: dispatch, tokenizers, zlib) release the GIL and scale.
+        self.threads: int = 1
+        self.host_pool = None
+
+    def set_threads(self, threads: int) -> None:
+        if threads > 1 and self.host_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self.threads = threads
+            self.host_pool = ThreadPoolExecutor(
+                max_workers=threads, thread_name_prefix="pw-worker"
+            )
 
     def add(self, node: Node) -> Node:
         node.id = len(self.nodes)
+        node.engine = self
         self.nodes.append(node)
         if isinstance(node, SourceNode):
             self.sources.append(node)

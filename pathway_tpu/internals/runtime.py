@@ -112,6 +112,9 @@ class GraphRunner:
 
     # ---- public ----
     def build(self, output_requests: list[tuple[Any, OutputNode]]) -> Engine:
+        from .config import get_pathway_config
+
+        self.engine.set_threads(get_pathway_config().threads)
         ops = G.relevant_operators([t._operator for t, _ in output_requests])
         for op in ops:
             self._lower(op)

@@ -1,0 +1,75 @@
+"""PATHWAY_THREADS host worker pool (VERDICT r1 weak #8).
+
+reference: timely ``Config::process(threads)``
+(src/engine/dataflow/config.rs:63-70) — worker threads per process.  Here
+threads shard row-wise operator batches: pure-Python mappers stay
+GIL-bound, but UDFs doing IO or native work (numpy, JAX dispatch,
+tokenizers) release the GIL and scale.
+"""
+
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.config import get_pathway_config
+
+
+@pytest.fixture
+def threads4(monkeypatch):
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    get_pathway_config(refresh=True)
+    yield
+    monkeypatch.delenv("PATHWAY_THREADS")
+    get_pathway_config(refresh=True)
+
+
+def _rows_markdown(n):
+    lines = ["    v | __time__"]
+    for i in range(n):
+        lines.append(f"    {i} | 2")
+    return "\n".join(lines)
+
+
+def test_threads_identical_results(threads4):
+    """A 4-thread run must produce exactly the single-thread output."""
+    t = pw.debug.table_from_markdown(_rows_markdown(200))
+    r = t.select(t.v, w=pw.apply(lambda v: v * 3 + 1, t.v), g=t.v % 5)
+    g = r.groupby(r.g).reduce(r.g, s=pw.reducers.sum(r.w))
+    (out,) = pw.debug.materialize(g)
+    got = sorted(tuple(row) for row in out.current.values())
+
+    pw.internals.graph.G.clear()
+    import os
+
+    os.environ["PATHWAY_THREADS"] = "1"
+    get_pathway_config(refresh=True)
+    t1 = pw.debug.table_from_markdown(_rows_markdown(200))
+    r1 = t1.select(t1.v, w=pw.apply(lambda v: v * 3 + 1, t1.v), g=t1.v % 5)
+    g1 = r1.groupby(r1.g).reduce(r1.g, s=pw.reducers.sum(r1.w))
+    (out1,) = pw.debug.materialize(g1)
+    assert got == sorted(tuple(row) for row in out1.current.values())
+
+
+def test_threads_scale_gil_releasing_work(threads4):
+    """GIL-releasing per-row work (IO, native code — simulated with
+    sleep) must scale with the pool instead of serializing."""
+    n = 128
+    per_row = 0.004
+
+    t = pw.debug.table_from_markdown(_rows_markdown(n))
+
+    def slow(v):
+        time.sleep(per_row)  # sleep releases the GIL like native IO
+        return v + 1
+
+    r = t.select(w=pw.apply(slow, t.v))
+    t0 = time.perf_counter()
+    (out,) = pw.debug.materialize(r)
+    elapsed = time.perf_counter() - t0
+    assert len(out.current) == n
+    serial_floor = n * per_row  # 0.512s serial
+    assert elapsed < serial_floor / 2, (
+        f"{elapsed:.3f}s vs serial floor {serial_floor:.3f}s — "
+        "pool did not parallelize"
+    )
