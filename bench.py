@@ -89,18 +89,42 @@ def child_device(seconds: float = 10.0) -> None:
     else:
         enc = SentenceEncoder(max_length=128)
         docs = _corpus()
-    enc.encode(docs[:256])  # warmup: compile (batch_bucket, seq_bucket)
+    budget = float(os.environ.get("BENCH_CHILD_BUDGET_S", "240"))
+    child_deadline = time.monotonic() + budget
 
-    n_docs = 0
-    t0 = time.perf_counter()
-    while True:
-        enc.encode(docs)
-        n_docs += len(docs)
-        elapsed = time.perf_counter() - t0
-        if elapsed > seconds:
-            break
-    docs_per_sec = n_docs / elapsed
+    def measure(batch: int) -> float:
+        """Time steady-state encode at one chunk size (already warm)."""
+        n_docs = 0
+        t0 = time.perf_counter()
+        while True:
+            for start in range(0, len(docs), batch):
+                enc.encode(docs[start : start + batch])
+                n_docs += min(batch, len(docs) - start)
+            if time.perf_counter() - t0 > seconds:
+                break
+        return n_docs / (time.perf_counter() - t0)
 
+    # escalating warmup: a small bucket compiles fast and guarantees a
+    # number even on a slow/contended chip; the big bucket (better RPC
+    # amortization + MXU fill) upgrades the number only if the child's
+    # own budget still allows its compile + a timed window.  The small
+    # result is PRINTED before escalating — the parent takes the last
+    # JSON line, so a hang mid-escalation still yields a measurement.
+    small = 256
+    enc.encode(docs[:small])  # compile (256, seq)
+    docs_per_sec = _emit_device_result(measure(small), dev)
+    big = min(1024, len(docs))
+    # conservative escalation cost: a fresh-shape compile over the tunnel
+    # has been observed north of 150s
+    if big > small and time.monotonic() + 180 + seconds < child_deadline:
+        enc.encode(docs[:big])  # compile (1024, seq)
+        docs_per_sec = max(docs_per_sec, measure(big))
+
+    _emit_device_result(docs_per_sec, dev)
+
+
+def _emit_device_result(docs_per_sec: float, dev) -> float:
+    """Print one result JSON line (the parent keeps the LAST line)."""
     kind = getattr(dev, "device_kind", str(dev))
     peak = None
     for key, val in _PEAK_BF16.items():
@@ -117,8 +141,10 @@ def child_device(seconds: float = 10.0) -> None:
                 "flops_per_doc": FLOPS_PER_DOC,
                 "mfu": round(mfu, 4) if mfu is not None else None,
             }
-        )
+        ),
+        flush=True,
     )
+    return docs_per_sec
 
 
 # ---------------------------------------------------------------------------
@@ -174,6 +200,9 @@ def child_torch(seconds: float = 8.0) -> None:
 
 def _run_child(mode: str, env: dict | None, timeout: float) -> dict | None:
     child_env = dict(os.environ)
+    # the child paces its own warmup escalation against this (it cannot
+    # see the parent's subprocess timeout otherwise)
+    child_env["BENCH_CHILD_BUDGET_S"] = str(max(timeout - 30.0, 30.0))
     if env:
         child_env.update(env)
     try:
@@ -185,7 +214,18 @@ def _run_child(mode: str, env: dict | None, timeout: float) -> dict | None:
             env=child_env,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
+        # salvage a partial result: the device child prints its
+        # guaranteed small-batch measurement BEFORE attempting the big
+        # (slow-compiling) bucket, so a hang mid-escalation still counts
+        partial = exc.stdout
+        if isinstance(partial, bytes):
+            partial = partial.decode("utf-8", "replace")
+        for line in reversed((partial or "").strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
         return {"error": f"{mode} timed out after {timeout:.0f}s"}
     if proc.returncode != 0:
         return {"error": f"{mode} rc={proc.returncode}: {proc.stderr[-400:]}"}
@@ -236,12 +276,16 @@ def main() -> None:
 
     errors: list[str] = []
 
-    # 1) TPU attempts: init can hang, so bound + retry with backoff
+    # 1) TPU attempts: init can hang, so bound + retry with backoff —
+    # but never spend the reserve needed for the CPU fallback (120s) +
+    # baseline (60s): a degraded number always beats value 0.0
+    RESERVE = 190.0
     result = None
     for attempt, timeout in enumerate([300.0, 150.0]):
-        if left() < 200:
+        budget = min(timeout, left() - RESERVE)
+        if budget < 60:
             break
-        r = _run_child("--child-device", None, min(timeout, left() - 150))
+        r = _run_child("--child-device", None, budget)
         if r and "docs_per_sec" in r:
             result = r
             break

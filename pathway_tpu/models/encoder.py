@@ -29,7 +29,10 @@ from .tokenizer import HashTokenizer, load_tokenizer
 __all__ = ["EncoderConfig", "TransformerEncoder", "SentenceEncoder"]
 
 SEQ_BUCKETS = (32, 64, 128, 256, 512)
-BATCH_BUCKETS = (1, 8, 32, 128, 256)
+# large top buckets matter: the chip may sit behind a network tunnel where
+# every dispatch is an RPC — fewer, bigger launches amortize it and fill
+# the MXU (measured 9x end-to-end gap at batch 256 on a tunneled v5e)
+BATCH_BUCKETS = (1, 8, 32, 128, 256, 512, 1024)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +81,12 @@ class TransformerEncoder(nn.Module):
     @nn.compact
     def __call__(self, ids, mask, type_ids=None, pool: bool = True):
         cfg = self.cfg
+        # callers transfer narrow dtypes (u16 ids / u8 masks) to cut
+        # host↔device bytes; widen on device where it is free
+        ids = ids.astype(jnp.int32)
+        mask = mask.astype(jnp.int32)
+        if type_ids is not None:
+            type_ids = type_ids.astype(jnp.int32)
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_dim, param_dtype=jnp.float32, name="tok_emb"
         )(ids).astype(cfg.dtype)
@@ -129,7 +138,11 @@ def bucketed_dispatch(
         type_ids_all = type_ids_all[:, :seq]
     b = ids_all.shape[0]
     bb = _bucket(b, BATCH_BUCKETS)
-    outs = []
+    # dispatch every chunk before collecting any result: JAX's async
+    # dispatch queues the launches back-to-back, so device compute and
+    # host→device transfers for chunk n+1 overlap the device→host copy of
+    # chunk n — one sync at the end instead of one per chunk
+    pending = []
     start = 0
     while start < b:
         chunk = min(bb, b - start)
@@ -143,9 +156,11 @@ def bucketed_dispatch(
             tids = np.zeros((bb, seq), np.int32)
             tids[:chunk] = type_ids_all[start : start + chunk]
             args.append(jnp.asarray(tids))
-        res = np.asarray(apply_fn(*args), dtype=np.float32)
-        outs.append(res[:chunk])
+        pending.append((apply_fn(*args), chunk))
         start += chunk
+    outs = [
+        np.asarray(res, dtype=np.float32)[:chunk] for res, chunk in pending
+    ]
     return np.concatenate(outs, axis=0)
 
 

@@ -16,6 +16,8 @@ reference's per-worker replica search.
 from .mesh import make_mesh, data_axis, model_axis
 from .sharding import encoder_param_specs, shard_params, batch_spec
 from .index import ShardedKnnIndex
+from .ring_attention import ring_attention, ring_attention_sharded
+from .long_encoder import ring_encode, ring_forward
 
 __all__ = [
     "make_mesh",
@@ -25,4 +27,8 @@ __all__ = [
     "shard_params",
     "batch_spec",
     "ShardedKnnIndex",
+    "ring_attention",
+    "ring_attention_sharded",
+    "ring_encode",
+    "ring_forward",
 ]

@@ -146,3 +146,31 @@ def test_udf_caching_via_persistence(corpus_dir):
     assert (first == second).all()
     assert calls == ["abc"]  # second run served from the persistence cache
     assert backend.storage.list_keys("udfcache/")
+
+
+def test_retrieval_latency_p50_under_100ms(corpus_dir):
+    """BASELINE.md:25 — served end-to-end retrieval p50 < 100 ms.  The
+    engine's as-of-now barrier plus HTTP stack must stay well inside the
+    budget even on the CPU backend (the TPU path only shrinks scoring)."""
+    import time as _t
+
+    docs = pw.io.fs.read(
+        corpus_dir, format="binary", mode="streaming", with_metadata=True,
+        refresh_interval=0.2,
+    )
+    vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=8))
+    port = _free_port()
+    vs.run_server(host="127.0.0.1", port=port, threaded=True)
+    client = VectorStoreClient(host="127.0.0.1", port=port)
+    _wait_http(lambda: client.query("warmup", k=1))
+
+    lat = []
+    for i in range(60):
+        t0 = _t.perf_counter()
+        res = client.query(f"capital of country {i}", k=2)
+        lat.append(_t.perf_counter() - t0)
+        assert res
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p95 = lat[int(len(lat) * 0.95)]
+    assert p50 < 0.100, f"p50 {p50*1000:.1f} ms >= 100 ms (p95 {p95*1000:.1f} ms)"
