@@ -76,16 +76,27 @@ def freeze_value(v: Any) -> Any:
 
 
 def freeze_row(row: tuple) -> tuple:
-    return tuple(freeze_value(v) for v in row)
+    # fast path: rows are overwhelmingly tuples of hashable scalars —
+    # hashing probes that in C instead of a Python isinstance walk
+    try:
+        hash(row)
+        return row
+    except TypeError:
+        return tuple(freeze_value(v) for v in row)
 
 
 def consolidate(entries: Iterable[Entry]) -> list[Entry]:
     """Merge entries with equal (key, values), summing diffs, dropping zeros
     (differential's ``consolidate``)."""
     acc: dict[tuple, list] = {}
+    get = acc.get
     for key, row, diff in entries:
-        k = (key, freeze_row(row))
-        slot = acc.get(k)
+        try:
+            k = (key, row)
+            slot = get(k)
+        except TypeError:  # unhashable cell (ndarray/Json/list/dict)
+            k = (key, freeze_row(row))
+            slot = get(k)
         if slot is None:
             acc[k] = [key, row, diff]
         else:

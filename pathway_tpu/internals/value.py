@@ -211,7 +211,7 @@ class Pointer:
     same field decides which host/device shard owns the row in the TPU build.
     """
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "_hash")
 
     SHARD_BITS = 16
     SHARD_MASK = (1 << SHARD_BITS) - 1
@@ -219,6 +219,9 @@ class Pointer:
 
     def __init__(self, value: int):
         self.value = value & (self._MOD - 1)
+        # keys are hashed on every consolidate/groupby/join probe — cache
+        # the 128-bit int reduction once at construction
+        self._hash = hash(self.value)
 
     @property
     def shard(self) -> int:
@@ -247,7 +250,7 @@ class Pointer:
         return self.value >= other.value
 
     def __hash__(self) -> int:
-        return hash(self.value)
+        return self._hash
 
     def __repr__(self) -> str:
         return f"^{self.value:032X}"
