@@ -1031,3 +1031,7 @@ class Engine:
         self.step(self.frontier + 1)
         for node in self.nodes:
             node.on_stream_close()
+        if self.host_pool is not None:
+            # each run builds its own engine — don't leak worker threads
+            self.host_pool.shutdown(wait=False)
+            self.host_pool = None

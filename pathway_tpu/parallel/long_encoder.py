@@ -118,6 +118,13 @@ def ring_encode(params, ids, mask, mesh: Mesh, axis: str, *,
             f"global sequence {ids.shape[1]} not divisible by mesh axis "
             f"{axis} size {n}"
         )
+    max_len = params["pos_emb"]["embedding"].shape[0]
+    if ids.shape[1] > max_len:
+        # jit would silently clamp the position gather — wrong embeddings
+        raise ValueError(
+            f"global sequence {ids.shape[1]} exceeds the checkpoint's "
+            f"position table ({max_len}); extend pos_emb before encoding"
+        )
     seq_spec = NamedSharding(mesh, P(None, axis))
     ids = jax.device_put(jnp.asarray(ids, jnp.int32), seq_spec)
     mask = jax.device_put(jnp.asarray(mask, jnp.int32), seq_spec)

@@ -66,7 +66,7 @@ def ring_attention(q, k, v, kv_valid, axis_name: str):
             "bhts,bshd->bthd", p, v.astype(jnp.float32)
         )
         m = m_new
-        if n > 1:
+        if _step < n - 1:  # the last step's rotation would never be read
             k = lax.ppermute(k, axis_name, perm)
             v = lax.ppermute(v, axis_name, perm)
             kv_valid = lax.ppermute(kv_valid, axis_name, perm)
@@ -75,8 +75,8 @@ def ring_attention(q, k, v, kv_valid, axis_name: str):
 
 
 @functools.lru_cache(maxsize=None)
-def _compiled_ring(mesh: Mesh, axis: str, b, t, h, dh, dtype_name):
-    dtype = jnp.dtype(dtype_name)
+def _compiled_ring(mesh: Mesh, axis: str):
+    # jit specializes on shapes/dtypes itself — cache only per (mesh, axis)
 
     @jax.jit
     def run(q, k, v, valid):
@@ -97,8 +97,4 @@ def ring_attention_sharded(q, k, v, kv_valid, mesh: Mesh, axis: str):
     spec = NamedSharding(mesh, P(None, axis))
     q, k, v = (jax.device_put(x, spec) for x in (q, k, v))
     kv_valid = jax.device_put(kv_valid, spec)
-    fn = _compiled_ring(
-        mesh, axis, q.shape[0], q.shape[1], q.shape[2], q.shape[3],
-        str(q.dtype),
-    )
-    return fn(q, k, v, kv_valid)
+    return _compiled_ring(mesh, axis)(q, k, v, kv_valid)

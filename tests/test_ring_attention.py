@@ -91,3 +91,22 @@ def test_ring_encode_rejects_indivisible_sequence(sp_mesh):
             params, ids, jnp.ones_like(ids), sp_mesh, "sp",
             num_layers=1, ln_eps=cfg.ln_eps,
         )
+
+
+def test_ring_encode_rejects_overlong_sequence(sp_mesh):
+    """T_global beyond the checkpoint's position table must raise, not
+    silently clamp the position gather."""
+    cfg = EncoderConfig(
+        vocab_size=50, hidden_dim=16, num_layers=1, num_heads=2,
+        mlp_dim=32, max_len=16, dtype=jnp.float32,
+    )
+    model = TransformerEncoder(cfg)
+    ids = jnp.zeros((1, 32), jnp.int32)  # 32 > max_len 16, divisible by 8
+    params = model.init(
+        jax.random.PRNGKey(0), ids[:, :8], jnp.ones((1, 8), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="position table"):
+        ring_encode(
+            params, ids, jnp.ones_like(ids), sp_mesh, "sp",
+            num_layers=1, ln_eps=cfg.ln_eps,
+        )
