@@ -184,3 +184,49 @@ def test_oracle_filter_groupby_join_chain(seed):
         return g.join(agg, g.g == agg.g).select(g.k, g.v, agg.s)
 
     assert_oracle(build, seed)
+
+
+# ---------------------------------------------------------------------------
+# temporal operators under the same oracle: random diff streams through
+# interval joins and sliding windows must equal batch recompute at every
+# timestamp (reference: tests/temporal/* assert final states only — the
+# incremental path here is checked at every prefix)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_oracle_interval_join(seed):
+    def build(left, right):
+        l2 = left.select(lt=left.v, lk=left.k)
+        r2 = right.select(rt=right.v, rk=right.k)
+        return l2.interval_join(
+            r2, l2.lt, r2.rt, pw.temporal.interval(-3, 3)
+        ).select(l2.lk, r2.rk, d=l2.lt - r2.rt)
+
+    assert_oracle(build, seed, binary=True)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_oracle_sliding_window(seed):
+    def build(t):
+        return t.windowby(
+            t.v, window=pw.temporal.sliding(hop=3, duration=6)
+        ).reduce(
+            start=pw.this._pw_window_start,
+            c=pw.reducers.count(),
+            s=pw.reducers.sum(pw.this.v),
+        )
+
+    assert_oracle(build, seed)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_oracle_interval_join_outer(seed):
+    def build(left, right):
+        l2 = left.select(lt=left.v, lk=left.k)
+        r2 = right.select(rt=right.v, rk=right.k)
+        return l2.interval_join_outer(
+            r2, l2.lt, r2.rt, pw.temporal.interval(-2, 2)
+        ).select(l2.lk, r2.rk)
+
+    assert_oracle(build, seed, binary=True)
