@@ -70,6 +70,7 @@ from .internals.row_transformer import (
     transformer,
 )
 from .internals.run import run, run_all, MonitoringLevel
+from .internals.config import set_license_key
 from .internals.graph import G as global_graph
 from .internals.iterate import iterate, iterate_universe
 
@@ -283,6 +284,7 @@ __all__ = [
     "iterate_universe",
     "run",
     "run_all",
+    "set_license_key",
     "groupby",
     "column_definition",
     "schema_from_types",

@@ -46,11 +46,12 @@ class PathwayConfig:
     monitoring_http_port: int | None = None
     ignore_asserts: bool = False
     skip_start_log: bool = False
+    license_key: str | None = None
 
     @classmethod
     def from_env(cls) -> "PathwayConfig":
         port = os.environ.get("PATHWAY_MONITORING_HTTP_PORT")
-        return cls(
+        cfg = cls(
             threads=_env_int("PATHWAY_THREADS", 1),
             processes=_env_int("PATHWAY_PROCESSES", 1),
             process_id=_env_int("PATHWAY_PROCESS_ID", 0),
@@ -63,7 +64,29 @@ class PathwayConfig:
             in ("1", "true", "yes"),
             skip_start_log=os.environ.get("PATHWAY_SKIP_START_LOG", "").lower()
             in ("1", "true", "yes"),
+            license_key=os.environ.get("PATHWAY_LICENSE_KEY") or None,
         )
+        cfg._apply_worker_cap()
+        return cfg
+
+    def _apply_worker_cap(self) -> None:
+        """Free-tier worker ceiling (reference: config.rs:98-107 — reduce
+        threads, then processes, warning rather than failing; a license
+        key lifts the cap the way the unlimited-workers feature does)."""
+        if self.license_key is not None:
+            return
+        if self.total_workers > MAX_WORKERS:
+            import warnings
+
+            warnings.warn(
+                f"{self.total_workers} workers exceeds the maximum allowed "
+                f"({MAX_WORKERS}), reducing (set PATHWAY_LICENSE_KEY to lift)",
+                stacklevel=3,
+            )
+            self.threads = max(MAX_WORKERS // self.processes, 0)
+            if self.threads == 0:
+                self.threads = 1
+                self.processes = MAX_WORKERS
 
     @property
     def total_workers(self) -> int:
@@ -78,3 +101,14 @@ def get_pathway_config(refresh: bool = False) -> PathwayConfig:
     if _config is None or refresh:
         _config = PathwayConfig.from_env()
     return _config
+
+
+def set_license_key(key: str | None) -> None:
+    """Set the license key programmatically (reference:
+    python/pathway/internals/config.py:125 ``set_license_key`` — lifts the
+    free-tier worker cap the way PATHWAY_LICENSE_KEY does)."""
+    if key is None:
+        os.environ.pop("PATHWAY_LICENSE_KEY", None)
+    else:
+        os.environ["PATHWAY_LICENSE_KEY"] = key
+    get_pathway_config(refresh=True)

@@ -273,3 +273,35 @@ def test_rag_example_app_end_to_end():
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["value"] == 1.0
     assert result["n_questions"] == 3
+
+
+def test_free_tier_worker_cap(monkeypatch):
+    """reference: config.rs:98-107 — threads*processes capped at 8 without
+    a license key, reducing threads first with a warning."""
+    import warnings
+
+    from pathway_tpu.internals.config import PathwayConfig, get_pathway_config
+
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    monkeypatch.setenv("PATHWAY_PROCESSES", "4")
+    monkeypatch.delenv("PATHWAY_LICENSE_KEY", raising=False)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cfg = PathwayConfig.from_env()
+    assert cfg.total_workers <= 8
+    assert cfg.threads == 2 and cfg.processes == 4
+    assert any("maximum allowed" in str(x.message) for x in w)
+
+    # a license key lifts the cap (reference unlimited-workers feature)
+    monkeypatch.setenv("PATHWAY_LICENSE_KEY", "test-key")
+    cfg = PathwayConfig.from_env()
+    assert cfg.threads == 4 and cfg.processes == 4
+
+    # programmatic API parity
+    import pathway_tpu as pw
+
+    monkeypatch.delenv("PATHWAY_LICENSE_KEY", raising=False)
+    pw.set_license_key("another-key")
+    assert get_pathway_config().license_key == "another-key"
+    pw.set_license_key(None)
+    assert get_pathway_config(refresh=True).license_key is None
