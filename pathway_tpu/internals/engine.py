@@ -303,6 +303,14 @@ class GroupByNode(Node):
         self.group_raw: dict[tuple, tuple] = {}
         self.group_instance: dict[tuple, Any] = {}
         self.last_out: dict[tuple, Entry] = {}
+        #: O(1) running aggregates per group for decomposable reducers
+        #: (count/sum/avg) — a touched group emits from these instead of
+        #: recomputing over its whole multiset; a state whose exactness
+        #: flag (last element) dropped falls back to recompute
+        self._inc_idx = [
+            i for i, r in enumerate(self.reducers) if r.incremental
+        ]
+        self.red_state: dict[tuple, dict[int, list]] = {}
 
     def flush(self, time: int) -> list[Entry]:
         dirty: set[tuple] = set()
@@ -322,6 +330,14 @@ class GroupByNode(Node):
             slot[0] += diff
             if slot[0] == 0:
                 del self.state[gfrozen][afrozen]
+            if self._inc_idx:
+                states = self.red_state.get(gfrozen)
+                if states is None:
+                    states = self.red_state[gfrozen] = {
+                        i: self.reducers[i].init_state() for i in self._inc_idx
+                    }
+                for i in self._inc_idx:
+                    self.reducers[i].update(states[i], args[i], diff)
             dirty.add(gfrozen)
         out: list[Entry] = []
         for gfrozen in dirty:
@@ -331,19 +347,26 @@ class GroupByNode(Node):
                 out.append((prev[0], prev[1], -1))
             if not group_state:
                 self.state.pop(gfrozen, None)
+                self.red_state.pop(gfrozen, None)
                 continue
             gvals = self.group_raw[gfrozen]
             instance = self.group_instance.get(gfrozen)
-            rows = list(group_state.values())  # [count, args, key, sort_key, seq]
-            if self.sort_by_fn is not None:
-                # None sort keys (outer-join padding rows) order last
-                rows.sort(key=lambda s: (s[3] is None, s[3]))
-            values = [
-                red.compute(
-                    [(s[1][i], s[0], s[2], s[4]) for s in rows]
+            rows = None
+            inc_states = self.red_state.get(gfrozen, {})
+            values = []
+            for i, red in enumerate(self.reducers):
+                st = inc_states.get(i)
+                if st is not None and st[-1]:
+                    values.append(red.current(st))
+                    continue
+                if rows is None:
+                    rows = list(group_state.values())  # [count,args,key,sk,seq]
+                    if self.sort_by_fn is not None:
+                        # None sort keys (outer-join padding) order last
+                        rows.sort(key=lambda s: (s[3] is None, s[3]))
+                values.append(
+                    red.compute([(s[1][i], s[0], s[2], s[4]) for s in rows])
                 )
-                for i, red in enumerate(self.reducers)
-            ]
             if self.key_fn is not None:
                 out_key = self.key_fn(gvals, instance)
             else:

@@ -55,11 +55,27 @@ def _arg1(args):
 class Reducer:
     name = "reducer"
     distinguish_by_key = False
+    #: decomposable reducers support O(1) per-diff updates (reference:
+    #: differential's monoid aggregation in reduce.rs) — the groupby node
+    #: then skips the O(group) recompute for touched groups.  A state may
+    #: declare itself inexact (state[-1] False) to force recompute — used
+    #: by sum/avg when non-integer values appear, where incremental
+    #: subtraction would drift from the batch result.
+    incremental = False
 
     def result_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         return dt.ANY
 
     def compute(self, rows: list) -> Any:
+        raise NotImplementedError
+
+    def init_state(self) -> list:
+        raise NotImplementedError
+
+    def update(self, state: list, args, dcount: int) -> None:
+        raise NotImplementedError
+
+    def current(self, state: list) -> Any:
         raise NotImplementedError
 
     def __repr__(self):
@@ -68,6 +84,7 @@ class Reducer:
 
 class CountReducer(Reducer):
     name = "count"
+    incremental = True
 
     def result_dtype(self, arg_dtypes):
         return dt.INT
@@ -75,9 +92,19 @@ class CountReducer(Reducer):
     def compute(self, rows):
         return _builtin_sum(c for _, c, _, _ in rows)
 
+    def init_state(self):
+        return [0, True]
+
+    def update(self, state, args, dcount):
+        state[0] += dcount
+
+    def current(self, state):
+        return state[0]
+
 
 class SumReducer(Reducer):
     name = "sum"
+    incremental = True
 
     def result_dtype(self, arg_dtypes):
         inner = dt.unoptionalize(arg_dtypes[0]) if arg_dtypes else dt.ANY
@@ -95,9 +122,29 @@ class SumReducer(Reducer):
             total = contrib if total is None else total + contrib
         return total if total is not None else 0
 
+    # incremental only over exact (int) values: float/ndarray retraction
+    # arithmetic can drift from the batch result, so a non-int poisons the
+    # state and the group falls back to full recompute
+    def init_state(self):
+        return [0, 0, True]  # total, non-None contributions, exact
+
+    def update(self, state, args, dcount):
+        v = _arg1(args)
+        if v is None:
+            return
+        if type(v) is not int:
+            state[2] = False
+            return
+        state[0] += v * dcount
+        state[1] += dcount
+
+    def current(self, state):
+        return state[0]
+
 
 class AvgReducer(Reducer):
     name = "avg"
+    incremental = True
 
     def result_dtype(self, arg_dtypes):
         return dt.FLOAT
@@ -112,6 +159,23 @@ class AvgReducer(Reducer):
             total += v * c
             n += c
         return total / n if n else None
+
+    def init_state(self):
+        return [0, 0, True]  # int total, count, exact
+
+    def update(self, state, args, dcount):
+        v = _arg1(args)
+        if v is None:
+            return
+        if type(v) is not int:
+            state[2] = False
+            return
+        state[0] += v * dcount
+        state[1] += dcount
+
+    def current(self, state):
+        # match compute(): float division, None on empty
+        return state[0] / state[1] if state[1] else None
 
 
 class MinReducer(Reducer):
