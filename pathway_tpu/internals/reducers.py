@@ -180,6 +180,8 @@ class AvgReducer(Reducer):
 
 class MinReducer(Reducer):
     name = "min"
+    incremental = True
+    _pick = staticmethod(_builtin_min)
 
     def result_dtype(self, arg_dtypes):
         return arg_dtypes[0] if arg_dtypes else dt.ANY
@@ -188,13 +190,65 @@ class MinReducer(Reducer):
         vals = [_arg1(a) for a, c, _, _ in rows if _arg1(a) is not None]
         return _builtin_min(vals) if vals else None
 
+    # incremental extremum over a value multiset: O(1) per diff except
+    # when the current extremum is retracted, which costs O(distinct)
+    # once, lazily.  Unhashable/incomparable values poison the state.
+    _UNKNOWN = object()
+
+    def init_state(self):
+        return [{}, self._UNKNOWN, True]  # value->count, cached ext, exact
+
+    def update(self, state, args, dcount):
+        v = _arg1(args)
+        if v is None:
+            return
+        counts, cached, _ = state
+        try:
+            n = counts.get(v, 0) + dcount
+        except TypeError:  # unhashable value
+            state[2] = False
+            return
+        if n:
+            counts[v] = n
+        else:
+            counts.pop(v, None)
+        if cached is self._UNKNOWN:
+            return
+        try:
+            if dcount > 0 and n > 0 and (cached is None or self._better(v, cached)):
+                state[1] = v
+            elif v == cached and n <= 0:
+                state[1] = self._UNKNOWN  # extremum left — recompute lazily
+        except TypeError:  # incomparable types
+            state[2] = False
+
+    def _better(self, a, b) -> bool:
+        return a < b
+
+    def current(self, state):
+        counts, cached, _ = state
+        if cached is self._UNKNOWN or (cached is not None and cached not in counts):
+            try:
+                cached = self._pick(counts) if counts else None
+            except TypeError:
+                # incomparable types: poison and surface the same error the
+                # batch compute() would raise
+                state[2] = False
+                raise
+            state[1] = cached
+        return cached
+
 
 class MaxReducer(MinReducer):
     name = "max"
+    _pick = staticmethod(_builtin_max)
 
     def compute(self, rows):
         vals = [_arg1(a) for a, c, _, _ in rows if _arg1(a) is not None]
         return _builtin_max(vals) if vals else None
+
+    def _better(self, a, b) -> bool:
+        return a > b
 
 
 class ArgMinReducer(Reducer):

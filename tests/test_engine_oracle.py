@@ -230,3 +230,31 @@ def test_oracle_interval_join_outer(seed):
         ).select(l2.lk, r2.rk)
 
     assert_oracle(build, seed, binary=True)
+
+
+def test_oracle_min_max_extremum_retraction():
+    """Adversarial for incremental extremum states: repeatedly insert a
+    new maximum, then retract it — every retraction forces the lazy
+    extremum recompute path."""
+    stream = []
+    t = 1
+    for i in range(6):
+        stream.append((100 + i, 50 + i, t, 1))  # new global max
+        stream.append((i, i, t, 1))             # filler
+        t += 1
+        stream.append((100 + i, 50 + i, t, -1))  # retract the max
+        t += 1
+
+    def build(tbl):
+        return tbl.reduce(
+            mx=pw.reducers.max(tbl.v),
+            mn=pw.reducers.min(tbl.v),
+            c=pw.reducers.count(),
+        )
+
+    history = run_incremental(build, stream)
+    times = sorted({tm for *_, tm, _d in stream})
+    for tt in list(times) + [times[-1] + 1]:
+        want = run_batch(build, prefix_rows(stream, tt))
+        got = state_at(history, tt)
+        assert got == want, (tt, sorted(got.items()), sorted(want.items()))
