@@ -18,10 +18,20 @@ Usage: python benchmarks/knn_crossover.py [N ...]   (default 10k 100k)
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    import jax
+
+    # a TPU shim may prepend its platform after env parsing; pinning the
+    # config is the only reliable way to honor a CPU request
+    jax.config.update("jax_platforms", "cpu")
 
 
 def run(n: int, dim: int = 384, n_queries: int = 64, k: int = 10) -> dict:

@@ -24,13 +24,11 @@ import time
 HERE = pathlib.Path(__file__).resolve().parent
 sys.path.insert(0, str(HERE.parent.parent))
 
-import os as _os  # noqa: E402
+import os  # noqa: E402
 
 # app.yaml's document path is repo-root-relative; make launching from any
 # cwd work
-_os.chdir(HERE.parent.parent)
-
-import os  # noqa: E402
+os.chdir(HERE.parent.parent)
 
 if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
     # honor a CPU request even when a TPU shim prepends its own platform

@@ -126,17 +126,16 @@ class SumReducer(Reducer):
     # arithmetic can drift from the batch result, so a non-int poisons the
     # state and the group falls back to full recompute
     def init_state(self):
-        return [0, 0, True]  # total, non-None contributions, exact
+        return [0, True]  # total, exact
 
     def update(self, state, args, dcount):
         v = _arg1(args)
         if v is None:
             return
         if type(v) is not int:
-            state[2] = False
+            state[1] = False
             return
         state[0] += v * dcount
-        state[1] += dcount
 
     def current(self, state):
         return state[0]

@@ -408,7 +408,7 @@ class _FakeAzureContainer:
 
 def test_azure_kv_roundtrip(tmp_path):
     backend = Backend.azure(
-        container_client=_FakeAzureContainer(tmp_path), prefix="pfx"
+        "pfx", container_client=_FakeAzureContainer(tmp_path)
     )
     kv = backend.storage
     assert kv.get("missing") is None
