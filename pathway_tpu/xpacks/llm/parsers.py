@@ -23,6 +23,7 @@ __all__ = [
     "ParseUnstructured",
     "PypdfParser",
     "OpenParse",
+    "AutoParser",
     "ImageParser",
     "SlideParser",
 ]
@@ -283,6 +284,26 @@ def _cleanup_pdf_text(text: str) -> str:
     text = re.sub(r"-\n(\w)", r"\1", text)  # de-hyphenate line breaks
     text = re.sub(r"(?<!\n)\n(?!\n)", " ", text)  # unwrap soft newlines
     return re.sub(r" {2,}", " ", text).strip()
+
+
+class AutoParser(UDF):
+    """Content-sniffing parser: routes each document by magic bytes —
+    PDFs through the structural :class:`OpenParse` pipeline (or plain
+    per-page extraction with ``structural=False``), everything else
+    through UTF-8 decoding.  The no-dependency counterpart of the
+    reference's auto-partitioning ``ParseUnstructured`` (parsers.py:79),
+    so a watched directory can mix .txt and .pdf files."""
+
+    def __init__(self, structural: bool = True, **kwargs):
+        super().__init__(deterministic=True)
+        self._pdf = OpenParse(**kwargs) if structural else PypdfParser()
+        self._text = Utf8Parser()
+
+    async def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
+        raw = bytes(contents)
+        if raw.startswith(b"%PDF"):
+            return await self._pdf.__wrapped__(raw, **kwargs)
+        return await self._text.__wrapped__(raw, **kwargs)
 
 
 class _VisionParserBase(UDF):

@@ -241,3 +241,53 @@ def test_real_producer_matplotlib_pdf(tmp_path):
     text = pdftext.extract_page_text(doc, doc.pages()[0])
     assert "quick brown fox" in text
     assert "Throughput versus batch size" in text
+
+
+def test_auto_parser_routes_by_magic_bytes(fixture_pdf):
+    from pathway_tpu.xpacks.llm.parsers import AutoParser
+
+    parser = AutoParser()
+    pdf_chunks = asyncio.run(parser.__wrapped__(fixture_pdf))
+    assert any(m["kind"] == "table" for _t, m in pdf_chunks)
+    txt_chunks = asyncio.run(parser.__wrapped__("plain text body".encode()))
+    assert txt_chunks == [("plain text body", {})]
+
+
+def test_auto_parser_end_to_end_vector_store(fixture_pdf, tmp_path):
+    """A watched dir mixing .txt and .pdf serves both through one parser."""
+    import socket
+    import time as _t
+
+    import pathway_tpu as pw
+    from pathway_tpu.xpacks.llm import mocks
+    from pathway_tpu.xpacks.llm.parsers import AutoParser
+    from pathway_tpu.xpacks.llm.vector_store import (
+        VectorStoreClient,
+        VectorStoreServer,
+    )
+
+    (tmp_path / "note.txt").write_text("the lighthouse keeper logs the storm")
+    (tmp_path / "report.pdf").write_bytes(fixture_pdf)
+    s = socket.socket(); s.bind(("127.0.0.1", 0)); port = s.getsockname()[1]; s.close()
+    docs = pw.io.fs.read(
+        str(tmp_path), format="binary", mode="streaming",
+        with_metadata=True, refresh_interval=0.2,
+    )
+    vs = VectorStoreServer(
+        docs, embedder=mocks.FakeEmbedder(dim=8), parser=AutoParser()
+    )
+    vs.run_server(host="127.0.0.1", port=port, threaded=True)
+    client = VectorStoreClient(host="127.0.0.1", port=port)
+    deadline = _t.monotonic() + 25
+    stats = {}
+    while _t.monotonic() < deadline:
+        try:
+            stats = client.get_vectorstore_statistics()
+            if stats.get("file_count", 0) >= 2:
+                break
+        except Exception:
+            pass
+        _t.sleep(0.3)
+    assert stats.get("file_count", 0) >= 2, stats
+    res = client.query("the lighthouse keeper logs the storm", k=1)
+    assert "lighthouse" in res[0]["text"]
