@@ -258,3 +258,19 @@ def test_oracle_min_max_extremum_retraction():
         want = run_batch(build, prefix_rows(stream, tt))
         got = state_at(history, tt)
         assert got == want, (tt, sorted(got.items()), sorted(want.items()))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_oracle_asof_join(seed):
+    """asof join: each left row pairs with the latest right row at or
+    before its time — order-sensitive state under retraction."""
+
+    def build(left, right):
+        l2 = left.select(lt=left.v, lk=left.k)
+        r2 = right.select(rt=right.v, rk=right.k)
+        joined = l2.asof_join(r2, l2.lt, r2.rt).select(
+            l2.lk, rk=pw.coalesce(r2.rk, -1)
+        )
+        return joined
+
+    assert_oracle(build, seed, binary=True)
