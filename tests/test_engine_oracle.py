@@ -274,3 +274,20 @@ def test_oracle_asof_join(seed):
         return joined
 
     assert_oracle(build, seed, binary=True)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_oracle_session_window(seed):
+    """Session windows merge on gaps — retracting a bridging row must
+    split sessions exactly as a batch recompute would."""
+
+    def build(t):
+        return t.windowby(
+            t.v, window=pw.temporal.session(max_gap=3)
+        ).reduce(
+            start=pw.this._pw_window_start,
+            end=pw.this._pw_window_end,
+            c=pw.reducers.count(),
+        )
+
+    assert_oracle(build, seed)
