@@ -188,12 +188,17 @@ class RowwiseNode(Node):
     def flush(self, time: int) -> list[Entry]:
         entries = self.take(0)
         pool = getattr(getattr(self, "engine", None), "host_pool", None)
+        # no consolidation here: row-wise maps are the hottest nodes and
+        # every stateful consumer (groupby/join multisets, output,
+        # exchange) absorbs raw diff streams; DeduplicateNode — the one
+        # consumer whose semantics need per-timestamp consolidation —
+        # consolidates its own input
         if (
             pool is not None
             and not self.memoize
             and len(entries) >= self.PARALLEL_MIN_ROWS
         ):
-            return consolidate(self._flush_parallel(pool, entries))
+            return self._flush_parallel(pool, entries)
         out: list[Entry] = []
         for key, row, diff in entries:
             if self.memoize:
@@ -208,7 +213,7 @@ class RowwiseNode(Node):
                 out.extend(
                     (k, r, d * diff) for k, r, d in self.fn(key, row, 1)
                 )
-        return consolidate(out)
+        return out
 
     def _flush_parallel(self, pool, entries: list[Entry]) -> list[Entry]:
         """Split the batch across the host worker pool; chunk order is
@@ -712,7 +717,10 @@ class DeduplicateNode(Node):
 
     def flush(self, time: int) -> list[Entry]:
         out: list[Entry] = []
-        for key, row, diff in self.take(0):
+        # consolidate here: a transient add+retract pair within one
+        # timestamp (possible now that row-wise maps emit raw diffs) must
+        # not reach the acceptor
+        for key, row, diff in consolidate(self.take(0)):
             if diff <= 0:
                 continue  # dedup consumes an append-only stream
             inst = freeze_value(self.instance_fn(key, row))
