@@ -290,9 +290,41 @@ class GraphRunner:
         memoize = any(_contains_nondeterministic(e) for e in exprs.values())
 
         def builder(fns, layout):
-            def fn(key, row, diff):
-                ctx = (key, row)
-                return [(key, tuple(f(ctx) for f in fns), diff)]
+            # arity-specialized row constructors: select is the hottest
+            # node and a genexpr-into-tuple per row costs ~2x a direct
+            # call tuple at small widths
+            if len(fns) == 1:
+                (f0,) = fns
+
+                def fn(key, row, diff):
+                    return [(key, (f0((key, row)),), diff)]
+
+            elif len(fns) == 2:
+                f0, f1 = fns
+
+                def fn(key, row, diff):
+                    ctx = (key, row)
+                    return [(key, (f0(ctx), f1(ctx)), diff)]
+
+            elif len(fns) == 3:
+                f0, f1, f2 = fns
+
+                def fn(key, row, diff):
+                    ctx = (key, row)
+                    return [(key, (f0(ctx), f1(ctx), f2(ctx)), diff)]
+
+            elif len(fns) == 4:
+                f0, f1, f2, f3 = fns
+
+                def fn(key, row, diff):
+                    ctx = (key, row)
+                    return [(key, (f0(ctx), f1(ctx), f2(ctx), f3(ctx)), diff)]
+
+            else:
+
+                def fn(key, row, diff):
+                    ctx = (key, row)
+                    return [(key, tuple([f(ctx) for f in fns]), diff)]
 
             return RowwiseNode(fn, memoize=memoize, name=f"select#{op.id}")
 
