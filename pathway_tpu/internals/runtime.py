@@ -339,9 +339,8 @@ class GraphRunner:
             cond_fn = fns[0]
 
             def fn(key, row, diff):
-                ctx = (key, row)
-                if cond_fn(ctx):
-                    return [(key, tuple(row[:width]), diff)]
+                if cond_fn((key, row)):
+                    return [(key, row[:width], diff)]  # row is a tuple; slice is too
                 return []
 
             return RowwiseNode(fn, name=f"filter#{op.id}")
@@ -427,12 +426,12 @@ class GraphRunner:
 
         def group_fn(key, row):
             ctx = (key, row)
-            return tuple(f(ctx) for f in g_fns)
+            return tuple([f(ctx) for f in g_fns])
 
         def args_fn(key, row):
             ctx = (key, row)
             return tuple(
-                tuple(f(ctx) for f in arg_fns) for arg_fns in red_arg_fns
+                [tuple([f(ctx) for f in arg_fns]) for arg_fns in red_arg_fns]
             )
 
         def out_fn(gvals, rvals):
