@@ -57,7 +57,27 @@ def compile_expression(
     if isinstance(e, expr_mod.ColumnBinaryOpExpression):
         lf, rf = rec(e.left), rec(e.right)
         impl = expr_mod.binary_op_impl(e.op)
-        op = e.op
+        # branch on the operator once at compile time, not per row
+        if e.op == "==":
+
+            def run_eq(ctx):
+                a = lf(ctx)
+                if a is ERROR:
+                    return ERROR
+                b = rf(ctx)
+                return ERROR if b is ERROR else a == b
+
+            return run_eq
+        if e.op == "!=":
+
+            def run_ne(ctx):
+                a = lf(ctx)
+                if a is ERROR:
+                    return ERROR
+                b = rf(ctx)
+                return ERROR if b is ERROR else a != b
+
+            return run_ne
 
         def run_binary(ctx):
             a = lf(ctx)
@@ -66,10 +86,6 @@ def compile_expression(
             b = rf(ctx)
             if b is ERROR:
                 return ERROR
-            if op == "==":
-                return a == b
-            if op == "!=":
-                return a != b
             if a is None or b is None:
                 return None
             try:
