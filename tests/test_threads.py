@@ -57,18 +57,23 @@ def test_threads_scale_gil_releasing_work(threads4):
     n = 128
     per_row = 0.004
 
-    t = pw.debug.table_from_markdown(_rows_markdown(n))
-
     def slow(v):
         time.sleep(per_row)  # sleep releases the GIL like native IO
         return v + 1
 
-    r = t.select(w=pw.apply(slow, t.v))
-    t0 = time.perf_counter()
-    (out,) = pw.debug.materialize(r)
-    elapsed = time.perf_counter() - t0
-    assert len(out.current) == n
     serial_floor = n * per_row  # 0.512s serial
+    # one retry absorbs scheduler noise on a loaded machine
+    elapsed = float("inf")
+    for _attempt in range(2):
+        pw.internals.graph.G.clear()
+        t = pw.debug.table_from_markdown(_rows_markdown(n))
+        r = t.select(w=pw.apply(slow, t.v))
+        t0 = time.perf_counter()
+        (out,) = pw.debug.materialize(r)
+        elapsed = time.perf_counter() - t0
+        assert len(out.current) == n
+        if elapsed < serial_floor / 2:
+            break
     assert elapsed < serial_floor / 2, (
         f"{elapsed:.3f}s vs serial floor {serial_floor:.3f}s — "
         "pool did not parallelize"
