@@ -181,12 +181,39 @@ class RowwiseNode(Node):
         self.fn = fn
         self.memoize = memoize
         self._memo: dict[tuple, list] = {}
+        #: columnar fast path (set by the lowering when the select/filter
+        #: vectorizes): big batches evaluate as numpy columns and fall
+        #: back to the row path when a batch holds non-numeric values
+        self.vector_fn = None  # rows -> list[out_row] | None
+        self.vector_mask = None  # rows -> list[bool] | None
+        self.filter_width = 0
 
     #: below this batch size the pool's dispatch overhead beats the win
     PARALLEL_MIN_ROWS = 64
+    #: below this batch size numpy conversion overhead beats the win
+    VECTOR_MIN_ROWS = 256
 
     def flush(self, time: int) -> list[Entry]:
         entries = self.take(0)
+        if len(entries) >= self.VECTOR_MIN_ROWS:
+            if self.vector_fn is not None:
+                rows = [e[1] for e in entries]
+                out_rows = self.vector_fn(rows)
+                if out_rows is not None:
+                    return [
+                        (e[0], out_rows[i], e[2])
+                        for i, e in enumerate(entries)
+                    ]
+            elif self.vector_mask is not None:
+                rows = [e[1] for e in entries]
+                mask = self.vector_mask(rows)
+                if mask is not None:
+                    w = self.filter_width
+                    return [
+                        (k, r[:w], d)
+                        for (k, r, d), keep in zip(entries, mask)
+                        if keep
+                    ]
         pool = getattr(getattr(self, "engine", None), "host_pool", None)
         # no consolidation here: row-wise maps are the hottest nodes and
         # every stateful consumer (groupby/join multisets, output,

@@ -20,7 +20,7 @@ from .value import ERROR, Json, Pointer
 from .keys import ref_scalar
 from . import dtype as dt
 
-__all__ = ["compile_expression", "EvalContext"]
+__all__ = ["compile_expression", "compile_vector_expression", "EvalContext"]
 
 
 class EvalContext:
@@ -354,3 +354,204 @@ def _cast(v: Any, target: dt.DType) -> Any:
     if target is dt.JSON:
         return v if isinstance(v, Json) else Json(v)
     return v
+
+
+# ---------------------------------------------------------------------------
+# columnar (batch) compilation — the TPU-first engine direction: evaluate a
+# whole micro-batch of rows as numpy column arrays instead of per-row
+# closures.  reference parity note: the Rust engine evaluates per row over
+# i64/f64 (src/engine/expression.rs); this path keeps those numeric
+# semantics (int64 arithmetic) and falls back to the row path whenever a
+# batch contains anything non-numeric (None/ERROR/strings → object dtype).
+# ---------------------------------------------------------------------------
+
+#: binary ops safe to vectorize: no zero-divide (numpy warns + returns
+#: inf/nan where the row path raises/routes ERROR), no Python-only
+#: semantics
+_VECTOR_BIN_OPS: dict | None = None
+
+
+def _vector_bin_ops():
+    global _VECTOR_BIN_OPS
+    if _VECTOR_BIN_OPS is None:
+        import numpy as np
+        import operator
+
+        _VECTOR_BIN_OPS = {
+            "+": operator.add,
+            "-": operator.sub,
+            "*": operator.mul,
+            "<": operator.lt,
+            "<=": operator.le,
+            ">": operator.gt,
+            ">=": operator.ge,
+            "==": operator.eq,
+            "!=": operator.ne,
+            "&": operator.and_,
+            "|": operator.or_,
+            "^": operator.xor,
+        }
+    return _VECTOR_BIN_OPS
+
+
+def compile_vector_expression(
+    e: expr_mod.ColumnExpression,
+    slot_of_ref,
+) -> Callable | None:
+    """Compile ``e`` into ``fn(cols) -> ndarray`` over numpy column arrays,
+    or return None when the expression isn't vectorizable.
+
+    ``slot_of_ref(ref) -> int | None`` maps a ColumnReference (or internal
+    slot expression) to its input-column index.
+    """
+    numeric = (dt.INT, dt.FLOAT, dt.BOOL)
+
+    def rec(node) -> Callable | None:
+        if isinstance(node, expr_mod.ColumnConstExpression):
+            v = node._value
+            if type(v) in (int, float, bool):
+                return lambda cols: v
+            return None
+        if isinstance(node, expr_mod.ColumnBinaryOpExpression):
+            impl = _vector_bin_ops().get(node.op)
+            if impl is None:
+                # division-family ops are safe when the divisor is a
+                # non-zero constant (no zero-divide can occur, so numpy
+                # and the row path agree)
+                if node.op in ("//", "%", "/") and isinstance(
+                    node.right, expr_mod.ColumnConstExpression
+                ):
+                    d = node.right._value
+                    if type(d) in (int, float) and d != 0:
+                        lf = rec(node.left)
+                        if lf is None:
+                            return None
+                        import operator
+
+                        impl2 = {
+                            "//": operator.floordiv,
+                            "%": operator.mod,
+                            "/": operator.truediv,
+                        }[node.op]
+                        return lambda cols: impl2(lf(cols), d)
+                return None
+            lf, rf = rec(node.left), rec(node.right)
+            if lf is None or rf is None:
+                return None
+            return lambda cols: impl(lf(cols), rf(cols))
+        if isinstance(node, expr_mod.ColumnUnaryOpExpression):
+            f = rec(node.expr)
+            if f is None:
+                return None
+            if node.op == "-":
+                return lambda cols: -f(cols)
+            if node.op == "~":
+                return lambda cols: ~f(cols)
+            return None
+        # column references / internal slots: only non-optional numerics —
+        # an Optional column may carry None, which the object-dtype guard
+        # catches anyway, but excluding it here avoids wasted conversions
+        slot = slot_of_ref(node)
+        if slot is None:
+            return None
+        if getattr(node, "_dtype", None) not in numeric:
+            return None
+        return lambda cols: cols[slot]
+
+    if getattr(e, "_dtype", None) not in numeric:
+        return None
+    return rec(e)
+
+
+def _collect_slots(e, slot_of_ref) -> set:
+    out = set()
+
+    def walk(node):
+        slot = slot_of_ref(node)
+        if slot is not None:
+            out.add(slot)
+            return
+        for d in getattr(node, "_deps", lambda: ())() or ():
+            walk(d)
+
+    walk(e)
+    return out
+
+
+def _materialize_cols(rows, slots):
+    """Column arrays for ``slots``; None if any column is non-numeric
+    (object dtype: None/ERROR/strings present in the batch)."""
+    import numpy as np
+
+    cols = {}
+    for s in slots:
+        arr = np.asarray([r[s] for r in rows])
+        if arr.dtype == object:
+            return None
+        cols[s] = arr
+    return cols
+
+
+def build_vector_select(exprs, slot_of_ref):
+    """``fn(rows) -> list[tuple] | None`` evaluating a whole select batch
+    over numpy columns; returns None at build time unless every output
+    column is a pass-through reference or a vectorizable expression (and
+    at least one actually computes)."""
+    fns = []
+    pass_slots = {}
+    for i, e in enumerate(exprs):
+        slot = slot_of_ref(e)
+        if slot is not None:
+            pass_slots[i] = slot
+            fns.append(None)
+            continue
+        f = compile_vector_expression(e, slot_of_ref)
+        if f is None:
+            return None
+        fns.append(f)
+    if all(f is None for f in fns):
+        return None  # pure projection — the row path is already cheap
+
+    compute_slots = sorted(
+        {
+            s
+            for f, e in zip(fns, exprs)
+            if f is not None
+            for s in _collect_slots(e, slot_of_ref)
+        }
+    )
+
+    def run(rows):
+        cols = _materialize_cols(rows, compute_slots)
+        if cols is None:
+            return None
+        out_cols = []
+        for i, f in enumerate(fns):
+            if f is None:
+                s = pass_slots[i]
+                out_cols.append([r[s] for r in rows])
+            else:
+                out_cols.append(f(cols).tolist())
+        # C-level transpose into row tuples
+        return list(zip(*out_cols))
+
+    return run
+
+
+def build_vector_filter(cond, slot_of_ref):
+    """``fn(rows) -> list[bool] | None`` evaluating a filter predicate
+    over numpy columns; None at build time if not vectorizable."""
+    f = compile_vector_expression(cond, slot_of_ref)
+    if f is None:
+        return None
+    slots = sorted(_collect_slots(cond, slot_of_ref))
+    if not slots:
+        return None
+
+    def run(rows):
+        cols = _materialize_cols(rows, slots)
+        if cols is None:
+            return None
+        return f(cols).tolist()
+
+    return run

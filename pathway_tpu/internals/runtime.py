@@ -83,6 +83,19 @@ class _TableLayout:
             id(t): {n: i for i, n in enumerate(t.column_names())} for t in tables
         }
 
+    def slot_of(self, node) -> int | None:
+        """Flat column index for a plain reference node, else None (used
+        by the columnar fast path; ``.id`` is not a slot)."""
+        if isinstance(node, _SlotExpression):
+            return node.flat_idx
+        if isinstance(node, ColumnReference) and node.name != "id":
+            off = self.offsets.get(id(node.table))
+            if off is None:
+                return None
+            idx = self.col_idx[id(node.table)].get(node.name)
+            return None if idx is None else off + idx
+        return None
+
     def resolver(self, extra_slots: int = 0):
         def resolve(ref: ColumnReference) -> Callable:
             if isinstance(ref, _SlotExpression):
@@ -326,7 +339,17 @@ class GraphRunner:
                     ctx = (key, row)
                     return [(key, tuple([f(ctx) for f in fns]), diff)]
 
-            return RowwiseNode(fn, memoize=memoize, name=f"select#{op.id}")
+            node = RowwiseNode(fn, memoize=memoize, name=f"select#{op.id}")
+            if not memoize:
+                from .evaluator import build_vector_select
+
+                # columnar fast path: big batches evaluate as numpy
+                # columns (engine.py RowwiseNode.flush), falling back per
+                # batch when non-numeric values appear
+                node.vector_fn = build_vector_select(
+                    list(exprs.values()), layout.slot_of
+                )
+            return node
 
         self._rowwise_pipeline(op, exprs, builder)
 
@@ -343,7 +366,12 @@ class GraphRunner:
                     return [(key, row[:width], diff)]  # row is a tuple; slice is too
                 return []
 
-            return RowwiseNode(fn, name=f"filter#{op.id}")
+            node = RowwiseNode(fn, name=f"filter#{op.id}")
+            from .evaluator import build_vector_filter
+
+            node.vector_mask = build_vector_filter(cond, layout.slot_of)
+            node.filter_width = width
+            return node
 
         self._rowwise_pipeline(op, {"__cond__": cond}, builder)
 

@@ -1,0 +1,96 @@
+"""Columnar (batch) evaluation fast path for select/filter.
+
+reference note: the Rust engine evaluates rows over i64/f64
+(src/engine/expression.rs); this path keeps those numeric semantics over
+numpy columns for large batches and must produce byte-identical results
+to the per-row closure path, falling back whenever a batch holds
+non-numeric values (None/ERROR/strings → object dtype).
+"""
+
+import pathway_tpu as pw
+from pathway_tpu.internals.engine import OutputNode, RowwiseNode
+from pathway_tpu.internals.runtime import GraphRunner
+
+
+def _run(table_expr_builder, n):
+    pw.internals.graph.G.clear()
+    rows = "\n".join(
+        ["    v | w | __time__"]
+        + [f"    {i} | {i % 13} | 2" for i in range(n)]
+    )
+    t = pw.debug.table_from_markdown(rows)
+    r = table_expr_builder(t)
+    runner = GraphRunner()
+    eng = runner.build([(r, OutputNode(name="out"))])
+    vectorized = [
+        node.name
+        for node in eng.nodes
+        if isinstance(node, RowwiseNode)
+        and (node.vector_fn is not None or node.vector_mask is not None)
+    ]
+    eng.run_all()
+    out = [n2 for n2 in eng.nodes if isinstance(n2, OutputNode)][0]
+    return sorted(tuple(r) for r in out.current.values()), vectorized
+
+
+def _build(t):
+    s = t.select(t.v, t.w, a=t.v * 2 + 1, b=(t.v % 7) - t.w)
+    f = s.filter((s.b != 3) & (s.a > 10))
+    return f.select(f.v, c=f.a + f.b, d=-f.b, e=f.v // 4)
+
+
+def test_vector_path_matches_row_path_large_batch():
+    """Above the vectorization threshold the columnar path must produce
+    exactly the row path's output."""
+    big, vectorized = _run(_build, 2000)  # >= VECTOR_MIN_ROWS
+    assert vectorized, "vector path did not attach"
+
+    # force the row path by dropping the vector threshold out of reach
+    orig = RowwiseNode.VECTOR_MIN_ROWS
+    RowwiseNode.VECTOR_MIN_ROWS = 10**9
+    try:
+        row, _ = _run(_build, 2000)
+    finally:
+        RowwiseNode.VECTOR_MIN_ROWS = orig
+    assert big == row
+
+
+def test_vector_path_falls_back_on_none(monkeypatch):
+    """A batch containing None must fall back to the row path (object
+    dtype) and keep None-propagation semantics."""
+    pw.internals.graph.G.clear()
+    n = 600
+    lines = ["    v | __time__"]
+    for i in range(n):
+        lines.append(f"    {i} | 2")
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    # Optional column via if_else to None
+    s = t.select(
+        x=pw.if_else(t.v % 2 == 0, t.v, None),
+    )
+    r = s.select(y=s.x * 2)
+    (out,) = pw.debug.materialize(r)
+    got = sorted(
+        (row[0] for row in out.current.values()),
+        key=lambda x: (x is None, x),
+    )
+    evens = [i * 2 for i in range(n) if i % 2 == 0]
+    assert [g for g in got if g is not None] == evens
+    assert sum(1 for g in got if g is None) == n // 2
+
+
+def test_vector_const_divisor_matches():
+    def build(t):
+        return t.select(
+            q=t.v // 5, m=t.v % 5, f=t.v / 4, neg=(-t.v) % 3
+        )
+
+    big, vectorized = _run(build, 1500)
+    assert vectorized
+    orig = RowwiseNode.VECTOR_MIN_ROWS
+    RowwiseNode.VECTOR_MIN_ROWS = 10**9
+    try:
+        row, _ = _run(build, 1500)
+    finally:
+        RowwiseNode.VECTOR_MIN_ROWS = orig
+    assert big == row
