@@ -370,11 +370,16 @@ def _cast(v: Any, target: dt.DType) -> Any:
 #: semantics
 _VECTOR_BIN_OPS: dict | None = None
 
+#: runtime magnitude bound for int columns on the vector path: with
+#: |inputs| < 2^31 the compile-time bit-growth analysis below guarantees
+#: no intermediate exceeds int64, so numpy can never silently wrap where
+#: the row path's Python bignums would keep going
+VECTOR_INT_BOUND = 1 << 31
+
 
 def _vector_bin_ops():
     global _VECTOR_BIN_OPS
     if _VECTOR_BIN_OPS is None:
-        import numpy as np
         import operator
 
         _VECTOR_BIN_OPS = {
@@ -394,6 +399,13 @@ def _vector_bin_ops():
     return _VECTOR_BIN_OPS
 
 
+#: worst-case result bit width assumed for an int column reference
+#: (enforced at runtime by _materialize_cols against VECTOR_INT_BOUND)
+_REF_BITS = 31
+#: int64 headroom the analysis must stay within (sign bit reserved)
+_MAX_BITS = 62
+
+
 def compile_vector_expression(
     e: expr_mod.ColumnExpression,
     slot_of_ref,
@@ -402,15 +414,26 @@ def compile_vector_expression(
     or return None when the expression isn't vectorizable.
 
     ``slot_of_ref(ref) -> int | None`` maps a ColumnReference (or internal
-    slot expression) to its input-column index.
+    slot expression) to its input-column index.  Integer expressions carry
+    a compile-time worst-case bit-width (inputs bounded by
+    ``VECTOR_INT_BOUND`` at runtime); anything that could exceed int64
+    stays on the row path, so wraparound can never diverge from the
+    Python-int row semantics.
     """
+    import operator
+
     numeric = (dt.INT, dt.FLOAT, dt.BOOL)
 
-    def rec(node) -> Callable | None:
+    def rec(node):
+        """Returns (fn, kind, bits) or None; kind in {'int','float','bool'}."""
         if isinstance(node, expr_mod.ColumnConstExpression):
             v = node._value
-            if type(v) in (int, float, bool):
-                return lambda cols: v
+            if type(v) is bool:
+                return (lambda cols: v), "bool", 1
+            if type(v) is int:
+                return (lambda cols: v), "int", max(v.bit_length(), 1)
+            if type(v) is float:
+                return (lambda cols: v), "float", 0
             return None
         if isinstance(node, expr_mod.ColumnBinaryOpExpression):
             impl = _vector_bin_ops().get(node.op)
@@ -423,30 +446,60 @@ def compile_vector_expression(
                 ):
                     d = node.right._value
                     if type(d) in (int, float) and d != 0:
-                        lf = rec(node.left)
-                        if lf is None:
+                        left = rec(node.left)
+                        if left is None:
                             return None
-                        import operator
-
+                        lf, lkind, lbits = left
                         impl2 = {
                             "//": operator.floordiv,
                             "%": operator.mod,
                             "/": operator.truediv,
                         }[node.op]
-                        return lambda cols: impl2(lf(cols), d)
+                        if node.op == "/" or lkind == "float":
+                            kind, bits = "float", 0
+                        elif node.op == "%":
+                            kind = "int"
+                            bits = (
+                                abs(d).bit_length() if type(d) is int else lbits
+                            )
+                        else:
+                            kind, bits = "int", lbits
+                        if kind == "int" and bits > _MAX_BITS:
+                            return None
+                        return (lambda cols: impl2(lf(cols), d)), kind, bits
                 return None
-            lf, rf = rec(node.left), rec(node.right)
-            if lf is None or rf is None:
+            left, right = rec(node.left), rec(node.right)
+            if left is None or right is None:
                 return None
-            return lambda cols: impl(lf(cols), rf(cols))
+            lf, lkind, lbits = left
+            rf, rkind, rbits = right
+            if node.op in ("<", "<=", ">", ">=", "==", "!="):
+                kind, bits = "bool", 1
+            elif node.op in ("&", "|", "^"):
+                kind = "bool" if lkind == rkind == "bool" else "int"
+                bits = max(lbits, rbits)
+            elif "float" in (lkind, rkind):
+                kind, bits = "float", 0
+            elif node.op == "*":
+                kind, bits = "int", lbits + rbits
+            else:  # + -
+                kind, bits = "int", max(lbits, rbits) + 1
+            if kind == "int" and bits > _MAX_BITS:
+                return None
+            return (lambda cols: impl(lf(cols), rf(cols))), kind, bits
         if isinstance(node, expr_mod.ColumnUnaryOpExpression):
-            f = rec(node.expr)
-            if f is None:
+            inner = rec(node.expr)
+            if inner is None:
                 return None
+            f, kind, bits = inner
             if node.op == "-":
-                return lambda cols: -f(cols)
-            if node.op == "~":
-                return lambda cols: ~f(cols)
+                if kind == "bool":
+                    # numpy forbids - on bool arrays; the row path returns
+                    # -True == -1 — keep that on the row path
+                    return None
+                return (lambda cols: -f(cols)), kind, bits
+            if node.op == "~" and kind in ("bool", "int"):
+                return (lambda cols: ~f(cols)), kind, bits + 1
             return None
         # column references / internal slots: only non-optional numerics —
         # an Optional column may carry None, which the object-dtype guard
@@ -454,13 +507,17 @@ def compile_vector_expression(
         slot = slot_of_ref(node)
         if slot is None:
             return None
-        if getattr(node, "_dtype", None) not in numeric:
+        d = getattr(node, "_dtype", None)
+        if d not in numeric:
             return None
-        return lambda cols: cols[slot]
+        kind = {dt.INT: "int", dt.FLOAT: "float", dt.BOOL: "bool"}[d]
+        bits = _REF_BITS if kind == "int" else (1 if kind == "bool" else 0)
+        return (lambda cols: cols[slot]), kind, bits
 
     if getattr(e, "_dtype", None) not in numeric:
         return None
-    return rec(e)
+    compiled = rec(e)
+    return None if compiled is None else compiled[0]
 
 
 def _collect_slots(e, slot_of_ref) -> set:
@@ -480,13 +537,20 @@ def _collect_slots(e, slot_of_ref) -> set:
 
 def _materialize_cols(rows, slots):
     """Column arrays for ``slots``; None if any column is non-numeric
-    (object dtype: None/ERROR/strings present in the batch)."""
+    (object dtype: None/ERROR/strings present in the batch) or an int
+    column exceeds the wraparound-safety bound the compile-time analysis
+    assumed."""
     import numpy as np
 
     cols = {}
     for s in slots:
         arr = np.asarray([r[s] for r in rows])
         if arr.dtype == object:
+            return None
+        if arr.dtype.kind == "i" and (
+            arr.max(initial=0) >= VECTOR_INT_BOUND
+            or arr.min(initial=0) <= -VECTOR_INT_BOUND
+        ):
             return None
         cols[s] = arr
     return cols
@@ -525,13 +589,18 @@ def build_vector_select(exprs, slot_of_ref):
         cols = _materialize_cols(rows, compute_slots)
         if cols is None:
             return None
+        n = len(rows)
         out_cols = []
         for i, f in enumerate(fns):
             if f is None:
                 s = pass_slots[i]
                 out_cols.append([r[s] for r in rows])
             else:
-                out_cols.append(f(cols).tolist())
+                res = f(cols)
+                # const-only expressions yield Python scalars — broadcast
+                out_cols.append(
+                    res.tolist() if hasattr(res, "tolist") else [res] * n
+                )
         # C-level transpose into row tuples
         return list(zip(*out_cols))
 

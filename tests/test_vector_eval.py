@@ -94,3 +94,64 @@ def test_vector_const_divisor_matches():
     finally:
         RowwiseNode.VECTOR_MIN_ROWS = orig
     assert big == row
+
+
+def test_vector_const_column_broadcasts():
+    """A constant output column must broadcast, not crash the batch."""
+
+    def build(t):
+        return t.select(t.v, a=1, b=t.v * 2)
+
+    big, vectorized = _run(build, 800)
+    assert vectorized
+    assert all(r[1] == 1 for r in big)
+    orig = RowwiseNode.VECTOR_MIN_ROWS
+    RowwiseNode.VECTOR_MIN_ROWS = 10**9
+    try:
+        row, _ = _run(build, 800)
+    finally:
+        RowwiseNode.VECTOR_MIN_ROWS = orig
+    assert big == row
+
+
+def test_vector_bool_negation_stays_on_row_path():
+    """numpy forbids - on bool arrays; the row path returns -True == -1,
+    so bool negation must not vectorize (and must stay correct)."""
+    pw.internals.graph.G.clear()
+    n = 600
+    lines = ["    v | __time__"] + [f"    {i} | 2" for i in range(n)]
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    s = t.select(flag=t.v % 2 == 0)
+    r = s.select(x=-s.flag)
+    (out,) = pw.debug.materialize(r)
+    got = sorted(row[0] for row in out.current.values())
+    assert got == [-1] * (n // 2) + [0] * (n // 2)
+
+
+def test_vector_no_int64_wraparound():
+    """Arithmetic whose result exceeds int64 must match Python-int row
+    semantics — large inputs fall back at runtime, and expressions whose
+    growth could overflow never vectorize."""
+    pw.internals.graph.G.clear()
+    n = 400
+    big = 2**62
+    lines = ["    v | __time__"] + [
+        f"    {big + i} | 2" for i in range(n)
+    ]
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    r = t.select(a=t.v * 2)
+    (out,) = pw.debug.materialize(r)
+    got = sorted(row[0] for row in out.current.values())
+    assert got == [(big + i) * 2 for i in range(n)]  # exact bignums
+
+    # moderate inputs but overflow-capable growth (a*b*c with 31-bit
+    # bounds sums to 93 bits) must not vectorize either
+    from pathway_tpu.internals.evaluator import build_vector_select
+
+    pw.internals.graph.G.clear()
+    t2 = pw.debug.table_from_markdown("\n".join(
+        ["    v | __time__"] + [f"    {i} | 2" for i in range(10)]
+    ))
+    e = (t2.v * t2.v) * t2.v
+    slot_of = lambda node: 0 if getattr(node, "name", None) == "v" else None
+    assert build_vector_select([e], slot_of) is None
