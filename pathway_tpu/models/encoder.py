@@ -142,18 +142,22 @@ def bucketed_dispatch(
     # dispatch queues the launches back-to-back, so device compute and
     # host→device transfers for chunk n+1 overlap the device→host copy of
     # chunk n — one sync at the end instead of one per chunk
+    # transfer narrow dtypes: vocab ids fit u16 (30522 < 65536), masks and
+    # type ids fit u8 — the model widens to i32 on device where it's free.
+    # Over a tunneled chip every host->device byte is RPC payload; this
+    # cuts input transfer 2-4x (the forward itself is unchanged)
     pending = []
     start = 0
     while start < b:
         chunk = min(bb, b - start)
-        ids = np.zeros((bb, seq), np.int32)
-        mask = np.zeros((bb, seq), np.int32)
+        ids = np.zeros((bb, seq), np.uint16)
+        mask = np.zeros((bb, seq), np.uint8)
         ids[:chunk] = ids_all[start : start + chunk]
         mask[:chunk] = mask_all[start : start + chunk]
         mask[chunk:, 0] = 1  # avoid 0/0 in pooling for pad rows
         args = [jnp.asarray(ids), jnp.asarray(mask)]
         if type_ids_all is not None:
-            tids = np.zeros((bb, seq), np.int32)
+            tids = np.zeros((bb, seq), np.uint8)
             tids[:chunk] = type_ids_all[start : start + chunk]
             args.append(jnp.asarray(tids))
         pending.append((apply_fn(*args), chunk))
