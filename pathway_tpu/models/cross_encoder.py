@@ -93,6 +93,7 @@ class CrossEncoder:
             mask_all,
             self.max_length,
             type_ids_all=type_ids_all,
+            vocab_size=self.cfg.vocab_size,
         )
 
     def __call__(self, query: str, doc: str) -> float:

@@ -126,7 +126,8 @@ def _bucket(value: int, buckets: Sequence[int]) -> int:
 
 
 def bucketed_dispatch(
-    apply_fn, ids_all, mask_all, max_length: int, type_ids_all=None
+    apply_fn, ids_all, mask_all, max_length: int, type_ids_all=None,
+    vocab_size: int = 1 << 31,
 ) -> np.ndarray:
     """Pad (batch, seq) to buckets and dispatch chunks through a jitted
     ``apply_fn(ids, mask[, type_ids])`` — one compilation per
@@ -142,15 +143,20 @@ def bucketed_dispatch(
     # dispatch queues the launches back-to-back, so device compute and
     # host→device transfers for chunk n+1 overlap the device→host copy of
     # chunk n — one sync at the end instead of one per chunk
-    # transfer narrow dtypes: vocab ids fit u16 (30522 < 65536), masks and
-    # type ids fit u8 — the model widens to i32 on device where it's free.
-    # Over a tunneled chip every host->device byte is RPC payload; this
-    # cuts input transfer 2-4x (the forward itself is unchanged)
+    # transfer narrow dtypes: masks and type ids fit u8, and vocab ids fit
+    # u16 when the tokenizer's id space allows it — the model widens to i32
+    # on device where it's free.  Over a tunneled chip every host->device
+    # byte is RPC payload; this cuts input transfer 2-4x (the forward
+    # itself is unchanged).  Large-vocab checkpoints (e.g. multilingual,
+    # 250k ids) keep i32 — a u16 buffer would silently wrap their ids.
+    # The choice keys on the model's vocab, not batch content, so the
+    # compiled shape/dtype is stable across batches
+    ids_dtype = np.uint16 if vocab_size <= 1 << 16 else np.int32
     pending = []
     start = 0
     while start < b:
         chunk = min(bb, b - start)
-        ids = np.zeros((bb, seq), np.uint16)
+        ids = np.zeros((bb, seq), ids_dtype)
         mask = np.zeros((bb, seq), np.uint8)
         ids[:chunk] = ids_all[start : start + chunk]
         mask[:chunk] = mask_all[start : start + chunk]
@@ -235,6 +241,7 @@ class SentenceEncoder:
             ids_all,
             mask_all,
             self.max_length,
+            vocab_size=self.cfg.vocab_size,
         )
 
     def __call__(self, text: str) -> np.ndarray:
