@@ -119,7 +119,7 @@ def table_from_markdown(
         out_schema = _schema_from_columns(columns)
 
     salt = next(_table_salt)
-    entries = []  # (time, key, values, diff)
+    entries = {}  # time -> [(key, values, diff)]
     for rownum, (rid, vals) in enumerate(rows):
         key = (
             unsafe_make_pointer(int(rid))
@@ -131,13 +131,16 @@ def table_from_markdown(
         t = vals[time_idx] if time_idx is not None else 0
         d = vals[diff_idx] if diff_idx is not None else 1
         values = tuple(_coerce(vals[i], out_schema[n].dtype) for i, n in zip(data_idx, data_names))
-        entries.append((t, key, values, d))
+        entries.setdefault(t, []).append((key, values, d))
 
     if time_idx is None:
         op = Operator(
             "input",
             [],
-            params=dict(rows=[(k, v) for _, k, v, _ in entries], schema=out_schema),
+            params=dict(
+                rows=[(k, v) for k, v, _ in entries.get(0, [])],
+                schema=out_schema,
+            ),
         )
     else:
         op = Operator(
@@ -230,7 +233,7 @@ def table_from_rows(
     names = schema.column_names()
     pk = schema.primary_key_columns()
     salt = next(_table_salt)
-    entries = []
+    entries = {}  # time -> [(key, values, diff)]
     data_rows = []
     for rownum, r in enumerate(rows):
         if is_stream:
@@ -241,7 +244,7 @@ def table_from_rows(
             key = ref_scalar(*[vals[names.index(c)] for c in pk])
         else:
             key = ref_scalar("__autogen__", salt, rownum)
-        entries.append((t, key, tuple(vals), d))
+        entries.setdefault(t, []).append((key, tuple(vals), d))
         data_rows.append((key, tuple(vals)))
     if is_stream:
         op = Operator("input", [], params=dict(rows=None, stream=entries, schema=schema))

@@ -159,7 +159,11 @@ class SourceNode(Node):
         self.queue[time].extend(entries)
 
     def flush(self, time: int) -> list[Entry]:
-        return consolidate(self.queue.pop(time, []))
+        # raw entries, no consolidation: every stateful consumer absorbs
+        # diff streams (multiset counts), DeduplicateNode and OutputNode
+        # consolidate their own input, and push order is preserved — the
+        # same reasoning that dropped consolidation from row-wise maps
+        return self.queue.pop(time, [])
 
     def has_pending(self, time: int) -> bool:
         return time in self.queue
@@ -186,6 +190,7 @@ class RowwiseNode(Node):
         #: back to the row path when a batch holds non-numeric values
         self.vector_fn = None  # rows -> list[out_row] | None
         self.vector_mask = None  # rows -> list[bool] | None
+        self.vector_entries_fn = None  # entries -> list[Entry] (projections)
         self.filter_width = 0
 
     #: below this batch size the pool's dispatch overhead beats the win
@@ -195,14 +200,19 @@ class RowwiseNode(Node):
 
     def flush(self, time: int) -> list[Entry]:
         entries = self.take(0)
+        if self.vector_entries_fn is not None and entries:
+            # pure projection: always total (no numpy involved, so no
+            # dtype fallback needed) and cheaper than per-row dispatch at
+            # every batch size
+            return self.vector_entries_fn(entries)
         if len(entries) >= self.VECTOR_MIN_ROWS:
             if self.vector_fn is not None:
                 rows = [e[1] for e in entries]
                 out_rows = self.vector_fn(rows)
                 if out_rows is not None:
                     return [
-                        (e[0], out_rows[i], e[2])
-                        for i, e in enumerate(entries)
+                        (e[0], row, e[2])
+                        for e, row in zip(entries, out_rows)
                     ]
             elif self.vector_mask is not None:
                 rows = [e[1] for e in entries]
@@ -343,10 +353,26 @@ class GroupByNode(Node):
             i for i, r in enumerate(self.reducers) if r.incremental
         ]
         self.red_state: dict[tuple, dict[int, list]] = {}
+        #: columnar ingest (set by the lowering when grouping columns and
+        #: reducer args are plain slot projections and every reducer is
+        #: vector-safe): ``(group_slots, arg_slots_per_reducer)``
+        self.vector_spec = None
+
+    #: below this batch size numpy conversion overhead beats the win
+    VECTOR_MIN_ROWS = 512
 
     def flush(self, time: int) -> list[Entry]:
+        entries = self.take(0)
+        dirty = None
+        if self.vector_spec is not None and len(entries) >= self.VECTOR_MIN_ROWS:
+            dirty = self._ingest_vector(entries)
+        if dirty is None:
+            dirty = self._ingest_rows(entries)
+        return self._emit(dirty)
+
+    def _ingest_rows(self, entries: list[Entry]) -> set:
         dirty: set[tuple] = set()
-        for key, row, diff in self.take(0):
+        for key, row, diff in entries:
             gvals = self.group_fn(key, row)
             gfrozen = freeze_row(gvals)
             self.group_raw[gfrozen] = gvals
@@ -371,6 +397,114 @@ class GroupByNode(Node):
                 for i in self._inc_idx:
                     self.reducers[i].update(states[i], args[i], diff)
             dirty.add(gfrozen)
+        return dirty
+
+    def _ingest_vector(self, entries: list[Entry]) -> set | None:
+        """Columnar ingest: group the batch by its (grouping, reducer-args)
+        identity with one ``np.unique`` pass, then apply ONE state update
+        per distinct slot instead of one per row.  State layout and seq
+        assignment match `_ingest_rows` exactly (slots are read back from
+        the original Python rows, not numpy casts), so vector and row
+        batches interleave freely on the same node.  Returns None to fall
+        back when the batch isn't columnar-safe (object dtype, NaN)."""
+        group_slots, arg_slots = self.vector_spec
+        rows = [e[1] for e in entries]
+        # an arg is either an int slot or a ("const", value) placeholder
+        # (count()'s Const(0)); constants are identical across rows, so
+        # they join the args tuples but not the identity columns
+        needed = sorted(
+            {*group_slots}
+            | {s for sl in arg_slots for s in sl if not isinstance(s, tuple)}
+        )
+        cols = []
+        for s in needed:
+            vals = [r[s] for r in rows]
+            arr = np.asarray(vals)
+            if arr.dtype == object:
+                return None  # None/ERROR/mixed types — row path handles
+            if arr.ndim != 1:
+                return None  # ndarray-valued column — row path handles
+            if arr.dtype.kind in "US":
+                # numpy silently coerces mixed batches (int+str, bytes+str)
+                # to one string dtype, merging values Python dict identity
+                # keeps distinct; numeric mixes (int/float/bool) are safe
+                # because Python == agrees with the coercion
+                t0 = type(vals[0])
+                if t0 not in (str, bytes) or any(
+                    t is not t0 for t in map(type, vals)
+                ):
+                    return None
+            if arr.dtype.kind == "f":
+                if np.isnan(arr).any():
+                    # dict identity for NaN is per-object; np.unique would
+                    # merge them — keep row-path semantics
+                    return None
+                # byte-wise rec-array identity must not split -0.0 / 0.0
+                # (Python dict keys treat them equal)
+                arr = arr + 0.0
+            cols.append(arr)
+        diffs = np.fromiter(
+            (e[2] for e in entries), np.int64, count=len(entries)
+        )
+        if not cols:
+            # global reduce with const-only args: every row shares one
+            # identity — one slot, net = sum of diffs
+            first_idx = np.zeros(1, np.int64)
+            net = np.asarray([diffs.sum()])
+        else:
+            if len(cols) == 1:
+                ident = cols[0]
+            else:
+                ident = np.rec.fromarrays(cols)
+            _, first_idx, sinv = np.unique(
+                ident, return_index=True, return_inverse=True
+            )
+            net = np.bincount(sinv, weights=diffs, minlength=len(first_idx))
+        # first-occurrence order keeps slot seq numbers identical to the
+        # row path (earliest/latest-style reducers are excluded from the
+        # vector gate, but state must stay bit-compatible regardless)
+        order = np.argsort(first_idx, kind="stable")
+        dirty: set[tuple] = set()
+        state = self.state
+        for u in order.tolist():
+            d = int(net[u])
+            if d == 0:
+                # add+retract cancelling within the batch: the row path's
+                # create-then-delete leaves the same state, and its
+                # retract+re-add emission cancels in consolidate()
+                continue
+            i = int(first_idx[u])
+            row = rows[i]
+            gvals = tuple(row[s] for s in group_slots)
+            gfrozen = gvals  # scalars from non-object columns — hashable
+            self.group_raw[gfrozen] = gvals
+            args = tuple(
+                tuple(
+                    s[1] if isinstance(s, tuple) else row[s] for s in sl
+                )
+                for sl in arg_slots
+            )
+            afrozen = (args, None)
+            bucket = state[gfrozen]
+            slot = bucket.get(afrozen)
+            if slot is None:
+                self._seq += 1
+                slot = bucket[afrozen] = [0, args, entries[i][0], None, self._seq]
+            slot[0] += d
+            if slot[0] == 0:
+                del bucket[afrozen]
+            if self._inc_idx:
+                states = self.red_state.get(gfrozen)
+                if states is None:
+                    states = self.red_state[gfrozen] = {
+                        j: self.reducers[j].init_state() for j in self._inc_idx
+                    }
+                for j in self._inc_idx:
+                    self.reducers[j].update(states[j], args[j], d)
+            dirty.add(gfrozen)
+        return dirty
+
+    def _emit(self, dirty: set) -> list[Entry]:
         out: list[Entry] = []
         for gfrozen in dirty:
             group_state = self.state.get(gfrozen)

@@ -574,7 +574,7 @@ def build_vector_select(exprs, slot_of_ref):
             return None
         fns.append(f)
     if all(f is None for f in fns):
-        return None  # pure projection — the row path is already cheap
+        return None  # pure projection — build_projection_entries covers it
 
     compute_slots = sorted(
         {
@@ -605,6 +605,28 @@ def build_vector_select(exprs, slot_of_ref):
         return list(zip(*out_cols))
 
     return run
+
+
+def build_projection_entries(exprs, slot_of_ref):
+    """Entry-level fast path for pure-projection selects:
+    ``fn(entries) -> list[Entry]`` rebuilding ``(key, out_row, diff)`` in a
+    single comprehension — no numpy, no intermediate row lists.  Returns
+    None unless every output column is a plain slot reference."""
+    import operator as _op
+
+    if not exprs:
+        return None  # id-only select — row path emits empty tuples
+    slots = []
+    for e in exprs:
+        s = slot_of_ref(e)
+        if s is None:
+            return None
+        slots.append(s)
+    if len(slots) == 1:
+        s0 = slots[0]
+        return lambda entries: [(k, (r[s0],), d) for k, r, d in entries]
+    getter = _op.itemgetter(*slots)
+    return lambda entries: [(k, getter(r), d) for k, r, d in entries]
 
 
 def build_vector_filter(cond, slot_of_ref):

@@ -55,6 +55,11 @@ def _arg1(args):
 class Reducer:
     name = "reducer"
     distinguish_by_key = False
+    #: safe for the groupby node's columnar ingest (engine.py
+    #: GroupByNode._ingest_vector): compute()/update() must ignore the
+    #: contributing row's key and seq, and update() must be linear in
+    #: dcount (k applications of +-1 == one application of +-k)
+    vector_safe = False
     #: decomposable reducers support O(1) per-diff updates (reference:
     #: differential's monoid aggregation in reduce.rs) — the groupby node
     #: then skips the O(group) recompute for touched groups.  A state may
@@ -84,6 +89,7 @@ class Reducer:
 
 class CountReducer(Reducer):
     name = "count"
+    vector_safe = True
     incremental = True
 
     def result_dtype(self, arg_dtypes):
@@ -104,6 +110,7 @@ class CountReducer(Reducer):
 
 class SumReducer(Reducer):
     name = "sum"
+    vector_safe = True
     incremental = True
 
     def result_dtype(self, arg_dtypes):
@@ -143,6 +150,7 @@ class SumReducer(Reducer):
 
 class AvgReducer(Reducer):
     name = "avg"
+    vector_safe = True
     incremental = True
 
     def result_dtype(self, arg_dtypes):
@@ -179,6 +187,7 @@ class AvgReducer(Reducer):
 
 class MinReducer(Reducer):
     name = "min"
+    vector_safe = True
     incremental = True
     _pick = staticmethod(_builtin_min)
 
@@ -274,6 +283,7 @@ class ArgMaxReducer(ArgMinReducer):
 
 class UniqueReducer(Reducer):
     name = "unique"
+    vector_safe = True
 
     def result_dtype(self, arg_dtypes):
         return arg_dtypes[0] if arg_dtypes else dt.ANY

@@ -30,6 +30,7 @@ from .engine import (
 from .evaluator import compile_expression
 from .expression import (
     AsyncApplyExpression,
+    ColumnConstExpression,
     ColumnExpression,
     ColumnReference,
     IdExpression,
@@ -149,8 +150,10 @@ class GraphRunner:
                 src.push(0, entries)
             stream = op.params.get("stream")
             if stream is not None:
-                for t, key, values, diff in stream:
-                    src.push(t, [(key, values, diff)])
+                # contract: stream is {time: [(key, values, diff)]} — built
+                # grouped at parse time so feeding is one push per time
+                for t, ent in stream.items():
+                    src.push(t, ent)
 
     # ---- helpers ----
     def _node_of(self, table) -> Node:
@@ -341,14 +344,22 @@ class GraphRunner:
 
             node = RowwiseNode(fn, memoize=memoize, name=f"select#{op.id}")
             if not memoize:
-                from .evaluator import build_vector_select
+                from .evaluator import (
+                    build_projection_entries,
+                    build_vector_select,
+                )
 
-                # columnar fast path: big batches evaluate as numpy
-                # columns (engine.py RowwiseNode.flush), falling back per
-                # batch when non-numeric values appear
-                node.vector_fn = build_vector_select(
+                # columnar fast paths: pure projections rebuild entries in
+                # one comprehension; computed selects evaluate big batches
+                # as numpy columns (engine.py RowwiseNode.flush), falling
+                # back per batch when non-numeric values appear
+                node.vector_entries_fn = build_projection_entries(
                     list(exprs.values()), layout.slot_of
                 )
+                if node.vector_entries_fn is None:
+                    node.vector_fn = build_vector_select(
+                        list(exprs.values()), layout.slot_of
+                    )
             return node
 
         self._rowwise_pipeline(op, exprs, builder)
@@ -481,6 +492,30 @@ class GraphRunner:
             sort_by_fn=(lambda key, row: sort_fn((key, row))) if sort_fn else None,
             name=f"groupby#{op.id}",
         )
+        # columnar ingest gate: plain column projections (or scalar
+        # constants, e.g. count()'s Const(0) placeholder arg) throughout,
+        # no per-row key/seq sensitivity (GroupByNode._ingest_vector)
+        def vec_arg(a):
+            s = layout.slot_of(a)
+            if s is not None:
+                return s
+            if isinstance(a, ColumnConstExpression) and type(a._value) in (
+                int, float, bool, str, type(None)
+            ):
+                return ("const", a._value)
+            return None
+
+        group_slots = [layout.slot_of(g) for g in grouping]
+        red_arg_slots = [[vec_arg(a) for a in r.args] for r in reducers]
+        if (
+            inst_fn is None
+            and sort_fn is None
+            and all(s is not None for s in group_slots)
+            and all(s is not None for sl in red_arg_slots for s in sl)
+            and all(r.reducer.vector_safe for r in reducers)
+            and not any(r.reducer.distinguish_by_key for r in reducers)
+        ):
+            node.vector_spec = (group_slots, red_arg_slots)
         self.engine.add(node)
         self._connect_inputs(op, node)
         self._register(op, node)

@@ -1,0 +1,156 @@
+"""Columnar groupby ingest (engine.py GroupByNode._ingest_vector).
+
+The vector path must be invisible: state stays bit-compatible with the
+row path so big (vectorized) and small (row-path) batches interleave on
+one node, and every columnar-unsafe batch falls back silently.
+reference parity: the Rust engine's grouped reduce is differential's
+``reduce`` (src/engine/dataflow.rs); these tests pin our micro-batch
+equivalent's semantics under the columnar rewrite.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.engine import GroupByNode
+from pathway_tpu.internals.keys import ref_scalar
+
+VEC = GroupByNode.VECTOR_MIN_ROWS  # batches >= this take the vector path
+
+
+def _counts_node():
+    node = GroupByNode(
+        group_fn=lambda k, r: (r[0],),
+        instance_fn=None,
+        args_fn=lambda k, r: ((0,),),
+        out_fn=lambda g, v: (g[0], v[0]),
+        key_fn=None,
+        reducers=[pw.reducers.count().reducer],
+    )
+    node.vector_spec = ([0], [[("const", 0)]])
+    return node
+
+
+def test_vector_groupby_used_and_matches_oracle():
+    n = max(4 * VEC, 2000)
+    lines = ["    w | x | __time__ | __diff__"]
+    for i in range(n):
+        lines.append(f"    k{i % 7} | {i} | 2 | 1")
+    # follow-up small batch exercises the row path on the same node
+    lines.append("    k0 | 0 | 4 | -1")
+    lines.append("    fresh | 5 | 4 | 1")
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    r = t.groupby(t.w).reduce(
+        t.w,
+        n=pw.reducers.count(),
+        s=pw.reducers.sum(t.x),
+        mn=pw.reducers.min(t.x),
+        mx=pw.reducers.max(t.x),
+        a=pw.reducers.avg(t.x),
+    )
+    (out,) = pw.debug.materialize(r)
+    got = {row[0]: row[1:] for row in out.current.values()}
+
+    vals = collections.defaultdict(list)
+    for i in range(n):
+        vals[f"k{i % 7}"].append(i)
+    vals["k0"].remove(0)
+    vals["fresh"].append(5)
+    for k, v in vals.items():
+        assert got[k] == (len(v), sum(v), min(v), max(v), sum(v) / len(v))
+
+
+def test_vector_groupby_retractions_within_one_batch():
+    n = 2 * VEC
+    lines = ["    w | __time__ | __diff__"]
+    for i in range(n):
+        lines.append(f"    k{i % 3} | 2 | 1")
+    # cancel a whole group inside the same timestamp
+    for i in range(n):
+        if i % 3 == 2:
+            lines.append("    k2 | 2 | -1")
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    r = t.groupby(t.w).reduce(t.w, c=pw.reducers.count())
+    (out,) = pw.debug.materialize(r)
+    got = {row[0]: row[1] for row in out.current.values()}
+    assert "k2" not in got
+    assert got["k0"] == (n + 2) // 3
+    assert got["k1"] == (n + 1) // 3
+
+
+def test_global_reduce_const_args_vector_batch():
+    n = 2 * VEC
+    lines = ["    x | __time__"] + [f"    {i} | 2" for i in range(n)]
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    (out,) = pw.debug.materialize(t.reduce(c=pw.reducers.count()))
+    assert list(out.current.values()) == [(n,)]
+
+
+def test_mixed_int_str_column_falls_back():
+    # numpy would coerce [1, "1"] to one string dtype and merge the
+    # groups; the guard must route the batch to the row path instead
+    node = _counts_node()
+    n = 2 * VEC
+    entries = [
+        (ref_scalar(i), (("1" if i % 2 else 1),), 1) for i in range(n)
+    ]
+    node.receive(0, entries)
+    out = node.flush(2)
+    groups = {row[0] for _, row, _ in out}
+    assert groups == {1, "1"}
+    counts = {row[0]: row[1] for _, row, d in out if d > 0}
+    assert counts == {1: n // 2, "1": n // 2}
+
+
+def test_ndarray_column_falls_back():
+    n = 2 * VEC
+    t = pw.debug.table_from_rows(pw.schema_from_types(g=str), [("a",)] * n)
+    arr_udf = pw.udfs.udf(lambda g: np.ones(3))(t.g)
+    t2 = t.select(g=t.g, v=arr_udf)
+    r = t2.groupby(t2.g).reduce(t2.g, s=pw.reducers.sum(t2.v))
+    (out,) = pw.debug.materialize(r)
+    (row,) = out.current.values()
+    assert np.allclose(row[1], np.full(3, float(n)))
+
+
+def test_nan_grouping_column_falls_back():
+    # each NaN object is its own dict key on the row path; np.unique
+    # would merge them — the batch must fall back
+    node = GroupByNode(
+        group_fn=lambda k, r: (r[0],),
+        instance_fn=None,
+        args_fn=lambda k, r: ((0,),),
+        out_fn=lambda g, v: (v[0],),
+        key_fn=None,
+        reducers=[pw.reducers.count().reducer],
+    )
+    node.vector_spec = ([0], [[("const", 0)]])
+    n = 2 * VEC
+    entries = [(ref_scalar(i), (float("nan"),), 1) for i in range(n)]
+    node.receive(0, entries)
+    out = node.flush(2)
+    # row path: every NaN object compares unequal, so each lands in its
+    # own group of count 1; the groups collide on one output key and
+    # consolidate into a single entry with diff n.  The vector path would
+    # instead merge them into ONE group emitting row (n,) with diff 1.
+    assert all(row == (1,) for _, row, _ in out)
+    assert sum(d for _, _, d in out) == n
+
+
+def test_empty_select_lowering():
+    t = pw.debug.table_from_rows(pw.schema_from_types(a=int), [(1,), (2,)])
+    (out,) = pw.debug.materialize(t.select())
+    assert len(out.current) == 2
+
+
+def test_projection_small_batch_uses_entries_fn():
+    # the entry-level projection path has no minimum batch size
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, b=str), [(1, "x"), (2, "y")]
+    )
+    (out,) = pw.debug.materialize(t.select(t.b, t.a))
+    assert sorted(out.current.values()) == [("x", 1), ("y", 2)]
