@@ -59,6 +59,10 @@ __all__ = [
 # An entry is (key, values_tuple, diff)
 Entry = tuple[Pointer, tuple, int]
 
+#: hashable stand-in for a None cell on the join fast path (None itself is
+#: the slow path's "key function returned no key" sentinel)
+_NULL_CELL = ("__pw_null_cell__",)
+
 
 def freeze_value(v: Any) -> Any:
     """Hashable representative of a value (ndarrays/Json are unhashable)."""
@@ -294,7 +298,10 @@ class ZipNode(Node):
     def flush(self, time: int) -> list[Entry]:
         touched: set[Pointer] = set()
         for port in range(self.n_inputs):
-            for key, row, diff in self.take(port):
+            # consolidate here: slot assignment below is last-entry-wins,
+            # so a transient add+retract pair (net zero) from an
+            # unconsolidated upstream must cancel before it is applied
+            for key, row, diff in consolidate(self.take(port)):
                 slot = self.state.setdefault(key, [None] * self.n_inputs)
                 slot[port] = row if diff > 0 else None
                 touched.add(key)
@@ -581,6 +588,11 @@ class JoinNode(Node):
         # padded rows currently emitted, per side: jk -> {slot: [count,key,row]}
         self.left_padded: dict[Any, dict] = defaultdict(dict)
         self.right_padded: dict[Any, dict] = defaultdict(dict)
+        #: single-column equi-join fast path (set by the lowering): probe
+        #: with the raw cell — no 1-tuple build, no freeze_value walk.
+        #: Both sides must be set together so bucket identities agree.
+        self.left_key_slot: int | None = None
+        self.right_key_slot: int | None = None
 
     @staticmethod
     def _apply(state: dict, jk, key, row, diff) -> None:
@@ -610,7 +622,10 @@ class JoinNode(Node):
             self._reconcile_padding(affected, left_side=True, out=out)
         if self.right_outer:
             self._reconcile_padding(affected, left_side=False, out=out)
-        return consolidate(out)
+        # raw diffs out: stateful consumers absorb add/retract pairs and
+        # OutputNode/DeduplicateNode consolidate their own input — same
+        # reasoning as row-wise maps (join emit is the next-hottest path)
+        return out
 
     def _emit(self, lkey, lrow, rkey, rrow, diff, out: list[Entry]) -> None:
         values = self.out_fn(lkey, lrow, rkey, rrow)
@@ -619,12 +634,29 @@ class JoinNode(Node):
 
     def _process(self, entries: list[Entry], left_side: bool, affected: set) -> list[Entry]:
         out: list[Entry] = []
-        my_key_fn = self.left_key_fn if left_side else self.right_key_fn
         my_state = self.left_state if left_side else self.right_state
         other_state = self.right_state if left_side else self.left_state
         my_count = self.left_count if left_side else self.right_count
+        slot = self.left_key_slot if left_side else self.right_key_slot
+        my_key_fn = None
+        if slot is None:
+            my_key_fn = self.left_key_fn if left_side else self.right_key_fn
         for key, row, diff in entries:
-            jk = freeze_value(my_key_fn(key, row))
+            if my_key_fn is None:
+                jk = row[slot]
+                if jk is None:
+                    # a None CELL is an ordinary join key (the tuple path
+                    # matches (None,) with (None,)); only a None result of
+                    # a key FUNCTION (ix optional pointer) means no-match.
+                    # _NULL_CELL is a process-unique hashable stand-in.
+                    jk = _NULL_CELL
+                else:
+                    try:
+                        hash(jk)
+                    except TypeError:  # ndarray/Json cell — freeze it
+                        jk = freeze_value(jk)
+            else:
+                jk = freeze_value(my_key_fn(key, row))
             if jk is None:
                 # null join keys never match (SQL semantics); a null-key row
                 # still participates in outer padding via a private bucket
@@ -634,12 +666,17 @@ class JoinNode(Node):
                 my_count[jk] += diff
                 continue
             affected.add(jk)
-            # inner products against current other side
-            for cnt, okey, orow in list(other_state.get(jk, {}).values()):
+            # inner products against the current other side; other_state
+            # is a different dict from my_state and is only mutated by the
+            # other port's drain, so iterating its live bucket is safe
+            bucket = other_state.get(jk)
+            if bucket:
                 if left_side:
-                    self._emit(key, row, okey, orow, diff * cnt, out)
+                    for cnt, okey, orow in bucket.values():
+                        self._emit(key, row, okey, orow, diff * cnt, out)
                 else:
-                    self._emit(okey, orow, key, row, diff * cnt, out)
+                    for cnt, okey, orow in bucket.values():
+                        self._emit(okey, orow, key, row, diff * cnt, out)
             self._apply(my_state, jk, key, row, diff)
             my_count[jk] += diff
         return out
@@ -737,7 +774,8 @@ class UpdateRowsNode(Node):
         out: list[Entry] = []
         touched: dict[Pointer, tuple | None] = {}
         for port in (0, 1):
-            for key, row, diff in self.take(port):
+            # consolidate: slot assignment is last-entry-wins (see ZipNode)
+            for key, row, diff in consolidate(self.take(port)):
                 slot = self.state.setdefault(key, [None, None])
                 if key not in touched:
                     touched[key] = self._current(slot)
@@ -777,7 +815,8 @@ class UpdateCellsNode(Node):
         out: list[Entry] = []
         touched: dict[Pointer, tuple | None] = {}
         for port in (0, 1):
-            for key, row, diff in self.take(port):
+            # consolidate: slot assignment is last-entry-wins (see ZipNode)
+            for key, row, diff in consolidate(self.take(port)):
                 slot = self.state.setdefault(key, [None, None])
                 if key not in touched:
                     touched[key] = self._current(slot)

@@ -269,6 +269,8 @@ class ColumnConstExpression(ColumnExpression):
             return dt.NONE
         if isinstance(v, bool):
             return dt.BOOL
+        if isinstance(v, Pointer):  # before int: Pointer subclasses it
+            return dt.POINTER
         if isinstance(v, int):
             return dt.INT
         if isinstance(v, float):
@@ -277,8 +279,6 @@ class ColumnConstExpression(ColumnExpression):
             return dt.STR
         if isinstance(v, bytes):
             return dt.BYTES
-        if isinstance(v, Pointer):
-            return dt.POINTER
         if isinstance(v, Json):
             return dt.JSON
         if isinstance(v, np.ndarray):

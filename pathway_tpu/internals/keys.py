@@ -44,6 +44,8 @@ def _serialize(value: Any, out: bytearray) -> None:
         out += b"\x0e"
     elif isinstance(value, bool):
         out += b"\x01" + (b"\x01" if value else b"\x00")
+    elif isinstance(value, Pointer):  # before int: Pointer subclasses it
+        out += b"\x06" + value.value.to_bytes(16, "little")
     elif isinstance(value, int):
         out += b"\x02" + value.to_bytes(16, "little", signed=True)
     elif isinstance(value, float):
@@ -53,8 +55,6 @@ def _serialize(value: Any, out: bytearray) -> None:
         out += b"\x04" + len(b).to_bytes(8, "little") + b
     elif isinstance(value, bytes):
         out += b"\x05" + len(value).to_bytes(8, "little") + value
-    elif isinstance(value, Pointer):
-        out += b"\x06" + value.value.to_bytes(16, "little")
     elif isinstance(value, tuple):
         out += b"\x07" + len(value).to_bytes(8, "little")
         for v in value:
@@ -88,11 +88,55 @@ def _digest128(data: bytes) -> int:
     return int.from_bytes(hashlib.blake2b(data, digest_size=16).digest(), "little")
 
 
+_MASK128 = (1 << 128) - 1
+#: FNV-128 prime / offset basis
+_FNV128_PRIME = 0x0000000001000000000000000000013B
+_FNV128_BASIS = 0x6C62272E07BB014262B821756295C58D
+#: per-type tags mirror the _serialize tag bytes so Pointer(5) and int 5
+#: cannot collide structurally
+_TAG_INT = 0x2 << 124
+_TAG_PTR = 0x6 << 124
+_INT128_MIN = -(1 << 127)
+_INT128_MAX = 1 << 127
+_AVALANCHE = 0x9E3779B97F4A7C15F39CC0605CEDC835  # odd
+
+
+def _mix128(values: tuple) -> int | None:
+    """Fast non-cryptographic 128-bit key mix for Pointer/int-only tuples
+    — the hot derivation on join/reindex/flatten output paths, where the
+    reference likewise uses non-crypto SipHash (value.rs Key::for_values).
+    Everything else keeps the BLAKE2b path.  Returns None when a value
+    isn't eligible."""
+    h = _FNV128_BASIS
+    for v in values:
+        t = type(v)
+        if t is Pointer:
+            h ^= v ^ _TAG_PTR  # Pointer subclasses int; already in range
+        elif t is int:
+            if not _INT128_MIN <= v < _INT128_MAX:
+                # out of signed-128 range: the serialize path raises
+                # OverflowError loudly; never wrap into a collision
+                return None
+            h ^= (v & _MASK128) ^ _TAG_INT
+        else:
+            return None
+        h = (h * _FNV128_PRIME) & _MASK128
+    # avalanche so low-entropy inputs (small ints) spread into the high
+    # bits that shard_of_key reads
+    h ^= h >> 64
+    h = (h * _AVALANCHE) & _MASK128
+    h ^= h >> 64
+    return h
+
+
 def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
     """Derive a deterministic Pointer from a tuple of values
     (reference: python/pathway/internals/api.py ``ref_scalar``)."""
     if optional and any(v is None for v in values):
         return None  # type: ignore[return-value]
+    h = _mix128(values)
+    if h is not None:
+        return Pointer(h)
     out = bytearray()
     for v in values:
         _serialize(v, out)

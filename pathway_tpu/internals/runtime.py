@@ -581,9 +581,60 @@ class GraphRunner:
             exact_match=op.params.get("exact_match", False),
             name=f"join#{op.id}",
         )
+        # single-column equi-join: probe with the raw cell instead of a
+        # frozen 1-tuple (JoinNode._process fast loop)
+        if len(on) == 1:
+            ls = llayout.slot_of(on[0][0])
+            rs = rlayout.slot_of(on[0][1])
+            if ls is not None and rs is not None:
+                node.left_key_slot = ls
+                node.right_key_slot = rs
+        # plain-reference join select: code-generate the output-row
+        # constructor once (a tuple display of subscripts) instead of a
+        # per-row genexpr over compiled closures
+        fast_out = self._join_fast_out(
+            out_exprs, left, right, lcols, rcols,
+            none_checks=mode is not JoinMode.INNER,
+        )
+        if fast_out is not None:
+            node.out_fn = fast_out
         self.engine.add(node)
         self._connect_inputs(op, node)
         self._register(op, node)
+
+    @staticmethod
+    def _join_fast_out(out_exprs, left, right, lcols, rcols, none_checks):
+        parts = []
+        for e in out_exprs.values():
+            if not isinstance(e, ColumnReference):
+                return None
+            if e.name == "id":
+                if e.table is left:
+                    parts.append("lkey")
+                elif e.table is right:
+                    parts.append("rkey")
+                else:
+                    return None
+            elif e.table is left and e.name in lcols:
+                idx = lcols[e.name]
+                parts.append(
+                    f"(lrow[{idx}] if lrow is not None else None)"
+                    if none_checks
+                    else f"lrow[{idx}]"
+                )
+            elif e.table is right and e.name in rcols:
+                idx = rcols[e.name]
+                parts.append(
+                    f"(rrow[{idx}] if rrow is not None else None)"
+                    if none_checks
+                    else f"rrow[{idx}]"
+                )
+            else:
+                return None
+        if not parts:
+            return None
+        body = ", ".join(parts) + ("," if len(parts) == 1 else "")
+        return eval(f"lambda lkey, lrow, rkey, rrow: ({body})")
 
     def _lower_ix(self, op: Operator) -> None:
         context_t, source_t = op.inputs

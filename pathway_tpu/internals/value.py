@@ -203,54 +203,52 @@ def _freeze(v: Any):
     return v
 
 
-class Pointer:
+class Pointer(int):
     """128-bit row key (reference: src/engine/value.rs:41 ``Key``).
 
     The low 16 bits form the shard field (value.rs:38 ``SHARD_MASK``) used by
     the ``ShardPolicy::LastKeyColumn`` instance-based co-partitioning — the
     same field decides which host/device shard owns the row in the TPU build.
+
+    Subclasses ``int`` so hashing/equality on every consolidate, groupby
+    and join probe run at C level with no Python frame — keys are the
+    hottest dict keys in the engine.  Type-dispatch sites that must
+    distinguish keys from plain ints (wire format, key serialization,
+    const dtype inference) check Pointer before int.  Accepted tradeoff:
+    ``Pointer(n) == n`` — a Pointer and a numerically equal plain int
+    merge when used as dict keys in the same mapping.  Columns are
+    statically typed (POINTER vs INT), so mixed mappings only arise for
+    ANY-typed columns, mirroring the kind of cross-type equality the row
+    path already had for int/float/bool.
     """
 
-    __slots__ = ("value", "_hash")
+    __slots__ = ()
 
     SHARD_BITS = 16
     SHARD_MASK = (1 << SHARD_BITS) - 1
     _MOD = 1 << 128
 
-    def __init__(self, value: int):
-        self.value = value & (self._MOD - 1)
-        # keys are hashed on every consolidate/groupby/join probe — cache
-        # the 128-bit int reduction once at construction
-        self._hash = hash(self.value)
+    def __new__(cls, value: int):
+        if 0 <= value < cls._MOD:
+            # already in range (every derived key is): skip the 128-bit
+            # mask, which would allocate a fresh bigint per construction
+            return int.__new__(cls, value)
+        return int.__new__(cls, value & (cls._MOD - 1))
+
+    @property
+    def value(self) -> int:
+        return int(self)
 
     @property
     def shard(self) -> int:
-        return self.value & self.SHARD_MASK
+        return int(self) & self.SHARD_MASK
 
     def with_shard(self, shard: int) -> "Pointer":
         """reference: value.rs:76 ``with_shard_of``"""
-        return Pointer((self.value & ~self.SHARD_MASK) | (shard & self.SHARD_MASK))
+        return Pointer((int(self) & ~self.SHARD_MASK) | (shard & self.SHARD_MASK))
 
     def with_shard_of(self, other: "Pointer") -> "Pointer":
         return self.with_shard(other.shard)
-
-    def __eq__(self, other: Any) -> bool:
-        return isinstance(other, Pointer) and self.value == other.value
-
-    def __lt__(self, other: "Pointer") -> bool:
-        return self.value < other.value
-
-    def __le__(self, other: "Pointer") -> bool:
-        return self.value <= other.value
-
-    def __gt__(self, other: "Pointer") -> bool:
-        return self.value > other.value
-
-    def __ge__(self, other: "Pointer") -> bool:
-        return self.value >= other.value
-
-    def __hash__(self) -> int:
-        return self._hash
 
     def __repr__(self) -> str:
         return f"^{self.value:032X}"

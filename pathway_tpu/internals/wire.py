@@ -102,6 +102,9 @@ def encode_value(v: Any, out: bytearray) -> None:
         out.append(_T_PENDING)
     elif isinstance(v, bool):
         out.append(_T_TRUE if v else _T_FALSE)
+    elif isinstance(v, Pointer):  # before int: Pointer subclasses it
+        out.append(_T_POINTER)
+        out += v.value.to_bytes(16, "little")
     elif isinstance(v, int):
         if _I64_MIN <= v <= _I64_MAX:
             out.append(_T_I64)
@@ -123,9 +126,6 @@ def encode_value(v: Any, out: bytearray) -> None:
         out.append(_T_BYTES)
         out += _U32.pack(_check_len(len(v), "bytes value"))
         out += v
-    elif isinstance(v, Pointer):
-        out.append(_T_POINTER)
-        out += v.value.to_bytes(16, "little")
     elif isinstance(v, tuple):
         out.append(_T_TUPLE)
         out += _U32.pack(len(v))

@@ -154,3 +154,71 @@ def test_projection_small_batch_uses_entries_fn():
     )
     (out,) = pw.debug.materialize(t.select(t.b, t.a))
     assert sorted(out.current.values()) == [("x", 1), ("y", 2)]
+
+
+def test_zipnode_transient_churn_cancels():
+    # unconsolidated upstreams may deliver add+retract (net zero) pairs in
+    # one timestamp; ZipNode's slot assignment must not treat the trailing
+    # retract as a deletion
+    from pathway_tpu.internals.engine import ZipNode
+
+    node = ZipNode(2, fn=lambda key, rows: (rows[0][0] + rows[1][0],))
+    k = ref_scalar(1)
+    node.receive(0, [(k, (5,), 1)])
+    node.receive(1, [(k, (7,), 1)])
+    assert node.flush(2) == [(k, (12,), 1)]
+    # transient churn on one port, net zero
+    node.receive(1, [(k, (7,), 1), (k, (7,), -1)])
+    assert node.flush(4) == []
+    assert node.last_out[k] == (12,)
+
+
+def test_join_none_cells_match_like_tuple_path():
+    # a None CELL is an ordinary join key on both the 1-column fast path
+    # and the multi-column tuple path — the two must agree
+    base_l = """
+          | k | k2 | v | __time__
+        1 | a | a  | 1 | 2
+        2 |   |    | 9 | 2
+    """
+    base_r = """
+           | rk | rk2 | w | __time__
+        10 | a  | a   | 4 | 2
+        11 |    |     | 8 | 2
+    """
+    l1 = pw.debug.table_from_markdown(base_l)
+    r1 = pw.debug.table_from_markdown(base_r)
+    single = l1.join(r1, l1.k == r1.rk).select(l1.v, r1.w)
+    (o1,) = pw.debug.materialize(single)
+    got1 = sorted(o1.current.values())
+
+    pw.internals.graph.G.clear()
+    l2 = pw.debug.table_from_markdown(base_l)
+    r2 = pw.debug.table_from_markdown(base_r)
+    double = l2.join(
+        r2, l2.k == r2.rk, l2.k2 == r2.rk2
+    ).select(l2.v, r2.w)
+    (o2,) = pw.debug.materialize(double)
+    got2 = sorted(o2.current.values())
+    assert got1 == got2 == [(1, 4), (9, 8)]
+
+
+def test_pointer_const_dtype_is_pointer():
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals.expression import ColumnConstExpression
+    from pathway_tpu.internals.keys import ref_scalar as rs
+
+    assert ColumnConstExpression(rs("x"))._dtype is dt.POINTER
+    assert ColumnConstExpression(5)._dtype is dt.INT
+
+
+def test_huge_int_keys_raise_not_collide():
+    from pathway_tpu.internals.keys import ref_scalar as rs
+
+    assert rs(-1) != rs(-2)
+    # out-of-signed-128-range ints fail loudly on the serialize path
+    # instead of wrapping onto an in-range value's key
+    with pytest.raises(OverflowError):
+        rs(1 << 127)
+    with pytest.raises(OverflowError):
+        rs((1 << 128) - 1)
