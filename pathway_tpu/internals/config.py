@@ -86,6 +86,17 @@ class PathwayConfig:
             self.threads = max(MAX_WORKERS // self.processes, 0)
             if self.threads == 0:
                 self.threads = 1
+                if self.process_id >= MAX_WORKERS:
+                    # this process is beyond the capped cluster: exiting
+                    # loudly beats shrinking `processes` under it — the
+                    # shrunken plane would have no address slot for us and
+                    # owner hashing would no longer match the peers
+                    raise RuntimeError(
+                        f"process id {self.process_id} exceeds the free-tier "
+                        f"worker cap ({MAX_WORKERS}); set PATHWAY_LICENSE_KEY "
+                        "or launch at most "
+                        f"{MAX_WORKERS} processes"
+                    )
                 self.processes = MAX_WORKERS
 
     @property

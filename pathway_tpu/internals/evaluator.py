@@ -520,13 +520,14 @@ def compile_vector_expression(
     return None if compiled is None else compiled[0]
 
 
-def _collect_slots(e, slot_of_ref) -> set:
-    out = set()
+def _collect_slots(e, slot_of_ref) -> dict:
+    """Slots referenced by ``e`` mapped to their declared dtype."""
+    out: dict = {}
 
     def walk(node):
         slot = slot_of_ref(node)
         if slot is not None:
-            out.add(slot)
+            out[slot] = getattr(node, "_dtype", None)
             return
         for d in getattr(node, "_deps", lambda: ())() or ():
             walk(d)
@@ -535,11 +536,14 @@ def _collect_slots(e, slot_of_ref) -> set:
     return out
 
 
-def _materialize_cols(rows, slots):
+def _materialize_cols(rows, slots, int_slots=()):
     """Column arrays for ``slots``; None if any column is non-numeric
     (object dtype: None/ERROR/strings present in the batch) or an int
     column exceeds the wraparound-safety bound the compile-time analysis
-    assumed."""
+    assumed.  A declared-INT column whose batch happens to be all Python
+    bools (bool subclasses int, so the row path accepts them) widens to
+    int64 so arithmetic stays numeric — numpy bool ops are logical
+    (True+True == True) and unary ``-`` raises."""
     import numpy as np
 
     cols = {}
@@ -547,6 +551,8 @@ def _materialize_cols(rows, slots):
         arr = np.asarray([r[s] for r in rows])
         if arr.dtype == object:
             return None
+        if arr.dtype.kind == "b" and s in int_slots:
+            arr = arr.astype(np.int64)
         if arr.dtype.kind == "i" and (
             arr.max(initial=0) >= VECTOR_INT_BOUND
             or arr.min(initial=0) <= -VECTOR_INT_BOUND
@@ -576,17 +582,17 @@ def build_vector_select(exprs, slot_of_ref):
     if all(f is None for f in fns):
         return None  # pure projection — build_projection_entries covers it
 
-    compute_slots = sorted(
-        {
-            s
-            for f, e in zip(fns, exprs)
-            if f is not None
-            for s in _collect_slots(e, slot_of_ref)
-        }
+    slot_dtypes: dict = {}
+    for f, e in zip(fns, exprs):
+        if f is not None:
+            slot_dtypes.update(_collect_slots(e, slot_of_ref))
+    compute_slots = sorted(slot_dtypes)
+    int_slots = frozenset(
+        s for s, d in slot_dtypes.items() if d is dt.INT
     )
 
     def run(rows):
-        cols = _materialize_cols(rows, compute_slots)
+        cols = _materialize_cols(rows, compute_slots, int_slots)
         if cols is None:
             return None
         n = len(rows)
@@ -635,12 +641,14 @@ def build_vector_filter(cond, slot_of_ref):
     f = compile_vector_expression(cond, slot_of_ref)
     if f is None:
         return None
-    slots = sorted(_collect_slots(cond, slot_of_ref))
+    slot_dtypes = _collect_slots(cond, slot_of_ref)
+    slots = sorted(slot_dtypes)
     if not slots:
         return None
+    int_slots = frozenset(s for s, d in slot_dtypes.items() if d is dt.INT)
 
     def run(rows):
-        cols = _materialize_cols(rows, slots)
+        cols = _materialize_cols(rows, slots, int_slots)
         if cols is None:
             return None
         return f(cols).tolist()

@@ -91,15 +91,19 @@ class ExchangePlane:
     reachable from a well-behaved peer, the role timely's progress
     tracking plays in the reference.
 
-    Peers authenticate on connect with a magic preamble + a BLAKE2b
-    digest of ``PATHWAY_EXCHANGE_TOKEN`` (empty default).  Stray
-    connections (port scanners, wrong cluster) are dropped without
-    consuming a peer slot and without ever reaching frame decoding — set
-    the token on any shared network.
+    Peers authenticate on connect with a mutual challenge-response
+    keyed by ``PATHWAY_EXCHANGE_TOKEN`` (empty default): each side proves
+    knowledge of the token by MACing the other side's fresh nonce, so an
+    observer of one handshake cannot replay anything (the old static
+    token digest was replayable).  Stray connections (port scanners,
+    wrong cluster) are dropped without consuming a peer slot and without
+    ever reaching frame decoding — set a strong token on any shared
+    network (a passive observer can brute-force weak tokens offline from
+    a captured nonce/MAC pair).
     """
 
-    #: connection preamble: magic + sender id + token digest
-    _HELLO_MAGIC = b"PWXCHG01"
+    #: connection preamble: magic + sender id + client nonce
+    _HELLO_MAGIC = b"PWXCHG02"
 
     def __init__(self, processes: int, process_id: int, first_port: int,
                  host: str = "127.0.0.1",
@@ -121,8 +125,10 @@ class ExchangePlane:
             import os
 
             token = os.environ.get("PATHWAY_EXCHANGE_TOKEN", "")
-        self._token_digest = hashlib.blake2b(
-            token.encode("utf-8"), digest_size=16
+        self._has_token = bool(token)
+        #: MAC key: fixed-size derivation of the (arbitrary-length) token
+        self._token_key = hashlib.blake2b(
+            token.encode("utf-8"), digest_size=32
         ).digest()
         self._send: dict[int, socket.socket] = {}
         self._inbox: dict[tuple, list] = {}  # (channel, time, from) -> payload
@@ -144,9 +150,7 @@ class ExchangePlane:
         # authenticated frame can execute code: spanning real hosts
         # without a shared secret would leave the port open to anyone who
         # can compute blake2b("") — refuse instead of warn
-        if self._token_digest == hashlib.blake2b(
-            b"", digest_size=16
-        ).digest() and any(
+        if not self._has_token and any(
             h not in ("127.0.0.1", "localhost", "::1")
             for h, _ in self.addresses
         ):
@@ -169,25 +173,40 @@ class ExchangePlane:
         accept_th = threading.Thread(target=self._accept_loop, daemon=True)
         accept_th.start()
         self._threads.append(accept_th)
-        hello = (
-            self._HELLO_MAGIC
-            + struct.pack("<H", self.me)
-            + self._token_digest
-        )
         deadline = _time.monotonic() + timeout
         for peer in range(self.n):
             if peer == self.me:
                 continue
             while True:
                 try:
+                    import os as _os
+
                     s = socket.create_connection(
                         self.addresses[peer], timeout=2.0
                     )
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    s.sendall(hello)
+                    # mutual challenge-response: send a fresh nonce, check
+                    # the server MACs it, then answer the server's nonce
+                    my_nonce = _os.urandom(16)
+                    s.sendall(
+                        self._HELLO_MAGIC
+                        + struct.pack("<H", self.me)
+                        + my_nonce
+                    )
+                    s.settimeout(5.0)
+                    resp = self._recv_exact(s, 32)
+                    if resp is None or not _digest_eq(
+                        resp[16:], self._mac(my_nonce, b"srv")
+                    ):
+                        s.close()
+                        raise RuntimeError(
+                            f"process {self.me}: peer {peer} failed the "
+                            "exchange challenge (PATHWAY_EXCHANGE_TOKEN "
+                            "mismatch?)"
+                        )
+                    s.sendall(self._mac(resp[:16], b"cli"))
                     # wait for the acceptor's 1-byte ack: a token mismatch
                     # fails fast at startup, not as a barrier timeout later
-                    s.settimeout(5.0)
                     ack = self._recv_exact(s, 1)
                     s.settimeout(None)
                     if ack != b"\x01":
@@ -210,6 +229,11 @@ class ExchangePlane:
 
     _HELLO_LEN = len(_HELLO_MAGIC) + 2 + 16
 
+    def _mac(self, *parts: bytes) -> bytes:
+        return hashlib.blake2b(
+            b"".join(parts), key=self._token_key, digest_size=16
+        ).digest()
+
     def _accept_loop(self) -> None:
         # handshakes run per-connection so a byte-dribbling stray cannot
         # stall acceptance of legitimate peers behind it
@@ -227,27 +251,40 @@ class ExchangePlane:
     def _handshake(self, conn: socket.socket) -> None:
         """Authenticate one inbound connection; a stray connection is
         closed without ever reaching frame decoding."""
+        import os as _os
+
+        def _read_exact(n: int, deadline: float) -> bytes | None:
+            buf = b""
+            while len(buf) < n:
+                if _time.monotonic() > deadline:
+                    return None
+                chunk = conn.recv(n - len(buf))
+                if not chunk:
+                    return None
+                buf += chunk
+            return buf
+
+        magic_len = len(self._HELLO_MAGIC)
         try:
-            # overall deadline for the whole hello, not per recv call
+            # overall deadline for the whole exchange, not per recv call
             conn.settimeout(5.0)
             deadline = _time.monotonic() + 5.0
-            hello = b""
-            while len(hello) < self._HELLO_LEN:
-                if _time.monotonic() > deadline:
-                    raise OSError("handshake deadline")
-                chunk = conn.recv(self._HELLO_LEN - len(hello))
-                if not chunk:
-                    raise OSError("handshake EOF")
-                hello += chunk
+            hello = _read_exact(self._HELLO_LEN, deadline)
+            if hello is None or hello[:magic_len] != self._HELLO_MAGIC:
+                raise OSError("bad hello")
+            client_nonce = hello[magic_len + 2 :]
+            # challenge-response: prove we know the token by MACing the
+            # client's nonce, then demand a MAC over a nonce of ours — a
+            # captured handshake gives an observer nothing replayable
+            server_nonce = _os.urandom(16)
+            conn.sendall(server_nonce + self._mac(client_nonce, b"srv"))
+            answer = _read_exact(16, deadline)
+            if answer is None or not _digest_eq(
+                answer, self._mac(server_nonce, b"cli")
+            ):
+                raise OSError("bad challenge answer")
             conn.settimeout(None)
         except OSError:
-            hello = None
-        magic_len = len(self._HELLO_MAGIC)
-        if (
-            hello is None
-            or hello[:magic_len] != self._HELLO_MAGIC
-            or not _digest_eq(hello[magic_len + 2 :], self._token_digest)
-        ):
             try:
                 conn.close()
             except OSError:
@@ -307,14 +344,20 @@ class ExchangePlane:
         channel: str,
         time: int,
         outgoing: dict[int, list],
+        is_entries: bool = True,
     ) -> list:
         """Send per-destination batches, receive this channel's batches
         from every peer for ``time``; returns the merged remote entries.
-        A barrier: blocks until all peers have sent for (channel, time)."""
+        A barrier: blocks until all peers have sent for (channel, time).
+        ``is_entries=False`` marks control payloads (arbitrary values
+        rather than (key, row, diff) entries)."""
         for peer in range(self.n):
             if peer == self.me:
                 continue
-            payload = encode_frame(channel, time, self.me, outgoing.get(peer, []))
+            payload = encode_frame(
+                channel, time, self.me, outgoing.get(peer, []),
+                is_entries=is_entries,
+            )
             # single sender thread (engine + driver barriers share it), so
             # no send lock: a lock shared across peer sockets would let one
             # stalled peer's TCP window block sends to every other peer

@@ -26,6 +26,7 @@ arbitrary-precision Python ints survive the trip bit-exactly.
 
 from __future__ import annotations
 
+import os
 import pickle
 import struct
 from typing import Any
@@ -74,6 +75,28 @@ _T_ERROR = 0x11
 _T_PENDING = 0x12
 _T_SET = 0x13
 _T_PICKLE = 0xFF
+
+#: the pickle escape hatch can execute code at decode time.  It is OFF by
+#: default: an authenticated-but-hostile (or replayed) frame must not be
+#: able to run arbitrary code.  Clusters that exchange exotic UDF values
+#: opt in explicitly on every process.  Programmatic override for embed-
+#: ders/tests; the env var is consulted at call time so setting it after
+#: import works as the error message instructs.
+_ALLOW_PICKLE = False
+
+
+def _pickle_allowed() -> bool:
+    return (
+        _ALLOW_PICKLE
+        or os.environ.get("PATHWAY_WIRE_ALLOW_PICKLE", "") == "1"
+    )
+
+
+_PICKLE_OFF_MSG = (
+    "the wire-format pickle escape hatch is disabled (it can execute "
+    "code on the receiving process); set PATHWAY_WIRE_ALLOW_PICKLE=1 on "
+    "every process to exchange values outside the engine value model"
+)
 
 _I64_MIN = -(1 << 63)
 _I64_MAX = (1 << 63) - 1
@@ -152,6 +175,8 @@ def encode_value(v: Any, out: bytearray) -> None:
         if v.dtype.hasobject:
             # object arrays hold pointers — tobytes() would serialize raw
             # addresses; route through the tagged pickle escape hatch
+            if not _pickle_allowed():
+                raise TypeError(_PICKLE_OFF_MSG)
             raw = pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
             out.append(_T_PICKLE)
             out += _U32.pack(_check_len(len(raw), "object array"))
@@ -190,6 +215,8 @@ def encode_value(v: Any, out: bytearray) -> None:
         encode_value(bool(v), out)
     else:
         # exotic UDF output — tagged escape hatch, still length-prefixed
+        if not _pickle_allowed():
+            raise TypeError(_PICKLE_OFF_MSG)
         raw = pickle.dumps(v, protocol=pickle.HIGHEST_PROTOCOL)
         out.append(_T_PICKLE)
         out += _U32.pack(_check_len(len(raw), "pickled value"))
@@ -289,6 +316,8 @@ def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
     if tag == _T_PENDING:
         return PENDING, pos
     if tag == _T_PICKLE:
+        if not _pickle_allowed():
+            raise ValueError(_PICKLE_OFF_MSG)
         (n,) = _U32.unpack_from(buf, pos)
         pos += 4
         return pickle.loads(buf[pos : pos + n]), pos + n
@@ -296,14 +325,17 @@ def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
 
 
 def encode_frame(
-    channel: str, time: int, sender: int, entries: list
+    channel: str, time: int, sender: int, entries: list,
+    is_entries: bool = True,
 ) -> bytes:
     """Encode one exchange batch (without the transport length prefix).
 
-    Items are either engine entries ``(Pointer, row, diff)`` — the data
-    plane — or arbitrary values (the driver's control barriers exchange
-    bare flags on ``__ctl__`` channels); a per-item marker byte keeps the
-    entry fast path while letting control payloads ride the same frames.
+    The caller states what the items are: ``is_entries=True`` for engine
+    entries ``(Pointer, row, diff)`` — the data plane — or
+    ``is_entries=False`` for arbitrary control values (the driver's
+    barriers exchange bare flags on ``__ctl__`` channels).  The explicit
+    flag (rather than per-item shape sniffing) guarantees a control value
+    that happens to look like an entry keeps its shape on the far side.
     """
     out = bytearray()
     out.append(WIRE_VERSION)
@@ -314,12 +346,7 @@ def encode_frame(
     out += _U16.pack(sender)
     out += _U32.pack(len(entries))
     for item in entries:
-        if (
-            isinstance(item, tuple)
-            and len(item) == 3
-            and isinstance(item[0], Pointer)
-            and isinstance(item[2], int)
-        ):
+        if is_entries:
             key, row, diff = item
             out.append(0x01)
             out += key.value.to_bytes(16, "little")

@@ -508,6 +508,7 @@ class StreamingDriver:
             peer_flags = plane.exchange(
                 "__ctl__", t,
                 {p: [done] for p in range(plane.n) if p != plane.me},
+                is_entries=False,
             )
             self.engine.step(t)
             if done and all(f for f in peer_flags):

@@ -230,6 +230,29 @@ class _Lexer:
         return d
 
 
+#: per-stream inflate ceiling for untrusted documents: a tiny crafted
+#: FlateDecode stream can expand ~1000x per level, so an unbounded
+#: zlib.decompress is a decompression bomb against the parsing UDF.
+#: 256 MiB comfortably covers real content streams/object streams.
+MAX_INFLATED_STREAM = 256 * 1024 * 1024
+
+
+def _bounded_inflate(data: bytes, limit: int = MAX_INFLATED_STREAM) -> bytes:
+    d = zlib.decompressobj()
+    out = d.decompress(data, limit)
+    if d.unconsumed_tail:
+        raise ValueError(
+            f"pdf stream inflates beyond {limit} bytes — refusing "
+            "(decompression bomb?)"
+        )
+    if not d.eof:
+        # plain zlib.decompress raises here too; never return silently
+        # truncated content (trailing junk after stream end is fine and
+        # was tolerated before — only an unfinished stream is an error)
+        raise zlib.error("incomplete or truncated pdf stream")
+    return out
+
+
 def _decode_stream(doc: "PdfDocument", s: _Stream) -> bytes:
     filters = doc.resolve(s.dict.get("Filter"))
     if filters is None:
@@ -240,7 +263,7 @@ def _decode_stream(doc: "PdfDocument", s: _Stream) -> bytes:
     for f in filters:
         f = doc.resolve(f)
         if f == "FlateDecode":
-            data = zlib.decompress(data)
+            data = _bounded_inflate(data)
             parms = doc.resolve(s.dict.get("DecodeParms")) or {}
             pred = doc.resolve(parms.get("Predictor", 1)) if parms else 1
             if isinstance(pred, int) and pred >= 10:

@@ -484,11 +484,13 @@ def test_stray_connection_does_not_consume_peer_slot():
         # the real mesh works end-to-end despite the scanner
         got1 = []
         t = threading.Thread(
-            target=lambda: got1.extend(planes[1].exchange("c", 0, {0: ["hi"]})),
+            target=lambda: got1.extend(
+                planes[1].exchange("c", 0, {0: ["hi"]}, is_entries=False)
+            ),
             daemon=True,
         )
         t.start()
-        got0 = planes[0].exchange("c", 0, {1: ["yo"]})
+        got0 = planes[0].exchange("c", 0, {1: ["yo"]}, is_entries=False)
         t.join(timeout=10)
         assert got0 == ["hi"] and got1 == ["yo"]
     finally:
@@ -512,7 +514,7 @@ def test_wrong_token_peer_rejected():
         # the mismatched hello digest is rejected with no ack, so the bad
         # peer fails FAST at startup with a clear error — not a 600s
         # barrier timeout later
-        with pytest.raises(RuntimeError, match="rejected the exchange handshake"):
+        with pytest.raises(RuntimeError, match="failed the exchange challenge"):
             bad.start(timeout=6)
         # and good never authenticated it: no inbound frames, no peer state
         assert not good._inbox and not good._down
@@ -544,7 +546,7 @@ def test_peer_death_aborts_barrier_promptly():
     planes[1].close()  # peer "crashes"
     t0 = _t.monotonic()
     with pytest.raises((ConnectionError, RuntimeError, OSError)):
-        planes[0].exchange("c", 0, {1: ["x"]})
+        planes[0].exchange("c", 0, {1: ["x"]}, is_entries=False)
     assert _t.monotonic() - t0 < 10.0
     planes[0].close()
 
@@ -636,3 +638,85 @@ def test_two_process_index_serving(tmp_path):
     assert merged == {q: [q] for q in queries}
     # queries actually ran on both processes (sharded ingestion)
     assert shards[0] and shards[1]
+
+
+def test_pickle_frames_gated_by_default(monkeypatch):
+    # the pickle escape hatch can execute code at decode time — both ends
+    # refuse it unless PATHWAY_WIRE_ALLOW_PICKLE=1 is set explicitly
+    import pathway_tpu.internals.wire as wire
+
+    exotic = complex(1, 2)  # picklable, outside the engine value model
+
+    with pytest.raises(TypeError, match="PATHWAY_WIRE_ALLOW_PICKLE"):
+        wire.encode_frame("c", 0, 0, [exotic], is_entries=False)
+
+    monkeypatch.setattr(wire, "_ALLOW_PICKLE", True)
+    frame = wire.encode_frame("c", 0, 0, [(1, "x")], is_entries=False)
+    monkeypatch.setattr(wire, "_ALLOW_PICKLE", False)
+    # a tuple is in the value model, decodes fine without pickle
+    assert wire.decode_frame(frame)[3] == [(1, "x")]
+    monkeypatch.setattr(wire, "_ALLOW_PICKLE", True)
+    frame2 = wire.encode_frame("c", 0, 0, [exotic], is_entries=False)
+    monkeypatch.setattr(wire, "_ALLOW_PICKLE", False)
+    with pytest.raises(ValueError, match="PATHWAY_WIRE_ALLOW_PICKLE"):
+        wire.decode_frame(frame2)
+
+
+def test_control_payload_shaped_like_entry_keeps_shape():
+    # a control value that *looks* like a (Pointer, row, diff) entry must
+    # come back as-is — the explicit is_entries flag, not shape sniffing,
+    # decides the frame kind
+    from pathway_tpu.internals.keys import ref_scalar
+    from pathway_tpu.internals.wire import decode_frame, encode_frame
+
+    tricky = (ref_scalar("x"), ("payload",), 7)
+    frame = encode_frame("ctl", 3, 0, [tricky], is_entries=False)
+    _, _, _, items = decode_frame(frame)
+    assert items == [tricky]
+
+
+def test_replaying_captured_hello_fails():
+    # challenge-response: a verbatim replay of bytes from a previous
+    # handshake must not authenticate (each side MACs fresh nonces)
+    import os as _os
+    import socket
+    import struct
+
+    from pathway_tpu.internals.exchange import ExchangePlane
+
+    port = _free_port_block(1)
+    plane = ExchangePlane(1, 0, port, token="secret")
+    # single-process plane: start() binds the listener without peers
+    plane.start(timeout=5.0)
+    try:
+        hello = (
+            ExchangePlane._HELLO_MAGIC + struct.pack("<H", 0) + _os.urandom(16)
+        )
+        s = socket.create_connection(("127.0.0.1", port), timeout=2.0)
+        s.sendall(hello)
+        s.settimeout(2.0)
+        resp = b""
+        while len(resp) < 32:
+            chunk = s.recv(32 - len(resp))
+            if not chunk:
+                break
+            resp += chunk
+        assert len(resp) == 32  # server answered with nonce + MAC
+        # no token -> cannot produce the MAC over the server nonce; send
+        # garbage and expect the server to close without the \x01 ack
+        s.sendall(_os.urandom(16))
+        got = s.recv(1)
+        assert got == b""  # closed, never acked
+        s.close()
+    finally:
+        plane.close()
+
+
+def test_free_tier_cap_rejects_out_of_range_process(monkeypatch):
+    from pathway_tpu.internals.config import MAX_WORKERS, PathwayConfig
+
+    monkeypatch.setenv("PATHWAY_PROCESSES", str(MAX_WORKERS * 2))
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", str(MAX_WORKERS))
+    monkeypatch.delenv("PATHWAY_LICENSE_KEY", raising=False)
+    with pytest.raises(RuntimeError, match="free-tier"):
+        PathwayConfig.from_env()

@@ -291,3 +291,17 @@ def test_auto_parser_end_to_end_vector_store(fixture_pdf, tmp_path):
     assert stats.get("file_count", 0) >= 2, stats
     res = client.query("the lighthouse keeper logs the storm", k=1)
     assert "lighthouse" in res[0]["text"]
+
+
+def test_flate_decompression_bomb_rejected():
+    import zlib
+
+    import pytest
+
+    from pathway_tpu.utils.pdftext import _bounded_inflate
+
+    bomb = zlib.compress(b"\x00" * (4 * 1024 * 1024), 9)  # ~4k compressed
+    with pytest.raises(ValueError, match="decompression bomb"):
+        _bounded_inflate(bomb, limit=1024 * 1024)
+    ok = zlib.compress(b"payload" * 100)
+    assert _bounded_inflate(ok) == b"payload" * 100

@@ -155,3 +155,21 @@ def test_vector_no_int64_wraparound():
     e = (t2.v * t2.v) * t2.v
     slot_of = lambda node: 0 if getattr(node, "name", None) == "v" else None
     assert build_vector_select([e], slot_of) is None
+
+
+def test_declared_int_column_of_bools_vectorizes_numerically():
+    # bool subclasses int, so the row path accepts Python bools in an
+    # INT-declared column; the vector path must widen them to int64 (numpy
+    # bool + is logical: True+True == True) and agree with the row path
+    import pathway_tpu as pw
+
+    n = 600  # above the vector threshold
+    rows = [(True,) if i % 3 else (False,) for i in range(n)]
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), rows
+    )
+    r = t.select(s=t.v + t.v, neg=-t.v)
+    (out,) = pw.debug.materialize(r)
+    got = sorted(out.current.values())
+    exp = sorted((v + v, -v) for (v,) in rows)
+    assert got == exp
