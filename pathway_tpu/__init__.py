@@ -234,6 +234,16 @@ def load_yaml(stream):
     return _load(stream)
 
 
+def pandas_transformer(output_schema, output_universe=None):
+    """reference: stdlib/utils/pandas_transformer.py:15 (re-exported at
+    top level like the reference's ``pw.pandas_transformer``)."""
+    from .stdlib.utils.pandas_transformer import (
+        pandas_transformer as _impl,
+    )
+
+    return _impl(output_schema, output_universe)
+
+
 def __getattr__(name: str):
     if name in _LAZY_SUBMODULES:
         import importlib
@@ -283,6 +293,7 @@ __all__ = [
     "iterate",
     "iterate_universe",
     "run",
+    "pandas_transformer",
     "run_all",
     "set_license_key",
     "groupby",

@@ -143,6 +143,9 @@ class ExchangePlane:
         #: sender ids whose inbound connection dropped (peer crashed or
         #: closed): barriers abort promptly instead of timing out
         self._down: set[int] = set()
+        #: last decode/transport error per dropped peer (surfaced in the
+        #: barrier's ConnectionError so misconfigurations are actionable)
+        self._peer_errors: dict[int, str] = {}
 
     # -- wiring --
     def start(self, timeout: float = 30.0) -> None:
@@ -317,10 +320,13 @@ class ExchangePlane:
                         entries
                     )
                     self._cv.notify_all()
-        except Exception:
-            # decode errors (version mismatch, corrupt frame) count as a
-            # dead peer too — never die silently leaving barriers to hang
-            pass
+        except Exception as exc:
+            # decode errors (version mismatch, pickle gate, corrupt frame)
+            # count as a dead peer too — never die silently leaving
+            # barriers to hang; keep the reason so the barrier's error
+            # points at the actual misconfiguration
+            with self._cv:
+                self._peer_errors[peer_id] = f"{type(exc).__name__}: {exc}"
         finally:
             # EOF / socket error / decode error: the peer is gone — wake
             # any barrier blocked on it so failures abort promptly
@@ -376,9 +382,11 @@ class ExchangePlane:
                             f"waiting for peer {peer}"
                         )
                     if peer in self._down:
+                        why = self._peer_errors.get(peer)
                         raise ConnectionError(
                             f"exchange {channel}@{time}: peer {peer} "
-                            "disconnected (crashed or shut down)"
+                            "disconnected"
+                            + (f" ({why})" if why else " (crashed or shut down)")
                         )
                     remaining = deadline - _time.monotonic()
                     if remaining <= 0 or not self._cv.wait(timeout=remaining):

@@ -8,4 +8,22 @@ __all__ = [
     "smart_table_ops",
     "fuzzy_match_tables",
     "fuzzy_self_match",
+    "classifiers",
+    "datasets",
+    "hmm",
+    "utils",
+    "classifier_accuracy",
 ]
+
+
+def __getattr__(name):
+    # heavier tails (sklearn/networkx-adjacent) import lazily
+    if name in ("classifiers", "datasets", "hmm", "utils"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    if name == "classifier_accuracy":
+        from .utils import classifier_accuracy
+
+        return classifier_accuracy
+    raise AttributeError(name)

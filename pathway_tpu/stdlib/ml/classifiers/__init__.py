@@ -1,0 +1,28 @@
+"""LSH classifiers, bucketers and clustering
+(reference: python/pathway/stdlib/ml/classifiers/__init__.py)."""
+
+from ._clustering_via_lsh import clustering_via_lsh
+from ._knn_lsh import (
+    compute_cosine_dist,
+    knn_lsh_classifier_train,
+    knn_lsh_classify,
+    knn_lsh_euclidean_classifier_train,
+    knn_lsh_generic_classifier_train,
+)
+from ._lsh import (
+    generate_cosine_lsh_bucketer,
+    generate_euclidean_lsh_bucketer,
+    lsh,
+)
+
+__all__ = [
+    "clustering_via_lsh",
+    "compute_cosine_dist",
+    "generate_cosine_lsh_bucketer",
+    "generate_euclidean_lsh_bucketer",
+    "knn_lsh_classifier_train",
+    "knn_lsh_classify",
+    "knn_lsh_euclidean_classifier_train",
+    "knn_lsh_generic_classifier_train",
+    "lsh",
+]

@@ -1,0 +1,119 @@
+"""(Pre)clustering via LSH bucket representatives.
+
+reference: python/pathway/stdlib/ml/classifiers/_clustering_via_lsh.py
+(``clustering_via_lsh``).  Bucket representatives (weighted centroids per
+(band, bucketing) cell) are clustered with weighted k-means, then every
+point takes the majority label over its buckets' representatives.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ._lsh import lsh
+
+__all__ = ["clustering_via_lsh"]
+
+
+def _weighted_kmeans(
+    data: np.ndarray, weights: np.ndarray, k: int, seed: int = 0,
+    n_iter: int = 50,
+) -> np.ndarray:
+    """Small weighted k-means (k-means++ init).  sklearn is used when
+    importable; this fallback keeps the API alive without it."""
+    try:
+        from sklearn.cluster import KMeans
+
+        km = KMeans(n_clusters=k, init="k-means++", random_state=seed, n_init=10)
+        km.fit(data, sample_weight=weights)
+        return km.labels_
+    except Exception:
+        pass
+    rng = np.random.default_rng(seed)
+    n = len(data)
+    k = min(k, n)
+    centers = [data[rng.integers(n)]]
+    for _ in range(k - 1):
+        d2 = np.min(
+            [np.sum((data - c) ** 2, axis=1) for c in centers], axis=0
+        )
+        probs = d2 * weights
+        total = probs.sum()
+        if total <= 0:
+            centers.append(data[rng.integers(n)])
+            continue
+        centers.append(data[rng.choice(n, p=probs / total)])
+    centers_arr = np.asarray(centers)
+    labels = np.zeros(n, dtype=int)
+    for _ in range(n_iter):
+        dists = ((data[:, None, :] - centers_arr[None, :, :]) ** 2).sum(-1)
+        new_labels = dists.argmin(axis=1)
+        if (new_labels == labels).all():
+            break
+        labels = new_labels
+        for j in range(len(centers_arr)):
+            mask = labels == j
+            if mask.any():
+                w = weights[mask]
+                centers_arr[j] = (data[mask] * w[:, None]).sum(0) / w.sum()
+    return labels
+
+
+def clustering_via_lsh(data, bucketer, k: int):
+    """Cluster ``data.data`` vectors into ``k`` groups
+    (reference: _clustering_via_lsh.py ``clustering_via_lsh``)."""
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.utils.col import apply_all_rows
+
+    flat = lsh(data, bucketer, origin_id="data_id", include_data=True)
+    reps = (
+        flat.groupby(flat.bucketing, flat.band)
+        .reduce(
+            flat.bucketing,
+            flat.band,
+            vec_sum=pw.apply(
+                lambda t: np.sum(np.asarray(t, dtype=float), axis=0),
+                pw.reducers.tuple(flat.data),
+            ),
+            count=pw.reducers.count(),
+        )
+        .select(
+            pw.this.bucketing,
+            pw.this.band,
+            data=pw.apply(lambda s, c: s / c, pw.this.vec_sum, pw.this.count),
+            weight=pw.this.count,
+        )
+    )
+
+    def _cluster(vecs, weights):
+        return [
+            int(x)
+            for x in _weighted_kmeans(
+                np.asarray(list(vecs), dtype=float),
+                np.asarray(list(weights), dtype=float),
+                k,
+            )
+        ]
+
+    labels = apply_all_rows(
+        reps.data, reps.weight, fun=_cluster, result_col_name="label"
+    ).with_universe_of(reps)
+    reps = reps.select(
+        reps.bucketing, reps.band, reps.weight, label=labels.label
+    )
+    votes = flat.join(
+        reps,
+        flat.bucketing == reps.bucketing,
+        flat.band == reps.band,
+    ).select(flat.data_id, reps.label)
+    majority = (
+        votes.groupby(votes.data_id)
+        .reduce(
+            votes.data_id,
+            label=pw.apply(
+                lambda ls: max(set(ls), key=ls.count),
+                pw.reducers.tuple(votes.label),
+            ),
+        )
+    )
+    return majority.with_id(majority.data_id).select(pw.this.label)

@@ -1,0 +1,94 @@
+"""LSH bucketers: map vectors to L band ids so similar items collide.
+
+reference: python/pathway/stdlib/ml/classifiers/_lsh.py
+(``generate_euclidean_lsh_bucketer``:31, ``generate_cosine_lsh_bucketer``:59,
+``lsh``:82).  TPU-first shape: each bucketer is ONE (batch, d) x (d, M*L)
+matmul over the whole batch — a single dense product instead of the
+reference's per-row apply, so large batches ride the MXU when jax arrays
+come in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = [
+    "generate_euclidean_lsh_bucketer",
+    "generate_cosine_lsh_bucketer",
+    "lsh",
+]
+
+
+def _fingerprint_rows(mat: np.ndarray) -> np.ndarray:
+    """Collapse each row of ints to one stable 63-bit id (the reference
+    engine fingerprints per band; any deterministic mix works)."""
+    out = np.empty(mat.shape[0], dtype=np.int64)
+    for i, row in enumerate(np.ascontiguousarray(mat, dtype=np.int64)):
+        h = hashlib.blake2b(row.tobytes(), digest_size=8).digest()
+        out[i] = int.from_bytes(h, "little") >> 1
+    return out
+
+
+def generate_euclidean_lsh_bucketer(
+    d: int, M: int, L: int, A: float = 1.0, seed: int = 0
+):
+    """Euclidean LSH: project on M*L random lines, floor-divide by bucket
+    width ``A``, AND the M ints per band into one id; L band ids out."""
+    gen = np.random.default_rng(seed=seed)
+    lines = gen.standard_normal((d, M * L))
+    lines = lines / np.linalg.norm(lines, axis=0)
+    shift = gen.random(size=M * L) * A
+
+    def bucketify(x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float64)
+        buckets = np.floor_divide(x @ lines + shift, A).astype(np.int64)
+        if buckets.ndim == 1:
+            return _fingerprint_rows(buckets.reshape(L, M))
+        return np.stack(
+            [_fingerprint_rows(b.reshape(L, M)) for b in buckets]
+        )
+
+    return bucketify
+
+
+def generate_cosine_lsh_bucketer(d: int, M: int, L: int, seed: int = 0):
+    """Cosine LSH: sign bits against M*L random hyperplanes, M bits packed
+    per band; L band ids out."""
+    gen = np.random.default_rng(seed=seed)
+    planes = gen.standard_normal((d, M * L))
+    powers = 2 ** np.arange(M, dtype=np.int64)
+
+    def bucketify(x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float64)
+        signs = (x @ planes >= 0).astype(np.int64)
+        if signs.ndim == 1:
+            return signs.reshape(L, M) @ powers
+        return np.einsum("blm,m->bl", signs.reshape(-1, L, M), powers)
+
+    return bucketify
+
+
+def lsh(data, bucketer, origin_id: str = "origin_id", include_data: bool = True):
+    """Flat (band, bucketing) representation: L rows per input row
+    (reference: _lsh.py:82 ``lsh``)."""
+    import pathway_tpu as pw
+
+    flat = data.select(
+        buckets=pw.apply(
+            lambda x: tuple(
+                (i, int(b)) for i, b in enumerate(bucketer(x))
+            ),
+            data.data,
+        )
+    )
+    flat = flat.flatten(pw.this.buckets, origin_id=origin_id)
+    cols = {
+        origin_id: flat[origin_id],
+        "band": pw.apply(lambda p: p[0], flat.buckets),
+        "bucketing": pw.apply(lambda p: p[1], flat.buckets),
+    }
+    if include_data:
+        cols["data"] = data.ix(flat[origin_id]).data
+    return flat.select(**cols)

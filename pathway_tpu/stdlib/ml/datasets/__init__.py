@@ -1,0 +1,5 @@
+"""Example datasets (reference: python/pathway/stdlib/ml/datasets/)."""
+
+from . import classification
+
+__all__ = ["classification"]

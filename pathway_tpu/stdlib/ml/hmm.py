@@ -1,0 +1,103 @@
+"""Hidden Markov Model decoding as a custom reducer.
+
+reference: python/pathway/stdlib/ml/hmm.py:11 ``create_hmm_reducer`` —
+an accumulator running incremental Viterbi over an observation stream;
+each engine timestamp yields the most likely state path decoded so far.
+
+The graph argument is a ``networkx.DiGraph`` (or any object with the
+same ``nodes``/``successors``/``get_edge_data``/``graph`` protocol):
+nodes carry ``calc_emission_log_ppb(observation) -> float``, edges carry
+``log_transition_ppb``, and ``graph.graph["start_nodes"]`` lists entry
+states.  Plug the result into ``pw.reducers.udf_reducer``.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+__all__ = ["create_hmm_reducer"]
+
+
+def create_hmm_reducer(
+    graph, beam_size: int | None = None, num_results_kept: int | None = None
+):
+    """Build the accumulator class for ``pw.reducers.udf_reducer``
+    (reference: ml/hmm.py:11)."""
+    idx_of = {node: i for i, node in enumerate(graph.nodes())}
+    node_of = {i: node for node, i in idx_of.items()}
+    n_states = len(idx_of)
+    effective_beam = beam_size if beam_size is not None else n_states + 1
+
+    class HmmAccumulator:
+        """Viterbi state: per-state log-probabilities + backpointers."""
+
+        def __init__(self, observation):
+            self.observation = observation
+            self.ppb = np.full(n_states, -np.inf)
+            self.backpointers: deque[np.ndarray] = deque()
+            self.alive: list[int] = []
+            for start in graph.graph["start_nodes"]:
+                i = idx_of[start]
+                self.ppb[i] = graph.nodes[start]["calc_emission_log_ppb"](
+                    observation
+                )
+                self.alive.append(i)
+            self.path_states = (node_of[int(self.ppb.argmax())],)
+
+        @classmethod
+        def from_row(cls, row):
+            (observation,) = row
+            return cls(observation)
+
+        def __add__(self, other: "HmmAccumulator") -> "HmmAccumulator":
+            # left fold in arrival order: `other` is always a fresh
+            # single-observation accumulator (udf_reducer contract)
+            observation = other.observation
+            new_ppb = np.full(n_states, -np.inf)
+            backptr = np.zeros(n_states, dtype=int)
+            reachable: dict[int, tuple[float, int]] = {}
+            for i in self.alive:
+                src = node_of[i]
+                base = self.ppb[i]
+                for succ in graph.successors(src):
+                    j = idx_of[succ]
+                    score = base + graph.get_edge_data(src, succ)[
+                        "log_transition_ppb"
+                    ]
+                    best = reachable.get(j)
+                    if best is None or score > best[0]:
+                        reachable[j] = (score, i)
+            alive = []
+            for j, (score, src_i) in reachable.items():
+                emit = graph.nodes[node_of[j]]["calc_emission_log_ppb"](
+                    observation
+                )
+                new_ppb[j] = emit + score
+                backptr[j] = src_i
+                alive.append(j)
+            if len(alive) > effective_beam:
+                costs = new_ppb[alive]
+                keep = np.argpartition(costs, len(alive) - effective_beam)
+                alive = [alive[s] for s in keep[-effective_beam:]]
+            self.alive = alive
+            self.ppb = new_ppb
+            self.backpointers.append(backptr)
+            if (
+                num_results_kept is not None
+                and len(self.backpointers) >= num_results_kept
+            ):
+                self.backpointers.popleft()
+            path = [int(new_ppb.argmax())]
+            for bp in reversed(self.backpointers):
+                path.append(int(bp[path[-1]]))
+            self.path_states = tuple(
+                node_of[i] for i in reversed(path)
+            )
+            return self
+
+        def retrieve(self) -> tuple:
+            return self.path_states
+
+    return HmmAccumulator

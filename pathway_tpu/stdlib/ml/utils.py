@@ -1,0 +1,30 @@
+"""ML utilities (reference: python/pathway/stdlib/ml/utils.py).
+
+``classifier_accuracy`` groups prediction/label matches so the result is
+a two-row live table (match=True/False with counts) that stays current as
+the underlying streams update.
+"""
+
+from __future__ import annotations
+
+__all__ = ["classifier_accuracy"]
+
+
+def classifier_accuracy(predicted_labels, exact_labels):
+    """Counts of matching / non-matching predictions
+    (reference: ml/utils.py:13)."""
+    import pathway_tpu as pw
+
+    comparative = predicted_labels.select(
+        predicted_label=predicted_labels.predicted_label,
+        label=exact_labels.restrict(predicted_labels).label,
+    )
+    comparative = comparative.select(
+        comparative.predicted_label,
+        comparative.label,
+        match=comparative.label == comparative.predicted_label,
+    )
+    return comparative.groupby(comparative.match).reduce(
+        cnt=pw.reducers.count(),
+        value=comparative.match,
+    )

@@ -1,6 +1,6 @@
 from . import col
 
-__all__ = ["col"]
+__all__ = ["col", "bucketing", "filtering", "pandas_transformer", "AsyncTransformer"]
 
 
 def __getattr__(name):
@@ -8,4 +8,8 @@ def __getattr__(name):
         from .async_transformer import AsyncTransformer
 
         return AsyncTransformer
+    if name in ("bucketing", "filtering", "pandas_transformer"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(name)

@@ -1,0 +1,13 @@
+"""Time bucketing helpers (reference: python/pathway/stdlib/utils/bucketing.py)."""
+
+from __future__ import annotations
+
+import datetime
+
+__all__ = ["truncate_to_minutes"]
+
+
+def truncate_to_minutes(time: datetime.datetime) -> datetime.datetime:
+    return time - datetime.timedelta(
+        seconds=time.second, microseconds=time.microsecond
+    )

@@ -1,0 +1,33 @@
+"""Row filtering helpers (reference: python/pathway/stdlib/utils/filtering.py)."""
+
+from __future__ import annotations
+
+__all__ = ["argmax_rows", "argmin_rows"]
+
+
+def argmax_rows(table, *on, what):
+    """Keep, per group of ``on``, the row maximizing ``what``
+    (reference: filtering.py ``argmax_rows``)."""
+    import pathway_tpu as pw
+
+    chooser = (
+        table.groupby(*on)
+        .reduce(argmax_id=pw.reducers.argmax(what))
+        .with_id(pw.this.argmax_id)
+        .promise_universe_is_subset_of(table)
+    )
+    return table.restrict(chooser)
+
+
+def argmin_rows(table, *on, what):
+    """Keep, per group of ``on``, the row minimizing ``what``
+    (reference: filtering.py ``argmin_rows``)."""
+    import pathway_tpu as pw
+
+    chooser = (
+        table.groupby(*on)
+        .reduce(argmin_id=pw.reducers.argmin(what))
+        .with_id(pw.this.argmin_id)
+        .promise_universe_is_subset_of(table)
+    )
+    return table.restrict(chooser)

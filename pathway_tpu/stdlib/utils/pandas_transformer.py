@@ -1,0 +1,132 @@
+"""``@pw.pandas_transformer`` — run a pandas function as a table operator.
+
+reference: python/pathway/stdlib/utils/pandas_transformer.py:15
+(``pandas_transformer`` decorator).  Each input table is packed into one
+row (sorted tuple of its rows), converted to a ``pandas.DataFrame``
+indexed by row keys, handed to the user function, and the resulting
+frame is exploded back into a table — the frame's index becomes the
+output universe (non-Pointer indexes are hashed through ``ref_scalar``).
+"""
+
+from __future__ import annotations
+
+__all__ = ["pandas_transformer"]
+
+
+def _to_frames(packed_rows, input_tables):
+    import pandas as pd
+
+    frames = []
+    for packed, table in zip(packed_rows, input_tables):
+        names = table.column_names()
+        idx = [r[0] for r in packed]
+        cols = {
+            n: [r[1 + i] for r in packed] for i, n in enumerate(names)
+        }
+        # object dtype keeps Pointer keys intact (pandas would silently
+        # collapse an int subclass into an int64 index)
+        frames.append(pd.DataFrame(cols, index=pd.Index(idx, dtype=object)))
+    return frames
+
+
+def pandas_transformer(output_schema, output_universe: str | int | None = None):
+    """Decorator (reference: pandas_transformer.py:15).  ``output_universe``
+    names (or indexes) the argument whose universe the result reuses."""
+    import functools
+    import inspect
+
+    def decorator(func):
+        arg_names = list(inspect.signature(func).parameters)
+
+        def universe_index() -> int | None:
+            if output_universe is None:
+                return None
+            if isinstance(output_universe, str):
+                try:
+                    return arg_names.index(output_universe)
+                except ValueError:
+                    raise ValueError(
+                        f"wrong output universe. No argument of name: "
+                        f"{output_universe}"
+                    )
+            if output_universe < 0 or output_universe >= len(arg_names):
+                raise ValueError("wrong output universe. Index out of range")
+            return output_universe
+
+        @functools.wraps(func)
+        def wrapper(*inputs):
+            import pandas as pd
+
+            import pathway_tpu as pw
+            from pathway_tpu.internals.keys import ref_scalar
+            from pathway_tpu.internals.value import Pointer
+            from pathway_tpu.stdlib.utils.col import unpack_col
+
+            uni_idx = universe_index()
+            out_names = output_schema.column_names()
+
+            if not inputs:
+                result = func()
+                if isinstance(result, pd.Series):
+                    result = pd.DataFrame(result)
+                result.columns = out_names
+                from pathway_tpu.debug import table_from_pandas
+
+                return table_from_pandas(result)
+
+            def as_tuple(*args):
+                return args
+
+            packed_tables = []
+            for i, table in enumerate(inputs):
+                cols = [table[n] for n in table.column_names()]
+                tupled = table.select(all_cols=pw.apply(as_tuple, table.id, *cols))
+                packed_tables.append(
+                    tupled.reduce(
+                        **{f"_{i}": pw.reducers.sorted_tuple(tupled.all_cols)}
+                    )
+                )
+            combined = packed_tables[0]
+            for extra in packed_tables[1:]:
+                combined += extra.with_universe_of(combined)
+
+            def run(*packed_rows):
+                frames = _to_frames(packed_rows, inputs)
+                result = func(*frames)
+                if isinstance(result, pd.Series):
+                    result = pd.DataFrame(result)
+                result.columns = out_names
+                if uni_idx is not None and not result.index.equals(
+                    frames[uni_idx].index
+                ):
+                    raise ValueError(
+                        "resulting universe does not match the universe "
+                        "of the indicated argument"
+                    )
+                if not result.index.is_unique:
+                    raise ValueError(
+                        "index of resulting DataFrame must be unique"
+                    )
+                rows = []
+                for idx, row in zip(result.index, result.itertuples(index=False)):
+                    key = idx if isinstance(idx, Pointer) else ref_scalar(idx)
+                    rows.append((key, *row))
+                return tuple(rows)
+
+            applied = combined.select(
+                all_rows=pw.apply(
+                    run, *[combined[f"_{i}"] for i in range(len(inputs))]
+                )
+            )
+            flattened = applied.flatten(pw.this.all_rows)
+            output = unpack_col(flattened.all_rows, "pw_row_key", *out_names)
+            output = output.with_id(output.pw_row_key).without(
+                pw.this.pw_row_key
+            )
+            if uni_idx is not None:
+                output = output.with_universe_of(inputs[uni_idx])
+            return output
+
+        return wrapper
+
+    return decorator
