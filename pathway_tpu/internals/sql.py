@@ -3,10 +3,16 @@
 reference: python/pathway/internals/sql.py (726 LoC, sqlglot-based
 translation).  sqlglot is not in this image, so the dialect core is
 parsed natively: SELECT (expressions, aliases, ``*``), FROM, INNER/LEFT/
-RIGHT/OUTER JOIN ... ON, WHERE, GROUP BY, HAVING, UNION ALL, scalar
-functions and the classic aggregates.  The query compiles onto the same
-Table operators the Python API uses — ``pw.sql`` is sugar, not a second
-engine.
+RIGHT/OUTER JOIN ... ON, WHERE, GROUP BY, HAVING, UNION ALL, ORDER BY +
+LIMIT (incremental top-k), CASE/WHEN, IN (value lists and single-column
+subqueries), LIKE, scalar subqueries (single-row aggregates broadcast to
+every outer row), scalar functions and the classic aggregates.  The
+query compiles onto the same Table operators the Python API uses —
+``pw.sql`` is sugar, not a second engine.
+
+Streaming caveat: tables are unordered sets of rows, so ORDER BY is only
+meaningful together with LIMIT (a maintained top-k); bare ORDER BY
+raises with that explanation rather than silently ignoring the clause.
 """
 
 from __future__ import annotations
@@ -112,6 +118,40 @@ class _Parser:
 
     # ---- query ----
     def parse_query(self) -> dict:
+        """Full query: SELECT core (UNION ALL core)* [ORDER BY ...]
+        [LIMIT n] — the trailing clauses bind to the whole union, not the
+        last leg."""
+        ast = self.parse_core()
+        tail = ast
+        while self.accept_kw("union"):
+            self.expect_kw("all")
+            nxt = self.parse_core()
+            tail["union"] = nxt
+            tail = nxt
+        order_by = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.accept_kw("desc"):
+                    asc = False
+                else:
+                    self.accept_kw("asc")
+                order_by.append((e, asc))
+                if not self.accept_op(","):
+                    break
+        limit = None
+        if self.accept_kw("limit"):
+            kind, val = self.next()
+            if kind != "num" or "." in val:
+                raise ValueError("LIMIT expects an integer literal")
+            limit = int(val)
+        ast["order_by"] = order_by
+        ast["limit"] = limit
+        return ast
+
+    def parse_core(self) -> dict:
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
         items = [self.parse_select_item()]
@@ -163,14 +203,10 @@ class _Parser:
         having = None
         if self.accept_kw("having"):
             having = self.parse_expr()
-        union = None
-        if self.accept_kw("union"):
-            self.expect_kw("all")
-            union = self.parse_query()
         return dict(
             items=items, table=table, table_alias=table_alias, joins=joins,
-            where=where, group_by=group_by, having=having, union=union,
-            distinct=distinct,
+            where=where, group_by=group_by, having=having, union=None,
+            distinct=distinct, order_by=[], limit=None,
         )
 
     def parse_select_item(self) -> dict:
@@ -211,6 +247,26 @@ class _Parser:
             negate = bool(self.accept_kw("not"))
             self.expect_kw("null")
             return ("is_not_null" if negate else "is_null", left)
+        negate = bool(self.accept_kw("not"))
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            if self.peek() == ("kw", "select"):
+                sub = self.parse_query()
+                self.expect_op(")")
+                return ("in_subquery", left, sub, negate)
+            vals = [self.parse_expr()]
+            while self.accept_op(","):
+                vals.append(self.parse_expr())
+            self.expect_op(")")
+            return ("in", left, vals, negate)
+        if self.accept_kw("like"):
+            kind, val = self.next()
+            if kind != "str":
+                raise ValueError("LIKE expects a string literal pattern")
+            pattern = val[1:-1].replace("''", "'")
+            return ("like", left, pattern, negate)
+        if negate:
+            raise ValueError("expected IN or LIKE after NOT")
         op = self.accept_op("=", "!=", "<>", "<=", ">=", "<", ">")
         if op:
             right = self.parse_add()
@@ -235,7 +291,13 @@ class _Parser:
 
     def parse_atom(self):
         kind, val = self.peek()
+        if kind == "kw" and val == "case":
+            return self.parse_case()
         if self.accept_op("("):
+            if self.peek() == ("kw", "select"):
+                sub = self.parse_query()
+                self.expect_op(")")
+                return ("subquery", sub)
             e = self.parse_expr()
             self.expect_op(")")
             return e
@@ -270,10 +332,34 @@ class _Parser:
             return ("col", None, name)
         raise ValueError(f"unexpected token {val!r} in expression")
 
+    def parse_case(self):
+        self.expect_kw("case")
+        operand = None
+        if self.peek() != ("kw", "when"):
+            operand = self.parse_expr()
+        cases = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            if operand is not None:
+                cond = ("==", operand, cond)
+            self.expect_kw("then")
+            cases.append((cond, self.parse_expr()))
+        if not cases:
+            raise ValueError("CASE requires at least one WHEN clause")
+        default = ("lit", None)
+        if self.accept_kw("else"):
+            default = self.parse_expr()
+        self.expect_kw("end")
+        return ("case", cases, default)
+
 
 class _Compiler:
-    def __init__(self, tables: dict[str, Table]):
+    def __init__(self, tables: dict[str, Table], all_tables: dict[str, Table] | None = None, context: Table | None = None):
         self.tables = tables
+        #: full kwarg scope for subqueries + the driving table subquery
+        #: results broadcast onto (set per compile stage by _execute)
+        self.all_tables = all_tables if all_tables is not None else dict(tables)
+        self.context = context
 
     def resolve_col(self, tab: str | None, col: str) -> ColumnExpression:
         if tab is not None:
@@ -329,7 +415,94 @@ class _Compiler:
                 raise ValueError(f"unknown SQL function {name!r}")
             fn = _FUNCTIONS[name]
             return ApplyExpression(fn, dt.ANY, *[self.compile(a) for a in args])
+        if kind == "in":
+            _, inner, vals, negate = node
+            val_exprs = [self.compile(v) for v in vals]
+            inner_e = self.compile(inner)
+
+            def _member(v, *opts):
+                res = v in opts
+                return not res if negate else res
+
+            return ApplyExpression(_member, dt.BOOL, inner_e, *val_exprs)
+        if kind == "like":
+            _, inner, pattern, negate = node
+            rx = re.compile(
+                "^"
+                + "".join(
+                    ".*" if ch == "%" else "." if ch == "_" else re.escape(ch)
+                    for ch in pattern
+                )
+                + "$",
+                re.S,
+            )
+
+            def _like(v):
+                if v is None:
+                    return False
+                res = rx.match(str(v)) is not None
+                return not res if negate else res
+
+            return ApplyExpression(_like, dt.BOOL, self.compile(inner))
+        if kind == "case":
+            _, cases, default = node
+            from .expression import IfElseExpression
+
+            result = self.compile(default)
+            for cond, value in reversed(cases):
+                result = IfElseExpression(
+                    self.compile(cond), self.compile(value), result
+                )
+            return result
+        if kind == "in_subquery":
+            _, inner, sub_ast, negate = node
+            vals_col = self._broadcast_subquery(sub_ast, want="tuple")
+
+            def _member_dyn(v, opts):
+                res = v in (opts or ())
+                return not res if negate else res
+
+            return ApplyExpression(
+                _member_dyn, dt.BOOL, self.compile(inner), vals_col
+            )
+        if kind == "subquery":
+            return self._broadcast_subquery(node[1], want="scalar")
         raise ValueError(f"cannot compile SQL node {node!r}")
+
+    def _broadcast_subquery(self, sub_ast: dict, want: str) -> ColumnExpression:
+        """Execute a subquery and broadcast its (single-row) result onto
+        every row of the current driving table.
+
+        Mechanics: the subquery result is globally reduced to ONE row
+        whose key is the deterministic empty-tuple pointer, then fetched
+        per outer row with ``ix_ref()`` — a constant-key ix the engine
+        maintains incrementally, so the subquery stays live as its
+        inputs change."""
+        import pathway_tpu as pw
+
+        if self.context is None:
+            raise ValueError("subqueries are not allowed in this clause")
+        sub = _execute(sub_ast, self.all_tables)
+        names = sub.column_names()
+        if len(names) != 1:
+            raise ValueError(
+                "subqueries must produce exactly one column"
+            )
+        (col,) = names
+        if want == "tuple":
+            packed = sub.reduce(
+                __vals__=pw.reducers.sorted_tuple(sub[col])
+            )
+            return packed.ix_ref(context=self.context, optional=True)[
+                "__vals__"
+            ]
+        # scalar: require single-row-by-construction (global aggregate)
+        if sub_ast["group_by"] or not _is_single_row(sub_ast):
+            raise ValueError(
+                "scalar subqueries must be single-row aggregates "
+                "(no GROUP BY), e.g. (SELECT MAX(x) FROM t)"
+            )
+        return sub.ix_ref(context=self.context, optional=True)[col]
 
     def find_aggregates(self, node, out: list) -> None:
         if not isinstance(node, tuple):
@@ -357,6 +530,16 @@ class _Compiler:
         }[name](arg)
 
 
+def _is_single_row(sub_ast: dict) -> bool:
+    comp = _Compiler({})
+    aggs: list = []
+    for item in sub_ast["items"]:
+        if item.get("star"):
+            return False
+        comp.find_aggregates(item["expr"], aggs)
+    return bool(aggs) and not sub_ast["group_by"]
+
+
 def sql(query: str, **tables: Table) -> Table:
     """Run a SQL query against the given tables
     (reference: pw.sql, internals/sql.py)::
@@ -368,17 +551,23 @@ def sql(query: str, **tables: Table) -> Table:
 
 
 def _execute(ast: dict, tables: dict[str, Table]) -> Table:
-    scope = dict(tables)
-    if ast["table"] not in scope:
+    if ast["table"] not in tables:
         raise ValueError(f"unknown table {ast['table']!r} (pass it as a kwarg)")
-    base = scope[ast["table"]]
+    base = tables[ast["table"]]
+    # name resolution sees only the FROM clause's tables (plus joins and
+    # aliases as they attach) — other kwargs stay reachable for
+    # subqueries via all_tables, but must not make unqualified columns
+    # ambiguous
+    scope = {ast["table"]: base}
     if ast["table_alias"]:
         scope[ast["table_alias"]] = base
-    compiler = _Compiler(scope)
+    compiler = _Compiler(scope, all_tables=tables, context=base)
 
     current = base
     for join in ast["joins"]:
-        right = scope.get(join["table"])
+        right = scope.get(join["table"]) or tables.get(join["table"])
+        if right is not None:
+            scope.setdefault(join["table"], right)
         if right is None:
             raise ValueError(f"unknown table {join['table']!r}")
         if join["alias"]:
@@ -403,11 +592,15 @@ def _execute(ast: dict, tables: dict[str, Table]) -> Table:
             if t is base or t is right or t is current:
                 scope[alias] = current
         base = current
-        compiler = _Compiler(scope)
+        compiler = _Compiler(scope, all_tables=tables, context=current)
 
     if ast["where"] is not None:
         current = current.filter(_rebind(compiler.compile(ast["where"]), current))
-        compiler = _Compiler({**scope, ast["table"]: current})
+        compiler = _Compiler(
+            {**scope, ast["table"]: current},
+            all_tables=tables,
+            context=current,
+        )
         base = current
 
     items = ast["items"]
@@ -441,7 +634,105 @@ def _execute(ast: dict, tables: dict[str, Table]) -> Table:
     if ast["union"] is not None:
         other = _execute(ast["union"], tables)
         result = result.concat_reindex(other)
+    if ast.get("order_by") or ast.get("limit") is not None:
+        # plain selects can order by non-projected source columns (the
+        # source table shares the result's universe); grouped / distinct
+        # / union results cannot, and raise a targeted error instead
+        plain = not (
+            agg_nodes or ast["group_by"] or ast.get("distinct")
+            or ast["union"] is not None
+        )
+        result = _apply_order_limit(
+            result,
+            ast.get("order_by") or [],
+            ast.get("limit"),
+            source=current if plain else None,
+            source_scope=scope if plain else None,
+        )
     return result
+
+
+def _apply_order_limit(
+    result: Table,
+    order_by: list,
+    limit: int | None,
+    source: Table | None = None,
+    source_scope: dict[str, Table] | None = None,
+) -> Table:
+    """ORDER BY + LIMIT as a maintained top-k: pack (sort-key, row), keep
+    the k best under the requested ordering, flatten back.  Bare ORDER BY
+    has no meaning over an unordered streaming table and raises."""
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.utils.col import unpack_col
+
+    if order_by and limit is None:
+        raise ValueError(
+            "ORDER BY without LIMIT: streaming tables are unordered row "
+            "sets, so ordering alone has no observable effect — add a "
+            "LIMIT n to keep the n best rows (maintained incrementally)"
+        )
+    names = result.column_names()
+    sort_exprs = []
+    ascending = []
+    for node, asc in order_by:
+        try:
+            compiler = _Compiler({"__result__": result}, context=result)
+            expr = _rebind(compiler.compile(node), result)
+        except ValueError:
+            if source is None:
+                raise ValueError(
+                    "ORDER BY over grouped/distinct/union results can "
+                    "only reference selected output columns"
+                )
+            # non-projected source column: the plain-select result shares
+            # the source universe, so the sort key rides alongside
+            compiler = _Compiler(dict(source_scope or {}), context=source)
+            expr = _rebind(compiler.compile(node), source)
+        sort_exprs.append(expr)
+        ascending.append(asc)
+
+    if sort_exprs:
+        pair_expr = pw.make_tuple(
+            pw.make_tuple(*sort_exprs),
+            pw.make_tuple(*[result[n] for n in names]),
+        )
+    else:
+        # LIMIT without ORDER BY: no sort keys — top_k falls back to a
+        # deterministic total order over the rows' repr (never compares
+        # unorderable cell types)
+        pair_expr = pw.make_tuple(
+            pw.make_tuple(),
+            pw.make_tuple(*[result[n] for n in names]),
+        )
+    packed = result.select(__pair__=pair_expr)
+    flags = tuple(ascending)
+    k = limit
+
+    def top_k(pairs):
+        rows = list(pairs)
+        if not flags:
+            rows.sort(key=repr)
+        # stable multi-key sort honoring per-column ASC/DESC; None sorts
+        # last under ASC (first under DESC), like NULLS LAST defaults
+        for idx in range(len(flags) - 1, -1, -1):
+            rows.sort(
+                key=lambda p, i=idx: (p[0][i] is None, p[0][i])
+                if p[0][i] is not None
+                else (True, 0),
+                reverse=not flags[idx],
+            )
+        return tuple(r for _, r in rows[:k])
+
+    reduced = packed.reduce(
+        # tuple (insertion-ordered), NOT sorted_tuple: the reducer must
+        # not compare packed rows itself — cells may be unorderable
+        # (ndarrays); top_k applies the requested ordering
+        __rows__=ApplyExpression(
+            top_k, dt.ANY, pw.reducers.tuple(packed["__pair__"])
+        )
+    )
+    flat = reduced.flatten(reduced["__rows__"])
+    return unpack_col(flat["__rows__"], *names)
 
 
 def _execute_groupby(ast: dict, table: Table, compiler: "_Compiler") -> Table:

@@ -23,12 +23,15 @@ def _weighted_kmeans(
     importable; this fallback keeps the API alive without it."""
     try:
         from sklearn.cluster import KMeans
-
-        km = KMeans(n_clusters=k, init="k-means++", random_state=seed, n_init=10)
+    except ImportError:
+        KMeans = None
+    if KMeans is not None:
+        # real fit errors (NaNs, bad weights) must propagate — only a
+        # missing sklearn routes to the fallback implementation
+        km = KMeans(n_clusters=min(k, len(data)), init="k-means++",
+                    random_state=seed, n_init=10)
         km.fit(data, sample_weight=weights)
         return km.labels_
-    except Exception:
-        pass
     rng = np.random.default_rng(seed)
     n = len(data)
     k = min(k, n)

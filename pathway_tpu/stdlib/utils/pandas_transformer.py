@@ -88,7 +88,13 @@ def pandas_transformer(output_schema, output_universe: str | int | None = None):
                 )
             combined = packed_tables[0]
             for extra in packed_tables[1:]:
-                combined += extra.with_universe_of(combined)
+                aligned = extra.with_universe_of(combined)
+                combined = combined.select(
+                    *[combined[c] for c in combined.column_names()],
+                    **{
+                        n: aligned[n] for n in aligned.column_names()
+                    },
+                )
 
             def run(*packed_rows):
                 frames = _to_frames(packed_rows, inputs)

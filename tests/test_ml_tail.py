@@ -225,3 +225,26 @@ def test_synthetic_dataset_tables():
     assert len(xo.current) == 60 and len(yo.current) == 60
     (xt,) = pw.debug.materialize(X_test)
     assert len(xt.current) == 10
+
+
+def test_pandas_transformer_two_inputs():
+    left = pw.debug.table_from_markdown("""
+          | a
+        0 | 1
+        1 | 2
+    """)
+    right = pw.debug.table_from_markdown("""
+          | b
+        5 | 10
+        6 | 20
+    """)
+
+    class Output(pw.Schema):
+        total: int
+
+    @pw.pandas_transformer(output_schema=Output)
+    def cross_sum(l, r) -> pd.DataFrame:  # noqa: E741
+        return pd.DataFrame({"total": [int(l["a"].sum() + r["b"].sum())]})
+
+    (out,) = pw.debug.materialize(cross_sum(left, right))
+    assert list(out.current.values()) == [(33,)]

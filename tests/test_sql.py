@@ -173,3 +173,214 @@ def test_global_error_log_collects(tmp_path):
     pw.run(terminate_on_error=False)
     assert len(results) == 2  # both rows flow; one carries ERROR
     assert any("ZeroDivisionError" in e for e in errors)
+
+
+def test_sql_order_by_limit_topk():
+    t = pw.debug.table_from_markdown("""
+          | name | score
+        1 | a    | 10
+        2 | b    | 40
+        3 | c    | 30
+        4 | d    | 20
+    """)
+    r = pw.sql("SELECT name, score FROM t ORDER BY score DESC LIMIT 2", t=t)
+    (out,) = pw.debug.materialize(r)
+    assert sorted(out.current.values()) == [("b", 40), ("c", 30)]
+
+
+def test_sql_order_by_multi_key_asc_desc():
+    t = pw.debug.table_from_markdown("""
+          | g | v
+        1 | x | 1
+        2 | x | 9
+        3 | y | 5
+        4 | y | 7
+    """)
+    r = pw.sql("SELECT g, v FROM t ORDER BY g ASC, v DESC LIMIT 3", t=t)
+    (out,) = pw.debug.materialize(r)
+    assert sorted(out.current.values()) == [("x", 1), ("x", 9), ("y", 7)]
+
+
+def test_sql_order_by_without_limit_raises():
+    t = pw.debug.table_from_markdown("""
+          | v
+        1 | 3
+    """)
+    with pytest.raises(ValueError, match="ORDER BY without LIMIT"):
+        pw.sql("SELECT v FROM t ORDER BY v", t=t)
+
+
+def test_sql_limit_without_order_by():
+    t = pw.debug.table_from_markdown("""
+          | v
+        1 | 3
+        2 | 1
+        3 | 2
+    """)
+    r = pw.sql("SELECT v FROM t LIMIT 2", t=t)
+    (out,) = pw.debug.materialize(r)
+    assert len(out.current) == 2
+
+
+def test_sql_case_when_searched_and_simple():
+    t = pw.debug.table_from_markdown("""
+          | v
+        1 | 5
+        2 | 15
+        3 | 25
+    """)
+    r = pw.sql(
+        "SELECT v, CASE WHEN v < 10 THEN 'low' WHEN v < 20 THEN 'mid' "
+        "ELSE 'high' END AS bucket FROM t",
+        t=t,
+    )
+    (out,) = pw.debug.materialize(r)
+    assert sorted(out.current.values()) == [
+        (5, "low"), (15, "mid"), (25, "high")
+    ] or sorted(out.current.values()) == sorted(
+        [(5, "low"), (15, "mid"), (25, "high")]
+    )
+    # simple CASE operand form
+    r2 = pw.sql(
+        "SELECT CASE v WHEN 5 THEN 'five' ELSE 'other' END AS w FROM t",
+        t=t,
+    )
+    (o2,) = pw.debug.materialize(r2)
+    assert sorted(o2.current.values()) == [("five",), ("other",), ("other",)]
+
+
+def test_sql_in_value_list_and_not_in():
+    t = pw.debug.table_from_markdown("""
+          | name
+        1 | apple
+        2 | banana
+        3 | cherry
+    """)
+    r = pw.sql("SELECT name FROM t WHERE name IN ('apple', 'cherry')", t=t)
+    (out,) = pw.debug.materialize(r)
+    assert sorted(v[0] for v in out.current.values()) == ["apple", "cherry"]
+    r2 = pw.sql("SELECT name FROM t WHERE name NOT IN ('apple')", t=t)
+    (o2,) = pw.debug.materialize(r2)
+    assert sorted(v[0] for v in o2.current.values()) == ["banana", "cherry"]
+
+
+def test_sql_like_patterns():
+    t = pw.debug.table_from_markdown("""
+          | name
+        1 | alice
+        2 | bob
+        3 | alfred
+        4 | carol
+    """)
+    r = pw.sql("SELECT name FROM t WHERE name LIKE 'al%'", t=t)
+    (out,) = pw.debug.materialize(r)
+    assert sorted(v[0] for v in out.current.values()) == ["alfred", "alice"]
+    r2 = pw.sql("SELECT name FROM t WHERE name LIKE '_ob'", t=t)
+    (o2,) = pw.debug.materialize(r2)
+    assert [v[0] for v in o2.current.values()] == ["bob"]
+    r3 = pw.sql("SELECT name FROM t WHERE name NOT LIKE '%o%'", t=t)
+    (o3,) = pw.debug.materialize(r3)
+    assert sorted(v[0] for v in o3.current.values()) == ["alfred", "alice"]
+
+
+def test_sql_scalar_subquery_broadcast():
+    t = pw.debug.table_from_markdown("""
+          | v
+        1 | 10
+        2 | 20
+        3 | 30
+    """)
+    r = pw.sql(
+        "SELECT v FROM t WHERE v = (SELECT MAX(v) FROM t)", t=t
+    )
+    (out,) = pw.debug.materialize(r)
+    assert [v[0] for v in out.current.values()] == [30]
+
+
+def test_sql_in_subquery():
+    orders = pw.debug.table_from_markdown("""
+          | customer | total
+        1 | ann      | 10
+        2 | bob      | 99
+        3 | cat      | 5
+    """)
+    vips = pw.debug.table_from_markdown("""
+          | name
+        7 | ann
+        8 | cat
+    """)
+    r = pw.sql(
+        "SELECT customer, total FROM orders "
+        "WHERE customer IN (SELECT name FROM vips)",
+        orders=orders, vips=vips,
+    )
+    (out,) = pw.debug.materialize(r)
+    assert sorted(out.current.values()) == [("ann", 10), ("cat", 5)]
+
+
+def test_sql_scalar_subquery_requires_aggregate():
+    t = pw.debug.table_from_markdown("""
+          | v
+        1 | 1
+    """)
+    with pytest.raises(ValueError, match="single-row aggregates"):
+        pw.sql("SELECT v FROM t WHERE v = (SELECT v FROM t)", t=t)
+
+
+def test_sql_topk_maintained_under_retraction():
+    t = pw.debug.table_from_markdown("""
+          | name | score | __time__ | __diff__
+        1 | a    | 10    | 2        | 1
+        2 | b    | 40    | 2        | 1
+        3 | c    | 30    | 2        | 1
+        2 | b    | 40    | 4        | -1
+    """)
+    r = pw.sql("SELECT name, score FROM t ORDER BY score DESC LIMIT 2", t=t)
+    (out,) = pw.debug.materialize(r)
+    # after b retracts, the maintained top-2 is c, a
+    assert sorted(out.current.values()) == [("a", 10), ("c", 30)]
+    times = sorted({h[2] for h in out.history})
+    assert len(times) >= 2  # the top-k actually updated incrementally
+
+
+def test_sql_union_then_order_limit_binds_to_whole_union():
+    a = pw.debug.table_from_markdown("""
+          | v
+        1 | 1
+        2 | 5
+    """)
+    b = pw.debug.table_from_markdown("""
+          | v
+        1 | 3
+        2 | 9
+    """)
+    r = pw.sql(
+        "SELECT v FROM a UNION ALL SELECT v FROM b ORDER BY v DESC LIMIT 2",
+        a=a, b=b,
+    )
+    (out,) = pw.debug.materialize(r)
+    assert sorted(x[0] for x in out.current.values()) == [5, 9]
+
+
+def test_sql_order_by_non_selected_source_column():
+    t = pw.debug.table_from_markdown("""
+          | name | score
+        1 | a    | 10
+        2 | b    | 40
+        3 | c    | 30
+    """)
+    r = pw.sql("SELECT name FROM t ORDER BY score DESC LIMIT 1", t=t)
+    (out,) = pw.debug.materialize(r)
+    assert [v[0] for v in out.current.values()] == ["b"]
+
+
+def test_sql_limit_over_unorderable_cells():
+    import numpy as np
+
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(data=np.ndarray),
+        [(np.ones(3),), (np.zeros(3),), (np.full(3, 2.0),)],
+    )
+    r = pw.sql("SELECT data FROM t LIMIT 2", t=t)
+    (out,) = pw.debug.materialize(r)
+    assert len(out.current) == 2
