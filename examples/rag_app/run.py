@@ -15,7 +15,6 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import csv
 import json
 import pathlib
 import sys
@@ -78,32 +77,24 @@ def main() -> int:
             raise TimeoutError("server did not index the documents in time")
         time.sleep(0.5)
 
-    rows = list(
-        csv.DictReader((HERE / "dataset.tsv").open(), delimiter="\t")
+    # answer-correctness harness (xpacks.llm.rag_evals — the reference's
+    # integration_tests/rag_evals flow): query the labeled dataset through
+    # the served app, grade each answer with the judge.  Offline runs use
+    # the deterministic MockJudgeChat; swap in any chat UDF (e.g.
+    # OpenAIChat) for a model-graded score.
+    from pathway_tpu.xpacks.llm.rag_evals import (
+        MockJudgeChat,
+        run_eval_experiment,
     )
-    latencies = []
-    hits = 0
-    for row in rows:
-        t0 = time.perf_counter()
-        # retrieval-grounded scoring: the mock chat echoes its prompt, which
-        # embeds the retrieved context — correctness = the right document
-        # was retrieved and fed to the model
-        answer = client.pw_ai_answer(
-            row["question"], return_context_docs=True
-        )
-        latencies.append(time.perf_counter() - t0)
-        context = " ".join(answer.get("context_docs") or [])
-        if row["expected_substring"].lower() in (
-            context + " " + answer["response"]
-        ).lower():
-            hits += 1
 
+    metrics = run_eval_experiment(
+        client, HERE / "labeled.tsv", judge_chat=MockJudgeChat()
+    )
     result = {
-        "metric": "rag_eval_context_hit_rate",
-        "value": round(hits / len(rows), 3),
+        "metric": "rag_eval_answer_correctness",
+        "value": metrics["answer_correctness"],
         "unit": "fraction",
-        "n_questions": len(rows),
-        "p50_latency_ms": round(sorted(latencies)[len(latencies) // 2] * 1000, 1),
+        **metrics,
     }
     print(json.dumps(result))
 
@@ -111,7 +102,7 @@ def main() -> int:
         print(f"serving on http://{host}:{port} — ctrl-c to stop", file=sys.stderr)
         while True:
             time.sleep(60)
-    return 0 if hits == len(rows) else 1
+    return 0 if metrics["n_correct"] == metrics["n_questions"] else 1
 
 
 if __name__ == "__main__":

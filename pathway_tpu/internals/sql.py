@@ -510,7 +510,10 @@ class _Compiler:
         if node[0] == "call" and node[1] in _AGGREGATES:
             out.append(node)
             return
-        for child in node[1:]:
+        # untagged pairs (CASE's (cond, value)) have no leading tag —
+        # walk every element, not just the tail
+        start = 1 if node and isinstance(node[0], str) else 0
+        for child in node[start:]:
             if isinstance(child, tuple):
                 self.find_aggregates(child, out)
             elif isinstance(child, list):
@@ -531,6 +534,8 @@ class _Compiler:
 
 
 def _is_single_row(sub_ast: dict) -> bool:
+    if sub_ast.get("union") is not None:
+        return False  # union legs multiply rows
     comp = _Compiler({})
     aggs: list = []
     for item in sub_ast["items"]:
@@ -546,7 +551,11 @@ def sql(query: str, **tables: Table) -> Table:
 
         pw.sql("SELECT owner, SUM(value) AS total FROM t GROUP BY owner", t=t)
     """
-    ast = _Parser(_tokenize(query)).parse_query()
+    parser = _Parser(_tokenize(query))
+    ast = parser.parse_query()
+    kind, val = parser.peek()
+    if kind != "end":
+        raise ValueError(f"unsupported trailing SQL near {val!r}")
     return _execute(ast, tables)
 
 
@@ -745,20 +754,51 @@ def _execute_groupby(ast: dict, table: Table, compiler: "_Compiler") -> Table:
         if g[0] == "col":
             group_names.append(g[2])
 
-    def lower_item(node, i: int, alias: str | None):
-        if node[0] == "call" and node[1] in _AGGREGATES:
-            return alias or node[1], compiler.compile_aggregate(node, table)
-        if node[0] == "col":
-            return alias or node[2], _rebind(compiler.resolve_col(node[1], node[2]), table)
-        raise ValueError(
-            "non-aggregate select expressions must appear in GROUP BY"
-        )
+    #: select items that are COMPOUND expressions over aggregates (e.g.
+    #: CASE WHEN SUM(v) > 5 ...): each aggregate reduces into a hidden
+    #: column, the expression evaluates per group row afterwards
+    post_items: list[tuple[str, Any]] = []
+    out_names: list[str] = []
+
+    def subst_aggs(node, mapping):
+        if isinstance(node, tuple):
+            if node[0] == "call" and node[1] in _AGGREGATES:
+                return ("col", None, mapping[id(node)])
+            return tuple(
+                subst_aggs(c, mapping) if isinstance(c, (tuple, list)) else c
+                for c in node
+            )
+        if isinstance(node, list):
+            return [subst_aggs(c, mapping) for c in node]
+        return node
 
     for i, item in enumerate(ast["items"]):
         if item.get("star"):
             raise ValueError("SELECT * cannot be combined with GROUP BY")
-        name, expr = lower_item(item["expr"], i, item["alias"])
-        reduce_kwargs[name] = expr
+        node, alias = item["expr"], item["alias"]
+        if node[0] == "call" and node[1] in _AGGREGATES:
+            name = alias or node[1]
+            reduce_kwargs[name] = compiler.compile_aggregate(node, table)
+        elif node[0] == "col":
+            name = alias or node[2]
+            reduce_kwargs[name] = _rebind(
+                compiler.resolve_col(node[1], node[2]), table
+            )
+        else:
+            aggs: list = []
+            compiler.find_aggregates(node, aggs)
+            if not aggs:
+                raise ValueError(
+                    "non-aggregate select expressions must appear in GROUP BY"
+                )
+            name = alias or _default_name(node, i)
+            mapping = {}
+            for j, agg in enumerate(aggs):
+                hidden = f"__item_{i}_{j}"
+                mapping[id(agg)] = hidden
+                reduce_kwargs[hidden] = compiler.compile_aggregate(agg, table)
+            post_items.append((name, subst_aggs(node, mapping)))
+        out_names.append(name)
     if ast["having"] is not None:
         having_aggs: list = []
         compiler.find_aggregates(ast["having"], having_aggs)
@@ -789,6 +829,18 @@ def _execute_groupby(ast: dict, table: Table, compiler: "_Compiler") -> Table:
         result = result.without(
             *[f"__having_{j}" for j in range(len(having_aggs))]
         )
+    if post_items:
+        post_compiler = _Compiler({"__result__": result})
+        exprs: dict[str, Any] = {}
+        post_map = dict(post_items)
+        for name in out_names:
+            if name in post_map:
+                exprs[name] = _rebind(
+                    post_compiler.compile(post_map[name]), result
+                )
+            else:
+                exprs[name] = result[name]
+        result = result.select(**exprs)
     return result
 
 

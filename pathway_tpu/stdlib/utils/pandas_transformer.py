@@ -89,11 +89,8 @@ def pandas_transformer(output_schema, output_universe: str | int | None = None):
             combined = packed_tables[0]
             for extra in packed_tables[1:]:
                 aligned = extra.with_universe_of(combined)
-                combined = combined.select(
-                    *[combined[c] for c in combined.column_names()],
-                    **{
-                        n: aligned[n] for n in aligned.column_names()
-                    },
+                combined = combined.with_columns(
+                    **{n: aligned[n] for n in aligned.column_names()}
                 )
 
             def run(*packed_rows):

@@ -106,7 +106,14 @@ class _Parser:
         if kind == "str":
             return ("lit", val[1:-1])
         if kind == "raw":
-            return ("lit", json.loads(val[1:-1]))
+            body = val[1:-1]
+            try:
+                return ("lit", json.loads(body))
+            except json.JSONDecodeError:
+                # jmespath's legacy behavior: a backtick literal that is
+                # not valid JSON is the raw string itself — the reference
+                # relies on it for glob patterns like `**/file.pdf`
+                return ("lit", body)
         if kind == "ident":
             if val in ("true", "false"):
                 return ("lit", val == "true")

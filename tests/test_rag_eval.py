@@ -1,0 +1,122 @@
+"""Answer-correctness eval harness over a served RAG app.
+
+reference: integration_tests/rag_evals/test_eval.py — serve the app,
+query the labeled dataset, assert answer correctness >= threshold.  The
+judge here is the deterministic MockJudgeChat (CI has no API key); the
+same harness takes any chat UDF as the judge.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import socket
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm import mocks
+from pathway_tpu.xpacks.llm.question_answering import (
+    BaseRAGQuestionAnswerer,
+    RAGClient,
+)
+from pathway_tpu.xpacks.llm.rag_evals import (
+    MockJudgeChat,
+    RAGEvaluator,
+    compare_sim_with_date,
+    load_dataset_tsv,
+    run_eval_experiment,
+)
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+APP_DIR = REPO / "examples" / "rag_app"
+
+#: committed threshold (reference: test_eval.py MIN_ACCURACY = 0.6)
+MIN_ANSWER_CORRECTNESS = 0.8
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_compare_sim_with_date():
+    assert compare_sim_with_date("The capital is Berlin", "Berlin", 0.2)
+    assert not compare_sim_with_date("Madrid", "Berlin")
+    assert compare_sim_with_date("May 8, 2014", "5/8/14")
+    assert not compare_sim_with_date("May 9, 2014", "5/8/14")
+    assert compare_sim_with_date("No information found.", "nan")
+
+
+def test_mock_judge_grades_prompt():
+    from pathway_tpu.xpacks.llm.rag_evals import build_judge_prompt
+
+    judge = MockJudgeChat()
+    ok = judge(build_judge_prompt("capital?", "Berlin", "It is Berlin."))
+    bad = judge(build_judge_prompt("capital?", "Berlin", "It is Madrid."))
+    assert ok == "CORRECT" and bad == "INCORRECT"
+
+
+def test_rag_answer_correctness_served_end_to_end(tmp_path, fresh_graph):
+    docs = pw.io.fs.read(
+        str(APP_DIR / "documents"),
+        format="binary",
+        with_metadata=True,
+        mode="streaming",
+        refresh_interval=0.2,
+    )
+    store = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=24))
+    qa = BaseRAGQuestionAnswerer(llm=mocks.IdentityMockChat(), indexer=store)
+    port = _free_port()
+    qa.build_server(host="127.0.0.1", port=port)
+    qa.server.run(threaded=True)
+
+    client = RAGClient(host="127.0.0.1", port=port)
+    deadline = time.monotonic() + 45
+    while True:
+        try:
+            if client.statistics().get("file_count", 0) >= 5:
+                break
+        except Exception:
+            pass
+        if time.monotonic() > deadline:
+            pytest.fail("server did not index the documents in time")
+        time.sleep(0.4)
+
+    metrics = run_eval_experiment(
+        client,
+        APP_DIR / "labeled.tsv",
+        judge_chat=MockJudgeChat(),
+    )
+    assert metrics["n_questions"] == 10
+    # the identity mock chat answers with the retrieved context embedded,
+    # so with a working retrieval+serving stack the judge must find the
+    # labeled ground truth in the answers
+    assert metrics["answer_correctness"] >= MIN_ANSWER_CORRECTNESS, metrics
+    assert metrics["context_hit_rate"] >= MIN_ANSWER_CORRECTNESS, metrics
+
+
+def test_evaluator_offline_unit():
+    # no server: inject a fake connector to pin evaluator mechanics
+    class FakeConnector:
+        def pw_ai_answer(self, prompt, filters=None, return_context_docs=False):
+            table = {
+                "q1": ("the answer is Berlin", ["Berlin is the capital"]),
+                "q2": ("I don't know", ["unrelated text"]),
+            }
+            resp, docs = table[prompt]
+            return {"response": resp, "context_docs": docs}
+
+    dataset = [
+        dict(question="q1", label="Berlin", file=""),
+        dict(question="q2", label="Paris", file=""),
+    ]
+    ev = RAGEvaluator(dataset, connector=FakeConnector())
+    ev.predict_dataset()
+    assert ev.judge_correctness(MockJudgeChat()) == 0.5
+    r = ev.calculate_retrieval_metrics()
+    assert r["context_hit_rate"] == 0.5 and r["mrr"] == 0.5

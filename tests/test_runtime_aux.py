@@ -272,7 +272,7 @@ def test_rag_example_app_end_to_end():
     assert proc.returncode == 0, proc.stderr[-2000:]
     result = json.loads(proc.stdout.strip().splitlines()[-1])
     assert result["value"] == 1.0
-    assert result["n_questions"] == 3
+    assert result["n_questions"] == 10
 
 
 def test_free_tier_worker_cap(monkeypatch):

@@ -384,3 +384,38 @@ def test_sql_limit_over_unorderable_cells():
     r = pw.sql("SELECT data FROM t LIMIT 2", t=t)
     (out,) = pw.debug.materialize(r)
     assert len(out.current) == 2
+
+
+def test_sql_trailing_garbage_raises():
+    t = pw.debug.table_from_markdown("""
+          | v
+        1 | 3
+    """)
+    with pytest.raises(ValueError, match="unsupported trailing SQL"):
+        pw.sql("SELECT v FROM t LIMIT 2 OFFSET 1", t=t)
+
+
+def test_sql_scalar_subquery_union_rejected():
+    t = pw.debug.table_from_markdown("""
+          | v
+        1 | 3
+    """)
+    with pytest.raises(ValueError, match="single-row aggregates"):
+        pw.sql(
+            "SELECT v FROM t WHERE v = "
+            "(SELECT MAX(v) AS m FROM t UNION ALL SELECT MIN(v) AS m FROM t)",
+            t=t,
+        )
+
+
+def test_sql_aggregate_inside_case_condition():
+    t = pw.debug.table_from_markdown("""
+          | v
+        1 | 3
+        2 | 4
+    """)
+    r = pw.sql(
+        "SELECT CASE WHEN SUM(v) > 5 THEN 1 ELSE 0 END AS s FROM t", t=t
+    )
+    (out,) = pw.debug.materialize(r)
+    assert list(out.current.values()) == [(1,)]
