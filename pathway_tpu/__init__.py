@@ -86,7 +86,22 @@ Type = dt  # pw.Type-ish access to dtypes
 
 def apply(fun, *args, **kwargs) -> ColumnExpression:
     """Row-wise application, result type inferred from annotations
-    (reference: internals/common.py apply)."""
+    (reference: internals/common.py apply).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a | b
+    ... 2 | 3
+    ... 5 | 1
+    ... ''')
+    >>> pw.debug.compute_and_print(
+    ...     t.select(m=pw.apply(max, t.a, t.b)), include_id=False)
+    m
+    3
+    5
+    """
     import inspect
 
     try:
@@ -123,6 +138,22 @@ def declare_type(target_type, expr) -> ColumnExpression:
 
 
 def coalesce(*args) -> ColumnExpression:
+    """First non-None argument (reference: pw.coalesce).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a    | b
+    ...      | 7
+    ... 2    | 9
+    ... ''')
+    >>> pw.debug.compute_and_print(
+    ...     t.select(v=pw.coalesce(t.a, t.b)), include_id=False)
+    v
+    2
+    7
+    """
     return CoalesceExpression(*args)
 
 
@@ -131,10 +162,40 @@ def require(val, *args) -> ColumnExpression:
 
 
 def if_else(if_clause, then_clause, else_clause) -> ColumnExpression:
+    """Conditional expression (reference: pw.if_else).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... v
+    ... 3
+    ... 8
+    ... ''')
+    >>> r = t.select(size=pw.if_else(t.v > 5, "big", "small"))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    size
+    big
+    small
+    """
     return IfElseExpression(if_clause, then_clause, else_clause)
 
 
 def make_tuple(*args) -> ColumnExpression:
+    """Pack expressions into one tuple cell (reference: pw.make_tuple).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a | b
+    ... 1 | x
+    ... ''')
+    >>> pw.debug.compute_and_print(
+    ...     t.select(pair=pw.make_tuple(t.a, t.b)), include_id=False)
+    pair
+    (1, 'x')
+    """
     return MakeTupleExpression(*args)
 
 

@@ -84,7 +84,33 @@ def table_from_markdown(
     """Parse a markdown-style table (reference: debug/__init__.py:431).
 
     The optional first unnamed column carries explicit row ids; special
-    columns ``__time__``/``__diff__`` build update streams."""
+    columns ``__time__``/``__diff__`` build update streams.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ...   | name  | age
+    ... 1 | alice | 30
+    ... 2 | bob   | 25
+    ... ''')
+    >>> pw.debug.compute_and_print(t, include_id=False)
+    name | age
+    alice | 30
+    bob | 25
+
+    Update streams replay timestamped diffs (same explicit id = same row):
+
+    >>> s = pw.debug.table_from_markdown('''
+    ...   | v | __time__ | __diff__
+    ... 1 | 5 | 2        | 1
+    ... 1 | 5 | 4        | -1
+    ... 2 | 7 | 4        | 1
+    ... ''')
+    >>> pw.debug.compute_and_print(s, include_id=False)
+    v
+    7
+    """
     lines = [l for l in txt.splitlines() if l.strip() and not set(l.strip()) <= {"-", "|", " "}]
     header = lines[0]
     sep = "|"
@@ -309,7 +335,19 @@ def compute_and_print(
     """reference: debug/__init__.py:207"""
     (out,) = materialize(table)
     names = table.column_names()
-    rows = sorted(out.current.items(), key=lambda kv: kv[0])
+    if include_id:
+        rows = sorted(out.current.items(), key=lambda kv: kv[0])
+    else:
+        # value order: keys are hashes, so key order looks arbitrary —
+        # doctests and humans want a stable, legible ordering
+        try:
+            rows = sorted(out.current.items(), key=lambda kv: kv[1])
+        except (TypeError, ValueError):
+            # mixed/unorderable cells (ndarray comparison raises
+            # ValueError, not TypeError) — stable repr order
+            rows = sorted(
+                out.current.items(), key=lambda kv: tuple(map(repr, kv[1]))
+            )
     if n_rows is not None:
         rows = rows[:n_rows]
     header = (["id"] if include_id else []) + list(names)
@@ -343,7 +381,9 @@ def compute_and_print_update_stream(
 def _fmt(v, short_pointers: bool) -> str:
     if isinstance(v, Pointer) and short_pointers:
         return f"^{v.value % 0xFFFFF:05X}..."
-    return repr(v) if isinstance(v, str) else str(v)
+    # strings print bare, matching the reference's table rendering (its
+    # doctests show `alice`, not `'alice'`)
+    return str(v)
 
 
 # ---------------------------------------------------------------------------

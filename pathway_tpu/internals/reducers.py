@@ -9,6 +9,29 @@ Engine contract: :meth:`Reducer.compute` receives the group's multiset as a
 list of ``(args, count, key, seq)`` where ``args`` is this reducer's argument
 tuple per distinct input row, ``count`` its multiplicity, ``key`` the source
 row id and ``seq`` a monotone insertion stamp (for earliest/latest).
+
+
+Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown(\'\'\'
+    ... g | v
+    ... a | 1
+    ... a | 4
+    ... b | 9
+    ... \'\'\')
+    >>> r = t.groupby(t.g).reduce(
+    ...     t.g,
+    ...     n=pw.reducers.count(),
+    ...     s=pw.reducers.sum(t.v),
+    ...     lo=pw.reducers.min(t.v),
+    ...     hi=pw.reducers.max(t.v),
+    ...     all_vals=pw.reducers.sorted_tuple(t.v),
+    ... )
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    g | n | s | lo | hi | all_vals
+    a | 2 | 5 | 1 | 4 | (1, 4)
+    b | 1 | 9 | 9 | 9 | (9,)
 """
 
 from __future__ import annotations

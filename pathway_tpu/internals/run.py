@@ -68,6 +68,9 @@ def run(
             from .monitoring import StatsMonitor, start_http_server_thread
 
             engine.monitor = StatsMonitor()
+            # OTel gauges ride whatever MeterProvider the embedding app
+            # configured; pure no-op otherwise (telemetry.py)
+            telemetry.register_metrics(engine.monitor)
             if with_http_server:
                 http_server = start_http_server_thread(
                     engine.monitor,

@@ -547,9 +547,30 @@ def _is_single_row(sub_ast: dict) -> bool:
 
 def sql(query: str, **tables: Table) -> Table:
     """Run a SQL query against the given tables
-    (reference: pw.sql, internals/sql.py)::
+    (reference: pw.sql, internals/sql.py).
 
-        pw.sql("SELECT owner, SUM(value) AS total FROM t GROUP BY owner", t=t)
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... owner | value
+    ... ann   | 10
+    ... bob   | 5
+    ... ann   | 2
+    ... ''')
+    >>> r = pw.sql("SELECT owner, SUM(value) AS total FROM t GROUP BY owner", t=t)
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    owner | total
+    ann | 12
+    bob | 5
+
+    Maintained top-k via ORDER BY + LIMIT:
+
+    >>> top = pw.sql("SELECT owner, value FROM t ORDER BY value DESC LIMIT 2", t=t)
+    >>> pw.debug.compute_and_print(top, include_id=False)
+    owner | value
+    ann | 10
+    bob | 5
     """
     parser = _Parser(_tokenize(query))
     ast = parser.parse_query()

@@ -104,7 +104,22 @@ class Table:
 
     # -- core relational ops --
     def select(self, *args: Any, **kwargs: Any) -> "Table":
-        """reference: table.py:382"""
+        """Project and compute columns (reference: table.py:382).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a | b
+        ... 1 | x
+        ... 2 | y
+        ... ''')
+        >>> pw.debug.compute_and_print(
+        ...     t.select(t.b, double=t.a * 2), include_id=False)
+        b | double
+        x | 2
+        y | 4
+        """
         exprs = expand_select_args(args, kwargs, self)
         return self._select_exprs(exprs, universe=self._universe)
 
@@ -124,7 +139,23 @@ class Table:
         return Table._new(op, schema, universe)
 
     def filter(self, condition: Any) -> "Table":
-        """reference: table.py:490"""
+        """Keep rows satisfying ``condition`` (reference: table.py).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... v
+        ... 1
+        ... 5
+        ... 9
+        ... ''')
+        >>> pw.debug.compute_and_print(t.filter(t.v >= 5), include_id=False)
+        v
+        5
+        9
+
+        reference: table.py:490"""
         cond = resolve_expression(condition, self)
         extra = _referenced_tables([cond], primary=self)
         op = Operator(
@@ -148,7 +179,24 @@ class Table:
         instance: Any = None,
         **kwargs,
     ) -> GroupedTable:
-        """reference: table.py:942"""
+        """Group rows for ``.reduce`` (reference: table.py:942).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... word  | n
+        ... apple | 2
+        ... pear  | 1
+        ... apple | 3
+        ... ''')
+        >>> r = t.groupby(t.word).reduce(
+        ...     t.word, total=pw.reducers.sum(t.n), c=pw.reducers.count())
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        word | total | c
+        apple | 5 | 2
+        pear | 1 | 1
+        """
         grouping = [resolve_expression(a, self) for a in args]
         set_id = False
         if id is not None:
@@ -176,7 +224,24 @@ class Table:
         name: str | None = None,
     ) -> "Table":
         """Keep one accepted row per instance
-        (reference: stdlib/stateful/deduplicate.py)."""
+        (reference: stdlib/stateful/deduplicate.py).
+
+        Example — keep each sensor's highest sequence number:
+
+        >>> import pathway_tpu as pw
+        >>> d = pw.debug.table_from_markdown('''
+        ...   | sensor | val | seq
+        ... 1 | s1     | 5   | 1
+        ... 2 | s1     | 9   | 2
+        ... 3 | s2     | 7   | 1
+        ... ''')
+        >>> out = d.deduplicate(value=d.seq, instance=d.sensor,
+        ...                     acceptor=lambda new, cur: new > cur)
+        >>> pw.debug.compute_and_print(out, include_id=False)
+        sensor | val | seq
+        s1 | 9 | 2
+        s2 | 7 | 1
+        """
         value_e = resolve_expression(value, self)
         instance_e = (
             resolve_expression(instance, self) if instance is not None else None
@@ -206,7 +271,28 @@ class Table:
         right_instance: Any = None,
         exact_match: bool = False,
     ) -> JoinResult:
-        """reference: table.py join / joins.py:  modes INNER/LEFT/RIGHT/OUTER"""
+        """Equi-join (reference: table.py join / joins.py; modes
+        INNER/LEFT/RIGHT/OUTER via ``how`` or ``join_left``/... sugar).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> left = pw.debug.table_from_markdown('''
+        ... k | v
+        ... a | 1
+        ... b | 2
+        ... ''')
+        >>> right = pw.debug.table_from_markdown('''
+        ... rk | label
+        ... a  | ant
+        ... b  | bee
+        ... ''')
+        >>> j = left.join(right, left.k == right.rk).select(left.v, right.label)
+        >>> pw.debug.compute_and_print(j, include_id=False)
+        v | label
+        1 | ant
+        2 | bee
+        """
         on = list(on)
         if left_instance is not None and right_instance is not None:
             on.append(
@@ -239,13 +325,53 @@ class Table:
         return Table._new(op, schema, Universe())
 
     def concat_reindex(self, *others: "Table") -> "Table":
+        """Union with fresh row keys, so universes never collide
+        (reference: table.py concat_reindex).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> a = pw.debug.table_from_markdown('''
+        ... v
+        ... 1
+        ... ''')
+        >>> b = pw.debug.table_from_markdown('''
+        ... v
+        ... 2
+        ... ''')
+        >>> pw.debug.compute_and_print(a.concat_reindex(b), include_id=False)
+        v
+        1
+        2
+        """
         tables = [self, *others]
         schema = _common_schema(tables)
         op = Operator("concat", tables, params=dict(reindex=True))
         return Table._new(op, schema, Universe())
 
     def update_rows(self, other: "Table") -> "Table":
-        """reference: table.py:1164"""
+        """Union where ``other``'s rows win on key collision
+        (reference: table.py:1164).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> base = pw.debug.table_from_markdown('''
+        ...   | name  | v
+        ... 1 | alice | 1
+        ... 2 | bob   | 2
+        ... ''')
+        >>> fresh = pw.debug.table_from_markdown('''
+        ...   | name  | v
+        ... 2 | bobby | 20
+        ... 3 | carol | 30
+        ... ''')
+        >>> pw.debug.compute_and_print(base.update_rows(fresh), include_id=False)
+        name | v
+        alice | 1
+        bobby | 20
+        carol | 30
+        """
         schema = _common_schema([self, other])
         universe = Universe()
         self._universe.promise_subset_of(universe)
@@ -254,7 +380,27 @@ class Table:
         return Table._new(op, schema, universe)
 
     def update_cells(self, other: "Table") -> "Table":
-        """reference: table.py:1064"""
+        """Override ``other``'s columns on rows where it has the key
+        (reference: table.py:1064).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> base = pw.debug.table_from_markdown('''
+        ...   | name  | v
+        ... 1 | alice | 1
+        ... 2 | bob   | 2
+        ... ''')
+        >>> upd = pw.debug.table_from_markdown('''
+        ...   | v
+        ... 2 | 99
+        ... ''')
+        >>> patched = base.update_cells(upd.promise_universe_is_subset_of(base))
+        >>> pw.debug.compute_and_print(patched, include_id=False)
+        name | v
+        alice | 1
+        bob | 99
+        """
         if not other._universe.is_subset_of(self._universe):
             raise ValueError(
                 "update_cells: other table's universe is not a subset of self's; "
@@ -375,7 +521,23 @@ class Table:
     # -- reshaping --
     def flatten(self, to_flatten: ColumnReference, *, origin_id: str | None = None) -> "Table":
         """Explode a sequence column (reference: table.py flatten /
-        graph.rs flatten_table)."""
+        graph.rs flatten_table).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... who | items
+        ... ann | a,b
+        ... bob | c
+        ... ''')
+        >>> parts = t.select(t.who, item=pw.apply(lambda s: tuple(s.split(",")), t.items))
+        >>> pw.debug.compute_and_print(parts.flatten(parts.item), include_id=False)
+        who | item
+        ann | a
+        ann | b
+        bob | c
+        """
         col = resolve_expression(to_flatten, self)
         if not isinstance(col, ColumnReference):
             raise TypeError("flatten expects a column reference")

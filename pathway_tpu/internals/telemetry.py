@@ -22,6 +22,8 @@ __all__ = ["Telemetry", "get_telemetry"]
 class Telemetry:
     def __init__(self, enabled: bool | None = None):
         self._tracer = None
+        self._meter = None
+        self._monitor = None
         try:
             from opentelemetry import trace
 
@@ -56,6 +58,86 @@ class Telemetry:
             "process.cpu.system_s": ru.ru_stime,
             "process.pid": os.getpid(),
         }
+
+    def register_metrics(self, monitor: Any = None) -> bool:
+        """Register process mem/CPU (+ per-operator latency, when a
+        StatsMonitor is supplied) as OTel observable gauges
+        (reference: telemetry.rs:316-350 register_stats_metrics /
+        register_sys_metrics + the 60 s periodic reader).
+
+        Uses the opentelemetry *metrics API*: with only the API installed
+        (this image) the no-op meter swallows everything; when the
+        embedding application configures an SDK ``MeterProvider`` (OTLP,
+        Prometheus, in-memory reader...), its periodic reader drives the
+        callbacks below.  Idempotent; returns True when gauges were
+        registered on a meter."""
+        if self._meter is not None:
+            # gauges exist — repoint the latency callback at the newest
+            # monitor (each pw.run builds a fresh StatsMonitor)
+            self._monitor = monitor
+            return True
+        try:
+            from opentelemetry import metrics
+            from opentelemetry.metrics import Observation
+        except ImportError:
+            return False
+        meter = metrics.get_meter("pathway_tpu")
+        self._meter = meter
+        self._monitor = monitor
+
+        def observe_memory(options):
+            try:
+                import psutil
+
+                rss = psutil.Process().memory_info().rss
+            except Exception:
+                import resource
+
+                rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+            return [Observation(rss)]
+
+        def observe_cpu(options):
+            import resource
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            return [Observation(ru.ru_utime + ru.ru_stime)]
+
+        def observe_latency(options):
+            mon = self._monitor
+            if mon is None:
+                return []
+            try:
+                snap = mon.snapshot()
+            except Exception:
+                return []
+            out = []
+            for name, st in snap.get("nodes", {}).items():
+                flushes = st.get("flushes", 0)
+                avg_ms = (
+                    st.get("busy_s", 0.0) / flushes * 1000.0 if flushes else 0.0
+                )
+                out.append(Observation(avg_ms, {"operator": name}))
+            return out
+
+        meter.create_observable_gauge(
+            "pathway.process.memory_rss_bytes",
+            callbacks=[observe_memory],
+            unit="By",
+            description="resident set size of the engine process",
+        )
+        meter.create_observable_gauge(
+            "pathway.process.cpu_seconds",
+            callbacks=[observe_cpu],
+            unit="s",
+            description="cumulative user+system CPU time",
+        )
+        meter.create_observable_gauge(
+            "pathway.operator.avg_latency_ms",
+            callbacks=[observe_latency],
+            unit="ms",
+            description="per-operator mean flush latency",
+        )
+        return True
 
 
 _singleton: Telemetry | None = None

@@ -467,7 +467,24 @@ def udf(
     cache_strategy: CacheStrategy | None = None,
     max_batch_size: int | None = None,
 ):
-    """``@pw.udf`` decorator (reference: udfs/__init__.py ``udf``)."""
+    """``@pw.udf`` decorator (reference: udfs/__init__.py ``udf``).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> @pw.udf
+    ... def shout(s: str) -> str:
+    ...     return s.upper() + "!"
+    >>> t = pw.debug.table_from_markdown('''
+    ... word
+    ... hi
+    ... there
+    ... ''')
+    >>> pw.debug.compute_and_print(t.select(loud=shout(t.word)), include_id=False)
+    loud
+    HI!
+    THERE!
+    """
 
     def wrap(f: Callable) -> UDF:
         return _FunctionUDF(

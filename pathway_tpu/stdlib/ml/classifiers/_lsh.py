@@ -35,7 +35,20 @@ def generate_euclidean_lsh_bucketer(
     d: int, M: int, L: int, A: float = 1.0, seed: int = 0
 ):
     """Euclidean LSH: project on M*L random lines, floor-divide by bucket
-    width ``A``, AND the M ints per band into one id; L band ids out."""
+    width ``A``, AND the M ints per band into one id; L band ids out.
+
+    Example:
+
+    >>> import numpy as np
+    >>> from pathway_tpu.stdlib.ml.classifiers import (
+    ...     generate_euclidean_lsh_bucketer)
+    >>> bucketer = generate_euclidean_lsh_bucketer(d=4, M=3, L=5, A=2.0)
+    >>> near_a = bucketer(np.zeros(4))
+    >>> near_b = bucketer(np.full(4, 0.01))   # a hair away: same buckets
+    >>> far = bucketer(np.full(4, 100.0))     # far away: different buckets
+    >>> near_a.shape, bool((near_a == near_b).all()), bool((near_a == far).any())
+    ((5,), True, False)
+    """
     gen = np.random.default_rng(seed=seed)
     lines = gen.standard_normal((d, M * L))
     lines = lines / np.linalg.norm(lines, axis=0)

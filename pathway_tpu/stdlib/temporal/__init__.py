@@ -3,6 +3,22 @@
 reference: python/pathway/stdlib/temporal/ (~5600 LoC: _window.py:863
 ``windowby``, _asof_now_join.py:403, _interval_join.py, _asof_join.py,
 _window_join.py, temporal_behavior.py).
+
+Example — tumbling-window aggregation:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... t  | v
+    ... 1  | 10
+    ... 3  | 20
+    ... 11 | 5
+    ... ''')
+    >>> r = t.windowby(t.t, window=pw.temporal.tumbling(duration=10)).reduce(
+    ...     start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    start | s
+    0 | 30
+    10 | 5
 """
 
 from ._window import (

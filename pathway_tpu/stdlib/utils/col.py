@@ -11,7 +11,24 @@ __all__ = ["unpack_col", "apply_all_rows", "multiapply_all_rows", "flatten_colum
 
 
 def unpack_col(column: ColumnReference, *unpacked_columns, schema: SchemaMetaclass | None = None) -> Table:
-    """Unpack a tuple column into named columns (reference: col.py unpack_col)."""
+    """Unpack a tuple column into named columns (reference: col.py unpack_col).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> from pathway_tpu.stdlib.utils.col import unpack_col
+    >>> t = pw.debug.table_from_markdown('''
+    ... a | b
+    ... 1 | x
+    ... 2 | y
+    ... ''')
+    >>> packed = t.select(pair=pw.make_tuple(t.a, t.b))
+    >>> pw.debug.compute_and_print(
+    ...     unpack_col(packed.pair, "num", "tag"), include_id=False)
+    num | tag
+    1 | x
+    2 | y
+    """
     table = column.table
     if schema is not None:
         names = schema.column_names()

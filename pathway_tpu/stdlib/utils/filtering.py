@@ -7,7 +7,23 @@ __all__ = ["argmax_rows", "argmin_rows"]
 
 def argmax_rows(table, *on, what):
     """Keep, per group of ``on``, the row maximizing ``what``
-    (reference: filtering.py ``argmax_rows``)."""
+    (reference: filtering.py ``argmax_rows``).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> from pathway_tpu.stdlib.utils.filtering import argmax_rows
+    >>> t = pw.debug.table_from_markdown('''
+    ... g | v
+    ... a | 3
+    ... a | 7
+    ... b | 5
+    ... ''')
+    >>> pw.debug.compute_and_print(argmax_rows(t, t.g, what=t.v), include_id=False)
+    g | v
+    a | 7
+    b | 5
+    """
     import pathway_tpu as pw
 
     chooser = (

@@ -31,7 +31,27 @@ def _to_frames(packed_rows, input_tables):
 
 def pandas_transformer(output_schema, output_universe: str | int | None = None):
     """Decorator (reference: pandas_transformer.py:15).  ``output_universe``
-    names (or indexes) the argument whose universe the result reuses."""
+    names (or indexes) the argument whose universe the result reuses.
+
+    Example:
+
+    >>> import pandas as pd
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ...   | foo | bar
+    ... 0 | 10  | 100
+    ... 1 | 20  | 200
+    ... ''')
+    >>> class Output(pw.Schema):
+    ...     total: int
+    >>> @pw.pandas_transformer(output_schema=Output, output_universe=0)
+    ... def sum_cols(frame) -> pd.DataFrame:
+    ...     return pd.DataFrame(frame.sum(axis=1))
+    >>> pw.debug.compute_and_print(sum_cols(t), include_id=False)
+    total
+    110
+    220
+    """
     import functools
     import inspect
 

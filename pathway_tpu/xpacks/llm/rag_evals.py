@@ -66,6 +66,11 @@ class PredictedData(Data):
 _DATE_RE = re.compile(r"\b(0?[1-9]|1[0-2])/(0?[1-9]|[12]\d|3[01])/\d{2}\b")
 
 
+def _norm(s) -> str:
+    """Lowercase alphanumeric normalization shared by every scorer."""
+    return "".join(c for c in str(s).lower() if c.isalnum())
+
+
 def is_date(s: str) -> bool:
     return bool(_DATE_RE.match(s))
 
@@ -79,23 +84,38 @@ def parse_date(s: str) -> datetime | None:
     return None
 
 
+def _strip_date_zeros(s: str) -> str:
+    return "/".join(part.lstrip("0") or "0" for part in s.split("/"))
+
+
 def compare_dates(pred: str, label: str) -> bool:
     d = parse_date(pred)
     if d is None:
         return False
-    return f"{d.month}/{d.day}/{d:%y}" == label
+    # zero-padded labels ('05/08/14') must match like '5/8/14'
+    return f"{d.month}/{d.day}/{d:%y}" == _strip_date_zeros(label)
 
 
 def compare_sim_with_date(
     pred: str, label: str, min_sequence_match: float = 0.4
 ) -> bool:
-    """reference: evaluator.py:65 — date-aware lenient string match."""
+    """reference: evaluator.py:65 — date-aware lenient string match.
+
+    Example:
+
+    >>> from pathway_tpu.xpacks.llm.rag_evals import compare_sim_with_date
+    >>> compare_sim_with_date("The capital is Berlin", "Berlin", 0.2)
+    True
+    >>> compare_sim_with_date("May 8, 2014", "5/8/14")
+    True
+    >>> compare_sim_with_date("Madrid", "Berlin")
+    False
+    """
     if "No information" in str(pred) and str(label) == "nan":
         return True
     if is_date(label):
         return compare_dates(pred, label)
-    a = "".join(c for c in str(pred).lower() if c.isalnum())
-    b = "".join(c for c in str(label).lower() if c.isalnum())
+    a, b = _norm(pred), _norm(label)
     return SequenceMatcher(None, a, b).ratio() > min_sequence_match
 
 
@@ -124,7 +144,16 @@ class MockJudgeChat:
     """Deterministic stand-in for the judge LLM: parses the judge prompt
     and grades by normalized containment / similarity — the verdict a
     well-behaved judge model reaches on unambiguous cases.  Callable like
-    the chat UDFs' plain-python form."""
+    the chat UDFs' plain-python form.
+
+    Example:
+
+    >>> from pathway_tpu.xpacks.llm.rag_evals import (
+    ...     MockJudgeChat, build_judge_prompt)
+    >>> judge = MockJudgeChat()
+    >>> judge(build_judge_prompt("capital?", "Berlin", "It is Berlin."))
+    'CORRECT'
+    """
 
     def __call__(self, prompt: str, **kwargs) -> str:
         m = re.search(
@@ -133,8 +162,7 @@ class MockJudgeChat:
         if not m:
             return "INCORRECT"
         label, answer = m.group(1), m.group(2)
-        a = "".join(c for c in answer.lower() if c.isalnum())
-        b = "".join(c for c in label.lower() if c.isalnum())
+        a, b = _norm(answer), _norm(label)
         if not b:
             return "CORRECT" if not a else "INCORRECT"
         if b in a:
@@ -254,15 +282,11 @@ class RAGEvaluator:
         rr_total = 0.0
         total = len(self.predicted_dataset)
         for p in self.predicted_dataset:
-            label_norm = "".join(
-                c for c in str(p.label).lower() if c.isalnum()
-            )
+            label_norm = _norm(p.label)
             rank = None
             for i, doc in enumerate(p.docs):
                 text = doc.get("text") if isinstance(doc, dict) else str(doc)
-                doc_norm = "".join(
-                    c for c in str(text).lower() if c.isalnum()
-                )
+                doc_norm = _norm(text)
                 if label_norm and label_norm in doc_norm:
                     rank = i + 1
                     break

@@ -59,7 +59,16 @@ def _token_spans(text: str, encoding_name: str) -> list[tuple[int, int]]:
 
 class TokenCountSplitter(UDF):
     """Split text into chunks of [min_tokens, max_tokens] tokens, preferring
-    to cut just after sentence punctuation (reference: splitters.py:34)."""
+    to cut just after sentence punctuation (reference: splitters.py:34).
+
+    Example:
+
+    >>> from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+    >>> sp = TokenCountSplitter(min_tokens=2, max_tokens=6)
+    >>> [c for c, _meta in sp.__wrapped__(
+    ...     "One two three. Four five six seven eight. Nine.")]
+    ['One two three.', 'Four five six seven eight.', 'Nine.']
+    """
 
     def __init__(
         self,

@@ -305,3 +305,62 @@ def test_free_tier_worker_cap(monkeypatch):
     assert get_pathway_config().license_key == "another-key"
     pw.set_license_key(None)
     assert get_pathway_config(refresh=True).license_key is None
+
+
+# ---------------------------------------------------------------------------
+# telemetry metrics (reference: src/engine/telemetry.rs:316-350)
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_gauges_with_in_memory_provider():
+    """With a meter provider configured, register_metrics exposes process
+    mem/CPU and per-operator latency gauges whose callbacks the reader
+    can drive; with only the no-op API, everything stays silent."""
+    from opentelemetry import metrics as otel_metrics
+    from opentelemetry.metrics import CallbackOptions
+
+    from pathway_tpu.internals.monitoring import StatsMonitor
+    from pathway_tpu.internals.telemetry import Telemetry
+
+    registered = {}
+
+    class _Gauge:
+        def __init__(self, name, callbacks):
+            registered[name] = callbacks
+
+    class _Meter(otel_metrics.NoOpMeter):
+        def create_observable_gauge(self, name, callbacks=None, **kw):
+            return _Gauge(name, callbacks or [])
+
+    class _Provider(otel_metrics.NoOpMeterProvider):
+        def get_meter(self, *a, **kw):
+            return _Meter("pathway_tpu")
+
+    monitor = StatsMonitor()
+    monitor.record_flush("groupby#1", 100, 0.02)
+    monitor.record_flush("groupby#1", 100, 0.04)
+
+    tele = Telemetry()
+    old_provider = otel_metrics.get_meter_provider()
+    otel_metrics.set_meter_provider(_Provider())
+    try:
+        assert tele.register_metrics(monitor) is True
+        assert set(registered) == {
+            "pathway.process.memory_rss_bytes",
+            "pathway.process.cpu_seconds",
+            "pathway.operator.avg_latency_ms",
+        }
+        opts = CallbackOptions()
+        (mem_obs,) = registered["pathway.process.memory_rss_bytes"][0](opts)
+        assert mem_obs.value > 10 * 1024 * 1024  # a real RSS
+        (cpu_obs,) = registered["pathway.process.cpu_seconds"][0](opts)
+        assert cpu_obs.value > 0
+        lat = list(registered["pathway.operator.avg_latency_ms"][0](opts))
+        assert len(lat) == 1
+        assert lat[0].attributes == {"operator": "groupby#1"}
+        assert lat[0].value == pytest.approx(30.0, rel=0.01)  # (20+40)ms / 2 flushes
+    finally:
+        # restore so other tests see the default provider
+        otel_metrics._internal._METER_PROVIDER = old_provider  # noqa: SLF001
+        tele2 = Telemetry()
+        assert tele2.register_metrics(None) is True  # API no-op path
