@@ -1224,6 +1224,31 @@ class Engine:
         if self.monitor is not None:
             self.monitor.record_step(time)
 
+    def step_ingest(self, time: int, safe_ids: set, first_hop) -> None:
+        """Stage 1 of a distributed round, runnable AHEAD of older
+        unfinished rounds: flush the ingest-safe subgraph (nodes whose
+        outputs flow only into exchange inputs — internals/exchange.py
+        ``ingest_safe_nodes``) to quiescence for ``time``, then partition
+        and SEND the first-hop exchanges' batches without waiting for
+        peers.  Everything else stays queued until ``step`` finishes the
+        round in order."""
+        for _pass in range(100_000):
+            progressed = False
+            for node in self.nodes:
+                if node.id not in safe_ids or not node.has_pending(time):
+                    continue
+                progressed = True
+                out = self._flush_node(node, time)
+                if out:
+                    for consumer, port in node.downstream:
+                        consumer.receive(port, out)
+            if not progressed:
+                break
+        else:  # pragma: no cover
+            raise RuntimeError("step_ingest did not quiesce")
+        for node in first_hop:
+            node.prepare(time)
+
     def _flush_node(self, node: Node, time: int) -> list[Entry]:
         if self.monitor is None:
             return node.flush(time)

@@ -12,10 +12,18 @@ of records.  Shared sources (fs/kafka/s3 scanners that every process can
 see) apply an ownership filter at ingestion — a record enters the system
 on exactly one process — and :class:`ExchangeNode`s spliced before every
 stateful operator re-partition records by that operator's key (group key,
-join key, instance, …) over a TCP full mesh.  One exchange is a barrier
-per (channel, timestamp): processes step timestamps in lockstep, which is
-what makes the per-timestamp consistency of the engine hold globally (the
-role timely's progress protocol plays in the reference).
+join key, instance, …) over a TCP full mesh.
+
+Progress is asynchronous, not lockstep: a round's stage 1 — drain
+sources, flush the ingest-safe subgraph, partition + ``send`` first-hop
+exchange batches (``prepare``) — may run up to ``PATHWAY_EXCHANGE_LOOKAHEAD``
+rounds ahead of the oldest unfinished round, so one worker's slow round
+overlaps the others' later ingest instead of serializing the cluster
+(the role timely's frontier-based progress tracking plays in the
+reference).  Stage 2 (``recv`` + stateful flush) completes rounds
+strictly in order, which is what keeps the engine's per-timestamp
+consistency global; the bounded lookahead doubles as flow control —
+peer inboxes hold at most W unpopped batches per (channel, sender).
 
 TPU mapping: this is the host/DCN plane.  Device-plane collectives
 (all-gather top-k of the sharded HBM index, psum stats) ride ICI inside
@@ -314,8 +322,9 @@ class ExchangePlane:
                 with self._cv:
                     # a queue per key: identical schedules may exchange the
                     # same (channel, time) more than once back-to-back, and
-                    # both batches must survive until popped (depth stays
-                    # ≤2 by the barrier protocol — see class docstring)
+                    # both batches must survive until popped (depth is
+                    # bounded by the sender's lookahead window W — see the
+                    # class docstring's flow-control note)
                     self._inbox.setdefault((channel, time, sender), []).append(
                         entries
                     )
@@ -344,19 +353,19 @@ class ExchangePlane:
             buf += chunk
         return buf
 
-    # -- the barrier exchange --
-    def exchange(
+    # -- the exchange protocol: decoupled send / receive --
+    def send(
         self,
         channel: str,
         time: int,
         outgoing: dict[int, list],
         is_entries: bool = True,
-    ) -> list:
-        """Send per-destination batches, receive this channel's batches
-        from every peer for ``time``; returns the merged remote entries.
-        A barrier: blocks until all peers have sent for (channel, time).
-        ``is_entries=False`` marks control payloads (arbitrary values
-        rather than (key, row, diff) entries)."""
+    ) -> None:
+        """Ship per-destination batches for (channel, time) WITHOUT
+        waiting for anything: the asynchronous-progress half that lets a
+        fast worker run ahead of a straggler.  Bounded by the caller's
+        lookahead window (io/streaming.py), so peer inboxes hold at most
+        W unpopped batches per (channel, sender)."""
         for peer in range(self.n):
             if peer == self.me:
                 continue
@@ -368,6 +377,24 @@ class ExchangePlane:
             # no send lock: a lock shared across peer sockets would let one
             # stalled peer's TCP window block sends to every other peer
             self._send[peer].sendall(_HDR.pack(len(payload)) + payload)
+
+    def exchange(
+        self,
+        channel: str,
+        time: int,
+        outgoing: dict[int, list],
+        is_entries: bool = True,
+    ) -> list:
+        """``send`` + ``recv``: ship batches, then block until every
+        peer's batch for (channel, time) arrived and return the merged
+        remote payloads.  ``is_entries=False`` marks control payloads
+        (arbitrary values rather than (key, row, diff) entries)."""
+        self.send(channel, time, outgoing, is_entries=is_entries)
+        return self.recv(channel, time)
+
+    def recv(self, channel: str, time: int) -> list:
+        """Collect every peer's batch for (channel, time); blocks until
+        each has arrived (they arrive in time order per sender)."""
         merged: list = []
         deadline = _time.monotonic() + self.barrier_timeout
         with self._cv:
@@ -434,6 +461,9 @@ class ExchangeNode(Node):
         self.key_fn = key_fn  # None = partition by row key
         self.broadcast = broadcast
         self._exchanged_time: int | None = None
+        #: rounds whose partition+send already ran (driver lookahead);
+        #: flush() then only has to receive
+        self._prepared: dict[int, list[Entry]] = {}
 
     # participates in every timestamp: peers may send even when this
     # process has nothing local
@@ -446,7 +476,13 @@ class ExchangeNode(Node):
         # so all local inputs have settled by the time this node fires.
         return self._exchanged_time != time
 
-    def flush(self, time: int) -> list[Entry]:
+    def prepare(self, time: int) -> None:
+        """Stage 1 of a round: partition the settled local input and SEND
+        it — without waiting for peers.  The driver calls this up to W
+        rounds ahead of the oldest unfinished round (asynchronous
+        progress); ``flush`` later only has to receive."""
+        if time in self._prepared:
+            return
         local = self.take(0)
         outgoing: dict[int, list] = {}
         mine: list[Entry] = []
@@ -463,9 +499,106 @@ class ExchangeNode(Node):
                     mine.append((key, row, diff))
                 else:
                     outgoing.setdefault(dest, []).append((key, row, diff))
-        remote = self.plane.exchange(self.channel, time, outgoing)
+        self.plane.send(self.channel, time, outgoing)
+        self._prepared[time] = mine
+
+    def flush(self, time: int) -> list[Entry]:
+        # stage 2: wait for every peer's batch for this round.  When the
+        # driver did not run stage 1 ahead (chained exchanges, lockstep
+        # paths), prepare() here degenerates to the old send+recv flush.
+        if time in self._prepared and self.pending.get(0):
+            # input arrived AFTER this round's batch was already sent —
+            # the ingest-safety analysis is broken; losing the rows or
+            # double-sending would silently corrupt results
+            raise RuntimeError(
+                f"{self.name}: local input settled after prepare({time}) "
+                "— first-hop classification violated"
+            )
+        self.prepare(time)
+        mine = self._prepared.pop(time)
+        remote = self.plane.recv(self.channel, time)
         self._exchanged_time = time
         return consolidate(mine + list(remote))
+
+
+def ingest_safe_nodes(engine) -> tuple[set[int], list["ExchangeNode"]]:
+    """Nodes the driver may flush AHEAD of the oldest unfinished round.
+
+    A node is ingest-safe when (a) it sits strictly BEFORE every
+    exchange — nothing in its transitive upstream is an ExchangeNode, so
+    running it early never consumes another round's remote data — and
+    (b) every output path terminates in an ExchangeNode input, so its
+    early output only feeds exchange ``prepare`` buffers, never sinks or
+    stateful state that must observe rounds in order.
+
+    A first-hop exchange is one whose ENTIRE transitive upstream closure
+    is ingest-safe: by prepare time its input for the round has fully
+    settled.  (A merely one-hop check would let a partially-flushed
+    chain lose the late-settling entries.)"""
+    from .engine import OutputNode
+
+    producers: dict[int, list] = {}
+    for n in engine.nodes:
+        for c, _port in n.downstream:
+            producers.setdefault(c.id, []).append(n)
+
+    # nodes with an exchange anywhere upstream (post-exchange set)
+    post: dict[int, bool] = {}
+
+    def post_exchange(node) -> bool:
+        if node.id in post:
+            return post[node.id]
+        post[node.id] = False  # cycle guard (pw.iterate loops)
+        res = any(
+            isinstance(p, ExchangeNode) or post_exchange(p)
+            for p in producers.get(node.id, ())
+        )
+        post[node.id] = res
+        return res
+
+    memo: dict[int, bool] = {}
+
+    def sinks_into_exchanges(node) -> bool:
+        if node.id in memo:
+            return memo[node.id]
+        if not node.downstream:
+            memo[node.id] = False
+            return False
+        memo[node.id] = False  # cycle guard
+        res = all(
+            isinstance(c, ExchangeNode) or sinks_into_exchanges(c)
+            for c, _ in node.downstream
+        )
+        memo[node.id] = res
+        return res
+
+    safe_ids = {
+        n.id
+        for n in engine.nodes
+        if not isinstance(n, (ExchangeNode, OutputNode))
+        and not post_exchange(n)
+        and sinks_into_exchanges(n)
+    }
+
+    def closure_safe(node) -> bool:
+        stack = list(producers.get(node.id, ()))
+        seen: set[int] = set()
+        while stack:
+            p = stack.pop()
+            if p.id in seen:
+                continue
+            seen.add(p.id)
+            if p.id not in safe_ids:
+                return False
+            stack.extend(producers.get(p.id, ()))
+        return True
+
+    first_hop = [
+        n
+        for n in engine.nodes
+        if isinstance(n, ExchangeNode) and closure_safe(n)
+    ]
+    return safe_ids, first_hop
 
 
 def insert_exchanges(engine, plane: ExchangePlane) -> None:

@@ -68,14 +68,17 @@ def run(
             from .monitoring import StatsMonitor, start_http_server_thread
 
             engine.monitor = StatsMonitor()
-            # OTel gauges ride whatever MeterProvider the embedding app
-            # configured; pure no-op otherwise (telemetry.py)
-            telemetry.register_metrics(engine.monitor)
             if with_http_server:
                 http_server = start_http_server_thread(
                     engine.monitor,
                     process_id=get_pathway_config().process_id,
                 )
+
+        # OTel gauges ride whatever MeterProvider the embedding app
+        # configured; pure no-op otherwise.  Registered every run so the
+        # latency gauge tracks THIS run's monitor (None detaches it when
+        # monitoring is off, instead of pinning a finished engine's stats)
+        telemetry.register_metrics(engine.monitor)
 
         pw_config = get_pathway_config(refresh=True)
         if pw_config.processes > 1:

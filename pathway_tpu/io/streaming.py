@@ -14,6 +14,7 @@ stamps with a micro-batch timestamp and pushes through the engine — one
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time as _time
 from typing import Any, Callable, Iterable
@@ -479,15 +480,40 @@ class StreamingDriver:
         self._setup_persistence(1, step=False)
         threads = self._start_connector_threads()
 
-        t = 1
-        while True:
+        # asynchronous progress: stage 1 of a round (drain sources,
+        # flush the ingest-safe subgraph, partition + SEND first-hop
+        # exchange batches and the control flag) may run up to W rounds
+        # ahead of the oldest unfinished round, so a straggler's slow
+        # rounds overlap the fast workers' later ingest instead of
+        # serializing the whole cluster per round (the role timely's
+        # frontier-based progress tracking plays in the reference);
+        # stage 2 (receive + stateful flush) completes rounds in order.
+        from ..internals.exchange import ingest_safe_nodes
+
+        safe_ids, first_hop = ingest_safe_nodes(self.engine)
+        lookahead = max(
+            1, int(os.environ.get("PATHWAY_EXCHANGE_LOOKAHEAD", "4"))
+        )
+        if not first_hop or plane.n == 1:
+            # nothing can run ahead safely / no peers to straggle —
+            # lookahead would only add dead output latency
+            lookahead = 1
+
+        from collections import deque
+
+        inflight: deque[tuple[int, bool]] = deque()
+        t_next = 1
+
+        def ingest_round() -> None:
+            nonlocal t_next
+            t = t_next
             _time.sleep(self.autocommit_ms / 1000.0)
             for subject, _src in self.subject_src:
                 if subject._autocommit_ms is not None:
                     subject.commit()
             # read the closed flags BEFORE draining: close() commits its
-            # final rows first, so a True flag means this round's drain saw
-            # everything
+            # final rows first, so a True flag means this round's drain
+            # saw everything
             local_closed = all(
                 s._closed.is_set() for s, _ in self.subject_src
             ) if self.subject_src else True
@@ -495,25 +521,33 @@ class StreamingDriver:
                 entries = subject._drain()
                 if subject._shared_source:
                     entries = [
-                        e for e in entries if owner_of(e[0], plane.n) == plane.me
+                        e for e in entries
+                        if owner_of(e[0], plane.n) == plane.me
                     ]
                 if entries:
                     src.push(t, entries)
                     self._write_snapshot(subject, entries)
                     self._record_connector(subject, len(entries))
-            # control barrier: carries this process's end-of-stream flag;
-            # every process sees the same flag set for round t, so all exit
-            # after stepping the same final round
             done = local_closed and t >= max_static
-            peer_flags = plane.exchange(
+            # the control flag rides ahead with the data plane; every
+            # process still sees the same flag set for round t
+            plane.send(
                 "__ctl__", t,
                 {p: [done] for p in range(plane.n) if p != plane.me},
                 is_entries=False,
             )
+            self.engine.step_ingest(t, safe_ids, first_hop)
+            inflight.append((t, done))
+            t_next += 1
+
+        while True:
+            while len(inflight) < lookahead:
+                ingest_round()
+            t, done = inflight.popleft()
+            peer_flags = plane.recv("__ctl__", t)
             self.engine.step(t)
             if done and all(f for f in peer_flags):
                 break
-            t += 1
         self._record_finished_connectors()
         self.engine.finish()
         plane.close()

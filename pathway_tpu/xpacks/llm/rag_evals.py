@@ -85,7 +85,11 @@ def parse_date(s: str) -> datetime | None:
 
 
 def _strip_date_zeros(s: str) -> str:
-    return "/".join(part.lstrip("0") or "0" for part in s.split("/"))
+    """Unpad month/day only — the year stays %y-style zero-padded
+    ('05/08/09' -> '5/8/09')."""
+    parts = s.split("/")
+    head = [p.lstrip("0") or "0" for p in parts[:2]]
+    return "/".join(head + parts[2:])
 
 
 def compare_dates(pred: str, label: str) -> bool:

@@ -312,13 +312,14 @@ def test_free_tier_worker_cap(monkeypatch):
 # ---------------------------------------------------------------------------
 
 
-def test_telemetry_gauges_with_in_memory_provider():
+def test_telemetry_gauges_with_in_memory_provider(monkeypatch):
     """With a meter provider configured, register_metrics exposes process
     mem/CPU and per-operator latency gauges whose callbacks the reader
     can drive; with only the no-op API, everything stays silent."""
     from opentelemetry import metrics as otel_metrics
     from opentelemetry.metrics import CallbackOptions
 
+    from pathway_tpu.internals import telemetry as telemetry_mod
     from pathway_tpu.internals.monitoring import StatsMonitor
     from pathway_tpu.internals.telemetry import Telemetry
 
@@ -332,17 +333,16 @@ def test_telemetry_gauges_with_in_memory_provider():
         def create_observable_gauge(self, name, callbacks=None, **kw):
             return _Gauge(name, callbacks or [])
 
-    class _Provider(otel_metrics.NoOpMeterProvider):
-        def get_meter(self, *a, **kw):
-            return _Meter("pathway_tpu")
-
     monitor = StatsMonitor()
     monitor.record_flush("groupby#1", 100, 0.02)
     monitor.record_flush("groupby#1", 100, 0.04)
 
     tele = Telemetry()
-    old_provider = otel_metrics.get_meter_provider()
-    otel_metrics.set_meter_provider(_Provider())
+    # the OTel API's global provider is set-once per process; patch the
+    # meter lookup instead so this test is order-independent
+    monkeypatch.setattr(
+        otel_metrics, "get_meter", lambda name: _Meter(name)
+    )
     try:
         assert tele.register_metrics(monitor) is True
         assert set(registered) == {
@@ -360,7 +360,6 @@ def test_telemetry_gauges_with_in_memory_provider():
         assert lat[0].attributes == {"operator": "groupby#1"}
         assert lat[0].value == pytest.approx(30.0, rel=0.01)  # (20+40)ms / 2 flushes
     finally:
-        # restore so other tests see the default provider
-        otel_metrics._internal._METER_PROVIDER = old_provider  # noqa: SLF001
-        tele2 = Telemetry()
-        assert tele2.register_metrics(None) is True  # API no-op path
+        pass
+    tele2 = Telemetry()
+    assert tele2.register_metrics(None) is True  # API no-op path
