@@ -28,6 +28,7 @@ flushing a node's input ports in ascending port order.
 from __future__ import annotations
 
 import asyncio
+import itertools
 from collections import Counter, defaultdict
 from typing import Any, Callable, Iterable, Sequence
 
@@ -348,7 +349,9 @@ class GroupByNode(Node):
         self.sort_by_fn = sort_by_fn
         # group_frozen -> {frozen_args: [count, raw_args, key, sort_key, seq]}
         self.state: dict[tuple, dict] = defaultdict(dict)
-        self._seq = 0
+        # C-level counter: slot creation happens from pool threads in the
+        # sharded columnar ingest, and `self._seq += 1` would race
+        self._seq = itertools.count(1)
         self.group_raw: dict[tuple, tuple] = {}
         self.group_instance: dict[tuple, Any] = {}
         self.last_out: dict[tuple, Entry] = {}
@@ -367,15 +370,67 @@ class GroupByNode(Node):
 
     #: below this batch size numpy conversion overhead beats the win
     VECTOR_MIN_ROWS = 512
+    #: below this batch size per-thread partitioning overhead beats the
+    #: win (PATHWAY_THREADS stateful scaling)
+    PARALLEL_MIN_ROWS = 16_384
 
     def flush(self, time: int) -> list[Entry]:
         entries = self.take(0)
         dirty = None
         if self.vector_spec is not None and len(entries) >= self.VECTOR_MIN_ROWS:
-            dirty = self._ingest_vector(entries)
+            engine = getattr(self, "engine", None)
+            pool = getattr(engine, "host_pool", None)
+            if (
+                pool is not None
+                and getattr(engine, "shard_stateful", False)
+                and len(entries) >= self.PARALLEL_MIN_ROWS
+            ):
+                dirty = self._ingest_vector_parallel(entries, pool)
+            if dirty is None:
+                dirty = self._ingest_vector(entries)
         if dirty is None:
             dirty = self._ingest_rows(entries)
         return self._emit(dirty)
+
+    def _ingest_vector_parallel(self, entries: list[Entry], pool) -> set | None:
+        """PATHWAY_THREADS scaling for the stateful hot path (reference:
+        timely worker threads, src/engine/dataflow/config.rs:63-70):
+        shard the batch by a hash of its FIRST grouping column so each
+        thread owns a disjoint set of groups — disjoint ``state``/
+        ``red_state``/``group_raw`` keys, so no locks — and run the
+        columnar ingest per shard.  The np.unique/argsort inside release
+        the GIL, so shards overlap on multi-core hosts.  Seq numbers are
+        allocated per shard (seq-order-sensitive reducers are excluded
+        from the vector gate).  Returns None to fall back when the batch
+        cannot be sharded at all (object dtype / ndarray cells)."""
+        group_slots, _arg_slots = self.vector_spec
+        if not group_slots:
+            return None  # global reduce: one group — nothing to shard
+        import pandas as pd
+
+        threads = self.engine.threads
+        s0 = group_slots[0]
+        col0 = np.asarray([e[1][s0] for e in entries])
+        if col0.dtype == object or col0.ndim != 1:
+            return None
+        if col0.dtype.kind == "f":
+            # bitwise hashing must not split -0.0 / 0.0 (equal dict keys)
+            # across shards — same normalization as _ingest_vector
+            col0 = col0 + 0.0
+        owners = pd.util.hash_array(col0) % threads
+        shards: list[list[Entry]] = [[] for _ in range(threads)]
+        for e, o in zip(entries, owners.tolist()):
+            shards[o].append(e)
+        results = list(pool.map(self._ingest_vector, shards))
+        dirty: set = set()
+        for i, r in enumerate(results):
+            if r is None:
+                # this shard's batch was columnar-unsafe (NaN/mixed):
+                # none of its rows were ingested — replay it on the row
+                # path (state keys stay disjoint per shard)
+                r = self._ingest_rows(shards[i])
+            dirty |= r
+        return dirty
 
     def _ingest_rows(self, entries: list[Entry]) -> set:
         dirty: set[tuple] = set()
@@ -390,8 +445,9 @@ class GroupByNode(Node):
             slot = self.state[gfrozen].get(afrozen)
             if slot is None:
                 sort_key = self.sort_by_fn(key, row) if self.sort_by_fn else None
-                self._seq += 1
-                slot = self.state[gfrozen][afrozen] = [0, args, key, sort_key, self._seq]
+                slot = self.state[gfrozen][afrozen] = [
+                    0, args, key, sort_key, next(self._seq)
+                ]
             slot[0] += diff
             if slot[0] == 0:
                 del self.state[gfrozen][afrozen]
@@ -495,8 +551,9 @@ class GroupByNode(Node):
             bucket = state[gfrozen]
             slot = bucket.get(afrozen)
             if slot is None:
-                self._seq += 1
-                slot = bucket[afrozen] = [0, args, entries[i][0], None, self._seq]
+                slot = bucket[afrozen] = [
+                    0, args, entries[i][0], None, next(self._seq)
+                ]
             slot[0] += d
             if slot[0] == 0:
                 del bucket[afrozen]
@@ -1164,14 +1221,23 @@ class Engine:
         #: dispatch, tokenizers, zlib) release the GIL and scale.
         self.threads: int = 1
         self.host_pool = None
+        self.shard_stateful = False
 
     def set_threads(self, threads: int) -> None:
         if threads > 1 and self.host_pool is None:
+            import os as _os
             from concurrent.futures import ThreadPoolExecutor
 
             self.threads = threads
             self.host_pool = ThreadPoolExecutor(
                 max_workers=threads, thread_name_prefix="pw-worker"
+            )
+            #: shard stateful columnar ingest across the pool only where
+            #: threads can actually overlap (numpy releases the GIL, but
+            #: a single core just pays the partitioning tax)
+            self.shard_stateful = (
+                (_os.cpu_count() or 1) > 1
+                or _os.environ.get("PATHWAY_FORCE_THREAD_SHARDS") == "1"
             )
 
     def add(self, node: Node) -> Node:

@@ -78,3 +78,49 @@ def test_threads_scale_gil_releasing_work(threads4):
         f"{elapsed:.3f}s vs serial floor {serial_floor:.3f}s — "
         "pool did not parallelize"
     )
+
+
+def test_groupby_sharded_columnar_ingest_matches_serial(monkeypatch):
+    """PATHWAY_THREADS stateful scaling: a big columnar batch is sharded
+    by group hash across the host pool (threads own disjoint groups, no
+    locks).  Output must be identical to the single-thread path."""
+    import collections
+
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    # this container has one core; force the sharded path so its
+    # correctness is pinned regardless of host size
+    monkeypatch.setenv("PATHWAY_FORCE_THREAD_SHARDS", "1")
+    from pathway_tpu.internals.config import get_pathway_config
+
+    get_pathway_config(refresh=True)
+    from pathway_tpu.internals.engine import GroupByNode
+
+
+    n = max(GroupByNode.PARALLEL_MIN_ROWS * 2, 40_000)
+    lines = ["    w | x | __time__ | __diff__"]
+    for i in range(n):
+        lines.append(f"    k{i % 97} | {i} | 2 | 1")
+    lines.append("    k0 | 0 | 4 | -1")
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    r = t.groupby(t.w).reduce(
+        t.w, c=pw.reducers.count(), s=pw.reducers.sum(t.x),
+        mn=pw.reducers.min(t.x), mx=pw.reducers.max(t.x),
+    )
+    try:
+        (out,) = pw.debug.materialize(r)
+    finally:
+        # the config cache outlives monkeypatch's env restore — refresh
+        # so later tests don't inherit a 4-thread engine (same pattern
+        # as the threads4 fixture)
+        monkeypatch.delenv("PATHWAY_THREADS")
+        monkeypatch.delenv("PATHWAY_FORCE_THREAD_SHARDS")
+        get_pathway_config(refresh=True)
+    got = {row[0]: row[1:] for row in out.current.values()}
+
+    vals = collections.defaultdict(list)
+    for i in range(n):
+        vals[f"k{i % 97}"].append(i)
+    vals["k0"].remove(0)
+    assert len(got) == 97
+    for k, v in vals.items():
+        assert got[k] == (len(v), sum(v), min(v), max(v)), k
