@@ -1,0 +1,23 @@
+"""Shared example bootstrap: make the repo importable and pin JAX to CPU
+when requested.
+
+The pinning is subtle enough to centralize: setting ``JAX_PLATFORMS=cpu``
+in the environment is NOT sufficient under a TPU shim that prepends its
+platform after env parsing — ``jax.config.update`` after import is the
+only reliable pin (see tests/conftest.py)."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+
+
+def setup(file: str) -> None:
+    repo_root = pathlib.Path(file).resolve().parent.parent.parent
+    sys.path.insert(0, str(repo_root))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,58 @@
+"""LSH k-NN classification example: train on a labeled table, classify a
+live query table, report accuracy — the reference's MNIST classifier
+flow (stdlib/ml showcase) on an offline synthetic dataset.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python examples/classifier/run.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from _bootstrap import setup  # noqa: E402
+
+setup(__file__)
+
+import pathway_tpu as pw  # noqa: E402
+from pathway_tpu.stdlib.ml.classifiers import (  # noqa: E402
+    knn_lsh_classifier_train,
+    knn_lsh_classify,
+)
+from pathway_tpu.stdlib.ml.datasets.classification import (  # noqa: E402
+    load_synthetic_sample,
+)
+from pathway_tpu.stdlib.ml.utils import classifier_accuracy  # noqa: E402
+
+
+def main() -> int:
+    d = 16
+    X_train, y_train, X_test, y_test = load_synthetic_sample(
+        sample_size=280, d=d, n_classes=4
+    )
+    model = knn_lsh_classifier_train(
+        X_train, L=10, type="euclidean", d=d, M=6, A=3.0
+    )
+    predictions = knn_lsh_classify(model, y_train, X_test, k=5)
+    acc = classifier_accuracy(predictions, y_test)
+
+    counts: dict = {}
+    pw.io.subscribe(
+        acc,
+        on_change=lambda k, row, t, add: counts.__setitem__(row["value"], row["cnt"])
+        if add
+        else None,
+    )
+    pw.run()
+    good, bad = counts.get(True, 0), counts.get(False, 0)
+    total = good + bad
+    print(f"accuracy: {good}/{total} = {good / total:.2f}")
+    assert good / total >= 0.9, counts
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

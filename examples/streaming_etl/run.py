@@ -1,0 +1,96 @@
+"""Streaming ETL example: live sensor events -> windowed aggregates,
+SQL top-k, and threshold alerts — all maintained incrementally.
+
+reference shape: the reference's examples/projects streaming ETL demos
+(windowed aggregation + alerting over a live source); everything here
+runs offline via pw.demo streams.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python examples/streaming_etl/run.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from _bootstrap import setup  # noqa: E402
+
+setup(__file__)
+
+import pathway_tpu as pw  # noqa: E402
+
+
+def main() -> int:
+    n_rows = 120
+
+    # live source: sensor readings (id, value) arriving over time
+    readings = pw.demo.generate_custom_stream(
+        {
+            "sensor": lambda i: f"s{i % 4}",
+            "t": lambda i: i,
+            "value": lambda i: float((i * 37) % 100),
+        },
+        schema=pw.schema_from_types(sensor=str, t=int, value=float),
+        nb_rows=n_rows,
+        input_rate=0,
+    )
+
+    # 1) tumbling-window aggregates per sensor (temporal stdlib)
+    windowed = readings.windowby(
+        readings.t,
+        window=pw.temporal.tumbling(duration=30),
+        instance=readings.sensor,
+    ).reduce(
+        sensor=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        avg=pw.reducers.avg(pw.this.value),
+        peak=pw.reducers.max(pw.this.value),
+        n=pw.reducers.count(),
+    )
+
+    # 2) maintained top-k via SQL over the live aggregate table
+    hottest = pw.sql(
+        "SELECT sensor, start, peak FROM w ORDER BY peak DESC LIMIT 3",
+        w=windowed,
+    )
+
+    # 3) alerting: windows whose peak crosses the threshold
+    alerts = windowed.filter(windowed.peak >= 95.0).select(
+        windowed.sensor, windowed.start, windowed.peak
+    )
+
+    win_rows: dict = {}
+    top_rows: dict = {}
+    alert_log: list = []
+    pw.io.subscribe(
+        windowed,
+        on_change=lambda k, row, t, add: win_rows.__setitem__(k, row)
+        if add
+        else win_rows.pop(k, None),
+    )
+    pw.io.subscribe(
+        hottest,
+        on_change=lambda k, row, t, add: top_rows.__setitem__(k, row)
+        if add
+        else top_rows.pop(k, None),
+    )
+    pw.io.subscribe(
+        alerts,
+        on_change=lambda k, row, t, add: alert_log.append(row) if add else None,
+    )
+    pw.run()
+
+    print(f"windows maintained: {len(win_rows)}")
+    for row in sorted(top_rows.values(), key=lambda r: -r["peak"]):
+        print(f"top: sensor={row['sensor']} window_start={row['start']} peak={row['peak']}")
+    print(f"alerts fired: {len(alert_log)}")
+    assert len(top_rows) == 3
+    assert all(r["peak"] >= 95.0 for r in alert_log)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

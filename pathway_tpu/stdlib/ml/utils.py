@@ -15,6 +15,12 @@ def classifier_accuracy(predicted_labels, exact_labels):
     (reference: ml/utils.py:13)."""
     import pathway_tpu as pw
 
+    # the reference promises the subset up front (ml/utils.py:14) — the
+    # predictions' universe is derived from the queries, which share keys
+    # with the labels table
+    predicted_labels = predicted_labels.promise_universe_is_subset_of(
+        exact_labels
+    )
     comparative = predicted_labels.select(
         predicted_label=predicted_labels.predicted_label,
         label=exact_labels.restrict(predicted_labels).label,

@@ -363,3 +363,19 @@ def test_telemetry_gauges_with_in_memory_provider(monkeypatch):
         pass
     tele2 = Telemetry()
     assert tele2.register_metrics(None) is True  # API no-op path
+
+
+@pytest.mark.parametrize(
+    "script", ["examples/streaming_etl/run.py", "examples/classifier/run.py"]
+)
+def test_example_apps_run(script):
+    import pathlib
+    import subprocess
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(repo / script)],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
