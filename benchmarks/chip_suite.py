@@ -31,15 +31,19 @@ REPO = os.path.dirname(HERE)
 
 
 def _run_child(args: list[str], timeout: float) -> list[dict]:
+    stderr = ""
+    rc: int | None = None
     try:
+        # -u: children that os._exit() would otherwise drop their final
+        # block-buffered line into the capture pipe
         proc = subprocess.run(
-            [sys.executable, *args],
+            [sys.executable, "-u", *args],
             capture_output=True,
             text=True,
             timeout=timeout,
             cwd=REPO,
         )
-        stdout = proc.stdout
+        stdout, stderr, rc = proc.stdout, proc.stderr, proc.returncode
     except subprocess.TimeoutExpired as exc:
         stdout = exc.stdout
         if isinstance(stdout, bytes):
@@ -50,10 +54,15 @@ def _run_child(args: list[str], timeout: float) -> list[dict]:
             results.append(json.loads(line))
         except json.JSONDecodeError:
             continue
+    if not results and rc not in (None, 0):
+        # a crashed child must be distinguishable from "ran, no output"
+        results.append(
+            {"error": f"child {args[-1]} rc={rc}: {(stderr or '')[-300:]}"}
+        )
     return results
 
 
-def probe_chip(timeout: float = 90.0) -> str | None:
+def probe_chip(timeout: float = 90.0) -> dict | None:
     """Device platform via a killable child (the tunnel can hang)."""
     out = _run_child(
         [
@@ -76,10 +85,11 @@ def main() -> int:
     budget = float(os.environ.get("BENCH_CHIP_BUDGET_S", "900"))
     deadline = time.monotonic() + budget
     dev = probe_chip()
-    if not dev:
+    if not dev or "error" in dev:
         print(
             json.dumps(
-                {"error": "device probe hung — chip tunnel down; nothing run"}
+                dev
+                or {"error": "device probe hung — chip tunnel down; nothing run"}
             )
         )
         return 1
@@ -96,7 +106,7 @@ def main() -> int:
             [os.path.join(HERE, "knn_crossover.py"), str(n)],
             min(left, 420.0),
         )
-        results["knn"].extend(out)
+        results["knn"].extend(r for r in out if "error" not in r)
         for r in out:
             print(json.dumps(r), flush=True)
     left = deadline - time.monotonic()
@@ -105,13 +115,16 @@ def main() -> int:
             [os.path.join(HERE, "streaming_ingest.py")], min(left, 300.0)
         )
         if out:
-            results["ingest"] = out[-1]
+            if "error" not in out[-1]:
+                results["ingest"] = out[-1]
             print(json.dumps(out[-1]), flush=True)
 
     if results["knn"]:
         _append_md(results)
         print(json.dumps({"appended": "benchmarks/KNN_CROSSOVER.md"}))
-    return 0
+        return 0
+    print(json.dumps({"error": "no measurements succeeded"}))
+    return 1
 
 
 def _append_md(results: dict) -> None:
