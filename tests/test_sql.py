@@ -419,3 +419,38 @@ def test_sql_aggregate_inside_case_condition():
     )
     (out,) = pw.debug.materialize(r)
     assert list(out.current.values()) == [(1,)]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sql_topk_random_churn_oracle(seed):
+    """Randomized diff streams through ORDER BY/LIMIT: the maintained
+    top-k must equal a batch recompute over the surviving rows."""
+    import random
+
+    rng = random.Random(seed)
+    alive: dict[int, tuple[str, int]] = {}
+    lines = ["      | name | score | __time__ | __diff__"]
+    next_id = 0
+    for step in range(2, 14, 2):
+        for _ in range(rng.randint(1, 6)):
+            if alive and rng.random() < 0.35:
+                rid = rng.choice(list(alive))
+                name, score = alive.pop(rid)
+                lines.append(f"    {rid} | {name} | {score} | {step} | -1")
+            else:
+                next_id += 1
+                name = f"n{next_id}"
+                score = rng.randint(0, 100)
+                alive[next_id] = (name, score)
+                lines.append(f"    {next_id} | {name} | {score} | {step} | 1")
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    r = pw.sql(
+        "SELECT name, score FROM t ORDER BY score DESC, name ASC LIMIT 4",
+        t=t,
+    )
+    (out,) = pw.debug.materialize(r)
+    got = sorted(out.current.values())
+    expected = sorted(
+        sorted(alive.values(), key=lambda p: (-p[1], p[0]))[:4]
+    )
+    assert got == expected, (seed, got, expected)
