@@ -74,6 +74,10 @@ def child_device(seconds: float = 10.0) -> None:
         # the TPU shim prepends its platform after env parsing; pinning the
         # config is the only reliable way to stay on CPU (see tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
+    from pathway_tpu.utils.compile_cache import enable_compile_cache
+
+    # persistent cache: a chip window never pays the same compile twice
+    enable_compile_cache()
     dev = jax.devices()[0]
     from pathway_tpu.models.encoder import (
         EncoderConfig,

@@ -1,0 +1,173 @@
+"""Session-long TPU chip-window watcher (VERDICT r3 #1b).
+
+The tunneled chip has been down for whole sessions at a time; waiting
+and manually probing three times a round has eaten three rounds.  This
+watcher engineers around the flakiness: it probes chip liveness in a
+cheap killable child every ``--interval`` seconds, forever, and the
+moment a probe succeeds it fires the full chip measurement stack:
+
+  1. ``bench.py`` (full run: torch baseline + chip child with the
+     persistent compile cache) → one JSON line appended to
+     ``benchmarks/chip_results.jsonl``;
+  2. ``benchmarks/chip_suite.py`` → measured rows appended to
+     ``benchmarks/KNN_CROSSOVER.md``.
+
+It keeps watching until BOTH have succeeded at least once (a window may
+close mid-run; partial salvage lines still count as progress), then
+exits 0.  All activity is logged with timestamps to
+``benchmarks/chip_watch.log``.
+
+Usage::
+
+    nohup python benchmarks/chip_watch.py &          # run all session
+    python benchmarks/chip_watch.py --once           # single probe+run
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+LOG = os.path.join(HERE, "chip_watch.log")
+RESULTS = os.path.join(HERE, "chip_results.jsonl")
+
+PROBE_TIMEOUT = 75.0
+PROBE_SRC = (
+    "import json, time, jax; "
+    "from pathway_tpu.utils.compile_cache import enable_compile_cache; "
+    "enable_compile_cache(); "
+    "t0 = time.time(); d = jax.devices()[0]; "
+    "import jax.numpy as jnp; "
+    "x = jnp.ones((128, 128), dtype=jnp.bfloat16); "
+    "(x @ x).block_until_ready(); "
+    "print(json.dumps({'platform': d.platform, "
+    "'kind': getattr(d, 'device_kind', str(d)), "
+    "'secs': round(time.time() - t0, 1)}))"
+)
+
+
+def _log(msg: str) -> None:
+    line = f"{time.strftime('%H:%M:%S')} {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe() -> dict | None:
+    """Liveness = device listing AND a real matmul, inside a killable child."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if out.get("platform") and out["platform"] != "cpu":
+            return out
+    return None
+
+
+def _run(args: list[str], timeout: float, env: dict | None = None) -> tuple[int | None, str]:
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", *args],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=REPO,
+            env=child_env,
+        )
+        return proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as exc:
+        out = exc.stdout
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        return None, out or ""
+
+
+def fire_bench() -> bool:
+    """Full bench.py run; success = a line with platform 'tpu'."""
+    _log("chip LIVE — running bench.py (budget 900s)")
+    rc, out = _run(
+        [os.path.join(REPO, "bench.py")], 960.0, {"BENCH_BUDGET_S": "900"}
+    )
+    ok = False
+    for line in out.strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        with open(RESULTS, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        if rec.get("platform") == "tpu" and rec.get("value"):
+            ok = True
+            _log(f"bench.py TPU result: {json.dumps(rec)}")
+    if not ok:
+        _log(f"bench.py did not produce a tpu line (rc={rc}): {out[-300:]!r}")
+    return ok
+
+
+def fire_suite() -> bool:
+    _log("running chip_suite.py (budget 900s)")
+    rc, out = _run(
+        [os.path.join(HERE, "chip_suite.py")],
+        960.0,
+        {"BENCH_CHIP_BUDGET_S": "900"},
+    )
+    _log(f"chip_suite rc={rc} tail: {out[-400:]!r}")
+    return rc == 0
+
+
+def main() -> int:
+    interval = 120.0
+    once = "--once" in sys.argv
+    for a in sys.argv[1:]:
+        if a.startswith("--interval="):
+            interval = float(a.split("=", 1)[1])
+    deadline = time.monotonic() + float(
+        os.environ.get("CHIP_WATCH_BUDGET_S", str(11 * 3600))
+    )
+    bench_done = suite_done = False
+    _log(f"watcher start (interval {interval:.0f}s, once={once})")
+    n = 0
+    while time.monotonic() < deadline:
+        n += 1
+        dev = probe()
+        if dev:
+            _log(f"probe #{n}: LIVE {json.dumps(dev)}")
+            if not bench_done:
+                bench_done = fire_bench()
+            if not suite_done:
+                suite_done = fire_suite()
+            if bench_done and suite_done:
+                _log("both bench.py and chip_suite.py succeeded — done")
+                return 0
+        else:
+            if n % 10 == 1:
+                _log(f"probe #{n}: chip down")
+        if once:
+            return 0 if dev else 1
+        time.sleep(interval)
+    _log("watch budget exhausted")
+    return 0 if (bench_done or suite_done) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,10 @@ if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
 def run(n: int, dim: int = 384, n_queries: int = 64, k: int = 10) -> dict:
     import jax
 
+    from pathway_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
     from pathway_tpu.ops.knn import DeviceKnnIndex
     from pathway_tpu.stdlib.indexing.retrievers import LshKnnIndex
 
