@@ -34,7 +34,7 @@ from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
 
-from .keys import ref_scalar
+from .keys import derive_subkey, ref_scalar
 from .value import ERROR, Json, Pointer
 
 __all__ = [
@@ -410,10 +410,18 @@ class GroupByNode(Node):
 
         threads = self.engine.threads
         s0 = group_slots[0]
-        col0 = np.asarray([e[1][s0] for e in entries])
+        vals0 = [e[1][s0] for e in entries]
+        col0 = np.asarray(vals0)
         if col0.dtype == object or col0.ndim != 1:
             return None
         if col0.dtype.kind == "f":
+            from .evaluator import _float_col_exact
+
+            if not _float_col_exact(col0, vals0):
+                # same guard as _ingest_vector: huge int-sourced values
+                # collapse to identical floats under coercion; don't even
+                # shard on a lossy identity
+                return None
             # bitwise hashing must not split -0.0 / 0.0 (equal dict keys)
             # across shards — same normalization as _ingest_vector
             col0 = col0 + 0.0
@@ -501,6 +509,16 @@ class GroupByNode(Node):
                 if np.isnan(arr).any():
                     # dict identity for NaN is per-object; np.unique would
                     # merge them — keep row-path semantics
+                    return None
+                from .evaluator import _float_col_exact
+
+                if not _float_col_exact(arr, vals):
+                    # float64 coerced from huge Python ints (e.g. an INT
+                    # column mixing 2**63 with smaller numerics): distinct
+                    # ints beyond 2**53 become byte-identical floats, so
+                    # np.unique would merge groups the row path keeps
+                    # distinct — silent wrong aggregates.  The "numeric
+                    # mixes are safe" reasoning only holds within float53
                     return None
                 # byte-wise rec-array identity must not split -0.0 / 0.0
                 # (Python dict keys treat them equal)
@@ -788,7 +806,7 @@ class JoinNode(Node):
 
 class ConcatNode(Node):
     """Union of inputs (reference: Graph::concat / concat_reindex).
-    ``reindex=True`` derives fresh keys ref(key, port) to keep universes
+    ``reindex=True`` derives fresh keys derive_subkey(key, port) to keep universes
     disjoint."""
 
     def __init__(self, n_inputs: int, reindex: bool = False, name: str = "concat"):
@@ -802,7 +820,7 @@ class ConcatNode(Node):
         for port in range(self.n_inputs):
             for key, row, diff in self.take(port):
                 if self.reindex:
-                    out.append((ref_scalar(key, port), row, diff))
+                    out.append((derive_subkey(key, port), row, diff))
                     continue
                 slot = self._owner.get(key)
                 if slot is None:

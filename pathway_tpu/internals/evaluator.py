@@ -548,18 +548,43 @@ def _materialize_cols(rows, slots, int_slots=()):
 
     cols = {}
     for s in slots:
-        arr = np.asarray([r[s] for r in rows])
+        vals = [r[s] for r in rows]
+        arr = np.asarray(vals)
         if arr.dtype == object:
             return None
         if arr.dtype.kind == "b" and s in int_slots:
             arr = arr.astype(np.int64)
-        if arr.dtype.kind == "i" and (
+        if arr.dtype.kind in "iu" and (
             arr.max(initial=0) >= VECTOR_INT_BOUND
             or arr.min(initial=0) <= -VECTOR_INT_BOUND
         ):
+            # kind 'u': a batch of all-huge positive ints coerces to
+            # uint64 and would otherwise bypass the wraparound bound
+            return None
+        if arr.dtype.kind == "f" and not _float_col_exact(arr, vals):
+            # float64 coerced from huge Python ints (declared-INT column
+            # mixing magnitudes, or optional numerics): values beyond
+            # 2**53 already lost precision vs the exact bigint row path
             return None
         cols[s] = arr
     return cols
+
+
+#: largest magnitude exactly representable in float64 — int-sourced
+#: values beyond this lose precision when numpy coerces a mixed batch
+FLOAT_EXACT_BOUND = 1 << 53
+
+
+def _float_col_exact(arr, vals) -> bool:
+    """True iff coercing ``vals`` to the float64 array ``arr`` was
+    value-preserving.  Vectorized precheck: if every magnitude is below
+    2**53 the coercion of any int source was exact; only when huge (or
+    NaN) values are present do we scan source types."""
+    import numpy as np
+
+    if bool((np.abs(arr) < FLOAT_EXACT_BOUND).all()):
+        return True
+    return all(isinstance(v, float) for v in vals)
 
 
 def build_vector_select(exprs, slot_of_ref):

@@ -92,41 +92,58 @@ _MASK128 = (1 << 128) - 1
 #: FNV-128 prime / offset basis
 _FNV128_PRIME = 0x0000000001000000000000000000013B
 _FNV128_BASIS = 0x6C62272E07BB014262B821756295C58D
-#: per-type tags mirror the _serialize tag bytes so Pointer(5) and int 5
-#: cannot collide structurally
-_TAG_INT = 0x2 << 124
 _TAG_PTR = 0x6 << 124
-_INT128_MIN = -(1 << 127)
-_INT128_MAX = 1 << 127
 _AVALANCHE = 0x9E3779B97F4A7C15F39CC0605CEDC835  # odd
 
 
 def _mix128(values: tuple) -> int | None:
-    """Fast non-cryptographic 128-bit key mix for Pointer/int-only tuples
-    — the hot derivation on join/reindex/flatten output paths, where the
-    reference likewise uses non-crypto SipHash (value.rs Key::for_values).
-    Everything else keeps the BLAKE2b path.  Returns None when a value
-    isn't eligible."""
+    """Fast non-cryptographic 128-bit key mix for all-Pointer tuples —
+    the hot derivation on join/reindex output paths.  Pointers are
+    themselves outputs of BLAKE2b (or of this mix over such outputs),
+    i.e. already uniform 128-bit values an adversary cannot choose
+    directly, so an invertible mix over them is collision-safe the same
+    way the reference's SipHash over row keys is (value.rs
+    Key::for_values).  Tuples containing RAW ints (user primary keys,
+    untrusted ingested values) must NOT take this path: every step here
+    is trivially invertible, so attacker-chosen ints could be crafted to
+    collide — those go through keyed-strength BLAKE2b in ref_scalar.
+    Engine-GENERATED ints (flatten indexes, output ports) pair with a
+    Pointer via :func:`derive_subkey` instead.  Returns None when a
+    value isn't an exact Pointer."""
     h = _FNV128_BASIS
     for v in values:
-        t = type(v)
-        if t is Pointer:
-            h ^= v ^ _TAG_PTR  # Pointer subclasses int; already in range
-        elif t is int:
-            if not _INT128_MIN <= v < _INT128_MAX:
-                # out of signed-128 range: the serialize path raises
-                # OverflowError loudly; never wrap into a collision
-                return None
-            h ^= (v & _MASK128) ^ _TAG_INT
-        else:
+        if type(v) is not Pointer:
             return None
+        h ^= v ^ _TAG_PTR  # Pointer subclasses int; already in range
         h = (h * _FNV128_PRIME) & _MASK128
-    # avalanche so low-entropy inputs (small ints) spread into the high
-    # bits that shard_of_key reads
+    # avalanche so the low bits spread into the high bits that
+    # shard_of_key reads
     h ^= h >> 64
     h = (h * _AVALANCHE) & _MASK128
     h ^= h >> 64
     return h
+
+
+_TAG_INT = 0x2 << 124
+
+
+def derive_subkey(key: Pointer, index: int) -> Pointer:
+    """Fast subkey for a row key and an ENGINE-GENERATED small int
+    (flatten element index, output port number — never user data).  The
+    Pointer component is uniform and unforgeable, so the invertible mix
+    stays collision-safe even though the int is attacker-visible: crafting
+    a collision would require choosing the Pointer, i.e. a BLAKE2b
+    preimage.  Keeps flatten/port output keying off the serialize+BLAKE2b
+    slow path (it is per-output-row hot)."""
+    h = _FNV128_BASIS
+    h ^= key ^ _TAG_PTR
+    h = (h * _FNV128_PRIME) & _MASK128
+    h ^= (index & _MASK128) ^ _TAG_INT
+    h = (h * _FNV128_PRIME) & _MASK128
+    h ^= h >> 64
+    h = (h * _AVALANCHE) & _MASK128
+    h ^= h >> 64
+    return Pointer(h)
 
 
 def ref_scalar(*values: Any, optional: bool = False) -> Pointer:

@@ -39,7 +39,7 @@ from .expression import (
 from .graph import G, Operator
 from .groupbys import _GroupColExpression, _ReducerSlotExpression
 from .joins import JoinMode
-from .keys import ref_pointer, ref_scalar
+from .keys import derive_subkey, ref_pointer, ref_scalar
 from .value import Pointer
 
 __all__ = ["GraphRunner", "build_engine"]
@@ -402,7 +402,7 @@ class GraphRunner:
                 new_row[col_idx] = v
                 if origin:
                     new_row.append(key)
-                out.append((ref_scalar(key, i), tuple(new_row), diff))
+                out.append((derive_subkey(key, i), tuple(new_row), diff))
             return out
 
         node = RowwiseNode(fn, name=f"flatten#{op.id}")

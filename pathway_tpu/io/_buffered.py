@@ -23,6 +23,7 @@ from typing import Any, Callable
 
 from ..internals.table import Table
 from ._subscribe import subscribe
+from ._utils import jsonable_row
 
 __all__ = ["BufferedSink", "buffered_subscribe"]
 
@@ -114,7 +115,7 @@ def buffered_subscribe(
     )
 
     def default_doc(key, row: dict, time: int, is_addition: bool) -> dict:
-        doc = dict(row)
+        doc = jsonable_row(row)  # Pointer cells → '^HEX' strings
         doc["time"] = time
         doc["diff"] = 1 if is_addition else -1
         return doc

@@ -14,15 +14,43 @@ from ..internals.graph import Operator
 from ..internals.schema import SchemaMetaclass, schema_from_types
 from ..internals.table import Table
 from ..internals.universe import Universe
-from ..internals.value import Json
+from ..internals.value import Json, Pointer
 
 __all__ = [
     "RawDataSchema",
     "MetadataSchema",
     "coerce_row",
     "input_table",
+    "jsonable_cell",
+    "jsonable_row",
     "with_metadata_schema",
 ]
+
+
+def jsonable_cell(v: Any) -> Any:
+    """JSON-safe cell conversion for sink payloads.
+
+    :class:`Pointer` subclasses ``int``, so ``json.dumps`` would emit
+    pointer cells as bare 128-bit JSON integers — a silent format change
+    from the ``^HEX`` strings and unparseable for consumers that read
+    JSON numbers as float64 (JS, BigQuery).  Convert explicitly before
+    the encoder's int branch ever sees them (a ``default=`` hook never
+    fires for int subclasses)."""
+    if isinstance(v, Pointer):
+        return str(v)
+    if isinstance(v, (tuple, list)):
+        return [jsonable_cell(x) for x in v]
+    if isinstance(v, Json):
+        return jsonable_cell(v.value)
+    if isinstance(v, dict):
+        return {k: jsonable_cell(x) for k, x in v.items()}
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    return v
+
+
+def jsonable_row(row: dict) -> dict:
+    return {n: jsonable_cell(v) for n, v in row.items()}
 
 RawDataSchema = schema_from_types(data=bytes)
 PlaintextDataSchema = schema_from_types(data=str)

@@ -16,7 +16,7 @@ from typing import Any, Callable, Sequence
 from ...internals.schema import SchemaMetaclass, schema_from_types
 from ...internals.table import Table
 from .._subscribe import subscribe
-from .._utils import coerce_row, input_table
+from .._utils import coerce_row, input_table, jsonable_row
 from ...internals.keys import ref_scalar
 from ..streaming import ConnectorSubject, next_autogen_key
 
@@ -112,7 +112,7 @@ def write(
     send_headers = {"Content-Type": "application/json", **(headers or {})}
 
     def on_change(key, row: dict, time: int, is_addition: bool) -> None:
-        payload = dict(row)
+        payload = jsonable_row(row)
         payload["time"] = time
         payload["diff"] = 1 if is_addition else -1
         if request_payload_template is not None:

@@ -11,9 +11,9 @@ from pathlib import Path
 from typing import Any
 
 from ...internals.schema import SchemaMetaclass
-from ...internals.value import Json, Pointer
 from ...internals.table import Table
 from .._subscribe import subscribe
+from .._utils import jsonable_cell as _jsonable
 
 __all__ = ["read", "write"]
 
@@ -40,16 +40,6 @@ def read(
     )
 
 
-def _jsonable(v: Any) -> Any:
-    if isinstance(v, Json):
-        return v.value
-    if isinstance(v, Pointer):
-        return str(v)
-    if isinstance(v, bytes):
-        return v.decode(errors="replace")
-    if isinstance(v, tuple):
-        return [_jsonable(x) for x in v]
-    return v
 
 
 def write(table: Table, filename: str | Path) -> None:

@@ -13,7 +13,7 @@ from typing import Any
 from ...internals.schema import SchemaMetaclass, schema_from_types
 from ...internals.table import Table
 from .._subscribe import subscribe
-from .._utils import coerce_row, input_table
+from .._utils import coerce_row, input_table, jsonable_cell
 from ...internals.keys import ref_scalar
 from ..streaming import ConnectorSubject, next_autogen_key
 
@@ -96,7 +96,7 @@ def write(table: Table, uri: str, topic: str, *, format: str = "json", **kwargs)
         return nc_holder[0]
 
     def on_change(key, row: dict, time: int, is_addition: bool) -> None:
-        payload = {n: row[n] for n in names}
+        payload = {n: jsonable_cell(row[n]) for n in names}
         payload["time"] = time
         payload["diff"] = 1 if is_addition else -1
         nc = _ensure_nc()

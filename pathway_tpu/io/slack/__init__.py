@@ -9,6 +9,7 @@ import urllib.request
 
 from ...internals.table import Table
 from .._subscribe import subscribe
+from .._utils import jsonable_row
 
 __all__ = ["send_alerts"]
 
@@ -19,7 +20,10 @@ def send_alerts(alerts: Table, slack_channel_id: str, slack_token: str) -> None:
     def on_change(key, row: dict, time: int, is_addition: bool) -> None:
         if not is_addition:
             return
-        text = str(row[names[0]]) if len(names) == 1 else _json.dumps(row, default=str)
+        if len(names) == 1:
+            text = str(row[names[0]])
+        else:
+            text = _json.dumps(jsonable_row(row), default=str)
         req = urllib.request.Request(
             "https://slack.com/api/chat.postMessage",
             data=_json.dumps({"channel": slack_channel_id, "text": text}).encode(),

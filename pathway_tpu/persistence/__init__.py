@@ -346,12 +346,49 @@ _active_stack: list["Config"] = []
 _active_lock = threading.Lock()
 
 
+#: on-disk format version.  Bump whenever key derivation or the snapshot
+#: layout changes incompatibly — replaying a snapshot whose row keys were
+#: derived by an older scheme against freshly-derived keys silently
+#: duplicates rows instead of replacing them.  History: 1 = rounds 1-3
+#: (FNV fast mix covered raw-int tuples); 2 = round 4 (raw-int tuples
+#: route through BLAKE2b, ADVICE r3).
+FORMAT_VERSION = 2
+_FORMAT_KEY = "format/version"
+
+
+def check_format_version(storage: "KVStorage") -> None:
+    """Stamp a fresh store with the current format version; refuse a store
+    written by an incompatible one (reference: persistence metadata
+    version gate, persistence/state.rs:35)."""
+    raw = storage.get(_FORMAT_KEY)
+    if raw is None:
+        if storage.list_keys("snap/") or storage.list_keys("opstate/"):
+            raise RuntimeError(
+                "persistent storage holds snapshots written before format "
+                f"versioning (current version {FORMAT_VERSION}); their row "
+                "keys are incompatible with this build's key derivation — "
+                "resuming would silently duplicate rows. Clear the storage "
+                "location (or point persistence at a fresh one) and rerun."
+            )
+        storage.put(_FORMAT_KEY, str(FORMAT_VERSION).encode())
+        return
+    found = int(raw.decode())
+    if found != FORMAT_VERSION:
+        raise RuntimeError(
+            f"persistent storage format version {found} does not match "
+            f"this build's version {FORMAT_VERSION} — snapshot row keys "
+            "are incompatible. Clear the storage location (or point "
+            "persistence at a fresh one) and rerun."
+        )
+
+
 def activate(config: "Config | None") -> None:
     """Push a run's config; ``deactivate`` removes exactly that config, so a
     run ending never clears a concurrently-running server's config (runs can
     overlap when servers run on threads — the top of the stack wins while
     they do)."""
     if config is not None:
+        check_format_version(config.backend.storage)
         with _active_lock:
             _active_stack.append(config)
 

@@ -181,3 +181,44 @@ def test_pubsub_sink_publishes_batch():
     pw.run()
     assert len(pub.messages) == 2
     assert all(p == "projects/proj/topics/topic" for p, _ in pub.messages)
+
+
+def test_pointer_cells_serialize_as_hex_strings():
+    """ADVICE r3: Pointer subclasses int, so json.dumps emits pointer
+    cells as bare 128-bit integers (unparseable as float64 JSON numbers)
+    unless sinks convert them first."""
+    import json
+
+    from pathway_tpu.internals.value import Pointer
+    from pathway_tpu.io._utils import jsonable_cell, jsonable_row
+
+    p = Pointer(2**100 + 17)
+    row = {"id": p, "nested": (p, 1), "x": 3}
+    doc = jsonable_row(row)
+    assert doc["id"] == str(p) and doc["id"].startswith("^")
+    assert doc["nested"][0] == str(p)
+    # round-trips through JSON without a default= hook
+    assert json.loads(json.dumps(doc))["id"] == f"^{p.value:032X}"
+    assert jsonable_cell([p]) == [str(p)]
+
+
+def test_buffered_subscribe_default_doc_converts_pointers():
+    import json
+
+    pw.internals.graph.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        a | __time__
+        1 | 2
+        2 | 2
+        """
+    )
+    t2 = t.select(t.a, ref=t.id)
+    batches = []
+    buffered_subscribe(t2, batches.append, name="capture", max_batch=16)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    docs = [d for b in batches for d in b]
+    assert len(docs) == 2
+    for d in docs:
+        assert isinstance(d["ref"], str) and d["ref"].startswith("^")
+        json.dumps(d)  # JSON-safe without default=

@@ -107,3 +107,32 @@ def test_tokenize_deterministic_same_word_same_id():
     assert ids[0] != ids[1]
     # case-insensitive by default
     assert tok.tokenize("Apple") == tok.tokenize("apple")
+
+
+def test_raw_int_keys_take_blake2b_not_fnv():
+    """ADVICE r3 (medium): the invertible FNV mix is collision-craftable
+    for attacker-chosen ints; raw-int tuples must route through BLAKE2b.
+    Only all-Pointer tuples (values already uniform 128-bit hashes) may
+    take the fast mix."""
+    import hashlib
+
+    from pathway_tpu.internals.keys import _mix128, _serialize, ref_scalar
+    from pathway_tpu.internals.value import Pointer
+
+    # int-containing tuples are ineligible for the fast mix
+    assert _mix128((5,)) is None
+    assert _mix128((Pointer(5), 7)) is None
+    # and ref_scalar over ints equals the BLAKE2b serialize path
+    out = bytearray()
+    for v in (42, -7):
+        _serialize(v, out)
+    expect = int.from_bytes(
+        hashlib.blake2b(bytes(out), digest_size=16).digest(), "little"
+    )
+    assert ref_scalar(42, -7).value == expect
+
+    # all-Pointer tuples still take the fast mix (and stay stable)
+    p = (Pointer(123456789), Pointer(987654321))
+    assert _mix128(p) is not None
+    assert ref_scalar(*p) == ref_scalar(*p)
+    assert ref_scalar(*p) != ref_scalar(p[1], p[0])

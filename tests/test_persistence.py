@@ -432,3 +432,30 @@ def test_azure_input_snapshot_roundtrip(tmp_path):
     replayed = [e for batch in r.replay() for e in batch]
     assert [row for _k, row, _d in replayed] == [("a",), ("b",)]
     assert r.last_offsets() == {"k": 2}
+
+
+def test_format_version_gate():
+    """Key derivation changed in round 4 (raw-int tuples → BLAKE2b); a
+    snapshot written under the old scheme must be refused loudly, not
+    replayed into silent duplicate rows."""
+    import pytest
+
+    from pathway_tpu import persistence as P
+
+    # fresh store: stamped with the current version, idempotent
+    kv = P.MemoryKV()
+    P.check_format_version(kv)
+    assert kv.get("format/version") == str(P.FORMAT_VERSION).encode()
+    P.check_format_version(kv)  # same version passes again
+
+    # store from an older build (explicit version marker)
+    kv2 = P.MemoryKV()
+    kv2.put("format/version", b"1")
+    with pytest.raises(RuntimeError, match="format version 1"):
+        P.check_format_version(kv2)
+
+    # legacy store with snapshots but no marker at all
+    kv3 = P.MemoryKV()
+    kv3.put("snap/src/chunk-00000000", b"x")
+    with pytest.raises(RuntimeError, match="before format versioning"):
+        P.check_format_version(kv3)

@@ -173,3 +173,34 @@ def test_declared_int_column_of_bools_vectorizes_numerically():
     got = sorted(out.current.values())
     exp = sorted((v + v, -v) for (v,) in rows)
     assert got == exp
+
+
+def test_huge_int_batches_fall_back_to_exact_row_path():
+    """ADVICE r3: a batch of all-huge ints coerces to uint64 (kind 'u')
+    or float64 and previously bypassed VECTOR_INT_BOUND — vectorized
+    arithmetic would wrap mod 2**64 or round, diverging from the exact
+    bigint row path."""
+    import pathway_tpu as pw
+
+    # three coercion shapes: all-huge positive → uint64 (kind 'u');
+    # huge + small mix → float64 (kind 'f'); sub-2**63 huge → int64
+    # above VECTOR_INT_BOUND (kind 'i')
+    for base, small in ((2**63, None), (2**63, 1), (2**62, 1)):
+        pw.internals.graph.G.clear()
+        rows = "\n".join(
+            ["    v | w | __time__"]
+            + [f"    {base + i} | {i % 13} | 2" for i in range(600)]
+            + ([f"    {small} | 5 | 2"] if small is not None else [])
+        )
+        t = pw.debug.table_from_markdown(rows)
+        r = t.select(t.v, a=t.v + 1, b=t.v * 2)
+        runner = GraphRunner()
+        eng = runner.build([(r, OutputNode(name="out"))])
+        eng.run_all()
+        out = [n2 for n2 in eng.nodes if isinstance(n2, OutputNode)][0]
+        got = sorted(tuple(r2) for r2 in out.current.values())
+        expect = sorted(
+            [(base + i, base + i + 1, (base + i) * 2) for i in range(600)]
+            + ([(small, small + 1, small * 2)] if small is not None else [])
+        )
+        assert got == expect

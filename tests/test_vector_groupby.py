@@ -222,3 +222,40 @@ def test_huge_int_keys_raise_not_collide():
         rs(1 << 127)
     with pytest.raises(OverflowError):
         rs((1 << 128) - 1)
+
+
+def test_huge_int_groups_not_merged_by_float_coercion():
+    """ADVICE r3 (high): numpy coerces an INT column mixing ints >= 2**63
+    with smaller numerics to float64, where 2**63 and 2**63 + 1 are
+    byte-identical — np.unique must not merge groups the row path (dict
+    identity) keeps distinct."""
+    vals = [1, 2**63, 2**63 + 1]
+    n = max(4 * VEC, 2000)
+    n -= n % len(vals)  # equal share per group
+    lines = ["    w | __time__ | __diff__"]
+    for i in range(n):
+        lines.append(f"    {vals[i % len(vals)]} | 2 | 1")
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    r = t.groupby(t.w).reduce(t.w, n=pw.reducers.count())
+    (out,) = pw.debug.materialize(r)
+    got = {row[0]: row[1] for row in out.current.values()}
+    assert got == {v: n // len(vals) for v in vals}
+
+
+def test_huge_int_reducer_args_stay_exact():
+    """Same coercion hazard on the reducer-arg identity columns: sums over
+    huge ints must match exact bigint arithmetic, not float64 rounding."""
+    vals = [7, 2**63, 2**63 + 1]
+    n = max(4 * VEC, 2000)
+    n -= n % len(vals)
+    lines = ["    g | x | __time__ | __diff__"]
+    for i in range(n):
+        lines.append(f"    k{i % 2} | {vals[i % len(vals)]} | 2 | 1")
+    t = pw.debug.table_from_markdown("\n".join(lines))
+    r = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.x))
+    (out,) = pw.debug.materialize(r)
+    got = {row[0]: row[1] for row in out.current.values()}
+    expect = {"k0": 0, "k1": 0}
+    for i in range(n):
+        expect[f"k{i % 2}"] += vals[i % len(vals)]
+    assert got == expect
