@@ -85,16 +85,26 @@ def child_device(seconds: float = 10.0) -> None:
         bucketed_dispatch,
     )
 
+    # fused attention (jax.nn.dot_product_attention) keeps the S×S
+    # intermediates out of HBM; numerically equal to the flax chain
+    # (1e-7 fp32, tests/test_models.py) and measured faster on both
+    # backends.  BENCH_ATTN=flax|fused|pallas overrides for A/B runs.
+    attn = os.environ.get("BENCH_ATTN", "fused")
     if os.environ.get("BENCH_CPU_FALLBACK"):
         # bf16 is emulated and pathologically slow on XLA-CPU — fp32 is
         # the honest CPU configuration (same numerics torch uses)
         import jax.numpy as jnp
 
-        enc = SentenceEncoder(max_length=128, cfg=EncoderConfig(dtype=jnp.float32))
+        enc = SentenceEncoder(
+            max_length=128,
+            cfg=EncoderConfig(dtype=jnp.float32, attention_impl=attn),
+        )
         docs = _corpus(256)
         seconds = 6.0
     else:
-        enc = SentenceEncoder(max_length=128)
+        enc = SentenceEncoder(
+            max_length=128, cfg=EncoderConfig(attention_impl=attn)
+        )
         docs = _corpus()
     budget = float(os.environ.get("BENCH_CHILD_BUDGET_S", "240"))
     child_deadline = time.monotonic() + budget
@@ -134,23 +144,41 @@ def child_device(seconds: float = 10.0) -> None:
     # JSON line, so a hang mid-escalation still yields a measurement.
     small = 256
     bucketed_dispatch(fwd, ids_all[:small], mask_all[:small], enc.max_length, vocab_size=vocab)
-    docs_per_sec = _emit_device_result(measure(small), dev)
+    docs_per_sec = _emit_device_result(measure(small), dev, attn)
     big = min(1024, len(docs))
     # conservative escalation cost: a fresh-shape compile over the tunnel
     # has been observed north of 150s
     if big > small and time.monotonic() + 180 + seconds < child_deadline:
         bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
         docs_per_sec = max(docs_per_sec, measure(big))
-        docs_per_sec = _emit_device_result(docs_per_sec, dev)
+        docs_per_sec = _emit_device_result(docs_per_sec, dev, attn)
         # steady chip + budget to spare: take a second same-length sample
         # (keeps the best of the two against scheduler noise)
         if time.monotonic() + 3 * seconds < child_deadline:
             docs_per_sec = max(docs_per_sec, measure(big))
 
-    _emit_device_result(docs_per_sec, dev)
+    _emit_device_result(docs_per_sec, dev, attn)
+
+    # A/B the pallas kernel only after a banked fused measurement and only
+    # on a real chip (interpret mode off-TPU is orders slower) — a hang or
+    # crash here cannot cost the number already printed above
+    if (
+        attn == "fused"
+        and dev.platform == "tpu"
+        and time.monotonic() + 180 + seconds < child_deadline
+    ):
+        enc2 = SentenceEncoder(
+            max_length=128, cfg=EncoderConfig(attention_impl="pallas")
+        )
+        fwd2 = lambda i, m: enc2._apply(enc2.params, i, m)  # noqa: E731
+        fwd = fwd2
+        bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
+        pallas_dps = measure(big)
+        _emit_device_result(max(docs_per_sec, pallas_dps), dev,
+                            "pallas" if pallas_dps > docs_per_sec else attn)
 
 
-def _emit_device_result(docs_per_sec: float, dev) -> float:
+def _emit_device_result(docs_per_sec: float, dev, attn: str = "fused") -> float:
     """Print one result JSON line (the parent keeps the LAST line)."""
     kind = getattr(dev, "device_kind", str(dev))
     peak = None
@@ -167,6 +195,7 @@ def _emit_device_result(docs_per_sec: float, dev) -> float:
                 "device_kind": kind,
                 "flops_per_doc": FLOPS_PER_DOC,
                 "mfu": round(mfu, 4) if mfu is not None else None,
+                "attn_impl": attn,
             }
         ),
         flush=True,
@@ -348,6 +377,7 @@ def main() -> None:
         out["platform"] = result.get("platform")
         out["device_kind"] = result.get("device_kind")
         out["mfu"] = result.get("mfu")
+        out["attn_impl"] = result.get("attn_impl")
         out["vs_baseline"] = (
             round(result["docs_per_sec"] / baseline_dps, 3) if baseline_dps else None
         )

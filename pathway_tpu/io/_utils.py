@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
+
 from ..internals import dtype as dt
 from ..internals.graph import Operator
 from ..internals.schema import SchemaMetaclass, schema_from_types
@@ -46,6 +48,10 @@ def jsonable_cell(v: Any) -> Any:
         return {k: jsonable_cell(x) for k, x in v.items()}
     if isinstance(v, bytes):
         return v.decode(errors="replace")
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.generic):
+        return v.item()
     return v
 
 

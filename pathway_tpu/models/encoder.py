@@ -51,6 +51,49 @@ class EncoderConfig:
     #: with converted HF checkpoints, models/checkpoint.py)
     ln_eps: float = 1e-12
     type_vocab_size: int = 2
+    #: attention kernel: "flax" (flax's unfused einsum chain — the
+    #: golden-parity reference), "fused" (jax.nn.dot_product_attention,
+    #: one XLA custom-call the compiler fuses QK^T→softmax→AV through —
+    #: no S² intermediate round-trips to HBM), or "pallas" (our explicit
+    #: flash-style TPU kernel, ops/flash_attention.py)
+    attention_impl: str = "flax"
+
+
+def _fused_attention_fn(query, key, value, bias=None, mask=None, **_kw):
+    """flax ``attention_fn`` adapter over :func:`jax.nn.dot_product_attention`
+    (VERDICT r3 #2: MFU — keep the S×S attention intermediates out of HBM).
+    flax does not pre-scale the query when a custom fn is supplied;
+    dot_product_attention applies 1/sqrt(head_dim) itself."""
+    return jax.nn.dot_product_attention(query, key, value, bias=bias, mask=mask)
+
+
+def _pallas_attention_fn(query, key, value, bias=None, mask=None, **_kw):
+    """flax ``attention_fn`` adapter over our Pallas flash kernel
+    (ops/flash_attention.py).  The encoder's mask is padding-only
+    ([batch, 1, 1, kv] broadcast), so it reduces to a per-key bool."""
+    from ..ops.flash_attention import flash_attention
+
+    kv_mask = None
+    if mask is not None:
+        if mask.ndim != 4 or mask.shape[-2] != 1:
+            # a causal/pairwise mask varies along q; collapsing it to one
+            # key row would be silently wrong — refuse loudly
+            raise ValueError(
+                "attention_impl='pallas' supports padding-only masks "
+                f"([batch, 1, 1, kv]); got shape {mask.shape}"
+            )
+        # [batch, 1, 1, kv] (or broadcastable) → [batch, kv]
+        kv_mask = jnp.broadcast_to(
+            mask, (query.shape[0], 1, 1, key.shape[1])
+        )[:, 0, 0, :]
+    return flash_attention(query, key, value, kv_mask=kv_mask)
+
+
+_ATTENTION_FNS = {
+    "flax": None,
+    "fused": _fused_attention_fn,
+    "pallas": _pallas_attention_fn,
+}
 
 
 class Block(nn.Module):
@@ -59,11 +102,16 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, mask):
         cfg = self.cfg
+        attn_kwargs = {}
+        fn = _ATTENTION_FNS[cfg.attention_impl]
+        if fn is not None:
+            attn_kwargs["attention_fn"] = fn
         h = nn.MultiHeadDotProductAttention(
             num_heads=cfg.num_heads,
             dtype=cfg.dtype,
             param_dtype=jnp.float32,
             name="attention",
+            **attn_kwargs,
         )(x, x, mask=mask)
         x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln1")(x + h)
         h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlp_in")(x)

@@ -1,0 +1,101 @@
+"""Pallas fused attention for the sentence-encoder geometry.
+
+TPU-native replacement for the HBM-round-tripping attention chain
+(reference runs torch SDPA inside its embedder UDFs,
+xpacks/llm/embedders.py:270; the torch kernel is cuDNN flash attention —
+this is the TPU equivalent for OUR geometry).
+
+Design (see /opt/skills/guides/pallas_guide.md):
+
+* Encoder sequences are short (SEQ_BUCKETS caps at 512), so one
+  (batch, head) tile's whole Q/K/V fits VMEM with room to spare —
+  the kernel computes QK^T → mask → softmax → AV entirely in VMEM and
+  writes only the [seq, head_dim] output to HBM.  No S² intermediate
+  ever touches HBM, which is the entire memory win of "flash" attention;
+  the streaming/online-softmax machinery only pays off when S² outgrows
+  VMEM (seq ≳ 2k), which this encoder never reaches.
+* Softmax accumulates in f32 regardless of input dtype (bf16 on chip).
+* grid = (batch, heads): each program owns one head of one row, so the
+  MXU sees [seq, head_dim] × [head_dim, seq] and [seq, seq] × [seq,
+  head_dim] matmuls back-to-back.  head_dim 32 underfills the 128-lane
+  tile (pallas pads); the matmuls still land on the MXU and the S×S
+  softmax — the part XLA-CPU/HBM handles worst — stays vectorized.
+* Padding mask is per-key ([batch, kv]); the encoder never uses causal
+  or pairwise masks.
+
+Falls back to interpret mode off-TPU so the same code path is testable
+on the CPU mesh (tests/test_models.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, *, sm_scale: float):
+    q = q_ref[0, 0].astype(jnp.float32)  # [sq, dh]
+    k = k_ref[0, 0].astype(jnp.float32)  # [skv, dh]
+    v = v_ref[0, 0].astype(jnp.float32)  # [skv, dh]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * sm_scale
+    mask = m_ref[0]  # [skv]
+    s = jnp.where(mask[None, :] != 0, s, _NEG_INF)
+    s = s - jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[0, 0] = jax.lax.dot(
+        p, v, preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _flash(q, k, v, kv_mask, sm_scale: float, interpret: bool):
+    batch, heads, sq, dh = q.shape
+    skv = k.shape[2]
+    grid = (batch, heads)
+
+    def spec(seq):
+        return pl.BlockSpec((1, 1, seq, dh), lambda b, h: (b, h, 0, 0))
+
+    mask_spec = pl.BlockSpec((1, skv), lambda b, h: (b, 0))
+    return pl.pallas_call(
+        functools.partial(_attn_kernel, sm_scale=sm_scale),
+        grid=grid,
+        in_specs=[spec(sq), spec(skv), spec(skv), mask_spec],
+        out_specs=spec(sq),
+        out_shape=jax.ShapeDtypeStruct((batch, heads, sq, dh), q.dtype),
+        interpret=interpret,
+    )(q, k, v, kv_mask)
+
+
+def flash_attention(query, key, value, kv_mask=None, sm_scale=None):
+    """Fused attention over flax layout ``[batch, seq, heads, head_dim]``.
+
+    ``kv_mask``: optional per-key padding mask ``[batch, kv_len]`` (nonzero
+    = attend).  Returns ``[batch, q_len, heads, head_dim]`` in the input
+    dtype.  Off-TPU the kernel runs in pallas interpret mode (slow but
+    exact) so correctness is testable on the CPU mesh.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(query.shape[-1])
+    if kv_mask is None:
+        kv_mask = jnp.ones(key.shape[:2], jnp.int8)
+    kv_mask = kv_mask.astype(jnp.int8)
+    # [b, s, h, d] → [b, h, s, d]
+    q = jnp.transpose(query, (0, 2, 1, 3))
+    k = jnp.transpose(key, (0, 2, 1, 3))
+    v = jnp.transpose(value, (0, 2, 1, 3))
+    interpret = jax.default_backend() != "tpu"
+    out = _flash(q, k, v, kv_mask, float(sm_scale), interpret)
+    return jnp.transpose(out, (0, 2, 1, 3))
