@@ -70,7 +70,7 @@ from .internals.row_transformer import (
     transformer,
 )
 from .internals.run import run, run_all, MonitoringLevel
-from .internals.config import set_license_key
+from .internals.config import set_license_key, set_monitoring_config
 from .internals.graph import G as global_graph
 from .internals.iterate import iterate, iterate_universe
 
@@ -357,6 +357,7 @@ __all__ = [
     "pandas_transformer",
     "run_all",
     "set_license_key",
+    "set_monitoring_config",
     "groupby",
     "column_definition",
     "schema_from_types",

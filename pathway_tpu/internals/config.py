@@ -47,6 +47,9 @@ class PathwayConfig:
     ignore_asserts: bool = False
     skip_start_log: bool = False
     license_key: str | None = None
+    #: OTLP endpoint for telemetry push (reference: config.py:66
+    #: ``monitoring_server`` / PATHWAY_MONITORING_SERVER)
+    monitoring_server: str | None = None
 
     @classmethod
     def from_env(cls) -> "PathwayConfig":
@@ -65,6 +68,8 @@ class PathwayConfig:
             skip_start_log=os.environ.get("PATHWAY_SKIP_START_LOG", "").lower()
             in ("1", "true", "yes"),
             license_key=os.environ.get("PATHWAY_LICENSE_KEY") or None,
+            monitoring_server=os.environ.get("PATHWAY_MONITORING_SERVER")
+            or None,
         )
         cfg._apply_worker_cap()
         return cfg
@@ -112,6 +117,16 @@ def get_pathway_config(refresh: bool = False) -> PathwayConfig:
     if _config is None or refresh:
         _config = PathwayConfig.from_env()
     return _config
+
+
+def set_monitoring_config(*, server_endpoint: str | None) -> None:
+    """Set the OTLP telemetry endpoint programmatically (reference:
+    python/pathway/internals/config.py:141 ``set_monitoring_config``)."""
+    if server_endpoint is None:
+        os.environ.pop("PATHWAY_MONITORING_SERVER", None)
+    else:
+        os.environ["PATHWAY_MONITORING_SERVER"] = server_endpoint
+    get_pathway_config(refresh=True)
 
 
 def set_license_key(key: str | None) -> None:

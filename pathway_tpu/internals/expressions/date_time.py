@@ -2,9 +2,15 @@
 
 from __future__ import annotations
 
+import datetime as _datetime
+import functools
+
 from .. import dtype as dt
 from ..expression import ColumnExpression, MethodCallExpression, smart_wrap
 from ..value import DateTimeNaive, DateTimeUtc, Duration
+
+_EPOCH = _datetime.datetime(1970, 1, 1)
+_UTC = _datetime.timezone.utc
 
 
 def _m(name, fun, result, *args, propagate_none=True):
@@ -13,6 +19,83 @@ def _m(name, fun, result, *args, propagate_none=True):
 
 def _dt_or_dur_same(arg_dtypes):
     return dt.unoptionalize(arg_dtypes[0])
+
+
+@functools.lru_cache(maxsize=None)
+def _zone(name: str):
+    from zoneinfo import ZoneInfo
+
+    return ZoneInfo(name)
+
+
+def _utc_ns_from_wall(ns: int, tz_name: str) -> int:
+    """Wall-clock ns in ``tz_name`` → UTC ns, with the reference's DST
+    semantics (date_time.py:660): a nonexistent wall time maps to the
+    first existing instant after it (the transition), an ambiguous one to
+    the LATER moment (fold=1)."""
+    if tz_name == "UTC":
+        return ns
+    zone = _zone(tz_name)
+    sec, rem = divmod(ns, 1_000_000_000)
+    wall = _EPOCH + _datetime.timedelta(seconds=sec)
+    d1 = wall.replace(tzinfo=zone, fold=1)
+    utc1 = d1.astimezone(_UTC)
+    if utc1.astimezone(zone).replace(tzinfo=None) == wall:
+        utc = utc1
+    else:
+        # nonexistent (spring-forward gap): the transition instant lies
+        # between the two fold candidates — binary search for the first
+        # UTC second whose zone offset equals the post-transition offset.
+        # Rare path (one hour per year per zone), so per-value search is
+        # fine; offsets are whole seconds.
+        utc0 = wall.replace(tzinfo=zone, fold=0).astimezone(_UTC)
+        lo, hi = sorted((utc0, utc1))
+        target_off = hi.astimezone(zone).utcoffset()
+        lo_s = int((lo - _EPOCH.replace(tzinfo=_UTC)).total_seconds())
+        hi_s = int((hi - _EPOCH.replace(tzinfo=_UTC)).total_seconds())
+        while lo_s < hi_s:
+            mid = (lo_s + hi_s) // 2
+            t = _EPOCH.replace(tzinfo=_UTC) + _datetime.timedelta(seconds=mid)
+            if t.astimezone(zone).utcoffset() == target_off:
+                hi_s = mid
+            else:
+                lo_s = mid + 1
+        utc = _EPOCH.replace(tzinfo=_UTC) + _datetime.timedelta(seconds=lo_s)
+        rem = 0  # clamped to the transition: sub-second remainder is gone
+    delta = utc.replace(tzinfo=None) - _EPOCH
+    return (delta.days * 86_400 + delta.seconds) * 1_000_000_000 + delta.microseconds * 1_000 + rem
+
+
+def _wrap_duration(d):
+    """Accept the reference's duration spellings (Duration, pd.Timedelta,
+    datetime.timedelta, or a column expression) as an expression."""
+    if isinstance(d, ColumnExpression):
+        return d
+    if isinstance(d, Duration):
+        return smart_wrap(d)
+    if hasattr(d, "value") and hasattr(d, "total_seconds"):  # pd.Timedelta
+        return smart_wrap(Duration(int(d.value)))
+    if isinstance(d, _datetime.timedelta):
+        return smart_wrap(
+            Duration(
+                (d.days * 86_400 + d.seconds) * 1_000_000_000
+                + d.microseconds * 1_000
+            )
+        )
+    return smart_wrap(d)
+
+
+def _wall_ns_from_utc(ns: int, tz_name: str) -> int:
+    """UTC ns → wall-clock ns in ``tz_name`` (reference: date_time.py:750
+    ``to_naive_in_timezone``).  Always well-defined."""
+    if tz_name == "UTC":
+        return ns
+    zone = _zone(tz_name)
+    sec, rem = divmod(ns, 1_000_000_000)
+    utc = _EPOCH.replace(tzinfo=_UTC) + _datetime.timedelta(seconds=sec)
+    wall = utc.astimezone(zone).replace(tzinfo=None)
+    delta = wall - _EPOCH
+    return (delta.days * 86_400 + delta.seconds) * 1_000_000_000 + delta.microseconds * 1_000 + rem
 
 
 class DateTimeNamespace:
@@ -73,16 +156,52 @@ class DateTimeNamespace:
         )
 
     def to_naive(self, timezone: str = "UTC"):
-        def impl(v):
-            return DateTimeNaive(ns=v.ns)
+        def impl(v, tz):
+            return DateTimeNaive(ns=_wall_ns_from_utc(v.ns, tz))
 
-        return _m("to_naive", impl, dt.DATE_TIME_NAIVE, self._expr)
+        return _m(
+            "to_naive", impl, dt.DATE_TIME_NAIVE, self._expr, smart_wrap(timezone)
+        )
+
+    def to_naive_in_timezone(self, timezone):
+        """DateTimeUtc → wall clock in ``timezone``
+        (reference: date_time.py:750)."""
+        return self.to_naive(timezone)
 
     def to_utc(self, from_timezone: str = "UTC"):
-        def impl(v):
-            return DateTimeUtc(ns=v.ns)
+        def impl(v, tz):
+            return DateTimeUtc(ns=_utc_ns_from_wall(v.ns, tz))
 
-        return _m("to_utc", impl, dt.DATE_TIME_UTC, self._expr)
+        return _m(
+            "to_utc", impl, dt.DATE_TIME_UTC, self._expr, smart_wrap(from_timezone)
+        )
+
+    def weekday(self):
+        """0 = Monday … 6 = Sunday (reference: date_time.py:1567)."""
+        return _m("weekday", lambda v: v.weekday(), dt.INT, self._expr)
+
+    def add_duration_in_timezone(self, duration, timezone):
+        """DST-aware wall-clock addition (reference: date_time.py:840 —
+        composed exactly the same way: via UTC and back)."""
+        return (
+            self.to_utc(timezone) + _wrap_duration(duration)
+        ).dt.to_naive_in_timezone(timezone)
+
+    def subtract_duration_in_timezone(self, duration, timezone):
+        """DST-aware wall-clock subtraction (reference: date_time.py:895)."""
+        return (
+            self.to_utc(timezone) - _wrap_duration(duration)
+        ).dt.to_naive_in_timezone(timezone)
+
+    def subtract_date_time_in_timezone(self, date_time, timezone):
+        """Difference of two wall-clock DateTimeNaives measured in real
+        elapsed time (reference: date_time.py:928)."""
+        other = (
+            date_time
+            if isinstance(date_time, ColumnExpression)
+            else smart_wrap(date_time)
+        )
+        return self.to_utc(timezone) - other.dt.to_utc(timezone)
 
     def from_timestamp(self, unit: str = "s"):
         mult = {"s": 1_000_000_000, "ms": 1_000_000, "us": 1_000, "ns": 1}[unit]

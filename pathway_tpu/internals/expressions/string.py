@@ -89,6 +89,27 @@ class StringNamespace:
     def endswith(self, suffix):
         return _m("endswith", lambda s, p: s.endswith(p), dt.BOOL, self._expr, smart_wrap(suffix))
 
+    def removeprefix(self, prefix):
+        """reference: string.py:634 — drop ``prefix`` if present, else
+        return the string unchanged (Python ``str.removeprefix``)."""
+        return _m(
+            "removeprefix",
+            lambda s, p: s.removeprefix(p),
+            dt.STR,
+            self._expr,
+            smart_wrap(prefix),
+        )
+
+    def removesuffix(self, suffix):
+        """reference: string.py:693 (Python ``str.removesuffix``)."""
+        return _m(
+            "removesuffix",
+            lambda s, p: s.removesuffix(p),
+            dt.STR,
+            self._expr,
+            smart_wrap(suffix),
+        )
+
     def swapcase(self):
         return _m("swapcase", lambda s: s.swapcase(), dt.STR, self._expr)
 

@@ -48,8 +48,15 @@ def run(
     if not sinks:
         return
 
-    from .telemetry import get_telemetry
+    from .telemetry import get_telemetry, setup_otlp
 
+    # refresh: the endpoint may have been set (env or
+    # set_monitoring_config) after an earlier config read
+    _cfg0 = get_pathway_config(refresh=True)
+    if _cfg0.monitoring_server:
+        # OTLP push pipeline (reference telemetry.rs:94-145); inert when
+        # the SDK is absent from the environment
+        setup_otlp(_cfg0.monitoring_server, run_id=_cfg0.run_id)
     telemetry = get_telemetry()
 
     _persistence.activate(persistence_config)

@@ -140,6 +140,102 @@ class Telemetry:
         return True
 
 
+#: reference: telemetry.rs:38-39
+PERIODIC_READER_INTERVAL_MS = 60_000
+EXPORT_TIMEOUT_MS = 3_000
+
+_otlp_configured_endpoint: str | None = None
+
+
+def setup_otlp(
+    endpoint: str,
+    *,
+    service_name: str = "pathway_tpu",
+    run_id: str | None = None,
+) -> bool:
+    """Push-pipeline parity with the reference (telemetry.rs:94-145
+    ``init_meter_provider``/``init_tracer_provider``): build SDK
+    Tracer/Meter providers with OTLP exporters and a 60 s PeriodicReader
+    against ``endpoint``, set them globally, and tag the resource with
+    service name / instance / run id.
+
+    Config-gated and inert without the SDK: this image ships only the
+    OTel *API*, so the function logs one debug line and returns False —
+    exactly the reference's off-unless-configured posture.  Returns True
+    when providers were installed (idempotent per endpoint)."""
+    global _otlp_configured_endpoint
+    if _otlp_configured_endpoint == endpoint:
+        return True
+    if _otlp_configured_endpoint is not None:
+        # OpenTelemetry refuses to override already-set global providers —
+        # claiming success would silently keep exporting to the OLD
+        # endpoint.  Be loud and honest instead.
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(
+            "telemetry already configured for %s; cannot re-point to %s "
+            "in the same process (OTel global providers are set once)",
+            _otlp_configured_endpoint,
+            endpoint,
+        )
+        return False
+    try:
+        from opentelemetry import metrics, trace
+        from opentelemetry.exporter.otlp.proto.grpc.metric_exporter import (
+            OTLPMetricExporter,
+        )
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
+        from opentelemetry.sdk.metrics import MeterProvider
+        from opentelemetry.sdk.metrics.export import PeriodicExportingMetricReader
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+    except ImportError:
+        import logging
+
+        logging.getLogger("pathway_tpu").debug(
+            "PATHWAY_MONITORING_SERVER set (%s) but the OpenTelemetry SDK "
+            "is not installed — telemetry push disabled",
+            endpoint,
+        )
+        return False
+
+    import os
+    import uuid
+
+    resource = Resource.create(
+        {
+            "service.name": service_name,
+            "service.instance.id": str(os.getpid()),
+            "pathway.run_id": run_id or str(uuid.uuid4()),
+        }
+    )
+    reader = PeriodicExportingMetricReader(
+        OTLPMetricExporter(
+            endpoint=endpoint, timeout=EXPORT_TIMEOUT_MS / 1000
+        ),
+        export_interval_millis=PERIODIC_READER_INTERVAL_MS,
+        export_timeout_millis=EXPORT_TIMEOUT_MS,
+    )
+    metrics.set_meter_provider(
+        MeterProvider(resource=resource, metric_readers=[reader])
+    )
+    tracer_provider = TracerProvider(resource=resource)
+    tracer_provider.add_span_processor(
+        BatchSpanProcessor(
+            OTLPSpanExporter(endpoint=endpoint, timeout=EXPORT_TIMEOUT_MS / 1000)
+        )
+    )
+    trace.set_tracer_provider(tracer_provider)
+    _otlp_configured_endpoint = endpoint
+    # rebuild the singleton so its tracer/meter bind to the new providers
+    global _singleton
+    _singleton = None
+    return True
+
+
 _singleton: Telemetry | None = None
 
 

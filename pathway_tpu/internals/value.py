@@ -443,6 +443,12 @@ class _DateTimeBase:
     def nanosecond(self) -> int:
         return self._ns % 1_000_000_000
 
+    def weekday(self) -> int:
+        """0 = Monday … 6 = Sunday (reference: date_time.py:1567 — naive
+        uses the wall-clock day, UTC the UTC day; both are this ns' day).
+        1970-01-01 was a Thursday (= 3)."""
+        return int(((self._ns // 86_400_000_000_000) + 3) % 7)
+
     def strftime(self, fmt: str) -> str:
         return self._dt().strftime(_convert_format(fmt))
 

@@ -1,13 +1,24 @@
 from . import index, smart_table_ops
 from .index import KNNIndex
-from .smart_table_ops import fuzzy_match_tables, fuzzy_self_match
+from .smart_table_ops import (
+    FuzzyJoinFeatureGeneration,
+    FuzzyJoinNormalization,
+    fuzzy_match_tables,
+    fuzzy_match_with_hint,
+    fuzzy_self_match,
+    smart_fuzzy_match,
+)
 
 __all__ = [
     "index",
     "KNNIndex",
     "smart_table_ops",
+    "FuzzyJoinFeatureGeneration",
+    "FuzzyJoinNormalization",
     "fuzzy_match_tables",
+    "fuzzy_match_with_hint",
     "fuzzy_self_match",
+    "smart_fuzzy_match",
     "classifiers",
     "datasets",
     "hmm",

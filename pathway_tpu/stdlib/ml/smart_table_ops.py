@@ -1,13 +1,23 @@
 """Fuzzy joins (reference: python/pathway/stdlib/ml/smart_table_ops/
 ``_fuzzy_join.py`` 470 LoC — feature extraction + weighted match scoring;
-``fuzzy_match_tables``, ``fuzzy_self_match``, ``smart_fuzzy_match``).
+``fuzzy_match_tables``, ``fuzzy_self_match``, ``smart_fuzzy_match``,
+``fuzzy_match_with_hint``).
 
-Scoring follows the reference's shape: values decompose into normalized
-token features, features are weighted by inverse frequency, and a pair's
-score is the summed weight of shared features; each left row keeps its
-best-scoring right row above the threshold.  The candidate generation +
-scoring runs as one packed reduce per side (host-side; token sets are
-tiny compared to the vector plane).
+Scoring follows the reference exactly: values decompose into features
+(FuzzyJoinFeatureGeneration), each feature's weight is a function of its
+occurrence count (FuzzyJoinNormalization: ``1/2^ceil(log2 cnt)``,
+``1/ceil(log2(cnt+1))`` or raw count — _fuzzy_join.py:59-73), a pair's
+score sums ``locc * rocc * weight(f)`` over shared features, and the
+result keeps only MUTUAL best pairs: argmax per left then per right with
+the reference's pseudoweight ``(weight, min_id, max_id)`` tiebreak
+(_fuzzy_join.py:428-456).  ``by_hand_match`` pre-matched rows are
+excluded from automatic matching and override the output
+(_fuzzy_join.py:300-316).
+
+The reference runs this as a dataflow of edge/feature tables with a
+heavy/light feature split; here candidate generation + scoring run as
+one packed reduce per side (host-side; token sets are tiny compared to
+the vector plane) computing the same sum directly.
 """
 
 from __future__ import annotations
@@ -15,62 +25,191 @@ from __future__ import annotations
 import math
 import re
 from collections import Counter, defaultdict
+from enum import IntEnum, auto
 
 from ...internals import dtype as dt
 from ...internals.desugaring import resolve_expression
 from ...internals.expression import ApplyExpression
 from ...internals.table import Table
 
-__all__ = ["fuzzy_match_tables", "fuzzy_self_match", "FuzzyJoinNormalization"]
+__all__ = [
+    "FuzzyJoinFeatureGeneration",
+    "FuzzyJoinNormalization",
+    "fuzzy_match_tables",
+    "fuzzy_match_with_hint",
+    "fuzzy_self_match",
+    "smart_fuzzy_match",
+]
 
 _TOKEN_RE = re.compile(r"\w+", re.UNICODE)
 
 
-class FuzzyJoinNormalization:
-    """reference: _fuzzy_join.py normalization kinds."""
+class FuzzyJoinFeatureGeneration(IntEnum):
+    """reference: _fuzzy_join.py:42 — how a value decomposes into
+    features.  AUTO is our autoguess (lowercased word tokens — unlike the
+    reference's case-sensitive split, 'Apple Inc' still matches 'apple
+    incorporated'); TOKENIZE is the reference's exact whitespace split;
+    LETTERS its lowercase alphanumeric characters."""
 
-    WORD = "word"
-    LETTERS = "letters"
+    AUTO = auto()
+    TOKENIZE = auto()
+    LETTERS = auto()
+
+    @property
+    def generate(self):
+        cls = type(self)
+        if self is cls.TOKENIZE:
+            return lambda obj: str(obj).split()
+        if self is cls.LETTERS:
+            return lambda obj: [c.lower() for c in str(obj) if c.isalnum()]
+        return lambda obj: _TOKEN_RE.findall(str(obj or "").lower())
 
 
-def _features(value, normalization: str) -> list[str]:
-    text = str(value or "").lower()
-    if normalization == FuzzyJoinNormalization.LETTERS:
-        return ["".join(sorted(_TOKEN_RE.findall(text)))]
-    return _TOKEN_RE.findall(text)
+class FuzzyJoinNormalization(IntEnum):
+    """reference: _fuzzy_join.py:77 — feature weight as a function of its
+    occurrence count."""
+
+    WEIGHT = auto()
+    LOGWEIGHT = auto()
+    NONE = auto()
+
+    @property
+    def normalize(self):
+        cls = type(self)
+        if self is cls.WEIGHT:
+            return lambda cnt: 0.0 if cnt == 0 else 1 / (2 ** math.ceil(math.log2(cnt)))
+        if self is cls.NONE:
+            return lambda cnt: float(cnt)
+        return lambda cnt: 0.0 if cnt == 0 else 1 / math.ceil(math.log2(cnt + 1))
+
+
+def _resolve_options(normalization, feature_generation):
+    """Map legacy string spellings ("word"/"letters", rounds 1-3 of this
+    port) onto the reference enums."""
+    if normalization == "word":
+        return FuzzyJoinNormalization.LOGWEIGHT, FuzzyJoinFeatureGeneration.AUTO
+    if normalization == "letters":
+        return FuzzyJoinNormalization.LOGWEIGHT, FuzzyJoinFeatureGeneration.LETTERS
+    return (
+        FuzzyJoinNormalization(normalization),
+        FuzzyJoinFeatureGeneration(feature_generation),
+    )
 
 
 def _score_pairs(
-    left_items: list[tuple], right_items: list[tuple], normalization: str
+    left_items: list[tuple],
+    right_items: list[tuple],
+    normalization: "FuzzyJoinNormalization",
+    feature_generation: "FuzzyJoinFeatureGeneration",
+    *,
+    symmetric: bool = False,
+    exclude_left: set | None = None,
+    exclude_right: set | None = None,
+    threshold: float = 0.0,
 ) -> list[tuple]:
-    """[(left_key, right_key, score)] — best right match per left row."""
-    feature_count: Counter = Counter()
-    left_feats = [(k, _features(v, normalization)) for k, v in left_items]
-    right_feats = [(k, _features(v, normalization)) for k, v in right_items]
+    """[(left_key, right_key, score)] — the reference's mutual-best pairs.
+
+    ``symmetric``: left_items IS right_items (self match); self-pairs are
+    dropped and each unordered pair reported once (left < right)."""
+    gen = feature_generation.generate
+    norm = normalization.normalize
+    exclude_left = exclude_left or set()
+    exclude_right = exclude_right or set()
+
+    left_feats = [
+        (k, Counter(gen(v))) for k, v in left_items if k not in exclude_left
+    ]
+    if symmetric:
+        right_feats = [
+            (k, fs) for k, fs in left_feats if k not in exclude_right
+        ]
+    else:
+        right_feats = [
+            (k, Counter(gen(v)))
+            for k, v in right_items
+            if k not in exclude_right
+        ]
+
+    # occurrence counts over every edge (reference counts the concatenated
+    # edge table, _fuzzy_join.py:356; for self match the edges exist once)
+    cnt: Counter = Counter()
     for _, fs in left_feats:
-        feature_count.update(set(fs))
-    for _, fs in right_feats:
-        feature_count.update(set(fs))
+        cnt.update(fs)
+    if not symmetric:
+        for _, fs in right_feats:
+            cnt.update(fs)
+    weight = {f: norm(c) for f, c in cnt.items()}
 
-    postings: dict[str, list] = defaultdict(list)
-    for k, fs in right_feats:
-        for f in set(fs):
-            postings[f].append(k)
+    postings: dict = defaultdict(list)
+    for rk, fs in right_feats:
+        for f, occ in fs.items():
+            postings[f].append((rk, occ))
 
-    def weight(f: str) -> float:
-        # rarer features weigh more (reference uses 1/count normalization)
-        return 1.0 / math.sqrt(feature_count[f])
+    scores: dict = defaultdict(float)
+    for lk, fs in left_feats:
+        for f, locc in fs.items():
+            w = weight[f]
+            for rk, rocc in postings.get(f, ()):
+                if symmetric and rk == lk:
+                    continue
+                scores[(lk, rk)] += locc * rocc * w
+
+    # mutual best with the reference's pseudoweight tiebreak: order pairs
+    # by (weight, min_id, max_id) so ties resolve identically on both
+    # sides (_fuzzy_join.py:428 weight_to_pseudoweight)
+    def pseudo(lk, rk, w):
+        a, b = (lk, rk) if lk < rk else (rk, lk)
+        return (w, a, b)
+
+    best_left: dict = {}
+    for (lk, rk), w in scores.items():
+        if w <= threshold:
+            continue
+        p = pseudo(lk, rk, w)
+        if lk not in best_left or p > best_left[lk][0]:
+            best_left[lk] = (p, rk, w)
+    best_right: dict = {}
+    for lk, (p, rk, w) in best_left.items():
+        if rk not in best_right or p > best_right[rk][0]:
+            best_right[rk] = (p, lk, w)
 
     out = []
-    for lk, fs in left_feats:
-        scores: dict = defaultdict(float)
-        for f in set(fs):
-            for rk in postings.get(f, ()):
-                scores[rk] += weight(f)
-        if scores:
-            best_rk, best = max(scores.items(), key=lambda kv: (kv[1], repr(kv[0])))
-            out.append((lk, best_rk, best))
+    seen = set()
+    for rk, (p, lk, w) in best_right.items():
+        if symmetric:
+            a, b = (lk, rk) if lk < rk else (rk, lk)
+            if (a, b) in seen:
+                continue
+            seen.add((a, b))
+            out.append((a, b, w))
+        else:
+            out.append((lk, rk, w))
     return out
+
+
+def _pairs_output(flat):
+    return flat._select_exprs(
+        {
+            "left": ApplyExpression(lambda p: p[0], dt.POINTER, flat.pairs),
+            "right": ApplyExpression(lambda p: p[1], dt.POINTER, flat.pairs),
+            "weight": ApplyExpression(lambda p: float(p[2]), dt.FLOAT, flat.pairs),
+        },
+        universe=flat._universe,
+    )
+
+
+def _pack_by_hand(by_hand_match):
+    import pathway_tpu as pw
+
+    if by_hand_match is None:
+        return None
+    return by_hand_match.reduce(
+        items=pw.reducers.tuple(
+            pw.make_tuple(
+                by_hand_match.left, by_hand_match.right, by_hand_match.weight
+            )
+        )
+    )
 
 
 def fuzzy_match_tables(
@@ -79,14 +218,16 @@ def fuzzy_match_tables(
     *,
     left_column=None,
     right_column=None,
+    by_hand_match: Table | None = None,
     threshold: float = 0.0,
-    normalization: str = FuzzyJoinNormalization.WORD,
+    normalization=FuzzyJoinNormalization.LOGWEIGHT,
+    feature_generation=FuzzyJoinFeatureGeneration.AUTO,
 ) -> Table:
     """Best fuzzy pairing between two tables' text columns
-    (reference: smart_table_ops fuzzy_match_tables).  Returns columns
-    (left, right, weight) with Pointer keys into the inputs."""
-    import pathway_tpu as pw
-
+    (reference: smart_table_ops ``fuzzy_match_tables``).  Returns columns
+    (left, right, weight) with Pointer keys into the inputs;
+    ``by_hand_match`` rows (left, right, weight) are taken as ground
+    truth — excluded from matching and merged into the result."""
     lcol = resolve_expression(
         left_column if left_column is not None else left_table[left_table.column_names()[0]],
         left_table,
@@ -95,68 +236,188 @@ def fuzzy_match_tables(
         right_column if right_column is not None else right_table[right_table.column_names()[0]],
         right_table,
     )
+    normalization, feature_generation = _resolve_options(
+        normalization, feature_generation
+    )
+    if (
+        left_table is right_table
+        and getattr(lcol, "name", None) is not None
+        and getattr(lcol, "name", None) == getattr(rcol, "name", None)
+    ):
+        return fuzzy_self_match(
+            left_table,
+            lcol,
+            by_hand_match=by_hand_match,
+            threshold=threshold,
+            normalization=normalization,
+            feature_generation=feature_generation,
+        )
+    return _match_packed(
+        left_table,
+        lcol,
+        right_table,
+        rcol,
+        by_hand_match,
+        threshold,
+        normalization,
+        feature_generation,
+    )
+
+
+def smart_fuzzy_match(
+    left_col,
+    right_col,
+    *,
+    by_hand_match: Table | None = None,
+    threshold: float = 0.0,
+    normalization=FuzzyJoinNormalization.LOGWEIGHT,
+    feature_generation=FuzzyJoinFeatureGeneration.AUTO,
+) -> Table:
+    """Column-level entry point (reference: _fuzzy_join.py:199
+    ``smart_fuzzy_match``).  Detects self-match when both references name
+    the same column of the same table."""
+    import pathway_tpu as pw
+
+    if not hasattr(left_col, "table") or not hasattr(right_col, "table"):
+        raise TypeError(
+            "smart_fuzzy_match takes column references; for computed "
+            "expressions use fuzzy_match_tables(left_column=..., "
+            "right_column=...)"
+        )
+    return fuzzy_match_tables(
+        left_col.table,
+        right_col.table,
+        left_column=left_col,
+        right_column=right_col,
+        by_hand_match=by_hand_match,
+        threshold=threshold,
+        normalization=normalization,
+        feature_generation=feature_generation,
+    )
+
+
+def _match_packed(
+    left_table,
+    lcol,
+    right_table,
+    rcol,
+    by_hand_match,
+    threshold,
+    normalization,
+    feature_generation,
+):
+    import pathway_tpu as pw
+
     left_packed = left_table.reduce(
         items=pw.reducers.tuple(pw.make_tuple(left_table.id, lcol))
     )
     right_packed = right_table.reduce(
         items=pw.reducers.tuple(pw.make_tuple(right_table.id, rcol))
     )
+    hint_packed = _pack_by_hand(by_hand_match)
 
-    def match(litems, ritems) -> tuple:
-        pairs = _score_pairs(list(litems or ()), list(ritems or ()), normalization)
-        return tuple(p for p in pairs if p[2] > threshold)
+    def match(litems, ritems, hitems=()) -> tuple:
+        hints = list(hitems or ())
+        pairs = _score_pairs(
+            list(litems or ()),
+            list(ritems or ()),
+            normalization,
+            feature_generation,
+            exclude_left={h[0] for h in hints},
+            exclude_right={h[1] for h in hints},
+            threshold=threshold,
+        )
+        return tuple(pairs) + tuple((h[0], h[1], float(h[2])) for h in hints)
 
-    matches = left_packed.join(right_packed).select(
-        pairs=ApplyExpression(match, dt.ANY, left_packed.items, right_packed.items)
-    )
+    if hint_packed is None:
+        matches = left_packed.join(right_packed).select(
+            pairs=ApplyExpression(
+                match, dt.ANY, left_packed.items, right_packed.items
+            )
+        )
+    else:
+        both = left_packed.join(right_packed).select(
+            litems=left_packed.items, ritems=right_packed.items
+        )
+        # LEFT join: an EMPTY hint table must not wipe the automatic
+        # matches (its packed reduce has zero rows)
+        matches = both.join_left(hint_packed).select(
+            pairs=ApplyExpression(
+                match, dt.ANY, both.litems, both.ritems, hint_packed.items
+            )
+        )
     flat = matches.flatten(matches.pairs)
-    return flat._select_exprs(
-        {
-            "left": ApplyExpression(lambda p: p[0], dt.POINTER, flat.pairs),
-            "right": ApplyExpression(lambda p: p[1], dt.POINTER, flat.pairs),
-            "weight": ApplyExpression(lambda p: float(p[2]), dt.FLOAT, flat.pairs),
-        },
-        universe=flat._universe,
+    return _pairs_output(flat)
+
+
+def fuzzy_match_with_hint(
+    left_col,
+    right_col,
+    by_hand_match: Table,
+    *,
+    threshold: float = 0.0,
+    normalization=FuzzyJoinNormalization.LOGWEIGHT,
+    feature_generation=FuzzyJoinFeatureGeneration.AUTO,
+) -> Table:
+    """reference: _fuzzy_join.py:282 — fuzzy match with a required table
+    of hand-made matches (left, right, weight) that override automatic
+    matching."""
+    if by_hand_match is None:
+        raise ValueError("fuzzy_match_with_hint requires by_hand_match")
+    return smart_fuzzy_match(
+        left_col,
+        right_col,
+        by_hand_match=by_hand_match,
+        threshold=threshold,
+        normalization=normalization,
+        feature_generation=feature_generation,
     )
 
 
 def fuzzy_self_match(
-    table: Table, column=None, *, threshold: float = 0.0,
-    normalization: str = FuzzyJoinNormalization.WORD,
+    table: Table,
+    column=None,
+    *,
+    by_hand_match: Table | None = None,
+    threshold: float = 0.0,
+    normalization=FuzzyJoinNormalization.LOGWEIGHT,
+    feature_generation=FuzzyJoinFeatureGeneration.AUTO,
 ) -> Table:
     """Fuzzy matches within one table, excluding self-pairs
-    (reference: smart_table_ops fuzzy_self_match)."""
+    (reference: smart_table_ops ``fuzzy_self_match``)."""
     import pathway_tpu as pw
 
+    normalization, feature_generation = _resolve_options(
+        normalization, feature_generation
+    )
     col = resolve_expression(
         column if column is not None else table[table.column_names()[0]], table
     )
     packed = table.reduce(items=pw.reducers.tuple(pw.make_tuple(table.id, col)))
+    hint_packed = _pack_by_hand(by_hand_match)
 
-    def match(items) -> tuple:
-        items = list(items or ())
-        out = []
-        for i, (lk, lv) in enumerate(items):
-            others = items[:i] + items[i + 1 :]
-            pairs = _score_pairs([(lk, lv)], others, normalization)
-            out.extend(p for p in pairs if p[2] > threshold)
-        # dedupe symmetric pairs
-        seen = set()
-        uniq = []
-        for lk, rk, w in out:
-            key = tuple(sorted((repr(lk), repr(rk))))
-            if key not in seen:
-                seen.add(key)
-                uniq.append((lk, rk, w))
-        return tuple(uniq)
+    def match(items, hitems=()) -> tuple:
+        hints = list(hitems or ())
+        matched = {h[0] for h in hints} | {h[1] for h in hints}
+        pairs = _score_pairs(
+            list(items or ()),
+            list(items or ()),
+            normalization,
+            feature_generation,
+            symmetric=True,
+            exclude_left=matched,
+            exclude_right=matched,
+            threshold=threshold,
+        )
+        return tuple(pairs) + tuple((h[0], h[1], float(h[2])) for h in hints)
 
-    matches = packed.select(pairs=ApplyExpression(match, dt.ANY, packed.items))
+    if hint_packed is None:
+        matches = packed.select(
+            pairs=ApplyExpression(match, dt.ANY, packed.items)
+        )
+    else:
+        matches = packed.join_left(hint_packed).select(
+            pairs=ApplyExpression(match, dt.ANY, packed.items, hint_packed.items)
+        )
     flat = matches.flatten(matches.pairs)
-    return flat._select_exprs(
-        {
-            "left": ApplyExpression(lambda p: p[0], dt.POINTER, flat.pairs),
-            "right": ApplyExpression(lambda p: p[1], dt.POINTER, flat.pairs),
-            "weight": ApplyExpression(lambda p: float(p[2]), dt.FLOAT, flat.pairs),
-        },
-        universe=flat._universe,
-    )
+    return _pairs_output(flat)

@@ -379,3 +379,37 @@ def test_example_apps_run(script):
         capture_output=True, text=True, timeout=180, env=env,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_otlp_setup_inert_without_sdk(monkeypatch):
+    """reference telemetry.rs:94-145 parity is config-gated: with only
+    the OTel API in the image, setup_otlp declines gracefully and pw.run
+    proceeds."""
+    from pathway_tpu.internals import telemetry as T
+
+    assert T.setup_otlp("http://127.0.0.1:4317") is False
+    # env-config path: run still works with the endpoint set
+    import pathway_tpu as pw
+
+    monkeypatch.setenv("PATHWAY_MONITORING_SERVER", "http://127.0.0.1:4317")
+    pw.internals.graph.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        a | __time__
+        1 | 2
+        """
+    )
+    got = []
+    pw.io.subscribe(t, on_change=lambda k, row, time, add: got.append(row))
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert got == [{"a": 1}]
+
+
+def test_set_monitoring_config_roundtrip():
+    import pathway_tpu as pw
+    from pathway_tpu.internals.config import get_pathway_config
+
+    pw.set_monitoring_config(server_endpoint="https://example.com:4317")
+    assert get_pathway_config().monitoring_server == "https://example.com:4317"
+    pw.set_monitoring_config(server_endpoint=None)
+    assert get_pathway_config().monitoring_server is None

@@ -318,3 +318,99 @@ def test_connector_monitoring_entries():
     metrics = engine.monitor.openmetrics()
     assert 'pathway_connector_messages_total{connector="python-0"} 5' in metrics
     assert 'pathway_connector_finished{connector="python-0"} 1' in metrics
+
+
+def test_fuzzy_match_with_hint_overrides_auto():
+    """reference: _fuzzy_join.py:282 — hand-matched rows are excluded from
+    automatic matching and appear verbatim in the output."""
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_with_hint
+
+    left = dbg.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("apple pie",), ("banana split",)],
+    )
+    right = dbg.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("apple tart",), ("banana bread",)],
+    )
+    _, lcols = dbg.table_to_dicts(left)
+    _, rcols = dbg.table_to_dicts(right)
+    lkeys = {v: k for k, v in lcols["name"].items()}
+    rkeys = {v: k for k, v in rcols["name"].items()}
+    # hand-match 'apple pie' to 'banana bread' (against the tokens)
+    hint = dbg.table_from_rows(
+        pw.schema_from_types(left=pw.Pointer, right=pw.Pointer, weight=float),
+        [(lkeys["apple pie"], rkeys["banana bread"], 9.0)],
+    )
+    matches = fuzzy_match_with_hint(left.name, right.name, hint)
+    _, cols = dbg.table_to_dicts(matches)
+    lnames = {k: v for k, v in lcols["name"].items()}
+    rnames = {k: v for k, v in rcols["name"].items()}
+    got = {
+        (lnames[l], rnames[r]): w
+        for l, r, w in zip(
+            cols["left"].values(), cols["right"].values(), cols["weight"].values()
+        )
+    }
+    # the hint appears verbatim and is the ONLY pair: the remaining rows
+    # ('banana split' / 'apple tart') share no tokens, and both hinted
+    # rows are excluded from automatic matching
+    assert got == {("apple pie", "banana bread"): 9.0}
+
+
+def test_fuzzy_feature_generation_options():
+    from pathway_tpu.stdlib.ml.smart_table_ops import (
+        FuzzyJoinFeatureGeneration,
+        fuzzy_match_tables,
+    )
+
+    left = dbg.table_from_rows(pw.schema_from_types(name=str), [("Apple Inc",)])
+    right = dbg.table_from_rows(
+        pw.schema_from_types(name=str), [("apple incorporated",)]
+    )
+    # reference-exact TOKENIZE is case-sensitive: no shared token, no match
+    m1 = fuzzy_match_tables(
+        left, right, feature_generation=FuzzyJoinFeatureGeneration.TOKENIZE
+    )
+    _, cols1 = dbg.table_to_dicts(m1)
+    assert len(cols1["weight"]) == 0
+    # LETTERS matches on shared characters
+    m2 = fuzzy_match_tables(
+        left, right, feature_generation=FuzzyJoinFeatureGeneration.LETTERS
+    )
+    _, cols2 = dbg.table_to_dicts(m2)
+    assert len(cols2["weight"]) == 1
+
+
+def test_fuzzy_empty_hint_table_keeps_auto_matches():
+    """An empty by_hand_match must not wipe the automatic matches (its
+    packed reduce has zero rows — regression from the round-4 review)."""
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    left = dbg.table_from_rows(pw.schema_from_types(name=str), [("apple pie",)])
+    right = dbg.table_from_rows(pw.schema_from_types(name=str), [("apple tart",)])
+    empty_hint = dbg.table_from_rows(
+        pw.schema_from_types(left=pw.Pointer, right=pw.Pointer, weight=float), []
+    )
+    m = fuzzy_match_tables(left, right, by_hand_match=empty_hint)
+    _, cols = dbg.table_to_dicts(m)
+    assert len(cols["weight"]) == 1
+
+
+def test_fuzzy_match_tables_computed_expression_columns():
+    """Computed expressions as left_column/right_column keep working."""
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    left = dbg.table_from_rows(
+        pw.schema_from_types(a=str, b=str), [("apple", "pie")]
+    )
+    right = dbg.table_from_rows(
+        pw.schema_from_types(a=str, b=str), [("apple", "tart")]
+    )
+    m = fuzzy_match_tables(
+        left, right,
+        left_column=left.a + " " + left.b,
+        right_column=right.a + " " + right.b,
+    )
+    _, cols = dbg.table_to_dicts(m)
+    assert len(cols["weight"]) == 1

@@ -354,3 +354,160 @@ def test_string_and_dt_namespaces():
     assert list(cols["low"].values()) == ["hello"]
     assert list(cols["ln"].values()) == [5]
     assert list(cols["swapped"].values()) == ["hELLO"]
+
+
+def test_str_removeprefix_removesuffix():
+    """reference: string.py:634/693 oracle semantics."""
+    t = pwd.table_from_markdown(
+        """
+        | name   | prefix
+    1   | dakota | da
+    2   | west   | wes
+    3   | ohio   | appa
+    """
+    )
+    res = t.select(
+        a=pw.this.name.str.removeprefix("da"),
+        b=pw.this.name.str.removeprefix(pw.this.prefix),
+        c=pw.this.name.str.removesuffix("ta"),
+        d=pw.this.name.str.removesuffix(pw.this.prefix),
+    )
+    _, cols = pwd.table_to_dicts(res)
+    assert list(cols["a"].values()) == ["kota", "west", "ohio"]
+    assert list(cols["b"].values()) == ["kota", "t", "ohio"]
+    assert list(cols["c"].values()) == ["dako", "west", "ohio"]
+    assert list(cols["d"].values()) == ["dakota", "west", "ohio"]
+
+
+def test_dt_weekday():
+    """reference doctest values (date_time.py:1567)."""
+    t = pwd.table_from_markdown(
+        """
+        | t1
+    1   | 1970-02-03T10:13:00
+    2   | 2023-03-25T10:13:00
+    3   | 2023-03-26T12:13:00
+    4   | 2023-05-15T14:13:23
+    """
+    )
+    res = t.select(w=pw.this.t1.dt.strptime(fmt="%Y-%m-%dT%H:%M:%S").dt.weekday())
+    _, cols = pwd.table_to_dicts(res)
+    assert list(cols["w"].values()) == [1, 5, 6, 0]
+
+
+def test_dt_to_utc_dst_semantics():
+    """reference doctest (date_time.py:660): nonexistent wall times map to
+    the transition instant; ambiguous ones to the later moment."""
+    t = pwd.table_from_markdown(
+        """
+        | date
+    1   | 2023-03-26T01:59:00
+    2   | 2023-03-26T02:30:00
+    3   | 2023-03-26T03:00:00
+    4   | 2023-10-29T01:59:00
+    5   | 2023-10-29T02:00:00
+    """
+    )
+    res = t.select(
+        u=pw.this.date.dt.strptime(fmt="%Y-%m-%dT%H:%M:%S")
+        .dt.to_utc(from_timezone="Europe/Warsaw")
+        .dt.strftime("%Y-%m-%d %H:%M:%S")
+    )
+    _, cols = pwd.table_to_dicts(res)
+    assert list(cols["u"].values()) == [
+        "2023-03-26 00:59:00",
+        "2023-03-26 01:00:00",  # nonexistent -> transition
+        "2023-03-26 01:00:00",
+        "2023-10-28 23:59:00",  # still CEST (+2) before the fall-back
+        "2023-10-29 01:00:00",  # ambiguous -> later moment (CET, +1)
+    ]
+
+
+def test_dt_to_naive_in_timezone():
+    """reference doctest (date_time.py:750)."""
+    t = pwd.table_from_markdown(
+        """
+        | date_utc
+    1   | 2023-03-26T00:59:00+0000
+    2   | 2023-03-26T01:00:00+0000
+    3   | 2023-10-29T00:30:00+0000
+    4   | 2023-10-29T01:00:00+0000
+    """
+    )
+    res = t.select(
+        n=pw.this.date_utc.dt.strptime(fmt="%Y-%m-%dT%H:%M:%S%z")
+        .dt.to_naive_in_timezone("Europe/Warsaw")
+        .dt.strftime("%Y-%m-%d %H:%M:%S")
+    )
+    _, cols = pwd.table_to_dicts(res)
+    assert list(cols["n"].values()) == [
+        "2023-03-26 01:59:00",
+        "2023-03-26 03:00:00",
+        "2023-10-29 02:30:00",
+        "2023-10-29 02:00:00",
+    ]
+
+
+def test_dt_add_subtract_duration_in_timezone():
+    """reference doctests (date_time.py:840/895): +2h across the Warsaw
+    spring-forward jumps the wall clock by 3h; across fall-back by 1h."""
+    import datetime
+
+    t = pwd.table_from_markdown(
+        """
+        | date
+    1   | 2023-03-26T01:23:00
+    2   | 2023-03-27T01:23:00
+    3   | 2023-10-29T01:23:00
+    4   | 2023-10-30T01:23:00
+    """
+    )
+    two_h = datetime.timedelta(hours=2)
+    res = t.select(
+        a=pw.this.date.dt.strptime(fmt="%Y-%m-%dT%H:%M:%S")
+        .dt.add_duration_in_timezone(two_h, timezone="Europe/Warsaw")
+        .dt.strftime("%H:%M"),
+    )
+    _, cols = pwd.table_to_dicts(res)
+    assert list(cols["a"].values()) == ["04:23", "03:23", "02:23", "03:23"]
+
+    t2 = pwd.table_from_markdown(
+        """
+        | date
+    1   | 2023-03-26T03:23:00
+    2   | 2023-03-27T03:23:00
+    3   | 2023-10-29T03:23:00
+    4   | 2023-10-30T03:23:00
+    """
+    )
+    res2 = t2.select(
+        s=pw.this.date.dt.strptime(fmt="%Y-%m-%dT%H:%M:%S")
+        .dt.subtract_duration_in_timezone(two_h, timezone="Europe/Warsaw")
+        .dt.strftime("%H:%M"),
+    )
+    _, cols2 = pwd.table_to_dicts(res2)
+    assert list(cols2["s"].values()) == ["00:23", "01:23", "02:23", "01:23"]
+
+
+def test_dt_subtract_date_time_in_timezone():
+    """reference doctest (date_time.py:928): same 2h wall difference spans
+    1h/3h of real time across the DST transitions."""
+    t = pwd.table_from_markdown(
+        """
+        | d1                  | d2
+    1   | 2023-03-26T03:20:00 | 2023-03-26T01:20:00
+    2   | 2023-03-27T03:20:00 | 2023-03-27T01:20:00
+    3   | 2023-10-29T03:20:00 | 2023-10-29T01:20:00
+    4   | 2023-10-30T03:20:00 | 2023-10-30T01:20:00
+    """
+    )
+    res = t.select(
+        h=pw.this.d1.dt.strptime(fmt="%Y-%m-%dT%H:%M:%S")
+        .dt.subtract_date_time_in_timezone(
+            pw.this.d2.dt.strptime(fmt="%Y-%m-%dT%H:%M:%S"),
+            timezone="Europe/Warsaw",
+        )
+        .dt.hours(),
+    )
+    _, cols = pwd.table_to_dicts(res)
+    assert list(cols["h"].values()) == [1, 2, 3, 2]
