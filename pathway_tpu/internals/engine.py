@@ -1270,15 +1270,40 @@ class Engine:
         src.downstream.append((dst, port))
 
     def step(self, time: int) -> None:
-        """Process one timestamp to quiescence.
+        """Process one timestamp to quiescence (drives :meth:`step_iter`
+        straight through — the yields only matter to the distributed
+        wavefront scheduler)."""
+        for _node in self.step_iter(time):
+            pass
+
+    def step_iter(self, time: int, skip_ids: frozenset = frozenset()):
+        """Resumable :meth:`step`: processes one timestamp to quiescence,
+        yielding each exchange node just before flushing it.
 
         Two phases per pass: regular nodes run until quiet, then ``late``
-        nodes (as-of-now index serving) get one pass — guaranteeing every
-        index update for this timestamp lands before any query is answered."""
+        nodes (exchanges, as-of-now index serving) get one pass —
+        guaranteeing every index update for this timestamp lands before
+        any query is answered.
+
+        The yield protocol is the poor-man's timely frontier (reference:
+        src/engine/dataflow.rs:5689-5731 ``step_or_park``): between two
+        yields a round's work runs atomically, so a scheduler that
+        resumes round ``t+1`` past an exchange only after round ``t``
+        passed it preserves per-node timestamp order while rounds overlap
+        — a downstream exchange can send round ``t+1`` while an upstream
+        straggler still completes ``t`` (io/streaming.py wavefront loop).
+        """
         for _pass in range(100_000):
             progressed = False
             for node in self.nodes:
-                if node.late or not node.has_pending(time):
+                if (
+                    node.late
+                    or node.id in skip_ids
+                    or not node.has_pending(time)
+                ):
+                    # skip_ids: the ingest-safe subgraph belongs to the
+                    # stage-1 ingest thread in distributed runs — touching
+                    # it here would race half-delivered later rounds
                     continue
                 progressed = True
                 out = self._flush_node(node, time)
@@ -1293,6 +1318,12 @@ class Engine:
             for node in self.nodes:
                 if node.late and node.has_pending(time):
                     progressed = True
+                    if getattr(node, "is_exchange", False):
+                        # suspension point: local input is settled (all
+                        # earlier nodes quiesced) — the scheduler may
+                        # prepare()/send now and resume when peers' data
+                        # arrived and the wavefront guard clears
+                        yield node
                     out = self._flush_node(node, time)
                     if out:
                         for consumer, port in node.downstream:

@@ -139,6 +139,9 @@ class ExchangePlane:
             token.encode("utf-8"), digest_size=32
         ).digest()
         self._send: dict[int, socket.socket] = {}
+        self._send_locks: dict[int, threading.Lock] = {
+            p: threading.Lock() for p in range(processes)
+        }
         self._inbox: dict[tuple, list] = {}  # (channel, time, from) -> payload
         self._cv = threading.Condition()
         #: max seconds a barrier waits for a peer before declaring it dead —
@@ -373,10 +376,13 @@ class ExchangePlane:
                 channel, time, self.me, outgoing.get(peer, []),
                 is_entries=is_entries,
             )
-            # single sender thread (engine + driver barriers share it), so
-            # no send lock: a lock shared across peer sockets would let one
-            # stalled peer's TCP window block sends to every other peer
-            self._send[peer].sendall(_HDR.pack(len(payload)) + payload)
+            # per-peer send locks: the ingest thread (ctl + first-hop
+            # batches) and the engine thread (eager prepares) send
+            # concurrently; a lock shared across peer sockets would let
+            # one stalled peer's TCP window block sends to every other
+            # peer, so each socket locks independently
+            with self._send_locks[peer]:
+                self._send[peer].sendall(_HDR.pack(len(payload)) + payload)
 
     def exchange(
         self,
@@ -391,6 +397,29 @@ class ExchangePlane:
         (arbitrary values rather than (key, row, diff) entries)."""
         self.send(channel, time, outgoing, is_entries=is_entries)
         return self.recv(channel, time)
+
+    def poll(self, channel: str, time: int) -> bool:
+        """Non-blocking: True when :meth:`recv` for (channel, time) would
+        not block — every live peer's batch arrived (a down peer or a
+        closed plane also returns True so the flush proceeds into recv
+        and raises its descriptive error there)."""
+        with self._cv:
+            if self._closed:
+                return True
+            for peer in range(self.n):
+                if peer == self.me:
+                    continue
+                if peer in self._down:
+                    return True
+                if not self._inbox.get((channel, time, peer)):
+                    return False
+        return True
+
+    def wait_any(self, timeout: float) -> None:
+        """Block until any inbox activity (or timeout) — the wavefront
+        scheduler's parking primitive when every round is blocked."""
+        with self._cv:
+            self._cv.wait(timeout=timeout)
 
     def recv(self, channel: str, time: int) -> list:
         """Collect every peer's batch for (channel, time); blocks until
@@ -460,7 +489,10 @@ class ExchangeNode(Node):
         self.channel = channel
         self.key_fn = key_fn  # None = partition by row key
         self.broadcast = broadcast
-        self._exchanged_time: int | None = None
+        #: rounds already exchanged — a SET, not a scalar: wavefront
+        #: rounds overlap, so round t+1 flushing must not make round t
+        #: look pending again (that double-fired exchanges per round)
+        self._exchanged: set[int] = set()
         #: rounds whose partition+send already ran (driver lookahead);
         #: flush() then only has to receive
         self._prepared: dict[int, list[Entry]] = {}
@@ -468,13 +500,16 @@ class ExchangeNode(Node):
     # participates in every timestamp: peers may send even when this
     # process has nothing local
     late = True
+    #: engine.step_iter suspension marker (duck-typed: engine cannot
+    #: import this module)
+    is_exchange = True
 
     def has_pending(self, time: int) -> bool:
         # exactly one exchange per timestamp, *independent of local data* —
         # peers run identical schedules, so a data-dependent flush count
         # would deadlock the barrier.  Node-list position is topological,
         # so all local inputs have settled by the time this node fires.
-        return self._exchanged_time != time
+        return time not in self._exchanged
 
     def prepare(self, time: int) -> None:
         """Stage 1 of a round: partition the settled local input and SEND
@@ -504,21 +539,147 @@ class ExchangeNode(Node):
 
     def flush(self, time: int) -> list[Entry]:
         # stage 2: wait for every peer's batch for this round.  When the
-        # driver did not run stage 1 ahead (chained exchanges, lockstep
-        # paths), prepare() here degenerates to the old send+recv flush.
-        if time in self._prepared and self.pending.get(0):
-            # input arrived AFTER this round's batch was already sent —
-            # the ingest-safety analysis is broken; losing the rows or
-            # double-sending would silently corrupt results
-            raise RuntimeError(
-                f"{self.name}: local input settled after prepare({time}) "
-                "— first-hop classification violated"
-            )
+        # driver did not run stage 1 ahead (lockstep paths), prepare()
+        # here degenerates to the old send+recv flush.  Note: pending may
+        # legitimately hold YOUNGER rounds' rows here — the wavefront
+        # scheduler lets round t+1's guarded segments deliver after this
+        # round's prepare() drained its input (io/streaming.py).
         self.prepare(time)
         mine = self._prepared.pop(time)
         remote = self.plane.recv(self.channel, time)
-        self._exchanged_time = time
+        self._exchanged.add(time)
+        if len(self._exchanged) > 64:
+            # rounds are monotone; anything far below the newest can no
+            # longer be asked about (bounded by the lookahead window)
+            floor = max(self._exchanged) - 32
+            self._exchanged = {t for t in self._exchanged if t >= floor}
         return consolidate(mine + list(remote))
+
+
+def wavefront_requirements(engine, safe_ids: set):
+    """Static schedule metadata for the cross-round wavefront
+    (VERDICT r3 #4 — lift chained-exchange lockstep).
+
+    ``engine.step_iter(t)`` yields once per ExchangeNode, in a firing
+    order that is identical every round (exchanges fire exactly once per
+    round, picked in node-list order).  Between two yields a round's work
+    runs atomically.  Round ``t+1`` may therefore overlap round ``t`` as
+    long as, before ``t+1`` executes a code stretch that DELIVERS into
+    some node's (timeless) pending buffer, round ``t`` is guaranteed to
+    never read that buffer again — otherwise ``t``'s flush would swallow
+    ``t+1``'s rows into the wrong timestamp.
+
+    Returns ``(ex_list, req_start, reqs)``; requirements are
+    ``(req_prepared, req_passed)`` pairs.  Round ``t+1``:
+
+    * may start its generator (segment 0: flush the non-ingest-safe
+      pre-exchange subgraph) once round ``t`` satisfies ``req_start``;
+    * may resume past its ``k``-th yield (flush exchange ``k`` and run
+      the following segment) once round ``t`` satisfies ``reqs[k]`` —
+      whose passed component is always ``>= k+1``, so rounds also flush
+      each exchange in timestamp order.
+
+    A round satisfies ``(p, q)`` when it has PREPARED ``>= p`` exchanges
+    (prepare runs at yield arrival, so prepared = passed + 1 while
+    suspended) and PASSED (resumed beyond) ``>= q``.
+
+    The requirement for delivering into a node ``n``:
+
+    * exchange: prepared component ``idx(n)+1`` — ``t``'s ``prepare(t)``
+      at the yield drained the buffer, even if its flush still blocks on
+      peers (this distinction is what lets round ``t+1`` run the groupby
+      segment and SEND its join-exchange batches while ``t`` still waits
+      for the join exchange's remote data);
+    * regular node: passed component = highest-index exchange in ``n``'s
+      upstream closure + 1 — after that atomic segment, ``t`` has
+      delivered and flushed everything it ever will through ``n``;
+    * late non-exchange node (e.g. as-of-now index): passed component =
+      first exchange AFTER ``n`` in node-list order + 1 (the late pass
+      is list-ordered, so by then ``n``'s round-``t`` flush ran); with
+      no later exchange, ``inf`` — round ``t`` must fully finish
+      (lockstep for that tail, the round-3 behavior).
+    """
+    nodes = engine.nodes
+    pos = {n.id: i for i, n in enumerate(nodes)}
+    ex_list = [n for n in nodes if isinstance(n, ExchangeNode)]
+    ex_idx = {n.id: k for k, n in enumerate(ex_list)}
+    inf = float("inf")
+
+    producers: dict[int, list] = {}
+    for n in nodes:
+        for c, _p in n.downstream:
+            producers.setdefault(c.id, []).append(n)
+
+    up_memo: dict[int, float] = {}
+
+    def up_req(n) -> float:
+        """1 + max exchange index in n's upstream closure (0 if none)."""
+        if n.id in up_memo:
+            return up_memo[n.id]
+        up_memo[n.id] = 0  # cycle guard (pw.iterate)
+        best: float = 0
+        for p in producers.get(n.id, ()):
+            if isinstance(p, ExchangeNode):
+                best = max(best, ex_idx[p.id] + 1)
+            else:
+                best = max(best, up_req(p))
+        up_memo[n.id] = best
+        return best
+
+    ex_pos = sorted((pos[e.id], ex_idx[e.id]) for e in ex_list)
+
+    def late_guard(n) -> float:
+        p = pos[n.id]
+        for q, k in ex_pos:
+            if q > p:
+                return k + 1
+        return inf
+
+    def delivered_req(starts, skip_safe: bool = False) -> tuple:
+        req_prepared: float = 0
+        req_passed: float = 0
+        seen: set[int] = set()
+        stack = list(starts)
+        while stack:
+            n = stack.pop()
+            if n.id in seen:
+                continue
+            seen.add(n.id)
+            if skip_safe and n.id in safe_ids:
+                # flushed in stage 1 (step_ingest), which prepares its
+                # first-hop exchanges immediately and in round order
+                continue
+            if isinstance(n, ExchangeNode):
+                req_prepared = max(req_prepared, ex_idx[n.id] + 1)
+                continue  # deliveries stop at the (prepared) buffer
+            r = up_req(n)
+            if n.late:
+                r = max(r, late_guard(n))
+            req_passed = max(req_passed, r)
+            stack.extend(c for c, _p in n.downstream)
+        return req_prepared, req_passed
+
+    req_start = delivered_req(
+        [c for s in engine.sources for c, _p in s.downstream], skip_safe=True
+    )
+    reqs = [
+        delivered_req([c for c, _p in e.downstream]) for e in ex_list
+    ]
+    # settlement threshold per exchange: once a round has PASSED this many
+    # exchanges, E's input can no longer grow — the driver may prepare()
+    # (snapshot + send) E's batch for the round EAGERLY, long before the
+    # round's own yield reaches it.  This is what ships a downstream
+    # exchange's round-t batches while the round still blocks upstream.
+    ups = []
+    for e in ex_list:
+        best: float = 0
+        for p in producers.get(e.id, ()):
+            if isinstance(p, ExchangeNode):
+                best = max(best, ex_idx[p.id] + 1)
+            else:
+                best = max(best, up_req(p))
+        ups.append(best)
+    return ex_list, req_start, reqs, ups
 
 
 def ingest_safe_nodes(engine) -> tuple[set[int], list["ExchangeNode"]]:

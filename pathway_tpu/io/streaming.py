@@ -488,26 +488,43 @@ class StreamingDriver:
         # serializing the whole cluster per round (the role timely's
         # frontier-based progress tracking plays in the reference);
         # stage 2 (receive + stateful flush) completes rounds in order.
-        from ..internals.exchange import ingest_safe_nodes
+        from ..internals.exchange import ingest_safe_nodes, wavefront_requirements
 
         safe_ids, first_hop = ingest_safe_nodes(self.engine)
+        safe_frozen = frozenset(safe_ids)
+        ex_list, req_start, reqs, ups = wavefront_requirements(
+            self.engine, safe_ids
+        )
+        # the lookahead window counts DATA-CARRYING rounds (real memory);
+        # empty ticks are nearly free (a few control frames) and get a
+        # separate, much larger cap — otherwise at a 20 ms tick the
+        # window fills with empty rounds in a fraction of a second and
+        # later batches have no in-flight round to land in
         lookahead = max(
             1, int(os.environ.get("PATHWAY_EXCHANGE_LOOKAHEAD", "4"))
         )
-        if not first_hop or plane.n == 1:
-            # nothing can run ahead safely / no peers to straggle —
-            # lookahead would only add dead output latency
+        max_rounds = max(
+            lookahead,
+            int(os.environ.get("PATHWAY_EXCHANGE_MAX_ROUNDS", "512")),
+        )
+        if plane.n == 1 or (not first_hop and not reqs):
+            # no peers to straggle / nothing can overlap — lookahead
+            # would only add dead output latency
             lookahead = 1
+            max_rounds = 1
 
         from collections import deque
 
-        inflight: deque[tuple[int, bool]] = deque()
+        inflight: deque[tuple[int, bool, bool]] = deque()  # (t, done, has_data)
         t_next = 1
 
         def ingest_round() -> None:
+            # pacing is the CALLER's job (the wavefront loop ticks this on
+            # the autocommit cadence instead of sleeping here, so a
+            # lookahead window never serializes W sleeps ahead of stage 2)
             nonlocal t_next
             t = t_next
-            _time.sleep(self.autocommit_ms / 1000.0)
+            had_data = False
             for subject, _src in self.subject_src:
                 if subject._autocommit_ms is not None:
                     subject.commit()
@@ -528,6 +545,7 @@ class StreamingDriver:
                     src.push(t, entries)
                     self._write_snapshot(subject, entries)
                     self._record_connector(subject, len(entries))
+                    had_data = True
             done = local_closed and t >= max_static
             # the control flag rides ahead with the data plane; every
             # process still sees the same flag set for round t
@@ -536,18 +554,221 @@ class StreamingDriver:
                 {p: [done] for p in range(plane.n) if p != plane.me},
                 is_entries=False,
             )
+            # static rows queued directly on sources also make a round
+            # data-carrying (flow control must bound their memory too)
+            had_data = had_data or any(
+                src.has_pending(t) for src in self.engine.sources
+            )
             self.engine.step_ingest(t, safe_ids, first_hop)
-            inflight.append((t, done))
+            with inflight_lock:
+                inflight.append((t, done, had_data))
             t_next += 1
 
-        while True:
-            while len(inflight) < lookahead:
-                ingest_round()
-            t, done = inflight.popleft()
-            peer_flags = plane.recv("__ctl__", t)
-            self.engine.step(t)
-            if done and all(f for f in peer_flags):
-                break
+        # --- cross-round wavefront (VERDICT r3 #4) -------------------
+        # Each inflight round owns a resumable engine.step_iter generator
+        # that yields at every exchange flush.  Rounds advance oldest
+        # first; round t+1 may start (or resume past yield k) only once
+        # round t has passed req_start (reqs[k]) exchanges — the static
+        # guards from wavefront_requirements that keep every node's
+        # timestamp order intact.  At each yield the exchange's batches
+        # are SENT immediately, so a downstream exchange ships round
+        # t+1's data while an upstream straggler still completes t —
+        # previously chained exchanges (groupby→join) fell back to
+        # lockstep here.
+
+        _INF = float("inf")
+
+        class _Round:
+            __slots__ = ("t", "gen", "started", "waiting", "passed",
+                         "finished", "blocked_since")
+
+            def __init__(self, t, gen):
+                self.t = t
+                self.gen = gen
+                self.started = False
+                self.waiting = None  # exchange node at the current yield
+                self.passed = 0
+                self.finished = False
+                self.blocked_since = None
+
+        def _resume(r: "_Round") -> None:
+            try:
+                node = r.gen.send(None)
+            except StopIteration:
+                r.finished = True
+                r.waiting = None
+                return
+            r.waiting = node
+            # send NOW: input for this round is settled (the generator
+            # only yields after quiescence); receivers buffer by time
+            node.prepare(r.t)
+            # eager prepare: any LATER exchange whose whole upstream has
+            # already been passed can no longer receive round-r input —
+            # snapshot and SEND its batch immediately, so peers stop
+            # waiting on it even though this round's own yield is still
+            # several hops away (e.g. the sums-side join input while the
+            # counts side stalls)
+            for k2 in range(r.passed + 1, len(ex_list)):
+                if ups[k2] <= r.passed and not ex_list[k2].broadcast:
+                    ex_list[k2].prepare(r.t)
+
+        rounds: deque[_Round] = deque()
+        # peers' done flags, consumed eagerly so the wavefront can know
+        # the FINAL round before running past it: rounds after the
+        # globally-done round must never start, or processes would finish
+        # at different frontiers and desync the finish()-time exchange
+        ctl_cache: dict[int, list] = {}
+
+        def _ctl_ready(t: int) -> bool:
+            if t in ctl_cache:
+                return True
+            if plane.poll("__ctl__", t):
+                ctl_cache[t] = plane.recv("__ctl__", t)
+                return True
+            return False
+
+        def _globally_done(i: int) -> bool:
+            t, done_local, _data = inflight[i]
+            return done_local and t in ctl_cache and all(ctl_cache[t])
+
+        def _try_advance(i: int) -> bool:
+            r = rounds[i]
+            prev = rounds[i - 1] if i > 0 else None
+
+            def prev_ok(need) -> bool:
+                if prev is None or prev.finished:
+                    return True
+                need_prepared, need_passed = need
+                if need_prepared == _INF or need_passed == _INF:
+                    return False  # requires prev to fully finish
+                if prev.passed < need_passed:
+                    return False
+                # prepared-or-flushed, queried per exchange: eager
+                # prepares (in _resume) may run far ahead of prev's yield
+                for k2 in range(int(need_prepared)):
+                    e = ex_list[k2]
+                    if prev.t not in e._prepared and e.has_pending(prev.t):
+                        return False
+                return True
+
+            prog = False
+            while not r.finished:
+                if not r.started:
+                    if prev is not None and (
+                        not _ctl_ready(prev.t) or _globally_done(i - 1)
+                    ):
+                        # don't run past the last real round: every
+                        # process must stop at the same frontier
+                        break
+                    if not prev_ok(req_start):
+                        break
+                    r.started = True
+                    _resume(r)
+                elif r.waiting is not None:
+                    k = r.passed
+                    ready = prev_ok(reqs[k]) and plane.poll(
+                        r.waiting.channel, r.t
+                    )
+                    if not ready:
+                        if r.blocked_since is None:
+                            r.blocked_since = _time.monotonic()
+                        elif (
+                            i == 0
+                            and _time.monotonic() - r.blocked_since
+                            > plane.barrier_timeout
+                        ):
+                            # hung-but-connected peer: force the flush so
+                            # recv raises its descriptive TimeoutError
+                            # instead of parking forever
+                            r.blocked_since = None
+                            r.passed += 1
+                            _resume(r)
+                            prog = True
+                            continue
+                        break
+                    r.blocked_since = None
+                    r.passed += 1
+                    _resume(r)
+                else:  # pragma: no cover — finished handled by loop guard
+                    break
+                prog = True
+            return prog
+
+        # --- stage-1 ingest thread ----------------------------------
+        # A slow operator (long UDF) blocks the engine thread mid-round;
+        # if ingest ran on the same thread, this process would also stop
+        # shipping ctl flags + first-hop batches for LATER rounds, and
+        # every peer's wavefront would stall on us (the reference keeps
+        # connector/commit machinery off the worker threads for the same
+        # reason, src/connectors/mod.rs reader threads + commit ticks).
+        # The ingest thread owns: subjects, source queue pushes, the
+        # ingest-safe subgraph (step_ingest), first-hop prepares and ctl
+        # sends.  The engine thread never touches those (step_iter skips
+        # safe_ids), so the two domains are disjoint; `inflight` hands
+        # rounds over under a lock.
+        autocommit_s = self.autocommit_ms / 1000.0
+        inflight_lock = threading.Lock()
+        stop_ingest = threading.Event()
+        ingest_error: list[BaseException] = []
+
+        def ingest_loop() -> None:
+            try:
+                while not stop_ingest.is_set():
+                    with inflight_lock:
+                        data_inflight = sum(1 for e in inflight if e[2])
+                        total = len(inflight)
+                    if data_inflight >= lookahead or total >= max_rounds:
+                        _time.sleep(0.005)
+                        continue
+                    _time.sleep(autocommit_s)
+                    if stop_ingest.is_set():
+                        return
+                    ingest_round()
+            except BaseException as exc:  # noqa: BLE001 — surfaced by main
+                ingest_error.append(exc)
+
+        ingest_thread = threading.Thread(target=ingest_loop, daemon=True)
+        ingest_thread.start()
+        try:
+            while True:
+                if ingest_error:
+                    raise ingest_error[0]
+                with inflight_lock:
+                    n_inflight = len(inflight)
+                    new_rounds = [
+                        inflight[i][0] for i in range(len(rounds), n_inflight)
+                    ]
+                for t_new in new_rounds:
+                    rounds.append(
+                        _Round(
+                            t_new,
+                            self.engine.step_iter(t_new, skip_ids=safe_frozen),
+                        )
+                    )
+                if not rounds:
+                    plane.wait_any(0.02)
+                    continue
+                progressed = False
+                for i in range(len(rounds)):
+                    if _try_advance(i):
+                        progressed = True
+                if rounds and rounds[0].finished:
+                    rounds.popleft()
+                    with inflight_lock:
+                        t, done, _data = inflight.popleft()
+                    while not _ctl_ready(t):
+                        plane.wait_any(0.05)
+                    peer_flags = ctl_cache.pop(t)
+                    if done and all(f for f in peer_flags):
+                        break
+                    continue
+                if not progressed:
+                    # every round is blocked on peer data — park until
+                    # inbox activity (bounded so liveness checks re-run)
+                    plane.wait_any(0.05)
+        finally:
+            stop_ingest.set()
+            ingest_thread.join(timeout=10)
         self._record_finished_connectors()
         self.engine.finish()
         plane.close()
