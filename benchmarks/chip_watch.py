@@ -136,6 +136,17 @@ def fire_suite() -> bool:
 
 
 def main() -> int:
+    # single-instance lock: two watchers would fire two bench runs into the
+    # same rare healthy window and likely time both out
+    import fcntl
+
+    lock = open(os.path.join(HERE, ".chip_watch.lock"), "w")
+    try:
+        fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        _log("another chip_watch.py holds the lock — exiting")
+        return 0
+
     interval = 120.0
     once = "--once" in sys.argv
     for a in sys.argv[1:]:

@@ -569,8 +569,13 @@ def wavefront_requirements(engine, safe_ids: set):
     never read that buffer again — otherwise ``t``'s flush would swallow
     ``t+1``'s rows into the wrong timestamp.
 
-    Returns ``(ex_list, req_start, reqs)``; requirements are
-    ``(req_prepared, req_passed)`` pairs.  Round ``t+1``:
+    Returns ``(ex_list, req_start, reqs, ups)``.  ``req_start`` and the
+    per-exchange ``reqs[k]`` are ``(req_prepared, req_passed)`` pairs;
+    ``ups[k]`` is exchange ``k``'s *settlement threshold*: once a round
+    has PASSED that many exchanges, ``k``'s input can no longer grow, so
+    the driver may ``prepare()`` (snapshot + send) its batch for the
+    round eagerly, before the round's own yield reaches it.  Round
+    ``t+1``:
 
     * may start its generator (segment 0: flush the non-ingest-safe
       pre-exchange subgraph) once round ``t`` satisfies ``req_start``;
@@ -622,7 +627,14 @@ def wavefront_requirements(engine, safe_ids: set):
             if isinstance(p, ExchangeNode):
                 best = max(best, ex_idx[p.id] + 1)
             else:
-                best = max(best, up_req(p))
+                r = up_req(p)
+                if p.late:
+                    # a late producer flushes in the list-ordered late
+                    # pass, not when its inputs settle — anything fed by
+                    # it (including an exchange's eager-prepare `ups`
+                    # threshold) must wait for the exchange AFTER it
+                    r = max(r, late_guard(p))
+                best = max(best, r)
         up_memo[n.id] = best
         return best
 
@@ -677,7 +689,14 @@ def wavefront_requirements(engine, safe_ids: set):
             if isinstance(p, ExchangeNode):
                 best = max(best, ex_idx[p.id] + 1)
             else:
-                best = max(best, up_req(p))
+                r = up_req(p)
+                if p.late:
+                    # a DIRECT late producer delivers during the late
+                    # pass; E's input settles only after the exchange
+                    # following it in node order (same guard up_req
+                    # applies to transitive late producers)
+                    r = max(r, late_guard(p))
+                best = max(best, r)
         ups.append(best)
     return ex_list, req_start, reqs, ups
 

@@ -73,6 +73,12 @@ def _pallas_attention_fn(query, key, value, bias=None, mask=None, **_kw):
     ([batch, 1, 1, kv] broadcast), so it reduces to a per-key bool."""
     from ..ops.flash_attention import flash_attention
 
+    if bias is not None:
+        # the kernel has no bias term; computing without it would be
+        # silently wrong — refuse loudly like the mask-shape check below
+        raise ValueError(
+            "attention_impl='pallas' does not support an attention bias"
+        )
     kv_mask = None
     if mask is not None:
         if mask.ndim != 4 or mask.shape[-2] != 1:
@@ -175,11 +181,13 @@ def _bucket(value: int, buckets: Sequence[int]) -> int:
 
 def bucketed_dispatch(
     apply_fn, ids_all, mask_all, max_length: int, type_ids_all=None,
-    vocab_size: int = 1 << 31,
+    vocab_size: int = 1 << 31, batch_multiple: int = 1,
 ) -> np.ndarray:
     """Pad (batch, seq) to buckets and dispatch chunks through a jitted
     ``apply_fn(ids, mask[, type_ids])`` — one compilation per
-    (batch_bucket, seq_bucket).  Shared by SentenceEncoder and CrossEncoder."""
+    (batch_bucket, seq_bucket).  Shared by SentenceEncoder and CrossEncoder.
+    ``batch_multiple`` rounds the batch bucket up so the batch dimension
+    divides evenly over a data-parallel mesh axis."""
     longest = int(mask_all.sum(axis=1).max())
     seq = min(_bucket(longest, SEQ_BUCKETS), max_length)
     ids_all, mask_all = ids_all[:, :seq], mask_all[:, :seq]
@@ -187,6 +195,8 @@ def bucketed_dispatch(
         type_ids_all = type_ids_all[:, :seq]
     b = ids_all.shape[0]
     bb = _bucket(b, BATCH_BUCKETS)
+    if bb % batch_multiple:
+        bb += batch_multiple - bb % batch_multiple
     # dispatch every chunk before collecting any result: JAX's async
     # dispatch queues the launches back-to-back, so device compute and
     # host→device transfers for chunk n+1 overlap the device→host copy of
@@ -236,6 +246,7 @@ class SentenceEncoder:
         cfg: EncoderConfig | None = None,
         seed: int = 0,
         max_length: int = 256,
+        mesh=None,
     ):
         self.pretrained = False
         params = None
@@ -265,6 +276,20 @@ class SentenceEncoder:
             self.params = self.model.init(
                 jax.random.PRNGKey(seed), ids, jnp.ones_like(ids)
             )["params"]
+        # multi-chip serving (SURVEY §2.7): weights tensor-parallel over the
+        # mesh's model axis, batches data-parallel over its data axis — XLA
+        # inserts the psums/all-gathers from the committed placements
+        self.mesh = mesh
+        self._batch_multiple = 1
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from ..parallel.mesh import data_axis
+            from ..parallel.sharding import batch_spec, shard_params
+
+            self.params = shard_params(self.params, mesh)
+            self._data_sharding = NamedSharding(mesh, batch_spec())
+            self._batch_multiple = int(mesh.shape.get(data_axis, 1))
         self._apply = functools.partial(jax.jit(self._forward))
 
     def _forward(self, params, ids, mask):
@@ -284,12 +309,20 @@ class SentenceEncoder:
         ids_all, mask_all = self.tokenizer.encode_batch(
             list(texts), max_length=self.max_length
         )
+
+        def dispatch(ids, mask):
+            if self.mesh is not None:
+                ids = jax.device_put(ids, self._data_sharding)
+                mask = jax.device_put(mask, self._data_sharding)
+            return self._apply(self.params, ids, mask)
+
         return bucketed_dispatch(
-            lambda ids, mask: self._apply(self.params, ids, mask),
+            dispatch,
             ids_all,
             mask_all,
             self.max_length,
             vocab_size=self.cfg.vocab_size,
+            batch_multiple=self._batch_multiple,
         )
 
     def __call__(self, text: str) -> np.ndarray:

@@ -109,11 +109,25 @@ class _FilteredMixin:
 
 class BruteForceKnnIndex(_FilteredMixin, InnerIndexImpl):
     """Exact KNN in HBM (ops/knn.py) — replaces both the reference's
-    brute-force index and, on TPU, the USearch HNSW one."""
+    brute-force index and, on TPU, the USearch HNSW one.
 
-    def __init__(self, dim: int, metric: str = "cos", capacity: int = 1024):
+    With ``mesh`` the vector matrix is row-sharded over the mesh's data
+    axis and queries merge across chips over ICI (parallel/index.py) —
+    the multi-chip inversion of the reference's full-replica-per-worker
+    design (src/engine/dataflow/operators/external_index.rs:95-98)."""
+
+    def __init__(
+        self, dim: int, metric: str = "cos", capacity: int = 1024, mesh=None
+    ):
         _FilteredMixin.__init__(self)
-        self.index = DeviceKnnIndex(dim=dim, metric=metric, capacity=capacity)
+        if mesh is not None:
+            from ...parallel.index import ShardedKnnIndex
+
+            self.index = ShardedKnnIndex(
+                dim=dim, mesh=mesh, metric=metric, capacity=capacity
+            )
+        else:
+            self.index = DeviceKnnIndex(dim=dim, metric=metric, capacity=capacity)
 
     def add(self, key, data, metadata) -> None:
         self.index.upsert(key, np.asarray(data, dtype=np.float32))
@@ -307,17 +321,20 @@ def _call_embedder(embedder, text: str):
 
 @dataclass
 class BruteForceKnnFactory(InnerIndexFactory):
-    """reference: nearest_neighbors.py:482"""
+    """reference: nearest_neighbors.py:482.  ``mesh`` shards the index
+    over a device mesh (ShardedKnnIndex) for multi-chip serving."""
 
     dimensions: int | None = None
     reserved_space: int = 1024
     metric: str = USearchMetricKind.COS
     embedder: Any = None
+    mesh: Any = None
 
     def build_inner_index(self) -> InnerIndexImpl:
         dim = self._resolve_dim(self.dimensions, self.embedder)
         return BruteForceKnnIndex(
-            dim=dim, metric=self.metric, capacity=self.reserved_space
+            dim=dim, metric=self.metric, capacity=self.reserved_space,
+            mesh=self.mesh,
         )
 
 
@@ -334,11 +351,13 @@ class UsearchKnnFactory(InnerIndexFactory):
     expansion_add: int = 0
     expansion_search: int = 0
     embedder: Any = None
+    mesh: Any = None
 
     def build_inner_index(self) -> InnerIndexImpl:
         dim = self._resolve_dim(self.dimensions, self.embedder)
         return BruteForceKnnIndex(
-            dim=dim, metric=self.metric, capacity=self.reserved_space
+            dim=dim, metric=self.metric, capacity=self.reserved_space,
+            mesh=self.mesh,
         )
 
 

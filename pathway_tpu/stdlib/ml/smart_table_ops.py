@@ -174,14 +174,14 @@ def _score_pairs(
             best_right[rk] = (p, lk, w)
 
     out = []
-    seen = set()
     for rk, (p, lk, w) in best_right.items():
         if symmetric:
-            a, b = (lk, rk) if lk < rk else (rk, lk)
-            if (a, b) in seen:
-                continue
-            seen.add((a, b))
-            out.append((a, b, w))
+            # reference's final filter(left < right) (_fuzzy_join.py):
+            # a pair surviving the double argmax only in the (c, b) with
+            # c > b orientation is DROPPED, not normalized — matching
+            # that exactly (ADVICE r4)
+            if lk < rk:
+                out.append((lk, rk, w))
         else:
             out.append((lk, rk, w))
     return out

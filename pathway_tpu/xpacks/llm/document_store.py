@@ -63,8 +63,30 @@ class DocumentStore:
         parser: Callable | None = None,
         splitter: Callable | None = None,
         doc_post_processors: list[Callable] | None = None,
+        mesh: Any = None,
     ):
         self.docs = [docs] if isinstance(docs, Table) else list(docs)
+        if mesh is not None:
+            # device-mesh knob: row-shard any KNN retriever over the mesh
+            # (parallel/index.py) — applied to every sub-factory of a
+            # hybrid factory too, when it exposes an unset ``mesh``
+            # field.  Caller-owned factory objects are copied, not
+            # mutated, so reuse with another server keeps its own mesh.
+            import copy
+            import dataclasses as _dc
+
+            subs = getattr(retriever_factory, "retriever_factories", None)
+            if subs is not None:
+                retriever_factory = copy.copy(retriever_factory)
+                retriever_factory.retriever_factories = [
+                    _dc.replace(f, mesh=mesh)
+                    if getattr(f, "mesh", "-") is None
+                    else f
+                    for f in subs
+                ]
+            elif getattr(retriever_factory, "mesh", "-") is None:
+                retriever_factory = _dc.replace(retriever_factory, mesh=mesh)
+        self.mesh = mesh
         self.retriever_factory = retriever_factory
         self.parser = parser if parser is not None else Utf8Parser()
         self.splitter = splitter if splitter is not None else null_splitter

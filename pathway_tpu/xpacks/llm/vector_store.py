@@ -84,6 +84,7 @@ class VectorStoreServer:
         splitter: Callable | None = None,
         doc_post_processors: list[Callable] | None = None,
         index_factory: Any = None,
+        mesh: Any = None,
     ):
         self.docs = list(docs)
         self.embedder = embedder
@@ -93,7 +94,17 @@ class VectorStoreServer:
         if index_factory is None:
             if embedder is None:
                 raise ValueError("provide embedder= or index_factory=")
-            index_factory = UsearchKnnFactory(embedder=embedder)
+            index_factory = UsearchKnnFactory(embedder=embedder, mesh=mesh)
+        elif mesh is not None and getattr(index_factory, "mesh", "-") is None:
+            # device-mesh knob (SURVEY §2.7): shard the KNN matrix over the
+            # mesh's data axis instead of replicating per worker like the
+            # reference (external_index.rs:95-98 broadcast replica).  Only
+            # factories exposing an unset ``mesh`` field participate; the
+            # caller's factory object is left untouched.
+            import dataclasses as _dc
+
+            index_factory = _dc.replace(index_factory, mesh=mesh)
+        self.mesh = mesh
         self.index_factory = index_factory
         self._graph = self._build_graph()
 
