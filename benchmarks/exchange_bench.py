@@ -1,0 +1,134 @@
+"""2-process exchange throughput harness.
+
+Companion to wordcount.py for the distributed hot path: the same
+select → groupby → count graph sharded over two processes on localhost,
+rows crossing the authed TCP exchange plane (internals/exchange.py).
+reference: integration_tests/wordcount/base.py runs its wordcount over
+n_processes the same way (timely Cluster on 127.0.0.1).
+
+Run: ``JAX_PLATFORMS=cpu python benchmarks/exchange_bench.py [n_rows]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+_PROG = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+input_dir, out_path = sys.argv[1:3]
+t = pw.io.fs.read(input_dir, format="plaintext", mode="static")
+words = t.select(w=pw.apply(lambda line: line.split(), t.data)).flatten(pw.this.w)
+counts = words.groupby(words.w).reduce(words.w, c=pw.reducers.count())
+pw.io.jsonlines.write(counts, out_path)
+t0 = time.perf_counter()
+pw.run()
+if os.environ.get("PATHWAY_PROCESS_ID", "0") == "0":
+    with open(out_path + ".time", "w") as f:
+        f.write(str(time.perf_counter() - t0))
+"""
+
+
+def _free_port_block(n: int = 2) -> int:
+    for _ in range(50):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        others = []
+        try:
+            for i in range(1, n):
+                o = socket.socket()
+                o.bind(("127.0.0.1", base + i))
+                others.append(o)
+            return base
+        except OSError:
+            continue
+        finally:
+            s.close()
+            for o in others:
+                o.close()
+    raise RuntimeError("no free port block")
+
+
+def run(n_rows: int = 100_000, n_words: int = 997, processes: int = 2) -> dict:
+    with tempfile.TemporaryDirectory() as tmp:
+        input_dir = os.path.join(tmp, "in")
+        os.makedirs(input_dir)
+        words_per_line = 8
+        n_lines = n_rows // words_per_line
+        with open(os.path.join(input_dir, "data.txt"), "w") as f:
+            for i in range(n_lines):
+                f.write(
+                    " ".join(
+                        f"word{(i * words_per_line + j) % n_words}"
+                        for j in range(words_per_line)
+                    )
+                    + "\n"
+                )
+        prog = os.path.join(tmp, "prog.py")
+        with open(prog, "w") as f:
+            f.write(_PROG)
+        out = os.path.join(tmp, "out.jsonl")
+        env = dict(os.environ)
+        env.update(
+            PATHWAY_PROCESSES=str(processes),
+            PATHWAY_FIRST_PORT=str(_free_port_block(processes)),
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+        )
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "pathway_tpu", "spawn", sys.executable,
+             prog, input_dir, out],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+            cwd=REPO,
+        )
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0:
+            return {
+                "metric": "exchange_2proc_rows_per_sec",
+                "error": f"rc={proc.returncode}: {proc.stderr[-300:]}",
+            }
+        # verify: counts over all output shards must sum to n_rows
+        total = 0
+        import glob
+
+        for p in glob.glob(out + "*"):
+            if p.endswith(".time"):
+                continue
+            for line in open(p):
+                total += json.loads(line)["c"]
+        n_emitted = n_lines * words_per_line
+        assert total == n_emitted, (total, n_emitted)
+        # prefer the in-graph timing (excludes interpreter startup)
+        t_path = out + ".time"
+        elapsed = (
+            float(open(t_path).read()) if os.path.exists(t_path) else wall
+        )
+        return {
+            "metric": "exchange_2proc_rows_per_sec",
+            "value": round(n_emitted / elapsed, 1),
+            "unit": "rows/sec",
+            "n_rows": n_emitted,
+            "processes": processes,
+            "wall_secs": round(wall, 1),
+        }
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    print(json.dumps(run(n)))

@@ -33,12 +33,16 @@ from .internals.value import (
 from .internals.keys import ref_scalar, unsafe_make_pointer
 from .internals.schema import (
     Schema,
+    SchemaProperties,
     column_definition,
+    schema_from_csv,
     schema_from_types,
     schema_from_dict,
     schema_from_pandas,
     schema_builder,
 )
+from .internals.pyobject import PyObjectWrapper, wrap_py_object
+from .internals.custom_reducers import BaseCustomAccumulator
 from .internals.expression import (
     ApplyExpression,
     AsyncApplyExpression,
@@ -56,11 +60,22 @@ from .internals.expression import (
 )
 from .internals.thisclass import this, left, right
 from .internals.table import Table, TableLike, groupby
+from .internals.table_slice import TableSlice
 from .internals.groupbys import GroupedTable
-from .internals.joins import JoinMode, JoinResult
+from .internals.joins import (
+    JoinMode,
+    JoinResult,
+    OuterJoinResult,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+)
 from .internals import reducers
 from .internals import udfs
-from .internals.udfs import UDF, udf
+from .internals.udfs import UDF, UDFAsync, UDFSync, udf, udf_async
+from .internals.interactive import LiveTable, enable_interactive_mode
 from .internals.row_transformer import (
     ClassArg,
     input_attribute,
@@ -77,6 +92,11 @@ from .internals.iterate import iterate, iterate_universe
 __version__ = "0.1.0"
 
 Type = dt  # pw.Type-ish access to dtypes
+
+# reference type-name parity (python/pathway/__init__.py): anything
+# joinable is a TableLike here; grouped joins reduce through GroupedTable
+Joinable = TableLike
+GroupedJoinResult = GroupedTable
 
 
 # ---------------------------------------------------------------------------
@@ -213,6 +233,7 @@ def assert_table_has_schema(
     *,
     allow_superset: bool = True,
     ignore_primary_keys: bool = True,
+    allow_subtype: bool = True,
 ) -> None:
     """reference: internals/asserts.py"""
     from .internals.schema import is_subschema
@@ -221,6 +242,12 @@ def assert_table_has_schema(
         ok = is_subschema(table.schema, schema)
     else:
         ok = is_subschema(table.schema, schema) and is_subschema(schema, table.schema)
+    if ok and not allow_subtype:
+        cols = table.schema.columns()
+        ok = all(
+            n in cols and cols[n].dtype == c.dtype
+            for n, c in schema.columns().items()
+        )
     if not ok:
         raise AssertionError(
             f"table schema {table.schema!r} does not match expected {schema!r}"
@@ -267,6 +294,8 @@ _LAZY_SUBMODULES = {
     "models",
     "parallel",
     "cli",
+    "viz",
+    "asynchronous",
 }
 
 
@@ -285,6 +314,69 @@ def global_error_log():
     from .internals.errors import global_error_log as _gel
 
     return _gel()
+
+
+def local_error_log():
+    """``with pw.local_error_log() as log:`` — errors of operators built
+    inside the block land in ``log`` (reference: internals/errors.py:12)."""
+    from .internals.errors import local_error_log as _lel
+
+    return _lel()
+
+
+def table_transformer(
+    func=None,
+    *,
+    allow_superset=True,
+    ignore_primary_keys=True,
+    allow_subtype=True,
+    locals=None,
+):
+    """Decorator checking ``pw.Table[SomeSchema]`` annotations of the
+    wrapped function's arguments and return value at call time
+    (reference: internals/common.py:533)."""
+    import functools
+    import typing
+
+    def _flag(mapping, key):
+        return mapping.get(key, True) if isinstance(mapping, dict) else mapping
+
+    def _check(value, annotation, key):
+        schema = None
+        args = typing.get_args(annotation)
+        if args and isinstance(args[0], type) and hasattr(args[0], "__columns__"):
+            schema = args[0]
+        if schema is not None and isinstance(value, Table):
+            assert_table_has_schema(
+                value,
+                schema,
+                allow_superset=_flag(allow_superset, key),
+                ignore_primary_keys=_flag(ignore_primary_keys, key),
+                allow_subtype=_flag(allow_subtype, key),
+            )
+
+    def decorate(fn):
+        try:
+            hints = typing.get_type_hints(fn, localns=locals)
+        except Exception:
+            hints = {}
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            import inspect
+
+            bound = inspect.signature(fn).bind(*args, **kwargs)
+            for pname, pvalue in bound.arguments.items():
+                if pname in hints:
+                    _check(pvalue, hints[pname], pname)
+            result = fn(*args, **kwargs)
+            if "return" in hints:
+                _check(result, hints["return"], "return")
+            return result
+
+        return wrapper
+
+    return decorate if func is None else decorate(func)
 
 
 def load_yaml(stream):
@@ -306,15 +398,45 @@ def pandas_transformer(output_schema, output_universe=None):
 
 
 def __getattr__(name: str):
-    if name in _LAZY_SUBMODULES:
-        import importlib
+    import importlib
 
-        if name in ("indexing", "temporal", "ml", "graphs", "stateful", "statistical", "ordered", "utils"):
+    if name in _LAZY_SUBMODULES:
+        if name in ("indexing", "temporal", "ml", "graphs", "stateful", "statistical", "ordered", "utils", "viz"):
             mod = importlib.import_module(f".stdlib.{name}", __name__)
         else:
             mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name == "AsyncTransformer":
+        from .stdlib.utils.async_transformer import AsyncTransformer
+
+        globals()[name] = AsyncTransformer
+        return AsyncTransformer
+    if name in ("IntervalJoinResult", "WindowJoinResult", "AsofJoinResult"):
+        temporal = importlib.import_module(".stdlib.temporal", __name__)
+        value = getattr(temporal, name)
+        globals()[name] = value
+        return value
+    if name == "PersistenceMode":
+        from .persistence import PersistenceMode
+
+        globals()[name] = PersistenceMode
+        return PersistenceMode
+    if name == "window":
+        # reference __all__ lists ``window`` (temporal window constructors);
+        # expose the temporal window namespace under the name
+        temporal = importlib.import_module(".stdlib.temporal", __name__)
+        import types
+
+        ns = types.SimpleNamespace(
+            Window=temporal.Window,
+            tumbling=temporal.tumbling,
+            sliding=temporal.sliding,
+            session=temporal.session,
+            intervals_over=temporal.intervals_over,
+        )
+        globals()[name] = ns
+        return ns
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -369,7 +491,36 @@ __all__ = [
     "unsafe_make_pointer",
     "load_yaml",
     "global_error_log",
+    "local_error_log",
     "sql",
+    "TableSlice",
+    "SchemaProperties",
+    "schema_from_csv",
+    "PyObjectWrapper",
+    "wrap_py_object",
+    "BaseCustomAccumulator",
+    "table_transformer",
+    "Joinable",
+    "GroupedJoinResult",
+    "OuterJoinResult",
+    "join",
+    "join_inner",
+    "join_left",
+    "join_right",
+    "join_outer",
+    "udf_async",
+    "UDFAsync",
+    "UDFSync",
+    "LiveTable",
+    "enable_interactive_mode",
+    "AsyncTransformer",
+    "IntervalJoinResult",
+    "WindowJoinResult",
+    "AsofJoinResult",
+    "PersistenceMode",
+    "window",
+    "viz",
+    "asynchronous",
     "ClassArg",
     "input_attribute",
     "input_method",

@@ -1,0 +1,22 @@
+"""Deprecated alias of :mod:`pathway_tpu.udfs`.
+
+reference: python/pathway/asynchronous.py — kept for API parity; new code
+should use ``pw.udfs`` (retry strategies, caches, executors).
+"""
+
+from __future__ import annotations
+
+from warnings import warn
+
+from .internals import udfs as _udfs
+
+
+def __getattr__(name: str):
+    value = getattr(_udfs, name)
+    warn(
+        f"pathway_tpu.asynchronous.{name} is deprecated, use "
+        f"pathway_tpu.udfs.{name}",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return value

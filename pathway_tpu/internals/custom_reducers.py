@@ -1,0 +1,92 @@
+"""Public custom-accumulator base for stateful reducers.
+
+reference: python/pathway/internals/custom_reducers.py:174
+(``BaseCustomAccumulator``) — subclasses implement ``from_row``,
+``update`` and ``compute_result`` (optionally ``neutral`` / ``retract``)
+and are turned into reducers with ``pw.reducers.udf_reducer``:
+
+>>> import pathway_tpu as pw
+>>> class CustomAvg(pw.BaseCustomAccumulator):
+...     def __init__(self, sum, cnt):
+...         self.sum, self.cnt = sum, cnt
+...     @classmethod
+...     def from_row(cls, row):
+...         [val] = row
+...         return cls(val, 1)
+...     def update(self, other):
+...         self.sum += other.sum
+...         self.cnt += other.cnt
+...     def compute_result(self) -> float:
+...         return self.sum / self.cnt
+>>> custom_avg = pw.reducers.udf_reducer(CustomAvg)
+>>> t = pw.debug.table_from_markdown('''
+... owner | price
+... Alice | 100
+... Bob   | 80
+... Alice | 90
+... Bob   | 70
+... ''')
+>>> r = t.groupby(t.owner).reduce(t.owner, avg=custom_avg(t.price))
+>>> pw.debug.compute_and_print(r, include_id=False)
+owner | avg
+Alice | 95.0
+Bob   | 75.0
+"""
+
+from __future__ import annotations
+
+import pickle
+from abc import ABC, abstractmethod
+from typing import Any
+
+__all__ = ["BaseCustomAccumulator"]
+
+
+class BaseCustomAccumulator(ABC):
+    """Base for custom reducer accumulators (see module docstring).
+
+    ``serialize``/``deserialize`` default to pickle and are used when the
+    accumulator state lands in operator snapshots (persistence/)."""
+
+    @classmethod
+    def neutral(cls) -> "BaseCustomAccumulator":
+        """Accumulator of an empty group (optional)."""
+        raise NotImplementedError
+
+    @classmethod
+    @abstractmethod
+    def from_row(cls, row: list[Any]) -> "BaseCustomAccumulator":
+        """Accumulator of a single row; ``row`` lists the reducer's
+        positional argument values."""
+
+    @abstractmethod
+    def update(self, other: "BaseCustomAccumulator") -> None:
+        """Fold ``other`` (a later accumulator) into self."""
+
+    def retract(self, other: "BaseCustomAccumulator") -> None:
+        """Remove ``other`` from self (optional; enables incremental
+        deletion handling instead of group recomputation)."""
+        raise NotImplementedError
+
+    @abstractmethod
+    def compute_result(self) -> Any:
+        """Final reduced value for the group."""
+
+    def serialize(self) -> Any:
+        return pickle.dumps(self)
+
+    @classmethod
+    def deserialize(cls, data: Any) -> "BaseCustomAccumulator":
+        return pickle.loads(data)
+
+    # -- adapters to the engine's fold protocol (reducers.udf_reducer) --
+    def __add__(self, other: "BaseCustomAccumulator") -> "BaseCustomAccumulator":
+        self.update(other)
+        return self
+
+    def __sub__(self, other: "BaseCustomAccumulator") -> "BaseCustomAccumulator":
+        self.retract(other)
+        return self
+
+    def retrieve(self) -> Any:
+        return self.compute_result()

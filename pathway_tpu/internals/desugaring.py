@@ -58,6 +58,12 @@ def resolve_expression(
     return e._substitute(mapping)
 
 
+def _is_named_expr(a) -> bool:
+    from .table_slice import NamedExpr
+
+    return isinstance(a, NamedExpr)
+
+
 def expand_select_args(
     args: Iterable[Any],
     kwargs: dict[str, Any],
@@ -100,6 +106,12 @@ def expand_select_args(
             out[a.name] = resolved
         elif isinstance(a, ColumnReference):
             out[a.name] = a
+        elif _is_named_expr(a):
+            # TableSlice rename/prefix/suffix output (table_slice.py):
+            # select under the slice's output name, resolve the original
+            out[a.name] = resolve_expression(
+                a.expr, this_table, left_table, right_table
+            )
         elif isinstance(a, type) and hasattr(a, "__columns__"):
             # a Schema: select all its columns from this table
             for name in a.column_names():

@@ -116,6 +116,9 @@ class Node:
     # timestamp — the global updates-before-queries barrier that the
     # reference gets from batch_by_time (external_index.rs:129)
     late: bool = False
+    # local error-log subjects active when this node's operator was built
+    # (errors.local_error_log); () for the common case
+    error_logs: tuple = ()
 
     def __init__(self, n_inputs: int = 1, name: str = ""):
         self.n_inputs = n_inputs
@@ -1365,16 +1368,27 @@ class Engine:
             node.prepare(time)
 
     def _flush_node(self, node: Node, time: int) -> list[Entry]:
-        if self.monitor is None:
-            return node.flush(time)
-        import time as _time_mod
+        logs = node.error_logs
+        if logs:
+            from .errors import set_current_local
 
-        t0 = _time_mod.perf_counter()
-        out = node.flush(time)
-        self.monitor.record_flush(
-            node.name, len(out), _time_mod.perf_counter() - t0
-        )
-        return out
+            set_current_local(logs)
+        try:
+            if self.monitor is None:
+                return node.flush(time)
+            import time as _time_mod
+
+            t0 = _time_mod.perf_counter()
+            out = node.flush(time)
+            self.monitor.record_flush(
+                node.name, len(out), _time_mod.perf_counter() - t0
+            )
+            return out
+        finally:
+            if logs:
+                from .errors import set_current_local
+
+                set_current_local(())
 
     def has_async_ready(self) -> bool:
         """Any pipelined async node holding resolved, unemitted results."""

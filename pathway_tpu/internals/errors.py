@@ -21,17 +21,41 @@ from .schema import schema_from_types
 if TYPE_CHECKING:
     from .table import Table
 
-__all__ = ["global_error_log", "register_error"]
+__all__ = [
+    "global_error_log",
+    "local_error_log",
+    "register_error",
+    "active_local_logs",
+    "set_current_local",
+]
 
 _lock = threading.Lock()
 _subjects: list = []
+# build-time stack of local error-log subjects (`with pw.local_error_log()`)
+_local_stack: list = []
+# evaluation-time routing target: set by the engine around a node's flush
+# to the local logs that were active when the node's OPERATOR was built —
+# reference scoping: errors go to the log whose `with` block created the
+# erroring operator (internals/errors.py:12 + test_errors.py:273).
+# thread-local: concurrent engines (LiveTable background runs, threaded
+# servers) must not clobber each other's routing
+_current = threading.local()
+
+
+def active_local_logs() -> tuple:
+    """Captured by Operator.__init__ at graph-build time."""
+    return tuple(_local_stack)
+
+
+def set_current_local(logs: tuple) -> None:
+    _current.logs = logs
 
 
 def register_error(message: str, trace: str = "") -> None:
     """Called by the evaluator when terminate_on_error is off."""
     with _lock:
         subjects = list(_subjects)
-    for subject in subjects:
+    for subject in (*subjects, *getattr(_current, "logs", ())):
         subject.next(message=message, trace=trace)
         subject.commit()
 
@@ -56,3 +80,33 @@ def global_error_log() -> "Table":
     with _lock:
         _subjects.append(subject)
     return input_table(schema, subject=subject)
+
+
+def _make_log_table():
+    from ..io._utils import input_table
+    from ..io.streaming import ConnectorSubject
+
+    class _ErrorLogSubject(ConnectorSubject):
+        def run(self) -> None:
+            return
+
+    schema = schema_from_types(message=str, trace=str)
+    subject = _ErrorLogSubject(datasource_name="local_error_log")
+    subject._configure(schema, None)
+    return subject, input_table(schema, subject=subject)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def local_error_log():
+    """``with pw.local_error_log() as log:`` — runtime errors of operators
+    BUILT inside the block are recorded in ``log`` (as well as the global
+    log).  reference: internals/errors.py:12 ``local_error_log``."""
+    subject, table = _make_log_table()
+    _local_stack.append(subject)
+    try:
+        yield table
+    finally:
+        _local_stack.remove(subject)

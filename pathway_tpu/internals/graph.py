@@ -58,6 +58,11 @@ class Operator:
         self.params = params or {}
         self.outputs: list[Table] = []
         self.trace = Trace()
+        from .errors import active_local_logs
+
+        # local error logs whose `with` block is building this operator
+        # (pw.local_error_log scoping)
+        self.error_logs = active_local_logs()
         self.id = G.add_operator(self)
 
     def input_operators(self) -> "Iterable[Operator]":

@@ -1,0 +1,142 @@
+"""Interactive (notebook) mode: live tables.
+
+reference: python/pathway/internals/interactive.py — ``LiveTable._create``
+runs the origin table's subgraph on a background thread via an export
+datasink, then imports it into the foreground graph so later pipeline
+stages (and the REPL) see a continuously-updated table with
+``snapshot()`` / ``failed()`` probes.
+
+Here the same shape rides the single-language export/import pair
+(internals/export.py): the export sink's subgraph runs on a daemon
+thread with its own GraphRunner + StreamingDriver; the returned table is
+``import_table``'s live replica in the caller's graph, upgraded to
+:class:`LiveTable` for the snapshot API.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import warnings
+from typing import Any, Callable
+
+from .graph import G
+from .table import Table
+
+__all__ = ["LiveTable", "enable_interactive_mode", "is_interactive_mode_enabled"]
+
+
+class _LiveState:
+    def __init__(self) -> None:
+        self.exception: BaseException | None = None
+        self.done = threading.Event()
+
+
+class LiveTable(Table):
+    """A table whose defining subgraph runs on a background thread
+    (reference: interactive.py:130).  Use it like any other table;
+    ``snapshot()`` returns the rows materialized so far."""
+
+    _exported: Any
+    _state: _LiveState
+    _thread: threading.Thread
+
+    @classmethod
+    def _create(cls, origin: Table) -> "LiveTable":
+        from .run import MonitoringLevel
+        from .runtime import GraphRunner
+        from ..io.streaming import StreamingDriver
+        from .export import export_table, import_table
+
+        exported = export_table(origin)
+        # export_table registered a subscribe sink on G; claim it so the
+        # user's later pw.run does not re-run this subgraph
+        table, node = G.sinks.pop()
+        state = _LiveState()
+
+        def drive() -> None:
+            try:
+                runner = GraphRunner()
+                engine = runner.build([(table, node)])
+                StreamingDriver(
+                    engine, runner, monitoring_level=MonitoringLevel.NONE
+                ).run()
+            except BaseException as exc:  # noqa: BLE001 - surfaced via failed()
+                state.exception = exc
+            finally:
+                state.done.set()
+
+        thread = threading.Thread(
+            target=drive, daemon=True, name=f"live table {origin!r}"
+        )
+        thread.start()
+
+        result = import_table(exported)
+        result.__class__ = cls
+        result._exported = exported
+        result._state = state
+        result._thread = thread
+        return result
+
+    def live(self) -> "LiveTable":
+        return self
+
+    def failed(self) -> bool:
+        return self._state.exception is not None
+
+    def snapshot(self) -> list[tuple[Any, tuple]]:
+        """Rows materialized so far as ``(key, values)`` pairs."""
+        if self._state.exception is not None:
+            raise self._state.exception
+        return self._exported.snapshot_at_now()
+
+    def to_pandas(self):
+        import pandas as pd
+
+        names = self.column_names()
+        rows = self.snapshot()
+        return pd.DataFrame(
+            [dict(zip(names, values)) for _, values in rows],
+            index=[key for key, _ in rows],
+        )
+
+    def __str__(self) -> str:
+        rows = self.snapshot()
+        return f"LiveTable({len(rows)} rows)\n" + "\n".join(
+            f"{key}: {values}" for key, values in rows[:20]
+        )
+
+
+class InteractiveModeController:
+    """Patches the REPL displayhook so LiveTables print their snapshot
+    (reference: interactive.py:181)."""
+
+    def __init__(self, _pathway_internal: bool = False) -> None:
+        assert _pathway_internal, "InteractiveModeController is internal"
+        self._orig_displayhook: Callable[[object], None] = sys.displayhook
+        sys.displayhook = self._displayhook
+
+    def _displayhook(self, value: object) -> None:
+        if isinstance(value, LiveTable):
+            import builtins
+
+            builtins._ = value  # type: ignore[attr-defined]
+            print(str(value))
+        else:
+            self._orig_displayhook(value)
+
+
+_controller: InteractiveModeController | None = None
+
+
+def is_interactive_mode_enabled() -> bool:
+    return _controller is not None
+
+
+def enable_interactive_mode() -> InteractiveModeController:
+    """reference: interactive.py:199 (experimental there too)."""
+    global _controller
+    warnings.warn("interactive mode is experimental", stacklevel=2)
+    if _controller is None:
+        _controller = InteractiveModeController(_pathway_internal=True)
+    return _controller

@@ -160,3 +160,47 @@ def _refers_to(e: ColumnExpression, table: "Table") -> bool:
     if isinstance(e, ColumnReference) and e.table is table:
         return True
     return any(_refers_to(d, table) for d in e._deps())
+
+
+# ---------------------------------------------------------------------------
+# free functions + public aliases (reference: joins.py:1105-1310,
+# exported from pathway/__init__.py)
+# ---------------------------------------------------------------------------
+
+
+def join(
+    left: "Table",
+    right: "Table",
+    *on,
+    id=None,
+    how: JoinMode = JoinMode.INNER,
+    left_instance=None,
+    right_instance=None,
+) -> JoinResult:
+    """``pw.join(a, b, ...)`` == ``a.join(b, ...)`` (reference: joins.py:1105)."""
+    return left.join(
+        right, *on, id=id, how=how,
+        left_instance=left_instance, right_instance=right_instance,
+    )
+
+
+def join_inner(left: "Table", right: "Table", *on, **kwargs) -> JoinResult:
+    return left.join(right, *on, how=JoinMode.INNER, **kwargs)
+
+
+def join_left(left: "Table", right: "Table", *on, **kwargs) -> JoinResult:
+    return left.join(right, *on, how=JoinMode.LEFT, **kwargs)
+
+
+def join_right(left: "Table", right: "Table", *on, **kwargs) -> JoinResult:
+    return left.join(right, *on, how=JoinMode.RIGHT, **kwargs)
+
+
+def join_outer(left: "Table", right: "Table", *on, **kwargs) -> JoinResult:
+    return left.join(right, *on, how=JoinMode.OUTER, **kwargs)
+
+
+# reference type names kept importable for isinstance checks / signatures:
+# outer-mode joins return the same deferred JoinResult here, and anything
+# joinable is a TableLike
+OuterJoinResult = JoinResult

@@ -1,0 +1,91 @@
+"""Opaque Python-object cells.
+
+reference: python/pathway/internals/api.py:228-300 (``PyObjectWrapper``,
+``wrap_py_object``, serializer protocol).  There the wrapper ferries
+arbitrary Python objects across the PyO3 boundary into the Rust engine;
+here the engine is single-language, so the wrapper is a plain value
+class — its (de)serialization hooks matter for persistence snapshots,
+UDF caches, and sinks, and its hash feeds key derivation
+(internals/keys.py) like any other value.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Generic, TypeVar
+
+T = TypeVar("T")
+
+__all__ = [
+    "PyObjectWrapper",
+    "PyObjectWrapperSerializer",
+    "wrap_py_object",
+    "wrap_serializer",
+]
+
+
+class PyObjectWrapperSerializer:
+    """Adapter keeping only ``dumps``/``loads`` from a serializer-like
+    object (which may be a whole module, e.g. ``dill``)."""
+
+    def __init__(self, serializer: Any) -> None:
+        self._loads = serializer.loads
+        self._dumps = serializer.dumps
+
+    def dumps(self, object: Any) -> bytes:
+        return self._dumps(object)
+
+    def loads(self, data: bytes) -> Any:
+        return self._loads(data)
+
+
+def wrap_serializer(serializer: Any) -> PyObjectWrapperSerializer:
+    return PyObjectWrapperSerializer(serializer)
+
+
+class PyObjectWrapper(Generic[T]):
+    """A cell holding an arbitrary Python object (reference: api.py:256
+    ``wrap_py_object`` docs).  Construct via :func:`wrap_py_object`.
+
+    >>> import pathway_tpu as pw
+    >>> w = pw.wrap_py_object({"a": 1})
+    >>> w.value
+    {'a': 1}
+    """
+
+    __slots__ = ("value", "_serializer")
+
+    def __init__(self, value: T, *, serializer: Any | None = None) -> None:
+        self.value = value
+        self._serializer = serializer
+
+    def __repr__(self) -> str:
+        return f"pw.wrap_py_object({self.value!r})"
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, PyObjectWrapper) and self.value == other.value
+
+    def __hash__(self) -> int:
+        try:
+            return hash(("PyObjectWrapper", self.value))
+        except TypeError:
+            return hash(("PyObjectWrapper", self.dumps()))
+
+    def dumps(self) -> bytes:
+        ser = self._serializer or pickle
+        return ser.dumps(self.value)
+
+    @classmethod
+    def loads(cls, data: bytes, *, serializer: Any | None = None) -> "PyObjectWrapper":
+        ser = serializer or pickle
+        return cls(ser.loads(data), serializer=serializer)
+
+
+def wrap_py_object(
+    object: T, *, serializer: Any | None = None
+) -> PyObjectWrapper[T]:
+    """Wrap any Python object so it can live in a table cell
+    (reference: api.py:256).  ``serializer`` must expose
+    ``dumps``/``loads``; ``pickle`` is used when not given."""
+    ser = wrap_serializer(serializer) if serializer is not None else None
+    return PyObjectWrapper(object, serializer=ser)

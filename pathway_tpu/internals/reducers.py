@@ -520,9 +520,18 @@ def udf_reducer(reducer_cls):
         def result_dtype(self, arg_dtypes):
             import typing
 
-            hints = typing.get_type_hints(getattr(reducer_cls, "retrieve", None)) if hasattr(reducer_cls, "retrieve") else {}
-            if "return" in hints:
-                return dt.wrap(hints["return"])
+            # BaseCustomAccumulator subclasses annotate compute_result;
+            # raw accumulator classes annotate retrieve
+            for meth in ("compute_result", "retrieve"):
+                fn = getattr(reducer_cls, meth, None)
+                if fn is None:
+                    continue
+                try:
+                    hints = typing.get_type_hints(fn)
+                except Exception:
+                    hints = {}
+                if "return" in hints and hints["return"] is not typing.Any:
+                    return dt.wrap(hints["return"])
             return dt.ANY
 
         def compute(self, rows):

@@ -172,7 +172,13 @@ class GraphRunner:
         handler = getattr(self, f"_lower_{op.kind}", None)
         if handler is None:
             raise NotImplementedError(f"no lowering for operator kind {op.kind!r}")
+        n0 = len(self.engine.nodes)
         handler(op)
+        if op.error_logs:
+            # evaluation errors in this operator's nodes route to the
+            # local logs active when it was built (errors.local_error_log)
+            for node in self.engine.nodes[n0:]:
+                node.error_logs = op.error_logs
 
     def _lower_input(self, op: Operator) -> None:
         src = SourceNode(name=f"input#{op.id}")

@@ -322,3 +322,75 @@ def is_subschema(sub: SchemaMetaclass, sup: SchemaMetaclass) -> bool:
         if not dt.dtype_issubclass(sub.__columns__[n].dtype, c.dtype):
             return False
     return True
+
+
+def schema_from_csv(
+    path: str,
+    *,
+    name: str | None = None,
+    properties: SchemaProperties | None = None,
+    delimiter: str = ",",
+    quote: str = '"',
+    comment_character: str | None = None,
+    escape: str | None = None,
+    double_quote_escapes: bool = True,
+    num_parsed_rows: int | None = None,
+) -> SchemaMetaclass:
+    """Infer a schema from a CSV file's header + values
+    (reference: schema.py:832 ``schema_from_csv`` — same inference rules:
+    supported types are str, int and float; ``num_parsed_rows=0`` makes
+    every column ``str``)."""
+    import csv as _csv
+
+    def lines(f):
+        for line in f:
+            if comment_character and line.lstrip()[:1] == comment_character:
+                continue
+            yield line
+
+    with open(path, newline="") as f:
+        reader = _csv.reader(
+            lines(f),
+            delimiter=delimiter,
+            quotechar=quote,
+            escapechar=escape,
+            doublequote=double_quote_escapes,
+        )
+        try:
+            header = next(reader)
+        except StopIteration:
+            raise ValueError(f"no header row in {path!r}") from None
+        # candidate types per column, narrowed by every parsed value
+        could_be = [{int, float} for _ in header]
+        n = 0
+        for row in reader:
+            if num_parsed_rows is not None and n >= num_parsed_rows:
+                break
+            n += 1
+            for i, value in enumerate(row[: len(header)]):
+                cands = could_be[i]
+                if int in cands:
+                    try:
+                        int(value)
+                    except ValueError:
+                        cands.discard(int)
+                if float in cands:
+                    try:
+                        float(value)
+                    except ValueError:
+                        cands.discard(float)
+        if num_parsed_rows == 0 or n == 0:
+            types = [str] * len(header)
+        else:
+            types = [
+                int if int in c else float if float in c else str
+                for c in could_be
+            ]
+    cols = {
+        h: ColumnSchema(name=h, dtype=dt.wrap(t))
+        for h, t in zip(header, types)
+    }
+    schema = _schema_from_columns(cols, name=name)
+    if properties is not None:
+        schema.__properties__ = properties
+    return schema

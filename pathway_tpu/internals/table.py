@@ -38,6 +38,14 @@ class Table:
 
     _operator: Operator
     _schema: SchemaMetaclass
+
+    # ``pw.Table[SomeSchema]`` annotations (reference: Table is
+    # Generic[TSchema]); the parameter is carried for table_transformer /
+    # typing introspection, not enforced at construction
+    def __class_getitem__(cls, item):
+        import types as _types
+
+        return _types.GenericAlias(cls, item)
     _universe: Universe
 
     # -- construction --
@@ -471,6 +479,21 @@ class Table:
             instance=resolve_expression(instance, self) if instance is not None else None,
             optional=optional,
         )
+
+    @property
+    def slice(self):
+        """Column-set view supporting without/rename/with_prefix/with_suffix
+        (reference: table.py ``slice`` + table_slice.py)."""
+        from .table_slice import TableSlice
+
+        return TableSlice({n: self[n] for n in self.column_names()}, self)
+
+    def live(self):
+        """Run this table's subgraph on a background thread and return a
+        live replica (reference: table.py:2565 + interactive.py)."""
+        from .interactive import LiveTable
+
+        return LiveTable._create(self)
 
     def ix(
         self,

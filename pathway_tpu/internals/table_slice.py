@@ -1,0 +1,148 @@
+"""Column-set views over a table.
+
+reference: python/pathway/internals/table_slice.py — ``t.slice`` yields a
+mapping-like view of the table's columns supporting ``without``,
+``rename``, ``with_prefix``/``with_suffix`` and splatting into
+``select``/``with_columns``:
+
+>>> import pathway_tpu as pw
+>>> t = pw.debug.table_from_markdown('''
+... a | b
+... 1 | 2
+... ''')
+>>> pw.debug.compute_and_print(
+...     t.select(*t.slice.with_suffix("_new")), include_id=False)
+a_new | b_new
+1     | 2
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Iterator
+
+from .expression import ColumnReference
+
+if TYPE_CHECKING:
+    from .table import Table
+
+__all__ = ["TableSlice", "NamedExpr"]
+
+
+class NamedExpr:
+    """A (output_name, expression) pair understood by ``select``
+    (desugaring.py) — lets a slice give a column a new output name while
+    the underlying ColumnReference keeps resolving its source column."""
+
+    __slots__ = ("name", "expr")
+
+    def __init__(self, name: str, expr: ColumnReference) -> None:
+        self.name = name
+        self.expr = expr
+
+    def __repr__(self) -> str:
+        return f"NamedExpr({self.name}={self.expr!r})"
+
+
+class TableSlice:
+    """reference: table_slice.py:16."""
+
+    def __init__(self, mapping: dict[str, ColumnReference], table: "Table"):
+        self._mapping = mapping
+        self._table = table
+
+    def __iter__(self) -> Iterator[NamedExpr]:
+        return iter(
+            NamedExpr(name, ref) for name, ref in self._mapping.items()
+        )
+
+    def __repr__(self) -> str:
+        return f"TableSlice({list(self._mapping.keys())})"
+
+    def keys(self) -> list[str]:
+        return list(self._mapping.keys())
+
+    def __getitem__(self, args: Any):
+        if isinstance(args, (list, tuple)):
+            names = [self._normalize(a) for a in args]
+            return TableSlice(
+                {n: self._mapping[n] for n in names}, self._table
+            )
+        return self._mapping[self._normalize(args)]
+
+    def __getattr__(self, name: str) -> ColumnReference:
+        mapping = object.__getattribute__(self, "_mapping")
+        if name in mapping:
+            return mapping[name]
+        raise AttributeError(f"no column {name!r} in this slice")
+
+    def without(self, *cols: str | ColumnReference) -> "TableSlice":
+        drop = {self._normalize(c) for c in cols}
+        for name in drop:
+            if name not in self._mapping:
+                raise KeyError(f"column {name!r} not in this slice")
+        return TableSlice(
+            {n: r for n, r in self._mapping.items() if n not in drop},
+            self._table,
+        )
+
+    def rename(
+        self, mapping: dict[str | ColumnReference, str | ColumnReference]
+    ) -> "TableSlice":
+        renames = {
+            self._normalize(old): self._normalize(new)
+            for old, new in mapping.items()
+        }
+        for old in renames:
+            if old not in self._mapping:
+                raise KeyError(f"column {old!r} not in this slice")
+        out: dict[str, ColumnReference] = {}
+        for n, r in self._mapping.items():
+            new = renames.get(n, n)
+            if new in out or (
+                new != n and new in self._mapping and new not in renames
+            ):
+                # a rename landing on a still-present column would
+                # silently drop one of the two — refuse instead
+                raise ValueError(f"rename collides on column {new!r}")
+            out[new] = r
+        return TableSlice(out, self._table)
+
+    def with_prefix(self, prefix: str) -> "TableSlice":
+        return TableSlice(
+            {prefix + n: r for n, r in self._mapping.items()}, self._table
+        )
+
+    def with_suffix(self, suffix: str) -> "TableSlice":
+        return TableSlice(
+            {n + suffix: r for n, r in self._mapping.items()}, self._table
+        )
+
+    def ix(self, expression, *, optional: bool = False, context=None) -> "TableSlice":
+        ixed = self._table.ix(expression, optional=optional)
+        return TableSlice(
+            {n: ixed[r.name] for n, r in self._mapping.items()}, ixed
+        )
+
+    def ix_ref(self, *args, optional: bool = False, context=None) -> "TableSlice":
+        ixed = self._table.ix_ref(*args, optional=optional)
+        return TableSlice(
+            {n: ixed[r.name] for n, r in self._mapping.items()}, ixed
+        )
+
+    @property
+    def slice(self) -> "TableSlice":
+        return self
+
+    def _normalize(self, arg: str | ColumnReference) -> str:
+        if isinstance(arg, ColumnReference):
+            tab = arg.table
+            # accept refs of this table or of pw.this
+            from .thisclass import ThisColumnReference
+
+            if not isinstance(arg, ThisColumnReference) and tab is not self._table:
+                raise ValueError(
+                    "columns used in TableSlice operations must belong to "
+                    "the sliced table"
+                )
+            return arg.name
+        return str(arg)

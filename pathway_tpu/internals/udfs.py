@@ -500,3 +500,24 @@ def udf(
     if fun is not None:
         return wrap(fun)
     return wrap
+
+
+def udf_async(fun: Callable | None = None, /, **kwargs):
+    """Deprecated alias of ``@pw.udf`` with the async executor
+    (reference: pathway/__init__.py ``udf_async``)."""
+    if "executor" not in kwargs:
+        kwargs["executor"] = async_executor()
+    return udf(fun, **kwargs) if fun is not None else udf(**kwargs)
+
+
+class UDFSync(UDF):
+    """Deprecated alias of :class:`UDF` (reference parity)."""
+
+
+class UDFAsync(UDF):
+    """Deprecated alias of :class:`UDF` with the async executor."""
+
+    def __init__(self, *args, **kwargs):
+        if "executor" not in kwargs:
+            kwargs["executor"] = async_executor()
+        super().__init__(*args, **kwargs)

@@ -281,6 +281,7 @@ class SentenceEncoder:
         # inserts the psums/all-gathers from the committed placements
         self.mesh = mesh
         self._batch_multiple = 1
+        self._sp_mesh = None
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -303,13 +304,36 @@ class SentenceEncoder:
         return self.dim
 
     def encode(self, texts: Sequence[str]) -> np.ndarray:
-        """Embed a batch of strings -> [B, dim] float32 (L2-normalized)."""
+        """Embed a batch of strings -> [B, dim] float32 (L2-normalized).
+
+        With a mesh and ``max_length`` beyond the single-dispatch bucket
+        cap (512), documents longer than the cap run sequence-parallel:
+        token positions sharded over all mesh devices with ring attention
+        rotating kv blocks over ICI (parallel/long_encoder.py) — the
+        reference can only chunk such documents (splitters.py:34)."""
         if not texts:
             return np.zeros((0, self.dim), dtype=np.float32)
         ids_all, mask_all = self.tokenizer.encode_batch(
             list(texts), max_length=self.max_length
         )
 
+        if self.mesh is not None and self.max_length > SEQ_BUCKETS[-1]:
+            lengths = mask_all.sum(axis=1)
+            long_rows = lengths > SEQ_BUCKETS[-1]
+            if long_rows.any():
+                out = np.zeros((len(texts), self.dim), dtype=np.float32)
+                short = np.where(~long_rows)[0]
+                if short.size:
+                    out[short] = self._encode_bucketed(
+                        ids_all[short], mask_all[short]
+                    )
+                longi = np.where(long_rows)[0]
+                out[longi] = self._encode_ring(ids_all[longi], mask_all[longi])
+                return out
+
+        return self._encode_bucketed(ids_all, mask_all)
+
+    def _encode_bucketed(self, ids_all, mask_all) -> np.ndarray:
         def dispatch(ids, mask):
             if self.mesh is not None:
                 ids = jax.device_put(ids, self._data_sharding)
@@ -324,6 +348,39 @@ class SentenceEncoder:
             vocab_size=self.cfg.vocab_size,
             batch_multiple=self._batch_multiple,
         )
+
+    def _encode_ring(self, ids_all, mask_all) -> np.ndarray:
+        """Sequence-parallel path for documents beyond the bucket cap."""
+        from jax.sharding import Mesh
+
+        from ..parallel.long_encoder import ring_encode
+
+        if self._sp_mesh is None:
+            devices = np.asarray(self.mesh.devices).reshape(-1)
+            self._sp_mesh = Mesh(devices, ("sp",))
+        n = self._sp_mesh.shape["sp"]
+        # pad the sequence to a coarse multiple so shapes (and compiles)
+        # stay few; the mask keeps the padding out of attention + pooling.
+        # cap = max_length rounded DOWN to the shard count, so the padded
+        # length never exceeds the position table (docs at the very cap
+        # lose < n tail tokens on a non-dividing mesh)
+        step = max(n * 64, 128)
+        cap = self.max_length - self.max_length % n
+        longest = int(mask_all.sum(axis=1).max())
+        seq = min(-(-longest // step) * step, cap)
+        if seq % n:  # step itself may not divide when n*64 < 128
+            seq += n - seq % n
+            seq = min(seq, cap)
+        ids = np.zeros((ids_all.shape[0], seq), np.int32)
+        mask = np.zeros((ids_all.shape[0], seq), np.int32)
+        width = min(seq, ids_all.shape[1])
+        ids[:, :width] = ids_all[:, :width]
+        mask[:, :width] = mask_all[:, :width]
+        out = ring_encode(
+            self.params, ids, mask, self._sp_mesh, "sp",
+            num_layers=self.cfg.num_layers, ln_eps=self.cfg.ln_eps,
+        )
+        return np.asarray(out, dtype=np.float32)
 
     def __call__(self, text: str) -> np.ndarray:
         return self.encode([text])[0]

@@ -19,6 +19,7 @@ The multi-device sharded variant lives in ``pathway_tpu/parallel/index.py``.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Hashable, Sequence
 
 import jax
@@ -62,6 +63,11 @@ class DeviceKnnIndex:
         # staged updates applied lazily before the next search
         self._staged_set: dict[int, np.ndarray] = {}
         self._staged_valid: dict[int, bool] = {}
+        # the engine serializes index ops, but REST/serving threads may
+        # query while another thread ingests — a coarse reentrant lock
+        # keeps every public op a coherent snapshot (cost is ~100ns,
+        # noise next to a device dispatch)
+        self._lock = threading.RLock()
         # scatter fns — subclasses swap in sharding-preserving variants
         self._scatter_rows_fn = _scatter_rows
         self._scatter_mask_fn = _scatter_mask
@@ -86,6 +92,10 @@ class DeviceKnnIndex:
 
     # -- mutation --
     def upsert(self, key: Hashable, vector: Any) -> None:
+        with self._lock:
+            self._upsert_locked(key, vector)
+
+    def _upsert_locked(self, key: Hashable, vector: Any) -> None:
         vec = np.asarray(vector, dtype=np.float32).reshape(-1)
         if vec.shape[0] != self.dim:
             raise ValueError(
@@ -106,6 +116,10 @@ class DeviceKnnIndex:
         self._staged_valid[slot] = True
 
     def remove(self, key: Hashable) -> None:
+        with self._lock:
+            self._remove_locked(key)
+
+    def _remove_locked(self, key: Hashable) -> None:
         slot = self.slot_of_key.pop(key, None)
         if slot is None:
             return
@@ -189,6 +203,10 @@ class DeviceKnnIndex:
     ) -> list[tuple[Hashable, float]]:
         """Exact rescoring restricted to ``keys`` (LSH candidate sets).
         Gathers candidate rows on device and runs the same fused top-k."""
+        with self._lock:
+            return self._search_among_locked(query, keys, k)
+
+    def _search_among_locked(self, query, keys, k):
         self._apply_staged()
         slots = [self.slot_of_key[key] for key in keys if key in self.slot_of_key]
         if not slots:
@@ -245,6 +263,10 @@ class DeviceKnnIndex:
         self, queries: Any, k: int
     ) -> list[list[tuple[Hashable, float]]]:
         """Top-k per query as (key, score) lists, higher scores better."""
+        with self._lock:
+            return self._search_locked(queries, k)
+
+    def _search_locked(self, queries, k):
         self._apply_staged()
         if len(self.slot_of_key) == 0:
             q = np.atleast_2d(np.asarray(queries))

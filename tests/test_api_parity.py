@@ -1,0 +1,280 @@
+"""Top-level API parity with the reference's ``pathway/__init__.py``
+(VERDICT r4 #5): every symbol in the reference's ``__all__`` must be
+reachable as ``pw.<name>``, and the new surfaces must actually work.
+"""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+
+# reference: python/pathway/__init__.py:95-187 __all__ (verbatim)
+REFERENCE_ALL = [
+    "asynchronous", "udfs", "graphs", "utils", "debug", "indexing", "ml",
+    "apply", "udf", "udf_async", "UDF", "UDFAsync", "UDFSync", "apply_async",
+    "apply_with_type", "declare_type", "cast", "GroupedTable", "iterate",
+    "iterate_universe", "JoinResult", "IntervalJoinResult",
+    "pandas_transformer", "AsyncTransformer", "reducers", "schema_from_types",
+    "Table", "TableLike", "ColumnReference", "ColumnExpression", "Schema",
+    "Pointer", "PyObjectWrapper", "wrap_py_object", "MonitoringLevel",
+    "WindowJoinResult", "this", "left", "right", "Joinable",
+    "OuterJoinResult", "coalesce", "require", "sql", "run", "run_all",
+    "if_else", "make_tuple", "Type", "__version__", "io", "universes",
+    "window", "JoinMode", "GroupedJoinResult", "AsofJoinResult", "temporal",
+    "statistical", "schema_builder", "column_definition", "TableSlice",
+    "demo", "unwrap", "fill_error", "SchemaProperties", "schema_from_csv",
+    "schema_from_dict", "assert_table_has_schema", "DateTimeNaive",
+    "DateTimeUtc", "Duration", "Json", "table_transformer",
+    "BaseCustomAccumulator", "stateful", "viz", "PersistenceMode", "join",
+    "join_inner", "join_left", "join_right", "join_outer", "groupby",
+    "enable_interactive_mode", "LiveTable", "persistence", "set_license_key",
+    "set_monitoring_config", "global_error_log", "local_error_log",
+    "load_yaml",
+]
+
+
+def test_reference_all_symbols_present():
+    missing = [n for n in REFERENCE_ALL if not hasattr(pw, n)]
+    assert missing == [], f"missing top-level symbols: {missing}"
+
+
+def test_table_slice_select():
+    t = dbg.table_from_markdown(
+        """
+        a | b | c
+        1 | 2 | 3
+        4 | 5 | 6
+        """
+    )
+    assert t.slice.keys() == ["a", "b", "c"]
+    r = t.select(*t.slice.without("b"))
+    assert r.column_names() == ["a", "c"]
+    r2 = t.select(*t.slice.with_suffix("_x"))
+    assert r2.column_names() == ["a_x", "b_x", "c_x"]
+    _, cols = dbg.table_to_dicts(r2)
+    assert sorted(cols["a_x"].values()) == [1, 4]
+    r3 = t.select(*t.slice.rename({"a": "alpha"}).without("c"))
+    assert sorted(r3.column_names()) == ["alpha", "b"]
+    # getitem/getattr return plain refs
+    assert t.slice["a"].name == "a"
+    assert t.slice.b.name == "b"
+    with pytest.raises(KeyError):
+        t.slice.without("nope")
+
+
+def test_free_join_functions():
+    t1 = dbg.table_from_markdown(
+        """
+        k | v
+        1 | a
+        2 | b
+        """
+    )
+    t2 = dbg.table_from_markdown(
+        """
+        k | w
+        1 | x
+        3 | y
+        """
+    )
+    r = pw.join(t1, t2, t1.k == t2.k).select(t1.k, t1.v, t2.w)
+    _, cols = dbg.table_to_dicts(r)
+    assert list(cols["v"].values()) == ["a"]
+    router = pw.join_left(t1, t2, t1.k == t2.k).select(t1.k, t2.w)
+    _, cols = dbg.table_to_dicts(router)
+    assert sorted(cols["w"].values(), key=str) == [None, "x"]
+
+
+def test_base_custom_accumulator():
+    class CustomAvg(pw.BaseCustomAccumulator):
+        def __init__(self, sum, cnt):
+            self.sum, self.cnt = sum, cnt
+
+        @classmethod
+        def from_row(cls, row):
+            [val] = row
+            return cls(val, 1)
+
+        def update(self, other):
+            self.sum += other.sum
+            self.cnt += other.cnt
+
+        def compute_result(self) -> float:
+            return self.sum / self.cnt
+
+    custom_avg = pw.reducers.udf_reducer(CustomAvg)
+    t = dbg.table_from_markdown(
+        """
+        owner | price
+        Alice | 100
+        Bob   | 80
+        Alice | 90
+        Bob   | 70
+        """
+    )
+    r = t.groupby(t.owner).reduce(t.owner, avg=custom_avg(t.price))
+    _, cols = dbg.table_to_dicts(r)
+    assert sorted(cols["avg"].values()) == [75.0, 95.0]
+
+
+def test_py_object_wrapper_roundtrip():
+    w = pw.wrap_py_object({"a": [1, 2]})
+    assert w.value == {"a": [1, 2]}
+    data = w.dumps()
+    assert pw.PyObjectWrapper.loads(data).value == {"a": [1, 2]}
+    assert w == pw.wrap_py_object({"a": [1, 2]})
+
+    class NoPickle:
+        def __reduce__(self):
+            raise TypeError("no")
+
+    class _Ser:
+        @staticmethod
+        def dumps(obj):
+            return b"custom"
+
+        @staticmethod
+        def loads(data):
+            return "restored"
+
+    w2 = pw.wrap_py_object(NoPickle(), serializer=_Ser)
+    assert w2.dumps() == b"custom"
+
+    # wrapped objects ride through UDFs and table cells
+    t = dbg.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+
+    @pw.udf
+    def box(a: int) -> pw.PyObjectWrapper:
+        return pw.wrap_py_object(a * 10)
+
+    @pw.udf
+    def unbox(w: pw.PyObjectWrapper) -> int:
+        return w.value
+
+    r = t.select(v=unbox(box(t.a)))
+    _, cols = dbg.table_to_dicts(r)
+    assert sorted(cols["v"].values()) == [10, 20]
+
+
+def test_schema_from_csv(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("name,age,score\nalice,31,4.5\nbob,28,3.25\n")
+    schema = pw.schema_from_csv(str(p))
+    cols = schema.columns()
+    assert str(cols["age"].dtype) in ("INT", "int", "DType(INT)") or "int" in str(cols["age"].dtype).lower()
+    assert "float" in str(cols["score"].dtype).lower()
+    assert "str" in str(cols["name"].dtype).lower()
+    # num_parsed_rows=0 -> all str
+    schema_all_str = pw.schema_from_csv(str(p), num_parsed_rows=0)
+    assert all(
+        "str" in str(c.dtype).lower()
+        for c in schema_all_str.columns().values()
+    )
+
+
+def test_local_error_log_scoping():
+    t = dbg.table_from_markdown(
+        """
+        a | b | c
+        3 | 3 | 1
+        4 | 0 | 0
+        """
+    )
+    with pw.local_error_log() as log1:
+        t2 = t.select(x=pw.fill_error(t.a // t.b, -1))
+    with pw.local_error_log() as log2:
+        t3 = t.select(y=pw.fill_error(t.a // t.c, -2))
+    outside = t.select(z=pw.fill_error(t.b // t.c, -3))
+
+    rows, errs1, errs2, g_errs = [], [], [], []
+    pw.io.subscribe(
+        t2, on_change=lambda k, row, tm, add: rows.append(row) if add else None
+    )
+    pw.io.subscribe(
+        outside, on_change=lambda k, row, tm, add: None
+    )
+    pw.io.subscribe(
+        log1, on_change=lambda k, row, tm, add: errs1.append(row["message"])
+    )
+    pw.io.subscribe(
+        log2, on_change=lambda k, row, tm, add: errs2.append(row["message"])
+    )
+    glog = pw.global_error_log()
+    pw.io.subscribe(
+        glog, on_change=lambda k, row, tm, add: g_errs.append(row["message"])
+    )
+    pw.io.subscribe(t3, on_change=lambda k, row, tm, add: None)
+    pw.run(terminate_on_error=False)
+
+    assert sorted(r["x"] for r in rows) == [-1, 1]
+    # each local log saw exactly its own block's division error; the
+    # global log saw all three
+    assert len(errs1) == 1 and "ZeroDivisionError" in errs1[0]
+    assert len(errs2) == 1 and "ZeroDivisionError" in errs2[0]
+    assert len(g_errs) == 3
+
+
+def test_table_transformer_checks_schema():
+    class S(pw.Schema):
+        a: int
+
+    @pw.table_transformer
+    def ident(t: pw.Table[S]) -> pw.Table[S]:
+        return t
+
+    t = dbg.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    assert ident(t) is t
+    bad = dbg.table_from_markdown(
+        """
+        z
+        1
+        """
+    )
+    with pytest.raises(AssertionError):
+        ident(bad)
+
+
+def test_live_table_snapshot():
+    t = dbg.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    doubled = t.select(d=t.a * 2)
+    lt = doubled.live()
+    import time
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if len(lt.snapshot()) == 2:
+            break
+        time.sleep(0.05)
+    vals = sorted(v[0] for _, v in lt.snapshot())
+    assert vals == [2, 4]
+    assert not lt.failed()
+
+
+def test_window_namespace_and_aliases():
+    assert callable(pw.window.tumbling)
+    assert pw.IntervalJoinResult is not None
+    assert pw.PersistenceMode.PERSISTING is not None
+    assert pw.Joinable is pw.TableLike
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        assert pw.asynchronous.async_executor is pw.udfs.async_executor

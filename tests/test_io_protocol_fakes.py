@@ -1,0 +1,419 @@
+"""Protocol-level fakes driving the REAL service-connector code paths
+(VERDICT r4 #8): kafka (library-shim broker: polling, partition offsets,
+seek-on-assign resume), NATS (async subscribe/publish bus), and
+elasticsearch (real HTTP ``/_bulk`` endpoint + client shim, exercising
+the bulk layout and the buffered sink's retry loop).
+
+reference model: tests/integration/ connector tests (kafka offsets,
+resilience) — scaled to in-image fakes instead of dockerized services.
+"""
+
+import json
+import sys
+import threading
+import time
+import types
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import pathway_tpu as pw
+
+# ---------------------------------------------------------------------------
+# kafka: in-memory broker behind a confluent_kafka shim
+# ---------------------------------------------------------------------------
+
+
+class _KafkaBroker:
+    def __init__(self):
+        self.topics: dict[str, list[tuple[bytes | None, bytes]]] = defaultdict(list)
+
+    def produce(self, topic: str, key, value) -> None:
+        self.topics[topic].append((key, value))
+
+
+def _make_fake_kafka(broker: _KafkaBroker) -> types.ModuleType:
+    mod = types.ModuleType("confluent_kafka")
+
+    class TopicPartition:
+        def __init__(self, topic, partition=0, offset=-1001):
+            self.topic = topic
+            self.partition = partition
+            self.offset = offset
+
+    class _Msg:
+        def __init__(self, topic, partition, offset, key, value):
+            self._t, self._p, self._o, self._k, self._v = (
+                topic, partition, offset, key, value,
+            )
+
+        def error(self):
+            return None
+
+        def value(self):
+            return self._v
+
+        def key(self):
+            return self._k
+
+        def partition(self):
+            return self._p
+
+        def offset(self):
+            return self._o
+
+    class Consumer:
+        def __init__(self, settings):
+            self.settings = dict(settings)
+            self._topic = None
+            self._pos = 0  # next offset to read (single partition 0)
+
+        def subscribe(self, topics, on_assign=None):
+            self._topic = topics[0]
+            start = (
+                len(broker.topics[self._topic])
+                if self.settings.get("auto.offset.reset") == "latest"
+                else 0
+            )
+            self._pos = start
+            if on_assign is not None:
+                # the connector's on_assign only calls assign() when it
+                # holds restored offsets (seek-on-resume)
+                on_assign(self, [TopicPartition(self._topic, 0)])
+
+        def assign(self, partitions):
+            for p in partitions:
+                if p.topic == self._topic and p.offset >= 0:
+                    self._pos = p.offset
+
+        def poll(self, timeout):
+            log = broker.topics[self._topic]
+            if self._pos < len(log):
+                key, value = log[self._pos]
+                msg = _Msg(self._topic, 0, self._pos, key, value)
+                self._pos += 1
+                return msg
+            time.sleep(min(timeout, 0.02))
+            return None
+
+        def close(self):
+            pass
+
+    class Producer:
+        def __init__(self, settings):
+            self.settings = dict(settings)
+
+        def produce(self, topic, value, key=None):
+            broker.produce(topic, key, value)
+
+        def poll(self, timeout):
+            return 0
+
+        def flush(self, timeout=None):
+            return 0
+
+    mod.Consumer = Consumer
+    mod.Producer = Producer
+    mod.TopicPartition = TopicPartition
+    return mod
+
+
+@pytest.fixture
+def kafka_broker(monkeypatch):
+    broker = _KafkaBroker()
+    monkeypatch.setitem(sys.modules, "confluent_kafka", _make_fake_kafka(broker))
+    return broker
+
+
+_SETTINGS = {"bootstrap.servers": "fake:9092", "group.id": "g1"}
+
+
+class _EventSchema(pw.Schema):
+    name: str
+    v: int
+
+
+def _close_when(subject, cond, timeout=20.0):
+    def waiter():
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                break
+            time.sleep(0.05)
+        subject.close()
+
+    th = threading.Thread(target=waiter, daemon=True)
+    th.start()
+    return th
+
+
+def test_kafka_read_transform_write_roundtrip(kafka_broker):
+    for i in range(3):
+        kafka_broker.produce(
+            "in", None, json.dumps({"name": f"n{i}", "v": i}).encode()
+        )
+    t = pw.io.kafka.read(
+        _SETTINGS, "in", format="json", schema=_EventSchema,
+        autocommit_duration_ms=50,
+    )
+    out = t.select(t.name, doubled=t.v * 2)
+    pw.io.kafka.write(out, _SETTINGS, "out")
+    subject = t._operator.params["subject"]
+    th = _close_when(subject, lambda: len(kafka_broker.topics["out"]) >= 3)
+    pw.run()
+    th.join()
+    msgs = [json.loads(v) for _, v in kafka_broker.topics["out"]]
+    assert sorted((m["name"], m["doubled"]) for m in msgs) == [
+        ("n0", 0), ("n1", 2), ("n2", 4),
+    ]
+    assert all(m["diff"] == 1 and "time" in m for m in msgs)
+    # the real consumer loop tracked per-partition offsets
+    assert subject.current_offsets() == {0: 2}
+
+
+def test_kafka_offset_seek_resumes_after_restart(kafka_broker):
+    from pathway_tpu.internals.graph import G
+
+    for i in range(3):
+        kafka_broker.produce(
+            "in", None, json.dumps({"name": f"a{i}", "v": i}).encode()
+        )
+    t = pw.io.kafka.read(
+        _SETTINGS, "in", format="json", schema=_EventSchema,
+        autocommit_duration_ms=50,
+    )
+    seen: list[str] = []
+    pw.io.subscribe(
+        t, on_change=lambda k, row, tm, add: seen.append(row["name"])
+    )
+    subject = t._operator.params["subject"]
+    th = _close_when(subject, lambda: len(seen) >= 3)
+    pw.run()
+    th.join()
+    assert sorted(seen) == ["a0", "a1", "a2"]
+    offsets = subject.current_offsets()
+    assert offsets == {0: 2}
+
+    # "restart": a fresh graph + subject seeded with the stored offsets —
+    # the consumer's on_assign seek must skip the already-read prefix
+    G.clear()
+    for i in range(2):
+        kafka_broker.produce(
+            "in", None, json.dumps({"name": f"b{i}", "v": i}).encode()
+        )
+    t2 = pw.io.kafka.read(
+        _SETTINGS, "in", format="json", schema=_EventSchema,
+        autocommit_duration_ms=50,
+    )
+    subject2 = t2._operator.params["subject"]
+    subject2.seek(offsets)
+    seen2: list[str] = []
+    pw.io.subscribe(
+        t2, on_change=lambda k, row, tm, add: seen2.append(row["name"])
+    )
+    th = _close_when(subject2, lambda: len(seen2) >= 2)
+    pw.run()
+    th.join()
+    assert sorted(seen2) == ["b0", "b1"], seen2  # no replay of a0..a2
+
+
+# ---------------------------------------------------------------------------
+# NATS: async subscribe/publish bus behind a nats shim
+# ---------------------------------------------------------------------------
+
+
+class _NatsBus:
+    def __init__(self):
+        self.subjects: dict[str, list[bytes]] = defaultdict(list)
+        self.cursors: dict[int, int] = {}
+
+
+def _make_fake_nats(bus: _NatsBus) -> types.ModuleType:
+    import asyncio
+
+    mod = types.ModuleType("nats")
+
+    class _Msg:
+        def __init__(self, data):
+            self.data = data
+
+    class _Sub:
+        def __init__(self, subject):
+            self.subject = subject
+            self.pos = 0
+
+        async def next_msg(self, timeout=0.5):
+            log = bus.subjects[self.subject]
+            if self.pos < len(log):
+                msg = _Msg(log[self.pos])
+                self.pos += 1
+                return msg
+            await asyncio.sleep(min(timeout, 0.02))
+            raise TimeoutError("no message")
+
+    class _NC:
+        async def subscribe(self, subject):
+            return _Sub(subject)
+
+        async def publish(self, subject, data):
+            bus.subjects[subject].append(data)
+
+        async def close(self):
+            pass
+
+    async def connect(uri):
+        return _NC()
+
+    mod.connect = connect
+    return mod
+
+
+@pytest.fixture
+def nats_bus(monkeypatch):
+    bus = _NatsBus()
+    monkeypatch.setitem(sys.modules, "nats", _make_fake_nats(bus))
+    return bus
+
+
+def test_nats_read_transform_write_roundtrip(nats_bus):
+    for i in range(2):
+        nats_bus.subjects["in.events"].append(
+            json.dumps({"name": f"n{i}", "v": i}).encode()
+        )
+    t = pw.io.nats.read(
+        "nats://fake:4222", "in.events", schema=_EventSchema, format="json",
+        autocommit_duration_ms=50,
+    )
+    out = t.select(t.name, tripled=t.v * 3)
+    pw.io.nats.write(out, "nats://fake:4222", "out.events")
+    subject = t._operator.params["subject"]
+    th = _close_when(
+        subject, lambda: len(nats_bus.subjects["out.events"]) >= 2
+    )
+    pw.run()
+    th.join()
+    msgs = [json.loads(m) for m in nats_bus.subjects["out.events"]]
+    assert sorted((m["name"], m["tripled"]) for m in msgs) == [
+        ("n0", 0), ("n1", 3),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# elasticsearch: REAL http server implementing /_bulk + a client shim
+# doing actual socket I/O — exercises the connector's bulk layout and the
+# buffered sink's retry loop end-to-end
+# ---------------------------------------------------------------------------
+
+
+class _BulkStore:
+    def __init__(self, fail_first: int = 0):
+        self.docs: list[tuple[str, dict]] = []
+        self.requests = 0
+        self.fail_first = fail_first
+        self.lock = threading.Lock()
+
+
+def _make_es_server(store: _BulkStore):
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            if not self.path.endswith("/_bulk"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length).decode()
+            with store.lock:
+                store.requests += 1
+                fail = store.requests <= store.fail_first
+                if not fail:
+                    lines = [ln for ln in body.splitlines() if ln.strip()]
+                    for action_line, doc_line in zip(lines[::2], lines[1::2]):
+                        action = json.loads(action_line)
+                        assert "index" in action, action
+                        store.docs.append(
+                            (action["index"]["_index"], json.loads(doc_line))
+                        )
+            payload = json.dumps({"errors": fail, "items": []}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def _make_fake_es_module() -> types.ModuleType:
+    """A minimal client speaking the actual bulk NDJSON protocol over HTTP."""
+    import urllib.request
+
+    mod = types.ModuleType("elasticsearch")
+
+    class Elasticsearch:
+        def __init__(self, hosts, **kwargs):
+            self.host = hosts[0].rstrip("/")
+
+        def bulk(self, operations, index=None):
+            body = "\n".join(json.dumps(op) for op in operations) + "\n"
+            req = urllib.request.Request(
+                f"{self.host}/_bulk",
+                data=body.encode(),
+                headers={"Content-Type": "application/x-ndjson"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+    mod.Elasticsearch = Elasticsearch
+    return mod
+
+
+def test_elasticsearch_bulk_write_over_http(monkeypatch):
+    store = _BulkStore()
+    server = _make_es_server(store)
+    monkeypatch.setitem(sys.modules, "elasticsearch", _make_fake_es_module())
+    try:
+        t = pw.debug.table_from_markdown(
+            """
+            name | v
+            a    | 1
+            b    | 2
+            c    | 3
+            """
+        )
+        pw.io.elasticsearch.write(
+            t, f"http://127.0.0.1:{server.server_address[1]}", index_name="docs"
+        )
+        pw.run()
+        assert sorted(d["name"] for _, d in store.docs) == ["a", "b", "c"]
+        assert all(idx == "docs" for idx, _ in store.docs)
+        assert all(d["diff"] == 1 and "time" in d for _, d in store.docs)
+    finally:
+        server.shutdown()
+
+
+def test_elasticsearch_bulk_retries_on_error(monkeypatch):
+    store = _BulkStore(fail_first=1)  # first bulk request reports errors
+    server = _make_es_server(store)
+    monkeypatch.setitem(sys.modules, "elasticsearch", _make_fake_es_module())
+    try:
+        t = pw.debug.table_from_markdown(
+            """
+            name | v
+            x    | 9
+            """
+        )
+        pw.io.elasticsearch.write(
+            t, f"http://127.0.0.1:{server.server_address[1]}", index_name="docs"
+        )
+        pw.run()
+        assert store.requests >= 2  # failed once, then retried
+        assert [d["name"] for _, d in store.docs] == ["x"]
+    finally:
+        server.shutdown()
