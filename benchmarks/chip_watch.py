@@ -12,8 +12,11 @@ moment a probe succeeds it fires the full chip measurement stack:
   2. ``benchmarks/chip_suite.py`` → measured rows appended to
      ``benchmarks/KNN_CROSSOVER.md``.
 
-It keeps watching until BOTH have succeeded at least once (a window may
-close mid-run; partial salvage lines still count as progress), then
+  3. ``benchmarks/serving_bench.py`` → end-to-end RAG serving metrics
+     with the real models, appended to ``benchmarks/serving_results.jsonl``.
+
+It keeps watching until ALL THREE have succeeded at least once (a window
+may close mid-run; partial salvage lines still count as progress), then
 exits 0.  All activity is logged with timestamps to
 ``benchmarks/chip_watch.log``.
 
@@ -135,6 +138,19 @@ def fire_suite() -> bool:
     return rc == 0
 
 
+def fire_serving() -> bool:
+    """End-to-end RAG serving metrics with the real models on the chip
+    (benchmarks/serving_bench.py appends to serving_results.jsonl)."""
+    _log("running serving_bench.py (budget 800s)")
+    rc, out = _run(
+        [os.path.join(HERE, "serving_bench.py")],
+        960.0,
+        {"SERVING_BENCH_BUDGET_S": "800"},
+    )
+    _log(f"serving_bench rc={rc} tail: {out[-400:]!r}")
+    return rc == 0
+
+
 def main() -> int:
     # single-instance lock: two watchers would fire two bench runs into the
     # same rare healthy window and likely time both out
@@ -155,7 +171,7 @@ def main() -> int:
     deadline = time.monotonic() + float(
         os.environ.get("CHIP_WATCH_BUDGET_S", str(11 * 3600))
     )
-    bench_done = suite_done = False
+    bench_done = suite_done = serving_done = False
     _log(f"watcher start (interval {interval:.0f}s, once={once})")
     n = 0
     while time.monotonic() < deadline:
@@ -167,8 +183,11 @@ def main() -> int:
                 bench_done = fire_bench()
             if not suite_done:
                 suite_done = fire_suite()
-            if bench_done and suite_done:
-                _log("both bench.py and chip_suite.py succeeded — done")
+            if not serving_done:
+                serving_done = fire_serving()
+            if bench_done and suite_done and serving_done:
+                _log("bench.py, chip_suite.py and serving_bench.py all "
+                     "succeeded — done")
                 return 0
         else:
             if n % 10 == 1:
@@ -177,7 +196,7 @@ def main() -> int:
             return 0 if dev else 1
         time.sleep(interval)
     _log("watch budget exhausted")
-    return 0 if (bench_done or suite_done) else 1
+    return 0 if (bench_done or suite_done or serving_done) else 1
 
 
 if __name__ == "__main__":
