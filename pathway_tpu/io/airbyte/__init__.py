@@ -1,10 +1,19 @@
 """``pw.io.airbyte`` — Airbyte-sourced streams.
 
 reference: python/pathway/io/airbyte (341 LoC + vendored
-airbyte_serverless) — runs an Airbyte source connector (docker or pypi
-flavor) and ingests its record messages.  This port drives a
-locally-installed ``airbyte`` pypi source package at call time; the
-docker flavor needs a docker runtime and is not wired in this image.
+airbyte_serverless, third_party/airbyte_serverless) — runs an Airbyte
+source connector (docker or pypi flavor) and ingests its record
+messages with incremental STATE checkpoints.
+
+Two execution paths here:
+
+* ``connector_command=[...]`` — the native protocol driver
+  (``_protocol.AirbyteProtocolDriver``): any argv speaking the Airbyte
+  protocol on stdout (docker image, console script, python file).
+  Incremental: the connector's STATE messages become the persistence
+  offset frontier, passed back via ``--state`` on resume.
+* ``config_file_path=`` — an ``airbyte_serverless`` Source config, when
+  that package is installed (the reference's pypi flavor).
 """
 
 from __future__ import annotations
@@ -18,11 +27,14 @@ from .._utils import input_table
 from ...internals.keys import ref_scalar
 from ...internals.value import Json
 from ..streaming import ConnectorSubject
+from ._protocol import AirbyteProtocolDriver
 
-__all__ = ["read"]
+__all__ = ["read", "AirbyteProtocolDriver"]
 
 
 class _AirbyteSubject(ConnectorSubject):
+    """airbyte_serverless-source flavor (reference pypi path)."""
+
     def __init__(self, source, streams, mode, refresh_s, autocommit_ms):
         super().__init__(datasource_name=f"airbyte:{streams}")
         self.source = source
@@ -50,30 +62,123 @@ class _AirbyteSubject(ConnectorSubject):
             self._sync_once()
 
 
+class _AirbyteProtocolSubject(ConnectorSubject):
+    """Native protocol-driver flavor with incremental state.
+
+    Offsets (= the persistence frontier for exactly-once resume) are the
+    connector's latest STATE blob; ``seek`` restores it so a restarted
+    run passes ``--state`` and re-reads only what the connector says is
+    new (reference: airbyte incremental sync modes)."""
+
+    def __init__(self, driver, streams, mode, refresh_s, autocommit_ms):
+        super().__init__(datasource_name="airbyte")
+        self.driver = driver
+        self.streams = streams
+        self._mode = "static" if mode == "static" else "streaming"
+        self.refresh_s = refresh_s
+        # no wall-clock autocommit: rows must become durable exactly at
+        # the connector's STATE checkpoints, or a mid-sync snapshot would
+        # pair them with the PREVIOUS state and the resumed connector
+        # would re-emit them (duplicates)
+        self._autocommit_ms = None
+        self._state: Any = None
+        self._catalog: dict | None = None
+        self._counter = 0
+
+    def _sync_once(self) -> None:
+        if self._catalog is None:
+            self._catalog = self.driver.configured_catalog(self.streams)
+        emitted = False
+        for kind, payload, state in self.driver.read(self._catalog, self._state):
+            if kind == "record":
+                self._counter += 1
+                key = ref_scalar("__airbyte__", self._counter)
+                self._add_inner(key, (Json(payload.get("data", payload)),))
+                emitted = True
+            elif kind == "state":
+                self._state = state
+                if emitted:
+                    # commit at connector checkpoints so the offset
+                    # frontier and the emitted rows advance together
+                    self.commit()
+                    emitted = False
+        if emitted:
+            self.commit()
+
+    def run(self) -> None:
+        self._sync_once()
+        if self._mode == "static":
+            return
+        while not self._closed.is_set():
+            _time.sleep(self.refresh_s)
+            self._sync_once()
+
+    # persistence frontier (io/streaming.py snapshot hooks): the counter
+    # rides along so resumed runs continue the key sequence instead of
+    # colliding with replayed snapshot rows
+    def current_offsets(self):
+        return {"state": self._state, "counter": self._counter}
+
+    def seek(self, offsets) -> None:
+        if offsets:
+            self._state = offsets.get("state")
+            self._counter = int(offsets.get("counter", 0) or 0)
+
+
 def read(
     config_file_path: str | None = None,
     streams: list[str] | None = None,
     *,
     source: Any = None,
+    connector_command: list[str] | str | None = None,
+    config: dict | None = None,
+    execution_type: str = "local",
+    env_vars: dict[str, str] | None = None,
     mode: str = "streaming",
     refresh_interval_ms: int = 60_000,
     autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
     """Each record becomes one row with a ``data`` Json column
-    (reference: io/airbyte read)."""
+    (reference: io/airbyte read:107).
+
+    Pass ``connector_command`` (argv or shell string) to drive any
+    Airbyte-protocol connector natively — e.g.
+    ``["docker", "run", "--rm", "-i", "airbyte/source-faker"]`` — with
+    ``config=`` as its source configuration; or ``config_file_path`` for
+    an installed ``airbyte_serverless`` source.
+    """
+    if connector_command is not None:
+        if isinstance(connector_command, str):
+            import shlex
+
+            connector_command = shlex.split(connector_command)
+        driver = AirbyteProtocolDriver(
+            connector_command, config, env=env_vars
+        )
+        schema = schema_from_types(data=Json)
+        subject = _AirbyteProtocolSubject(
+            driver, streams, mode, refresh_interval_ms / 1000.0,
+            autocommit_duration_ms,
+        )
+        subject.persistent_id = persistent_id
+        subject._configure(schema, None)
+        return input_table(schema, subject=subject)
+
     if source is None:
         import yaml
 
         from airbyte_serverless.sources import Source  # optional dependency
 
         with open(config_file_path) as f:
-            config = yaml.safe_load(f)
-        source = Source(**config.get("source", config))
+            cfg = yaml.safe_load(f)
+        source = Source(**cfg.get("source", cfg))
     schema = schema_from_types(data=Json)
     subject = _AirbyteSubject(
         source, streams or [], mode, refresh_interval_ms / 1000.0,
         autocommit_duration_ms,
     )
+    subject.persistent_id = persistent_id
     subject._configure(schema, None)
     return input_table(schema, subject=subject)

@@ -153,3 +153,59 @@ class AsyncMicroBatcher:
             for _, fut in lst:
                 if not fut.done():
                     fut.set_exception(exc)
+
+
+#: static per-provider parameter tables — the reference resolves these
+#: through litellm.get_supported_openai_params (llms.py _utils); when
+#: litellm is importable we do the same, else these serve as the offline
+#: fallback so _accepts_call_arg stays accurate without the dependency
+_PROVIDER_PARAMS = {
+    "openai": {
+        "model", "temperature", "max_tokens", "max_completion_tokens",
+        "top_p", "n", "stop", "seed", "presence_penalty",
+        "frequency_penalty", "logit_bias", "logprobs", "top_logprobs",
+        "response_format", "tools", "tool_choice", "user", "stream",
+    },
+    "cohere": {
+        "model", "temperature", "max_tokens", "p", "k", "seed",
+        "stop_sequences", "frequency_penalty", "presence_penalty",
+        "documents",
+    },
+}
+
+
+def check_provider_accepts_arg(model: str, provider: str, arg: str) -> bool:
+    """reference: xpacks/llm/_utils.py ``_check_model_accepts_arg`` —
+    ask litellm for the model's supported OpenAI-style params, falling
+    back to a static provider table offline."""
+    try:
+        import litellm
+
+        params = litellm.get_supported_openai_params(
+            model=model, custom_llm_provider=provider
+        )
+        if params:
+            return arg in params
+    except Exception:
+        pass
+    return arg in _PROVIDER_PARAMS.get(provider, set())
+
+
+def prep_message_log(messages: list, verbose: bool) -> str:
+    """Shorten chat messages for structured request logs (reference:
+    llms.py:55 ``_prep_message_log``): verbose mode redacts inline
+    images, non-verbose truncates."""
+    import copy
+    import json as _json
+
+    if verbose:
+        log_messages = copy.deepcopy(messages)
+        for message in log_messages:
+            content = message.get("content")
+            if isinstance(content, list):
+                for part in content:
+                    if isinstance(part, dict) and part.get("type") == "image_url":
+                        part["image_url"] = {"url": "<redacted image>"}
+        return _json.dumps(log_messages, ensure_ascii=False, default=str)
+    text = _json.dumps(messages, ensure_ascii=False, default=str)
+    return text[:500] + ("..." if len(text) > 500 else "")

@@ -13,13 +13,58 @@ path is the models/ roadmap item).
 
 from __future__ import annotations
 
+import json as _json
+import logging
+import uuid
 from typing import Any
 
 from ...internals import udfs
 from ...internals.expression import ColumnExpression, MakeTupleExpression
 from ...internals.udfs import UDF
 from ...internals.value import Json
-from ._utils import coerce_str
+from ._utils import check_provider_accepts_arg, coerce_str, prep_message_log
+
+logger = logging.getLogger(__name__)
+
+
+_SECRET_KEY_MARKERS = ("key", "secret", "token", "password", "credential")
+
+
+def _log_request(provider: str, kwargs: dict, messages: list, verbose: bool) -> str:
+    """Structured request log line (reference: llms.py:270-273).
+    Credential-shaped kwargs are redacted — providers like litellm take
+    api_key/aws_secret_access_key as plain call kwargs."""
+    msg_id = str(uuid.uuid4())[-8:]
+    logged = {
+        k: ("<redacted>" if any(m in k.lower() for m in _SECRET_KEY_MARKERS) else str(v))
+        for k, v in kwargs.items()
+    }
+    logger.info(
+        _json.dumps(
+            {
+                "_type": f"{provider}_chat_request",
+                "kwargs": logged,
+                "id": msg_id,
+                "messages": prep_message_log(messages, verbose),
+            },
+            ensure_ascii=False,
+        )
+    )
+    return msg_id
+
+
+def _log_response(provider: str, msg_id: str, response: str | None, verbose: bool) -> None:
+    text = response or ""
+    logger.info(
+        _json.dumps(
+            {
+                "_type": f"{provider}_chat_response",
+                "response": text if verbose else text[: min(50, len(text))] + "...",
+                "id": msg_id,
+            },
+            ensure_ascii=False,
+        )
+    )
 
 __all__ = [
     "BaseChat",
@@ -74,40 +119,53 @@ class OpenAIChat(BaseChat):
         self.model = model
         if model is not None:
             self.kwargs["model"] = model
+        # constructor-level credentials are client config, not call args
+        self._creds = {
+            k: self.kwargs.pop(k)
+            for k in ("api_key", "base_url", "organization")
+            if k in self.kwargs
+        }
         self._client = None
+        self._override_clients: dict = {}
 
     def _accepts_call_arg(self, arg_name: str) -> bool:
-        return arg_name in (
-            "model",
-            "temperature",
-            "max_tokens",
-            "top_p",
-            "logit_bias",
-            "stop",
-            "seed",
-            "response_format",
-        )
+        if self.model is None:
+            return False
+        return check_provider_accepts_arg(self.model, "openai", arg_name)
 
-    def _ensure_client(self):
-        if self._client is None:
-            import openai  # optional dependency
+    def _ensure_client(self, **overrides):
+        import openai  # optional dependency
 
-            self._client = openai.AsyncOpenAI(
-                **{
-                    k: self.kwargs.pop(k)
-                    for k in ("api_key", "base_url", "organization")
-                    if k in self.kwargs
-                }
-            )
-        return self._client
+        if not overrides:
+            if self._client is None:
+                self._client = openai.AsyncOpenAI(**self._creds)
+            return self._client
+        # per-call credentials: cache per distinct override set — a fresh
+        # client per row would leak httpx connections under load
+        key = tuple(sorted(overrides.items()))
+        client = self._override_clients.get(key)
+        if client is None:
+            client = openai.AsyncOpenAI(**{**self._creds, **overrides})
+            self._override_clients[key] = client
+        return client
 
     async def __wrapped__(self, messages, **kwargs) -> str | None:
-        client = self._ensure_client()
         kwargs = {**self.kwargs, **kwargs}
-        ret = await client.chat.completions.create(
-            messages=_messages_to_list(messages), **kwargs
-        )
-        return ret.choices[0].message.content
+        verbose = bool(kwargs.pop("verbose", False))
+        # per-call credentials (reference llms.py:262-264) select a
+        # per-override cached client
+        overrides = {
+            k: kwargs.pop(k)
+            for k in ("api_key", "base_url", "organization")
+            if k in kwargs
+        }
+        client = self._ensure_client(**overrides)
+        msgs = _messages_to_list(messages)
+        msg_id = _log_request("openai", kwargs, msgs, verbose)
+        ret = await client.chat.completions.create(messages=msgs, **kwargs)
+        response = ret.choices[0].message.content
+        _log_response("openai", msg_id, response, verbose)
+        return response
 
 
 class LiteLLMChat(BaseChat):
@@ -126,19 +184,27 @@ class LiteLLMChat(BaseChat):
             cache_strategy=cache_strategy,
         )
         self.kwargs = dict(litellm_kwargs)
+        self.model = model
         if model is not None:
             self.kwargs["model"] = model
 
     def _accepts_call_arg(self, arg_name: str) -> bool:
-        return arg_name in ("model", "temperature", "max_tokens", "top_p", "stop")
+        if self.model is None:
+            return False
+        provider = self.model.split("/", 1)[0] if "/" in self.model else "openai"
+        return check_provider_accepts_arg(self.model, provider, arg_name)
 
     async def __wrapped__(self, messages, **kwargs) -> str | None:
         import litellm  # optional dependency
 
-        ret = await litellm.acompletion(
-            messages=_messages_to_list(messages), **{**self.kwargs, **kwargs}
-        )
-        return ret.choices[0]["message"]["content"]
+        kwargs = {**self.kwargs, **kwargs}
+        verbose = bool(kwargs.pop("verbose", False))
+        msgs = _messages_to_list(messages)
+        msg_id = _log_request("litellm", kwargs, msgs, verbose)
+        ret = await litellm.acompletion(messages=msgs, **kwargs)
+        response = ret.choices[0]["message"]["content"]
+        _log_response("litellm", msg_id, response, verbose)
+        return response
 
 
 class HFPipelineChat(BaseChat):
@@ -207,26 +273,34 @@ class CohereChat(BaseChat):
             cache_strategy=cache_strategy,
         )
         self.kwargs = dict(cohere_kwargs)
+        self.model = model
         if model is not None:
             self.kwargs["model"] = model
 
     def _accepts_call_arg(self, arg_name: str) -> bool:
-        return arg_name in ("model", "temperature", "max_tokens")
+        if self.model is None:
+            return False
+        return check_provider_accepts_arg(self.model, "cohere", arg_name)
 
     async def __wrapped__(self, messages, docs, **kwargs) -> tuple:
         import cohere  # optional dependency
 
+        kwargs = {**self.kwargs, **kwargs}
+        verbose = bool(kwargs.pop("verbose", False))
+        api_key = kwargs.pop("api_key", None)
         msgs = _messages_to_list(messages)
         if isinstance(docs, Json):
             docs = docs.value
-        client = cohere.AsyncClient()
+        client = cohere.AsyncClient(api_key=api_key) if api_key else cohere.AsyncClient()
         message = msgs[-1]["content"]
         chat_history = msgs[:-1]
+        msg_id = _log_request("cohere", kwargs, msgs, verbose)
         ret = await client.chat(
             message=message, chat_history=chat_history, documents=docs,
-            **{**self.kwargs, **kwargs},
+            **kwargs,
         )
         cited_docs = [dict(c.__dict__) for c in (ret.citations or [])]
+        _log_response("cohere", msg_id, ret.text, verbose)
         return ret.text, cited_docs
 
 
