@@ -247,6 +247,7 @@ class SentenceEncoder:
         seed: int = 0,
         max_length: int = 256,
         mesh=None,
+        extend_positions: int | None = None,
     ):
         self.pretrained = False
         params = None
@@ -266,6 +267,36 @@ class SentenceEncoder:
                 cfg = loaded_cfg
                 self.pretrained = True
         self.cfg = cfg or EncoderConfig()
+        if (
+            extend_positions is not None
+            and extend_positions > SEQ_BUCKETS[-1]
+            and mesh is None
+        ):
+            import warnings
+
+            warnings.warn(
+                f"extend_positions={extend_positions} without a mesh: the "
+                f"single-device dispatch caps sequences at "
+                f"{SEQ_BUCKETS[-1]} tokens, so longer documents will be "
+                "truncated — pass mesh= to embed them sequence-parallel",
+                stacklevel=2,
+            )
+        if extend_positions is not None and extend_positions > self.cfg.max_len:
+            # stretch the learned position table by linear interpolation
+            # (the standard BERT-family length extension) so a 512-pos
+            # checkpoint can serve multi-thousand-token documents — the
+            # sequence-parallel ring path then spans them across the mesh
+            if params is not None:
+                params = dict(params)
+                pos = jnp.asarray(params["pos_emb"]["embedding"])
+                params["pos_emb"] = {
+                    "embedding": jax.image.resize(
+                        pos.astype(jnp.float32),
+                        (extend_positions, pos.shape[1]),
+                        method="linear",
+                    ).astype(pos.dtype)
+                }
+            self.cfg = dataclasses.replace(self.cfg, max_len=extend_positions)
         self.max_length = min(max_length, self.cfg.max_len)
         self.tokenizer = load_tokenizer(model_name, vocab_size=self.cfg.vocab_size)
         self.model = TransformerEncoder(self.cfg)

@@ -1,0 +1,113 @@
+"""Multimodal RAG end-to-end (BASELINE config #5): image documents
+described by a vision chat (ImageParser), indexed next to text documents
+through a hybrid KNN+BM25 DocumentStore, retrieved by text query — the
+reference's multimodal pipeline shape
+(integration_tests + xpacks/llm/parsers.py:396), offline with a fake
+vision LLM.  The CLIP-space variant (image vectors + text queries in one
+index) is covered by tests/test_vision.py.
+"""
+
+import io
+
+import pytest
+
+import pathway_tpu as pw
+import pathway_tpu.debug as dbg
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndexFactory
+from pathway_tpu.stdlib.indexing.retrievers import (
+    BruteForceKnnFactory,
+    TantivyBM25Factory,
+)
+from pathway_tpu.xpacks.llm import mocks
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.parsers import AutoParser, ImageParser
+
+
+def _png_bytes(color) -> bytes:
+    from PIL import Image
+
+    img = Image.new("RGB", (24, 24), color)
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+class _FakeVisionChat:
+    """Vision-capable chat stub: 'describes' the attached image by its
+    dominant pixel color, like a multimodal LLM would."""
+
+    def __wrapped__(self, messages, **kwargs):
+        import base64
+
+        from PIL import Image
+
+        for part in messages[0]["content"]:
+            if part.get("type") == "image_url":
+                b64 = part["image_url"]["url"].split("base64,", 1)[1]
+                img = Image.open(io.BytesIO(base64.b64decode(b64))).convert("RGB")
+                r, g, b = img.getpixel((0, 0))
+                color = {(255, 0, 0): "red", (0, 0, 255): "blue",
+                         (0, 128, 0): "green"}.get((r, g, b), "unknown")
+                return f"a photo of a {color} square"
+        return "no image attached"
+
+
+class _RoutingParser(pw.UDF):
+    """AutoParser-style router that sends PNGs through ImageParser and
+    text through utf-8 (the mixed-corpus multimodal shape)."""
+
+    def __init__(self, vision_llm):
+        super().__init__()
+        self._image = ImageParser(llm=vision_llm)
+
+    async def __wrapped__(self, contents: bytes, **kwargs):
+        raw = bytes(contents)
+        if raw.startswith(b"\x89PNG"):
+            return await self._image.__wrapped__(raw)
+        return [(raw.decode("utf-8", "replace"), {})]
+
+
+@pytest.fixture
+def corpus_dir(tmp_path):
+    (tmp_path / "red.png").write_bytes(_png_bytes("red"))
+    (tmp_path / "blue.png").write_bytes(_png_bytes("blue"))
+    (tmp_path / "note.txt").write_text("The quarterly report is ready.")
+    return tmp_path
+
+
+def test_multimodal_document_store_hybrid_retrieval(corpus_dir):
+    docs = pw.io.fs.read(
+        corpus_dir, format="binary", mode="static", with_metadata=True
+    )
+    embedder = mocks.FakeEmbedder(dim=16)
+    factory = HybridIndexFactory(
+        [
+            BruteForceKnnFactory(dimensions=16, embedder=embedder),
+            TantivyBM25Factory(),
+        ]
+    )
+    store = DocumentStore(
+        docs, factory, parser=_RoutingParser(_FakeVisionChat())
+    )
+    from pathway_tpu.xpacks.llm.vector_store import RetrieveQuerySchema
+
+    queries = dbg.table_from_rows(
+        RetrieveQuerySchema,
+        [
+            ("a photo of a red square", 1, None, None),
+            ("quarterly report", 1, None, None),
+        ],
+    )
+    _, cols = dbg.table_to_dicts(store.retrieve_query(queries))
+    results = [r.value for r in cols["result"].values()]
+    by_text = {res[0]["text"]: res[0]["metadata"]["path"] for res in results}
+    # the image doc is retrievable BY TEXT through its vision description
+    assert any(
+        text == "a photo of a red square" and path.endswith("red.png")
+        for text, path in by_text.items()
+    ), by_text
+    # and the plain text doc rides the same hybrid index
+    assert any(
+        "quarterly report" in text and path.endswith("note.txt")
+        for text, path in by_text.items()
+    ), by_text

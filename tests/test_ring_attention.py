@@ -149,3 +149,63 @@ def test_sentence_encoder_long_doc_ring_parity():
     # matches the plain single-device encode
     short_ref = ref_enc.encode([short_text])
     np.testing.assert_allclose(out[1], short_ref[0], atol=2e-5)
+
+
+def test_extend_positions_long_context(monkeypatch):
+    """A checkpoint with a short position table serves longer documents
+    after linear position interpolation (SentenceEncoder(
+    extend_positions=)) — driving the REAL checkpoint branch via a
+    patched loader — and the mesh ring path spans them
+    sequence-parallel."""
+    import dataclasses
+
+    from pathway_tpu.models import checkpoint as ckpt_mod
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+    from pathway_tpu.parallel import make_mesh
+
+    cfg = EncoderConfig(
+        vocab_size=512, hidden_dim=32, num_layers=2, num_heads=4,
+        mlp_dim=64, max_len=64, dtype=jnp.float32,
+    )
+    base = SentenceEncoder(cfg=cfg, seed=9, max_length=64)
+    # "checkpoint": the base encoder's params behind the loader API
+    monkeypatch.setattr(
+        ckpt_mod, "load_encoder", lambda name: (cfg, base.params)
+    )
+    ext = SentenceEncoder(
+        "fake-checkpoint", max_length=1024, mesh=make_mesh(8),
+        extend_positions=1024,
+    )
+    assert ext.pretrained and ext.cfg.max_len == 1024
+
+    text = " ".join(f"tok{i % 83}" for i in range(700))  # > 512: ring path
+    out = ext.encode([text])
+    assert out.shape == (1, 32)
+
+    # parity with the unsharded forward on independently stretched params
+    import jax as _jax
+
+    pos = base.params["pos_emb"]["embedding"]
+    params = dict(base.params)
+    params["pos_emb"] = {
+        "embedding": _jax.image.resize(pos, (1024, pos.shape[1]), method="linear")
+    }
+    ext_model = TransformerEncoder(dataclasses.replace(cfg, max_len=1024))
+    ids, mask = ext.tokenizer.encode_batch([text], max_length=1024)
+    seq = 1024
+    ids_p = np.zeros((1, seq), np.int32)
+    mask_p = np.zeros((1, seq), np.int32)
+    ids_p[:, : ids.shape[1]] = ids
+    mask_p[:, : mask.shape[1]] = mask
+    ref = ext_model.apply(
+        {"params": params}, jnp.asarray(ids_p), jnp.asarray(mask_p)
+    )
+    np.testing.assert_allclose(out[0], np.asarray(ref)[0], atol=2e-3)
+
+    # without a mesh the constructor warns that long docs would truncate
+    import warnings as _warnings
+
+    with pytest.warns(UserWarning, match="without a mesh"):
+        SentenceEncoder(
+            "fake-checkpoint", max_length=1024, extend_positions=1024
+        )
