@@ -151,6 +151,20 @@ def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
     (reference: python/pathway/internals/api.py ``ref_scalar``)."""
     if optional and any(v is None for v in values):
         return None  # type: ignore[return-value]
+    if len(values) == 1:
+        # connector-ingest hot path (one key column per row): same bytes
+        # as _serialize, without the bytearray churn or dispatch frame
+        v = values[0]
+        tv = type(v)
+        if tv is str:
+            b = v.encode("utf-8")
+            return Pointer(
+                _digest128(b"\x04" + len(b).to_bytes(8, "little") + b)
+            )
+        if tv is int:
+            return Pointer(
+                _digest128(b"\x02" + v.to_bytes(16, "little", signed=True))
+            )
     h = _mix128(values)
     if h is not None:
         return Pointer(h)

@@ -358,7 +358,15 @@ class StreamingDriver:
         t = self._setup_persistence(max(static_times, default=0) + 1)
         threads = self._start_connector_threads(data_event)
 
+        from ..internals.engine import gc_batch_mode
+
         last_autocommit = {id(s): _time.monotonic() for s, _ in self.subject_src}
+        with gc_batch_mode():
+            self._live_loop(data_event, t, last_autocommit)
+        self._record_finished_connectors()
+        self.engine.finish()
+
+    def _live_loop(self, data_event, t, last_autocommit) -> None:
         while True:
             data_event.wait(timeout=self.autocommit_ms / 1000.0)
             data_event.clear()
@@ -402,8 +410,6 @@ class StreamingDriver:
                     self.engine.step(t)
                     t += 1
                 break
-        self._record_finished_connectors()
-        self.engine.finish()
 
     def _write_snapshot(self, subject: ConnectorSubject, entries: list[Entry]) -> None:
         writer = self._snapshot_writers.get(id(subject))
@@ -448,6 +454,12 @@ class StreamingDriver:
     # -- multi-process run loop (reference: timely Cluster workers stepping
     # in lockstep; dataflow/config.rs:71-120 + worker-architecture doc) --
     def _run_distributed(self) -> None:
+        from ..internals.engine import gc_batch_mode
+
+        with gc_batch_mode():
+            self._run_distributed_inner()
+
+    def _run_distributed_inner(self) -> None:
         from ..internals.exchange import owner_of
 
         plane = self.exchange_plane

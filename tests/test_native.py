@@ -136,3 +136,35 @@ def test_raw_int_keys_take_blake2b_not_fnv():
     assert _mix128(p) is not None
     assert ref_scalar(*p) == ref_scalar(*p)
     assert ref_scalar(*p) != ref_scalar(p[1], p[0])
+
+
+def test_ref_scalar_fast_path_matches_serialize():
+    """The single-value str/int fast path must stay byte-identical to the
+    _serialize wire format (keys live in persisted snapshots and must
+    match across code paths forever)."""
+    from pathway_tpu.internals.keys import _digest128, _serialize, ref_scalar
+
+    for v in ["", "word", "unicode-éü-人", "x" * 10_000, 0, 1, -1,
+              2**63, -(2**63), 2**120, 12345]:
+        out = bytearray()
+        _serialize(v, out)
+        assert ref_scalar(v).value == _digest128(bytes(out)), v
+    # bool and Pointer must NOT take the int/str fast path
+    assert ref_scalar(True) != ref_scalar(1)
+    p = ref_scalar("q")
+    assert ref_scalar(p) == ref_scalar(p) and ref_scalar(p) != ref_scalar("q")
+
+
+def test_gc_batch_mode_reentrant():
+    import gc
+
+    from pathway_tpu.internals.engine import gc_batch_mode
+
+    old = gc.get_threshold()
+    with gc_batch_mode():
+        assert gc.get_threshold() != old
+        with gc_batch_mode():  # pw.iterate nests an inner run_all
+            assert gc.get_threshold() != old
+        # inner exit must NOT restore the outer run's gc state
+        assert gc.get_threshold() != old
+    assert gc.get_threshold() == old
