@@ -985,3 +985,104 @@ def test_two_hop_straggler_wavefront_overlap(tmp_path):
         if wall < 4.0:
             break
     assert wall < 4.0, wall
+
+
+# ---------------------------------------------------------------------------
+# three-hop chain (groupby → join → groupby) under staggered stragglers:
+# correctness of the wavefront's settlement thresholds (`ups` eager
+# prepare + late-producer guards) across THREE exchange boundaries
+# ---------------------------------------------------------------------------
+
+_THREE_HOP = r"""
+import json, os, sys, time, random
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+out_path = sys.argv[1]
+me = int(os.environ["PATHWAY_PROCESS_ID"])
+R = 5
+
+class Src(pw.io.python.ConnectorSubject):
+    def run(self):
+        rng = random.Random(40 + me)
+        for r in range(R):
+            # every process contributes rows for shared keys each round
+            for i in range(6):
+                self.next(k="key%d" % (i % 4), v=r * 10 + i)
+            self.commit()
+            # staggered pacing: each process sleeps differently per round
+            time.sleep(0.05 + 0.1 * rng.random())
+
+t = pw.io.python.read(Src(), schema=pw.schema_from_types(k=str, v=int),
+                      autocommit_duration_ms=50)
+# hop 1: groupby (exchange on k)
+sums = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+cnts = t.groupby(t.k).reduce(t.k, c=pw.reducers.count())
+# hop 2: join (exchange on join key)
+j = sums.join(cnts, sums.k == cnts.k).select(sums.k, sums.s, cnts.c)
+# hop 3: regroup by a derived key (second groupby = third exchange chain)
+band = j.select(j.k, j.s, j.c, b=pw.apply_with_type(lambda c: c % 3, int, j.c))
+final = band.groupby(band.b).reduce(
+    band.b, total=pw.reducers.sum(band.s), n=pw.reducers.count()
+)
+state = {}
+pw.io.subscribe(
+    final,
+    on_change=lambda key, row, tm, add:
+        state.__setitem__(row["b"], (row["total"], row["n"]))
+        if add else state.pop(row["b"], None),
+)
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+with open(out_path, "w") as f:
+    json.dump({str(k): v for k, v in state.items()}, f)
+"""
+
+
+def test_three_hop_chain_correct_under_stragglers(tmp_path):
+    prog = tmp_path / "threehop.py"
+    prog.write_text(_THREE_HOP)
+    port = _free_port_block()
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            PYTHONPATH=repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+            JAX_PLATFORMS="cpu",
+            PATHWAY_PROCESSES="2",
+            PATHWAY_PROCESS_ID=str(pid),
+            PATHWAY_FIRST_PORT=str(port),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(prog), str(tmp_path / f"three_out{pid}.json")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    for p in procs:
+        _, err = p.communicate(timeout=180)
+        assert p.returncode == 0, err[-3000:]
+    outs = [
+        json.loads((tmp_path / f"three_out{pid}.json").read_text())
+        for pid in range(2)
+    ]
+    merged = {}
+    for o in outs:
+        merged.update(o)
+    # ground truth: 2 processes × 5 rounds × 6 rows; k i%4, v=r*10+i
+    rows = [
+        (f"key{i % 4}", r * 10 + i) for r in range(5) for i in range(6)
+    ] * 2
+    sums, cnts = {}, {}
+    for k, v in rows:
+        sums[k] = sums.get(k, 0) + v
+        cnts[k] = cnts.get(k, 0) + 1
+    bands = {}
+    for k in sums:
+        b = cnts[k] % 3
+        tot, n = bands.get(b, (0, 0))
+        bands[b] = (tot + sums[k], n + 1)
+    want = {str(b): [tot, n] for b, (tot, n) in bands.items()}
+    got = {k: list(v) for k, v in merged.items()}
+    assert got == want, (got, want)
