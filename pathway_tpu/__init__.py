@@ -401,7 +401,11 @@ def __getattr__(name: str):
     import importlib
 
     if name in _LAZY_SUBMODULES:
-        if name in ("indexing", "temporal", "ml", "graphs", "stateful", "statistical", "ordered", "utils", "viz"):
+        # "utils" stays the top-level package (it delegates the stdlib
+        # helper names via its own __getattr__) — binding stdlib.utils
+        # here would fight the attribute the import system sets when
+        # pathway_tpu.utils.* is imported, losing whichever came second
+        if name in ("indexing", "temporal", "ml", "graphs", "stateful", "statistical", "ordered", "viz"):
             mod = importlib.import_module(f".stdlib.{name}", __name__)
         else:
             mod = importlib.import_module(f".{name}", __name__)

@@ -1,3 +1,19 @@
+"""Host utilities (PDF text engine, JMESPath-lite, compile cache).
+
+This package shares the ``pw.utils`` name with the public stdlib helper
+namespace (reference: python/pathway/stdlib/utils — col, filtering,
+bucketing, AsyncTransformer, pandas_transformer); whichever the import
+order binds first, the public names resolve here via delegation.
+"""
+
 from . import jmespath_lite
 
 __all__ = ["jmespath_lite"]
+
+
+def __getattr__(name: str):
+    from ..stdlib import utils as _stdlib_utils
+
+    value = getattr(_stdlib_utils, name)
+    globals()[name] = value
+    return value

@@ -278,3 +278,16 @@ def test_window_namespace_and_aliases():
     with warnings.catch_warnings():
         warnings.simplefilter("ignore", DeprecationWarning)
         assert pw.asynchronous.async_executor is pw.udfs.async_executor
+
+
+def test_pw_utils_surfaces_stdlib_helpers():
+    """pw.utils is the internal package and must expose BOTH its own
+    modules and the stdlib helper namespace (col, pandas_transformer,
+    AsyncTransformer) through delegation, in any import order."""
+    import pathway_tpu.utils.jmespath_lite  # either order must work
+
+    assert pw.utils.__name__ == "pathway_tpu.utils"
+    assert callable(pw.utils.col.unpack_col)
+    assert pw.utils.pandas_transformer is not None
+    assert pw.utils.jmespath_lite is not None
+    assert pw.utils.AsyncTransformer is not None
