@@ -46,6 +46,7 @@ class CrossEncoder:
         cfg: EncoderConfig | None = None,
         seed: int = 0,
         max_length: int = 256,
+        mesh=None,
     ):
         import dataclasses
 
@@ -72,6 +73,17 @@ class CrossEncoder:
             self.params = self.model.init(
                 jax.random.PRNGKey(seed), ids, jnp.ones_like(ids)
             )["params"]
+        # multi-chip reranking: same tp/dp recipe as SentenceEncoder —
+        # the sharding rules match the encoder subtree by path name, the
+        # pooler column-splits, and XLA inserts the collectives
+        self.mesh = mesh
+        self._batch_multiple = 1
+        if mesh is not None:
+            from ..parallel.sharding import mesh_setup
+
+            self.params, self._data_sharding, self._batch_multiple = (
+                mesh_setup(self.params, mesh)
+            )
         self._apply = jax.jit(
             lambda params, ids, mask, tids: self.model.apply(
                 {"params": params}, ids, mask, tids
@@ -87,13 +99,22 @@ class CrossEncoder:
         ids_all, mask_all, type_ids_all = self.tokenizer.encode_batch(
             queries, max_length=self.max_length, pair=docs, return_type_ids=True
         )
+
+        def dispatch(ids, mask, tids):
+            if self.mesh is not None:
+                ids = jax.device_put(ids, self._data_sharding)
+                mask = jax.device_put(mask, self._data_sharding)
+                tids = jax.device_put(tids, self._data_sharding)
+            return self._apply(self.params, ids, mask, tids)
+
         return bucketed_dispatch(
-            lambda ids, mask, tids: self._apply(self.params, ids, mask, tids),
+            dispatch,
             ids_all,
             mask_all,
             self.max_length,
             type_ids_all=type_ids_all,
             vocab_size=self.cfg.vocab_size,
+            batch_multiple=self._batch_multiple,
         )
 
     def __call__(self, query: str, doc: str) -> float:

@@ -314,14 +314,11 @@ class SentenceEncoder:
         self._batch_multiple = 1
         self._sp_mesh = None
         if mesh is not None:
-            from jax.sharding import NamedSharding
+            from ..parallel.sharding import mesh_setup
 
-            from ..parallel.mesh import data_axis
-            from ..parallel.sharding import batch_spec, shard_params
-
-            self.params = shard_params(self.params, mesh)
-            self._data_sharding = NamedSharding(mesh, batch_spec())
-            self._batch_multiple = int(mesh.shape.get(data_axis, 1))
+            self.params, self._data_sharding, self._batch_multiple = (
+                mesh_setup(self.params, mesh)
+            )
         self._apply = functools.partial(jax.jit(self._forward))
 
     def _forward(self, params, ids, mask):

@@ -21,7 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import data_axis, model_axis
 
-__all__ = ["encoder_param_specs", "shard_params", "batch_spec"]
+__all__ = ["encoder_param_specs", "shard_params", "batch_spec", "mesh_setup"]
 
 
 def batch_spec() -> P:
@@ -65,4 +65,20 @@ def shard_params(params: Any, mesh: Mesh) -> Any:
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
         params,
         specs,
+    )
+
+
+def mesh_setup(params: Any, mesh: Mesh):
+    """The dp/tp placement recipe shared by every bucketed-dispatch model
+    (SentenceEncoder, CrossEncoder): tensor-parallel weights, a
+    data-parallel batch sharding for inputs, and the multiple the batch
+    bucket must round to so it divides the data axis.
+
+    Returns ``(sharded_params, data_sharding, batch_multiple)``."""
+    from .mesh import data_axis
+
+    return (
+        shard_params(params, mesh),
+        NamedSharding(mesh, batch_spec()),
+        int(mesh.shape.get(data_axis, 1)),
     )

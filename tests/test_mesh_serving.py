@@ -212,3 +212,24 @@ def test_streaming_upsert_delete_sharded(corpus_dir, mesh):
         return r
 
     _wait_http(replaced)
+
+
+def test_cross_encoder_mesh_parity(mesh):
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.cross_encoder import CrossEncoder
+    from pathway_tpu.models.encoder import EncoderConfig
+
+    cfg = EncoderConfig(
+        vocab_size=512, hidden_dim=32, num_layers=2, num_heads=4, mlp_dim=64,
+        max_len=64, dtype=jnp.float32,
+    )
+    pairs = [
+        (f"query {i}", f"document body {i % 3} with words") for i in range(10)
+    ]
+    base = CrossEncoder(cfg=cfg, seed=4, max_length=64).predict(pairs)
+    dp = CrossEncoder(cfg=cfg, seed=4, max_length=64, mesh=mesh).predict(pairs)
+    np.testing.assert_allclose(base, dp, atol=2e-5)
+    tp_mesh = make_mesh(8, model_parallel=4)
+    tp = CrossEncoder(cfg=cfg, seed=4, max_length=64, mesh=tp_mesh).predict(pairs)
+    np.testing.assert_allclose(base, tp, atol=2e-5)
