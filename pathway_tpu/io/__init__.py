@@ -10,7 +10,8 @@ time (none are baked into this image).
 """
 
 from . import csv, fs, http, jsonlines, null, plaintext, python, sqlite
-from ._subscribe import subscribe
+from ._subscribe import OnChangeCallback, OnFinishCallback, subscribe
+from ._utils import CsvParserSettings
 from .streaming import ConnectorSubject, StreamingDriver
 
 _LAZY = {
@@ -47,6 +48,9 @@ __all__ = sorted(
         "subscribe",
         "ConnectorSubject",
         "StreamingDriver",
+        "CsvParserSettings",
+        "OnChangeCallback",
+        "OnFinishCallback",
         *_LAZY,
     ]
 )

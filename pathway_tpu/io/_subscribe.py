@@ -12,7 +12,12 @@ from ..internals.engine import OutputNode
 from ..internals.graph import G
 from ..internals.table import Table
 
-__all__ = ["subscribe"]
+__all__ = ["subscribe", "OnChangeCallback", "OnFinishCallback"]
+
+# callback type aliases (reference: internals/table_subscription.py
+# OnChangeCallback / OnFinishCallback protocols)
+OnChangeCallback = Callable[..., None]
+OnFinishCallback = Callable[[], None]
 
 
 def subscribe(

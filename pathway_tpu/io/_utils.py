@@ -110,3 +110,37 @@ def input_table(schema: SchemaMetaclass, subject=None, **params: Any) -> Table:
         "input", [], params=dict(schema=schema, subject=subject, **params)
     )
     return Table._new(op, schema, Universe())
+
+
+class CsvParserSettings:
+    """CSV parser settings (reference: io/_utils.py:125 — same fields;
+    consumed by ``pw.io.csv.read``/``pw.io.fs.read(format="csv")``)."""
+
+    def __init__(
+        self,
+        delimiter=",",
+        quote='"',
+        escape=None,
+        enable_double_quote_escapes=True,
+        enable_quoting=True,
+        comment_character=None,
+    ):
+        self.delimiter = delimiter
+        self.quote = quote
+        self.escape = escape
+        self.enable_double_quote_escapes = enable_double_quote_escapes
+        self.enable_quoting = enable_quoting
+        self.comment_character = comment_character
+
+    def reader_kwargs(self) -> dict:
+        import csv as _csv
+
+        kwargs = {
+            "delimiter": self.delimiter,
+            "quotechar": self.quote,
+            "escapechar": self.escape,
+            "doublequote": self.enable_double_quote_escapes,
+        }
+        if not self.enable_quoting:
+            kwargs["quoting"] = _csv.QUOTE_NONE
+        return kwargs

@@ -25,6 +25,7 @@ def read(
     mode: str = "streaming",
     with_metadata: bool = False,
     autocommit_duration_ms: int | None = 1500,
+    parser_settings=None,
     **kwargs: Any,
 ) -> Table:
     from .. import fs
@@ -33,6 +34,7 @@ def read(
         path,
         format="csv",
         schema=schema,
+        csv_settings=parser_settings,
         mode=mode,
         with_metadata=with_metadata,
         autocommit_duration_ms=autocommit_duration_ms,

@@ -59,6 +59,7 @@ class _FsSubject(ConnectorSubject):
         object_pattern: str,
         refresh_s: float,
         autocommit_ms: int | None,
+        csv_settings=None,
     ):
         super().__init__(datasource_name=f"fs:{path}")
         self.path = os.fspath(path)
@@ -69,6 +70,7 @@ class _FsSubject(ConnectorSubject):
         self.object_pattern = object_pattern
         self.refresh_s = refresh_s
         self._autocommit_ms = autocommit_ms
+        self.csv_settings = csv_settings
         # path -> (mtime, size, [row keys])
         self._seen: dict[str, tuple[float, int, list]] = {}
 
@@ -114,8 +116,16 @@ class _FsSubject(ConnectorSubject):
                 for i, line in enumerate(f):
                     yield (path, i), attach({"data": line.rstrip("\n")})
         elif self.fmt == "csv":
+            settings = self.csv_settings
+            reader_kwargs = settings.reader_kwargs() if settings else {}
+            comment = settings.comment_character if settings else None
             with open(path, newline="") as f:
-                for i, rec in enumerate(_csv.DictReader(f)):
+                lines = (
+                    (ln for ln in f if not ln.lstrip().startswith(comment))
+                    if comment
+                    else f
+                )
+                for i, rec in enumerate(_csv.DictReader(lines, **reader_kwargs)):
                     yield (path, i), attach(coerce_row(self.schema_for_rows, rec))
         elif self.fmt in ("json", "jsonlines"):
             with open(path) as f:
@@ -195,6 +205,7 @@ def read(
     autocommit_duration_ms: int | None = 1500,
     refresh_interval: float = 1.0,
     persistent_id: str | None = None,
+    csv_settings=None,
     **kwargs: Any,
 ) -> Table:
     """Read files under ``path`` (reference io/fs/__init__.py:369).
@@ -220,6 +231,7 @@ def read(
         object_pattern,
         refresh_interval,
         autocommit_duration_ms,
+        csv_settings=csv_settings,
     )
     subject.persistent_id = persistent_id
     subject._configure(out_schema, schema.primary_key_columns())

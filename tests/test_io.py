@@ -156,3 +156,27 @@ def test_rest_connector_echo():
     finally:
         subject.close()
         th.join(timeout=10)
+
+
+def test_csv_parser_settings(tmp_path):
+    """CsvParserSettings (reference io/_utils.py:125): delimiter, quote,
+    and comment-character control of the csv reader."""
+    p = tmp_path / "data.csv"
+    p.write_text(
+        "# a comment line\n"
+        "name;age\n"
+        "'van der Berg; Jan';41\n"
+        "bo;28\n"
+    )
+    settings = pw.io.CsvParserSettings(
+        delimiter=";", quote="'", comment_character="#"
+    )
+
+    class S(pw.Schema):
+        name: str
+        age: int
+
+    t = pw.io.csv.read(str(p), schema=S, mode="static", parser_settings=settings)
+    df = pw.debug.table_to_pandas(t)
+    assert sorted(df["name"]) == ["bo", "van der Berg; Jan"], df
+    assert sorted(df["age"]) == [28, 41]
