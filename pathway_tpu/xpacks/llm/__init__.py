@@ -7,6 +7,10 @@ reference: python/pathway/xpacks/llm/__init__.py.  The component families
 JAX modules on the TPU instead of torch-on-CPU/GPU inside the UDF.
 """
 
+from typing import Callable, Iterable, TypeAlias, Union
+
+from ...internals.udfs import UDF as _UDF
+
 from . import (
     embedders,
     llms,
@@ -16,6 +20,14 @@ from . import (
     rerankers,
     splitters,
 )
+
+# document-transformer typing surface (reference: xpacks/llm/_typing.py)
+Doc: TypeAlias = dict[str, str | dict]
+DocTransformerCallable: TypeAlias = Union[
+    Callable[[Iterable[Doc]], Iterable[Doc]],
+    Callable[[Iterable[Doc], float], Iterable[Doc]],
+]
+DocTransformer: TypeAlias = Union[_UDF, DocTransformerCallable]
 
 __all__ = [
     "embedders",
@@ -28,6 +40,9 @@ __all__ = [
     "vector_store",
     "document_store",
     "question_answering",
+    "Doc",
+    "DocTransformer",
+    "DocTransformerCallable",
     "servers",
 ]
 
