@@ -233,3 +233,31 @@ def test_cross_encoder_mesh_parity(mesh):
     tp_mesh = make_mesh(8, model_parallel=4)
     tp = CrossEncoder(cfg=cfg, seed=4, max_length=64, mesh=tp_mesh).predict(pairs)
     np.testing.assert_allclose(base, tp, atol=2e-5)
+
+
+def test_declarative_mesh_in_yaml_template(corpus_dir):
+    """Multi-chip serving is expressible declaratively: a !pw tag builds
+    the mesh and threads it into VectorStoreServer (yaml_loader.py)."""
+    yaml_text = f"""
+$mesh: !pw.parallel.make_mesh
+  n_devices: 8
+
+$docs: !pw.io.fs.read
+  path: {corpus_dir}
+  format: binary
+  with_metadata: true
+  mode: static
+
+$embedder: !pw.xpacks.llm.mocks.FakeEmbedder
+  dim: 16
+
+store: !pw.xpacks.llm.vector_store.VectorStoreServer
+  __args__: [$docs]
+  embedder: $embedder
+  mesh: $mesh
+"""
+    app = pw.load_yaml(yaml_text)
+    vs = app["store"]
+    assert vs.index_factory.mesh is not None
+    inner = vs.index_factory.build_inner_index()
+    assert isinstance(inner.index, ShardedKnnIndex)
