@@ -211,8 +211,9 @@ th.start()
 
 # exit suddenly once this shard has settled (quiescent for 4s after first
 # data).  Generous ceiling: on a loaded 1-core host the engine may take
-# minutes to even start ingesting (observed in a 25x loop under load)
-deadline = time.monotonic() + 240
+# minutes to even start ingesting (observed in a 25x loop under load,
+# and again when the full suite shares the core with other work)
+deadline = time.monotonic() + 420
 while time.monotonic() < deadline:
     if state and time.monotonic() - last_change[0] > 4.0:
         break
@@ -269,7 +270,7 @@ def test_two_process_kill_restart_recovery(tmp_path):
             )
         outs = []
         for p in procs:
-            _, err = p.communicate(timeout=360)
+            _, err = p.communicate(timeout=600)
             assert p.returncode == 9, err[-3000:]
         for pid in range(2):
             outs.append(json.loads(
