@@ -16,9 +16,35 @@ from __future__ import annotations
 import os
 
 
+def _machine_tag() -> str:
+    """Fingerprint the host for CPU-backend cache separation.
+
+    XLA:CPU AOT artifacts bake in the compiling machine's CPU features;
+    loading them on a host with different features logs loud warnings
+    and can SIGILL.  Keying the cache dir on (platform, machine, a hash
+    of the cpu flags) keeps artifacts machine-local while still sharing
+    TPU executables (which key on device kind, not host CPU)."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    flags = line
+                    break
+    except OSError:
+        pass
+    digest = hashlib.blake2b(
+        flags.encode(), digest_size=4
+    ).hexdigest()
+    return f"{platform.machine()}-{digest}"
+
+
 def default_cache_dir() -> str:
     return os.environ.get("PATHWAY_JAX_CACHE_DIR") or os.path.join(
-        os.path.expanduser("~"), ".cache", "pathway_tpu", "xla"
+        os.path.expanduser("~"), ".cache", "pathway_tpu", "xla", _machine_tag()
     )
 
 
