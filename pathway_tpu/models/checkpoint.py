@@ -41,7 +41,11 @@ __all__ = [
     "load_cross_encoder",
 ]
 
-_PREFIXES = ("bert.", "model.", "0.auto_model.", "auto_model.")
+_PREFIXES = (
+    "bert.", "model.", "0.auto_model.", "auto_model.",
+    # GPT2LMHeadModel nests the decoder under "transformer."
+    "transformer.",
+)
 
 
 def _strip_prefix(sd: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
@@ -232,5 +236,89 @@ def load_cross_encoder(model_name: str):
         cfg = bert_config_from_hf(local)
         sd = load_state_dict(local)
         return cfg, classifier_to_flax(sd, cfg)
+    except (FileNotFoundError, KeyError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# GPT-2-family decoder checkpoints (models/decoder.py)
+# ---------------------------------------------------------------------------
+
+
+def gpt2_config_from_hf(path_or_dict):
+    """DecoderConfig from an HF gpt2-style config.json/dict."""
+    import json as _json
+
+    from .decoder import DecoderConfig
+
+    if isinstance(path_or_dict, str):
+        cfg_path = path_or_dict
+        if os.path.isdir(cfg_path):
+            cfg_path = os.path.join(cfg_path, "config.json")
+        elif not cfg_path.endswith(".json"):
+            # a checkpoint FILE path: its directory holds config.json
+            cfg_path = os.path.join(os.path.dirname(cfg_path), "config.json")
+        with open(cfg_path) as f:
+            hf = _json.load(f)
+    else:
+        hf = dict(path_or_dict)
+    return DecoderConfig(
+        vocab_size=hf.get("vocab_size", 50257),
+        hidden_dim=hf.get("n_embd", 768),
+        num_layers=hf.get("n_layer", 12),
+        num_heads=hf.get("n_head", 12),
+        mlp_dim=hf.get("n_inner") or 4 * hf.get("n_embd", 768),
+        max_len=hf.get("n_positions", 1024),
+        ln_eps=hf.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def gpt2_to_flax(sd, cfg) -> dict:
+    """HF ``GPT2LMHeadModel``/``GPT2Model`` state dict -> Decoder params.
+
+    HF's Conv1D stores weights as ``(in, out)`` — the same orientation as
+    flax ``nn.Dense`` kernels, so they map without transposition."""
+    sd = _strip_prefix(sd)
+
+    def dense(key):
+        return {
+            "kernel": _to_numpy(sd[f"{key}.weight"]),
+            "bias": _to_numpy(sd[f"{key}.bias"]),
+        }
+
+    def ln(key):
+        return {
+            "scale": _to_numpy(sd[f"{key}.weight"]),
+            "bias": _to_numpy(sd[f"{key}.bias"]),
+        }
+
+    params: dict = {
+        "wte": {"embedding": _to_numpy(sd["wte.weight"])},
+        "wpe": {"embedding": _to_numpy(sd["wpe.weight"])},
+        "ln_f": ln("ln_f"),
+    }
+    for i in range(cfg.num_layers):
+        hf = f"h.{i}"
+        params[f"h_{i}"] = {
+            "ln_1": ln(f"{hf}.ln_1"),
+            "c_attn": dense(f"{hf}.attn.c_attn"),
+            "attn_proj": dense(f"{hf}.attn.c_proj"),
+            "ln_2": ln(f"{hf}.ln_2"),
+            "c_fc": dense(f"{hf}.mlp.c_fc"),
+            "mlp_proj": dense(f"{hf}.mlp.c_proj"),
+        }
+    return params
+
+
+def load_decoder(model_name: str):
+    """(cfg, params) for ``Decoder`` from a local gpt2-family checkpoint,
+    or None if unavailable."""
+    local = _resolve_local(model_name)
+    if local is None:
+        return None
+    try:
+        cfg = gpt2_config_from_hf(local)
+        sd = load_state_dict(local)
+        return cfg, gpt2_to_flax(sd, cfg)
     except (FileNotFoundError, KeyError):
         return None

@@ -1,0 +1,364 @@
+"""Causal-LM decoder (GPT-2 class): local chat generation on TPU.
+
+TPU-native replacement for the reference's ``HFPipelineChat`` compute
+path (xpacks/llm/llms.py:441 — a torch ``transformers`` text-generation
+pipeline on CPU).  Decoding is the classic TPU recipe: static shapes
+everywhere, one prefill over the padded prompt, then a ``lax.scan`` over
+generation steps reading/writing a preallocated kv cache — no Python
+control flow inside jit, one compilation per (prompt bucket,
+max_new_tokens).
+
+Weight layout follows HF GPT-2 conventions (pre-LN blocks, fused c_attn,
+tanh-approx GELU, tied output head) so converted checkpoints are
+weight-compatible (models/checkpoint.py ``gpt2_to_flax``); parity with
+``transformers.GPT2LMHeadModel`` is pinned in tests/test_decoder.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax import lax
+
+from .tokenizer import load_tokenizer
+
+__all__ = ["DecoderConfig", "Decoder", "CausalLM"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    """gpt2 (124M) geometry by default."""
+
+    vocab_size: int = 50257
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    ln_eps: float = 1e-5
+
+
+class _Block(nn.Module):
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, T, D = x.shape
+        H = cfg.num_heads
+        Dh = D // H
+        h = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_1")(x)
+        h = h.astype(cfg.dtype)
+        qkv = nn.Dense(3 * D, dtype=cfg.dtype, name="c_attn")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, Dh)
+        k = k.reshape(B, T, H, Dh)
+        v = v.reshape(B, T, H, Dh)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) / np.sqrt(Dh)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+        x = x + nn.Dense(D, dtype=cfg.dtype, name="attn_proj")(ctx)
+        h2 = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_2")(x)
+        h2 = h2.astype(cfg.dtype)
+        m = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="c_fc")(h2)
+        m = jax.nn.gelu(m, approximate=True)
+        return x + nn.Dense(D, dtype=cfg.dtype, name="mlp_proj")(m)
+
+
+class Decoder(nn.Module):
+    """Full-sequence forward: ``[B, T] ids -> [B, T, V] logits``."""
+
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, ids):
+        cfg = self.cfg
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_dim, dtype=cfg.dtype, name="wte")
+        wpe = nn.Embed(cfg.max_len, cfg.hidden_dim, dtype=cfg.dtype, name="wpe")
+        T = ids.shape[1]
+        x = wte(ids) + wpe(jnp.arange(T)[None, :])
+        for i in range(cfg.num_layers):
+            x = _Block(self.cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_f")(x)
+        # tied head (HF lm_head shares wte)
+        return jnp.einsum(
+            "btd,vd->btv", x.astype(jnp.float32),
+            wte.embedding.astype(jnp.float32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# functional forward with kv cache — prefill + scan decode inside one jit
+# ---------------------------------------------------------------------------
+
+
+def _ln(x, p, eps):
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _block_prefill(x, p, cfg, pos_mask):
+    """Full-prompt pass for one layer; returns (x, k, v) with k/v shaped
+    ``[B, T, H, Dh]`` for the cache."""
+    B, T, D = x.shape
+    H = cfg.num_heads
+    Dh = D // H
+    h = _ln(x, p["ln_1"], cfg.ln_eps).astype(cfg.dtype)
+    qkv = h @ p["c_attn"]["kernel"] + p["c_attn"]["bias"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, Dh)
+    k = k.reshape(B, T, H, Dh)
+    v = v.reshape(B, T, H, Dh)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / np.sqrt(Dh)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    valid = causal[None, None] & pos_mask[:, None, None, :]
+    scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, D)
+    x = x + ctx @ p["attn_proj"]["kernel"] + p["attn_proj"]["bias"]
+    h2 = _ln(x, p["ln_2"], cfg.ln_eps).astype(cfg.dtype)
+    m = jax.nn.gelu(h2 @ p["c_fc"]["kernel"] + p["c_fc"]["bias"], approximate=True)
+    x = x + m @ p["mlp_proj"]["kernel"] + p["mlp_proj"]["bias"]
+    return x, k, v
+
+
+def _logits_of(x, params):
+    wte = params["wte"]["embedding"].astype(jnp.float32)
+    return x.astype(jnp.float32) @ wte.T
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new", "greedy")
+)
+def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
+                  greedy: bool, rng, temperature):
+    """Prefill + scan decode.  ids: ``[B, Tp]`` left-padded to a static
+    prompt bucket with real length per row in ``length``; returns
+    ``[B, max_new]`` generated ids."""
+    B, Tp = ids.shape
+    D = cfg.hidden_dim
+    H = cfg.num_heads
+    Dh = D // H
+    Tmax = Tp + max_new
+    pos_mask = jnp.arange(Tp)[None, :] < length[:, None]
+    positions = jnp.arange(Tp)[None, :]
+    x = (
+        params["wte"]["embedding"][ids]
+        + params["wpe"]["embedding"][positions]
+    ).astype(cfg.dtype)
+    k_caches = []
+    v_caches = []
+    for i in range(cfg.num_layers):
+        x, k, v = _block_prefill(x, params[f"h_{i}"], cfg, pos_mask)
+        k_pad = jnp.zeros((B, Tmax, H, Dh), cfg.dtype).at[:, :Tp].set(k)
+        v_pad = jnp.zeros((B, Tmax, H, Dh), cfg.dtype).at[:, :Tp].set(v)
+        k_caches.append(k_pad)
+        v_caches.append(v_pad)
+    x = _ln(x, params["ln_f"], cfg.ln_eps)
+    # logits at each row's LAST real token
+    last = jnp.take_along_axis(x, (length - 1)[:, None, None], axis=1)[:, 0]
+    logits = _logits_of(last, params)
+    k_stack = jnp.stack(k_caches)  # [L, B, Tmax, H, Dh]
+    v_stack = jnp.stack(v_caches)
+
+    def pick(logits, rng):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits / jnp.maximum(temperature, 1e-6), axis=-1
+        ).astype(jnp.int32)
+
+    def step(carry, i):
+        logits, k_stack, v_stack, rng = carry
+        rng, sub = jax.random.split(rng)
+        tok = pick(logits, sub)
+        pos = length + i  # per-row write position
+        # embed the new token at its per-row position
+        x = (
+            params["wte"]["embedding"][tok]
+            + params["wpe"]["embedding"][jnp.minimum(pos, cfg.max_len - 1)]
+        ).astype(cfg.dtype)
+        new_k = []
+        new_v = []
+        # per-row positions differ; dynamic_update needs a scalar index,
+        # so scatter with one-hot over the time axis instead
+        t_iota = jnp.arange(Tmax)
+        write = t_iota[None, :] == pos[:, None]  # [B, Tmax]
+        for li in range(cfg.num_layers):
+            p = params[f"h_{li}"]
+            h = _ln(x, p["ln_1"], cfg.ln_eps).astype(cfg.dtype)
+            qkv = h @ p["c_attn"]["kernel"] + p["c_attn"]["bias"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, H, Dh)
+            kc = jnp.where(
+                write[:, :, None, None],
+                k.reshape(B, 1, H, Dh).astype(k_stack.dtype),
+                k_stack[li],
+            )
+            vc = jnp.where(
+                write[:, :, None, None],
+                v.reshape(B, 1, H, Dh).astype(v_stack.dtype),
+                v_stack[li],
+            )
+            k_stack = k_stack.at[li].set(kc)
+            v_stack = v_stack.at[li].set(vc)
+            scores = jnp.einsum(
+                "bhd,bthd->bht", q, kc, preferred_element_type=jnp.float32
+            ) / np.sqrt(Dh)
+            t_mask = t_iota[None, :] <= pos[:, None]
+            scores = jnp.where(t_mask[:, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            ctx = jnp.einsum("bht,bthd->bhd", probs, vc).reshape(B, D)
+            x = x + ctx @ p["attn_proj"]["kernel"] + p["attn_proj"]["bias"]
+            h2 = _ln(x, p["ln_2"], cfg.ln_eps).astype(cfg.dtype)
+            m = jax.nn.gelu(
+                h2 @ p["c_fc"]["kernel"] + p["c_fc"]["bias"], approximate=True
+            )
+            x = x + m @ p["mlp_proj"]["kernel"] + p["mlp_proj"]["bias"]
+        x = _ln(x, params["ln_f"], cfg.ln_eps)
+        logits = _logits_of(x, params)
+        return (logits, k_stack, v_stack, rng), tok
+
+    (_, _, _, _), toks = lax.scan(
+        step, (logits, k_stack, v_stack, rng), jnp.arange(max_new)
+    )
+    return jnp.transpose(toks, (1, 0))  # [B, max_new]
+
+
+_PROMPT_BUCKETS = (32, 64, 128, 256, 512, 1024)
+
+
+class CausalLM:
+    """Host-facing generator: tokenize, bucket, jit-generate, detokenize.
+
+    ``model_name`` resolves a local GPT-2-family checkpoint
+    (models/checkpoint.py ``load_decoder``); without one the geometry is
+    random-initialized (useful for latency work and tests — the API and
+    compiled program are identical)."""
+
+    def __init__(
+        self,
+        model_name: str | None = None,
+        cfg: DecoderConfig | None = None,
+        seed: int = 0,
+    ):
+        self.pretrained = False
+        params = None
+        if model_name is not None:
+            from . import checkpoint
+
+            loaded = checkpoint.load_decoder(model_name)
+            if loaded is not None:
+                loaded_cfg, params = loaded
+                cfg = dataclasses.replace(
+                    loaded_cfg, dtype=(cfg or DecoderConfig()).dtype
+                )
+                self.pretrained = True
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"no local checkpoint for {model_name!r}: CausalLM "
+                    "runs RANDOM-INITIALIZED weights (generation is "
+                    "deterministic noise) — cache the model locally for "
+                    "real text",
+                    stacklevel=2,
+                )
+        self.cfg = cfg or DecoderConfig()
+        self.tokenizer = load_tokenizer(
+            model_name, vocab_size=self.cfg.vocab_size
+        )
+        self.model = Decoder(self.cfg)
+        if params is not None:
+            self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        else:
+            ids = jnp.zeros((1, 8), jnp.int32)
+            self.params = self.model.init(jax.random.PRNGKey(seed), ids)[
+                "params"
+            ]
+
+    def logits(self, ids) -> jax.Array:
+        """Full-sequence logits (scoring path)."""
+        return self.model.apply({"params": self.params}, jnp.asarray(ids))
+
+    def generate_ids(
+        self,
+        prompts_ids: Sequence[Sequence[int]],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """Generate token ids for a batch of prompts -> [B, max_new]."""
+        if max_new_tokens >= self.cfg.max_len:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} must leave room for a "
+                f"prompt within max_len={self.cfg.max_len}"
+            )
+        lengths = np.asarray([len(p) for p in prompts_ids], np.int32)
+        longest = int(lengths.max())
+        bucket = next(
+            (b for b in _PROMPT_BUCKETS if b >= longest), _PROMPT_BUCKETS[-1]
+        )
+        bucket = max(min(bucket, self.cfg.max_len - max_new_tokens), 1)
+        ids = np.zeros((len(prompts_ids), bucket), np.int32)
+        for i, p in enumerate(prompts_ids):
+            # keep the TAIL of over-long prompts: the question/recent
+            # context lives there (reference: HFPipelineChat
+            # crop_to_max_length keeps tokens[-max_prompt_length:])
+            tail = np.asarray(p[-bucket:], np.int32)
+            ids[i, : len(tail)] = tail
+        lengths = np.minimum(lengths, bucket)
+        out = _generate_jit(
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray(lengths),
+            self.cfg,
+            int(max_new_tokens),
+            temperature <= 0.0,
+            jax.random.PRNGKey(seed),
+            jnp.float32(max(temperature, 1e-6)),
+        )
+        return np.asarray(out)
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> list[str]:
+        encode = getattr(self.tokenizer, "encode_ids", None)
+        if encode is None:
+            # reuse the batch encoder and strip padding
+            ids_all, mask_all = self.tokenizer.encode_batch(
+                list(prompts), max_length=self.cfg.max_len
+            )
+            prompt_ids = [
+                ids_all[i, : int(mask_all[i].sum())].tolist()
+                for i in range(len(prompts))
+            ]
+        else:
+            prompt_ids = [encode(p) for p in prompts]
+        toks = self.generate_ids(
+            prompt_ids, max_new_tokens=max_new_tokens,
+            temperature=temperature, seed=seed,
+        )
+        decode = getattr(self.tokenizer, "decode_ids", None)
+        if decode is not None:
+            return [decode(row.tolist()) for row in toks]
+        return [" ".join(f"<{t}>" for t in row.tolist()) for row in toks]

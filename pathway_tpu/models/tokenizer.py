@@ -151,6 +151,14 @@ class _HFTokenizerWrapper:
         )
         return ids, mask, type_ids
 
+    # unpadded id codec (decoder generation path — GPT-2-family
+    # tokenizers have no pad token, so padding="max_length" would raise)
+    def encode_ids(self, text: str) -> list[int]:
+        return list(self.tok.encode(text, add_special_tokens=False))
+
+    def decode_ids(self, ids) -> str:
+        return self.tok.decode(list(ids), skip_special_tokens=True)
+
 
 def load_tokenizer(model_name: str | None = None, vocab_size: int = 30522):
     """Local HF tokenizer when available, hashing fallback otherwise."""

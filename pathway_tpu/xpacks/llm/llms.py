@@ -7,8 +7,9 @@ reference: python/pathway/xpacks/llm/llms.py — ``BaseChat``:27,
 Chats take a tuple/list of ``{"role": ..., "content": ...}`` dicts (or a
 Json of the same) and return the completion string.  API chats are async
 UDFs with capacity/retry/cache; ``HFPipelineChat`` runs a local
-transformers pipeline (torch CPU in this image — a flax causal-LM serving
-path is the models/ roadmap item).
+transformers pipeline (torch CPU), and ``JaxPipelineChat`` is its
+TPU-native counterpart — the flax causal-LM with jitted prefill +
+scan + kv-cache decoding (models/decoder.py).
 """
 
 from __future__ import annotations
@@ -71,6 +72,7 @@ __all__ = [
     "OpenAIChat",
     "LiteLLMChat",
     "HFPipelineChat",
+    "JaxPipelineChat",
     "CohereChat",
     "prompt_chat_single_qa",
 ]
@@ -313,3 +315,62 @@ def prompt_chat_single_qa(question: ColumnExpression) -> ColumnExpression:
         return Json([{"role": "user", "content": coerce_str(q)}])
 
     return ApplyExpression(to_msg, Json, smart_wrap(question))
+
+
+class JaxPipelineChat(BaseChat):
+    """Local causal-LM chat on TPU (models/decoder.py CausalLM): the
+    jit-compiled prefill + scan + kv-cache counterpart of the
+    reference's torch ``HFPipelineChat`` (llms.py:441).  ``model``
+    resolves a local GPT-2-family checkpoint; pass ``causal_lm=`` for a
+    ready :class:`pathway_tpu.models.decoder.CausalLM`."""
+
+    def __init__(
+        self,
+        model: str | None = "gpt2",
+        *,
+        causal_lm: Any = None,
+        call_kwargs: dict = {},
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        **init_kwargs,
+    ):
+        super().__init__(executor=udfs.async_executor(), deterministic=True)
+        self.model = model
+        self._lm = causal_lm
+        self.call_kwargs = dict(call_kwargs)
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self._init_kwargs = init_kwargs
+
+    def _ensure_lm(self):
+        if self._lm is None:
+            from ...models.decoder import CausalLM
+
+            self._lm = CausalLM(self.model, **self._init_kwargs)
+        return self._lm
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return arg_name in ("max_new_tokens", "temperature", "seed")
+
+    async def __wrapped__(self, messages, **kwargs) -> str | None:
+        import asyncio
+
+        lm = self._ensure_lm()
+        kwargs = {**self.call_kwargs, **kwargs}
+        msgs = _messages_to_list(messages)
+        prompt = "\n".join(coerce_str(m.get("content", "")) for m in msgs)
+
+        def _gen() -> str:
+            [text] = lm.generate(
+                [prompt],
+                max_new_tokens=int(
+                    kwargs.get("max_new_tokens", self.max_new_tokens)
+                ),
+                temperature=float(kwargs.get("temperature", self.temperature)),
+                seed=int(kwargs.get("seed", 0)),
+            )
+            return text
+
+        # compile + device generation are seconds-long synchronous work;
+        # run off the event loop so concurrent async chats keep flowing
+        return await asyncio.to_thread(_gen)
