@@ -142,3 +142,41 @@ def test_random_init_warns_for_named_model(monkeypatch):
     monkeypatch.setattr(ckpt, "load_decoder", lambda name: None)
     with pytest.warns(UserWarning, match="RANDOM-INITIALIZED"):
         CausalLM("definitely-not-cached", cfg=TINY)
+
+
+def test_adaptive_rag_with_local_jax_lm(tmp_path):
+    """BASELINE config #4 shape: AdaptiveRAG question answering with a
+    LOCAL causal LM on the device plane (the reference uses a local
+    Mistral via torch; here the flax decoder serves the same role) —
+    retrieval, geometric context escalation, and generation all run
+    in-process with no API."""
+    import pathway_tpu as pw
+    import pathway_tpu.debug as dbg
+    from pathway_tpu.models.decoder import CausalLM
+    from pathway_tpu.xpacks.llm import mocks
+    from pathway_tpu.xpacks.llm.llms import JaxPipelineChat
+    from pathway_tpu.xpacks.llm.question_answering import (
+        AdaptiveRAGQuestionAnswerer,
+    )
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    (tmp_path / "doc1.txt").write_text("Berlin is the capital of Germany.")
+    (tmp_path / "doc2.txt").write_text("Paris is the capital of France.")
+    docs = pw.io.fs.read(
+        str(tmp_path), format="binary", mode="static", with_metadata=True
+    )
+    vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=8))
+    qa = AdaptiveRAGQuestionAnswerer(
+        llm=JaxPipelineChat(
+            model=None, causal_lm=CausalLM(cfg=TINY, seed=5), max_new_tokens=4
+        ),
+        indexer=vs,
+        max_iterations=2,
+    )
+    queries = dbg.table_from_rows(
+        qa.AnswerQuerySchema,
+        [("What is the capital of France?", None, None, False, "short")],
+    )
+    _, cols = dbg.table_to_dicts(qa.answer_query(queries))
+    [result] = [r.value for r in cols["result"].values()]
+    assert isinstance(result["response"], str) and result["response"]
