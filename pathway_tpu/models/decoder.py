@@ -193,8 +193,6 @@ def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
             params["wte"]["embedding"][tok]
             + params["wpe"]["embedding"][jnp.minimum(pos, cfg.max_len - 1)]
         ).astype(cfg.dtype)
-        new_k = []
-        new_v = []
         # per-row positions differ; dynamic_update needs a scalar index,
         # so scatter with one-hot over the time axis instead
         t_iota = jnp.arange(Tmax)
@@ -256,6 +254,7 @@ class CausalLM:
         model_name: str | None = None,
         cfg: DecoderConfig | None = None,
         seed: int = 0,
+        mesh=None,
     ):
         self.pretrained = False
         params = None
@@ -291,6 +290,14 @@ class CausalLM:
             self.params = self.model.init(jax.random.PRNGKey(seed), ids)[
                 "params"
             ]
+        # multi-chip decoding: Megatron tensor parallelism over the
+        # mesh's model axis (parallel/sharding.decoder_param_specs);
+        # XLA inserts the psums after the row-parallel projections
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharding import shard_decoder_params
+
+            self.params = shard_decoder_params(self.params, mesh)
 
     def logits(self, ids) -> jax.Array:
         """Full-sequence logits (scoring path)."""

@@ -21,7 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import data_axis, model_axis
 
-__all__ = ["encoder_param_specs", "shard_params", "batch_spec", "mesh_setup"]
+__all__ = ["encoder_param_specs", "shard_params", "batch_spec", "mesh_setup", "decoder_param_specs", "shard_decoder_params"]
 
 
 def batch_spec() -> P:
@@ -81,4 +81,42 @@ def mesh_setup(params: Any, mesh: Mesh):
         shard_params(params, mesh),
         NamedSharding(mesh, batch_spec()),
         int(mesh.shape.get(data_axis, 1)),
+    )
+
+
+def decoder_param_specs(params: Any) -> Any:
+    """PartitionSpec pytree for ``models/decoder.py`` (GPT-2 layout).
+
+    Megatron split adapted to the fused-qkv layout: ``c_attn (D, 3D)``
+    and ``c_fc (D, M)`` column-parallel, ``attn_proj``/``mlp_proj``
+    row-parallel (one psum each), embeddings/layernorms replicated.
+    Note the fused qkv's output shards span q/k/v boundaries; GSPMD
+    repartitions after the in-graph split (correctness guaranteed; a
+    de-fused qkv would save that collective — future optimization)."""
+
+    def spec_for(path: tuple, leaf) -> P:
+        names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+        joined = "/".join(str(n) for n in names)
+        ndim = getattr(leaf, "ndim", 0)
+        if names[-1] == "kernel" and ndim == 2:
+            if "c_attn" in joined or "c_fc" in joined:
+                return P(None, model_axis)
+            if "attn_proj" in joined or "mlp_proj" in joined:
+                return P(model_axis, None)
+            return P(None, None)
+        if names[-1] == "bias" and ndim == 1 and (
+            "c_attn" in joined or "c_fc" in joined
+        ):
+            return P(model_axis)
+        return P(*([None] * ndim))
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_decoder_params(params: Any, mesh: Mesh) -> Any:
+    specs = decoder_param_specs(params)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params,
+        specs,
     )

@@ -180,3 +180,16 @@ def test_adaptive_rag_with_local_jax_lm(tmp_path):
     _, cols = dbg.table_to_dicts(qa.answer_query(queries))
     [result] = [r.value for r in cols["result"].values()]
     assert isinstance(result["response"], str) and result["response"]
+
+
+def test_causal_lm_tensor_parallel_parity():
+    """tp decoding on the 8-device CPU mesh reproduces the single-device
+    greedy continuation exactly (parallel/sharding.decoder_param_specs)."""
+    from pathway_tpu.parallel import make_mesh
+
+    prompts = [[3, 7, 11, 19], [2, 4]]
+    base = CausalLM(cfg=TINY, seed=5).generate_ids(prompts, max_new_tokens=6)
+    tp = CausalLM(
+        cfg=TINY, seed=5, mesh=make_mesh(8, model_parallel=4)
+    ).generate_ids(prompts, max_new_tokens=6)
+    np.testing.assert_array_equal(base, tp)
