@@ -142,11 +142,34 @@ def _logits_of(x, params):
     return x.astype(jnp.float32) @ wte.T
 
 
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Standard sampling filters, all static-shape: keep the top-k
+    logits and/or the smallest nucleus whose probability mass reaches
+    ``top_p``; everything else goes to -inf."""
+    V = logits.shape[-1]
+    if 0 < top_k < V:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the cumulative mass BEFORE them is < top_p
+        # (always keeps the most probable token)
+        keep_sorted = (cum - probs) < top_p
+        cutoff = jnp.where(
+            keep_sorted, sorted_logits, jnp.inf
+        ).min(axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new", "greedy")
+    jax.jit, static_argnames=("cfg", "max_new", "greedy", "top_k", "top_p")
 )
 def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
-                  greedy: bool, rng, temperature):
+                  greedy: bool, rng, temperature, top_k: int = 0,
+                  top_p: float = 1.0):
     """Prefill + scan decode.  ids: ``[B, Tp]`` left-padded to a static
     prompt bucket with real length per row in ``length``; returns
     ``[B, max_new]`` generated ids."""
@@ -179,6 +202,7 @@ def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
     def pick(logits, rng):
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _filter_logits(logits, top_k, top_p)
         return jax.random.categorical(
             rng, logits / jnp.maximum(temperature, 1e-6), axis=-1
         ).astype(jnp.int32)
@@ -309,6 +333,8 @@ class CausalLM:
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> np.ndarray:
         """Generate token ids for a batch of prompts -> [B, max_new]."""
         if max_new_tokens >= self.cfg.max_len:
@@ -339,6 +365,8 @@ class CausalLM:
             temperature <= 0.0,
             jax.random.PRNGKey(seed),
             jnp.float32(max(temperature, 1e-6)),
+            top_k=int(top_k),
+            top_p=float(top_p),
         )
         return np.asarray(out)
 
@@ -348,6 +376,8 @@ class CausalLM:
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> list[str]:
         encode = getattr(self.tokenizer, "encode_ids", None)
         if encode is None:
@@ -363,7 +393,7 @@ class CausalLM:
             prompt_ids = [encode(p) for p in prompts]
         toks = self.generate_ids(
             prompt_ids, max_new_tokens=max_new_tokens,
-            temperature=temperature, seed=seed,
+            temperature=temperature, seed=seed, top_k=top_k, top_p=top_p,
         )
         decode = getattr(self.tokenizer, "decode_ids", None)
         if decode is not None:

@@ -350,7 +350,9 @@ class JaxPipelineChat(BaseChat):
         return self._lm
 
     def _accepts_call_arg(self, arg_name: str) -> bool:
-        return arg_name in ("max_new_tokens", "temperature", "seed")
+        return arg_name in (
+            "max_new_tokens", "temperature", "seed", "top_k", "top_p"
+        )
 
     async def __wrapped__(self, messages, **kwargs) -> str | None:
         import asyncio
@@ -368,6 +370,8 @@ class JaxPipelineChat(BaseChat):
                 ),
                 temperature=float(kwargs.get("temperature", self.temperature)),
                 seed=int(kwargs.get("seed", 0)),
+                top_k=int(kwargs.get("top_k", 0)),
+                top_p=float(kwargs.get("top_p", 1.0)),
             )
             return text
 

@@ -193,3 +193,27 @@ def test_causal_lm_tensor_parallel_parity():
         cfg=TINY, seed=5, mesh=make_mesh(8, model_parallel=4)
     ).generate_ids(prompts, max_new_tokens=6)
     np.testing.assert_array_equal(base, tp)
+
+
+def test_top_k_top_p_sampling():
+    lm = CausalLM(cfg=TINY, seed=5)
+    prompts = [[5, 9, 13, 2]]
+    # top_k=1 == greedy regardless of temperature
+    greedy = lm.generate_ids(prompts, max_new_tokens=6)
+    k1 = lm.generate_ids(
+        prompts, max_new_tokens=6, temperature=1.5, seed=3, top_k=1
+    )
+    np.testing.assert_array_equal(greedy, k1)
+    # a tiny nucleus similarly collapses to the argmax
+    p_small = lm.generate_ids(
+        prompts, max_new_tokens=6, temperature=1.5, seed=3, top_p=1e-6
+    )
+    np.testing.assert_array_equal(greedy, p_small)
+    # a loose filter still samples (deterministically per seed)
+    a = lm.generate_ids(
+        prompts, max_new_tokens=6, temperature=1.0, seed=3, top_k=50, top_p=0.95
+    )
+    b = lm.generate_ids(
+        prompts, max_new_tokens=6, temperature=1.0, seed=3, top_k=50, top_p=0.95
+    )
+    np.testing.assert_array_equal(a, b)
