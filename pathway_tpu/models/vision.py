@@ -123,11 +123,21 @@ def _decode_image(data: Any, size: int) -> np.ndarray:
 class ImageEncoder:
     """Host-facing image embedder: decode + bucketed jit dispatch."""
 
-    def __init__(self, cfg: VisionConfig | None = None, seed: int = 0):
+    def __init__(self, cfg: VisionConfig | None = None, seed: int = 0, mesh=None):
         self.cfg = cfg or VisionConfig()
         self.model = VisionTransformer(self.cfg)
         dummy = jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3))
         self.params = self.model.init(jax.random.PRNGKey(seed), dummy)["params"]
+        # multi-chip: the ViT blocks use the encoder naming (attention /
+        # mlp_in / mlp_out), so the shared Megatron specs apply directly
+        self.mesh = mesh
+        self._batch_multiple = 1
+        if mesh is not None:
+            from ..parallel.sharding import mesh_setup
+
+            self.params, self._data_sharding, self._batch_multiple = (
+                mesh_setup(self.params, mesh)
+            )
         self._apply = jax.jit(
             lambda params, images: self.model.apply({"params": params}, images)
         )
@@ -146,13 +156,18 @@ class ImageEncoder:
         batch = np.stack([_decode_image(im, size) for im in images])
         b = batch.shape[0]
         bucket = next((bb for bb in BATCH_BUCKETS if b <= bb), BATCH_BUCKETS[-1])
+        if bucket % self._batch_multiple:
+            bucket += self._batch_multiple - bucket % self._batch_multiple
         outs = []
         start = 0
         while start < b:
             chunk = min(bucket, b - start)
             padded = np.zeros((bucket, size, size, 3), np.float32)
             padded[:chunk] = batch[start : start + chunk]
-            res = np.asarray(self._apply(self.params, jnp.asarray(padded)))
+            images = jnp.asarray(padded)
+            if self.mesh is not None:
+                images = jax.device_put(images, self._data_sharding)
+            res = np.asarray(self._apply(self.params, images))
             outs.append(res[:chunk])
             start += chunk
         return np.concatenate(outs, axis=0).astype(np.float32)

@@ -91,3 +91,22 @@ def test_image_embeddings_in_knn_index():
     results = index.search(enc.encode([_png_bytes("blue")]), k=1)
     assert results[0][0][0] == "blue"
     assert results[0][0][1] == pytest.approx(1.0, abs=1e-4)
+
+
+def test_image_encoder_mesh_parity():
+    """ViT embedding on the 8-device CPU mesh (dp batches + tp weights
+    via the shared Megatron specs) matches single-device output.
+    fp32 config: bf16 partial-sum order differs across shardings."""
+    import dataclasses
+
+    from pathway_tpu.parallel import make_mesh
+
+    cfg = dataclasses.replace(TINY, dtype=np.float32)
+    images = [_png_bytes(c) for c in ("red", "blue", "green", "yellow",
+                                      "purple", "orange", "white", "black",
+                                      "gray", "pink")]
+    base = ImageEncoder(cfg, seed=3).encode(images)
+    dp = ImageEncoder(cfg, seed=3, mesh=make_mesh(8)).encode(images)
+    np.testing.assert_allclose(base, dp, atol=2e-5)
+    tp = ImageEncoder(cfg, seed=3, mesh=make_mesh(8, model_parallel=2)).encode(images)
+    np.testing.assert_allclose(base, tp, atol=2e-5)
