@@ -1,0 +1,97 @@
+"""Randomized-pipeline engine fuzz: compositions drawn from an operator
+grammar must satisfy the incremental == batch-recompute oracle at every
+timestamp (machinery from tests/test_engine_oracle.py).
+
+Each stage maps a (k:int, v:int) table to another, so stages chain
+arbitrarily; pipelines are generated from a seeded RNG so failures
+reproduce.  This widens the hand-picked oracle compositions to a few
+dozen random ones per run.
+"""
+
+import random
+
+import pytest
+
+import pathway_tpu as pw
+
+from test_engine_oracle import assert_oracle
+
+
+def _stage_map(rng):
+    a = rng.randint(1, 5)
+    b = rng.randint(-10, 10)
+
+    def stage(t):
+        return t.select(t.k, v=t.v * a + b)
+
+    return stage, f"map(v*{a}+{b})"
+
+
+def _stage_filter(rng):
+    m = rng.randint(2, 5)
+    r = rng.randrange(m)
+
+    def stage(t):
+        return t.filter(t.v % m != r).select(t.k, t.v)
+
+    return stage, f"filter(v%{m}!={r})"
+
+
+def _stage_groupby(rng):
+    m = rng.randint(2, 6)
+    red = rng.choice(["sum", "count", "max", "min"])
+
+    def stage(t):
+        g = t.select(t.k, t.v, g=t.v % m)
+        reducer = {
+            "sum": pw.reducers.sum(g.v),
+            "count": pw.reducers.count(),
+            "max": pw.reducers.max(g.v),
+            "min": pw.reducers.min(g.v),
+        }[red]
+        return g.groupby(g.g).reduce(k=g.g, v=reducer)
+
+    return stage, f"groupby(v%{m},{red})"
+
+
+def _stage_join_aggregate(rng):
+    m = rng.randint(2, 5)
+
+    def stage(t):
+        g = t.select(t.k, t.v, g=t.v % m)
+        agg = g.groupby(g.g).reduce(g.g, s=pw.reducers.sum(g.v))
+        j = g.join(agg, g.g == agg.g)
+        return j.select(g.k, v=g.v + agg.s)
+
+    return stage, f"join_agg(v%{m})"
+
+
+_STAGES = [_stage_map, _stage_filter, _stage_groupby, _stage_join_aggregate]
+
+
+def _random_pipeline(pipeline_seed: int):
+    rng = random.Random(pipeline_seed)
+    n = rng.randint(2, 3)
+    stages = []
+    names = []
+    for _ in range(n):
+        stage, name = rng.choice(_STAGES)(rng)
+        stages.append(stage)
+        names.append(name)
+
+    def build(t):
+        for stage in stages:
+            t = stage(t)
+        return t
+
+    return build, " | ".join(names)
+
+
+@pytest.mark.parametrize("pipeline_seed", range(40))
+def test_fuzz_random_pipeline(pipeline_seed):
+    build, desc = _random_pipeline(pipeline_seed)
+    for data_seed in (3, 41):
+        try:
+            assert_oracle(build, data_seed)
+        except AssertionError as exc:  # keep the pipeline in the report
+            raise AssertionError(f"pipeline [{desc}]: {exc}") from exc
