@@ -95,3 +95,46 @@ def test_fuzz_random_pipeline(pipeline_seed):
             assert_oracle(build, data_seed)
         except AssertionError as exc:  # keep the pipeline in the report
             raise AssertionError(f"pipeline [{desc}]: {exc}") from exc
+
+
+# binary pipelines: two independent diff streams through concat/join/
+# update_rows before a random unary tail
+def _binary_combiner(rng):
+    kind = rng.choice(["concat", "join", "update_rows"])
+    if kind == "concat":
+        def combine(a, b):
+            u = a.concat_reindex(b)
+            # concat_reindex makes fresh keys; regroup to a (k, v) shape
+            g = u.select(u.v, g=u.v % 7)
+            return g.groupby(g.g).reduce(k=g.g, v=pw.reducers.sum(g.v))
+    elif kind == "join":
+        m = rng.randint(2, 5)
+
+        def combine(a, b):
+            ga = a.select(a.k, a.v, g=a.v % m)
+            gb = b.select(b.k, b.v, g=b.v % m)
+            sb = gb.groupby(gb.g).reduce(gb.g, s=pw.reducers.sum(gb.v))
+            j = ga.join(sb, ga.g == sb.g)
+            return j.select(ga.k, v=ga.v - sb.s)
+    else:
+        def combine(a, b):
+            return a.update_rows(b)
+    return combine, kind
+
+
+@pytest.mark.parametrize("pipeline_seed", range(20))
+def test_fuzz_random_binary_pipeline(pipeline_seed):
+    rng = random.Random(10_000 + pipeline_seed)
+    combine, kind = _binary_combiner(rng)
+    tail, tail_name = rng.choice(_STAGES)(rng)
+
+    def build(a, b):
+        return tail(combine(a, b))
+
+    for data_seed in (5, 29):
+        try:
+            assert_oracle(build, data_seed, binary=True)
+        except AssertionError as exc:
+            raise AssertionError(
+                f"pipeline [{kind} | {tail_name}]: {exc}"
+            ) from exc
