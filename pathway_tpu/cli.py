@@ -25,6 +25,57 @@ import sys
 __all__ = ["main", "spawn_program"]
 
 
+def checkout_repository(
+    repository_url: str, branch: str | None
+) -> str:
+    """Clone ``repository_url`` (any git URL, incl. ``file://`` and local
+    paths) into a temp dir and return its path
+    (reference: cli.py:34-50 ``checkout_repository``).  If the repo
+    carries a ``requirements.txt``, a private venv is built for it and
+    the spawned program runs on that interpreter."""
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="pathway-spawn-")
+    repo_path = os.path.join(root, "repository")
+    clone = subprocess.run(
+        ["git", "clone", "--quiet", repository_url, repo_path],
+        capture_output=True,
+        text=True,
+    )
+    if clone.returncode != 0:
+        raise RuntimeError(f"git clone failed: {clone.stderr.strip()}")
+    if branch:
+        co = subprocess.run(
+            ["git", "-C", repo_path, "checkout", "--quiet", branch],
+            capture_output=True,
+            text=True,
+        )
+        if co.returncode != 0:
+            raise RuntimeError(f"git checkout failed: {co.stderr.strip()}")
+    return repo_path
+
+
+def _venv_python(repo_path: str) -> str | None:
+    """Build a venv + install the repo's requirements, when present
+    (reference: cli.py venv flow).  Returns the venv's python or None."""
+    req = os.path.join(repo_path, "requirements.txt")
+    if not os.path.exists(req):
+        return None
+    import venv
+
+    venv_path = os.path.join(os.path.dirname(repo_path), "venv")
+    venv.create(venv_path, with_pip=True)
+    python = os.path.join(venv_path, "bin", "python")
+    pip = subprocess.run(
+        [python, "-m", "pip", "install", "--quiet", "-r", req],
+        capture_output=True,
+        text=True,
+    )
+    if pip.returncode != 0:
+        raise RuntimeError(f"pip install failed: {pip.stderr[-500:]}")
+    return python
+
+
 def spawn_program(
     threads: int,
     processes: int,
@@ -32,8 +83,17 @@ def spawn_program(
     program: str,
     arguments: list[str],
     env: dict | None = None,
+    repository_url: str | None = None,
+    branch: str | None = None,
 ) -> int:
-    """reference: cli.py:92-109 — N processes, shared env, wait for all."""
+    """reference: cli.py:92-109 — N processes, shared env, wait for all;
+    with ``repository_url`` the program runs from a fresh clone."""
+    cwd = None
+    if repository_url is not None:
+        cwd = checkout_repository(repository_url, branch)
+        python = _venv_python(cwd)
+        if python is not None and program in ("python", sys.executable):
+            program = python
     base_env = dict(env or os.environ)
     base_env.update(
         {
@@ -47,7 +107,9 @@ def spawn_program(
         for pid in range(processes):
             penv = dict(base_env)
             penv["PATHWAY_PROCESS_ID"] = str(pid)
-            procs.append(subprocess.Popen([program, *arguments], env=penv))
+            procs.append(
+                subprocess.Popen([program, *arguments], env=penv, cwd=cwd)
+            )
         exit_code = 0
         for p in procs:
             code = p.wait()
@@ -72,6 +134,12 @@ def main(argv: list[str] | None = None) -> int:
     sp.add_argument("--first-port", type=int, default=10000)
     sp.add_argument("--record", action="store_true", help="persist inputs while running")
     sp.add_argument("--record-path", default="record")
+    sp.add_argument(
+        "--repository-url", default=None,
+        help="git URL to clone and run the program from (reference: "
+        "spawn's git-repo flow; a repo requirements.txt gets a venv)",
+    )
+    sp.add_argument("--branch", default=None)
     sp.add_argument("program")
     sp.add_argument("arguments", nargs=argparse.REMAINDER)
 
@@ -91,6 +159,7 @@ def main(argv: list[str] | None = None) -> int:
         return spawn_program(
             args.threads, args.processes, args.first_port,
             args.program, args.arguments, env,
+            repository_url=args.repository_url, branch=args.branch,
         )
     if args.command == "spawn-from-env":
         spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "").split()

@@ -413,3 +413,47 @@ def test_set_monitoring_config_roundtrip():
     assert get_pathway_config().monitoring_server == "https://example.com:4317"
     pw.set_monitoring_config(server_endpoint=None)
     assert get_pathway_config().monitoring_server is None
+
+
+def test_spawn_from_git_repository(tmp_path):
+    """`pathway spawn --repository-url` clones and runs the program from
+    the repo (reference: cli.py git-repo spawn; offline via a local
+    clone source)."""
+    import subprocess
+    import sys
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "prog.py").write_text(
+        "import os, pathlib\n"
+        "pathlib.Path(os.environ['OUT_DIR'], "
+        "'out-%s.txt' % os.environ['PATHWAY_PROCESS_ID']).write_text("
+        "open('data.txt').read())\n"
+    )
+    (src / "data.txt").write_text("from-the-repo")
+    for cmd in (
+        ["git", "init", "-q"],
+        ["git", "add", "-A"],
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "init"],
+    ):
+        subprocess.run(cmd, cwd=src, check=True)
+
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    from pathway_tpu.cli import main as cli_main
+
+    env_backup = dict(os.environ)
+    os.environ["OUT_DIR"] = str(out_dir)
+    try:
+        rc = cli_main([
+            "spawn", "-n", "2", "--repository-url", str(src),
+            sys.executable, "prog.py",
+        ])
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+    assert rc == 0
+    outs = sorted(p.name for p in out_dir.iterdir())
+    assert outs == ["out-0.txt", "out-1.txt"]
+    assert (out_dir / "out-0.txt").read_text() == "from-the-repo"
