@@ -165,7 +165,8 @@ def main(argv: list[str] | None = None) -> int:
         spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "").split()
         ns = parser.parse_args(["spawn", *spawn_args, args.program, *args.arguments])
         return spawn_program(
-            ns.threads, ns.processes, ns.first_port, ns.program, ns.arguments
+            ns.threads, ns.processes, ns.first_port, ns.program, ns.arguments,
+            repository_url=ns.repository_url, branch=ns.branch,
         )
     return 2
 
