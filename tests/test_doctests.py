@@ -31,6 +31,9 @@ MODULES = [
     "pathway_tpu.stdlib.temporal",
     "pathway_tpu.xpacks.llm.splitters",
     "pathway_tpu.xpacks.llm.rag_evals",
+    "pathway_tpu.internals.table_slice",
+    "pathway_tpu.internals.custom_reducers",
+    "pathway_tpu.internals.pyobject",
 ]
 
 #: examples the curated list must carry in total — stops silent decay
