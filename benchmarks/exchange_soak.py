@@ -1,0 +1,180 @@
+"""Distributed-plane soak: minutes of continuous streaming rounds over
+the 2-process TCP exchange, with end-state verification.
+
+A writer appends lines to a watched directory the whole time; both
+processes run the sharded wordcount (select → flatten → groupby → count,
+rows crossing the exchange at the stateful boundary) in streaming mode
+and dump their final shard state at close.  The harness then checks the
+merged counts against ground truth — thousands of micro-batch rounds
+through `wavefront`-scheduled exchanges, watching for drift, stalls, or
+leaks.
+
+Run: ``JAX_PLATFORMS=cpu SOAK_SECS=300 python benchmarks/exchange_soak.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import Counter
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+_PROG = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+input_dir, out_path, stop_path = sys.argv[1:4]
+
+t = pw.io.fs.read(input_dir, format="plaintext", mode="streaming",
+                  refresh_interval=0.1)
+words = t.select(w=pw.apply(lambda line: line.split(), t.data)).flatten(pw.this.w)
+counts = words.groupby(words.w).reduce(words.w, c=pw.reducers.count())
+state = {}
+def on_change(key, row, tm, add):
+    if add:
+        state[row["w"]] = row["c"]
+    elif state.get(row["w"]) == row["c"]:
+        del state[row["w"]]
+pw.io.subscribe(counts, on_change=on_change)
+subject = t._operator.params["subject"]
+
+import threading
+def stopper():
+    while not os.path.exists(stop_path):
+        time.sleep(0.25)
+    # let the final scan round drain before closing
+    time.sleep(2.0)
+    subject.close()
+threading.Thread(target=stopper, daemon=True).start()
+
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+with open(out_path, "w") as f:
+    json.dump(state, f)
+"""
+
+
+def _free_port_block(n: int = 2) -> int:
+    for _ in range(50):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        try:
+            others = []
+            try:
+                for i in range(1, n):
+                    o = socket.socket()
+                    o.bind(("127.0.0.1", base + i))
+                    others.append(o)
+                return base
+            finally:
+                for o in others:
+                    o.close()
+        except OSError:
+            continue
+        finally:
+            s.close()
+    raise RuntimeError("no free port block")
+
+
+def run(soak_secs: float = 300.0) -> dict:
+    import random
+
+    rng = random.Random(23)
+    truth: Counter = Counter()
+    with tempfile.TemporaryDirectory() as tmp:
+        input_dir = os.path.join(tmp, "in")
+        os.makedirs(input_dir)
+        stop_path = os.path.join(tmp, "stop")
+        prog = os.path.join(tmp, "prog.py")
+        with open(prog, "w") as f:
+            f.write(_PROG)
+        port = _free_port_block()
+        procs = []
+        for pid in range(2):
+            env = dict(os.environ)
+            env.update(
+                PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+                JAX_PLATFORMS="cpu",
+                PATHWAY_PROCESSES="2",
+                PATHWAY_PROCESS_ID=str(pid),
+                PATHWAY_FIRST_PORT=str(port),
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, prog, input_dir,
+                     os.path.join(tmp, f"out{pid}.json"), stop_path],
+                    env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE, text=True,
+                )
+            )
+
+        # writer: append batches of lines across a few rotating files
+        words = [f"word{i:03d}" for i in range(200)]
+        t_end = time.monotonic() + soak_secs
+        n_lines = 0
+        file_idx = 0
+        while time.monotonic() < t_end:
+            path = os.path.join(input_dir, f"f{file_idx % 5}.txt")
+            with open(path, "a") as f:
+                for _ in range(rng.randint(5, 20)):
+                    line = " ".join(rng.choices(words, k=6))
+                    truth.update(line.split())
+                    f.write(line + "\n")
+                    n_lines += 1
+            file_idx += 1
+            time.sleep(rng.uniform(0.05, 0.3))
+        # allow the last appends to be scanned, then signal stop
+        time.sleep(3.0)
+        with open(stop_path, "w") as f:
+            f.write("stop")
+        for p in procs:
+            try:
+                _, err = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                return {"metric": "exchange_soak", "error": "worker hung at drain"}
+            if p.returncode != 0:
+                return {
+                    "metric": "exchange_soak",
+                    "error": f"worker rc={p.returncode}: {err[-400:]}",
+                }
+        merged: dict = {}
+        overlap = 0
+        for pid in range(2):
+            with open(os.path.join(tmp, f"out{pid}.json")) as f:
+                shard = json.load(f)
+            overlap += sum(1 for k in shard if k in merged)
+            merged.update(shard)
+        mismatches = {
+            w: (merged.get(w), c) for w, c in truth.items()
+            if merged.get(w) != c
+        }
+        return {
+            "metric": "exchange_soak",
+            "soak_secs": round(soak_secs, 0),
+            "lines_written": n_lines,
+            "distinct_words": len(truth),
+            "shard_overlap": overlap,  # keys must be owned by exactly one
+            "mismatched_words": len(mismatches),
+            "sample_mismatches": dict(list(mismatches.items())[:5]),
+        }
+
+
+if __name__ == "__main__":
+    out = run(float(os.environ.get("SOAK_SECS", "300")))
+    print(json.dumps(out))
+    ok = (
+        "error" not in out
+        and out["mismatched_words"] == 0
+        and out["shard_overlap"] == 0
+    )
+    sys.exit(0 if ok else 1)
