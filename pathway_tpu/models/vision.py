@@ -187,14 +187,18 @@ class ClipEncoder:
         text_cfg: EncoderConfig | None = None,
         seed: int = 0,
         max_length: int = 77,
+        mesh=None,
     ):
         from .encoder import SentenceEncoder
 
-        self.vision = ImageEncoder(vision_cfg, seed=seed)
+        self.mesh = mesh
+        self.vision = ImageEncoder(vision_cfg, seed=seed, mesh=mesh)
         tcfg = text_cfg or EncoderConfig(emb_dim=self.vision.dim)
         if (tcfg.emb_dim or tcfg.hidden_dim) != self.vision.dim:
             tcfg = dataclasses.replace(tcfg, emb_dim=self.vision.dim)
-        self.text = SentenceEncoder(cfg=tcfg, seed=seed, max_length=max_length)
+        self.text = SentenceEncoder(
+            cfg=tcfg, seed=seed, max_length=max_length, mesh=mesh
+        )
 
     @property
     def dim(self) -> int:
