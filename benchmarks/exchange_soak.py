@@ -1,6 +1,11 @@
 """Distributed-plane soak: minutes of continuous streaming rounds over
 the 2-process TCP exchange, with end-state verification.
 
+Input uses ``append_only=True`` tailing: this soak's workload IS the
+log-append pattern, and the default full-re-read-on-change semantics
+make it quadratic (the first 10-minute run drowned the drain in
+re-reads — that finding produced the append_only connector mode).
+
 A writer appends lines to a watched directory the whole time; both
 processes run the sharded wordcount (select → flatten → groupby → count,
 rows crossing the exchange at the stateful boundary) in streaming mode
@@ -28,14 +33,15 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 _PROG = r"""
-import json, os, sys, time
+import faulthandler, json, os, signal, sys, time
+faulthandler.register(signal.SIGUSR1)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import pathway_tpu as pw
 
 input_dir, out_path, stop_path = sys.argv[1:4]
 
 t = pw.io.fs.read(input_dir, format="plaintext", mode="streaming",
-                  refresh_interval=0.1)
+                  refresh_interval=0.1, append_only=True)
 words = t.select(w=pw.apply(lambda line: line.split(), t.data)).flatten(pw.this.w)
 counts = words.groupby(words.w).reduce(words.w, c=pw.reducers.count())
 state = {}
@@ -136,12 +142,32 @@ def run(soak_secs: float = 300.0) -> dict:
         time.sleep(3.0)
         with open(stop_path, "w") as f:
             f.write("stop")
+        hang = None
         for p in procs:
             try:
-                _, err = p.communicate(timeout=180)
+                _, err = p.communicate(timeout=300)
             except subprocess.TimeoutExpired:
-                p.kill()
-                return {"metric": "exchange_soak", "error": "worker hung at drain"}
+                # dump the hung worker's stacks before killing it
+                import signal as _signal
+
+                try:
+                    p.send_signal(_signal.SIGUSR1)
+                    _, err = p.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    _, err = p.communicate()
+                hang = {
+                    "metric": "exchange_soak",
+                    "error": "worker hung at drain",
+                    "stacks": (err or "")[-3000:],
+                }
+                break
+        if hang is not None:
+            for p in procs:  # no orphans: kill the rest of the fleet too
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+            return hang
             if p.returncode != 0:
                 return {
                     "metric": "exchange_soak",

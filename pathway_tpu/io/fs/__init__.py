@@ -60,6 +60,7 @@ class _FsSubject(ConnectorSubject):
         refresh_s: float,
         autocommit_ms: int | None,
         csv_settings=None,
+        append_only: bool = False,
     ):
         super().__init__(datasource_name=f"fs:{path}")
         self.path = os.fspath(path)
@@ -71,6 +72,11 @@ class _FsSubject(ConnectorSubject):
         self.refresh_s = refresh_s
         self._autocommit_ms = autocommit_ms
         self.csv_settings = csv_settings
+        #: opt-in log-tailing mode: grown files emit only new lines
+        self.append_only = append_only
+        self._consumed: dict[str, int] = {}
+        self._overlaps: dict[str, bytes] = {}
+        self._line_counts: dict[str, int] = {}
         # path -> (mtime, size, [row keys])
         self._seen: dict[str, tuple[float, int, list]] = {}
 
@@ -164,6 +170,7 @@ class _FsSubject(ConnectorSubject):
         for path in list(self._seen):
             if path not in current:
                 _, _, keys = self._seen.pop(path)
+                self._append_state_clear(path)
                 for key, values in keys:
                     self._remove(key, values)
                 changed = True
@@ -171,6 +178,11 @@ class _FsSubject(ConnectorSubject):
         for path, (mtime, size) in current.items():
             old = self._seen.get(path)
             if old is not None and (old[0], old[1]) == (mtime, size):
+                continue
+            if self.append_only and self.fmt in (
+                "plaintext", "json", "jsonlines"
+            ):
+                changed |= self._scan_append_mode(path, old, mtime, size)
                 continue
             if old is not None:
                 for key, values in old[2]:
@@ -184,6 +196,108 @@ class _FsSubject(ConnectorSubject):
         if changed:
             self.commit()
         return changed
+
+    # ---- append-only tailing (opt-in log mode) --------------------------
+
+    #: bytes of pre-growth tail re-read to confirm a pure append
+    _APPEND_OVERLAP = 64
+
+    def _append_state_clear(self, path: str) -> None:
+        self._consumed.pop(path, None)
+        self._overlaps.pop(path, None)
+        self._line_counts.pop(path, None)
+
+    def _emit_record(self, path, line_idx, row, keys, meta) -> None:
+        """One row into the stream — the single emit contract shared by
+        the append reader (the full-read path keeps _emit_file)."""
+        if meta is not None:
+            row["_metadata"] = Json(meta)
+        values = tuple(row.get(n) for n in self._column_names)
+        if self._primary_key:
+            key = ref_scalar(*[row.get(c) for c in self._primary_key])
+        else:
+            key = ref_scalar("__fs__", path, line_idx)
+        self._add_inner(key, values)
+        keys.append((key, values))
+
+    def _scan_append_mode(self, path, old, mtime, size) -> bool:
+        """Grown files consume only their new complete lines; anything
+        else (first sight, shrink/rotation, overlap mismatch, state lost
+        in a persistence restore) retracts and re-reads from offset 0
+        through the same byte reader, so both paths emit identical
+        values (CRLF handling included)."""
+        grown = (
+            old is not None
+            and size >= old[1]
+            and path in self._consumed  # restore drops append state
+        )
+        if grown:
+            keys = old[2]
+            try:
+                if self._read_line_region(path, keys):
+                    self._seen[path] = (mtime, size, keys)
+                    return True
+            except OSError:
+                return False
+        # full reset + re-read
+        if old is not None:
+            for key, values in old[2]:
+                self._remove(key, values)
+        self._append_state_clear(path)
+        keys: list = []
+        try:
+            self._read_line_region(path, keys)
+        except OSError:
+            return old is not None
+        self._seen[path] = (mtime, size, keys)
+        return True
+
+    def _read_line_region(self, path: str, keys: list) -> bool:
+        """Consume complete lines from ``_consumed[path]`` (0 when fresh),
+        emitting rows keyed by file line index; updates consumed offset,
+        line count, and the overlap snapshot.  Returns False when the
+        pre-growth overlap no longer matches (not a pure append).
+
+        The ``tail -F`` trade-off applies: an in-place edit strictly
+        before the overlap window is only caught by the default mode.
+        Partial trailing lines are held until their newline arrives
+        (writers may flush mid-line)."""
+        consumed = self._consumed.get(path, 0)
+        line_idx = self._line_counts.get(path, 0)
+        with open(path, "rb") as f:
+            lap = min(self._APPEND_OVERLAP, consumed)
+            overlap = b""
+            if lap:
+                f.seek(consumed - lap)
+                overlap = f.read(lap)
+                stored = self._overlaps.get(path)
+                if stored is not None and overlap != stored[-lap:]:
+                    return False
+            new_data = f.read()
+        cut = new_data.rfind(b"\n")
+        if cut < 0:
+            return True  # grew, but no complete new line yet
+        block = new_data[: cut + 1]
+        meta = _file_metadata(path) if self.with_metadata else None
+        for line in block.decode("utf-8", errors="replace").split("\n")[:-1]:
+            if line.endswith("\r"):
+                # text-mode universal newlines give the full-read path
+                # \r\n -> \n; match it byte-side
+                line = line[:-1]
+            if self.fmt in ("json", "jsonlines"):
+                if line.strip():
+                    self._emit_record(
+                        path, line_idx,
+                        coerce_row(self.schema_for_rows, _json.loads(line)),
+                        keys, meta,
+                    )
+            else:  # plaintext
+                self._emit_record(path, line_idx, {"data": line}, keys, meta)
+            line_idx += 1
+        self._consumed[path] = consumed + cut + 1
+        self._line_counts[path] = line_idx
+        self._overlaps[path] = (overlap + block)[-self._APPEND_OVERLAP:]
+        return True
 
     def run(self) -> None:
         self._scan_once()
@@ -206,6 +320,7 @@ def read(
     refresh_interval: float = 1.0,
     persistent_id: str | None = None,
     csv_settings=None,
+    append_only: bool = False,
     **kwargs: Any,
 ) -> Table:
     """Read files under ``path`` (reference io/fs/__init__.py:369).
@@ -213,7 +328,19 @@ def read(
     format: "csv" | "json" (jsonlines) | "plaintext" (row per line) |
     "plaintext_by_file" | "binary".  mode: "streaming" polls for
     new/changed/deleted files; "static" reads once at build time.
+
+    ``append_only=True`` (plaintext/jsonlines): grown files emit only
+    their new complete lines instead of retract + full re-read — linear
+    instead of quadratic on log-style appends.  Non-append modifications
+    are detected via a tail-overlap check (``tail -F`` semantics: an
+    in-place edit strictly before the overlap window needs the default
+    mode) and fall back to the full re-read.
     """
+    if append_only and format not in ("plaintext", "json", "jsonlines"):
+        raise ValueError(
+            "append_only=True supports line formats (plaintext/jsonlines), "
+            f"not {format!r}"
+        )
     if format in ("binary",):
         schema = schema_from_types(data=bytes)
     elif format in ("plaintext", "plaintext_by_file"):
@@ -232,6 +359,7 @@ def read(
         refresh_interval,
         autocommit_duration_ms,
         csv_settings=csv_settings,
+        append_only=append_only,
     )
     subject.persistent_id = persistent_id
     subject._configure(out_schema, schema.primary_key_columns())

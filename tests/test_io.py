@@ -180,3 +180,114 @@ def test_csv_parser_settings(tmp_path):
     df = pw.debug.table_to_pandas(t)
     assert sorted(df["name"]) == ["bo", "van der Berg; Jan"], df
     assert sorted(df["age"]) == [28, 41]
+
+
+def test_fs_append_only_tailing(tmp_path):
+    """append_only=True: grown files emit only their new complete lines
+    (no retract/full re-read); non-append modifications fall back."""
+    log = tmp_path / "app.log"
+    log.write_text("l0\nl1\n")
+    t = pw.io.fs.read(
+        tmp_path, format="plaintext", mode="streaming", refresh_interval=0.05,
+        append_only=True,
+    )
+    events = []
+    pw.io.subscribe(
+        t, on_change=lambda k, row, tm, add: events.append((row["data"], add))
+    )
+    subject = t._operator.params["subject"]
+
+    def mutate():
+        time.sleep(0.5)
+        with open(log, "a") as f:
+            f.write("l2\n")
+            f.flush()
+        time.sleep(0.5)
+        with open(log, "a") as f:
+            f.write("l3\npartial")  # incomplete final line held back
+        time.sleep(0.5)
+        with open(log, "a") as f:
+            f.write("-done\n")
+        time.sleep(0.5)
+        # non-append rewrite: earlier content changes -> full re-read
+        log.write_text("X0\nX1\nX2\nX3\npartial-done\nextra\n")
+        time.sleep(0.6)
+        subject.close()
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run()
+    th.join()
+
+    adds = [d for d, a in events if a]
+    # appends arrived incrementally, with zero retractions before the
+    # rewrite and the partial line held until completed
+    first_retract = next(
+        (i for i, (_, a) in enumerate(events) if not a), len(events)
+    )
+    assert adds[:5] == ["l0", "l1", "l2", "l3", "partial-done"]
+    assert first_retract >= 5, events[:8]
+    # the rewrite retracted the changed rows and re-emitted the new
+    # content ("partial-done" kept the same key+value at the same line
+    # index, so its retract+re-add cancels in consolidation)
+    retracted = [d for d, a in events if not a]
+    assert set(retracted) >= {"l0", "l1", "l2", "l3"}
+    assert {"X0", "X1", "X2", "X3", "extra"} <= set(adds)
+
+
+def test_fs_append_only_rejects_csv(tmp_path):
+    class S(pw.Schema):
+        a: int
+
+    with pytest.raises(ValueError, match="append_only"):
+        pw.io.fs.read(
+            tmp_path, format="csv", schema=S, mode="streaming",
+            append_only=True,
+        )
+
+
+def test_fs_append_only_jsonlines_blank_lines_and_rotation(tmp_path):
+    """Blank jsonlines keep file-line-index keying across the append
+    boundary (no key collisions), and copytruncate-style rotation resets
+    the tail state instead of poisoning it."""
+    class S(pw.Schema):
+        a: int
+
+    log = tmp_path / "ev.jsonl"
+    log.write_text('{"a": 1}\n\n{"a": 2}\n')
+    t = pw.io.fs.read(
+        tmp_path, format="jsonlines", schema=S, mode="streaming",
+        refresh_interval=0.05, append_only=True,
+    )
+    events = []
+    pw.io.subscribe(
+        t, on_change=lambda k, row, tm, add: events.append((k, row["a"], add))
+    )
+    subject = t._operator.params["subject"]
+
+    def mutate():
+        time.sleep(0.45)
+        with open(log, "a") as f:
+            f.write('{"a": 3}\n')
+        time.sleep(0.45)
+        log.write_text('{"a": 10}\n')  # truncate + rewrite (rotation)
+        time.sleep(0.45)
+        with open(log, "a") as f:
+            f.write('{"a": 11}\n')  # tailing must work again post-reset
+        time.sleep(0.45)
+        subject.close()
+
+    th = threading.Thread(target=mutate)
+    th.start()
+    pw.run()
+    th.join()
+
+    adds = [(k, v) for k, v, add in events if add]
+    assert [v for _, v in adds[:3]] == [1, 2, 3]
+    # distinct keys for every added row (the blank line must not make
+    # the appended record collide with an existing key)
+    assert len({k for k, _ in adds[:3]}) == 3
+    assert [v for _, v in adds[3:]] == [10, 11]
+    # rotation retracted the pre-truncation rows
+    removed = [v for _, v, add in events if not add]
+    assert set(removed) >= {1, 2, 3}
