@@ -167,34 +167,55 @@ def child_device(seconds: float = 10.0) -> None:
         and dev.platform == "tpu"
         and time.monotonic() + 180 + seconds < child_deadline
     ):
-        enc2 = SentenceEncoder(
-            max_length=128, cfg=EncoderConfig(attention_impl="pallas")
-        )
-        fwd2 = lambda i, m: enc2._apply(enc2.params, i, m)  # noqa: E731
-        fwd = fwd2
-        bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
-        pallas_dps = measure(big)
+        try:
+            enc2 = SentenceEncoder(
+                max_length=128, cfg=EncoderConfig(attention_impl="pallas")
+            )
+            fwd2 = lambda i, m: enc2._apply(enc2.params, i, m)  # noqa: E731
+            fwd = fwd2
+            bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
+            pallas_dps = measure(big)
+        except Exception as exc:  # a pallas lowering failure must never
+            # cost the fused number already printed above — but it must be
+            # VISIBLE: re-emit the fused result with the failure attached
+            # (the parent keeps the last stdout JSON line)
+            print(
+                json.dumps(
+                    {
+                        "docs_per_sec": round(docs_per_sec, 1),
+                        "platform": dev.platform,
+                        "device_kind": getattr(dev, "device_kind", str(dev)),
+                        "flops_per_doc": FLOPS_PER_DOC,
+                        "mfu": _mfu(docs_per_sec, dev),
+                        "attn_impl": attn,
+                        "child_warning": f"pallas A/B failed: {exc!r}"[:300],
+                    }
+                ),
+                flush=True,
+            )
+            return
         _emit_device_result(max(docs_per_sec, pallas_dps), dev,
                             "pallas" if pallas_dps > docs_per_sec else attn)
 
 
+def _mfu(docs_per_sec: float, dev) -> float | None:
+    kind = getattr(dev, "device_kind", str(dev))
+    for key, peak in _PEAK_BF16.items():
+        if key in kind.lower():
+            return round(docs_per_sec * FLOPS_PER_DOC / peak, 4)
+    return None
+
+
 def _emit_device_result(docs_per_sec: float, dev, attn: str = "fused") -> float:
     """Print one result JSON line (the parent keeps the LAST line)."""
-    kind = getattr(dev, "device_kind", str(dev))
-    peak = None
-    for key, val in _PEAK_BF16.items():
-        if key in kind.lower():
-            peak = val
-            break
-    mfu = docs_per_sec * FLOPS_PER_DOC / peak if peak else None
     print(
         json.dumps(
             {
                 "docs_per_sec": round(docs_per_sec, 1),
                 "platform": dev.platform,
-                "device_kind": kind,
+                "device_kind": getattr(dev, "device_kind", str(dev)),
                 "flops_per_doc": FLOPS_PER_DOC,
-                "mfu": round(mfu, 4) if mfu is not None else None,
+                "mfu": _mfu(docs_per_sec, dev),
                 "attn_impl": attn,
             }
         ),
@@ -254,6 +275,22 @@ def child_torch(seconds: float = 8.0) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _last_json_line(text) -> dict | None:
+    """Last stdout line that parses as a JSON *object* (children emit one
+    dict per banked measurement; scalars/garbage from crashing libs are
+    skipped, not returned)."""
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", "replace")
+    for line in reversed((text or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
 def _run_child(mode: str, env: dict | None, timeout: float) -> dict | None:
     child_env = dict(os.environ)
     # the child paces its own warmup escalation against this (it cannot
@@ -274,22 +311,25 @@ def _run_child(mode: str, env: dict | None, timeout: float) -> dict | None:
         # salvage a partial result: the device child prints its
         # guaranteed small-batch measurement BEFORE attempting the big
         # (slow-compiling) bucket, so a hang mid-escalation still counts
-        partial = exc.stdout
-        if isinstance(partial, bytes):
-            partial = partial.decode("utf-8", "replace")
-        for line in reversed((partial or "").strip().splitlines()):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
+        salvaged = _last_json_line(exc.stdout)
+        if salvaged is not None:
+            salvaged.setdefault("child_warning", f"timed out after {timeout:.0f}s")
+            return salvaged
         return {"error": f"{mode} timed out after {timeout:.0f}s"}
     if proc.returncode != 0:
+        # salvage: the device child prints every banked measurement as it
+        # goes, so a crash in a LATER phase (e.g. the pallas A/B) must not
+        # discard the lines already printed
+        salvaged = _last_json_line(proc.stdout)
+        if salvaged is not None:
+            salvaged.setdefault(
+                "child_warning", f"rc={proc.returncode}: {proc.stderr[-200:]}"
+            )
+            return salvaged
         return {"error": f"{mode} rc={proc.returncode}: {proc.stderr[-400:]}"}
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            return json.loads(line)
-        except json.JSONDecodeError:
-            continue
+    result = _last_json_line(proc.stdout)
+    if result is not None:
+        return result
     return {"error": f"{mode} produced no JSON: {proc.stdout[-200:]}"}
 
 
@@ -309,11 +349,9 @@ def _run_script(rel_path: str, timeout: float) -> dict | None:
         )
     except subprocess.TimeoutExpired:
         return {"error": f"{rel_path} timed out after {timeout:.0f}s"}
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            return json.loads(line)
-        except json.JSONDecodeError:
-            continue
+    result = _last_json_line(proc.stdout)
+    if result is not None:
+        return result
     return {"error": f"{rel_path} rc={proc.returncode}: {proc.stderr[-200:]}"}
 
 
@@ -419,8 +457,15 @@ def main() -> None:
             break
         r = _run_child("--child-device", None, min(budget, 420.0))
         if r and "docs_per_sec" in r:
-            result = r
-            break
+            if result is None or r["docs_per_sec"] > result["docs_per_sec"]:
+                result = r
+            if "child_warning" not in r:
+                break  # clean full run — done
+            # degraded (salvaged) result: keep it, but retry with the
+            # remaining budget — a transient crash right after the small
+            # bucket should not bank the small-bucket number unchallenged
+            errors.append(f"device child: {r['child_warning']}")
+            continue
         errors.append(r.get("error", "unknown") if r else "unknown")
         time.sleep(5 * (attempt + 1))
 
@@ -437,6 +482,9 @@ def main() -> None:
         out["vs_baseline"] = (
             round(result["docs_per_sec"] / baseline_dps, 3) if baseline_dps else None
         )
+        warn = result.get("child_warning")
+        if warn and f"device child: {warn}" not in errors:
+            errors.append(f"device child: {warn}")
     else:
         out["value"] = 0.0
         out["vs_baseline"] = 0.0

@@ -49,7 +49,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, *, sm_scale: float):
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     s = s * sm_scale
-    mask = m_ref[0]  # [skv]
+    mask = m_ref[0, 0]  # [skv]
     s = jnp.where(mask[None, :] != 0, s, _NEG_INF)
     s = s - jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s)
@@ -68,7 +68,12 @@ def _flash(q, k, v, kv_mask, sm_scale: float, interpret: bool):
     def spec(seq):
         return pl.BlockSpec((1, 1, seq, dh), lambda b, h: (b, h, 0, 0))
 
-    mask_spec = pl.BlockSpec((1, skv), lambda b, h: (b, 0))
+    # Mosaic requires each of a block's last two dims to be a multiple of
+    # the dtype tile OR the full array dim.  A (1, skv) block over a
+    # (batch, skv) mask violates that (second-minor 1 ∉ {32k, batch}), so
+    # the mask rides as [batch, 1, skv]: block (1, 1, skv) has second-minor
+    # == full dim 1 and minor == skv (a 128-multiple bucket) — both legal.
+    mask_spec = pl.BlockSpec((1, 1, skv), lambda b, h: (b, 0, 0))
     return pl.pallas_call(
         functools.partial(_attn_kernel, sm_scale=sm_scale),
         grid=grid,
@@ -90,8 +95,10 @@ def flash_attention(query, key, value, kv_mask=None, sm_scale=None):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(query.shape[-1])
     if kv_mask is None:
-        kv_mask = jnp.ones(key.shape[:2], jnp.int8)
-    kv_mask = kv_mask.astype(jnp.int8)
+        kv_mask = jnp.ones(key.shape[:2], jnp.int32)
+    # int32 (not int8): sub-word dtypes hit stricter Mosaic tiling rules
+    # and buy nothing here (mask is batch×skv ≤ a few KB per block)
+    kv_mask = kv_mask.astype(jnp.int32)[:, None, :]
     # [b, s, h, d] → [b, h, s, d]
     q = jnp.transpose(query, (0, 2, 1, 3))
     k = jnp.transpose(key, (0, 2, 1, 3))
