@@ -179,19 +179,9 @@ def child_device(seconds: float = 10.0) -> None:
             # cost the fused number already printed above — but it must be
             # VISIBLE: re-emit the fused result with the failure attached
             # (the parent keeps the last stdout JSON line)
-            print(
-                json.dumps(
-                    {
-                        "docs_per_sec": round(docs_per_sec, 1),
-                        "platform": dev.platform,
-                        "device_kind": getattr(dev, "device_kind", str(dev)),
-                        "flops_per_doc": FLOPS_PER_DOC,
-                        "mfu": _mfu(docs_per_sec, dev),
-                        "attn_impl": attn,
-                        "child_warning": f"pallas A/B failed: {exc!r}"[:300],
-                    }
-                ),
-                flush=True,
+            _emit_device_result(
+                docs_per_sec, dev, attn,
+                child_warning=f"pallas A/B failed: {exc!r}"[:300],
             )
             return
         _emit_device_result(max(docs_per_sec, pallas_dps), dev,
@@ -206,21 +196,20 @@ def _mfu(docs_per_sec: float, dev) -> float | None:
     return None
 
 
-def _emit_device_result(docs_per_sec: float, dev, attn: str = "fused") -> float:
+def _emit_device_result(
+    docs_per_sec: float, dev, attn: str = "fused", **extra
+) -> float:
     """Print one result JSON line (the parent keeps the LAST line)."""
-    print(
-        json.dumps(
-            {
-                "docs_per_sec": round(docs_per_sec, 1),
-                "platform": dev.platform,
-                "device_kind": getattr(dev, "device_kind", str(dev)),
-                "flops_per_doc": FLOPS_PER_DOC,
-                "mfu": _mfu(docs_per_sec, dev),
-                "attn_impl": attn,
-            }
-        ),
-        flush=True,
-    )
+    rec = {
+        "docs_per_sec": round(docs_per_sec, 1),
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "flops_per_doc": FLOPS_PER_DOC,
+        "mfu": _mfu(docs_per_sec, dev),
+        "attn_impl": attn,
+    }
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
     return docs_per_sec
 
 

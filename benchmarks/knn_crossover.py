@@ -34,7 +34,20 @@ if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
     jax.config.update("jax_platforms", "cpu")
 
 
-def run(n: int, dim: int = 384, n_queries: int = 64, k: int = 10) -> dict:
+def run(
+    n: int,
+    dim: int = 384,
+    n_queries: int = 64,
+    k: int = 10,
+    deadline: float | None = None,
+) -> dict:
+    """Measure exact and LSH search at corpus size ``n``.
+
+    Emits the exact-index measurement as its own JSON line BEFORE starting
+    the LSH side: over the tunneled chip, transfers run ~3.5 MB/s and the
+    tunnel can drop mid-run, so every completed stage must be salvageable
+    by the parent's last-line capture (same discipline as bench.py).
+    """
     import jax
 
     from pathway_tpu.utils.compile_cache import enable_compile_cache
@@ -64,10 +77,6 @@ def run(n: int, dim: int = 384, n_queries: int = 64, k: int = 10) -> dict:
         exact.upsert(i, corpus[i])
     exact._apply_staged()
 
-    lsh = LshKnnIndex(dim=dim, metric="cos", capacity=n)
-    for i in range(n):
-        lsh.add(i, corpus[i], None)
-
     def timed(fn, reps=3):
         fn()  # warmup/compile
         times = []
@@ -78,6 +87,20 @@ def run(n: int, dim: int = 384, n_queries: int = 64, k: int = 10) -> dict:
         return out, sorted(times)[len(times) // 2]
 
     exact_res, exact_t = timed(lambda: exact.search(queries, k))
+    result = {
+        "n": n,
+        "platform": jax.devices()[0].platform,
+        "exact_ms_per_query": round(exact_t / n_queries * 1000, 3),
+    }
+    print(json.dumps(result), flush=True)  # salvage point: exact banked
+
+    if deadline is not None and time.monotonic() > deadline - 30:
+        result["lsh_skipped"] = "child budget exhausted after exact stage"
+        return result
+
+    lsh = LshKnnIndex(dim=dim, metric="cos", capacity=n)
+    for i in range(n):
+        lsh.add(i, corpus[i], None)
     lsh_res, lsh_t = timed(
         lambda: lsh.search([(q, k, None) for q in queries])
     )
@@ -88,16 +111,15 @@ def run(n: int, dim: int = 384, n_queries: int = 64, k: int = 10) -> dict:
         got = {key for key, _ in lsh_res[qi][:k]}  # noqa: E501
         hits += len(truth & got)
         total += len(truth)
-    return {
-        "n": n,
-        "platform": jax.devices()[0].platform,
-        "exact_ms_per_query": round(exact_t / n_queries * 1000, 3),
-        "lsh_ms_per_query": round(lsh_t / n_queries * 1000, 3),
-        "lsh_recall_at_10": round(hits / max(total, 1), 4),
-    }
+    result["lsh_ms_per_query"] = round(lsh_t / n_queries * 1000, 3)
+    result["lsh_recall_at_10"] = round(hits / max(total, 1), 4)
+    return result
 
 
 if __name__ == "__main__":
     sizes = [int(x) for x in sys.argv[1:]] or [10_000, 100_000]
+    deadline = None
+    if os.environ.get("KNN_BUDGET_S"):
+        deadline = time.monotonic() + float(os.environ["KNN_BUDGET_S"])
     for n in sizes:
-        print(json.dumps(run(n)), flush=True)
+        print(json.dumps(run(n, deadline=deadline)), flush=True)
