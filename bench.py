@@ -158,10 +158,13 @@ def child_device(seconds: float = 10.0) -> None:
             docs_per_sec = max(docs_per_sec, measure(big))
 
     _emit_device_result(docs_per_sec, dev, attn)
+    best_attn = attn
+    extra: dict = {}
 
     # A/B the pallas kernel only after a banked fused measurement and only
     # on a real chip (interpret mode off-TPU is orders slower) — a hang or
     # crash here cannot cost the number already printed above
+    fused_fwd = fwd
     if (
         attn == "fused"
         and dev.platform == "tpu"
@@ -175,17 +178,39 @@ def child_device(seconds: float = 10.0) -> None:
             fwd = fwd2
             bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
             pallas_dps = measure(big)
+            extra["pallas_docs_per_sec"] = round(pallas_dps, 1)
+            if pallas_dps > docs_per_sec:
+                docs_per_sec, best_attn = pallas_dps, "pallas"
         except Exception as exc:  # a pallas lowering failure must never
-            # cost the fused number already printed above — but it must be
-            # VISIBLE: re-emit the fused result with the failure attached
-            # (the parent keeps the last stdout JSON line)
-            _emit_device_result(
-                docs_per_sec, dev, attn,
-                child_warning=f"pallas A/B failed: {exc!r}"[:300],
+            # cost the fused number already printed above — but it must
+            # be VISIBLE.  ab_warning (not child_warning): the headline
+            # measurement is complete, so the parent must surface it
+            # without treating the run as degraded and retrying.
+            extra["ab_warning"] = f"pallas A/B failed: {exc!r}"[:300]
+        _emit_device_result(docs_per_sec, dev, best_attn, **extra)
+
+    # bf16-wire A/B: over the tunneled chip the device→host download of
+    # f32 embeddings dominates measured throughput (1024×384×4B ≈ 1.5 MB
+    # per batch at the observed ~3.5 MB/s).  Casting the normalized
+    # embedding to bf16 ON DEVICE halves the wire bytes; the forward is
+    # unchanged.  Not the headline (the torch baseline delivers f32) —
+    # reported alongside so the wire-bound ceiling is visible.  Margin:
+    # the cast composes OUTSIDE the forward's jit (the cached executable
+    # is reused), so warmup compiles only a trivial convert kernel —
+    # 60 s covers it even over the tunnel.
+    if dev.platform == "tpu" and time.monotonic() + 60 + 3 * seconds < child_deadline:
+        try:
+            import jax.numpy as jnp
+
+            fwd = lambda i, m: fused_fwd(i, m).astype(jnp.bfloat16)  # noqa: E731
+            bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
+            extra["wire_bf16_docs_per_sec"] = round(measure(big), 1)
+        except Exception as exc:
+            msg = f"bf16-wire A/B failed: {exc!r}"[:300]
+            extra["ab_warning"] = (
+                f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
             )
-            return
-        _emit_device_result(max(docs_per_sec, pallas_dps), dev,
-                            "pallas" if pallas_dps > docs_per_sec else attn)
+        _emit_device_result(docs_per_sec, dev, best_attn, **extra)
 
 
 def _mfu(docs_per_sec: float, dev) -> float | None:
@@ -468,6 +493,11 @@ def main() -> None:
         out["device_kind"] = result.get("device_kind")
         out["mfu"] = result.get("mfu")
         out["attn_impl"] = result.get("attn_impl")
+        for opt in ("pallas_docs_per_sec", "wire_bf16_docs_per_sec"):
+            if result.get(opt) is not None:
+                out[opt] = result[opt]
+        if result.get("ab_warning"):
+            errors.append(f"device child A/B: {result['ab_warning']}")
         out["vs_baseline"] = (
             round(result["docs_per_sec"] / baseline_dps, 3) if baseline_dps else None
         )

@@ -122,4 +122,9 @@ if __name__ == "__main__":
     if os.environ.get("KNN_BUDGET_S"):
         deadline = time.monotonic() + float(os.environ["KNN_BUDGET_S"])
     for n in sizes:
+        if deadline is not None and time.monotonic() > deadline - 30:
+            # don't start a size whose exact stage (corpus build + upload)
+            # would run entirely past the parent's child timeout
+            print(json.dumps({"n": n, "skipped": "budget exhausted"}), flush=True)
+            continue
         print(json.dumps(run(n, deadline=deadline)), flush=True)
