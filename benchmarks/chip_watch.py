@@ -13,9 +13,13 @@ moment a probe succeeds it fires the full chip measurement stack:
      ``benchmarks/KNN_CROSSOVER.md``.
 
   3. ``benchmarks/serving_bench.py`` → end-to-end RAG serving metrics
-     with the real models, appended to ``benchmarks/serving_results.jsonl``.
+     with the real models, appended to ``benchmarks/serving_results.jsonl``;
 
-It keeps watching until ALL THREE have succeeded at least once (a window
+  4. ``benchmarks/decoder_bench.py`` → causal-LM decode tokens/sec,
+     appended to ``benchmarks/decoder_results.jsonl`` (success requires a
+     platform=="tpu" line).
+
+It keeps watching until ALL FOUR have succeeded at least once (a window
 may close mid-run; partial salvage lines still count as progress), then
 exits 0.  All activity is logged with timestamps to
 ``benchmarks/chip_watch.log``.
@@ -151,6 +155,29 @@ def fire_serving() -> bool:
     return rc == 0
 
 
+def fire_decoder() -> bool:
+    """Causal-LM decode tokens/sec on the chip (BASELINE config #4's
+    compute path; appends to decoder_results.jsonl).  Success requires a
+    platform=="tpu" result line — JAX silently falls back to CPU if the
+    tunnel drops between the probe and the run, and a CPU decode number
+    must not be banked as the chip measurement."""
+    _log("running decoder_bench.py (budget 600s)")
+    rc, out = _run(
+        [os.path.join(HERE, "decoder_bench.py")],
+        600.0,
+    )
+    ok = False
+    for line in (out or "").strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("platform") == "tpu":
+            ok = True
+    _log(f"decoder_bench rc={rc} tpu={ok} tail: {out[-300:]!r}")
+    return ok
+
+
 def main() -> int:
     # single-instance lock: two watchers would fire two bench runs into the
     # same rare healthy window and likely time both out
@@ -171,7 +198,7 @@ def main() -> int:
     deadline = time.monotonic() + float(
         os.environ.get("CHIP_WATCH_BUDGET_S", str(11 * 3600))
     )
-    bench_done = suite_done = serving_done = False
+    bench_done = suite_done = serving_done = decoder_done = False
     _log(f"watcher start (interval {interval:.0f}s, once={once})")
     n = 0
     while time.monotonic() < deadline:
@@ -185,9 +212,11 @@ def main() -> int:
                 suite_done = fire_suite()
             if not serving_done:
                 serving_done = fire_serving()
-            if bench_done and suite_done and serving_done:
-                _log("bench.py, chip_suite.py and serving_bench.py all "
-                     "succeeded — done")
+            if not decoder_done:
+                decoder_done = fire_decoder()
+            if bench_done and suite_done and serving_done and decoder_done:
+                _log("bench.py, chip_suite.py, serving_bench.py and "
+                     "decoder_bench.py all succeeded — done")
                 return 0
         else:
             if n % 10 == 1:
@@ -196,7 +225,7 @@ def main() -> int:
             return 0 if dev else 1
         time.sleep(interval)
     _log("watch budget exhausted")
-    return 0 if (bench_done or suite_done or serving_done) else 1
+    return 0 if (bench_done or suite_done or serving_done or decoder_done) else 1
 
 
 if __name__ == "__main__":
