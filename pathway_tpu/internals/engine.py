@@ -135,6 +135,31 @@ def gc_batch_mode():
         gc.unfreeze()
 
 
+def net_row_changes(entries: Iterable[Entry]) -> dict:
+    """Fold one port's batch into the net per-key row change,
+    order-independently: ``{key: new_row | None}`` where a row means the
+    key's single net-inserted row and ``None`` means net-removed; keys
+    whose diffs cancel exactly are absent (no change).
+
+    Slot-per-key nodes (Zip/UpdateRows/UpdateCells) must NOT apply
+    entries last-wins: upstream nodes don't promise retract-before-insert
+    within a batch (e.g. JoinNode emits new matches in ``_process`` but
+    outer-padding retractions later in ``_reconcile_padding``), so an
+    (insert new, retract old) arrival order would otherwise null the slot
+    and silently drop the key until its next touch."""
+    changes: dict = {}
+    # consolidate is the canonical fold (freeze_row keying, diff summing,
+    # zero-dropping); a surviving positive diff is the key's net-live row
+    # — universe invariant says at most one, keep the last on anomalies —
+    # and surviving negatives alone mean net-removed
+    for key, row, diff in consolidate(entries):
+        if diff > 0:
+            changes[key] = row
+        else:
+            changes.setdefault(key, None)
+    return changes
+
+
 def consolidate(entries: Iterable[Entry]) -> list[Entry]:
     """Merge entries with equal (key, values), summing diffs, dropping zeros
     (differential's ``consolidate``)."""
@@ -347,12 +372,12 @@ class ZipNode(Node):
     def flush(self, time: int) -> list[Entry]:
         touched: set[Pointer] = set()
         for port in range(self.n_inputs):
-            # consolidate here: slot assignment below is last-entry-wins,
-            # so a transient add+retract pair (net zero) from an
-            # unconsolidated upstream must cancel before it is applied
-            for key, row, diff in consolidate(self.take(port)):
+            # order-independent fold: see net_row_changes — last-wins
+            # application would drop keys on (insert, retract) arrival
+            # order from upstreams like JoinNode's padding reconciler
+            for key, new_row in net_row_changes(self.take(port)).items():
                 slot = self.state.setdefault(key, [None] * self.n_inputs)
-                slot[port] = row if diff > 0 else None
+                slot[port] = new_row
                 touched.add(key)
         out: list[Entry] = []
         for key in touched:
@@ -897,15 +922,12 @@ class UpdateRowsNode(Node):
         out: list[Entry] = []
         touched: dict[Pointer, tuple | None] = {}
         for port in (0, 1):
-            # consolidate: slot assignment is last-entry-wins (see ZipNode)
-            for key, row, diff in consolidate(self.take(port)):
+            # order-independent fold (see net_row_changes)
+            for key, new_row in net_row_changes(self.take(port)).items():
                 slot = self.state.setdefault(key, [None, None])
                 if key not in touched:
                     touched[key] = self._current(slot)
-                if diff > 0:
-                    slot[port] = row
-                else:
-                    slot[port] = None
+                slot[port] = new_row
         for key, before in touched.items():
             slot = self.state.get(key, [None, None])
             after = self._current(slot)
@@ -938,15 +960,12 @@ class UpdateCellsNode(Node):
         out: list[Entry] = []
         touched: dict[Pointer, tuple | None] = {}
         for port in (0, 1):
-            # consolidate: slot assignment is last-entry-wins (see ZipNode)
-            for key, row, diff in consolidate(self.take(port)):
+            # order-independent fold (see net_row_changes)
+            for key, new_row in net_row_changes(self.take(port)).items():
                 slot = self.state.setdefault(key, [None, None])
                 if key not in touched:
                     touched[key] = self._current(slot)
-                if diff > 0:
-                    slot[port] = row
-                else:
-                    slot[port] = None
+                slot[port] = new_row
         for key, before in touched.items():
             slot = self.state.get(key, [None, None])
             after = self._current(slot)

@@ -66,7 +66,51 @@ def _stage_join_aggregate(rng):
     return stage, f"join_agg(v%{m})"
 
 
-_STAGES = [_stage_map, _stage_filter, _stage_groupby, _stage_join_aggregate]
+def _stage_tumbling(rng):
+    m = rng.randint(3, 9)
+    red = rng.choice(["sum", "count"])
+
+    def stage(t):
+        wb = t.windowby(t.v, window=pw.temporal.tumbling(duration=m))
+        reducer = (
+            pw.reducers.sum(pw.this.v) if red == "sum" else pw.reducers.count()
+        )
+        return wb.reduce(k=pw.this._pw_window_start, v=reducer)
+
+    return stage, f"tumbling({m},{red})"
+
+
+def _stage_sliding(rng):
+    hop = rng.randint(2, 4)
+    dur = hop * rng.randint(1, 3)
+
+    def stage(t):
+        wb = t.windowby(t.v, window=pw.temporal.sliding(hop=hop, duration=dur))
+        return wb.reduce(k=pw.this._pw_window_start, v=pw.reducers.count())
+
+    return stage, f"sliding({hop},{dur})"
+
+
+def _stage_ordered_diff(rng):
+    # exercises sort prev-pointers + pointer ix — the composition that
+    # exposed the ZipNode insert-before-retract ordering bug (net-fold
+    # regression now pinned in test_zip_retract_order below)
+    def stage(t):
+        d = t.diff(t.v, t.v)
+        return t.select(t.k, v=pw.coalesce(d.diff_v, 0))
+
+    return stage, "ordered_diff"
+
+
+_STAGES = [
+    _stage_map,
+    _stage_filter,
+    _stage_groupby,
+    _stage_join_aggregate,
+    _stage_tumbling,
+    _stage_sliding,
+    _stage_ordered_diff,
+]
 
 
 def _random_pipeline(pipeline_seed: int):
@@ -100,8 +144,15 @@ def test_fuzz_random_pipeline(pipeline_seed):
 # binary pipelines: two independent diff streams through concat/join/
 # update_rows before a random unary tail
 def _binary_combiner(rng):
-    kind = rng.choice(["concat", "join", "update_rows"])
-    if kind == "concat":
+    kind = rng.choice(["concat", "join", "update_rows", "interval_join"])
+    if kind == "interval_join":
+        w = rng.randint(1, 3)
+
+        def combine(a, b):
+            j = a.interval_join(b, a.v, b.v, pw.temporal.interval(-w, w))
+            p = j.select(g=a.v % 5, w=a.v + b.v)
+            return p.groupby(p.g).reduce(k=p.g, v=pw.reducers.sum(p.w))
+    elif kind == "concat":
         def combine(a, b):
             u = a.concat_reindex(b)
             # concat_reindex makes fresh keys; regroup to a (k, v) shape
@@ -120,6 +171,43 @@ def _binary_combiner(rng):
         def combine(a, b):
             return a.update_rows(b)
     return combine, kind
+
+
+def test_zip_retract_order():
+    """Regression (found by _stage_ordered_diff, pipeline seed 3): slot
+    nodes must fold a port's batch order-independently.  JoinNode emits
+    new matches in ``_process`` but outer-padding retractions later in
+    ``_reconcile_padding``, so a same-round (insert new, retract old)
+    pair reaches ZipNode insert-FIRST; last-wins application nulled the
+    slot and the row vanished for two timestamps."""
+    from pathway_tpu.internals.engine import ZipNode, net_row_changes
+
+    # direct: net fold keeps the new row regardless of arrival order
+    assert net_row_changes([(1, ("new",), 1), (1, ("old",), -1)]) == {1: ("new",)}
+    assert net_row_changes([(1, ("old",), -1), (1, ("new",), 1)]) == {1: ("new",)}
+    assert net_row_changes([(1, ("x",), 1), (1, ("x",), -1)]) == {}
+    assert net_row_changes([(1, ("old",), -1)]) == {1: None}
+
+    node = ZipNode(2, lambda key, rows: rows[0] + rows[1], name="z")
+    node.pending[0] = [(7, ("L",), 1)]
+    node.pending[1] = [(7, ("old",), 1)]
+    assert node.flush(1) == [(7, ("L", "old"), 1)]
+    # the hostile order: insert of the replacement BEFORE the retraction
+    node.pending[1] = [(7, ("new",), 1), (7, ("old",), -1)]
+    out = node.flush(2)
+    assert out == [(7, ("L", "old"), -1), (7, ("L", "new"), 1)], out
+
+
+def test_ordered_diff_oracle_seed3():
+    """The original failing composition, pinned (sort ties broken by
+    pointer hash + same-round neighbor deletion + ix lookup)."""
+
+    def build(t):
+        d = t.diff(t.v, t.v)
+        return t.select(t.k, v=pw.coalesce(d.diff_v, 0))
+
+    for data_seed in (3, 41, 77):
+        assert_oracle(build, data_seed)
 
 
 @pytest.mark.parametrize("pipeline_seed", range(20))
