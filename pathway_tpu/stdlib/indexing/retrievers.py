@@ -15,6 +15,7 @@ oversampling, like DerivedFilteredSearchIndex (mod.rs:248-310).
 from __future__ import annotations
 
 import math
+import threading
 from collections import Counter, defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Hashable
@@ -167,34 +168,75 @@ class LshKnnIndex(_FilteredMixin, InnerIndexImpl):
         self.index = DeviceKnnIndex(dim=dim, metric=metric, capacity=capacity)
         self.buckets: dict[tuple[int, int], set] = defaultdict(set)
         self.sig_of_key: dict[Hashable, np.ndarray] = {}
+        self._pending: dict[Hashable, np.ndarray] = {}
+        # serving threads query while an ingest thread adds — same
+        # contract as DeviceKnnIndex (ops/knn.py), which this class wraps
+        self._lock = threading.RLock()
 
     def add(self, key, data, metadata) -> None:
-        vec = np.asarray(data, dtype=np.float32)
-        self.index.upsert(key, vec)
-        sig = self.projector.signatures(vec)[0]
-        self.sig_of_key[key] = sig
-        for band, bucket in enumerate(sig):
-            self.buckets[(band, int(bucket))].add(key)
-        self._store_meta(key, metadata)
+        # flatten up front: upsert accepts any shape via reshape(-1), and the
+        # staging dict must stay np.stack-homogeneous for the batched flush
+        vec = np.asarray(data, dtype=np.float32).reshape(-1)
+        with self._lock:
+            self.index.upsert(key, vec)
+            # Signature computation is deferred and batched: one device
+            # matmul per flush instead of one per add.  A per-add round trip
+            # is ruinous when the chip is remote (observed: 30k adds never
+            # finishing over a tunneled TPU, while one batched 30k x dim
+            # matmul is milliseconds).
+            self._pending[key] = vec
+            self._store_meta(key, metadata)
+
+    def _flush_pending(self) -> None:
+        if not self._pending:
+            return
+        keys = list(self._pending)
+        vecs = np.stack([self._pending[k] for k in keys])
+        # compute signatures BEFORE dropping the staging dict: a transient
+        # device failure here must leave the flush retryable, not silently
+        # strip these keys out of every future candidate set
+        sigs = self.projector.signatures(vecs)
+        for k in keys:
+            self._pending.pop(k, None)
+        for key, sig in zip(keys, sigs):
+            old = self.sig_of_key.get(key)
+            if old is not None:  # re-add: drop stale bucket entries
+                for band, bucket in enumerate(old):
+                    self.buckets[(band, int(bucket))].discard(key)
+            self.sig_of_key[key] = sig
+            for band, bucket in enumerate(sig):
+                self.buckets[(band, int(bucket))].add(key)
 
     def remove(self, key) -> None:
-        self.index.remove(key)
-        sig = self.sig_of_key.pop(key, None)
-        if sig is not None:
-            for band, bucket in enumerate(sig):
-                self.buckets[(band, int(bucket))].discard(key)
-        self._drop_meta(key)
+        with self._lock:
+            self._pending.pop(key, None)
+            self.index.remove(key)
+            sig = self.sig_of_key.pop(key, None)
+            if sig is not None:
+                for band, bucket in enumerate(sig):
+                    self.buckets[(band, int(bucket))].discard(key)
+            self._drop_meta(key)
 
     def search(self, queries):
         if not queries:
             return []
         vecs = np.stack([np.asarray(q[0], dtype=np.float32) for q in queries])
+        # query signatures only read the (immutable) projections — no lock
         sigs = self.projector.signatures(vecs)
+        # hold the lock just long enough to flush staged adds and snapshot
+        # candidate sets; the per-query device rescoring below must NOT
+        # serialize ingest (search_among tolerates concurrently-removed keys
+        # under DeviceKnnIndex's own lock)
+        with self._lock:
+            self._flush_pending()
+            cand_lists = []
+            for sig in sigs:
+                candidates: set = set()
+                for band, bucket in enumerate(sig):
+                    candidates |= self.buckets.get((band, int(bucket)), set())
+                cand_lists.append(list(candidates))
         results = []
-        for (data, k, flt), sig in zip(queries, sigs):
-            candidates: set = set()
-            for band, bucket in enumerate(sig):
-                candidates |= self.buckets.get((band, int(bucket)), set())
+        for (data, k, flt), candidates in zip(queries, cand_lists):
             if not candidates:
                 results.append([])
                 continue
@@ -202,7 +244,9 @@ class LshKnnIndex(_FilteredMixin, InnerIndexImpl):
             # (reference: _knn_lsh.py:219-256 knn candidate rescoring)
             oversample = self.OVERSAMPLE if flt else 1
             raw = self.index.search_among(
-                np.asarray(data, dtype=np.float32), list(candidates), k * oversample
+                np.asarray(data, dtype=np.float32),
+                candidates,
+                k * oversample,
             )
             results.append(self._apply_filter(raw, flt, k))
         return results

@@ -478,3 +478,36 @@ def test_sharded_index_compaction_keeps_shard_divisibility():
     res = idx.search(rng.standard_normal((2, 8)), k=5)
     assert len(res[0]) == 5
     assert all(k >= 480 for k, _ in res[0])
+
+
+def test_lsh_index_staged_adds_batched_and_readd_clean():
+    """LshKnnIndex defers signature computation to one batched device call
+    per flush (a per-add round trip never finishes over a remote chip), and
+    re-adding a key must drop its stale bucket entries."""
+    from pathway_tpu.stdlib.indexing.retrievers import LshKnnIndex
+
+    idx = LshKnnIndex(dim=16, metric="cos", capacity=64)
+    rng = np.random.default_rng(0)
+    vs = rng.standard_normal((20, 16)).astype(np.float32)
+    for i, v in enumerate(vs):
+        idx.add(i, v, None)
+    assert len(idx._pending) == 20 and not idx.sig_of_key  # deferred
+    (res,) = idx.search([(vs[3], 3, None)])
+    assert res[0][0] == 3
+    assert not idx._pending and len(idx.sig_of_key) == 20  # one flush
+
+    # re-add key 3 with a different vector: old buckets must not leak
+    idx.add(3, vs[7], None)
+    (res,) = idx.search([(vs[7], 2, None)])
+    got = {k for k, _ in res}
+    assert got == {3, 7}
+    stale = [b for b, keys in idx.buckets.items() if 3 in keys]
+    sig3 = idx.sig_of_key[3]
+    assert all(b in {(band, int(s)) for band, s in enumerate(sig3)} for b in stale)
+
+    # removing a still-pending key discards it everywhere
+    idx.add(50, vs[0], None)
+    idx.remove(50)
+    (res,) = idx.search([(vs[0], 2, None)])
+    assert all(k != 50 for k, _ in res)
+    assert 50 not in idx.sig_of_key and 50 not in idx._pending
