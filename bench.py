@@ -146,10 +146,12 @@ def child_device(seconds: float = 10.0) -> None:
     bucketed_dispatch(fwd, ids_all[:small], mask_all[:small], enc.max_length, vocab_size=vocab)
     docs_per_sec = _emit_device_result(measure(small), dev, attn)
     big = min(1024, len(docs))
+    big_warm = False
     # conservative escalation cost: a fresh-shape compile over the tunnel
     # has been observed north of 150s
     if big > small and time.monotonic() + 180 + seconds < child_deadline:
         bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
+        big_warm = True
         docs_per_sec = max(docs_per_sec, measure(big))
         docs_per_sec = _emit_device_result(docs_per_sec, dev, attn)
         # steady chip + budget to spare: take a second same-length sample
@@ -207,6 +209,59 @@ def child_device(seconds: float = 10.0) -> None:
             extra["wire_bf16_docs_per_sec"] = round(measure(big), 1)
         except Exception as exc:
             msg = f"bf16-wire A/B failed: {exc!r}"[:300]
+            extra["ab_warning"] = (
+                f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
+            )
+        _emit_device_result(docs_per_sec, dev, best_attn, **extra)
+
+    # compute-only: device-resident inputs, no per-dispatch wire.  The
+    # dispatch numbers above are tunnel-wire-bound (~2.2 MB/s of u16 ids
+    # floors them); this measures what the chip itself sustains — the
+    # honest basis for the BASELINE "A100-parity" comparison, since
+    # published accelerator figures are likewise data-resident.  Reuses
+    # the dispatch path's own padding protocol (pad_chunk) so the cached
+    # executable is hit — a fresh big-bucket compile is only paid when
+    # the escalation never warmed it, and then only with compile budget.
+    margin = 30 if big_warm else 180
+    if dev.platform == "tpu" and time.monotonic() + margin + seconds < child_deadline:
+        try:
+            import jax
+
+            from pathway_tpu.models.encoder import (
+                BATCH_BUCKETS,
+                SEQ_BUCKETS,
+                _bucket,
+                dispatch_dtype,
+                pad_chunk,
+            )
+
+            longest = int(mask_all[:big].sum(axis=1).max())
+            seq_b = min(_bucket(longest, SEQ_BUCKETS), enc.max_length)
+            bb = _bucket(big, BATCH_BUCKETS)
+            ids_np, mask_np, _ = pad_chunk(
+                ids_all[:big],
+                mask_all[:big],
+                bb,
+                seq_b,
+                ids_dtype=dispatch_dtype(vocab),
+            )
+            di, dm = jax.device_put(ids_np), jax.device_put(mask_np)
+            fused_fwd(di, dm).block_until_ready()  # cached-executable warm
+            n = 0
+            t0 = time.perf_counter()
+            out = None
+            while time.perf_counter() - t0 < seconds:
+                # sync every 32 dispatches: async dispatch would otherwise
+                # enqueue unbounded device work the trailing drain pays for
+                for _ in range(32):
+                    out = fused_fwd(di, dm)
+                    n += bb
+                out.block_until_ready()
+            co = n / (time.perf_counter() - t0)
+            extra["compute_only_docs_per_sec"] = round(co, 1)
+            extra["mfu_compute_only"] = _mfu(co, dev)
+        except Exception as exc:
+            msg = f"compute-only probe failed: {exc!r}"[:300]
             extra["ab_warning"] = (
                 f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
             )
@@ -493,7 +548,12 @@ def main() -> None:
         out["device_kind"] = result.get("device_kind")
         out["mfu"] = result.get("mfu")
         out["attn_impl"] = result.get("attn_impl")
-        for opt in ("pallas_docs_per_sec", "wire_bf16_docs_per_sec"):
+        for opt in (
+            "pallas_docs_per_sec",
+            "wire_bf16_docs_per_sec",
+            "compute_only_docs_per_sec",
+            "mfu_compute_only",
+        ):
             if result.get(opt) is not None:
                 out[opt] = result[opt]
         if result.get("ab_warning"):
@@ -555,7 +615,8 @@ def _last_banked_tpu() -> dict | None:
                 k: rec[k]
                 for k in (
                     "value", "unit", "mfu", "attn_impl", "device_kind",
-                    "pallas_docs_per_sec", "wire_bf16_docs_per_sec", "ts",
+                    "pallas_docs_per_sec", "wire_bf16_docs_per_sec",
+                    "compute_only_docs_per_sec", "mfu_compute_only", "ts",
                 )
                 if rec.get(k) is not None
             }

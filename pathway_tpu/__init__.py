@@ -18,6 +18,21 @@ Import as ``import pathway_tpu as pw`` — the public surface mirrors
 
 from __future__ import annotations
 
+import os as _os
+
+if "JAX_PLATFORMS" in _os.environ:
+    # Honor an explicit platform request even under device-plugin shims
+    # that prepend their own platform after jax parses the env var
+    # (observed with a tunneled-TPU shim: a `JAX_PLATFORMS=cpu` process
+    # otherwise blocks in backend init for minutes whenever the remote
+    # chip is unreachable).  Must run before any backend is initialized.
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # noqa: BLE001 - never make import fail over this
+        pass
+
 from typing import Any
 
 from .internals import dtype as dt
