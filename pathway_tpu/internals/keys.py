@@ -146,6 +146,26 @@ def derive_subkey(key: Pointer, index: int) -> Pointer:
     return Pointer(h)
 
 
+def ref_pair(a, b) -> Pointer:
+    """``ref_scalar(a, b)`` specialized for the join output-key hot path.
+
+    Bit-identical to ``_mix128((a, b))`` for two Pointers (so persisted
+    downstream state keyed by join outputs replays unchanged) with the
+    tuple build, loop, and per-element dispatch peeled off; anything that
+    is not exactly a Pointer pair falls back to :func:`ref_scalar`."""
+    if type(a) is Pointer and type(b) is Pointer:
+        h = _FNV128_BASIS
+        h ^= a ^ _TAG_PTR
+        h = (h * _FNV128_PRIME) & _MASK128
+        h ^= b ^ _TAG_PTR
+        h = (h * _FNV128_PRIME) & _MASK128
+        h ^= h >> 64
+        h = (h * _AVALANCHE) & _MASK128
+        h ^= h >> 64
+        return Pointer(h)
+    return ref_scalar(a, b)
+
+
 def ref_scalar(*values: Any, optional: bool = False) -> Pointer:
     """Derive a deterministic Pointer from a tuple of values
     (reference: python/pathway/internals/api.py ``ref_scalar``)."""

@@ -39,7 +39,7 @@ from .expression import (
 from .graph import G, Operator
 from .groupbys import _GroupColExpression, _ReducerSlotExpression
 from .joins import JoinMode
-from .keys import derive_subkey, ref_pointer, ref_scalar
+from .keys import derive_subkey, ref_pair, ref_pointer, ref_scalar
 from .value import Pointer
 
 __all__ = ["GraphRunner", "build_engine"]
@@ -575,7 +575,7 @@ class GraphRunner:
                     (lkey, lrow, rkey, rrow)
                 )
         else:
-            out_key_fn = lambda lkey, lrow, rkey, rrow: ref_scalar(lkey, rkey)
+            out_key_fn = lambda lkey, lrow, rkey, rrow: ref_pair(lkey, rkey)
 
         node = JoinNode(
             left_key_fn=lambda key, row: tuple(f((key, row)) for f in lfns),

@@ -122,7 +122,7 @@ def lower_asof_now_join(runner, op) -> None:
     port 0 so updates land first) and no revisiting."""
     from ...internals.evaluator import compile_expression
     from ...internals.expression import ColumnReference, IdExpression
-    from ...internals.keys import ref_scalar
+    from ...internals.keys import ref_pair
     from ...internals.runtime import _TableLayout
 
     left, right = op.inputs
@@ -161,7 +161,7 @@ def lower_asof_now_join(runner, op) -> None:
     if id_expr is not None and isinstance(id_expr, IdExpression) and id_expr.table is left:
         out_key_fn = lambda lkey, lrow, rkey, rrow: lkey
     else:
-        out_key_fn = lambda lkey, lrow, rkey, rrow: ref_scalar(lkey, rkey)
+        out_key_fn = lambda lkey, lrow, rkey, rrow: ref_pair(lkey, rkey)
 
     node = AsofNowJoinNode(
         left_key_fn=lambda key, row: tuple(f((key, row)) for f in lfns),

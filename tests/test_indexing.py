@@ -511,3 +511,60 @@ def test_lsh_index_staged_adds_batched_and_readd_clean():
     (res,) = idx.search([(vs[0], 2, None)])
     assert all(k != 50 for k, _ in res)
     assert 50 not in idx.sig_of_key and 50 not in idx._pending
+
+
+def test_lsh_index_concurrent_churn():
+    """Ingest/remove/search from three threads must not lose staged adds,
+    corrupt buckets, or deadlock (the staged-flush + lock contract)."""
+    import threading
+
+    from pathway_tpu.stdlib.indexing.retrievers import LshKnnIndex
+
+    idx = LshKnnIndex(dim=8, metric="cos", capacity=4096)
+    rng = np.random.default_rng(1)
+    vs = rng.standard_normal((600, 8)).astype(np.float32)
+    errors: list[BaseException] = []
+
+    def adder():
+        try:
+            for i in range(600):
+                idx.add(i, vs[i], None)
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def remover():
+        try:
+            # some keys not yet added: remove() is a no-op for unknown keys
+            for i in range(0, 600, 3):
+                idx.remove(i)
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def searcher():
+        try:
+            for _ in range(60):
+                idx.search([(vs[5], 3, None)])
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=adder),
+        threading.Thread(target=remover),
+        threading.Thread(target=searcher),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "thread deadlocked"
+    assert not errors, errors
+    # settle: everything still pending flushes; state is consistent
+    idx.search([(vs[5], 3, None)])
+    assert not idx._pending
+    for key, sig in idx.sig_of_key.items():
+        for band, bucket in enumerate(sig):
+            assert key in idx.buckets[(band, int(bucket))]
+    # a key present in buckets must have a signature recorded
+    for bucket_keys in idx.buckets.values():
+        for key in bucket_keys:
+            assert key in idx.sig_of_key

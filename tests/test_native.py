@@ -183,3 +183,21 @@ def test_gc_batch_mode_reentrant():
     assert eng._gc_mode_depth == base_depth
     if base_depth == 0:
         assert gc.get_threshold() == old
+
+
+def test_ref_pair_matches_ref_scalar():
+    """ref_pair hand-unrolls _mix128 for Pointer pairs with a
+    bit-identical-persistence contract — pin it against ref_scalar so a
+    future _mix128 edit cannot silently diverge the fast path."""
+    import random
+
+    from pathway_tpu.internals.keys import Pointer, ref_pair, ref_scalar
+
+    rng = random.Random(7)
+    for _ in range(500):
+        a = Pointer(rng.getrandbits(128))
+        b = Pointer(rng.getrandbits(128))
+        assert ref_pair(a, b) == ref_scalar(a, b)
+    # non-Pointer operands take the generic (BLAKE2b) path unchanged
+    assert ref_pair(3, "x") == ref_scalar(3, "x")
+    assert ref_pair(Pointer(5), 9) == ref_scalar(Pointer(5), 9)
