@@ -786,6 +786,9 @@ class JoinNode(Node):
         other_state = self.right_state if left_side else self.left_state
         my_count = self.left_count if left_side else self.right_count
         slot = self.left_key_slot if left_side else self.right_key_slot
+        out_fn = self.out_fn
+        key_fn = self.out_key_fn
+        append = out.append
         my_key_fn = None
         if slot is None:
             my_key_fn = self.left_key_fn if left_side else self.right_key_fn
@@ -816,15 +819,29 @@ class JoinNode(Node):
             affected.add(jk)
             # inner products against the current other side; other_state
             # is a different dict from my_state and is only mutated by the
-            # other port's drain, so iterating its live bucket is safe
+            # other port's drain, so iterating its live bucket is safe.
+            # _emit is inlined with hoisted locals: this append is the
+            # hottest line of the join (one per output row)
             bucket = other_state.get(jk)
             if bucket:
                 if left_side:
                     for cnt, okey, orow in bucket.values():
-                        self._emit(key, row, okey, orow, diff * cnt, out)
+                        append(
+                            (
+                                key_fn(key, row, okey, orow),
+                                out_fn(key, row, okey, orow),
+                                diff * cnt,
+                            )
+                        )
                 else:
                     for cnt, okey, orow in bucket.values():
-                        self._emit(okey, orow, key, row, diff * cnt, out)
+                        append(
+                            (
+                                key_fn(okey, orow, key, row),
+                                out_fn(okey, orow, key, row),
+                                diff * cnt,
+                            )
+                        )
             self._apply(my_state, jk, key, row, diff)
             my_count[jk] += diff
         return out
