@@ -26,7 +26,37 @@ from .joins import JoinMode, JoinResult
 from .schema import ColumnSchema, Schema, SchemaMetaclass, _schema_from_columns
 from .universe import Universe
 
-__all__ = ["Table", "TableLike", "groupby"]
+__all__ = ["Table", "TableLike", "ColumnNamespace", "groupby"]
+
+
+class ColumnNamespace:
+    """``table.C.<name>`` / ``table.C[<name>]`` column accessor
+    (reference repo: python/pathway/internals/table.py ``Table.C``,
+    python/pathway/tests/test_colnamespace.py) — reaches columns whose
+    names collide with Table methods (``select``, ``filter``, even ``C``)."""
+
+    __slots__ = ("_table",)
+
+    def __init__(self, table: "Table"):
+        object.__setattr__(self, "_table", table)
+
+    def __getattr__(self, name: str):
+        # validate eagerly: this is the *safe* accessor, so a typo must
+        # fail here with the column list, not later as a deep KeyError.
+        # Leading-underscore names would also swallow notebook/hasattr
+        # protocol probes (_repr_html_ etc.) — bracket access is the
+        # escape hatch for such column names.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        table = self._table
+        if name == "id" or name in table._schema.__columns__:
+            return table[name]
+        raise AttributeError(
+            f"Table has no column {name!r}; columns: {table.column_names()}"
+        )
+
+    def __getitem__(self, name):
+        return self._table[name]
 
 
 class Table:
@@ -83,6 +113,13 @@ class Table:
     @property
     def id(self) -> IdExpression:
         return IdExpression(self)
+
+    @property
+    def C(self) -> "ColumnNamespace":
+        """Column accessor immune to Table method-name collisions
+        (reference: internals/table.py ``Table.C``, tests/test_colnamespace.py):
+        ``t.C.select`` reads the column named "select"."""
+        return ColumnNamespace(self)
 
     def __getattr__(self, name: str) -> ColumnReference:
         if name.startswith("_"):

@@ -42,6 +42,31 @@ class ThisWithout:
         self.names = names
 
 
+class ThisNamespace:
+    """``pw.this.C.<name>`` — column accessor immune to sentinel
+    method-name collisions (mirrors ``Table.C``; reference repo:
+    python/pathway/internals/thisclass.py,
+    python/pathway/tests/test_colnamespace.py)."""
+
+    __slots__ = ("_sentinel",)
+
+    def __init__(self, sentinel: "ThisSentinel"):
+        object.__setattr__(self, "_sentinel", sentinel)
+
+    def __getattr__(self, name: str) -> Any:
+        # underscore names: protocol probes (notebook display, hasattr
+        # feature checks), never columns — bracket access is the escape
+        # hatch, same stance as ColumnNamespace
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ThisColumnReference(self._sentinel, name)
+
+    def __getitem__(self, name) -> Any:
+        if isinstance(name, ColumnReference):
+            name = name.name
+        return ThisColumnReference(self._sentinel, name)
+
+
 class ThisSentinel:
     __slots__ = ("kind",)
 
@@ -51,6 +76,8 @@ class ThisSentinel:
     def __getattr__(self, name: str) -> Any:
         if name.startswith("__") and name.endswith("__"):
             raise AttributeError(name)
+        if name == "C":
+            return ThisNamespace(self)
         if name == "id":
             return ThisColumnReference(self, "id")
         return ThisColumnReference(self, name)

@@ -511,3 +511,50 @@ def test_dt_subtract_date_time_in_timezone():
     )
     _, cols = pwd.table_to_dicts(res)
     assert list(cols["h"].values()) == [1, 2, 3, 2]
+
+
+def test_column_namespace_accessor():
+    """t.C.<name> / pw.this.C.<name> reach columns whose names collide
+    with Table/sentinel methods (reference: tests/test_colnamespace.py)."""
+    t = pw.debug.table_from_markdown(
+        """
+        select | filter | C
+        1      | 10     | x
+        2      | 20     | y
+        """
+    )
+    r = t.select(a=t.C.select, b=t.C["filter"], c=t.C.C)
+    (out,) = pw.debug.materialize(r)
+    assert sorted(out.current.values()) == [(1, 10, "x"), (2, 20, "y")]
+
+
+def test_column_namespace_via_this():
+    t = pw.debug.table_from_markdown(
+        """
+        select | v
+        1      | 5
+        1      | 7
+        2      | 1
+        """
+    )
+    g = t.groupby(pw.this.C.select).reduce(
+        k=pw.this.C.select, s=pw.reducers.sum(pw.this.C.v)
+    )
+    (out,) = pw.debug.materialize(g)
+    assert sorted(out.current.values()) == [(1, 12), (2, 1)]
+
+
+def test_column_namespace_validates_and_guards_probes():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    with pytest.raises(AttributeError, match="no column 'nope'"):
+        t.C.nope
+    with pytest.raises(AttributeError):
+        t.C._repr_html_  # notebook display probe must not fabricate a column
+    with pytest.raises(AttributeError):
+        pw.this.C._repr_html_
+    assert t.C.id is not None
