@@ -102,6 +102,34 @@ def _stage_ordered_diff(rng):
     return stage, "ordered_diff"
 
 
+def _stage_session(rng):
+    gap = rng.randint(2, 5)
+
+    def stage(t):
+        wb = t.windowby(t.v, window=pw.temporal.session(max_gap=gap))
+        return wb.reduce(k=pw.this._pw_window_start, v=pw.reducers.count())
+
+    return stage, f"session({gap})"
+
+
+def _stage_intervals_over(rng):
+    w = rng.randint(1, 3)
+
+    def stage(t):
+        at = t.groupby(t.v).reduce(a=t.v)
+        wb = t.windowby(
+            t.v,
+            window=pw.temporal.intervals_over(
+                at=at.a, lower_bound=-w, upper_bound=w, is_outer=False
+            ),
+        )
+        return wb.reduce(
+            k=pw.this._pw_window_location, v=pw.reducers.count()
+        )
+
+    return stage, f"intervals_over({w})"
+
+
 _STAGES = [
     _stage_map,
     _stage_filter,
@@ -110,6 +138,12 @@ _STAGES = [
     _stage_tumbling,
     _stage_sliding,
     _stage_ordered_diff,
+    _stage_session,
+    # NOTE: deduplicate is deliberately NOT in the grammar: it is
+    # path-dependent by design (remembers values whose source rows were
+    # later retracted — reference semantics), so incremental == batch
+    # recompute does not hold for it.
+    _stage_intervals_over,
 ]
 
 
