@@ -188,8 +188,18 @@ def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
     v_caches = []
     for i in range(cfg.num_layers):
         x, k, v = _block_prefill(x, params[f"h_{i}"], cfg, pos_mask)
-        k_pad = jnp.zeros((B, Tmax, H, Dh), cfg.dtype).at[:, :Tp].set(k)
-        v_pad = jnp.zeros((B, Tmax, H, Dh), cfg.dtype).at[:, :Tp].set(v)
+        # cast before the scatter: future JAX errors on implicit
+        # f32->bf16 value demotion in .at[].set
+        k_pad = (
+            jnp.zeros((B, Tmax, H, Dh), cfg.dtype)
+            .at[:, :Tp]
+            .set(k.astype(cfg.dtype))
+        )
+        v_pad = (
+            jnp.zeros((B, Tmax, H, Dh), cfg.dtype)
+            .at[:, :Tp]
+            .set(v.astype(cfg.dtype))
+        )
         k_caches.append(k_pad)
         v_caches.append(v_pad)
     x = _ln(x, params["ln_f"], cfg.ln_eps)

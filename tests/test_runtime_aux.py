@@ -457,3 +457,35 @@ def test_spawn_from_git_repository(tmp_path):
     outs = sorted(p.name for p in out_dir.iterdir())
     assert outs == ["out-0.txt", "out-1.txt"]
     assert (out_dir / "out-0.txt").read_text() == "from-the-repo"
+
+
+def test_example_yaml_apps_load():
+    """Both shipped YAML app templates instantiate end-to-end through the
+    loader (components constructed, no engine run)."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    for name in ("examples/rag_app/app.yaml", "examples/local_qa/app.yaml"):
+        text = (root / name).read_text()
+        # avoid compiling real encoders/LMs in the unit tier — and assert
+        # the mock swap actually matched so YAML drift can't silently
+        # re-enable real model construction here
+        swapped = text.replace(
+            "!pw.xpacks.llm.embedders.SentenceTransformerEmbedder\n"
+            "  model: all-MiniLM-L6-v2",
+            "!pw.xpacks.llm.mocks.FakeEmbedder\n  dim: 16",
+        )
+        assert swapped != text, f"embedder block drifted in {name}"
+        text = swapped
+        if "JaxPipelineChat" in text:
+            swapped = text.replace(
+                "!pw.xpacks.llm.llms.JaxPipelineChat\n"
+                "  model: null\n"
+                "  max_new_tokens: 48",
+                "!pw.xpacks.llm.mocks.IdentityMockChat {}",
+            )
+            assert swapped != text, f"llm block drifted in {name}"
+            text = swapped
+        app = pw.load_yaml(text)
+        assert "question_answerer" in app and app["port"], name
+        pw.internals.graph.G.clear()
