@@ -17,9 +17,13 @@ moment a probe succeeds it fires the full chip measurement stack:
 
   4. ``benchmarks/decoder_bench.py`` → causal-LM decode tokens/sec,
      appended to ``benchmarks/decoder_results.jsonl`` (success requires a
-     platform=="tpu" line).
+     platform=="tpu" line);
 
-It keeps watching until ALL FOUR have succeeded at least once (a window
+  5. ``benchmarks/attn_probe.py`` → compute-only encoder throughput +
+     fused-vs-pallas A/B at seq 128/512, appended to
+     ``benchmarks/attn_probe_results.jsonl``.
+
+It keeps watching until ALL FIVE have succeeded at least once (a window
 may close mid-run; partial salvage lines still count as progress), then
 exits 0.  All activity is logged with timestamps to
 ``benchmarks/chip_watch.log``.
@@ -155,6 +159,28 @@ def fire_serving() -> bool:
     return rc == 0
 
 
+def fire_attn() -> bool:
+    """Compute-only throughput + fused-vs-pallas seq-128/512 A/B with
+    device-resident inputs (benchmarks/attn_probe.py; appends to
+    attn_probe_results.jsonl).  Success requires a platform=="tpu" line."""
+    _log("running attn_probe.py (budget 540s)")
+    rc, out = _run(
+        [os.path.join(HERE, "attn_probe.py")],
+        560.0,
+        {"ATTN_PROBE_BUDGET_S": "540"},
+    )
+    ok = False
+    for line in (out or "").strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("platform") == "tpu":
+            ok = True
+    _log(f"attn_probe rc={rc} tpu={ok} tail: {out[-300:]!r}")
+    return ok
+
+
 def fire_decoder() -> bool:
     """Causal-LM decode tokens/sec on the chip (BASELINE config #4's
     compute path; appends to decoder_results.jsonl).  Success requires a
@@ -198,7 +224,7 @@ def main() -> int:
     deadline = time.monotonic() + float(
         os.environ.get("CHIP_WATCH_BUDGET_S", str(11 * 3600))
     )
-    bench_done = suite_done = serving_done = decoder_done = False
+    bench_done = suite_done = serving_done = decoder_done = attn_done = False
     _log(f"watcher start (interval {interval:.0f}s, once={once})")
     n = 0
     while time.monotonic() < deadline:
@@ -214,9 +240,12 @@ def main() -> int:
                 serving_done = fire_serving()
             if not decoder_done:
                 decoder_done = fire_decoder()
-            if bench_done and suite_done and serving_done and decoder_done:
-                _log("bench.py, chip_suite.py, serving_bench.py and "
-                     "decoder_bench.py all succeeded — done")
+            if not attn_done:
+                attn_done = fire_attn()
+            if (bench_done and suite_done and serving_done and decoder_done
+                    and attn_done):
+                _log("bench.py, chip_suite.py, serving_bench.py, "
+                     "decoder_bench.py and attn_probe.py all succeeded — done")
                 return 0
         else:
             if n % 10 == 1:
@@ -225,7 +254,8 @@ def main() -> int:
             return 0 if dev else 1
         time.sleep(interval)
     _log("watch budget exhausted")
-    return 0 if (bench_done or suite_done or serving_done or decoder_done) else 1
+    return 0 if (bench_done or suite_done or serving_done
+                 or decoder_done or attn_done) else 1
 
 
 if __name__ == "__main__":
