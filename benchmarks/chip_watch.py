@@ -159,16 +159,17 @@ def fire_serving() -> bool:
     return rc == 0
 
 
-def fire_attn() -> bool:
-    """Compute-only throughput + fused-vs-pallas seq-128/512 A/B with
-    device-resident inputs (benchmarks/attn_probe.py; appends to
-    attn_probe_results.jsonl).  Success requires a platform=="tpu" line."""
-    _log("running attn_probe.py (budget 540s)")
-    rc, out = _run(
-        [os.path.join(HERE, "attn_probe.py")],
-        560.0,
-        {"ATTN_PROBE_BUDGET_S": "540"},
-    )
+def _fire_tpu_jsonl(
+    script: str, timeout: float, env: dict | None = None
+) -> bool:
+    """Run a bench script; success requires a platform=="tpu" JSON line —
+    JAX silently falls back to CPU if the tunnel drops between the probe
+    and the run, and a CPU number must not be banked as the chip
+    measurement.  Shared by decoder_bench and attn_probe (each script
+    appends its own results file)."""
+    name = os.path.basename(script)
+    _log(f"running {name} (budget {timeout:.0f}s)")
+    rc, out = _run([script], timeout, env)
     ok = False
     for line in (out or "").strip().splitlines():
         try:
@@ -177,31 +178,25 @@ def fire_attn() -> bool:
             continue
         if rec.get("platform") == "tpu":
             ok = True
-    _log(f"attn_probe rc={rc} tpu={ok} tail: {out[-300:]!r}")
+    _log(f"{name} rc={rc} tpu={ok} tail: {out[-300:]!r}")
     return ok
+
+
+def fire_attn() -> bool:
+    """Compute-only throughput + fused-vs-pallas seq-128/512 A/B with
+    device-resident inputs (benchmarks/attn_probe.py; appends to
+    attn_probe_results.jsonl)."""
+    return _fire_tpu_jsonl(
+        os.path.join(HERE, "attn_probe.py"),
+        560.0,
+        {"ATTN_PROBE_BUDGET_S": "540"},
+    )
 
 
 def fire_decoder() -> bool:
     """Causal-LM decode tokens/sec on the chip (BASELINE config #4's
-    compute path; appends to decoder_results.jsonl).  Success requires a
-    platform=="tpu" result line — JAX silently falls back to CPU if the
-    tunnel drops between the probe and the run, and a CPU decode number
-    must not be banked as the chip measurement."""
-    _log("running decoder_bench.py (budget 600s)")
-    rc, out = _run(
-        [os.path.join(HERE, "decoder_bench.py")],
-        600.0,
-    )
-    ok = False
-    for line in (out or "").strip().splitlines():
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if rec.get("platform") == "tpu":
-            ok = True
-    _log(f"decoder_bench rc={rc} tpu={ok} tail: {out[-300:]!r}")
-    return ok
+    compute path; appends to decoder_results.jsonl)."""
+    return _fire_tpu_jsonl(os.path.join(HERE, "decoder_bench.py"), 600.0)
 
 
 def main() -> int:

@@ -232,6 +232,79 @@ class DeviceKnnIndex:
                 out.append((key, float(s)))
         return out
 
+    def search_among_batched(
+        self,
+        queries: Any,  # [Q, D]
+        keys_lists: list[list[Hashable]],
+        k: int,
+    ) -> list[list[tuple[Hashable, float]]]:
+        """Batched :meth:`search_among`: one device call rescoring every
+        query against its own candidate set (padded to shared buckets so
+        compiled shapes stay stable).  The per-query form costs one RPC
+        round trip each over a remote chip; this is the LSH serving path."""
+        with self._lock:
+            return self._search_among_batched_locked(queries, keys_lists, k)
+
+    #: elements budget for the [Q, C, D] candidate gather — bounds peak
+    #: HBM next to the resident index (32M f32 elems ≈ 128 MB); larger
+    #: batches process in query chunks
+    _AMONG_GATHER_ELEMS = 32 * 1024 * 1024
+
+    def _search_among_batched_locked(self, queries, keys_lists, k):
+        from .topk import among_topk_search
+
+        self._apply_staged()
+        slot_lists = [
+            [self.slot_of_key[key] for key in keys if key in self.slot_of_key]
+            for keys in keys_lists
+        ]
+        cmax = max((len(s) for s in slot_lists), default=0)
+        if cmax == 0:
+            return [[] for _ in keys_lists]
+        # bucket the candidate dim: stable compiled shapes
+        c_b = max(16, 1 << (cmax - 1).bit_length())
+        n_q = len(slot_lists)
+        # chunk queries so the [Q, C, D] gather stays within budget (one
+        # huge bucket union must not OOM HBM; a chunk of 1 degrades to the
+        # per-query cost, never worse)
+        max_chunk = max(1, self._AMONG_GATHER_ELEMS // (c_b * self.dim))
+        q_all = np.asarray(queries, dtype=np.float32).reshape(n_q, -1)
+        results: list[list[tuple[Hashable, float]]] = []
+        for start in range(0, n_q, max_chunk):
+            chunk = slot_lists[start : start + max_chunk]
+            q_b = max(8, 1 << (len(chunk) - 1).bit_length())
+            idx = np.zeros((q_b, c_b), np.int32)
+            pad_valid = np.zeros((q_b, c_b), bool)
+            for i, s in enumerate(chunk):
+                idx[i, : len(s)] = s
+                pad_valid[i, : len(s)] = True
+            q = np.zeros((q_b, self.dim), np.float32)
+            q[: len(chunk)] = q_all[start : start + len(chunk)]
+            if self.metric == "cos":
+                norms = np.linalg.norm(q, axis=1, keepdims=True)
+                np.divide(q, norms, out=q, where=norms > 0)
+            scores, sub_idx = among_topk_search(
+                jnp.asarray(q, dtype=self.dtype),
+                self.vectors,
+                self.valid,
+                jnp.asarray(idx),
+                jnp.asarray(pad_valid),
+                min(k, c_b),
+                self.metric,
+            )
+            scores = np.asarray(scores)
+            sub_idx = np.asarray(sub_idx)
+            for i in range(len(chunk)):
+                row: list[tuple[Hashable, float]] = []
+                for s, j in zip(scores[i], sub_idx[i]):
+                    if not np.isfinite(s):
+                        continue
+                    key = self.key_of_slot[int(idx[i, int(j)])]
+                    if key is not None:
+                        row.append((key, float(s)))
+                results.append(row)
+        return results
+
     def _device_search(self, q: np.ndarray, k: int) -> tuple[jax.Array, jax.Array]:
         """(scores, slot indices) for normalized queries — subclasses
         override with the mesh-sharded path.  Large cos/dot indexes take

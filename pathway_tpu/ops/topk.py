@@ -142,3 +142,38 @@ def pallas_topk_search(
         )
     scores = pallas_masked_scores(queries, vectors, valid)[:q]
     return lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def among_topk_search(
+    queries: jax.Array,  # [Q, D]
+    vectors: jax.Array,  # [N, D] full index matrix
+    valid: jax.Array,  # [N] tombstone mask
+    idx: jax.Array,  # [Q, C] per-query candidate slot indices
+    pad_valid: jax.Array,  # [Q, C] False on padding entries
+    k: int,
+    metric: str = "cos",
+):
+    """Per-query candidate-subset top-k in ONE device call.
+
+    The LSH rescoring path (reference: _knn_lsh.py:219-256 rescores each
+    query's bucket union) previously dispatched one gather+top-k per
+    query; over a remote chip that is a full RPC round trip each.  Here
+    all Q candidate sets ride one gather ([Q, C, D]) and one batched
+    matvec.
+    """
+    sub = vectors[idx]  # [Q, C, D]
+    v = valid[idx] & pad_valid
+    dots = jnp.einsum(
+        "qd,qcd->qc", queries, sub, preferred_element_type=jnp.float32
+    )
+    if metric in ("cos", "dot"):
+        s = dots
+    elif metric == "l2sq":
+        qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+        vn = jnp.sum(sub.astype(jnp.float32) ** 2, axis=-1)
+        s = 2.0 * dots - qn - vn
+    else:
+        raise ValueError(f"unknown metric {metric!r}")
+    s = jnp.where(v, s, NEG_INF)
+    return lax.top_k(s, k)

@@ -224,9 +224,10 @@ class LshKnnIndex(_FilteredMixin, InnerIndexImpl):
         # query signatures only read the (immutable) projections — no lock
         sigs = self.projector.signatures(vecs)
         # hold the lock just long enough to flush staged adds and snapshot
-        # candidate sets; the per-query device rescoring below must NOT
-        # serialize ingest (search_among tolerates concurrently-removed keys
-        # under DeviceKnnIndex's own lock)
+        # candidate sets; the single batched device rescoring call below
+        # must NOT serialize ingest (search_among_batched resolves/filters
+        # keys under DeviceKnnIndex's own lock, tolerating concurrent
+        # removals)
         with self._lock:
             self._flush_pending()
             cand_lists = []
@@ -235,20 +236,19 @@ class LshKnnIndex(_FilteredMixin, InnerIndexImpl):
                 for band, bucket in enumerate(sig):
                     candidates |= self.buckets.get((band, int(bucket)), set())
                 cand_lists.append(list(candidates))
+        # exact rescoring over the candidate sets only, ALL queries in one
+        # device call (reference: _knn_lsh.py:219-256 knn candidate
+        # rescoring).  The per-query form costs one RPC round trip each
+        # on a remote chip — the dominant term in the measured 155-178
+        # ms/query LSH numbers in benchmarks/KNN_CROSSOVER.md.
+        kmax = max(
+            q[1] * (self.OVERSAMPLE if q[2] else 1) for q in queries
+        )
+        raw_rows = self.index.search_among_batched(vecs, cand_lists, kmax)
         results = []
-        for (data, k, flt), candidates in zip(queries, cand_lists):
-            if not candidates:
-                results.append([])
-                continue
-            # exact rescoring over the candidate set only
-            # (reference: _knn_lsh.py:219-256 knn candidate rescoring)
+        for (data, k, flt), raw in zip(queries, raw_rows):
             oversample = self.OVERSAMPLE if flt else 1
-            raw = self.index.search_among(
-                np.asarray(data, dtype=np.float32),
-                candidates,
-                k * oversample,
-            )
-            results.append(self._apply_filter(raw, flt, k))
+            results.append(self._apply_filter(raw[: k * oversample], flt, k))
         return results
 
 

@@ -568,3 +568,32 @@ def test_lsh_index_concurrent_churn():
     for bucket_keys in idx.buckets.values():
         for key in bucket_keys:
             assert key in idx.sig_of_key
+
+
+def test_search_among_batched_matches_per_query():
+    """One-device-call batched candidate rescoring must reproduce the
+    per-query search_among results (both metrics, ragged candidate sets,
+    empty sets included)."""
+    from pathway_tpu.ops import DeviceKnnIndex
+
+    rng = np.random.default_rng(4)
+    for metric in ("cos", "l2sq"):
+        idx = DeviceKnnIndex(dim=12, metric=metric, capacity=128)
+        vs = rng.standard_normal((60, 12)).astype(np.float32)
+        for i, v in enumerate(vs):
+            idx.upsert(i, v)
+        queries = vs[:5] + 0.01
+        cand_lists = [
+            list(range(0, 30)),
+            list(range(25, 60)),
+            [7],
+            [],
+            list(range(0, 60, 3)),
+        ]
+        batched = idx.search_among_batched(queries, cand_lists, 6)
+        for q, cands, got in zip(queries, cand_lists, batched):
+            want = idx.search_among(q, cands, 6)
+            assert [k for k, _ in got] == [k for k, _ in want], (metric, cands)
+            np.testing.assert_allclose(
+                [s for _, s in got], [s for _, s in want], rtol=1e-5
+            )
