@@ -1,0 +1,172 @@
+"""Operator-snapshot checkpoint benchmark: chunked delta plane vs legacy
+whole-state pickling (ISSUE 1 tentpole).
+
+Drives the REAL ``DeduplicateNode`` commit path — ingest ``n_keys``
+instances, then churn ~1% of them per commit — with two snapshot writers:
+
+* legacy ``OperatorSnapshot``: one whole-state pickle per commit,
+  O(state) bytes every time (the pre-chunk behaviour);
+* ``ChunkedOperatorSnapshot``: one delta chunk per commit (O(churn)
+  bytes) with merge compaction bounding stored bytes at O(live state).
+
+Compaction runs synchronously here so per-commit byte counts are
+deterministic; its writes are reported separately (``amortized`` folds
+them back in).  The default 120 commits accumulate >= live delta entries,
+so at least one compaction fires and the stored-bytes bound is exercised.
+A cold restore replays base + deltas and must equal the engine state
+exactly.
+
+Prints ONE JSON line and appends it to
+``benchmarks/checkpoint_results.jsonl``.  CPU-runnable, no model
+downloads: ``JAX_PLATFORMS=cpu python benchmarks/checkpoint_bench.py
+[n_keys] [n_commits]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _make_node(persistent_id):
+    from pathway_tpu.internals.engine import DeduplicateNode
+
+    return DeduplicateNode(
+        instance_fn=lambda key, row: row[0],
+        value_fn=lambda key, row: row[1],
+        acceptor=lambda new, cur: new >= cur,
+        persistent_id=persistent_id,
+    )
+
+
+def run(n_keys: int = 100_000, n_commits: int = 120) -> dict:
+    import numpy as np
+
+    from pathway_tpu.persistence import (
+        ChunkedOperatorSnapshot,
+        FilesystemKV,
+        OperatorSnapshot,
+    )
+
+    churn = max(1, n_keys // 100)  # ~1% of instances touched per commit
+    rng = np.random.default_rng(7)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        kv = FilesystemKV(os.path.join(tmp, "kv"))
+        snap = ChunkedOperatorSnapshot(kv, background=False)
+        node = _make_node("dedup")
+        node._op_snapshot = snap
+
+        # commit 0: initial ingest — the unavoidable O(state) base
+        node.receive(0, [(i, (i, 0), 1) for i in range(n_keys)])
+        node.flush(0)
+        t0 = time.perf_counter()
+        node.end_of_step(0)
+        base_write_s = time.perf_counter() - t0
+        base_bytes = snap.bytes_written
+
+        # steady churn: ~1% of instances get a newer value each commit
+        delta_bytes = []
+        delta_write_s = []
+        seq = n_keys
+        for t in range(1, n_commits + 1):
+            insts = rng.integers(0, n_keys, churn)
+            entries = []
+            for inst in insts:
+                entries.append((seq, (int(inst), t), 1))
+                seq += 1
+            node.receive(0, entries)
+            node.flush(t)
+            before, compactions_before = snap.bytes_written, snap.compactions
+            t0 = time.perf_counter()
+            node.end_of_step(t)
+            if snap.compactions == compactions_before:
+                # pure delta commit; compaction commits fold an O(live)
+                # base write into the count and are reported separately
+                delta_write_s.append(time.perf_counter() - t0)
+                delta_bytes.append(snap.bytes_written - before)
+        total_churn_bytes = snap.bytes_written - base_bytes
+        expected_state = dict(node.state)
+
+        # legacy baseline: identical churn, whole-state pickle per commit
+        legacy_kv = FilesystemKV(os.path.join(tmp, "legacy"))
+        legacy = OperatorSnapshot(legacy_kv)
+        legacy_bytes = []
+        legacy_write_s = []
+        for t in range(1, 6):  # O(state) each commit — 5 samples suffice
+            insts = rng.integers(0, n_keys, churn)
+            for inst in insts:
+                node.state[int(inst)] = (seq, (int(inst), n_commits + t))
+                seq += 1
+            t0 = time.perf_counter()
+            legacy.save("dedup", node.state)
+            legacy_write_s.append(time.perf_counter() - t0)
+            legacy_bytes.append(len(legacy_kv.get("opstate/dedup")))
+
+        # stored-bytes bound after compaction: everything under the pid's
+        # chunk prefix vs one whole-state pickle
+        stored = sum(
+            len(kv.get(k)) for k in kv.list_keys("opstate/dedup/chunk-")
+        )
+        live_pickle = legacy_bytes[-1]
+
+        # cold restore: fresh handle replays base + deltas
+        t0 = time.perf_counter()
+        restored = ChunkedOperatorSnapshot(
+            FilesystemKV(os.path.join(tmp, "kv"))
+        ).load("dedup")
+        restore_s = time.perf_counter() - t0
+        fresh = _make_node("dedup")
+        fresh.restore_snapshot(restored)
+        restore_ok = fresh.state == expected_state
+
+    mean_delta = sum(delta_bytes) / len(delta_bytes)
+    mean_legacy = sum(legacy_bytes) / len(legacy_bytes)
+    return {
+        "metric": "checkpoint",
+        "n_keys": n_keys,
+        "n_commits": n_commits,
+        "churn_per_commit": churn,
+        "base_bytes": base_bytes,
+        "base_write_ms": round(base_write_s * 1000.0, 1),
+        "chunked_bytes_per_commit": round(mean_delta),
+        "chunked_bytes_per_commit_amortized": round(
+            total_churn_bytes / n_commits
+        ),
+        "legacy_bytes_per_commit": round(mean_legacy),
+        "bytes_ratio": round(mean_legacy / mean_delta, 1),
+        "bytes_ratio_amortized": round(
+            mean_legacy / (total_churn_bytes / n_commits), 1
+        ),
+        "chunked_commit_ms": round(
+            1000.0 * sum(delta_write_s) / len(delta_write_s), 2
+        ),
+        "legacy_commit_ms": round(
+            1000.0 * sum(legacy_write_s) / len(legacy_write_s), 2
+        ),
+        "compactions": snap.compactions,
+        "stored_over_live": round(stored / live_pickle, 2),
+        "restore_ms": round(restore_s * 1000.0, 1),
+        "restore_ok": restore_ok,
+    }
+
+
+if __name__ == "__main__":
+    n_keys = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    n_commits = int(sys.argv[2]) if len(sys.argv) > 2 else 120
+    out = run(n_keys, n_commits)
+    out["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    line = json.dumps(out)
+    print(line)
+    with open(os.path.join(HERE, "checkpoint_results.jsonl"), "a") as f:
+        f.write(line + "\n")
+    sys.exit(0 if out.get("restore_ok") else 1)

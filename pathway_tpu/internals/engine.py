@@ -411,6 +411,7 @@ class GroupByNode(Node):
         reducers: Sequence[Any] = (),
         sort_by_fn: Callable[[Pointer, tuple], Any] | None = None,
         name: str = "groupby",
+        persistent_id: str | None = None,
     ):
         super().__init__(n_inputs=1, name=name)
         self.group_fn = group_fn
@@ -440,6 +441,12 @@ class GroupByNode(Node):
         #: reducer args are plain slot projections and every reducer is
         #: vector-safe): ``(group_slots, arg_slots_per_reducer)``
         self.vector_spec = None
+        #: chunked operator-snapshot plane (streaming driver attaches it in
+        #: OPERATOR_PERSISTING mode when a persistent_id is set): dirty
+        #: groups accumulate per finalized time and emit as delta chunks
+        self.persistent_id = persistent_id
+        self._op_snapshot = None
+        self._snap_dirty: set = set()
 
     #: below this batch size numpy conversion overhead beats the win
     VECTOR_MIN_ROWS = 512
@@ -463,6 +470,8 @@ class GroupByNode(Node):
                 dirty = self._ingest_vector(entries)
         if dirty is None:
             dirty = self._ingest_rows(entries)
+        if self.persistent_id and self._op_snapshot is not None:
+            self._snap_dirty |= dirty
         return self._emit(dirty)
 
     def _ingest_vector_parallel(self, entries: list[Entry], pool) -> set | None:
@@ -700,6 +709,60 @@ class GroupByNode(Node):
 
     def _needs_key(self) -> bool:
         return any(getattr(r, "distinguish_by_key", False) for r in self.reducers)
+
+    # -- operator snapshots (reference: operator_snapshot.rs) --
+    def end_of_step(self, time: int) -> None:
+        if not (
+            self._snap_dirty
+            and self._op_snapshot is not None
+            and self.persistent_id
+        ):
+            self._snap_dirty.clear()
+            return
+        upserts: dict = {}
+        deletes: list = []
+        for g in self._snap_dirty:
+            if g in self.state:
+                upserts[g] = (
+                    dict(self.state[g]),
+                    self.red_state.get(g),
+                    self.group_raw.get(g),
+                    self.group_instance.get(g),
+                    self.last_out.get(g),
+                )
+            else:
+                deletes.append(g)
+        self._op_snapshot.save_delta(
+            self.persistent_id,
+            time,
+            upserts,
+            deletes,
+            live_entries=len(self.state),
+        )
+        self._snap_dirty.clear()
+
+    def restore_snapshot(self, snapshot: dict) -> None:
+        """Adopt restored per-group records (state, incremental reducer
+        states, raw group values, instance, last emitted entry); the slot
+        seq counter resumes past every restored slot so seq-sensitive
+        reducers keep a total order across the restart."""
+        max_seq = 0
+        for g, (slots, red, graw, ginst, last) in snapshot.items():
+            self.state[g] = dict(slots)
+            if red is not None:
+                self.red_state[g] = red
+            self.group_raw[g] = graw
+            if ginst is not None:
+                self.group_instance[g] = ginst
+            if last is not None:
+                self.last_out[g] = last
+            for slot in slots.values():
+                max_seq = max(max_seq, slot[4])
+        # past the snapshot AND the live counter: static sources may have
+        # handed out seqs before restore runs, and a duplicate seq would
+        # make seq-tie-broken reducers pick a different winner than the
+        # pre-restart run (gaps are harmless, collisions are not)
+        self._seq = itertools.count(max(max_seq, next(self._seq)) + 1)
 
 
 class JoinNode(Node):
@@ -1069,10 +1132,12 @@ class DeduplicateNode(Node):
         self.acceptor = acceptor
         self.persistent_id = persistent_id
         self.state: dict[Any, tuple[Pointer, tuple]] = {}
-        # operator-snapshot hook attached by the streaming driver when full
-        # persistence is on (reference: persistence/operator_snapshot.rs)
+        # chunked operator-snapshot plane attached by the streaming driver
+        # when full persistence is on (reference: operator_snapshot.rs);
+        # _snap_dirty holds the instance keys touched since the last
+        # finalized time, so a commit writes O(delta), not O(state)
         self._op_snapshot = None
-        self._dirty = False
+        self._snap_dirty: set = set()
 
     def flush(self, time: int) -> list[Entry]:
         out: list[Entry] = []
@@ -1095,14 +1160,30 @@ class DeduplicateNode(Node):
                 if current is not None:
                     out.append((out_key, current[1], -1))
                 self.state[inst] = (key, row)
-                self._dirty = True
+                self._snap_dirty.add(inst)
                 out.append((out_key, row, 1))
         return consolidate(out)
 
     def end_of_step(self, time: int) -> None:
-        if self._dirty and self._op_snapshot is not None and self.persistent_id:
-            self._op_snapshot.save(self.persistent_id, self.state)
-            self._dirty = False
+        if self._snap_dirty and self._op_snapshot is not None and self.persistent_id:
+            upserts = {
+                inst: self.state[inst]
+                for inst in self._snap_dirty
+                if inst in self.state
+            }
+            deletes = [i for i in self._snap_dirty if i not in self.state]
+            self._op_snapshot.save_delta(
+                self.persistent_id,
+                time,
+                upserts,
+                deletes,
+                live_entries=len(self.state),
+            )
+        self._snap_dirty.clear()
+
+    def restore_snapshot(self, state: dict) -> None:
+        """Adopt a restored base+delta state (streaming driver startup)."""
+        self.state = dict(state)
 
 
 class BufferNode(Node):

@@ -159,7 +159,15 @@ class ExchangePlane:
         self._peer_errors: dict[int, str] = {}
 
     # -- wiring --
-    def start(self, timeout: float = 30.0) -> None:
+    def start(self, timeout: float | None = None) -> None:
+        if timeout is None:
+            # overridable for loaded hosts where a peer may take far
+            # longer than 30s just to import its runtime (observed in
+            # full-suite CI: the slow peer's partner timed out here, died
+            # on its daemon thread, and the run hung silently)
+            import os as _os
+
+            timeout = float(_os.environ.get("PATHWAY_CONNECT_TIMEOUT_S", "30"))
         # the wire format's tagged pickle escape hatch means an
         # authenticated frame can execute code: spanning real hosts
         # without a shared secret would leave the port open to anyone who

@@ -58,12 +58,14 @@ class GroupedTable:
         set_id: bool = False,
         sort_by: ColumnExpression | None = None,
         instance: ColumnExpression | None = None,
+        persistent_id: str | None = None,
     ):
         self._table = table
         self._grouping = grouping
         self._set_id = set_id
         self._sort_by = sort_by
         self._instance = instance
+        self._persistent_id = persistent_id
 
     def reduce(self, *args: Any, **kwargs: Any) -> "Table":
         from .table import Table
@@ -115,6 +117,7 @@ class GroupedTable:
                 instance=self._instance,
                 sort_by=self._sort_by,
                 set_id=self._set_id,
+                persistent_id=self._persistent_id,
             ),
         )
         return Table._new(op, schema, Universe())

@@ -97,6 +97,25 @@ class JoinResult:
         # fall back on universe identity
         return "mixed"
 
+    def __getitem__(self, name: str) -> ColumnReference:
+        """Column lookup over both sides, left side winning on name
+        conflicts (the same substitution priority ``_flat`` applies)."""
+        if name == "id" or name in self._left.column_names():
+            return self._left[name]
+        if name in self._right.column_names():
+            return self._right[name]
+        raise KeyError(
+            f"join has no column {name!r}; columns: "
+            f"{sorted(set(self._left.column_names()) | set(self._right.column_names()))}"
+        )
+
+    @property
+    def C(self) -> "_JoinColumnNamespace":
+        """Column accessor on the pending join (reference: Joinable.C,
+        joins.py:106) — ``t.join(u, ...).C.col`` resolves like the
+        sentinels do: the left side wins on name conflicts."""
+        return _JoinColumnNamespace(self)
+
     def select(self, *args: Any, **kwargs: Any) -> "Table":
         from .table import Table
 
@@ -154,6 +173,29 @@ class JoinResult:
         flat = self._flat()
         cond = resolve_expression(condition, flat, flat, flat)
         return flat.filter(cond)
+
+
+class _JoinColumnNamespace:
+    """``join_result.C.<name>`` / ``join_result.C[<name>]`` — mirrors
+    ``table.ColumnNamespace`` (same leading-underscore guard so notebook
+    protocol probes don't resolve as columns; bracket access is the
+    escape hatch)."""
+
+    __slots__ = ("_join",)
+
+    def __init__(self, join: JoinResult):
+        object.__setattr__(self, "_join", join)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self._join[name]
+        except KeyError as exc:
+            raise AttributeError(str(exc)) from None
+
+    def __getitem__(self, name):
+        return self._join[name]
 
 
 def _refers_to(e: ColumnExpression, table: "Table") -> bool:

@@ -497,6 +497,7 @@ class GraphRunner:
             reducers=[r.reducer for r in reducers],
             sort_by_fn=(lambda key, row: sort_fn((key, row))) if sort_fn else None,
             name=f"groupby#{op.id}",
+            persistent_id=op.params.get("persistent_id"),
         )
         # columnar ingest gate: plain column projections (or scalar
         # constants, e.g. count()'s Const(0) placeholder arg) throughout,

@@ -222,9 +222,16 @@ class Table:
         id: ColumnReference | None = None,
         sort_by: Any = None,
         instance: Any = None,
+        persistent_id: str | None = None,
         **kwargs,
     ) -> GroupedTable:
         """Group rows for ``.reduce`` (reference: table.py:942).
+
+        ``persistent_id`` opts the reduction's state into the chunked
+        operator-snapshot plane: under
+        ``PersistenceMode.OPERATOR_PERSISTING`` the group state
+        checkpoints as per-commit deltas and restores on restart
+        (``pw.persistence`` module docstring documents the format).
 
         Example:
 
@@ -253,6 +260,7 @@ class Table:
             set_id=set_id,
             sort_by=resolve_expression(sort_by, self) if sort_by is not None else None,
             instance=resolve_expression(instance, self) if instance is not None else None,
+            persistent_id=persistent_id,
         )
 
     def reduce(self, *args: Any, **kwargs: Any) -> "Table":

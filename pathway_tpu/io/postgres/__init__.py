@@ -3,7 +3,11 @@
 reference: python/pathway/io/postgres over the Rust ``PsqlWriter``
 (src/connectors/data_storage.rs:1080) — ``write`` appends the diff stream
 with time/diff columns, ``write_snapshot`` maintains the latest row per
-primary key.  Needs ``psycopg2`` (or psycopg) at call time.
+primary key.  Rows buffer through the shared ``io/_buffered.py`` sink (as
+the ES/BigQuery sinks do) and flush with ``executemany`` at every commit
+tick or once ``max_batch_size`` rows accumulate — not one round trip per
+row.  Needs ``psycopg2`` (or psycopg) at call time; pass ``connection=``
+to inject one (tests, pools).
 """
 
 from __future__ import annotations
@@ -11,9 +15,11 @@ from __future__ import annotations
 from typing import Any
 
 from ...internals.table import Table
-from .._subscribe import subscribe
+from .._buffered import buffered_subscribe
 
 __all__ = ["write", "write_snapshot"]
+
+_DEFAULT_BATCH = 512
 
 
 def _connect(postgres_settings: dict):
@@ -24,45 +30,98 @@ def _connect(postgres_settings: dict):
     return pg.connect(**postgres_settings)
 
 
-def write(table: Table, postgres_settings: dict, table_name: str, *, max_batch_size: int | None = None) -> None:
-    con = _connect(postgres_settings)
-    con.autocommit = True
+def _flush_statement_runs(con, batch: list[dict]) -> None:
+    """executemany per run of consecutive identical statements, preserving
+    the callback order (an upsert and the delete that follows it must not
+    be reordered across the batch).  The whole batch is ONE transaction:
+    the buffered sink retries a failed flush from the top, so a partial
+    commit would duplicate the already-landed rows — rollback makes the
+    retry all-or-nothing."""
+    cur = con.cursor()
+    try:
+        run_sql: str | None = None
+        run_params: list[list] = []
+        for doc in batch:
+            if doc["sql"] != run_sql and run_params:
+                cur.executemany(run_sql, run_params)
+                run_params = []
+            run_sql = doc["sql"]
+            run_params.append(doc["params"])
+        if run_params:
+            cur.executemany(run_sql, run_params)
+    except Exception:
+        con.rollback()
+        raise
+    else:
+        con.commit()
+    finally:
+        cur.close()
+
+
+def write(
+    table: Table,
+    postgres_settings: dict,
+    table_name: str,
+    *,
+    max_batch_size: int | None = None,
+    connection: Any = None,
+) -> None:
+    con = connection if connection is not None else _connect(postgres_settings)
+    con.autocommit = False  # one transaction per flushed batch
     names = table.column_names()
     cols = ", ".join(names + ["time", "diff"])
     ph = ", ".join(["%s"] * (len(names) + 2))
+    insert_sql = f"INSERT INTO {table_name} ({cols}) VALUES ({ph})"
 
-    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
-        with con.cursor() as cur:
-            cur.execute(
-                f"INSERT INTO {table_name} ({cols}) VALUES ({ph})",
-                [row[n] for n in names] + [time, 1 if is_addition else -1],
-            )
+    def doc_fn(key, row: dict, time: int, is_addition: bool) -> dict:
+        return {
+            "sql": insert_sql,
+            "params": [row[n] for n in names] + [time, 1 if is_addition else -1],
+        }
 
-    subscribe(table, on_change=on_change, on_end=con.close, name=f"psql:{table_name}")
+    buffered_subscribe(
+        table,
+        lambda batch: _flush_statement_runs(con, batch),
+        name=f"psql:{table_name}",
+        max_batch=max_batch_size or _DEFAULT_BATCH,
+        on_close=con.close,
+        doc_fn=doc_fn,
+    )
 
 
-def write_snapshot(table: Table, postgres_settings: dict, table_name: str, primary_key: list[str], *, max_batch_size: int | None = None) -> None:
-    con = _connect(postgres_settings)
-    con.autocommit = True
+def write_snapshot(
+    table: Table,
+    postgres_settings: dict,
+    table_name: str,
+    primary_key: list[str],
+    *,
+    max_batch_size: int | None = None,
+    connection: Any = None,
+) -> None:
+    con = connection if connection is not None else _connect(postgres_settings)
+    con.autocommit = False  # one transaction per flushed batch
     names = table.column_names()
     cols = ", ".join(names)
     ph = ", ".join(["%s"] * len(names))
     conflict = ", ".join(primary_key)
     updates = ", ".join(f"{n} = EXCLUDED.{n}" for n in names if n not in primary_key)
     where = " AND ".join(f"{k} = %s" for k in primary_key)
+    upsert_sql = (
+        f"INSERT INTO {table_name} ({cols}) VALUES ({ph}) "
+        f"ON CONFLICT ({conflict}) DO UPDATE SET {updates}"
+    )
+    delete_sql = f"DELETE FROM {table_name} WHERE {where}"
 
-    def on_change(key, row: dict, time: int, is_addition: bool) -> None:
-        with con.cursor() as cur:
-            if is_addition:
-                cur.execute(
-                    f"INSERT INTO {table_name} ({cols}) VALUES ({ph}) "
-                    f"ON CONFLICT ({conflict}) DO UPDATE SET {updates}",
-                    [row[n] for n in names],
-                )
-            else:
-                cur.execute(
-                    f"DELETE FROM {table_name} WHERE {where}",
-                    [row[k] for k in primary_key],
-                )
+    def doc_fn(key, row: dict, time: int, is_addition: bool) -> dict:
+        if is_addition:
+            return {"sql": upsert_sql, "params": [row[n] for n in names]}
+        return {"sql": delete_sql, "params": [row[k] for k in primary_key]}
 
-    subscribe(table, on_change=on_change, on_end=con.close, name=f"psql:{table_name}")
+    buffered_subscribe(
+        table,
+        lambda batch: _flush_statement_runs(con, batch),
+        name=f"psql:{table_name}",
+        max_batch=max_batch_size or _DEFAULT_BATCH,
+        on_close=con.close,
+        doc_fn=doc_fn,
+    )

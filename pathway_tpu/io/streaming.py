@@ -83,6 +83,21 @@ class ConnectorSubject:
         self._primary_key: list[str] | None = None
         self._last_by_key: dict[Any, tuple] = {}
         self._data_event: threading.Event | None = None
+        # offset frontier snapshotted atomically with commit()/_drain():
+        # the persisted frontier must cover EXACTLY the drained entries —
+        # reading current_offsets() on the driver thread after _drain()
+        # would race the reader (an entry committed in between would be
+        # covered by the frontier but missing from the batch, i.e. lost
+        # on restart)
+        self._offsets_at_commit: Any = None
+        self._offsets_at_drain: Any = None
+        #: total commit() calls — the driver uses this to detect a
+        #: tracking subject that never self-commits (see _live_loop)
+        self._commit_count = 0
+        #: set by the driver when persistence storage is configured —
+        #: without it the frontier snapshot in commit() is never consumed,
+        #: so the (possibly large) current_offsets() copy is skipped
+        self._record_offsets = False
 
     # -- to be implemented by subclasses --
     def run(self) -> None:
@@ -130,6 +145,16 @@ class ConnectorSubject:
             if self._pending:
                 self._committed.append(self._pending)
                 self._pending = []
+            # every connector updates its offsets before its own commit()
+            # (fs: _seen per emitted file; kafka: per consumed message),
+            # so this snapshot is exactly the frontier of the batches
+            # committed so far.  Skipped without persistence: nobody
+            # consumes it, and for fs it copies the whole _seen dict —
+            # which a driver-thread autocommit could also race mid-resize
+            # (tracking subjects only self-commit once persistence is on)
+            if self._record_offsets:
+                self._offsets_at_commit = self.current_offsets()
+            self._commit_count += 1
         if self._data_event is not None:
             self._data_event.set()
 
@@ -194,6 +219,9 @@ class ConnectorSubject:
         """Convert committed batches to engine entries (upsert-aware)."""
         with self._lock:
             batches, self._committed = self._committed, []
+            # pair the batch with the frontier of its last commit — a
+            # commit landing after this point belongs to the NEXT drain
+            self._offsets_at_drain = self._offsets_at_commit
         entries: list[Entry] = []
         for batch in batches:
             for op, key, values in batch:
@@ -264,6 +292,9 @@ class StreamingDriver:
                 name_counts[subject._datasource_name] = n + 1
                 self._pid_occurrence[id(subject)] = n
         self._snapshot_writers: dict[int, Any] = {}
+        #: OPERATOR_PERSISTING: subject-id -> (pid, subject), offsets ride
+        #: the per-tick commit record instead of input snapshot chunks
+        self._commit_subjects: dict[int, tuple] = {}
         self._op_snapshot = None
 
     def _snapshot_storage(self):
@@ -280,6 +311,21 @@ class StreamingDriver:
             return cfg.backend.storage
         return None
 
+    @property
+    def _operator_mode(self) -> bool:
+        """OPERATOR_PERSISTING: stateful-operator state recovers from the
+        chunked snapshot plane (O(delta) per commit); input entries are
+        never logged — a single post-step commit record (``commit/record``)
+        carries the finalized time + offset frontier, and restart seeks
+        rather than replays (replaying on top of restored operator state
+        would double every record)."""
+        cfg = self.persistence_config
+        if cfg is None:
+            return False
+        from ..persistence import PersistenceMode
+
+        return cfg.persistence_mode is PersistenceMode.OPERATOR_PERSISTING
+
     def _setup_persistence(self, t: int, step: bool = True) -> int:
         """Replay input snapshots, seek subjects, restore operator state
         (reference: Entry::{Snapshot,RewindFinishSentinel} replay,
@@ -290,12 +336,21 @@ class StreamingDriver:
         if storage is None:
             return t
         from ..persistence import (
+            ChunkedOperatorSnapshot,
             InputSnapshotReader,
             InputSnapshotWriter,
-            OperatorSnapshot,
         )
 
-        self._op_snapshot = OperatorSnapshot(storage)
+        self._op_snapshot = ChunkedOperatorSnapshot(storage)
+        operator_mode = self._operator_mode
+        commit_rec = None
+        if operator_mode:
+            self._check_operator_mode_coverage()
+            raw = storage.get(self._commit_record_key())
+            if raw is not None:
+                import pickle as _pickle
+
+                commit_rec = _pickle.loads(raw)
         pushed = False
         for subject, src in self.subject_src:
             # Opt-in contract (reference: persistent_id on connectors):
@@ -314,6 +369,26 @@ class StreamingDriver:
             # snapshots, src/persistence/input_snapshot.rs:56-283)
             if self.exchange_plane is not None:
                 pid = f"{pid}-p{self.exchange_plane.me}"
+            # this subject's commit() frontier now has a consumer (input
+            # snapshot chunks or the per-tick commit record)
+            subject._record_offsets = True
+            if operator_mode:
+                # offsets live in the per-tick commit record, written
+                # AFTER the operator deltas are durable — entries are
+                # never logged, so there is nothing to replay
+                self._commit_subjects[id(subject)] = (pid, subject)
+                if commit_rec is not None:
+                    offsets = commit_rec["offsets"].get(pid)
+                    if offsets is not None:
+                        subject.seek(offsets)
+                        # seed the drain frontier: the next commit record
+                        # must carry this restored position forward, not
+                        # clobber it with None before the subject's first
+                        # own commit (a crash in that window would lose
+                        # the frontier and double-apply the whole source)
+                        subject._offsets_at_commit = offsets
+                        subject._offsets_at_drain = offsets
+                continue
             reader = InputSnapshotReader(storage, pid)
             replayed: list[Entry] = []
             for entries in reader.replay():
@@ -326,18 +401,154 @@ class StreamingDriver:
                 subject.seek(offsets)
             self._snapshot_writers[id(subject)] = InputSnapshotWriter(storage, pid)
         # restore stateful-operator snapshots before any replayed data flows
-        from ..internals.engine import DeduplicateNode
+        from ..internals.engine import DeduplicateNode, GroupByNode
 
+        committed_t = commit_rec["time"] if commit_rec is not None else 0
+        restored_t = 0
         for node in self.engine.nodes:
-            if isinstance(node, DeduplicateNode) and node.persistent_id:
-                state = self._op_snapshot.load(node.persistent_id)
+            if isinstance(node, (DeduplicateNode, GroupByNode)) and node.persistent_id:
+                if isinstance(node, GroupByNode) and not operator_mode:
+                    # groupby state is rebuilt by input replay in
+                    # PERSISTING mode; only OPERATOR_PERSISTING restores
+                    # (and writes) it through the snapshot plane
+                    continue
+                if self.exchange_plane is not None and not node.persistent_id.endswith(
+                    f"-p{self.exchange_plane.me}"
+                ):
+                    # per-process keyspace, same as the input snapshots
+                    node.persistent_id = (
+                        f"{node.persistent_id}-p{self.exchange_plane.me}"
+                    )
+                # single scan: drops a crashed run's uncommitted tail (its
+                # input offsets were never recorded, so the batch re-reads
+                # and would double-apply on top of orphaned chunks) and
+                # replays base+deltas in one pass over the store
+                state, last_t = self._op_snapshot.restore(
+                    node.persistent_id,
+                    committed_time=committed_t if operator_mode else None,
+                )
                 if state is not None:
-                    node.state = state
+                    node.restore_snapshot(state)
+                restored_t = max(restored_t, last_t)
                 node._op_snapshot = self._op_snapshot
+        if operator_mode and commit_rec is not None:
+            self._op_snapshot.mark_committed(committed_t)
+            t = max(t, committed_t + 1)
+        # EVERY mode: resume engine time past the newest restored delta —
+        # chunk replay orders deltas by finalized time, so a fresh run
+        # re-using earlier times (engine times restart from 1) would make
+        # a stale previous-run delta win on the next restore
+        t = max(t, restored_t + 1)
         if pushed and step:
             self.engine.step(t)
             t += 1
         return t
+
+    def _commit_record_key(self) -> str:
+        if self.exchange_plane is not None:
+            return f"commit/record-p{self.exchange_plane.me}"
+        return "commit/record"
+
+    def _check_operator_mode_coverage(self) -> None:
+        """OPERATOR_PERSISTING replays no input entries, so every stateful
+        node must recover from the snapshot plane — refuse the mode when
+        the graph holds stateful nodes it does not cover, instead of
+        silently restarting them empty."""
+        from ..internals.engine import (
+            AsyncMapNode,
+            BufferNode,
+            DeduplicateNode,
+            GroupByNode,
+            JoinNode,
+            RowwiseNode,
+            SemiJoinNode,
+            UpdateCellsNode,
+            UpdateRowsNode,
+            ZipNode,
+        )
+        from ..stdlib.indexing.lowering import ExternalIndexNode, SortNode
+
+        if self.exchange_plane is not None:
+            raise RuntimeError(
+                "PersistenceMode.OPERATOR_PERSISTING is not supported in "
+                "multi-process runs yet — the pipelined exchange completes "
+                "rounds out of band, so there is no single point to record "
+                "the committed offset frontier. Use "
+                "PersistenceMode.PERSISTING (input replay) instead."
+            )
+        # sources too: a subject that opts out of persistence re-produces
+        # every row from scratch on restart — harmless under input replay
+        # (the state is rebuilt from the same rows), but on top of RESTORED
+        # operator state it double-applies everything
+        unseekable = []
+        for subject, _src in self.subject_src:
+            pid = subject.effective_persistent_id(
+                self._pid_occurrence.get(id(subject))
+            )
+            # an explicit persistent_id does NOT make a source seekable —
+            # without offset tracking there is no frontier to seek to, and
+            # run() re-produces every row on top of RESTORED operator state
+            if pid is None or not subject._tracks_offsets():
+                unseekable.append(subject._datasource_name)
+        if unseekable:
+            raise RuntimeError(
+                "PersistenceMode.OPERATOR_PERSISTING restores operator "
+                "state without replaying inputs, so every source must be "
+                "seekable; these are not: "
+                f"{', '.join(sorted(unseekable))}. Give them a "
+                "persistent_id (and offset tracking), or use "
+                "PersistenceMode.PERSISTING."
+            )
+        uncovered = []
+        for node in self.engine.nodes:
+            if isinstance(node, (DeduplicateNode, GroupByNode)):
+                if not node.persistent_id:
+                    uncovered.append(f"{node.name} (no persistent_id)")
+            elif isinstance(
+                node,
+                # every node whose flush() folds input into cross-step
+                # state: restarting it empty on top of restored downstream
+                # state silently corrupts results (missing retractions,
+                # empty indexes, unpaired non-deterministic recomputes)
+                (JoinNode, BufferNode, ZipNode, UpdateRowsNode,
+                 UpdateCellsNode, SemiJoinNode, AsyncMapNode,
+                 ExternalIndexNode, SortNode),
+            ):
+                uncovered.append(node.name)
+            elif isinstance(node, RowwiseNode) and node.memoize:
+                # memoized maps exist precisely because the fn is
+                # non-deterministic: an empty memo after restart would
+                # recompute a different row for a retraction and unpair it
+                uncovered.append(f"{node.name} (memoized non-deterministic map)")
+        if uncovered:
+            raise RuntimeError(
+                "PersistenceMode.OPERATOR_PERSISTING cannot recover these "
+                f"stateful operators: {', '.join(sorted(uncovered))}. Give "
+                "groupby/deduplicate operators a persistent_id, or use "
+                "PersistenceMode.PERSISTING (input replay covers every "
+                "operator)."
+            )
+
+    def _write_commit_record(self, t: int) -> None:
+        """Durably record the finalized time and every subject's offset
+        frontier — AFTER the tick's operator deltas are on disk.  A crash
+        before this write replays the batch against truncated chunks
+        (exactly-once); writing offsets first instead would drop the
+        batch entirely."""
+        storage = self._snapshot_storage()
+        if storage is None or not self._commit_subjects:
+            return
+        import pickle as _pickle
+
+        offsets = {
+            pid: subject._offsets_at_drain
+            for pid, subject in self._commit_subjects.values()
+        }
+        storage.put(
+            self._commit_record_key(),
+            _pickle.dumps({"time": t, "offsets": offsets}),
+        )
+        self._op_snapshot.mark_committed(t)
 
     def run(self) -> None:
         if self.exchange_plane is not None:
@@ -367,12 +578,47 @@ class StreamingDriver:
         self.engine.finish()
 
     def _live_loop(self, data_event, t, last_autocommit) -> None:
+        loop_start = _time.monotonic()
+        warned_stalled: set[int] = set()
         while True:
             data_event.wait(timeout=self.autocommit_ms / 1000.0)
             data_event.clear()
             now = _time.monotonic()
+            persisting = self._snapshot_storage() is not None
             for subject, _src in self.subject_src:
                 ac = subject._autocommit_ms
+                # under persistence, offset-tracking subjects commit on
+                # their own reader thread at consistent boundaries (fs: end
+                # of scan, kafka: per message); a driver-thread commit could
+                # snapshot a mid-unit frontier that pairs rows already in
+                # the batch with an offset that re-reads them on restart.
+                # Without persistence no frontier is recorded, so driver
+                # autocommit stays on (external ConnectorSubject subclasses
+                # may override current_offsets yet rely on it)
+                if persisting and subject._tracks_offsets():
+                    # a tracking subject that NEVER self-commits would
+                    # stall silently here — surface it once, loudly
+                    if (
+                        ac is not None
+                        and subject._commit_count == 0
+                        and id(subject) not in warned_stalled
+                        and (now - loop_start) * 1000 >= 20 * max(ac, 1500)
+                    ):
+                        warned_stalled.add(id(subject))
+                        import warnings
+
+                        warnings.warn(
+                            f"connector {subject._datasource_name!r} tracks "
+                            "offsets but has not committed once: under "
+                            "persistence the driver never autocommits "
+                            "offset-tracking subjects (a driver-paced "
+                            "frontier could re-read committed rows after "
+                            "restart) — call self.commit() from the "
+                            "connector at consistent source boundaries",
+                            RuntimeWarning,
+                            stacklevel=1,
+                        )
+                    continue
                 if ac is not None and (now - last_autocommit[id(subject)]) * 1000 >= ac:
                     subject.commit()
                     last_autocommit[id(subject)] = now
@@ -389,12 +635,14 @@ class StreamingDriver:
             self._record_finished_connectors()
             if pushed:
                 self.engine.step(t)
+                self._write_commit_record(t)
                 t += 1
                 continue
             if self.engine.has_async_ready():
                 # a pipelined async batch resolved while sources are idle:
                 # step once so its results emit now, not at the next input
                 self.engine.step(t)
+                self._write_commit_record(t)
                 t += 1
                 continue
             if all(s._closed.is_set() for s, _ in self.subject_src):
@@ -408,13 +656,19 @@ class StreamingDriver:
                         pushed = True
                 if pushed:
                     self.engine.step(t)
+                    self._write_commit_record(t)
                     t += 1
                 break
 
     def _write_snapshot(self, subject: ConnectorSubject, entries: list[Entry]) -> None:
+        # OPERATOR_PERSISTING never registers writers: its offsets are
+        # recorded post-step by _write_commit_record, and entries are
+        # never logged (operator deltas carry the state)
         writer = self._snapshot_writers.get(id(subject))
         if writer is not None:
-            writer.write_batch(entries, subject.current_offsets())
+            # the drain-time frontier, not current_offsets(): the reader
+            # may already have committed entries this batch doesn't hold
+            writer.write_batch(entries, subject._offsets_at_drain)
 
     # -- per-connector progress (reference: connectors/monitoring.rs) --
     def _connector_label(self, subject: ConnectorSubject) -> str:
@@ -537,8 +791,14 @@ class StreamingDriver:
             nonlocal t_next
             t = t_next
             had_data = False
+            persisting = self._snapshot_storage() is not None
             for subject, _src in self.subject_src:
-                if subject._autocommit_ms is not None:
+                # under persistence, tracking subjects self-commit at
+                # consistent boundaries (see _live_loop) — a driver commit
+                # could pair a batch with a mid-unit offset frontier
+                if subject._autocommit_ms is not None and not (
+                    persisting and subject._tracks_offsets()
+                ):
                     subject.commit()
             # read the closed flags BEFORE draining: close() commits its
             # final rows first, so a True flag means this round's drain

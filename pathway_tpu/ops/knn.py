@@ -251,7 +251,7 @@ class DeviceKnnIndex:
     _AMONG_GATHER_ELEMS = 32 * 1024 * 1024
 
     def _search_among_batched_locked(self, queries, keys_lists, k):
-        from .topk import among_topk_search
+        from .topk import among_topk_search, bucket_k
 
         self._apply_staged()
         slot_lists = [
@@ -283,17 +283,21 @@ class DeviceKnnIndex:
             if self.metric == "cos":
                 norms = np.linalg.norm(q, axis=1, keepdims=True)
                 np.divide(q, norms, out=q, where=norms > 0)
+            # bucket k like q/c: heterogeneous serving k values must not
+            # each compile a fresh kernel — top_k rows come back sorted,
+            # so slicing recovers the exact k-result (ADVICE #2)
+            k_eff = min(k, c_b)
             scores, sub_idx = among_topk_search(
                 jnp.asarray(q, dtype=self.dtype),
                 self.vectors,
                 self.valid,
                 jnp.asarray(idx),
                 jnp.asarray(pad_valid),
-                min(k, c_b),
+                bucket_k(k_eff, c_b),
                 self.metric,
             )
-            scores = np.asarray(scores)
-            sub_idx = np.asarray(sub_idx)
+            scores = np.asarray(scores)[:, :k_eff]
+            sub_idx = np.asarray(sub_idx)[:, :k_eff]
             for i in range(len(chunk)):
                 row: list[tuple[Hashable, float]] = []
                 for s, j in zip(scores[i], sub_idx[i]):

@@ -20,9 +20,21 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["masked_topk_scores", "topk_search", "pallas_masked_scores"]
+__all__ = ["masked_topk_scores", "topk_search", "pallas_masked_scores", "bucket_k"]
 
 NEG_INF = -jnp.inf
+
+
+def bucket_k(k: int, cap: int) -> int:
+    """Round ``k`` up to the next power of two, clamped to ``cap``.
+
+    ``k`` is a static argument of the jitted top-k searches, so every
+    distinct serving ``k`` would otherwise trigger a fresh XLA compile;
+    bucketing it the same way the query/candidate dims are bucketed keeps
+    compiled shapes stable — callers slice the returned (sorted) rows
+    back down to the requested ``k``."""
+    k = max(1, k)
+    return min(cap, 1 << (k - 1).bit_length())
 
 
 def _scores(queries: jax.Array, vectors: jax.Array, metric: str) -> jax.Array:

@@ -18,6 +18,31 @@ restored from its own device-array dump).  Three cooperating pieces:
   src/connectors/mod.rs:100-104);
 * UDF caching: ``PersistenceMode.UDF_CACHING`` routes ``DefaultCache``
   through the configured backend (reference: vector_store.py:564-567).
+* operator snapshots: stateful operators (deduplicate, persistent
+  groupby state) checkpoint through :class:`ChunkedOperatorSnapshot` —
+  per-commit **delta chunks** with background merge compaction
+  (reference: operator_snapshot.rs:21-37 chunked writes keyed by
+  finalized time, compaction at :337).
+
+Chunked operator-snapshot on-disk format (format version >= 2)::
+
+    opstate/{pid}/chunk-NNNNNNNN   (NNNNNNNN = zero-padded decimal seq)
+
+Each chunk is a pickled dict.  Delta chunks are
+``{"kind": "delta", "time": t, "upserts": {k: v}, "deletes": [k, ...]}``
+— the net state-key changes of one finalized engine timestamp, so a
+commit costs O(changed keys), not O(state).  Compaction merges the run
+of chunks into one ``{"kind": "base", "time": t, "state": {...}}`` chunk
+written at the *next* sequence number, then removes the merged chunks;
+because the base is written before anything is deleted, a crash at any
+point leaves a readable store.  Restore replays base + later deltas in
+sequence order.
+
+Migration: the pre-chunk format stored one pickled blob of the whole
+state at ``opstate/{pid}`` (see :class:`OperatorSnapshot`, kept as the
+legacy writer).  :meth:`ChunkedOperatorSnapshot.load` treats such a blob
+as the implicit base below every chunk, so old stores restore unchanged;
+the first compaction folds the blob into a base chunk and removes it.
 """
 
 from __future__ import annotations
@@ -30,7 +55,14 @@ import pickle
 import threading
 from typing import Any, Iterable
 
-__all__ = ["Backend", "Config", "PersistenceMode", "KVStorage"]
+__all__ = [
+    "Backend",
+    "Config",
+    "PersistenceMode",
+    "KVStorage",
+    "ChunkedOperatorSnapshot",
+    "OperatorSnapshot",
+]
 
 
 class PersistenceMode(enum.Enum):
@@ -67,6 +99,44 @@ class FilesystemKV(KVStorage):
     def __init__(self, root: str):
         self.root = os.fspath(root)
         os.makedirs(self.root, exist_ok=True)
+        self._sweep_stale_tmp()
+
+    #: tmp files younger than this are never touched — cheap first
+    #: filter before the pid-liveness check
+    _TMP_STALE_S = 60.0
+
+    def _sweep_stale_tmp(self) -> None:
+        """Remove orphaned ``*.tmp`` files left by writers that died
+        between write and ``os.replace`` (a sudden kill mid-``put``).
+        Age alone is not proof of death — under heavy load a live writer
+        can stall arbitrarily long mid-``put``, and deleting its tmp
+        would make its ``os.replace`` die silently — so a file is only
+        swept when the pid embedded in its name (``{key}.{pid}-{tid}.tmp``)
+        is no longer alive on this host.  Unparseable names (the old
+        fixed ``.tmp`` suffix) sweep on age alone."""
+        import time as _t
+
+        cutoff = _t.time() - self._TMP_STALE_S
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".tmp"):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                if os.path.getmtime(path) >= cutoff:
+                    continue
+                pid = int(name[: -len(".tmp")].rsplit(".", 1)[-1].split("-", 1)[0])
+                os.kill(pid, 0)  # raises ProcessLookupError if dead
+            except (ValueError, ProcessLookupError):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass  # concurrent sweep — fine
+            except OSError:
+                pass  # writer alive (or liveness unknowable): leave it
 
     @staticmethod
     def _escape(key: str) -> str:
@@ -92,7 +162,12 @@ class FilesystemKV(KVStorage):
 
     def put(self, key: str, value: bytes) -> None:
         path = self._path(key)
-        tmp = path + ".tmp"
+        # unique tmp name per writer: two processes putting the same key
+        # concurrently (e.g. both stamping format/version on a fresh
+        # store at startup) must not race on one shared tmp file — with a
+        # fixed name the loser's os.replace throws FileNotFoundError
+        # after the winner's replace consumed the tmp
+        tmp = f"{path}.{os.getpid()}-{threading.get_ident()}.tmp"
         with open(tmp, "wb") as f:
             f.write(value)
         os.replace(tmp, path)
@@ -482,7 +557,10 @@ class InputSnapshotReader:
 
 
 class OperatorSnapshot:
-    """State dump for stateful operators keyed by persistent_id."""
+    """Legacy whole-state dump for stateful operators keyed by
+    persistent_id (single pickled blob per save — O(state) bytes per
+    commit).  Kept for migration: :class:`ChunkedOperatorSnapshot.load`
+    reads blobs written by this writer."""
 
     def __init__(self, storage: KVStorage):
         self.storage = storage
@@ -493,3 +571,334 @@ class OperatorSnapshot:
     def load(self, persistent_id: str) -> Any:
         data = self.storage.get(f"opstate/{persistent_id}")
         return pickle.loads(data) if data else None
+
+
+class ChunkedOperatorSnapshot:
+    """Incremental operator-state plane: per-commit delta chunks +
+    merge compaction (module docstring documents the on-disk format;
+    reference: persistence/operator_snapshot.rs:21-37, compaction :337).
+
+    Writers call :meth:`save_delta` once per finalized timestamp with the
+    net upserted/deleted state keys — O(delta) bytes per commit instead
+    of the O(state) the legacy :class:`OperatorSnapshot` paid.  Once the
+    delta entries written since the last base exceed the live state size
+    (the same amortization argument as ``DeviceKnnIndex._maybe_compact``:
+    a compaction writes O(live) entries and is charged to the >= live
+    delta entries that made it necessary), the chunk run is merged into
+    one base chunk — total stored bytes stay O(live state).  Compaction
+    runs on a background thread by default so the engine's commit path
+    never blocks on the merge.
+    """
+
+    #: compact when delta entries since the last base exceed this
+    #: multiple of the live entry count (1.0 == dead fraction ~50%)
+    COMPACT_DEAD_RATIO = 1.0
+    #: never compact a run shorter than this many chunks (a tiny state
+    #: would otherwise compact on every commit)
+    MIN_COMPACT_CHUNKS = 4
+
+    def __init__(self, storage: KVStorage, *, background: bool = True):
+        self.storage = storage
+        self.background = background
+        self._master = threading.Lock()
+        # pid -> [next_seq, delta_entries_since_base, compaction_inflight,
+        #         delta_chunks_since_base]
+        self._meta: dict[str, list] = {}
+        # per-pid reentrant lock guarding sequence assignment and meta;
+        # the merge itself runs OUTSIDE it (chunks are immutable and the
+        # base's sequence number is reserved up front), so a commit's
+        # save_delta never blocks on an in-flight O(state) merge
+        self._pid_locks: dict[str, threading.RLock] = {}
+        #: only chunks at or below this finalized time may be folded by
+        #: compaction (None = no bound).  The streaming driver advances it
+        #: after each durable commit record so a crash can still truncate
+        #: the uncommitted tail (``truncate_after``) without a base having
+        #: swallowed it.
+        self._committed_time: int | None = None
+        #: write-side counters (surfaced by benchmarks/checkpoint_bench.py)
+        self.bytes_written = 0
+        self.chunks_written = 0
+        self.compactions = 0
+        self._compact_threads: list[threading.Thread] = []
+
+    def mark_committed(self, time: int) -> None:
+        """Advance the compaction bound: chunks up to ``time`` are covered
+        by a durable commit record and safe to fold into a base."""
+        with self._master:
+            if self._committed_time is None or time > self._committed_time:
+                self._committed_time = time
+
+    def _prefix(self, pid: str) -> str:
+        return f"opstate/{pid}/chunk-"
+
+    def _pid_lock(self, pid: str) -> threading.RLock:
+        with self._master:
+            lock = self._pid_locks.get(pid)
+            if lock is None:
+                lock = self._pid_locks[pid] = threading.RLock()
+            return lock
+
+    def _meta_for(self, pid: str) -> list:
+        meta = self._meta.get(pid)
+        if meta is None:
+            existing = self.storage.list_keys(self._prefix(pid))
+            nxt = (
+                max(int(k.rsplit("-", 1)[1]) for k in existing) + 1
+                if existing
+                else 0
+            )
+            # entries-since-base is unknown for a pre-existing store; the
+            # chunk count stands in (conservative: compacts sooner)
+            meta = self._meta[pid] = [nxt, 0, False, len(existing)]
+        return meta
+
+    def _put_chunk(self, pid: str, payload: bytes) -> None:
+        # caller holds the pid lock
+        meta = self._meta_for(pid)
+        seq = meta[0]
+        meta[0] += 1
+        self.storage.put(f"{self._prefix(pid)}{seq:08d}", payload)
+        with self._master:
+            self.bytes_written += len(payload)
+            self.chunks_written += 1
+
+    def save_delta(
+        self,
+        persistent_id: str,
+        time: int,
+        upserts: dict,
+        deletes: Iterable = (),
+        *,
+        live_entries: int | None = None,
+    ) -> None:
+        """Append one finalized-time delta chunk; may schedule compaction."""
+        deletes = list(deletes)
+        if not upserts and not deletes:
+            return
+        payload = pickle.dumps(
+            {"kind": "delta", "time": time, "upserts": upserts, "deletes": deletes}
+        )
+        want_compact = False
+        with self._pid_lock(persistent_id):
+            meta = self._meta_for(persistent_id)
+            self._put_chunk(persistent_id, payload)
+            meta[1] += len(upserts) + len(deletes)
+            meta[3] += 1
+            # both floors must clear: enough dead entries to amortize the
+            # O(live) base write, AND a run of at least MIN_COMPACT_CHUNKS
+            # chunks (a tiny state would otherwise compact every commit)
+            if (
+                not meta[2]
+                and live_entries is not None
+                and meta[3] >= self.MIN_COMPACT_CHUNKS
+                and meta[1] >= int(self.COMPACT_DEAD_RATIO * live_entries)
+            ):
+                meta[2] = True
+                want_compact = True
+        if want_compact:
+            if self.background:
+                th = threading.Thread(
+                    target=self._compact_guarded,
+                    args=(persistent_id,),
+                    daemon=True,
+                    name="pw-snapshot-compact",
+                )
+                th.start()
+                with self._master:
+                    self._compact_threads = [
+                        t for t in self._compact_threads if t.is_alive()
+                    ] + [th]
+            else:
+                self._compact_guarded(persistent_id)
+
+    def save_base(self, persistent_id: str, time: int, state: dict) -> None:
+        """Write the full state as one base chunk (first save of a fresh
+        run, or a compaction result)."""
+        payload = pickle.dumps({"kind": "base", "time": time, "state": state})
+        with self._pid_lock(persistent_id):
+            self._put_chunk(persistent_id, payload)
+            meta = self._meta_for(persistent_id)
+            meta[1] = 0
+            meta[3] = 0
+
+    def wait_compactions(self, timeout: float = 10.0) -> None:
+        """Join in-flight background merges (tests / orderly shutdown)."""
+        with self._master:
+            threads = list(self._compact_threads)
+        for th in threads:
+            th.join(timeout=timeout)
+
+    def _compact_guarded(self, pid: str) -> None:
+        try:
+            self.compact_now(pid)
+        finally:
+            with self._pid_lock(pid):
+                self._meta_for(pid)[2] = False
+
+    def compact_now(self, persistent_id: str) -> None:
+        """Merge the committed prefix of the chunk run (and any legacy
+        blob) into one base chunk at a sequence number reserved up front,
+        then remove the merged keys.
+
+        The per-pid lock is held only to snapshot the key list and reserve
+        the base's sequence — the O(state) read/merge/write itself runs
+        unlocked, so a concurrent commit's ``save_delta`` never stalls on
+        it (its chunks land at sequences *after* the reserved base and
+        replay on top).  Crash-safe: the base lands *before* anything is
+        deleted, so restore reads a consistent state at every point.
+
+        Chunks newer than the committed-time bound (``mark_committed``)
+        are left in place — folding them into a base would make it
+        impossible for :meth:`truncate_after` to drop an uncommitted tail
+        after a crash.  The streaming driver always triggers compaction
+        from ``save_delta`` *before* the tick's commit record lands, so
+        the just-written chunk is routinely past the bound; folding the
+        committed prefix (instead of abandoning the merge, which would
+        let the store grow O(history)) keeps compaction effective.
+        :meth:`load` replays the surviving newer deltas on top of the
+        base by finalized time, which is strictly monotone per pid.
+        """
+        prefix = self._prefix(persistent_id)
+        legacy_key = f"opstate/{persistent_id}"
+        with self._pid_lock(persistent_id):
+            meta = self._meta_for(persistent_id)
+            old_keys = self.storage.list_keys(prefix)
+            legacy = self.storage.get(legacy_key)
+            if not old_keys and legacy is None:
+                return
+            base_seq = meta[0]
+            meta[0] += 1
+        with self._master:
+            bound = self._committed_time
+        folded_keys: list[str] = []
+        folded_chunks: list[dict] = []
+        folded_entries = 0
+        folded_bases = 0
+        for key in old_keys:
+            data = self.storage.get(key)
+            if not data:
+                continue
+            chunk = pickle.loads(data)
+            if bound is not None and chunk.get("time", 0) > bound:
+                continue  # uncommitted tail — stays as-is this round
+            folded_keys.append(key)
+            folded_chunks.append(chunk)
+            if chunk["kind"] == "base":
+                folded_bases += 1
+            else:
+                folded_entries += len(chunk["upserts"]) + len(chunk["deletes"])
+        if legacy is None and folded_entries == 0 and folded_bases <= 1:
+            return  # nothing to merge — don't rewrite a lone base forever
+        state, last_time = self._replay(
+            folded_chunks, pickle.loads(legacy) if legacy else {}
+        )
+        payload = pickle.dumps(
+            {"kind": "base", "time": last_time, "state": state}
+        )
+        self.storage.put(f"{prefix}{base_seq:08d}", payload)
+        with self._pid_lock(persistent_id):
+            meta = self._meta_for(persistent_id)
+            meta[1] = max(0, meta[1] - folded_entries)
+            meta[3] = max(0, meta[3] - len(folded_keys))
+        with self._master:
+            self.bytes_written += len(payload)
+            self.chunks_written += 1
+            self.compactions += 1
+        for key in folded_keys:
+            self.storage.remove(key)
+        if legacy is not None:
+            self.storage.remove(legacy_key)
+
+    def truncate_after(self, persistent_id: str, time: int) -> None:
+        """Remove chunks written after finalized ``time`` — the restart
+        path drops a crashed run's uncommitted tail (its input offsets
+        were never recorded, so the data replays and would double-apply
+        if the orphaned chunks survived)."""
+        with self._pid_lock(persistent_id):
+            for key in self.storage.list_keys(self._prefix(persistent_id)):
+                data = self.storage.get(key)
+                if not data:
+                    continue
+                if pickle.loads(data).get("time", 0) > time:
+                    self.storage.remove(key)
+
+    def load(self, persistent_id: str) -> dict | None:
+        """Replay the newest base + later deltas; a legacy single-blob
+        snapshot (``opstate/{pid}``) acts as the base below every chunk.
+
+        The newest base is the one at the highest sequence number (a
+        crash between a compaction's base write and its removals can
+        leave the folded run behind).  Deltas replay on top when their
+        finalized time exceeds the base's — prefix compaction can leave
+        an uncommitted-tail delta at a LOWER sequence than the base that
+        later folded older chunks, so sequence order alone is not the
+        replay order; per-pid delta times are strictly monotone (the
+        driver resumes engine time past :meth:`restore`'s returned time),
+        so time is."""
+        return self.restore(persistent_id)[0]
+
+    def restore(
+        self, persistent_id: str, committed_time: int | None = None
+    ) -> tuple[dict | None, int]:
+        """Single-scan restart path: read every chunk once, drop chunks
+        newer than ``committed_time`` (a crashed run's uncommitted tail —
+        its input offsets were never recorded, so the data replays and
+        would double-apply), replay the rest as :meth:`load` does.
+
+        Returns ``(state | None, newest_folded_time)``.  The driver MUST
+        resume engine time past the returned time in every persistence
+        mode: replay orders deltas by finalized time, so a later run
+        re-using earlier times would make a stale delta win (engine times
+        restart from 1 per run unless resumed)."""
+        keys = self.storage.list_keys(self._prefix(persistent_id))
+        legacy = self.storage.get(f"opstate/{persistent_id}")
+        chunks = []
+        with self._pid_lock(persistent_id):
+            for key in keys:
+                data = self.storage.get(key)
+                if not data:
+                    continue
+                chunk = pickle.loads(data)
+                if (
+                    committed_time is not None
+                    and chunk.get("time", 0) > committed_time
+                ):
+                    self.storage.remove(key)
+                    continue
+                chunks.append(chunk)
+        if not chunks and legacy is None:
+            return None, 0
+        state, last_time = self._replay(
+            chunks, pickle.loads(legacy) if legacy else {}
+        )
+        return state, max(last_time, 0)
+
+    @staticmethod
+    def _replay(chunks: list[dict], state: dict) -> tuple[dict, int]:
+        """Merge ``chunks`` (sequence order) over ``state``: the newest
+        base — the one at the highest sequence — wins, then deltas whose
+        finalized time exceeds the base's replay on top in time order.
+        Sequence order alone is NOT the replay order: prefix compaction
+        can leave an uncommitted-tail delta at a LOWER sequence than a
+        base that later folded older chunks; per-pid delta times are
+        strictly monotone, so time disambiguates.  Returns the merged
+        state and the newest folded time (-1 when ``chunks`` is empty —
+        below every real engine time, so any later delta applies)."""
+        base_time = -1
+        for chunk in chunks:
+            if chunk["kind"] == "base":
+                state = dict(chunk["state"])
+                base_time = chunk.get("time", 0)
+        last_time = base_time
+        deltas = [c for c in chunks if c["kind"] != "base"]
+        deltas.sort(key=lambda c: c.get("time", 0))
+        for chunk in deltas:
+            if chunk.get("time", 0) > base_time:
+                state.update(chunk["upserts"])
+                for k in chunk["deletes"]:
+                    state.pop(k, None)
+                last_time = max(last_time, chunk.get("time", 0))
+        return state, last_time
+
+    def chunk_count(self, persistent_id: str) -> int:
+        return len(self.storage.list_keys(self._prefix(persistent_id)))

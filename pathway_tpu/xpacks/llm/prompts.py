@@ -51,9 +51,9 @@ def prompt_qa(
     """reference: prompts.py:141"""
     context = _docs_to_context(docs)
     return (
-        "Please provide an answer based solely on the provided sources. "
-        "Keep your answer concise and accurate. Make sure that it starts "
-        "with an expression in standalone form.\n"
+        "Answer using only the information in the sources below — do not "
+        "draw on outside knowledge. Be brief and precise, and begin the "
+        "answer with a standalone expression.\n"
         f"If you cannot answer from the sources, say: {information_not_found_response}\n"
         f"{additional_rules}\n"
         f"Sources:\n{context}\n"
@@ -71,8 +71,8 @@ def prompt_short_qa(
     """Few-word answer variant (reference: prompts.py short-qa template)."""
     context = _docs_to_context(docs)
     return (
-        "Please provide an answer in a few words based solely on the "
-        "provided sources.\n"
+        "Answer in just a few words, using only the information in the "
+        "sources below.\n"
         f"{additional_rules}\n"
         f"Sources:\n{context}\n"
         f"Question: {query}\n"
@@ -118,10 +118,10 @@ def prompt_citing_qa(
     """reference: prompts.py:268"""
     context = _docs_to_context(docs)
     return (
-        "Please provide an answer based solely on the provided sources. "
-        "When referencing information from a source, cite the appropriate "
-        "source(s) using their corresponding numbers like [1], [2]. Every "
-        "answer should include at least one source citation.\n"
+        "Answer using only the information in the sources below — do not "
+        "draw on outside knowledge. When a statement comes from a source, "
+        "cite that source by its number like [1], [2]; every answer must "
+        "carry at least one citation.\n"
         f"{additional_rules}\n"
         f"Sources:\n{context}\n"
         f"Question: {query}\n"

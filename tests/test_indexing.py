@@ -597,3 +597,32 @@ def test_search_among_batched_matches_per_query():
             np.testing.assert_allclose(
                 [s for _, s in got], [s for _, s in want], rtol=1e-5
             )
+
+
+def test_bucket_k_and_heterogeneous_k_results_exact():
+    """ADVICE #2: serving ``k`` is bucketed to the next power of two (one
+    compiled shape per bucket instead of one per distinct k) and the
+    returned sorted rows sliced back — results must stay the exact
+    requested top-k."""
+    from pathway_tpu.ops import DeviceKnnIndex
+    from pathway_tpu.ops.topk import bucket_k
+
+    assert bucket_k(1, 64) == 1
+    assert bucket_k(3, 64) == 4
+    assert bucket_k(5, 64) == 8
+    assert bucket_k(8, 64) == 8
+    assert bucket_k(9, 4) == 4  # clamped to the candidate bucket
+    assert bucket_k(0, 64) == 1
+
+    rng = np.random.default_rng(9)
+    idx = DeviceKnnIndex(dim=8, metric="cos", capacity=64)
+    vs = rng.standard_normal((40, 8)).astype(np.float32)
+    for i, v in enumerate(vs):
+        idx.upsert(i, v)
+    cands = list(range(40))
+    q = vs[3] + 0.01
+    for k in (1, 3, 5, 6, 7, 12):
+        (got,) = idx.search_among_batched([q], [cands], k)
+        assert len(got) == k, k
+        want = idx.search_among(q, cands, k)
+        assert [kk for kk, _ in got] == [kk for kk, _ in want], k

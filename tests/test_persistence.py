@@ -47,6 +47,33 @@ def test_kv_roundtrip(tmp_path, make_kv):
     assert kv.list_keys("a/") == ["a/c"]
 
 
+def test_filesystem_kv_concurrent_same_key_puts(tmp_path):
+    """Two writers putting the SAME key concurrently must both succeed
+    (with a shared fixed tmp name, the loser's os.replace raised
+    FileNotFoundError — the race behind two processes stamping
+    format/version on a fresh store at the same instant)."""
+    import threading as th
+
+    kv = FilesystemKV(str(tmp_path / "kv"))
+    errors = []
+
+    def writer(tag):
+        try:
+            for i in range(200):
+                kv.put("format/version", b"%d-%d" % (tag, i))
+        except Exception as exc:  # pragma: no cover - the bug under test
+            errors.append(exc)
+
+    threads = [th.Thread(target=writer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert kv.get("format/version") is not None
+    assert kv.list_keys("format/") == ["format/version"]
+
+
 def test_filesystem_kv_escaping_is_injective(tmp_path):
     kv = FilesystemKV(str(tmp_path / "kv"))
     kv.put("snap/a__b/chunk-0", b"x")
@@ -164,6 +191,93 @@ def test_kill_restart_recovery(tmp_path):
     (input_dir / "b.txt").write_text("banana cherry")
     second = run("out2.json", 5)
     assert second == {"apple": 2, "banana": 2, "cherry": 1}
+
+
+_OPERATOR_WORDCOUNT_PROGRAM = r"""
+import json, os, sys, threading, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+input_dir, pstore, out_path, expected_total = sys.argv[1:5]
+
+t = pw.io.fs.read(input_dir, format="plaintext", mode="streaming",
+                  refresh_interval=0.1, persistent_id="wordsrc")
+words = t.select(w=pw.apply(lambda line: line.split(), t.data)).flatten(pw.this.w)
+counts = words.groupby(words.w, persistent_id="wordstate").reduce(
+    words.w, c=pw.reducers.count())
+
+state = {}
+def on_change(key, row, time_, is_addition):
+    if is_addition:
+        state[row["w"]] = row["c"]
+    elif state.get(row["w"]) == row["c"]:
+        del state[row["w"]]
+
+pw.io.subscribe(counts, on_change=on_change)
+
+cfg = pw.persistence.Config(
+    pw.persistence.Backend.filesystem(pstore),
+    persistence_mode=pw.persistence.PersistenceMode.OPERATOR_PERSISTING)
+th = threading.Thread(
+    target=lambda: pw.run(persistence_config=cfg), daemon=True)
+th.start()
+
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    if sum(state.values()) >= int(expected_total):
+        break
+    time.sleep(0.1)
+with open(out_path, "w") as f:
+    json.dump(state, f)
+os._exit(9)  # sudden termination, engine gets no chance to clean up
+"""
+
+
+def test_kill_restart_recovery_operator_persisting(tmp_path):
+    """OPERATOR_PERSISTING: groupby state recovers from the chunked
+    delta-snapshot plane; input chunks carry only the offset frontier
+    (no entry replay — the operator state already holds the history)."""
+    input_dir = tmp_path / "in"
+    input_dir.mkdir()
+    pstore = tmp_path / "pstore"
+    program = tmp_path / "prog.py"
+    program.write_text(_OPERATOR_WORDCOUNT_PROGRAM)
+
+    (input_dir / "a.txt").write_text("apple banana apple")
+
+    def run(out_name, expected_total):
+        out = tmp_path / out_name
+        env = dict(os.environ)
+        repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, str(program), str(input_dir), str(pstore),
+             str(out), str(expected_total)],
+            timeout=120, capture_output=True, text=True, env=env,
+        )
+        assert proc.returncode == 9, proc.stderr[-2000:]
+        return json.loads(out.read_text())
+
+    first = run("out1.json", 3)
+    assert first == {"apple": 2, "banana": 1}
+
+    kv = Backend.filesystem(str(pstore)).storage
+    # operator-state delta chunks were written before the crash ...
+    assert kv.list_keys("opstate/wordstate/chunk-")
+    # ... and no input entry log exists at all: the offset frontier rides
+    # the per-tick commit record, not input snapshot chunks
+    assert kv.list_keys("snap/wordsrc/chunk-") == []
+    import pickle as _pickle
+
+    rec = _pickle.loads(kv.get("commit/record"))
+    assert rec["offsets"].get("wordsrc"), rec
+
+    # restart with one more file: the restored operator state must carry
+    # a.txt's counts (no entry replay happens in this mode), and the new
+    # file lands on top — banana's update retracts 1 and emits 2
+    (input_dir / "b.txt").write_text("banana cherry")
+    second = run("out2.json", 3)
+    assert second == {"banana": 2, "cherry": 1}
 
 
 # ---------------------------------------------------------------------------

@@ -102,6 +102,37 @@ def test_join_inner():
     pwd.assert_table_equality_wo_index(res, expected)
 
 
+def test_join_result_C_namespace():
+    """ADVICE #1: ``join(...).C.<col>`` resolves over both sides (left
+    wins on conflicts), matching the reference's ``Joinable.C`` surface."""
+    t = t_pets()
+    prices = pwd.table_from_markdown(
+        """
+        | pet | price
+    1   | dog | 100
+    2   | cat | 50
+    """
+    )
+    j = t.join(prices, t.pet == prices.pet)
+    res = j.select(owner=j.C.owner, price=j.C["price"])
+    expected = pwd.table_from_markdown(
+        """
+        owner | price
+        Alice | 100
+        Bob   | 50
+        Alice | 50
+        Carol | 100
+    """
+    )
+    pwd.assert_table_equality_wo_index(res, expected)
+    # 'pet' exists on both sides — the left reference wins
+    assert j.C.pet.table is j._left
+    with pytest.raises(AttributeError):
+        j.C.nope
+    with pytest.raises(AttributeError):
+        j.C._repr_html_  # notebook protocol probes must not resolve
+
+
 def test_join_left_outer():
     t1 = pwd.table_from_markdown(
         """
