@@ -15,11 +15,32 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
-__all__ = ["StatsMonitor", "start_http_server_thread", "MonitoringLevel"]
+__all__ = [
+    "StatsMonitor",
+    "start_http_server_thread",
+    "MonitoringLevel",
+    "register_metrics_provider",
+]
+
+
+#: pluggable metric sources (e.g. the serving scheduler,
+#: xpacks/llm/_scheduler.py) — weakly held so a test-local provider
+#: disappears with its owner.  A provider exposes ``stats() -> dict`` and
+#: ``openmetrics_lines() -> list[str]``.
+_metrics_providers: "weakref.WeakValueDictionary[str, Any]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def register_metrics_provider(name: str, provider: Any) -> None:
+    """Surface an external component's counters on every
+    :class:`StatsMonitor` snapshot and the OpenMetrics endpoint."""
+    _metrics_providers[name] = provider
 
 
 class StatsMonitor:
@@ -97,7 +118,7 @@ class StatsMonitor:
             connectors = {
                 name: self._connector_stats_locked(name, now) for name in names
             }
-            return {
+            snap = {
                 "uptime_s": time.time() - self.started_at,
                 "timestamp": self.current_timestamp,
                 "nodes": {
@@ -110,6 +131,15 @@ class StatsMonitor:
                 },
                 "connectors": connectors,
             }
+        providers = {}
+        for name, provider in list(_metrics_providers.items()):
+            try:
+                providers[name] = provider.stats()
+            except Exception:  # noqa: BLE001 — a dying provider must not kill /status
+                pass
+        if providers:
+            snap["providers"] = providers
+        return snap
 
     # -- OpenMetrics rendering (reference: http_server.rs:25
     # ``metrics_from_stats``) --
@@ -147,6 +177,11 @@ class StatsMonitor:
                 f'pathway_connector_finished{{connector="{safe}"}} '
                 f'{1 if st["finished"] else 0}'
             )
+        for _name, provider in list(_metrics_providers.items()):
+            try:
+                lines.extend(provider.openmetrics_lines())
+            except Exception:  # noqa: BLE001 — a dying provider must not kill /status
+                pass
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 

@@ -61,6 +61,19 @@ class PathwayWebserver:
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
 
+    def add_raw_route(
+        self,
+        route: str,
+        methods: Sequence[str],
+        handler: Callable,
+        documentation: "EndpointDocumentation | None" = None,
+    ) -> None:
+        """Serve ``route`` with a plain aiohttp handler instead of a
+        dataflow-backed rest_connector — the serving scheduler's fused
+        retrieve plane uses this to answer off the admission queue
+        (xpacks/llm/_scheduler.py) while other routes ride the engine."""
+        self._register(route, methods, handler, documentation)
+
     def _register(self, route: str, methods: Sequence[str], handler, doc) -> None:
         with self._lock:
             if self._thread is not None:

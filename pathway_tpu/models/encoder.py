@@ -31,8 +31,13 @@ __all__ = ["EncoderConfig", "TransformerEncoder", "SentenceEncoder"]
 SEQ_BUCKETS = (32, 64, 128, 256, 512)
 # large top buckets matter: the chip may sit behind a network tunnel where
 # every dispatch is an RPC — fewer, bigger launches amortize it and fill
-# the MXU (measured 9x end-to-end gap at batch 256 on a tunneled v5e)
-BATCH_BUCKETS = (1, 8, 32, 128, 256, 512, 1024)
+# the MXU (measured 9x end-to-end gap at batch 256 on a tunneled v5e).
+# Small buckets matter too: serving-scheduler ticks carry 1-8 queries, and
+# padding a 2-query tick to batch 8 is free on the MXU but real compute on
+# the CPU backend (measured 74 ms vs 25 ms for MiniLM at seq 128) — the
+# 2/4 steps keep low-occupancy ticks pay-for-what-you-use at the cost of
+# two extra compiles per sequence bucket
+BATCH_BUCKETS = (1, 2, 4, 8, 32, 128, 256, 512, 1024)
 
 
 @dataclasses.dataclass(frozen=True)

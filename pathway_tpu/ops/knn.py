@@ -251,7 +251,7 @@ class DeviceKnnIndex:
     _AMONG_GATHER_ELEMS = 32 * 1024 * 1024
 
     def _search_among_batched_locked(self, queries, keys_lists, k):
-        from .topk import among_topk_search, bucket_k
+        from .topk import among_topk_search, bucket_k, bucket_q
 
         self._apply_staged()
         slot_lists = [
@@ -272,7 +272,7 @@ class DeviceKnnIndex:
         results: list[list[tuple[Hashable, float]]] = []
         for start in range(0, n_q, max_chunk):
             chunk = slot_lists[start : start + max_chunk]
-            q_b = max(8, 1 << (len(chunk) - 1).bit_length())
+            q_b = bucket_q(len(chunk))
             idx = np.zeros((q_b, c_b), np.int32)
             pad_valid = np.zeros((q_b, c_b), bool)
             for i, s in enumerate(chunk):
@@ -344,8 +344,10 @@ class DeviceKnnIndex:
             return self._search_locked(queries, k)
 
     def _search_locked(self, queries, k):
+        from .topk import bucket_k, bucket_q
+
         self._apply_staged()
-        if len(self.slot_of_key) == 0:
+        if len(self.slot_of_key) == 0 or k <= 0:
             q = np.atleast_2d(np.asarray(queries))
             return [[] for _ in range(q.shape[0])]
         q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
@@ -353,11 +355,23 @@ class DeviceKnnIndex:
             norms = np.linalg.norm(q, axis=1, keepdims=True)
             norms[norms == 0] = 1.0
             q = q / norms
-        scores, idx = self._device_search(q, k)
-        scores = np.asarray(scores)
-        idx = np.asarray(idx)
+        n_q = q.shape[0]
+        # bucket BOTH dims that vary under serving traffic: the ragged
+        # scheduler-tick batch size (pad Q to a power of two, slice back)
+        # and the heterogeneous per-request k (bucket_k; top_k rows come
+        # back sorted so slicing recovers the exact result) — without
+        # this every distinct (Q, k) pair compiles a fresh XLA program
+        q_b = bucket_q(n_q)
+        if q_b != n_q:
+            q = np.concatenate(
+                [q, np.zeros((q_b - n_q, q.shape[1]), dtype=q.dtype)]
+            )
+        k_req = min(k, self.capacity)
+        scores, idx = self._device_search(q, bucket_k(k_req, self.capacity))
+        scores = np.asarray(scores)[:n_q]
+        idx = np.asarray(idx)[:n_q]
         out: list[list[tuple[Hashable, float]]] = []
-        for qi in range(q.shape[0]):
+        for qi in range(n_q):
             row: list[tuple[Hashable, float]] = []
             for s, i in zip(scores[qi], idx[qi]):
                 if not np.isfinite(s):
@@ -366,7 +380,7 @@ class DeviceKnnIndex:
                 if key is None:
                     continue
                 row.append((key, float(s)))
-                if len(row) == k:
+                if len(row) == k_req:
                     break
             out.append(row)
         return out

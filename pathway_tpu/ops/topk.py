@@ -20,7 +20,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["masked_topk_scores", "topk_search", "pallas_masked_scores", "bucket_k"]
+__all__ = [
+    "masked_topk_scores",
+    "topk_search",
+    "pallas_masked_scores",
+    "bucket_k",
+    "bucket_q",
+]
 
 NEG_INF = -jnp.inf
 
@@ -35,6 +41,15 @@ def bucket_k(k: int, cap: int) -> int:
     back down to the requested ``k``."""
     k = max(1, k)
     return min(cap, 1 << (k - 1).bit_length())
+
+
+def bucket_q(n: int, lo: int = 8) -> int:
+    """Round a query-batch size up to the next power of two (≥ ``lo``).
+
+    Serving traffic arrives in ragged batches (whatever the scheduler
+    tick collected); padding the Q dim to buckets keeps the compiled
+    top-k variants to O(log) — callers slice the padded rows back off."""
+    return max(lo, 1 << (max(1, n) - 1).bit_length())
 
 
 def _scores(queries: jax.Array, vectors: jax.Array, metric: str) -> jax.Array:

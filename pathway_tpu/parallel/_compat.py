@@ -1,0 +1,49 @@
+"""JAX version compatibility for the parallel package.
+
+``shard_map`` has moved twice across JAX releases: old versions expose it
+only as ``jax.experimental.shard_map.shard_map`` (replication check kwarg
+``check_rep``), newer ones promote it to ``jax.shard_map`` (kwarg renamed
+``check_vma``) and eventually drop the experimental module.  Every caller
+in this package goes through :func:`shard_map` below so the resolution and
+the kwarg translation live in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def _resolve():
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, "check_vma"
+    from jax.experimental.shard_map import shard_map as fn  # type: ignore
+
+    return fn, "check_rep"
+
+
+_SHARD_MAP, _CHECK_KW = _resolve()
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs: Any,
+    out_specs: Any,
+    check_replication: bool | None = None,
+):
+    """Version-portable ``shard_map``.
+
+    ``check_replication`` maps onto whichever of ``check_vma`` /
+    ``check_rep`` the installed JAX understands; ``None`` keeps the
+    library default.
+    """
+    kwargs = {}
+    if check_replication is not None:
+        kwargs[_CHECK_KW] = check_replication
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

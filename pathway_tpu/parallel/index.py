@@ -23,6 +23,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.knn import DeviceKnnIndex
+from ._compat import shard_map
 from .mesh import data_axis
 
 __all__ = ["ShardedKnnIndex"]
@@ -65,13 +66,7 @@ def _sharded_search_fn(mesh: Mesh, k: int, metric: str, n_local: int):
         in_specs=(P(), P(data_axis, None), P(data_axis)),
         out_specs=(P(), P()),
     )
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is not None:
-        mapped = shard_map(local_search, check_vma=False, **specs)
-    else:  # older jax: same API but the kwarg is named check_rep
-        from jax.experimental.shard_map import shard_map  # type: ignore
-
-        mapped = shard_map(local_search, check_rep=False, **specs)
+    mapped = shard_map(local_search, check_replication=False, **specs)
     return jax.jit(mapped)
 
 

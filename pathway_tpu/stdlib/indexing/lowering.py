@@ -14,6 +14,7 @@ timestamp is answered — the invariant the reference gets from
 
 from __future__ import annotations
 
+import weakref
 from typing import Any
 
 from ...internals.engine import Entry, Node, consolidate
@@ -21,7 +22,27 @@ from ...internals.evaluator import compile_expression
 from ...internals.runtime import GraphRunner, _TableLayout
 from ...internals.graph import Operator
 
-__all__ = ["ExternalIndexNode", "lower_external_index", "lower_sort"]
+__all__ = [
+    "ExternalIndexNode",
+    "lower_external_index",
+    "lower_sort",
+    "live_index_node",
+]
+
+
+#: live ExternalIndexNodes keyed by the identity of the factory that built
+#: their inner index — the serving scheduler's retrieve plane
+#: (xpacks/llm/_scheduler.py) uses this to answer REST queries against the
+#: engine-maintained index without riding engine micro-batch cadence.
+#: Weak values: a finished engine's nodes drop out with it.
+_LIVE_INDEX_NODES: "weakref.WeakValueDictionary[int, Node]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def live_index_node(factory: Any) -> "ExternalIndexNode | None":
+    """The running index node lowered from ``factory``, if any."""
+    return _LIVE_INDEX_NODES.get(id(factory))
 
 
 class ExternalIndexNode(Node):
@@ -190,6 +211,11 @@ def lower_external_index(runner: GraphRunner, op: Operator) -> None:
     runner.engine.add(node)
     runner._connect_inputs(op, node)
     runner._register(op, node)
+    # pin the factory on the node: the registry key is id(factory), so the
+    # factory must stay alive exactly as long as the entry does — otherwise
+    # a recycled id could alias a NEW factory to this stale node
+    node._factory = p["factory"]
+    _LIVE_INDEX_NODES[id(p["factory"])] = node
 
 
 # ---------------------------------------------------------------------------

@@ -142,12 +142,22 @@ class BruteForceKnnIndex(_FilteredMixin, InnerIndexImpl):
         if not queries:
             return []
         vecs = np.stack([np.asarray(q[0], dtype=np.float32) for q in queries])
-        max_k = max(q[1] for q in queries)
-        oversample = self.OVERSAMPLE if any(q[2] for q in queries) else 1
+        return self.search_embedded(vecs, [(k, flt) for _, k, flt in queries])
+
+    def search_embedded(self, vecs, specs):
+        """Fused-path search over pre-embedded queries: ``vecs`` is the
+        whole ``[Q, D]`` batch (numpy or device array) handed straight to
+        the device index — the serving scheduler's embed→search tick
+        never re-stages per-query rows on host.  ``specs`` is one
+        ``(k, metadata_filter)`` pair per query."""
+        if not specs:
+            return []
+        max_k = max(k for k, _ in specs)
+        oversample = self.OVERSAMPLE if any(flt for _, flt in specs) else 1
         raw = self.index.search(vecs, max_k * oversample)
         return [
             self._apply_filter(row, flt, k)
-            for row, (_, k, flt) in zip(raw, queries)
+            for row, (k, flt) in zip(raw, specs)
         ]
 
 
@@ -267,6 +277,9 @@ class BM25Index(_FilteredMixin, InnerIndexImpl):
         self.doc_len: dict[Hashable, int] = {}
         self.postings: dict[str, set] = defaultdict(set)
         self.total_len = 0
+        # the serving scheduler searches from its own thread while the
+        # engine thread mutates — same contract as DeviceKnnIndex's lock
+        self._lock = threading.RLock()
 
     @staticmethod
     def _terms(text: str) -> list[str]:
@@ -275,27 +288,33 @@ class BM25Index(_FilteredMixin, InnerIndexImpl):
         return re.findall(r"\w+", str(text).lower())
 
     def add(self, key, data, metadata) -> None:
-        if key in self.doc_terms:
-            self.remove(key)
-        terms = Counter(self._terms(data))
-        self.doc_terms[key] = terms
-        n = sum(terms.values())
-        self.doc_len[key] = n
-        self.total_len += n
-        for t in terms:
-            self.postings[t].add(key)
-        self._store_meta(key, metadata)
+        with self._lock:
+            if key in self.doc_terms:
+                self.remove(key)
+            terms = Counter(self._terms(data))
+            self.doc_terms[key] = terms
+            n = sum(terms.values())
+            self.doc_len[key] = n
+            self.total_len += n
+            for t in terms:
+                self.postings[t].add(key)
+            self._store_meta(key, metadata)
 
     def remove(self, key) -> None:
-        terms = self.doc_terms.pop(key, None)
-        if terms is None:
-            return
-        self.total_len -= self.doc_len.pop(key, 0)
-        for t in terms:
-            self.postings[t].discard(key)
-        self._drop_meta(key)
+        with self._lock:
+            terms = self.doc_terms.pop(key, None)
+            if terms is None:
+                return
+            self.total_len -= self.doc_len.pop(key, 0)
+            for t in terms:
+                self.postings[t].discard(key)
+            self._drop_meta(key)
 
     def search(self, queries):
+        with self._lock:
+            return self._search_locked(queries)
+
+    def _search_locked(self, queries):
         n_docs = len(self.doc_terms)
         if n_docs == 0:
             return [[] for _ in queries]

@@ -1,0 +1,640 @@
+"""Continuous cross-request serving scheduler.
+
+The engine's :class:`~pathway_tpu.xpacks.llm._utils.AsyncMicroBatcher`
+coalesces only the calls that land in the *same* engine micro-batch, so
+under concurrent REST load the device sees one small embed/search dispatch
+per request and query p99 balloons (serving_bench: p99 ≈ 2.4× p50 on CPU).
+This module decouples device batching from engine cadence the way WindVE
+(arXiv:2504.14941) decouples a host-side concurrency queue from the
+accelerator:
+
+* a host-side **admission queue** collects work items (embed texts, rerank
+  pairs, fused retrieve requests) from every in-flight plane — engine
+  micro-batches AND concurrent REST handlers;
+* a single **device-step loop** drains it on a ``max_batch`` /
+  ``max_wait_ms`` policy, so one scheduler tick carries embeds from
+  request A, KNN probes from request B and rerank pairs from request C,
+  each kind as one padded device dispatch (the power-of-two bucketing in
+  ``models/encoder.py`` / ``ops/topk.bucket_k`` keeps XLA compile counts
+  flat across the ragged batch sizes this produces);
+* requests carry an optional **deadline**: items whose deadline passed
+  before dispatch are shed with :class:`DeadlineExceeded` (REST planes
+  map it to 503 + ``Retry-After``) and their device work never runs —
+  backpressure, not collapse.  Admission beyond ``max_queue`` is refused
+  immediately with :class:`SchedulerOverloaded`.
+
+Observability (queue depth, batch occupancy, wait-time histogram,
+deadline drops) registers with ``internals/monitoring.py`` and renders on
+the OpenMetrics ``/status`` endpoint as ``pathway_scheduler_*`` series.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+__all__ = [
+    "ServingScheduler",
+    "WorkGroup",
+    "DeadlineExceeded",
+    "SchedulerOverloaded",
+    "ServingNotReady",
+    "RetrievePlane",
+    "get_scheduler",
+    "configure",
+    "scheduler_enabled",
+    "serving_settings",
+]
+
+
+class DeadlineExceeded(Exception):
+    """The request was shed: its deadline passed before dispatch.
+
+    ``retry_after_s`` is the server's backoff hint (HTTP ``Retry-After``).
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class SchedulerOverloaded(DeadlineExceeded):
+    """Admission refused: the queue is at capacity."""
+
+
+class ServingNotReady(DeadlineExceeded):
+    """The live index is not lowered yet (engine still starting up)."""
+
+
+class WorkGroup:
+    """One batchable kind of device work.
+
+    ``batch_fn(list_of_payloads) -> list_of_results`` runs on the
+    scheduler thread; items of the same group drained in one tick execute
+    as one call (chunked at ``max_batch``).
+    """
+
+    def __init__(
+        self,
+        label: str,
+        batch_fn: Callable[[list], Sequence],
+        max_batch: int = 1024,
+    ):
+        self.label = label
+        self.batch_fn = batch_fn
+        self.max_batch = max_batch
+
+
+class _WorkItem:
+    __slots__ = ("group", "payload", "future", "enqueued_at", "deadline_at")
+
+    def __init__(self, group, payload, future, enqueued_at, deadline_at):
+        self.group = group
+        self.payload = payload
+        self.future = future
+        self.enqueued_at = enqueued_at
+        self.deadline_at = deadline_at
+
+
+#: wait-time histogram bucket upper bounds (milliseconds)
+_WAIT_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 1000.0)
+
+
+class ServingScheduler:
+    """Admission queue + device-step loop (see module docstring)."""
+
+    def __init__(
+        self,
+        *,
+        max_batch: int = 256,
+        max_wait_ms: float = 2.0,
+        max_queue: int = 1024,
+        retry_after_s: float = 1.0,
+        name: str = "serving",
+    ):
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
+        self.name = name
+        self._cv = threading.Condition()
+        self._queue: list[_WorkItem] = []
+        self._thread: threading.Thread | None = None
+        # metrics — guarded by _mx, not _cv: the tick updates them while
+        # submitters hold _cv
+        self._mx = threading.Lock()
+        self._counters = {
+            "submitted_total": 0,
+            "completed_total": 0,
+            "failed_total": 0,
+            "shed_deadline_total": 0,
+            "shed_queue_total": 0,
+            "batches_total": 0,
+            "multi_item_batches_total": 0,
+        }
+        self._occupancy_sum = 0
+        self._occupancy_max = 0
+        self._queue_depth_max = 0
+        self._wait_buckets = [0] * (len(_WAIT_BUCKETS_MS) + 1)
+        self._wait_sum_ms = 0.0
+        self._wait_count = 0
+        from ...internals.monitoring import register_metrics_provider
+
+        register_metrics_provider(name, self)
+
+    # -- submission ------------------------------------------------------
+    def submit(
+        self,
+        group: WorkGroup,
+        payload: Any,
+        *,
+        deadline_s: float | None = None,
+        sheddable: bool | None = None,
+    ) -> Future:
+        """Enqueue one payload; the future resolves when its batch ran.
+
+        ``deadline_s`` is a relative budget: if the item is still queued
+        that long after submission it is shed with :class:`DeadlineExceeded`
+        and its work never executes.  ``None`` (engine-plane work) is
+        never shed.
+
+        ``sheddable`` work (default: anything with a deadline; serving
+        planes pass True explicitly) is additionally subject to
+        ``max_queue`` admission control.  Engine-plane work is exempt:
+        refusing an ingest micro-batch's embeds would error the engine,
+        and its volume is already bounded by engine batch sizes.
+        """
+        if sheddable is None:
+            sheddable = deadline_s is not None
+        fut: Future = Future()
+        if self._thread is not None and threading.current_thread() is self._thread:
+            # re-entrant submit from inside a batch handler (e.g. a
+            # retrieve handler whose embedder delegates through the
+            # batcher): run inline — a queued item could never drain
+            # while the loop is inside this very tick.  _execute handles
+            # the dispatch lock, result validation and error routing
+            self._execute(group, [_WorkItem(group, payload, fut, time.monotonic(), None)])
+            return fut
+        now = time.monotonic()
+        item = _WorkItem(
+            group,
+            payload,
+            fut,
+            now,
+            None if deadline_s is None else now + deadline_s,
+        )
+        with self._cv:
+            if sheddable and len(self._queue) >= self.max_queue:
+                with self._mx:
+                    self._counters["shed_queue_total"] += 1
+                fut.set_exception(
+                    SchedulerOverloaded(
+                        f"scheduler queue full ({self.max_queue} pending)",
+                        retry_after_s=self.retry_after_s,
+                    )
+                )
+                return fut
+            self._ensure_thread()
+            self._queue.append(item)
+            depth = len(self._queue)
+            self._cv.notify_all()
+        with self._mx:
+            self._counters["submitted_total"] += 1
+            if depth > self._queue_depth_max:
+                self._queue_depth_max = depth
+        return fut
+
+    async def submit_async(
+        self,
+        group: WorkGroup,
+        payload: Any,
+        *,
+        deadline_s: float | None = None,
+        sheddable: bool | None = None,
+    ) -> Any:
+        return await asyncio.wrap_future(
+            self.submit(group, payload, deadline_s=deadline_s, sheddable=sheddable)
+        )
+
+    # -- device-step loop ------------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=f"pw-scheduler-{self.name}"
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue:
+                    self._cv.wait()
+                # admission window: from the first pending item, wait up
+                # to max_wait_ms for concurrent requests to join the tick,
+                # flushing early once max_batch items are pending
+                flush_at = time.monotonic() + self.max_wait_ms / 1000.0
+                while len(self._queue) < self.max_batch:
+                    remaining = flush_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+                items, self._queue = self._queue, []
+            try:
+                self._run_tick(items)
+            except BaseException as exc:  # noqa: BLE001 — the loop must
+                # survive; per-item errors are already routed to futures in
+                # _execute, so anything landing here is a harness bug: fail
+                # the unresolved items with the ACTUAL exception (a generic
+                # wrapper would make the defect undiagnosable)
+                for it in items:
+                    if not it.future.done():
+                        it.future.set_exception(exc)
+
+    def _run_tick(self, items: list[_WorkItem]) -> None:
+        now = time.monotonic()
+        groups: dict[int, tuple[WorkGroup, list[_WorkItem]]] = {}
+        for it in items:  # submission order preserved: results must zip
+            groups.setdefault(id(it.group), (it.group, []))[1].append(it)
+        for group, gitems in groups.values():
+            live: list[_WorkItem] = []
+            for it in gitems:
+                self._observe_wait((now - it.enqueued_at) * 1000.0)
+                if it.deadline_at is not None and now > it.deadline_at:
+                    with self._mx:
+                        self._counters["shed_deadline_total"] += 1
+                    if not it.future.done():  # client may have cancelled
+                        it.future.set_exception(
+                            DeadlineExceeded(
+                                "deadline exceeded before dispatch "
+                                f"(queued {(now - it.enqueued_at) * 1000:.1f} ms)",
+                                retry_after_s=self.retry_after_s,
+                            )
+                        )
+                else:
+                    live.append(it)
+            for start in range(0, len(live), group.max_batch):
+                self._execute(group, live[start : start + group.max_batch])
+
+    def _execute(self, group: WorkGroup, chunk: list[_WorkItem]) -> None:
+        if not chunk:
+            return
+        with self._mx:
+            self._counters["batches_total"] += 1
+            if len(chunk) > 1:
+                self._counters["multi_item_batches_total"] += 1
+            self._occupancy_sum += len(chunk)
+            if len(chunk) > self._occupancy_max:
+                self._occupancy_max = len(chunk)
+        # honor the batcher's dispatch lock: build-time probes may call the
+        # model off-thread while the loop runs
+        lock = getattr(group, "_dispatch_lock", None)
+        try:
+            if lock is not None:
+                with lock:
+                    results = group.batch_fn([it.payload for it in chunk])
+            else:
+                results = group.batch_fn([it.payload for it in chunk])
+            if len(results) != len(chunk):
+                raise RuntimeError(
+                    f"batch handler {group.label!r} returned {len(results)} "
+                    f"results for {len(chunk)} items"
+                )
+        except BaseException as exc:  # noqa: BLE001 — propagate to every waiter
+            with self._mx:
+                self._counters["failed_total"] += len(chunk)
+            for it in chunk:
+                if not it.future.done():
+                    it.future.set_exception(exc)
+            return
+        with self._mx:
+            self._counters["completed_total"] += len(chunk)
+        for it, res in zip(chunk, results):
+            if not it.future.done():
+                it.future.set_result(res)
+
+    def _observe_wait(self, wait_ms: float) -> None:
+        with self._mx:
+            self._wait_sum_ms += wait_ms
+            self._wait_count += 1
+            for i, le in enumerate(_WAIT_BUCKETS_MS):
+                if wait_ms <= le:
+                    self._wait_buckets[i] += 1
+                    break
+            else:
+                self._wait_buckets[-1] += 1
+
+    # -- observability ---------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        with self._cv:
+            depth = len(self._queue)
+        with self._mx:
+            batches = self._counters["batches_total"]
+            return {
+                **self._counters,
+                "queue_depth": depth,
+                "queue_depth_max": self._queue_depth_max,
+                "batch_occupancy_mean": (
+                    self._occupancy_sum / batches if batches else 0.0
+                ),
+                "batch_occupancy_max": self._occupancy_max,
+                "wait_ms_sum": self._wait_sum_ms,
+                "wait_ms_count": self._wait_count,
+                "wait_ms_buckets": [
+                    (le, n)
+                    for le, n in zip(
+                        (*_WAIT_BUCKETS_MS, float("inf")), self._wait_buckets
+                    )
+                ],
+            }
+
+    def openmetrics_lines(self) -> list[str]:
+        """``pathway_scheduler_*`` series for the /status endpoint."""
+        s = self.stats()
+        lbl = f'scheduler="{self.name}"'
+        lines = []
+        for metric, kind in (
+            ("submitted_total", "counter"),
+            ("completed_total", "counter"),
+            ("failed_total", "counter"),
+            ("shed_deadline_total", "counter"),
+            ("shed_queue_total", "counter"),
+            ("batches_total", "counter"),
+            ("multi_item_batches_total", "counter"),
+            ("queue_depth", "gauge"),
+            ("queue_depth_max", "gauge"),
+            ("batch_occupancy_max", "gauge"),
+        ):
+            lines.append(f"# TYPE pathway_scheduler_{metric} {kind}")
+            lines.append(f"pathway_scheduler_{metric}{{{lbl}}} {s[metric]}")
+        lines.append("# TYPE pathway_scheduler_batch_occupancy_mean gauge")
+        lines.append(
+            f"pathway_scheduler_batch_occupancy_mean{{{lbl}}} "
+            f"{s['batch_occupancy_mean']:.3f}"
+        )
+        lines.append("# TYPE pathway_scheduler_wait_ms histogram")
+        cum = 0
+        for le, n in s["wait_ms_buckets"]:
+            cum += n
+            le_s = "+Inf" if le == float("inf") else f"{le:g}"
+            lines.append(
+                f'pathway_scheduler_wait_ms_bucket{{{lbl},le="{le_s}"}} {cum}'
+            )
+        lines.append(
+            f"pathway_scheduler_wait_ms_sum{{{lbl}}} {s['wait_ms_sum']:.3f}"
+        )
+        lines.append(
+            f"pathway_scheduler_wait_ms_count{{{lbl}}} {s['wait_ms_count']}"
+        )
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# process-global scheduler + settings
+# ---------------------------------------------------------------------------
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "off", "no", "")
+
+
+_SETTINGS: dict[str, Any] = {
+    "enabled": _env_flag("PATHWAY_SERVING_SCHEDULER", True),
+    "max_batch": int(os.environ.get("PATHWAY_SERVING_MAX_BATCH", "256")),
+    # 5 ms absorbs the few-ms arrival stagger of a burst (e.g. responses
+    # of one tick fanning back out through HTTP and returning) so bursts
+    # stay coalesced instead of splitting into alternating half-full
+    # ticks; singleton queries pay at most this much extra
+    "max_wait_ms": float(os.environ.get("PATHWAY_SERVING_MAX_WAIT_MS", "5.0")),
+    "max_queue": int(os.environ.get("PATHWAY_SERVING_MAX_QUEUE", "1024")),
+    "deadline_ms": (
+        float(os.environ["PATHWAY_SERVING_DEADLINE_MS"])
+        if os.environ.get("PATHWAY_SERVING_DEADLINE_MS")
+        else None
+    ),
+    "retry_after_s": float(os.environ.get("PATHWAY_SERVING_RETRY_AFTER_S", "1.0")),
+}
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: ServingScheduler | None = None
+
+
+def scheduler_enabled() -> bool:
+    return bool(_SETTINGS["enabled"])
+
+
+def serving_settings() -> dict[str, Any]:
+    return dict(_SETTINGS)
+
+
+def configure(**kwargs: Any) -> None:
+    """Adjust the global serving policy (``enabled``, ``max_batch``,
+    ``max_wait_ms``, ``max_queue``, ``deadline_ms``, ``retry_after_s``).
+    Live knobs apply to the already-running global scheduler too."""
+    unknown = set(kwargs) - set(_SETTINGS)
+    if unknown:
+        raise TypeError(f"unknown serving settings: {sorted(unknown)}")
+    _SETTINGS.update(kwargs)
+    with _GLOBAL_LOCK:
+        sched = _GLOBAL
+    if sched is not None:
+        for knob in ("max_batch", "max_wait_ms", "max_queue", "retry_after_s"):
+            if knob in kwargs:
+                setattr(sched, knob, kwargs[knob])
+
+
+def get_scheduler() -> ServingScheduler:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = ServingScheduler(
+                max_batch=_SETTINGS["max_batch"],
+                max_wait_ms=_SETTINGS["max_wait_ms"],
+                max_queue=_SETTINGS["max_queue"],
+                retry_after_s=_SETTINGS["retry_after_s"],
+            )
+        return _GLOBAL
+
+
+# ---------------------------------------------------------------------------
+# fused retrieve plane (embed → KNN in one scheduler tick)
+# ---------------------------------------------------------------------------
+
+
+def _batch_embed(embedder, texts: list[str]):
+    """One padded device dispatch for a batch of query texts.
+
+    Model-backed embedders expose their underlying encoder
+    (``_ensure_encoder``) — calling it directly keeps the embeddings as
+    one device array handed straight to the index search (the fused
+    path) AND avoids re-entering the scheduler from its own thread.
+    Generic UDF embedders fall back to per-text calls.
+    """
+    from ._utils import coerce_str
+
+    ensure = getattr(embedder, "_ensure_encoder", None)
+    if ensure is not None:
+        enc = ensure()
+        # hold the batcher's dispatch lock: with a mixed configuration
+        # (e.g. use_scheduler=False on the embedder) engine-plane encodes
+        # run off this thread under the same lock, and the model is not
+        # thread-safe across concurrent callers
+        batcher = getattr(embedder, "_batcher", None)
+        lock = getattr(batcher, "_dispatch_lock", None)
+        if lock is not None:
+            with lock:
+                return enc.encode([coerce_str(t) for t in texts])
+        return enc.encode([coerce_str(t) for t in texts])
+    from .embedders import _call_sync
+
+    fn = getattr(embedder, "__wrapped__", embedder)
+    return np.stack(
+        [np.asarray(_call_sync(fn, coerce_str(t))).reshape(-1) for t in texts]
+    )
+
+
+class RetrievePlane:
+    """Scheduler-served ``/v1/retrieve``: concurrent REST requests coalesce
+    into one fused embed→search tick over the LIVE index (the engine keeps
+    maintaining it; queries no longer ride engine micro-batch cadence).
+
+    Answers are as-of-now: each batch reads the index's current state
+    under its own lock, the same contract ``query_as_of_now`` serves.
+    """
+
+    def __init__(
+        self,
+        *,
+        index_factory: Any,
+        embedder: Any,
+        payload_columns: list[str],
+        scheduler: ServingScheduler | None = None,
+        deadline_ms: float | None = None,
+        include_score: bool = False,
+        max_batch: int | None = None,
+        label: str = "retrieve",
+    ):
+        self.scheduler = scheduler if scheduler is not None else get_scheduler()
+        self.index_factory = index_factory
+        self.embedder = embedder
+        self.include_score = include_score
+        self._deadline_ms_override = deadline_ms
+        self._text_i = payload_columns.index("text")
+        self._meta_i = payload_columns.index("metadata")
+        if max_batch is None:
+            max_batch = self.scheduler.max_batch
+        self.group = WorkGroup(label, self._batch, max_batch=max_batch)
+
+    @property
+    def deadline_ms(self) -> float | None:
+        """Per-plane override, else the LIVE global setting — so
+        ``configure(deadline_ms=...)`` applies to running servers too."""
+        if self._deadline_ms_override is not None:
+            return self._deadline_ms_override
+        return _SETTINGS["deadline_ms"]
+
+    # -- batch handler (scheduler thread) --
+    def _batch(self, items: list[tuple[str, int, str | None]]) -> list[list[dict]]:
+        from ...stdlib.indexing.lowering import live_index_node
+
+        node = live_index_node(self.index_factory)
+        if node is None:
+            raise ServingNotReady(
+                "index is not serving yet (engine starting)",
+                retry_after_s=self.scheduler.retry_after_s,
+            )
+        index = node.index
+        if getattr(index, "query_is_text", False):
+            raw = index.search(list(items))
+        else:
+            if self.embedder is None:
+                raise RuntimeError(
+                    "retrieve plane needs an embedder for a vector index"
+                )
+            embs = _batch_embed(self.embedder, [q for q, _, _ in items])
+            specs = [(k, flt) for _, k, flt in items]
+            if hasattr(index, "search_embedded"):
+                raw = index.search_embedded(embs, specs)
+            else:
+                raw = index.search(
+                    [(embs[i], k, flt) for i, (k, flt) in enumerate(specs)]
+                )
+        return [self._pack(node, row) for row in raw]
+
+    def _pack(self, node, row) -> list[dict]:
+        from ...internals.value import Json
+        from ._utils import coerce_str
+
+        out = []
+        for key, score in row:
+            payload = node.doc_payload.get(key)
+            if payload is None:  # retracted between search and pack
+                continue
+            meta = payload[self._meta_i]
+            if isinstance(meta, Json):
+                meta = meta.value
+            entry = {
+                "text": coerce_str(payload[self._text_i]),
+                "metadata": meta,
+                "dist": -float(score),
+            }
+            if self.include_score:
+                entry["score"] = float(score)
+            out.append(entry)
+        return out
+
+    # -- HTTP handler (webserver thread) --
+    def aiohttp_handler(self):
+        from ._utils import coerce_str, merge_filter_exprs
+
+        async def handle(request):
+            from aiohttp import web
+
+            if request.method in ("POST", "PUT", "PATCH"):
+                try:
+                    payload = await request.json()
+                except Exception:  # noqa: BLE001 — malformed body
+                    return web.json_response(
+                        {"detail": "request body is not valid JSON"}, status=400
+                    )
+            else:
+                payload = dict(request.query)
+            query = coerce_str(payload.get("query", ""))
+            try:
+                k = int(payload.get("k", 3))
+            except (TypeError, ValueError):
+                return web.json_response({"detail": "invalid k"}, status=400)
+            flt = merge_filter_exprs(
+                payload.get("metadata_filter"),
+                payload.get("filepath_globpattern"),
+            )
+            deadline_ms = payload.get("deadline_ms", self.deadline_ms)
+            try:
+                deadline_s = (
+                    None if deadline_ms is None else float(deadline_ms) / 1000.0
+                )
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"detail": "invalid deadline_ms"}, status=400
+                )
+            try:
+                result = await self.scheduler.submit_async(
+                    self.group, (query, k, flt),
+                    deadline_s=deadline_s, sheddable=True,
+                )
+            except DeadlineExceeded as exc:
+                return web.json_response(
+                    {"detail": str(exc)},
+                    status=503,
+                    headers={"Retry-After": f"{exc.retry_after_s:g}"},
+                )
+            return web.json_response(result)
+
+        return handle

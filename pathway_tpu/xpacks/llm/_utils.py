@@ -20,6 +20,7 @@ __all__ = [
     "AsyncMicroBatcher",
     "RestClientBase",
     "run_with_cache",
+    "merge_filter_exprs",
     "_check_model_accepts_arg",
 ]
 
@@ -28,6 +29,20 @@ def coerce_str(value: Any) -> str:
     if isinstance(value, bytes):
         return value.decode("utf-8", errors="replace")
     return str(value)
+
+
+def merge_filter_exprs(
+    metadata_filter: str | None, filepath_globpattern: str | None
+) -> str | None:
+    """Combine the two request filters into one expression
+    (reference: vector_store.py:358 ``merge_filters``) — plain-function
+    form shared by the dataflow UDF and the scheduler retrieve plane."""
+    parts = []
+    if metadata_filter:
+        parts.append(f"({metadata_filter})")
+    if filepath_globpattern:
+        parts.append(f"globmatch('{filepath_globpattern}', path)")
+    return " && ".join(parts) if parts else None
 
 
 def _check_model_accepts_arg(model_cls_or_fn: Any, arg: str) -> bool:
@@ -41,7 +56,13 @@ def _check_model_accepts_arg(model_cls_or_fn: Any, arg: str) -> bool:
 
 
 class RestClientBase:
-    """Shared urllib JSON client (VectorStoreClient / RAGClient)."""
+    """Shared urllib JSON client (VectorStoreClient / RAGClient).
+
+    ``retry_on_unavailable`` (off by default) makes a 503 response —
+    the serving scheduler's deadline/overload shedding — degrade
+    gracefully: the client honors the server's ``Retry-After`` hint
+    (clamped to ``max_retry_after_s``) and retries exactly once.
+    """
 
     def __init__(
         self,
@@ -50,6 +71,8 @@ class RestClientBase:
         url: str | None = None,
         timeout: float = 30.0,
         additional_headers: dict | None = None,
+        retry_on_unavailable: bool = False,
+        max_retry_after_s: float = 5.0,
     ):
         if url is None:
             if host is None or port is None:
@@ -58,8 +81,26 @@ class RestClientBase:
         self.url = url.rstrip("/")
         self.timeout = timeout
         self.additional_headers = additional_headers or {}
+        self.retry_on_unavailable = retry_on_unavailable
+        self.max_retry_after_s = max_retry_after_s
 
     def _post(self, route: str, payload: dict):
+        import time
+        import urllib.error
+
+        try:
+            return self._post_once(route, payload)
+        except urllib.error.HTTPError as exc:
+            if not (self.retry_on_unavailable and exc.code == 503):
+                raise
+            try:
+                retry_after = float(exc.headers.get("Retry-After", 1.0))
+            except (TypeError, ValueError):
+                retry_after = 1.0
+            time.sleep(max(0.0, min(retry_after, self.max_retry_after_s)))
+            return self._post_once(route, payload)
+
+    def _post_once(self, route: str, payload: dict):
         import json
         import urllib.request
 
@@ -113,17 +154,43 @@ class AsyncMicroBatcher:
     as a concurrent task on one loop, so all rows of the timestamp land in
     the same device batch — the bucketed-padding path of
     ``models/encoder.py`` then compiles once per shape bucket.
+
+    When the serving scheduler is enabled (the default,
+    ``xpacks/llm/_scheduler.py``) calls delegate to the shared scheduler
+    instead: work coalesces ACROSS engine steps and REST planes on its
+    ``max_wait_ms`` window, not just within one loop round, and every
+    device dispatch serializes on the scheduler thread.  ``use_scheduler``
+    pins the behavior per batcher (None = follow the global setting).
     """
 
-    def __init__(self, batch_fn: Callable[[list], Sequence], max_batch: int = 1024):
+    def __init__(
+        self,
+        batch_fn: Callable[[list], Sequence],
+        max_batch: int = 1024,
+        use_scheduler: bool | None = None,
+    ):
         self.batch_fn = batch_fn
         self.max_batch = max_batch
+        self.label = getattr(batch_fn, "__name__", "batch")
+        self.use_scheduler = use_scheduler
         # device dispatch is serialized; the model call itself is not
         # thread-safe across loops
         self._dispatch_lock = threading.Lock()
         self._pending: dict[int, list[tuple[Any, asyncio.Future]]] = {}
 
+    def _scheduler(self):
+        from ._scheduler import get_scheduler, scheduler_enabled
+
+        use = self.use_scheduler
+        if use is None:
+            use = scheduler_enabled()
+        return get_scheduler() if use else None
+
     async def call(self, item: Any) -> Any:
+        sched = self._scheduler()
+        if sched is not None:
+            # engine-plane work carries no deadline: it is never shed
+            return await sched.submit_async(self, item)
         loop = asyncio.get_running_loop()
         lid = id(loop)
         lst = self._pending.setdefault(lid, [])

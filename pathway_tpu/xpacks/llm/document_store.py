@@ -128,6 +128,30 @@ class DocumentStore:
     def index(self) -> DataIndex:
         return self._retriever
 
+    def scheduler_retrieve_plane(self, deadline_ms: float | None = None):
+        """Fused retrieve plane for the serving scheduler, or ``None`` when
+        this store cannot serve it (hybrid retriever, or a vector factory
+        without an embedder).  BM25 retrievers serve text queries directly
+        (no embed stage in the tick)."""
+        from ...stdlib.indexing.hybrid_index import HybridIndexFactory
+        from ...stdlib.indexing.retrievers import TantivyBM25Factory
+        from ._scheduler import RetrievePlane
+
+        if isinstance(self.retriever_factory, HybridIndexFactory):
+            return None
+        embedder = getattr(self.retriever_factory, "embedder", None)
+        if embedder is None and not isinstance(
+            self.retriever_factory, TantivyBM25Factory
+        ):
+            return None
+        return RetrievePlane(
+            index_factory=self.retriever_factory,
+            embedder=embedder,
+            payload_columns=self.chunked_docs.column_names(),
+            deadline_ms=deadline_ms,
+            include_score=True,
+        )
+
     # -- queries (reference: document_store.py:426 retrieve_query) --
     def retrieve_query(self, retrieval_queries: Table) -> Table:
         queries = retrieval_queries.select(

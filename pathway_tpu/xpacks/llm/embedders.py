@@ -75,11 +75,15 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         encoder: Any = None,
         max_batch: int = 1024,
         pipelined: bool = False,
+        use_scheduler: bool | None = None,
         **init_kwargs,
     ):
         # pipelined: fully-async dispatch — the device encode of micro-batch
         # t overlaps host ingest/parse of t+1, embeddings land one engine
         # step later (the FullyAsyncExecutor contract)
+        # use_scheduler: None follows the global serving-scheduler setting
+        # (calls coalesce across engine steps and REST planes); False pins
+        # the per-loop micro-batching
         super().__init__(
             executor=(
                 udfs.fully_async_executor() if pipelined else udfs.async_executor()
@@ -91,6 +95,7 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         self._encoder = encoder
         self._batcher: AsyncMicroBatcher | None = None
         self._max_batch = max_batch
+        self._use_scheduler = use_scheduler
         self._init_kwargs = init_kwargs
 
     def _ensure_encoder(self):
@@ -104,7 +109,10 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             def batch_encode(texts: list[str]) -> list[np.ndarray]:
                 return list(enc.encode([coerce_str(t) for t in texts]))
 
-            self._batcher = AsyncMicroBatcher(batch_encode, max_batch=self._max_batch)
+            self._batcher = AsyncMicroBatcher(
+                batch_encode, max_batch=self._max_batch,
+                use_scheduler=self._use_scheduler,
+            )
         return self._encoder
 
     async def __wrapped__(self, input: str, **kwargs) -> np.ndarray:
@@ -126,12 +134,14 @@ class ImageEmbedder(BaseEmbedder):
         *,
         encoder: Any = None,
         max_batch: int = 256,
+        use_scheduler: bool | None = None,
         **init_kwargs,
     ):
         super().__init__(executor=udfs.async_executor(), deterministic=True)
         self._encoder = encoder
         self._batcher: AsyncMicroBatcher | None = None
         self._max_batch = max_batch
+        self._use_scheduler = use_scheduler
         self._init_kwargs = init_kwargs
 
     def _ensure_encoder(self):
@@ -145,7 +155,10 @@ class ImageEmbedder(BaseEmbedder):
             def batch_encode(images: list) -> list[np.ndarray]:
                 return list(enc.encode(images))
 
-            self._batcher = AsyncMicroBatcher(batch_encode, max_batch=self._max_batch)
+            self._batcher = AsyncMicroBatcher(
+                batch_encode, max_batch=self._max_batch,
+                use_scheduler=self._use_scheduler,
+            )
         return self._encoder
 
     async def __wrapped__(self, input, **kwargs) -> np.ndarray:

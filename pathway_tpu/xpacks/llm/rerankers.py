@@ -104,6 +104,7 @@ class CrossEncoderReranker(UDF):
         *,
         cross_encoder: Any = None,
         max_batch: int = 1024,
+        use_scheduler: bool | None = None,
         **init_kwargs,
     ):
         super().__init__(executor=udfs.async_executor(), deterministic=True)
@@ -111,6 +112,7 @@ class CrossEncoderReranker(UDF):
         self._model = cross_encoder
         self._batcher: AsyncMicroBatcher | None = None
         self._max_batch = max_batch
+        self._use_scheduler = use_scheduler
         self._init_kwargs = init_kwargs
 
     def _ensure_model(self):
@@ -124,7 +126,10 @@ class CrossEncoderReranker(UDF):
             def batch_score(pairs: list[tuple[str, str]]) -> list[float]:
                 return [float(s) for s in model.predict(pairs)]
 
-            self._batcher = AsyncMicroBatcher(batch_score, max_batch=self._max_batch)
+            self._batcher = AsyncMicroBatcher(
+                batch_score, max_batch=self._max_batch,
+                use_scheduler=self._use_scheduler,
+            )
         return self._model
 
     async def __wrapped__(self, doc: str, query: str, **kwargs) -> float:
@@ -142,6 +147,7 @@ class EncoderReranker(UDF):
         *,
         encoder: Any = None,
         max_batch: int = 1024,
+        use_scheduler: bool | None = None,
         **init_kwargs,
     ):
         super().__init__(executor=udfs.async_executor(), deterministic=True)
@@ -149,6 +155,7 @@ class EncoderReranker(UDF):
         self._encoder = encoder
         self._batcher: AsyncMicroBatcher | None = None
         self._max_batch = max_batch
+        self._use_scheduler = use_scheduler
         self._init_kwargs = init_kwargs
 
     def _ensure(self):
@@ -165,7 +172,10 @@ class EncoderReranker(UDF):
                 docs = enc.encode([d for _, d in pairs])
                 return [float(np.dot(q, d)) for q, d in zip(queries, docs)]
 
-            self._batcher = AsyncMicroBatcher(batch_score, max_batch=self._max_batch)
+            self._batcher = AsyncMicroBatcher(
+                batch_score, max_batch=self._max_batch,
+                use_scheduler=self._use_scheduler,
+            )
 
     async def __wrapped__(self, doc: str, query: str, **kwargs) -> float:
         self._ensure()

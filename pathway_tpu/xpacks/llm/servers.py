@@ -70,18 +70,54 @@ class BaseRestServer:
 
 
 class DocumentStoreServer(BaseRestServer):
-    """reference: servers.py:92"""
+    """reference: servers.py:92
 
-    def __init__(self, host: str, port: int, document_store, **rest_kwargs):
+    With the serving scheduler enabled (default), ``/v1/retrieve``
+    answers off the shared cross-request scheduler (fused embed→search,
+    deadline shedding) when the store exposes a plane for it; hybrid or
+    embedder-less stores keep the engine-routed endpoint.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        document_store,
+        with_scheduler: bool | None = None,
+        deadline_ms: float | None = None,
+        **rest_kwargs,
+    ):
         super().__init__(host, port, **rest_kwargs)
         self.document_store = document_store
         ds = document_store
-        self.serve(
-            "/v1/retrieve",
-            ds.RetrieveQuerySchema if hasattr(ds, "RetrieveQuerySchema") else _retrieve_schema(),
-            ds.retrieve_query,
-            EndpointDocumentation(summary="Retrieve documents", tags=["pathway"]),
-        )
+        plane = None
+        if with_scheduler is None:
+            from ._scheduler import scheduler_enabled
+
+            with_scheduler = scheduler_enabled()
+        if with_scheduler and hasattr(ds, "scheduler_retrieve_plane"):
+            plane = ds.scheduler_retrieve_plane(deadline_ms=deadline_ms)
+        self._retrieve_plane = plane
+        if plane is not None:
+            from .vector_store import _wire_index_maintenance
+
+            self.webserver.add_raw_route(
+                "/v1/retrieve",
+                ("GET", "POST"),
+                plane.aiohttp_handler(),
+                EndpointDocumentation(summary="Retrieve documents", tags=["pathway"]),
+            )
+            _wire_index_maintenance(
+                ds.retrieve_query,
+                ds.RetrieveQuerySchema if hasattr(ds, "RetrieveQuerySchema") else _retrieve_schema(),
+            )
+        else:
+            self.serve(
+                "/v1/retrieve",
+                ds.RetrieveQuerySchema if hasattr(ds, "RetrieveQuerySchema") else _retrieve_schema(),
+                ds.retrieve_query,
+                EndpointDocumentation(summary="Retrieve documents", tags=["pathway"]),
+            )
         self.serve(
             "/v1/statistics",
             ds.StatisticsQuerySchema if hasattr(ds, "StatisticsQuerySchema") else _stats_schema(),

@@ -62,15 +62,26 @@ class QueryResultSchema(Schema):
 def _merge_filters(metadata_filter: str | None, filepath_globpattern: str | None) -> str | None:
     """Combine the two request filters into one expression
     (reference: vector_store.py:358 ``merge_filters``)."""
-    parts = []
-    if metadata_filter:
-        parts.append(f"({metadata_filter})")
-    if filepath_globpattern:
-        parts.append(f"globmatch('{filepath_globpattern}', path)")
-    return " && ".join(parts) if parts else None
+    from ._utils import merge_filter_exprs
+
+    return merge_filter_exprs(metadata_filter, filepath_globpattern)
 
 
 from ._pipeline import build_document_pipeline, component_expr as _component_expr
+
+
+def _wire_index_maintenance(retrieve_query_fn, query_schema) -> None:
+    """Keep the external-index operator in the graph when the scheduler
+    plane answers queries: an empty static query stream through the same
+    ``retrieve_query`` pipeline makes the engine build and continuously
+    maintain the index (docs embed/upsert per micro-batch) while REST
+    retrieval reads it through the admission queue instead."""
+    from ...debug import table_from_rows
+    from ...io._subscribe import subscribe
+
+    queries = table_from_rows(query_schema, [])
+    result = retrieve_query_fn(queries)
+    subscribe(result, on_change=lambda *a, **k: None, name="index-maintain")
 
 
 class VectorStoreServer:
@@ -274,20 +285,63 @@ class VectorStoreServer:
         )
 
     # -- serving (reference: vector_store.py:523-582) --
-    def build_server(self, host: str, port: int, **rest_kwargs) -> None:
+    def build_server(
+        self,
+        host: str,
+        port: int,
+        *,
+        with_scheduler: bool | None = None,
+        deadline_ms: float | None = None,
+        **rest_kwargs,
+    ) -> None:
+        """Register the REST routes.
+
+        ``with_scheduler`` (default: the global setting, on unless
+        ``PATHWAY_SERVING_SCHEDULER=0``) serves ``/v1/retrieve`` off the
+        continuous cross-request scheduler — concurrent queries coalesce
+        into one fused embed→search device tick instead of riding engine
+        micro-batch cadence — with ``deadline_ms``-based shedding
+        (503 + Retry-After).  Statistics/inputs stay engine-routed.
+        """
         from ...io.http import PathwayWebserver, rest_connector
 
         webserver = PathwayWebserver(host=host, port=port)
         self._webserver = webserver
 
-        retrieval_queries, retrieval_writer = rest_connector(
-            webserver=webserver,
-            route="/v1/retrieve",
-            schema=RetrieveQuerySchema,
-            methods=("GET", "POST"),
-            delete_completed_queries=True,
-        )
-        retrieval_writer(self.retrieve_query(retrieval_queries))
+        embedder = self.embedder or getattr(self.index_factory, "embedder", None)
+        if with_scheduler is None:
+            from ._scheduler import scheduler_enabled
+
+            with_scheduler = scheduler_enabled() and embedder is not None
+        elif with_scheduler and embedder is None:
+            # fail at build time, not as a 500 on every query
+            raise ValueError(
+                "with_scheduler=True needs an embedder (the fused retrieve "
+                "plane embeds queries itself); pass embedder= or use an "
+                "index factory that carries one"
+            )
+        if with_scheduler:
+            from ._scheduler import RetrievePlane
+
+            self._retrieve_plane = RetrievePlane(
+                index_factory=self.index_factory,
+                embedder=embedder,
+                payload_columns=self._graph["chunked_docs"].column_names(),
+                deadline_ms=deadline_ms,
+            )
+            webserver.add_raw_route(
+                "/v1/retrieve", ("GET", "POST"), self._retrieve_plane.aiohttp_handler()
+            )
+            _wire_index_maintenance(self.retrieve_query, RetrieveQuerySchema)
+        else:
+            retrieval_queries, retrieval_writer = rest_connector(
+                webserver=webserver,
+                route="/v1/retrieve",
+                schema=RetrieveQuerySchema,
+                methods=("GET", "POST"),
+                delete_completed_queries=True,
+            )
+            retrieval_writer(self.retrieve_query(retrieval_queries))
 
         stats_queries, stats_writer = rest_connector(
             webserver=webserver,
@@ -315,10 +369,16 @@ class VectorStoreServer:
         with_cache: bool = True,
         cache_backend: Any = None,
         terminate_on_error: bool = True,
+        with_scheduler: bool | None = None,
+        deadline_ms: float | None = None,
     ):
         """Start serving; ``threaded=True`` runs the engine loop on a daemon
-        thread and returns it (reference: vector_store.py:558-582)."""
-        self.build_server(host=host, port=port)
+        thread and returns it (reference: vector_store.py:558-582).
+        ``with_scheduler``/``deadline_ms`` — see :meth:`build_server`."""
+        self.build_server(
+            host=host, port=port,
+            with_scheduler=with_scheduler, deadline_ms=deadline_ms,
+        )
         return run_with_cache(
             threaded=threaded,
             with_cache=with_cache,
@@ -334,7 +394,11 @@ class SlidesVectorStoreServer(VectorStoreServer):
 
 class VectorStoreClient(RestClientBase):
     """HTTP client for :class:`VectorStoreServer`
-    (reference: vector_store.py:651)."""
+    (reference: vector_store.py:651).
+
+    ``retry_on_unavailable=True`` honors the scheduler's
+    503 + ``Retry-After`` shedding with one bounded retry (off by
+    default — callers owning their own backoff keep full control)."""
 
     def __init__(self, *args, timeout: float = 15.0, **kwargs):
         super().__init__(*args, timeout=timeout, **kwargs)

@@ -274,7 +274,10 @@ while time.monotonic() < deadline:
     time.sleep(0.05)
 # barrier on OUR OWN snapshot keyspace before dying: the kill must be
 # sudden with respect to the ENGINE, but the restart needs this shard's
-# chunks on disk — without this the exit races the first chunk flush
+# chunks on disk — without this the exit races the first chunk flush.
+# The wait is bounded, not required: a shard that owns ZERO source lines
+# (line keys hash the per-run tmp path, so with a 6-line corpus that is a
+# real per-run possibility) never writes a chunk at all
 from pathway_tpu.persistence import Backend
 kv = Backend.filesystem(pstore).storage
 deadline = time.monotonic() + 30
@@ -336,11 +339,15 @@ def test_two_process_kill_restart_recovery(tmp_path):
     s0, s1 = launch("r1")
     assert not (set(s0) & set(s1))
     assert {**s0, **s1} == {"apple": 6, "banana": 3, "cherry": 3, "date": 2}
-    # per-process snapshot keyspaces exist
+    # per-process snapshot keyspaces: every shard that ingested source
+    # rows has its own chunk stream.  Line→process ownership hashes the
+    # per-run tmp path, so one process owning zero of the 6 lines is a
+    # legitimate (if unlikely) outcome — requiring BOTH -p0 and -p1 here
+    # made that coin flip a test failure (the missing-p1-chunk flake);
+    # the restart round below still pins no-duplication recovery either way
     from pathway_tpu.persistence import Backend
     keys = Backend.filesystem(str(pstore)).storage.list_keys()
-    assert any("-p0" in k for k in keys), keys
-    assert any("-p1" in k for k in keys), keys
+    assert any("snap/wordsrc-p" in k for k in keys), keys
 
     # restart with one more file: replayed shards + new data, no doubling
     (input_dir / "b.txt").write_text("banana elder")
