@@ -8,6 +8,17 @@ end that the index state matches the surviving files and that query p50
 did not degrade between the first and last thirds.
 
 Run: ``JAX_PLATFORMS=cpu SOAK_SECS=180 python benchmarks/soak.py``
+
+``--chaos`` (or ``SOAK_CHAOS=1``) additionally turns on the seeded
+fault-injection harness (``pathway_tpu.testing.faults``): connector reads
+fail/drop, UDF invocations fail, scheduler device steps fail/stall — at
+nonzero rates for the whole run, with ``terminate_on_error=False`` so
+failures land in the global error log instead of killing the run.  The
+report then includes injected-fault, error-log, dead-letter, connector
+restart and degraded-response counts alongside the usual metrics; the
+pass criterion becomes "survived the chaos and kept answering", not
+byte-exact final consistency (dropped reads are *supposed* to lose rows).
+Seed: ``SOAK_SEED`` (default 17) — a failing run replays exactly.
 """
 
 from __future__ import annotations
@@ -44,7 +55,20 @@ def _free_port() -> int:
     return port
 
 
-def run(soak_secs: float = 180.0) -> dict:
+#: chaos-mode fault plan — deliberately nonzero everywhere the harness
+#: reaches: reader failures exercise the connector supervisor's backoff
+#: restarts, drops exercise at-least-once accounting, UDF failures land
+#: ERROR rows in the global error log, scheduler failures trip the
+#: serving breaker into lexical degraded mode (and recover)
+CHAOS_RULES = {
+    "connector.read": {"fail": 0.002, "drop": 0.002},
+    "udf": {"fail": 0.01},
+    "embedder": {"fail": 0.05},
+    "scheduler.step": {"delay": 0.05, "delay_ms": 5.0},
+}
+
+
+def run(soak_secs: float = 180.0, chaos: bool = False) -> dict:
     import resource
 
     import pathway_tpu as pw
@@ -55,7 +79,20 @@ def run(soak_secs: float = 180.0) -> dict:
         VectorStoreServer,
     )
 
-    rng = random.Random(17)
+    seed = int(os.environ.get("SOAK_SEED", "17"))
+    dead_letters: list = []
+    if chaos:
+        from pathway_tpu.testing import faults
+
+        faults.configure(seed=seed, rules=CHAOS_RULES)
+        pw.set_dead_letter_sink(lambda rec: dead_letters.append(rec))
+        # the soak keeps injecting reader faults for its whole duration:
+        # give the supervisor a budget to ride them out (the default of 3
+        # is sized for real-world transients, not sustained chaos)
+        os.environ.setdefault("PATHWAY_CONNECTOR_MAX_RESTARTS", "10000")
+        os.environ.setdefault("PATHWAY_CONNECTOR_BACKOFF_S", "0.05")
+
+    rng = random.Random(seed)
     tmp = tempfile.mkdtemp(prefix="soak-")
     live: dict[str, str] = {}
 
@@ -77,14 +114,19 @@ def run(soak_secs: float = 180.0) -> dict:
     mesh = make_mesh(8)
     vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=16), mesh=mesh)
     port = _free_port()
-    vs.run_server(host="127.0.0.1", port=port, threaded=True, with_cache=False)
+    vs.run_server(
+        host="127.0.0.1", port=port, threaded=True, with_cache=False,
+        terminate_on_error=not chaos,
+    )
     client = VectorStoreClient(host="127.0.0.1", port=port)
 
-    # wait until queryable
+    # wait until queryable (under chaos, injected read drops may lose a
+    # few of the initial docs — that's the scenario, not a failure)
+    want = 30 if chaos else 40
     deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
         try:
-            if client.get_vectorstore_statistics().get("file_count", 0) >= 40:
+            if client.get_vectorstore_statistics().get("file_count", 0) >= want:
                 break
         except Exception:
             pass
@@ -95,7 +137,7 @@ def run(soak_secs: float = 180.0) -> dict:
     rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     t_end = time.monotonic() + soak_secs
     lat: list[tuple[float, float]] = []  # (t, ms)
-    n_mut = n_q = q_errors = 0
+    n_mut = n_q = q_errors = n_degraded = 0
     next_name = 40
     while time.monotonic() < t_end:
         op = rng.random()
@@ -117,6 +159,8 @@ def run(soak_secs: float = 180.0) -> dict:
                 res = client.query(text, k=1)
                 lat.append((time.monotonic(), (time.perf_counter() - t0) * 1e3))
                 n_q += 1
+                if client.last_degraded:
+                    n_degraded += 1
                 # identical text must be the top hit unless the file just
                 # changed under us — tolerate transient misses, count them
                 if not res or res[0]["text"] != text:
@@ -142,12 +186,14 @@ def run(soak_secs: float = 180.0) -> dict:
     p50_first = sorted(ms for _, ms in lat[:third])[third // 2]
     last = [ms for _, ms in lat[-third:]]
     p50_last = sorted(last)[len(last) // 2]
-    return {
+    out = {
         "metric": "serving_soak",
+        "chaos": chaos,
         "soak_secs": round(soak_secs, 0),
         "mutations": n_mut,
         "queries": n_q,
         "transient_query_misses": q_errors,
+        "degraded_responses": n_degraded,
         "final_stale_docs": stale,
         "final_live_docs": len(live),
         "server_file_count": stats.get("file_count"),
@@ -155,14 +201,52 @@ def run(soak_secs: float = 180.0) -> dict:
         "query_p50_ms_last_third": round(p50_last, 2),
         "rss_growth_mb": round((rss1 - rss0) / 1024.0, 1),
     }
+    if chaos:
+        from pathway_tpu.internals.errors import error_stats
+        from pathway_tpu.internals.health import get_health
+        from pathway_tpu.testing import faults
+
+        fstats = faults.stats()
+        health = get_health().snapshot()
+        breakers = {
+            name: comp["state"]
+            for name, comp in health["components"].items()
+            if name.startswith("breaker:")
+        }
+        from pathway_tpu.io.streaming import connector_restart_total
+
+        out.update(
+            {
+                "fault_seed": seed,
+                "faults_injected": fstats["injected_total"],
+                "faults_by_site": fstats.get("sites", {}),
+                "error_log_counts": error_stats(),
+                "dead_letters": len(dead_letters),
+                "connector_restarts": connector_restart_total(),
+                "breaker_states_final": breakers,
+                "health_status_final": health["status"],
+            }
+        )
+    return out
 
 
 if __name__ == "__main__":
-    out = run(float(os.environ.get("SOAK_SECS", "180")))
+    chaos = "--chaos" in sys.argv or os.environ.get("SOAK_CHAOS") == "1"
+    out = run(float(os.environ.get("SOAK_SECS", "180")), chaos=chaos)
     print(json.dumps(out))
-    ok = (
-        "error" not in out
-        and out["final_stale_docs"] == 0
-        and out["server_file_count"] == out["final_live_docs"]
-    )
+    if chaos:
+        # chaos criteria: survived nonzero injected faults, kept answering
+        # (most queries succeeded), and reported the fault accounting
+        ok = (
+            "error" not in out
+            and out["faults_injected"] > 0
+            and out["queries"] > 0
+            and out["transient_query_misses"] < out["queries"]
+        )
+    else:
+        ok = (
+            "error" not in out
+            and out["final_stale_docs"] == 0
+            and out["server_file_count"] == out["final_live_docs"]
+        )
     sys.exit(0 if ok else 1)

@@ -339,6 +339,17 @@ def local_error_log():
     return _lel()
 
 
+def set_dead_letter_sink(sink):
+    """Register a callable receiving every dead-lettered record
+    (``{"payload", "reason", "source", "time"}``): poison connector
+    payloads routed via ``ConnectorSubject.dead_letter`` /
+    ``on_error="dead_letter"`` land here in addition to the global error
+    log, so operators can persist them for replay."""
+    from .internals.errors import set_dead_letter_sink as _sdls
+
+    _sdls(sink)
+
+
 def table_transformer(
     func=None,
     *,
@@ -511,6 +522,7 @@ __all__ = [
     "load_yaml",
     "global_error_log",
     "local_error_log",
+    "set_dead_letter_sink",
     "sql",
     "TableSlice",
     "SchemaProperties",

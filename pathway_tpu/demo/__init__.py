@@ -29,6 +29,10 @@ class _StreamSubject(ConnectorSubject):
     """Emits ``nb_rows`` generated rows at ``input_rate`` rows/sec
     (unbounded when ``nb_rows`` is None)."""
 
+    # run() restarts from i=0 with fresh autogen keys — a supervised
+    # restart would silently duplicate already-emitted rows
+    _supervised = False
+
     def __init__(
         self,
         value_generators: dict[str, Callable[[int], Any]],
@@ -109,6 +113,9 @@ def range_stream(
 
 
 class _CsvReplaySubject(ConnectorSubject):
+    # replays from the first CSV row on re-entry — not restart-safe
+    _supervised = False
+
     def __init__(self, path: str, schema: SchemaMetaclass, input_rate: float):
         super().__init__(datasource_name=f"replay_csv:{path}")
         self.path = path

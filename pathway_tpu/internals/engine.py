@@ -526,15 +526,34 @@ class GroupByNode(Node):
         dirty: set[tuple] = set()
         for key, row, diff in entries:
             gvals = self.group_fn(key, row)
+            args = self.args_fn(key, row)
+            sort_key = self.sort_by_fn(key, row) if self.sort_by_fn else None
+            # ERROR-row guard (reference: src/engine/error.rs — rows whose
+            # grouping, reducer or sort inputs are ERROR go to the error
+            # log and never poison the aggregate: an ERROR sort key would
+            # blow up the sorted() at emission).  Symmetric across diff
+            # signs: the retraction of a skipped addition skips identically.
+            if (
+                any(v is ERROR for v in gvals)
+                or any(v is ERROR for t in args for v in t)
+                or sort_key is ERROR
+            ):
+                if diff > 0:
+                    from .errors import register_error
+
+                    register_error(
+                        "row with ERROR excluded from aggregation",
+                        kind="groupby",
+                        operator=self.name,
+                    )
+                continue
             gfrozen = freeze_row(gvals)
             self.group_raw[gfrozen] = gvals
             if self.instance_fn is not None:
                 self.group_instance[gfrozen] = self.instance_fn(key, row)
-            args = self.args_fn(key, row)
             afrozen = (freeze_row(args), key if self._needs_key() else None)
             slot = self.state[gfrozen].get(afrozen)
             if slot is None:
-                sort_key = self.sort_by_fn(key, row) if self.sort_by_fn else None
                 slot = self.state[gfrozen][afrozen] = [
                     0, args, key, sort_key, next(self._seq)
                 ]
@@ -871,6 +890,21 @@ class JoinNode(Node):
                         jk = freeze_value(jk)
             else:
                 jk = freeze_value(my_key_fn(key, row))
+            if jk is ERROR or (
+                type(jk) is tuple and any(v is ERROR for v in jk)
+            ):
+                # ERROR join keys never match and never enter join state
+                # (reference error.rs semantics): log on addition, skip the
+                # matching retraction symmetrically
+                if diff > 0:
+                    from .errors import register_error
+
+                    register_error(
+                        "row with ERROR join key excluded from join",
+                        kind="join",
+                        operator=self.name,
+                    )
+                continue
             if jk is None:
                 # null join keys never match (SQL semantics); a null-key row
                 # still participates in outer padding via a private bucket

@@ -6,15 +6,30 @@ cells and an error-log table (``error_log``/``set_error_log``
 graph.rs:958-965); ``remove_errors_from_table`` (graph.rs:984) drops rows
 containing errors.
 
-``pw.global_error_log()`` returns a table of (message, trace) rows
-appended as evaluation errors occur in a run with
-``terminate_on_error=False``; read it with ``pw.io.subscribe``.
+``pw.global_error_log()`` returns a table of (message, trace, kind,
+operator) rows appended as errors occur anywhere in the failure domain —
+evaluation errors (``kind="eval"``), async-UDF retry exhaustion
+(``"udf"``), connector read/parse failures and supervision events
+(``"connector"``), rows dead-lettered out of the pipeline
+(``"dead_letter"``), serving-plane failures (``"serving"``), sanitized
+REST handler errors (``"http"``) and stateful-operator ERROR-row drops
+(``"filter"``/``"join"``/``"groupby"``/``"index"``).  Read it with
+``pw.io.subscribe``.
+
+Beyond the log table, this module keeps process-global per-kind counters
+(surfaced on ``/v1/health`` and, via the ``register_metrics_provider``
+hook, on the OpenMetrics ``/status`` endpoint) and an optional
+**dead-letter sink**: callables registered with
+:func:`set_dead_letter_sink` receive every poisoned payload so operators
+can persist them for replay.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import TYPE_CHECKING
+import time
+from collections import defaultdict
+from typing import TYPE_CHECKING, Any, Callable
 
 from .schema import schema_from_types
 
@@ -27,6 +42,11 @@ __all__ = [
     "register_error",
     "active_local_logs",
     "set_current_local",
+    "error_stats",
+    "reset_error_stats",
+    "set_dead_letter_sink",
+    "clear_dead_letter_sinks",
+    "dead_letter",
 ]
 
 _lock = threading.Lock()
@@ -41,6 +61,16 @@ _local_stack: list = []
 # servers) must not clobber each other's routing
 _current = threading.local()
 
+# -- process-global counters (health / metrics plane) -----------------------
+_stats_lock = threading.Lock()
+_counters: dict[str, int] = defaultdict(int)
+#: (timestamp, kind) ring of recent errors for rate reporting
+_recent: list = []
+_RECENT_WINDOW_S = 60.0
+
+# -- dead-letter sinks ------------------------------------------------------
+_dead_letter_sinks: list[Callable[[dict], None]] = []
+
 
 def active_local_logs() -> tuple:
     """Captured by Operator.__init__ at graph-build time."""
@@ -51,13 +81,101 @@ def set_current_local(logs: tuple) -> None:
     _current.logs = logs
 
 
-def register_error(message: str, trace: str = "") -> None:
-    """Called by the evaluator when terminate_on_error is off."""
+def register_error(
+    message: str, trace: str = "", kind: str = "eval", operator: str = ""
+) -> None:
+    """Record one failure-domain event: bump the per-kind counter and
+    append a row to every active error-log table."""
+    now = time.time()
+    with _stats_lock:
+        _counters[kind] += 1
+        _counters["total"] += 1
+        _recent.append((now, kind))
+        cutoff = now - _RECENT_WINDOW_S
+        while _recent and _recent[0][0] < cutoff:
+            _recent.pop(0)
     with _lock:
         subjects = list(_subjects)
     for subject in (*subjects, *getattr(_current, "logs", ())):
-        subject.next(message=message, trace=trace)
+        subject.next(message=message, trace=trace, kind=kind, operator=operator)
         subject.commit()
+
+
+def error_stats() -> dict[str, Any]:
+    """Per-kind totals plus a rolling last-minute rate."""
+    now = time.time()
+    with _stats_lock:
+        cutoff = now - _RECENT_WINDOW_S
+        recent = sum(1 for t, _ in _recent if t >= cutoff)
+        return {**_counters, "last_minute": recent}
+
+
+def reset_error_stats() -> None:
+    """Test isolation hook."""
+    with _stats_lock:
+        _counters.clear()
+        _recent.clear()
+
+
+def set_dead_letter_sink(sink: Callable[[dict], None]) -> None:
+    """Register a callable receiving every dead-lettered payload as a dict
+    ``{"payload", "reason", "source", "time"}``.  Multiple sinks stack."""
+    _dead_letter_sinks.append(sink)
+
+
+def clear_dead_letter_sinks() -> None:
+    del _dead_letter_sinks[:]
+
+
+def dead_letter(payload: Any, reason: str, source: str = "") -> None:
+    """Route a poisoned record out of the pipeline: count it, log it to
+    the error-log tables, and hand it to every registered sink.  A sink
+    raising must not re-poison the caller — sink errors are counted and
+    swallowed."""
+    record = {
+        "payload": payload,
+        "reason": reason,
+        "source": source,
+        "time": time.time(),
+    }
+    for sink in list(_dead_letter_sinks):
+        try:
+            sink(record)
+        except Exception:  # noqa: BLE001 — a broken sink must not cascade
+            with _stats_lock:
+                _counters["dead_letter_sink_error"] += 1
+    register_error(reason, trace=repr(payload)[:500], kind="dead_letter",
+                   operator=source)
+
+
+class _ErrorMetrics:
+    """OpenMetrics provider: ``pathway_errors_total{kind=...}`` counters."""
+
+    def stats(self) -> dict[str, Any]:
+        return error_stats()
+
+    def openmetrics_lines(self) -> list[str]:
+        s = error_stats()
+        lines = ["# TYPE pathway_errors_total counter"]
+        for kind, n in sorted(s.items()):
+            if kind in ("last_minute", "total"):
+                # "total" is the sum of the kinds — emitting it under the
+                # same label would double any sum() over the series
+                continue
+            lines.append(f'pathway_errors_total{{kind="{kind}"}} {n}')
+        lines.append("# TYPE pathway_errors_last_minute gauge")
+        lines.append(f"pathway_errors_last_minute {s['last_minute']}")
+        return lines
+
+
+#: strong module ref — register_metrics_provider holds providers weakly
+_ERROR_METRICS = _ErrorMetrics()
+
+
+def _register_metrics() -> None:
+    from .monitoring import register_metrics_provider
+
+    register_metrics_provider("errors", _ERROR_METRICS)
 
 
 def global_error_log() -> "Table":
@@ -68,32 +186,36 @@ def global_error_log() -> "Table":
     emitted mid-run ride the driver's regular drain cycle.
     """
     from ..io._utils import input_table
+
+    subject = _make_log_subject("error_log")
+    with _lock:
+        _subjects.append(subject)
+    return input_table(subject._schema, subject=subject)
+
+
+def _make_log_subject(name: str):
     from ..io.streaming import ConnectorSubject
 
     class _ErrorLogSubject(ConnectorSubject):
+        # the log subject is internal plumbing: fault injection and
+        # supervision restarts must not apply to it
+        _fault_site = None
+        _supervised = False
+
         def run(self) -> None:
             return
 
-    schema = schema_from_types(message=str, trace=str)
-    subject = _ErrorLogSubject(datasource_name="error_log")
+    schema = schema_from_types(message=str, trace=str, kind=str, operator=str)
+    subject = _ErrorLogSubject(datasource_name=name)
     subject._configure(schema, None)
-    with _lock:
-        _subjects.append(subject)
-    return input_table(schema, subject=subject)
+    return subject
 
 
 def _make_log_table():
     from ..io._utils import input_table
-    from ..io.streaming import ConnectorSubject
 
-    class _ErrorLogSubject(ConnectorSubject):
-        def run(self) -> None:
-            return
-
-    schema = schema_from_types(message=str, trace=str)
-    subject = _ErrorLogSubject(datasource_name="local_error_log")
-    subject._configure(schema, None)
-    return subject, input_table(schema, subject=subject)
+    subject = _make_log_subject("local_error_log")
+    return subject, input_table(subject._schema, subject=subject)
 
 
 import contextlib
@@ -110,3 +232,6 @@ def local_error_log():
         yield table
     finally:
         _local_stack.remove(subject)
+
+
+_register_metrics()

@@ -19,6 +19,7 @@ from . import expression as expr_mod
 from .value import ERROR, Json, Pointer
 from .keys import ref_scalar
 from . import dtype as dt
+from ..testing import faults
 
 __all__ = ["compile_expression", "compile_vector_expression", "EvalContext"]
 
@@ -29,12 +30,16 @@ class EvalContext:
     terminate_on_error: bool = True
 
     @classmethod
-    def handle(cls, exc: Exception):
+    def handle(cls, exc: Exception, kind: str = "eval", operator: str = ""):
         if cls.terminate_on_error:
             raise exc
         from .errors import register_error
 
-        register_error(f"{type(exc).__name__}: {exc}")
+        retries = getattr(exc, "retries_exhausted", None)
+        suffix = "" if retries is None else f" (after {retries} retries)"
+        register_error(
+            f"{type(exc).__name__}: {exc}{suffix}", kind=kind, operator=operator
+        )
         return ERROR
 
 
@@ -137,13 +142,15 @@ def compile_expression(
             ):
                 return None
             try:
+                if faults.enabled:
+                    faults.perturb("udf")
                 if is_async:
                     import asyncio
 
                     return asyncio.run(fun(*args, **kwargs))
                 return fun(*args, **kwargs)
             except Exception as exc:
-                return EvalContext.handle(exc)
+                return EvalContext.handle(exc, kind="udf")
 
         return run_apply
 

@@ -1,0 +1,176 @@
+"""Process-global health registry backing the ``/v1/health`` endpoint.
+
+reference inspiration: the reference engine exposes per-connector monitor
+state and an OpenMetrics endpoint but no liveness/readiness contract; a
+live RAG service needs one (EdgeRAG, arXiv 2412.21023: degrade gracefully
+under resource failure instead of failing closed).  Components across the
+stack register here:
+
+* the streaming driver registers the ``engine`` component and heartbeats
+  it every loop iteration (an engine watchdog: a wedged engine thread
+  stops beating and readiness drops);
+* the connector supervisor (``io/streaming.py``) registers one
+  ``connector:<name>`` component per source with its supervision state
+  (``running`` / ``backoff`` / ``failed`` / ``finished``);
+* serving circuit breakers (``xpacks/llm/_breaker.py``) register
+  ``breaker:<name>`` components — an OPEN breaker marks the process
+  *degraded* (still serving, via fallbacks) rather than unready;
+* the distributed driver registers ``ingest_thread`` and flips it to
+  ``leaked`` if the thread survives its join timeout.
+
+Readiness = every *critical* component is ready AND the engine heartbeat
+(when an engine is registered and running) is fresher than
+``engine_stall_s``.  Degraded = ready, but at least one component flags
+itself degraded (tripped breaker, connector in backoff).
+
+Scope note: the registry assumes ONE live engine per process (the
+deployment shape of every server here; multi-process scale-out gives
+each process its own registry).  Starting a second concurrent ``pw.run``
+in the same process re-claims the run-scoped components — the last run
+owns ``/v1/health``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+__all__ = ["HealthRegistry", "get_health", "reset_health"]
+
+
+class HealthRegistry:
+    """Thread-safe component/heartbeat registry (see module docstring)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # name -> {state, ready, degraded, critical, detail, since, scope}
+        self._components: dict[str, dict] = {}
+        self._beats: dict[str, float] = {}
+        self.started_at = time.time()
+        self.engine_stall_s = float(
+            os.environ.get("PATHWAY_HEALTH_STALL_S", "10")
+        )
+
+    def set_component(
+        self,
+        name: str,
+        state: str,
+        *,
+        ready: bool = True,
+        degraded: bool = False,
+        critical: bool = True,
+        detail: str = "",
+        scope: str = "run",
+    ) -> None:
+        """``scope="run"`` components are cleared by :meth:`begin_run`
+        (driver-owned: engine, connectors); ``scope="process"`` ones
+        persist (breakers, serving planes)."""
+        with self._lock:
+            self._components[name] = {
+                "state": state,
+                "ready": bool(ready),
+                "degraded": bool(degraded),
+                "critical": bool(critical),
+                "detail": detail,
+                "since": time.time(),
+                "scope": scope,
+            }
+
+    def remove_component(self, name: str) -> None:
+        with self._lock:
+            self._components.pop(name, None)
+            self._beats.pop(name, None)
+
+    def beat(self, name: str = "engine") -> None:
+        # plain float store is GIL-atomic; no lock on the hot path
+        self._beats[name] = time.monotonic()
+
+    def heartbeat_age(self, name: str = "engine") -> float | None:
+        t = self._beats.get(name)
+        return None if t is None else time.monotonic() - t
+
+    def begin_run(self) -> None:
+        """Called by the streaming driver at run start: a fresh run owns
+        the run-scoped components (a previous run's finished connectors
+        must not linger in the snapshot)."""
+        with self._lock:
+            self._components = {
+                n: c
+                for n, c in self._components.items()
+                if c.get("scope") != "run"
+            }
+            self._beats.pop("engine", None)
+
+    # -- snapshot / readiness ------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            components = {n: dict(c) for n, c in self._components.items()}
+        engine = components.get("engine")
+        engine_age = self.heartbeat_age("engine")
+        if (
+            engine is not None
+            and engine["state"] == "running"
+            and engine_age is not None
+            and engine_age > self.engine_stall_s
+        ):
+            engine["state"] = "stalled"
+            engine["ready"] = False
+            engine["detail"] = (
+                f"no heartbeat for {engine_age:.1f}s "
+                f"(threshold {self.engine_stall_s:g}s)"
+            )
+        for name, comp in components.items():
+            comp.pop("scope", None)
+            comp["since"] = round(time.time() - comp["since"], 3)
+        if engine is None:
+            # warmup: the webserver can be up before the engine loop is —
+            # report unready instead of guessing
+            ready = False
+            status = "starting"
+        else:
+            ready = all(
+                c["ready"] for c in components.values() if c["critical"]
+            )
+            degraded = any(c["degraded"] for c in components.values())
+            status = "ready" if ready else "unready"
+            if ready and degraded:
+                status = "degraded"
+        snap: dict[str, Any] = {
+            "status": status,
+            "ready": ready,
+            "components": components,
+        }
+        if engine_age is not None:
+            snap["engine_heartbeat_age_s"] = round(engine_age, 3)
+        from .errors import error_stats
+
+        snap["errors"] = error_stats()
+        try:
+            from ..testing import faults
+
+            if faults.enabled:
+                snap["faults"] = faults.stats()
+        except Exception:  # noqa: BLE001 — health must never raise
+            pass
+        return snap
+
+
+_health_lock = threading.Lock()
+_health: HealthRegistry | None = None
+
+
+def get_health() -> HealthRegistry:
+    global _health
+    with _health_lock:
+        if _health is None:
+            _health = HealthRegistry()
+        return _health
+
+
+def reset_health() -> None:
+    """Test isolation hook: drop the process-global registry."""
+    global _health
+    with _health_lock:
+        _health = None

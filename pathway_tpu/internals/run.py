@@ -144,8 +144,19 @@ def run(
             with_http_server=with_http_server,
             exchange_plane=exchange_plane,
         )
-        with telemetry.span("graph_runner.run"):
-            driver.run()
+        try:
+            with telemetry.span("graph_runner.run"):
+                driver.run()
+        except BaseException as exc:
+            # a dying engine loop (threaded servers especially) must be
+            # visible on /v1/health, not just in a daemon thread's traceback
+            from .health import get_health
+
+            get_health().set_component(
+                "engine", "dead", ready=False,
+                detail=f"{type(exc).__name__}: {exc}",
+            )
+            raise
     finally:
         # idempotent close (double-close after a successful _run_distributed
         # is a no-op): on failure the peers see the socket drop and abort

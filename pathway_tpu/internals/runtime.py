@@ -40,7 +40,7 @@ from .graph import G, Operator
 from .groupbys import _GroupColExpression, _ReducerSlotExpression
 from .joins import JoinMode
 from .keys import derive_subkey, ref_pair, ref_pointer, ref_scalar
-from .value import Pointer
+from .value import ERROR, Pointer
 
 __all__ = ["GraphRunner", "build_engine"]
 
@@ -245,8 +245,12 @@ class GraphRunner:
                 if cap is not None:
                     capacity = cap if capacity is None else min(capacity, cap)
 
-            async def async_fn(row, _slot_fns=slot_fns):
-                import asyncio
+            op_name = f"async#{op.id}"
+
+            async def async_fn(row, _slot_fns=slot_fns, _op=op_name):
+                from ..testing import faults
+                from .evaluator import EvalContext
+                from .value import ERROR
 
                 key, values = row
                 ctx = (key, values)
@@ -254,10 +258,26 @@ class GraphRunner:
                 for fun, arg_fns, kwarg_fns, propagate_none in _slot_fns:
                     args = [f(ctx) for f in arg_fns]
                     kwargs = {k: f(ctx) for k, f in kwarg_fns.items()}
+                    if any(a is ERROR for a in args) or any(
+                        v is ERROR for v in kwargs.values()
+                    ):
+                        results.append(ERROR)
+                        continue
                     if propagate_none and any(a is None for a in args):
                         results.append(None)
                         continue
-                    results.append(await fun(*args, **kwargs))
+                    # failure domain: an async UDF whose retries are
+                    # exhausted must not tear down the engine loop — under
+                    # terminate_on_error=False the row carries ERROR and
+                    # the failure lands in the global error log
+                    try:
+                        if faults.enabled:
+                            faults.perturb("udf")
+                        results.append(await fun(*args, **kwargs))
+                    except Exception as exc:  # noqa: BLE001 — routed
+                        results.append(
+                            EvalContext.handle(exc, kind="udf", operator=_op)
+                        )
                 return (key, tuple(values) + tuple(results))
 
             # AsyncMapNode operates on rows; we need key in ctx, so wrap rows
@@ -377,9 +397,25 @@ class GraphRunner:
 
         def builder(fns, layout):
             cond_fn = fns[0]
+            op_name = f"filter#{op.id}"
 
             def fn(key, row, diff):
-                if cond_fn((key, row)):
+                c = cond_fn((key, row))
+                if c is ERROR:
+                    # reference semantics (src/engine/error.rs): an ERROR
+                    # condition drops the row and logs it — ERROR is truthy
+                    # in Python, so without this guard poisoned rows would
+                    # silently PASS the filter
+                    if diff > 0:
+                        from .errors import register_error
+
+                        register_error(
+                            "filter condition evaluated to ERROR; row dropped",
+                            kind="filter",
+                            operator=op_name,
+                        )
+                    return []
+                if c:
                     return [(key, row[:width], diff)]  # row is a tuple; slice is too
                 return []
 
@@ -398,9 +434,23 @@ class GraphRunner:
         col_idx = names.index(op.params["column"])
         origin = op.params.get("origin_id") is not None
 
+        op_name = f"flatten#{op.id}"
+
         def fn(key, row, diff):
             seq = row[col_idx]
             if seq is None:
+                return []
+            if seq is ERROR:
+                # a poisoned sequence (e.g. failed parse UDF under
+                # terminate_on_error=False) flattens to nothing, loudly
+                if diff > 0:
+                    from .errors import register_error
+
+                    register_error(
+                        "flatten input is ERROR; row dropped",
+                        kind="eval",
+                        operator=op_name,
+                    )
                 return []
             out = []
             for i, v in enumerate(_iter_flat(seq)):

@@ -85,6 +85,12 @@ class FixedDelayRetryStrategy(AsyncRetryStrategy):
                 if attempt == self.max_retries:
                     break
                 await asyncio.sleep(self._next_delay(attempt))
+        # annotate exhaustion so the error-log entry distinguishes a
+        # retried-to-death call from a first-shot failure
+        try:
+            last.retries_exhausted = self.max_retries  # type: ignore[union-attr]
+        except Exception:  # noqa: BLE001 — slots-only exception classes
+            pass
         raise last  # type: ignore[misc]
 
 
@@ -402,17 +408,27 @@ class UDF:
             return False
         return asyncio.iscoroutinefunction(target)
 
+    def async_callable(self) -> Callable:
+        """The fully-wrapped async callable this UDF executes per row —
+        retry strategy, timeout and cache applied in executor order.  Lets
+        supervision layers (e.g. the circuit-breaker-guarded LLM path in
+        ``xpacks/llm/question_answering.py``) invoke the UDF's semantics
+        outside an expression context without losing its resilience
+        config."""
+        afun = coerce_async(self.__wrapped__)
+        if self.executor.retry_strategy is not None:
+            afun = with_retry_strategy(afun, self.executor.retry_strategy)
+        if self.executor.timeout is not None:
+            afun = with_timeout(afun, self.executor.timeout)
+        if self.cache_strategy is not None:
+            afun = with_cache_strategy(afun, self.cache_strategy)
+        return afun
+
     def __call__(self, *args, **kwargs) -> ColumnExpression:
         fun: Callable = self.__wrapped__
         return_type = self._resolved_return_type()
         if self._is_async():
-            afun = coerce_async(fun)
-            if self.executor.retry_strategy is not None:
-                afun = with_retry_strategy(afun, self.executor.retry_strategy)
-            if self.executor.timeout is not None:
-                afun = with_timeout(afun, self.executor.timeout)
-            if self.cache_strategy is not None:
-                afun = with_cache_strategy(afun, self.cache_strategy)
+            afun = self.async_callable()
             expr_cls = (
                 FullyAsyncApplyExpression
                 if self.executor.kind == "fully_async"

@@ -66,11 +66,32 @@ class _HttpPollSubject(ConnectorSubject):
         self._fetch_once()
         if self._mode == "static":
             return
+        consecutive_failures = 0
         while not self._closed.is_set():
-            _time.sleep(self.refresh_s)
+            # exponential backoff on a flapping endpoint instead of
+            # hammering it at the refresh cadence; recovery resets
+            wait_s = min(
+                self.refresh_s * (2.0 ** consecutive_failures), 60.0
+            )
+            if self._closed.wait(wait_s):
+                return
             try:
                 self._fetch_once()
-            except Exception:  # noqa: BLE001 — endpoint may flap; keep polling
+                consecutive_failures = 0
+            except Exception as exc:  # noqa: BLE001 — endpoint may flap
+                consecutive_failures += 1
+                if consecutive_failures in (1, 5):
+                    # log the first failure and the point where backoff is
+                    # clearly engaged; avoid one log line per poll forever
+                    from ...internals.errors import register_error
+
+                    register_error(
+                        f"http poll of {self.url} failing "
+                        f"({consecutive_failures} consecutive): "
+                        f"{type(exc).__name__}: {exc}",
+                        kind="connector",
+                        operator=self._datasource_name,
+                    )
                 continue
 
 

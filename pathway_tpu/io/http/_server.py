@@ -111,7 +111,40 @@ class PathwayWebserver:
 
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
-        app = web.Application()
+
+        @web.middleware
+        async def sanitize_errors_mw(request, handler):
+            """An unhandled handler exception must not leak a traceback
+            body to the client: return structured JSON 500, count it, and
+            log with route context (the traceback goes to the log)."""
+            try:
+                return await handler(request)
+            except (web.HTTPException, asyncio.CancelledError):
+                raise
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "unhandled REST handler error on %s %s",
+                    request.method, request.path,
+                )
+                from ...internals.errors import register_error
+
+                register_error(
+                    f"unhandled REST handler error on "
+                    f"{request.method} {request.path}",
+                    kind="http",
+                    operator=request.path,
+                )
+                return web.json_response(
+                    {
+                        "error": "internal server error",
+                        "route": request.path,
+                    },
+                    status=500,
+                )
+
+        app = web.Application(middlewares=[sanitize_errors_mw])
         for route, methods, handler in self._routes:
             for m in methods:
                 app.router.add_route(m, route, handler)
@@ -120,6 +153,23 @@ class PathwayWebserver:
             return web.json_response(self.openapi_description_json())
 
         app.router.add_get("/_schema", openapi_handler)
+
+        async def health_handler(_request):
+            """Liveness/readiness: engine watchdog + connector supervision
+            + breaker states + error-log counters, from the process-global
+            health registry.  503 while unready (warmup, stalled engine,
+            leaked ingest thread); 200 when ready — ``status`` flips to
+            ``"degraded"`` when a breaker is open or a connector is in
+            backoff but the service still answers."""
+            from ...internals.health import get_health
+
+            snap = get_health().snapshot()
+            return web.json_response(
+                snap, status=200 if snap["ready"] else 503
+            )
+
+        if not any(route == "/v1/health" for route, _, _ in self._routes):
+            app.router.add_get("/v1/health", health_handler)
         if self.with_cors:
 
             @web.middleware

@@ -14,7 +14,9 @@ stamps with a micro-batch timestamp and pushes through the engine — one
 from __future__ import annotations
 
 import json
+import logging
 import os
+import random
 import threading
 import time as _time
 from typing import Any, Callable, Iterable
@@ -22,8 +24,16 @@ from typing import Any, Callable, Iterable
 from ..internals.engine import Engine, Entry, SourceNode
 from ..internals.keys import ref_scalar
 from ..internals.value import Json, Pointer
+from ..testing import faults
 
-__all__ = ["ConnectorSubject", "StreamingDriver", "next_autogen_key"]
+__all__ = [
+    "ConnectorSubject",
+    "ConnectorSupervisor",
+    "StreamingDriver",
+    "next_autogen_key",
+]
+
+logger = logging.getLogger(__name__)
 
 _autogen_lock = threading.Lock()
 _autogen_counter = 0
@@ -70,6 +80,22 @@ class ConnectorSubject:
     #: owns, so a record enters the system exactly once globally.  False
     #: for process-local subjects (REST requests, custom python sources).
     _shared_source: bool = False
+    #: supervision (ConnectorSupervisor): a reader exception no longer
+    #: silently kills the source — run() is restarted with exponential
+    #: backoff up to ``_max_restarts`` times (None = env
+    #: PATHWAY_CONNECTOR_MAX_RESTARTS, default 3), then the connector is
+    #: marked failed on /v1/health while the run keeps going.  Set
+    #: ``_supervised = False`` for subjects whose run() is not safely
+    #: re-enterable (emits non-idempotent rows without dedup/upsert).
+    _supervised: bool = True
+    _max_restarts: int | None = None
+    #: fault-injection site for rows this subject pushes (None = exempt,
+    #: e.g. the error-log subjects themselves)
+    _fault_site: str | None = "connector.read"
+    #: "raise" (default) re-raises malformed payloads into the reader
+    #: (supervisor territory); "dead_letter" routes them to the global
+    #: error log + dead-letter sinks and keeps consuming
+    _on_error: str = "raise"
 
     def __init__(self, datasource_name: str = "python") -> None:
         self._datasource_name = datasource_name
@@ -117,9 +143,31 @@ class ConnectorSubject:
         self._push("insert", key, values)
 
     def next_json(self, message: dict | str | bytes) -> None:
-        if isinstance(message, (str, bytes)):
-            message = json.loads(message)
+        try:
+            if isinstance(message, (str, bytes)):
+                message = json.loads(message)
+            if not isinstance(message, dict):
+                raise TypeError(
+                    f"expected a JSON object, got {type(message).__name__}"
+                )
+        except (ValueError, TypeError) as exc:
+            if self._on_error == "dead_letter":
+                self.dead_letter(message, exc)
+                return
+            raise
         self.next(**message)
+
+    def dead_letter(self, payload: Any, exc: Exception | None = None) -> None:
+        """Route a poison record out of the stream: it lands in
+        ``pw.global_error_log()`` (kind ``dead_letter``) and every sink
+        registered via ``pw.set_dead_letter_sink`` — the pipeline keeps
+        consuming."""
+        from ..internals.errors import dead_letter as _dead_letter
+
+        reason = (
+            f"{type(exc).__name__}: {exc}" if exc is not None else "poison record"
+        )
+        _dead_letter(payload, reason, source=self._datasource_name)
 
     def next_str(self, message: str) -> None:
         self.next(data=message)
@@ -203,6 +251,11 @@ class ConnectorSubject:
         return next_autogen_key(self._datasource_name)
 
     def _push(self, op: str, key: Any, values: tuple | None) -> None:
+        if faults.enabled and self._fault_site is not None:
+            # chaos harness: "fail" raises into the reader thread (the
+            # supervisor's backoff territory), "drop" loses the row
+            if faults.perturb(self._fault_site) == "drop":
+                return
         with self._lock:
             self._pending.append((op, key, values))
 
@@ -252,6 +305,141 @@ class ConnectorSubject:
             src.push(0, list(self._static_entries))
 
 
+#: process-lifetime reader-restart counter (chaos soak reporting and
+#: operational introspection) — survives finished runs' supervisors
+_restart_total = 0
+
+
+def connector_restart_total() -> int:
+    """Total reader restarts across all supervised connectors so far."""
+    return _restart_total
+
+
+class ConnectorSupervisor:
+    """Runs one subject's reader under supervision (reference inspiration:
+    src/connectors/mod.rs reader threads, which on error poison the whole
+    run — here a reader exception instead triggers exponential-backoff
+    restarts, bounded by ``max_restarts``, with per-connector state
+    surfaced on ``/v1/health``).
+
+    Restart safety: connectors that dedupe (fs/http ``_seen``) or run
+    upsert sessions re-enter ``run()`` cleanly; subjects that cannot set
+    ``_supervised = False`` and keep the old die-silently behavior, minus
+    the silence (the failure is logged and the connector marked failed).
+    """
+
+    #: after this long healthy, the restart budget refills
+    BACKOFF_RESET_S = 60.0
+
+    def __init__(self, subject: ConnectorSubject, label: str):
+        self.subject = subject
+        self.label = label
+        self.restarts = 0
+        self.max_restarts = subject._max_restarts
+        if self.max_restarts is None:
+            self.max_restarts = int(
+                os.environ.get("PATHWAY_CONNECTOR_MAX_RESTARTS", "3")
+            )
+        self.backoff_s = float(
+            os.environ.get("PATHWAY_CONNECTOR_BACKOFF_S", "0.1")
+        )
+        self.backoff_cap_s = float(
+            os.environ.get("PATHWAY_CONNECTOR_BACKOFF_CAP_S", "30")
+        )
+
+    def _health(self):
+        from ..internals.health import get_health
+
+        return get_health()
+
+    def _set_state(self, state: str, *, ready: bool = True,
+                   degraded: bool = False, detail: str = "") -> None:
+        # connectors are not individually critical for readiness: one
+        # failed source must not mark an otherwise-serving process
+        # unready — it shows as degraded instead
+        self._health().set_component(
+            f"connector:{self.label}", state,
+            ready=ready, degraded=degraded, critical=False, detail=detail,
+        )
+
+    def run(self) -> None:
+        """Reader-thread body: run → (on failure) backoff → rerun."""
+        from ..internals.errors import register_error
+
+        subject = self.subject
+        attempt = 0
+        delay = self.backoff_s
+        while True:
+            started = _time.monotonic()
+            try:
+                self._set_state("running")
+                subject.run()
+                self._set_state("finished")
+                return
+            except BaseException as exc:  # noqa: BLE001 — supervised
+                if subject._closed.is_set():
+                    # shutdown race: the failure is a consequence of
+                    # closing, not a fault
+                    self._set_state("finished")
+                    return
+                register_error(
+                    f"connector {self.label!r} reader failed: "
+                    f"{type(exc).__name__}: {exc}",
+                    kind="connector",
+                    operator=self.label,
+                )
+                if not subject._supervised:
+                    self._set_state(
+                        "failed", ready=True, degraded=True,
+                        detail=f"unsupervised reader died: {exc}",
+                    )
+                    logger.error(
+                        "connector %r reader died (unsupervised): %s",
+                        self.label, exc,
+                    )
+                    return
+                if _time.monotonic() - started > self.BACKOFF_RESET_S:
+                    attempt = 0
+                    delay = self.backoff_s
+                if attempt >= self.max_restarts:
+                    self.restarts = attempt
+                    self._set_state(
+                        "failed", ready=True, degraded=True,
+                        detail=(
+                            f"gave up after {attempt} restarts: "
+                            f"{type(exc).__name__}: {exc}"
+                        ),
+                    )
+                    logger.error(
+                        "connector %r failed permanently after %d restarts: %s",
+                        self.label, attempt, exc,
+                    )
+                    return
+                attempt += 1
+                self.restarts = attempt
+                global _restart_total
+                _restart_total += 1
+                sleep_s = min(delay, self.backoff_cap_s) * (
+                    1.0 + random.uniform(0.0, 0.25)
+                )
+                self._set_state(
+                    "backoff", degraded=True,
+                    detail=(
+                        f"restart {attempt}/{self.max_restarts} in "
+                        f"{sleep_s:.2f}s after {type(exc).__name__}: {exc}"
+                    ),
+                )
+                logger.warning(
+                    "connector %r reader failed (%s); restart %d/%d in %.2fs",
+                    self.label, exc, attempt, self.max_restarts, sleep_s,
+                )
+                # responsive to shutdown: close() sets _closed
+                if subject._closed.wait(sleep_s):
+                    self._set_state("finished")
+                    return
+                delay = min(delay * 2.0, self.backoff_cap_s)
+
+
 class StreamingDriver:
     """The run loop behind ``pw.run`` (reference: timely's
     ``worker.step_or_park`` pump, dataflow.rs:5689-5731, with connector
@@ -296,6 +484,8 @@ class StreamingDriver:
         #: the per-tick commit record instead of input snapshot chunks
         self._commit_subjects: dict[int, tuple] = {}
         self._op_snapshot = None
+        #: subject-id -> ConnectorSupervisor (restart counts for soak/health)
+        self.supervisors: dict[int, ConnectorSupervisor] = {}
 
     def _snapshot_storage(self):
         """KV storage when full persistence is on (not UDF-caching-only)."""
@@ -551,11 +741,18 @@ class StreamingDriver:
         self._op_snapshot.mark_committed(t)
 
     def run(self) -> None:
+        from ..internals.health import get_health
+
+        health = get_health()
+        health.begin_run()
+        health.set_component("engine", "running", ready=True)
+        health.beat("engine")
         if self.exchange_plane is not None:
             self._run_distributed()
             return
         if not self.subject_src:
             self.engine.run_all()
+            health.set_component("engine", "finished", ready=True)
             return
         data_event = threading.Event()
         # statically-fed sources (debug tables, static subjects) queued rows
@@ -576,13 +773,22 @@ class StreamingDriver:
             self._live_loop(data_event, t, last_autocommit)
         self._record_finished_connectors()
         self.engine.finish()
+        from ..internals.health import get_health
+
+        get_health().set_component("engine", "finished", ready=True)
 
     def _live_loop(self, data_event, t, last_autocommit) -> None:
+        from ..internals.health import get_health
+
+        health = get_health()
         loop_start = _time.monotonic()
         warned_stalled: set[int] = set()
         while True:
             data_event.wait(timeout=self.autocommit_ms / 1000.0)
             data_event.clear()
+            # engine watchdog: a wedged loop stops beating and /v1/health
+            # flips unready after health.engine_stall_s
+            health.beat("engine")
             now = _time.monotonic()
             persisting = self._snapshot_storage() is not None
             for subject, _src in self.subject_src:
@@ -692,10 +898,14 @@ class StreamingDriver:
         for subject, _src in self.subject_src:
             if data_event is not None:
                 subject._data_event = data_event
+            supervisor = ConnectorSupervisor(
+                subject, self._connector_label(subject)
+            )
+            self.supervisors[id(subject)] = supervisor
 
-            def runner(s=subject):
+            def runner(s=subject, sup=supervisor):
                 try:
-                    s.run()
+                    sup.run()
                 finally:
                     s.close()
                     s.on_stop()
@@ -983,9 +1193,15 @@ class StreamingDriver:
         stop_ingest = threading.Event()
         ingest_error: list[BaseException] = []
 
+        from ..internals.health import get_health
+
+        health = get_health()
+        health.set_component("ingest_thread", "running", ready=True)
+
         def ingest_loop() -> None:
             try:
                 while not stop_ingest.is_set():
+                    health.beat("ingest_thread")
                     with inflight_lock:
                         data_inflight = sum(1 for e in inflight if e[2])
                         total = len(inflight)
@@ -998,11 +1214,16 @@ class StreamingDriver:
                     ingest_round()
             except BaseException as exc:  # noqa: BLE001 — surfaced by main
                 ingest_error.append(exc)
+                health.set_component(
+                    "ingest_thread", "dead", ready=False,
+                    detail=f"{type(exc).__name__}: {exc}",
+                )
 
         ingest_thread = threading.Thread(target=ingest_loop, daemon=True)
         ingest_thread.start()
         try:
             while True:
+                health.beat("engine")
                 if ingest_error:
                     raise ingest_error[0]
                 with inflight_lock:
@@ -1041,6 +1262,24 @@ class StreamingDriver:
         finally:
             stop_ingest.set()
             ingest_thread.join(timeout=10)
+            if ingest_thread.is_alive():
+                # a stuck reader (hung socket, wedged commit) leaks a live
+                # daemon thread that keeps draining subjects after "exit":
+                # say so loudly and pin it on /v1/health instead of
+                # silently returning
+                from ..internals.errors import register_error
+
+                detail = (
+                    "ingest thread failed to stop within 10s — leaked a "
+                    "live thread still draining connector subjects"
+                )
+                logger.error("%s", detail)
+                register_error(detail, kind="connector", operator="ingest_thread")
+                health.set_component(
+                    "ingest_thread", "leaked", ready=False, detail=detail
+                )
+            else:
+                health.set_component("ingest_thread", "stopped", ready=True)
         self._record_finished_connectors()
         self.engine.finish()
         plane.close()

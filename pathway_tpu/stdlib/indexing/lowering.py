@@ -19,6 +19,7 @@ from typing import Any
 
 from ...internals.engine import Entry, Node, consolidate
 from ...internals.evaluator import compile_expression
+from ...internals.value import ERROR
 from ...internals.runtime import GraphRunner, _TableLayout
 from ...internals.graph import Operator
 
@@ -88,9 +89,26 @@ class ExternalIndexNode(Node):
         # 1. apply index updates (updates-before-queries)
         for key, row, diff in self.take(0):
             index_changed = True
+            ctx = (key, row)
+            data = self.doc_data_fn(ctx)
+            meta = self.doc_meta_fn(ctx)
+            if data is ERROR or meta is ERROR:
+                # a document whose embedding/metadata errored (failed UDF
+                # under terminate_on_error=False) must not poison the
+                # index: skip it both ways (its retraction computes the
+                # same ERROR and is skipped symmetrically) and log once
+                if diff > 0:
+                    from ...internals.errors import register_error
+
+                    register_error(
+                        "document with ERROR embedding/metadata excluded "
+                        "from index",
+                        kind="index",
+                        operator=self.name,
+                    )
+                continue
             if diff > 0:
-                ctx = (key, row)
-                self.index.add(key, self.doc_data_fn(ctx), self.doc_meta_fn(ctx))
+                self.index.add(key, data, meta)
                 self.doc_payload[key] = self.doc_payload_fn(ctx)
             else:
                 self.index.remove(key)
@@ -146,16 +164,27 @@ class ExternalIndexNode(Node):
         queries = []
         for row in rows:
             ctx = (None, row)
-            queries.append(
-                (
-                    self.query_data_fn(ctx),
-                    int(self.query_k_fn(ctx)),
-                    self.query_filter_fn(ctx),
+            q = self.query_data_fn(ctx)
+            k = self.query_k_fn(ctx)
+            flt = self.query_filter_fn(ctx)
+            if q is ERROR or k is ERROR or flt is ERROR:
+                # an errored query gets an empty reply instead of
+                # crashing the whole batch's device search
+                from ...internals.errors import register_error
+
+                register_error(
+                    "query with ERROR input answered empty",
+                    kind="index",
+                    operator=self.name,
                 )
-            )
-        raw = self.index.search(queries)
+                queries.append(None)
+            else:
+                queries.append((q, int(k), flt))
+        raw = self.index.search([q for q in queries if q is not None])
+        raw_iter = iter(raw)
         replies = []
-        for matches in raw:
+        for q in queries:
+            matches = () if q is None else next(raw_iter)
             replies.append(
                 tuple(
                     (key, float(score), self.doc_payload.get(key))

@@ -1,0 +1,5 @@
+"""Testing utilities: the deterministic fault-injection harness."""
+
+from . import faults
+
+__all__ = ["faults"]

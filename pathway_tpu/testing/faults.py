@@ -1,0 +1,224 @@
+"""Deterministic, seeded fault injection for chaos testing.
+
+The harness perturbs well-known *sites* in the product stack at
+configurable rates — every decision is a pure function of
+``(seed, site, per-site call counter)``, so a failing chaos run replays
+exactly from its printed seed regardless of thread interleaving.
+
+Sites wired into the codebase:
+
+========================  ====================================================
+``connector.read``        every row a :class:`ConnectorSubject` pushes
+                          (``io/streaming.py``) — ``fail`` raises inside the
+                          reader (exercising the connector supervisor's
+                          backoff restarts), ``drop`` silently loses the row
+                          (dead-letter / at-least-once testing)
+``udf``                   every UDF/apply invocation (sync path in
+                          ``internals/evaluator.py``, async path in
+                          ``internals/runtime.py``) — ``fail`` raises
+                          (routed to the global error log as ERROR rows
+                          under ``terminate_on_error=False``)
+``embedder``              the fused serving plane's embed stage
+                          (``xpacks/llm/_scheduler.py``) — ``fail`` trips
+                          the serving circuit breaker and forces the
+                          lexical degraded path
+``scheduler.step``        every device-step batch the serving scheduler
+                          executes — ``fail`` fans the error out to the
+                          batch's waiters, ``delay`` stretches the tick
+========================  ====================================================
+
+Activation:
+
+* programmatic — ``faults.configure(seed=7, rules={"udf": {"fail": 0.1}})``
+  (or the :func:`scoped` context manager in tests);
+* environment — ``PATHWAY_FAULTS="connector.read:fail=0.05;udf:fail=0.1"``
+  plus ``PATHWAY_FAULT_SEED=7``, parsed at import.
+
+Rules per site: ``fail`` / ``drop`` / ``delay`` probabilities in [0, 1]
+(at most one action fires per call, tried in that order) and ``delay_ms``
+for the delay action.  All injections are counted; :func:`stats` feeds
+``/v1/health`` and ``benchmarks/soak.py --chaos`` reports.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import itertools
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Any
+
+__all__ = [
+    "FaultInjected",
+    "configure",
+    "configure_from_env",
+    "reset",
+    "scoped",
+    "perturb",
+    "stats",
+    "enabled",
+    "current_seed",
+]
+
+#: hot-path guard — sites check this module global before calling
+#: :func:`perturb`, so an unconfigured process pays one attribute load
+enabled: bool = False
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a ``fail`` injection; carries the site for assertions."""
+
+    def __init__(self, site: str, n: int):
+        super().__init__(f"injected fault at {site!r} (call #{n})")
+        self.site = site
+        self.call_number = n
+
+
+class _Plan:
+    def __init__(self, seed: int, rules: dict[str, dict]):
+        self.seed = int(seed)
+        self.rules: dict[str, dict] = {}
+        for site, rule in rules.items():
+            r = {
+                "fail": float(rule.get("fail", 0.0)),
+                "drop": float(rule.get("drop", 0.0)),
+                "delay": float(rule.get("delay", 0.0)),
+                "delay_ms": float(rule.get("delay_ms", 5.0)),
+            }
+            if r["fail"] + r["drop"] + r["delay"] > 1.0:
+                raise ValueError(
+                    f"fault probabilities for site {site!r} sum over 1.0"
+                )
+            self.rules[site] = r
+        self._counters: dict[str, Any] = {
+            site: itertools.count() for site in self.rules
+        }
+        self._lock = threading.Lock()
+        self.injected: dict[str, dict[str, int]] = defaultdict(
+            lambda: defaultdict(int)
+        )
+
+    def _uniform(self, site: str, n: int) -> float:
+        h = hashlib.blake2b(
+            f"{self.seed}:{site}:{n}".encode(), digest_size=8
+        ).digest()
+        return int.from_bytes(h, "little") / float(1 << 64)
+
+    def decide(self, site: str) -> str:
+        rule = self.rules.get(site)
+        if rule is None:
+            return "ok"
+        n = next(self._counters[site])
+        u = self._uniform(site, n)
+        if u < rule["fail"]:
+            action = "fail"
+        elif u < rule["fail"] + rule["drop"]:
+            action = "drop"
+        elif u < rule["fail"] + rule["drop"] + rule["delay"]:
+            action = "delay"
+        else:
+            return "ok"
+        with self._lock:
+            self.injected[site][action] += 1
+        if action == "delay":
+            time.sleep(rule["delay_ms"] / 1000.0)
+            return "ok"
+        if action == "fail":
+            raise FaultInjected(site, n)
+        return "drop"
+
+
+_plan: _Plan | None = None
+
+
+def configure(seed: int = 0, rules: dict[str, dict] | None = None) -> None:
+    """Install a fault plan (replacing any active one)."""
+    global _plan, enabled
+    _plan = _Plan(seed, rules or {})
+    enabled = bool(_plan.rules)
+
+
+def reset() -> None:
+    global _plan, enabled
+    _plan = None
+    enabled = False
+
+
+@contextlib.contextmanager
+def scoped(seed: int = 0, rules: dict[str, dict] | None = None):
+    """Test helper: install a plan for the block, restore the prior one."""
+    global _plan, enabled
+    prev = _plan
+    try:
+        configure(seed, rules)
+        yield
+    finally:
+        _plan = prev
+        enabled = prev is not None and bool(prev.rules)
+
+
+def perturb(site: str) -> str:
+    """Injection chokepoint for instrumented sites.
+
+    Returns ``"ok"`` (possibly after an injected delay) or ``"drop"``
+    (the caller should silently discard the item, where that is
+    meaningful); raises :class:`FaultInjected` for a ``fail`` decision.
+    """
+    plan = _plan
+    if plan is None:
+        return "ok"
+    return plan.decide(site)
+
+
+def current_seed() -> int | None:
+    return None if _plan is None else _plan.seed
+
+
+def stats() -> dict[str, Any]:
+    plan = _plan
+    if plan is None:
+        return {"enabled": False, "injected_total": 0}
+    with plan._lock:
+        sites = {s: dict(a) for s, a in plan.injected.items()}
+    return {
+        "enabled": True,
+        "seed": plan.seed,
+        "rules": {s: dict(r) for s, r in plan.rules.items()},
+        "injected_total": sum(n for a in sites.values() for n in a.values()),
+        "sites": sites,
+    }
+
+
+def parse_spec(spec: str) -> dict[str, dict]:
+    """``"connector.read:fail=0.05,drop=0.01;udf:fail=0.1"`` → rules dict."""
+    rules: dict[str, dict] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, kvs = part.partition(":")
+        rule: dict[str, float] = {}
+        for kv in kvs.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            rule[k.strip()] = float(v)
+        rules[site.strip()] = rule
+    return rules
+
+
+def configure_from_env() -> bool:
+    """Activate from ``PATHWAY_FAULTS`` / ``PATHWAY_FAULT_SEED``."""
+    spec = os.environ.get("PATHWAY_FAULTS")
+    if not spec:
+        return False
+    seed = int(os.environ.get("PATHWAY_FAULT_SEED", "0") or 0)
+    configure(seed=seed, rules=parse_spec(spec))
+    return True
+
+
+configure_from_env()

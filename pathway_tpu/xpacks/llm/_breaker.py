@@ -1,0 +1,202 @@
+"""Serving circuit breakers.
+
+A live RAG service must keep answering when a stage starts failing — an
+embedder OOMs, an upstream LLM times out (VectorLiteRAG, arXiv
+2504.08930: latency-aware fallback when one pipeline stage becomes the
+bottleneck; EdgeRAG, arXiv 2412.21023: degrade gracefully, don't fail
+closed).  The breaker is the switch that turns repeated stage failures
+into a *fast, deliberate* fallback instead of per-request timeouts:
+
+* CLOSED — normal operation; consecutive failures are counted;
+* OPEN — tripped after ``failure_threshold`` consecutive failures: calls
+  are refused instantly (callers take their degraded path) for
+  ``cooldown_s``;
+* HALF_OPEN — after the cooldown one probe call is admitted; success
+  closes the breaker, failure re-opens it for another cooldown.
+
+Breakers register with the health registry (``breaker:<name>``
+components, OPEN/HALF_OPEN = degraded-but-ready) and with the
+OpenMetrics plane (``pathway_breaker_*`` series via
+``register_metrics_provider``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any
+
+__all__ = ["CircuitBreaker", "BreakerOpen"]
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class BreakerOpen(RuntimeError):
+    """Raised by :meth:`CircuitBreaker.call` when the breaker refuses."""
+
+
+class CircuitBreaker:
+    """Thread-safe consecutive-failure circuit breaker (module docstring)."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        failure_threshold: int | None = None,
+        cooldown_s: float | None = None,
+        probe_timeout_s: float = 60.0,
+    ):
+        self.name = name
+        self.failure_threshold = (
+            failure_threshold
+            if failure_threshold is not None
+            else int(os.environ.get("PATHWAY_BREAKER_FAILURES", "3"))
+        )
+        self.cooldown_s = (
+            cooldown_s
+            if cooldown_s is not None
+            else float(os.environ.get("PATHWAY_BREAKER_COOLDOWN_S", "5.0"))
+        )
+        #: a HALF_OPEN probe whose caller never reports back (cancelled
+        #: task, BaseException) releases its slot after this long — else
+        #: the breaker would refuse forever
+        self.probe_timeout_s = max(probe_timeout_s, self.cooldown_s)
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._probe_granted_at = 0.0
+        self._counters = {
+            "trips_total": 0,
+            "refused_total": 0,
+            "failures_total": 0,
+            "successes_total": 0,
+            "last_error": "",
+        }
+        from ...internals.health import get_health
+        from ...internals.monitoring import register_metrics_provider
+
+        self._health = get_health()
+        self._publish_health()
+        register_metrics_provider(f"breaker:{name}", self)
+
+    # -- state machine ---------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._effective_state()
+
+    def _effective_state(self) -> str:
+        # caller holds the lock
+        if self._state == OPEN and (
+            time.monotonic() - self._opened_at >= self.cooldown_s
+        ):
+            self._state = HALF_OPEN
+            self._probe_in_flight = False
+        return self._state
+
+    def allow(self) -> bool:
+        """True when a call may proceed.  In HALF_OPEN exactly one caller
+        gets the probe slot until its outcome is recorded (or the probe
+        times out — a vanished prober must not wedge the breaker)."""
+        with self._lock:
+            state = self._effective_state()
+            if state == CLOSED:
+                return True
+            if state == HALF_OPEN:
+                if self._probe_in_flight and (
+                    time.monotonic() - self._probe_granted_at
+                    > self.probe_timeout_s
+                ):
+                    self._probe_in_flight = False
+                if not self._probe_in_flight:
+                    self._probe_in_flight = True
+                    self._probe_granted_at = time.monotonic()
+                    return True
+            self._counters["refused_total"] += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._counters["successes_total"] += 1
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+            if self._state != CLOSED:
+                self._state = CLOSED
+        self._publish_health()
+
+    def record_failure(self, exc: BaseException | None = None) -> None:
+        with self._lock:
+            self._counters["failures_total"] += 1
+            if exc is not None:
+                self._counters["last_error"] = f"{type(exc).__name__}: {exc}"
+            self._probe_in_flight = False
+            if self._state == HALF_OPEN:
+                # failed probe: straight back to OPEN for another cooldown
+                self._state = OPEN
+                self._opened_at = time.monotonic()
+                self._counters["trips_total"] += 1
+            else:
+                self._consecutive_failures += 1
+                if (
+                    self._state == CLOSED
+                    and self._consecutive_failures >= self.failure_threshold
+                ):
+                    self._state = OPEN
+                    self._opened_at = time.monotonic()
+                    self._counters["trips_total"] += 1
+        self._publish_health()
+
+    def call(self, fn, *args, **kwargs):
+        """Run ``fn`` through the breaker: refused → :class:`BreakerOpen`;
+        outcome recorded either way."""
+        if not self.allow():
+            raise BreakerOpen(f"circuit breaker {self.name!r} is open")
+        try:
+            result = fn(*args, **kwargs)
+        except Exception as exc:
+            self.record_failure(exc)
+            raise
+        self.record_success()
+        return result
+
+    # -- observability ---------------------------------------------------
+    def _publish_health(self) -> None:
+        state = self.state
+        self._health.set_component(
+            f"breaker:{self.name}",
+            state,
+            ready=True,
+            degraded=state != CLOSED,
+            critical=False,
+            detail=self._counters["last_error"] if state != CLOSED else "",
+            scope="process",
+        )
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._effective_state(),
+                "consecutive_failures": self._consecutive_failures,
+                **self._counters,
+            }
+
+    def openmetrics_lines(self) -> list[str]:
+        s = self.stats()
+        lbl = f'breaker="{self.name}"'
+        state_code = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}[s["state"]]
+        lines = [
+            "# TYPE pathway_breaker_state gauge",
+            f"pathway_breaker_state{{{lbl}}} {state_code}",
+        ]
+        for metric in (
+            "trips_total", "refused_total", "failures_total",
+            "successes_total",
+        ):
+            lines.append(f"# TYPE pathway_breaker_{metric} counter")
+            lines.append(f"pathway_breaker_{metric}{{{lbl}}} {s[metric]}")
+        return lines

@@ -295,6 +295,12 @@ class ServingScheduler:
         # model off-thread while the loop runs
         lock = getattr(group, "_dispatch_lock", None)
         try:
+            from ...testing import faults
+
+            if faults.enabled:
+                # chaos site "scheduler.step": a failed device step fans
+                # out to the batch's waiters like any handler error
+                faults.perturb("scheduler.step")
             if lock is not None:
                 with lock:
                     results = group.batch_fn([it.payload for it in chunk])
@@ -500,6 +506,48 @@ def _batch_embed(embedder, texts: list[str]):
     )
 
 
+class _LexicalMirror:
+    """Degraded-mode lexical fallback: a host-side BM25 index (the same
+    scoring the hybrid index's lexical side uses,
+    ``stdlib/indexing/retrievers.BM25Index``) mirrored lazily from the
+    live index node's doc payloads.  When the embedder breaker is open,
+    ``/v1/retrieve`` answers from here — wrong ranking beats no answer
+    for a RAG service (EdgeRAG, arXiv 2412.21023)."""
+
+    def __init__(self, text_i: int, meta_i: int):
+        from ...stdlib.indexing.retrievers import BM25Index
+        from ...internals.value import Json
+
+        self._Json = Json
+        self._bm25 = BM25Index()
+        self._text_i = text_i
+        self._meta_i = meta_i
+        self._have: set = set()
+        self._lock = threading.Lock()
+
+    def _sync(self, node) -> None:
+        # dict(d) is one C-level copy under the GIL — safe against the
+        # engine thread mutating doc_payload mid-snapshot
+        snap = dict(node.doc_payload)
+        with self._lock:
+            for key in self._have - snap.keys():
+                self._bm25.remove(key)
+            for key, payload in snap.items():
+                if key in self._have:
+                    continue
+                meta = payload[self._meta_i]
+                if isinstance(meta, self._Json):
+                    meta = meta.value
+                from ._utils import coerce_str
+
+                self._bm25.add(key, coerce_str(payload[self._text_i]), meta)
+            self._have = set(snap)
+
+    def search(self, node, items: list[tuple[str, int, str | None]]):
+        self._sync(node)
+        return self._bm25.search(list(items))
+
+
 class RetrievePlane:
     """Scheduler-served ``/v1/retrieve``: concurrent REST requests coalesce
     into one fused embed→search tick over the LIVE index (the engine keeps
@@ -507,6 +555,12 @@ class RetrievePlane:
 
     Answers are as-of-now: each batch reads the index's current state
     under its own lock, the same contract ``query_as_of_now`` serves.
+
+    Failure domain: consecutive embed failures trip ``breaker`` (a
+    :class:`~pathway_tpu.xpacks.llm._breaker.CircuitBreaker`); while it is
+    open, queries are served from the BM25 lexical mirror and responses
+    carry ``"degraded": true`` instead of 5xx-ing.  A half-open probe
+    batch restores the vector path automatically once the embedder heals.
     """
 
     def __init__(
@@ -520,6 +574,8 @@ class RetrievePlane:
         include_score: bool = False,
         max_batch: int | None = None,
         label: str = "retrieve",
+        breaker: Any = None,
+        lexical_fallback: bool = True,
     ):
         self.scheduler = scheduler if scheduler is not None else get_scheduler()
         self.index_factory = index_factory
@@ -528,6 +584,16 @@ class RetrievePlane:
         self._deadline_ms_override = deadline_ms
         self._text_i = payload_columns.index("text")
         self._meta_i = payload_columns.index("metadata")
+        if breaker is None and embedder is not None:
+            from ._breaker import CircuitBreaker
+
+            breaker = CircuitBreaker(f"embedder:{label}")
+        self.breaker = breaker
+        self._mirror = (
+            _LexicalMirror(self._text_i, self._meta_i)
+            if lexical_fallback
+            else None
+        )
         if max_batch is None:
             max_batch = self.scheduler.max_batch
         self.group = WorkGroup(label, self._batch, max_batch=max_batch)
@@ -541,7 +607,9 @@ class RetrievePlane:
         return _SETTINGS["deadline_ms"]
 
     # -- batch handler (scheduler thread) --
-    def _batch(self, items: list[tuple[str, int, str | None]]) -> list[list[dict]]:
+    def _batch(
+        self, items: list[tuple[str, int, str | None]]
+    ) -> list[dict]:
         from ...stdlib.indexing.lowering import live_index_node
 
         node = live_index_node(self.index_factory)
@@ -553,20 +621,65 @@ class RetrievePlane:
         index = node.index
         if getattr(index, "query_is_text", False):
             raw = index.search(list(items))
-        else:
-            if self.embedder is None:
-                raise RuntimeError(
-                    "retrieve plane needs an embedder for a vector index"
+            return [
+                {"results": self._pack(node, row), "degraded": False}
+                for row in raw
+            ]
+        if self.embedder is None:
+            raise RuntimeError(
+                "retrieve plane needs an embedder for a vector index"
+            )
+        raw = None
+        if self.breaker is None or self.breaker.allow():
+            try:
+                from ...testing import faults
+
+                if faults.enabled:
+                    faults.perturb("embedder")
+                embs = _batch_embed(self.embedder, [q for q, _, _ in items])
+                specs = [(k, flt) for _, k, flt in items]
+                if hasattr(index, "search_embedded"):
+                    raw = index.search_embedded(embs, specs)
+                else:
+                    raw = index.search(
+                        [(embs[i], k, flt) for i, (k, flt) in enumerate(specs)]
+                    )
+            except Exception as exc:  # noqa: BLE001 — degrade, don't 5xx
+                # record FIRST: even without a fallback the breaker must
+                # trip so repeated failures fail fast (ServingNotReady)
+                # instead of paying the full embed timeout per request
+                if self.breaker is not None:
+                    self.breaker.record_failure(exc)
+                from ...internals.errors import register_error
+
+                register_error(
+                    f"serving embed/search failed, degrading to lexical: "
+                    f"{type(exc).__name__}: {exc}",
+                    kind="serving",
+                    operator=self.group.label,
                 )
-            embs = _batch_embed(self.embedder, [q for q, _, _ in items])
-            specs = [(k, flt) for _, k, flt in items]
-            if hasattr(index, "search_embedded"):
-                raw = index.search_embedded(embs, specs)
+                if self.breaker is None or self._mirror is None:
+                    raise
             else:
-                raw = index.search(
-                    [(embs[i], k, flt) for i, (k, flt) in enumerate(specs)]
-                )
-        return [self._pack(node, row) for row in raw]
+                if self.breaker is not None:
+                    self.breaker.record_success()
+        if raw is not None:
+            return [
+                {"results": self._pack(node, row), "degraded": False}
+                for row in raw
+            ]
+        # degraded path: breaker open (or this batch just tripped it) —
+        # lexical BM25 over the live doc payloads, tagged degraded
+        if self._mirror is None:
+            raise ServingNotReady(
+                "embedder unavailable and lexical fallback disabled",
+                retry_after_s=self.scheduler.retry_after_s,
+            )
+        raw = self._mirror.search(node, items)
+        return [
+            {"results": self._pack(node, row), "degraded": True}
+            for row in raw
+        ]
 
     def _pack(self, node, row) -> list[dict]:
         from ...internals.value import Json
@@ -635,6 +748,13 @@ class RetrievePlane:
                     status=503,
                     headers={"Retry-After": f"{exc.retry_after_s:g}"},
                 )
-            return web.json_response(result)
+            if result["degraded"]:
+                # degraded-mode contract: an object tagging the fallback,
+                # so callers/monitors can tell lexical answers apart; the
+                # healthy path keeps the plain-list shape for back-compat
+                return web.json_response(
+                    {"results": result["results"], "degraded": True}
+                )
+            return web.json_response(result["results"])
 
         return handle

@@ -60,8 +60,13 @@ class RestClientBase:
 
     ``retry_on_unavailable`` (off by default) makes a 503 response —
     the serving scheduler's deadline/overload shedding — degrade
-    gracefully: the client honors the server's ``Retry-After`` hint
-    (clamped to ``max_retry_after_s``) and retries exactly once.
+    gracefully: the client retries with jittered exponential backoff
+    (``backoff_initial_s`` · ``backoff_factor``^attempt, up to
+    ``max_retries`` attempts), honoring the server's ``Retry-After``
+    hint when present.  Every individual sleep is clamped to
+    ``max_retry_after_s`` and the whole retry budget to
+    ``retry_deadline_s`` of wall clock — a saturated server makes the
+    client fail fast after the deadline instead of piling on.
     """
 
     def __init__(
@@ -73,6 +78,11 @@ class RestClientBase:
         additional_headers: dict | None = None,
         retry_on_unavailable: bool = False,
         max_retry_after_s: float = 5.0,
+        max_retries: int = 4,
+        backoff_initial_s: float = 0.25,
+        backoff_factor: float = 2.0,
+        backoff_jitter_s: float = 0.1,
+        retry_deadline_s: float = 10.0,
     ):
         if url is None:
             if host is None or port is None:
@@ -83,22 +93,48 @@ class RestClientBase:
         self.additional_headers = additional_headers or {}
         self.retry_on_unavailable = retry_on_unavailable
         self.max_retry_after_s = max_retry_after_s
+        self.max_retries = max_retries
+        self.backoff_initial_s = backoff_initial_s
+        self.backoff_factor = backoff_factor
+        self.backoff_jitter_s = backoff_jitter_s
+        self.retry_deadline_s = retry_deadline_s
 
     def _post(self, route: str, payload: dict):
+        import random
         import time
         import urllib.error
 
-        try:
-            return self._post_once(route, payload)
-        except urllib.error.HTTPError as exc:
-            if not (self.retry_on_unavailable and exc.code == 503):
-                raise
+        deadline = time.monotonic() + self.retry_deadline_s
+        attempt = 0
+        while True:
             try:
-                retry_after = float(exc.headers.get("Retry-After", 1.0))
-            except (TypeError, ValueError):
-                retry_after = 1.0
-            time.sleep(max(0.0, min(retry_after, self.max_retry_after_s)))
-            return self._post_once(route, payload)
+                return self._post_once(route, payload)
+            except urllib.error.HTTPError as exc:
+                if not (self.retry_on_unavailable and exc.code == 503):
+                    raise
+                if attempt >= self.max_retries:
+                    raise
+                retry_after = None
+                try:
+                    header = exc.headers.get("Retry-After")
+                    if header is not None:
+                        retry_after = float(header)
+                except (TypeError, ValueError):
+                    retry_after = None
+                delay = (
+                    retry_after
+                    if retry_after is not None
+                    else self.backoff_initial_s
+                    * (self.backoff_factor ** attempt)
+                )
+                delay += random.uniform(0.0, self.backoff_jitter_s)
+                delay = max(0.0, min(delay, self.max_retry_after_s))
+                if time.monotonic() + delay > deadline:
+                    # total-deadline cap: fail fast instead of sleeping
+                    # past the caller's patience
+                    raise
+                time.sleep(delay)
+                attempt += 1
 
     def _post_once(self, route: str, payload: dict):
         import json

@@ -81,8 +81,21 @@ class SummaryQuestionAnswerer(BaseQuestionAnswerer):
     def summarize_query(self, summarize_queries: Table) -> Table: ...
 
 
+import itertools as _itertools
+
+_qa_seq = _itertools.count()
+
+
 class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
-    """reference: question_answering.py:314"""
+    """reference: question_answering.py:314
+
+    Failure domain: LLM calls run through a circuit breaker
+    (``xpacks/llm/_breaker.py``).  Consecutive LLM failures trip it, after
+    which ``/v1/pw_ai_answer`` keeps answering with *retrieval-only*
+    results (``response: null``, ``"degraded": true``, context docs
+    included) instead of 5xx-ing; a half-open probe restores full answers
+    once the model heals.
+    """
 
     def __init__(
         self,
@@ -94,6 +107,7 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
         long_prompt_template=prompts.prompt_qa,
         summarize_template=prompts.prompt_summarize,
         search_topk: int = 6,
+        llm_breaker: Any = None,
     ):
         self.llm = llm
         self.indexer = indexer
@@ -104,6 +118,42 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
         self.search_topk = search_topk
         self.server: Any = None
         self._pending_endpoints: list = []
+        if llm_breaker is None:
+            from ._breaker import CircuitBreaker
+
+            llm_breaker = CircuitBreaker(f"llm-{next(_qa_seq)}")
+        self.llm_breaker = llm_breaker
+
+    def _guarded_llm(self):
+        """The LLM as a breaker-guarded async UDF: a refused or failed
+        call yields ``None`` (→ degraded retrieval-only answer) instead of
+        an engine-visible exception."""
+        from ...internals.udfs import async_executor, udf
+
+        base = self.llm.async_callable()
+        breaker = self.llm_breaker
+
+        @udf(executor=async_executor(), return_type=dt.Optional(dt.STR))
+        async def guarded_llm(messages, model: str | None = None):
+            if not breaker.allow():
+                return None
+            try:
+                result = await base(messages, model=model)
+            except Exception as exc:  # noqa: BLE001 — degrade, don't poison
+                breaker.record_failure(exc)
+                from ...internals.errors import register_error
+
+                register_error(
+                    f"LLM call failed, answer degraded to retrieval-only: "
+                    f"{type(exc).__name__}: {exc}",
+                    kind="serving",
+                    operator="llm",
+                )
+                return None
+            breaker.record_success()
+            return result
+
+        return guarded_llm
 
     # -- the 4-select answer pipeline (reference: :451-482) --
     def answer_query(self, pw_ai_queries: Table) -> Table:
@@ -172,7 +222,7 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
             docs=prompted.docs,
         )
         answered = chosen.select(
-            response=self.llm(
+            response=self._guarded_llm()(
                 prompt_chat_single_qa(chosen.rag_prompt), model=chosen.model
             ),
             return_context_docs=chosen.return_context_docs,
@@ -180,6 +230,15 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
         )
 
         def pack(response, return_context_docs, docs) -> Json:
+            if response is None:
+                # LLM breaker open / call failed: retrieval-only answer
+                return Json(
+                    {
+                        "response": None,
+                        "degraded": True,
+                        "context_docs": [coerce_str(d) for d in (docs or ())],
+                    }
+                )
             out: dict = {"response": coerce_str(response)}
             if return_context_docs:
                 out["context_docs"] = [coerce_str(d) for d in (docs or ())]

@@ -402,6 +402,9 @@ class VectorStoreClient(RestClientBase):
 
     def __init__(self, *args, timeout: float = 15.0, **kwargs):
         super().__init__(*args, timeout=timeout, **kwargs)
+        #: True when the last /v1/retrieve answer came from the degraded
+        #: (lexical fallback) path — see RetrievePlane's breaker
+        self.last_degraded = False
 
     def query(
         self,
@@ -415,7 +418,12 @@ class VectorStoreClient(RestClientBase):
             payload["metadata_filter"] = metadata_filter
         if filepath_globpattern is not None:
             payload["filepath_globpattern"] = filepath_globpattern
-        return self._post("/v1/retrieve", payload)
+        res = self._post("/v1/retrieve", payload)
+        if isinstance(res, dict) and "results" in res:
+            self.last_degraded = bool(res.get("degraded"))
+            return res["results"]
+        self.last_degraded = False
+        return res
 
     __call__ = query
 

@@ -14,6 +14,8 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import hashlib
+
 import pytest
 
 
@@ -25,3 +27,38 @@ def fresh_graph():
     G.clear()
     yield
     G.clear()
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    """No fault plan leaks across tests; re-arm any env-requested plan."""
+    from pathway_tpu.testing import faults
+
+    yield
+    faults.reset()
+    faults.configure_from_env()
+    from pathway_tpu.internals.errors import clear_dead_letter_sinks
+
+    clear_dead_letter_sinks()
+
+
+@pytest.fixture
+def chaos_seed(request):
+    """Deterministic fault-injection seed for @pytest.mark.chaos tests.
+
+    Defaults to a stable hash of the test's nodeid so every test gets its
+    own (but reproducible) fault sequence; ``PATHWAY_FAULT_SEED`` in the
+    environment overrides it globally.  The seed is printed, so a chaos
+    failure reproduces with
+    ``PATHWAY_FAULT_SEED=<printed> pytest <nodeid>``.
+    """
+    env = os.environ.get("PATHWAY_FAULT_SEED")
+    if env:
+        seed = int(env)
+    else:
+        digest = hashlib.blake2b(
+            request.node.nodeid.encode(), digest_size=4
+        ).digest()
+        seed = int.from_bytes(digest, "little")
+    print(f"[chaos] PATHWAY_FAULT_SEED={seed}")
+    return seed

@@ -1,0 +1,247 @@
+"""Serving circuit breaker + degraded mode, end to end over real HTTP:
+with a failing embedder ``/v1/retrieve`` serves BM25-fallback answers
+tagged ``"degraded": true`` instead of 5xx, ``/v1/health`` reports the
+tripped breaker, and the breaker's half-open probe restores the vector
+path automatically once the embedder heals.
+"""
+
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.health import get_health
+from pathway_tpu.xpacks.llm import mocks
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient, VectorStoreServer
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait(cond, timeout=20.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            res = cond()
+            if res:
+                return res
+        except Exception as exc:  # noqa: BLE001 — server still starting
+            last = exc
+        time.sleep(interval)
+    raise TimeoutError(f"condition not met: {last}")
+
+
+def _post_retrieve(port, query, k=1):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/retrieve",
+        data=json.dumps({"query": query, "k": k}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+class FlakyEmbedder(mocks.FakeEmbedder):
+    """FakeEmbedder with a kill switch for query-time failures."""
+
+    def __init__(self, dim=8):
+        super().__init__(dim=dim)
+        self.fail = False
+        self.calls = 0
+
+    def __wrapped__(self, input, **kwargs):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("embedder OOM (injected)")
+        return super().__wrapped__(input, **kwargs)
+
+
+@pytest.mark.chaos
+def test_retrieve_degrades_to_bm25_and_recovers(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("PATHWAY_BREAKER_COOLDOWN_S", "0.3")
+    (tmp_path / "doc1.txt").write_text("Berlin is the capital of Germany.")
+    (tmp_path / "doc2.txt").write_text("Paris is the capital of France.")
+    docs = pw.io.fs.read(
+        tmp_path, format="binary", mode="streaming", with_metadata=True,
+        refresh_interval=0.2,
+    )
+    embedder = FlakyEmbedder(dim=8)
+    vs = VectorStoreServer(docs, embedder=embedder)
+    port = _free_port()
+    vs.run_server(
+        host="127.0.0.1", port=port, threaded=True, with_cache=False,
+        terminate_on_error=False,
+    )
+    client = VectorStoreClient(host="127.0.0.1", port=port)
+
+    # healthy path: plain-list response, not degraded
+    res = _wait(lambda: client.query("Paris is the capital of France.", k=1))
+    assert res[0]["text"] == "Paris is the capital of France."
+    assert client.last_degraded is False
+    status, body = _post_retrieve(port, "capital of France")
+    assert status == 200 and isinstance(body, list)
+
+    # embedder starts failing: every response stays 200, now from the
+    # lexical (BM25) fallback, tagged degraded — never a 5xx
+    embedder.fail = True
+    for _ in range(4):
+        status, body = _post_retrieve(port, "Paris capital France")
+        assert status == 200
+        assert isinstance(body, dict) and body["degraded"] is True
+    assert body["results"][0]["text"] == "Paris is the capital of France."
+    # lexical ranking really is lexical: a Berlin query finds Berlin
+    _, body = _post_retrieve(port, "Berlin capital Germany")
+    assert body["degraded"] is True
+    assert body["results"][0]["text"] == "Berlin is the capital of Germany."
+
+    # client helper unwraps and flags
+    res = client.query("Paris capital France", k=1)
+    assert res[0]["text"] == "Paris is the capital of France."
+    assert client.last_degraded is True
+
+    # breaker tripped: OPEN refuses embed calls (no hammering) and
+    # /v1/health reports degraded-but-ready
+    breaker = vs._retrieve_plane.breaker
+    assert breaker.state in ("open", "half_open")
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/health", timeout=5
+    ) as resp:
+        health = json.loads(resp.read().decode())
+    assert health["ready"] is True
+    assert health["status"] == "degraded"
+    breaker_comps = [
+        c for n, c in health["components"].items() if n.startswith("breaker:")
+    ]
+    assert any(c["state"] != "closed" for c in breaker_comps)
+    calls_while_open = embedder.calls
+    _post_retrieve(port, "probe suppressed?")
+    # at most one half-open probe may have sneaked in
+    assert embedder.calls <= calls_while_open + 1
+
+    # heal: after the cooldown the half-open probe succeeds, the breaker
+    # closes, and responses return to the (non-degraded) vector path
+    embedder.fail = False
+
+    def recovered():
+        status, body = _post_retrieve(port, "Paris is the capital of France.")
+        return status == 200 and isinstance(body, list)
+
+    _wait(recovered, timeout=10.0)
+    assert breaker.state == "closed"
+    res = client.query("Paris is the capital of France.", k=1)
+    assert res[0]["text"] == "Paris is the capital of France."
+    assert client.last_degraded is False
+
+
+class FlakyChat(mocks.IdentityMockChat):
+    """IdentityMockChat with a kill switch for LLM-call failures."""
+
+    def __init__(self):
+        super().__init__()
+        self.fail = False
+
+    def __wrapped__(self, messages, model=None, **kwargs):
+        if self.fail:
+            raise RuntimeError("upstream LLM timeout (injected)")
+        return super().__wrapped__(messages, model=model, **kwargs)
+
+
+@pytest.mark.chaos
+def test_answer_endpoint_degrades_to_retrieval_only_and_recovers(
+    tmp_path, monkeypatch
+):
+    from pathway_tpu.xpacks.llm.question_answering import (
+        BaseRAGQuestionAnswerer,
+        RAGClient,
+    )
+
+    monkeypatch.setenv("PATHWAY_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("PATHWAY_BREAKER_COOLDOWN_S", "0.3")
+    (tmp_path / "doc1.txt").write_text("Berlin is the capital of Germany.")
+    docs = pw.io.fs.read(
+        tmp_path, format="binary", mode="streaming", with_metadata=True,
+        refresh_interval=0.2,
+    )
+    vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=8))
+    llm = FlakyChat()
+    qa = BaseRAGQuestionAnswerer(llm=llm, indexer=vs)
+    port = _free_port()
+    qa.build_server(host="127.0.0.1", port=port)
+    qa.server.run(threaded=True, with_cache=False, terminate_on_error=False)
+    client = RAGClient(host="127.0.0.1", port=port)
+
+    ans = _wait(lambda: client.pw_ai_answer("What is the capital of Germany?"))
+    assert ans["response"].startswith("mock::")
+    assert "degraded" not in ans
+
+    # LLM starts failing: answers degrade to retrieval-only (context docs
+    # included, response null, degraded flag) instead of erroring
+    llm.fail = True
+    for _ in range(3):
+        ans = client.pw_ai_answer("What is the capital of Germany?")
+        assert ans["degraded"] is True and ans["response"] is None
+        assert any("Berlin" in d for d in ans["context_docs"])
+    assert qa.llm_breaker.state in ("open", "half_open")
+
+    # heal: half-open probe closes the breaker, full answers return
+    llm.fail = False
+    time.sleep(0.35)
+
+    def full_again():
+        a = client.pw_ai_answer("What is the capital of Germany?")
+        return a.get("response", "") and a["response"].startswith("mock::")
+
+    _wait(full_again, timeout=10.0)
+    assert qa.llm_breaker.state == "closed"
+
+
+@pytest.mark.chaos
+def test_injected_embedder_faults_degrade_instead_of_5xx(tmp_path, monkeypatch, chaos_seed):
+    """Acceptance scenario via the harness: seeded `embedder` faults make
+    some retrieves serve degraded; none 5xx; the run stays up."""
+    from pathway_tpu.testing import faults
+
+    monkeypatch.setenv("PATHWAY_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("PATHWAY_BREAKER_COOLDOWN_S", "0.1")
+    (tmp_path / "doc.txt").write_text("Madrid is the capital of Spain.")
+    docs = pw.io.fs.read(
+        tmp_path, format="binary", mode="streaming", with_metadata=True,
+        refresh_interval=0.2,
+    )
+    vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=8))
+    port = _free_port()
+    vs.run_server(
+        host="127.0.0.1", port=port, threaded=True, with_cache=False,
+        terminate_on_error=False,
+    )
+    client = VectorStoreClient(host="127.0.0.1", port=port)
+    _wait(lambda: client.query("Madrid is the capital of Spain.", k=1))
+
+    faults.configure(seed=chaos_seed, rules={"embedder": {"fail": 0.5}})
+    try:
+        degraded = healthy = 0
+        for _ in range(20):
+            status, body = _post_retrieve(port, "capital of Spain")
+            assert status == 200  # never 5xx under embedder chaos
+            if isinstance(body, dict) and body.get("degraded"):
+                degraded += 1
+                assert body["results"][0]["text"] == (
+                    "Madrid is the capital of Spain."
+                )
+            else:
+                healthy += 1
+        assert degraded > 0  # the chaos actually bit
+        assert faults.stats()["sites"]["embedder"]["fail"] > 0
+    finally:
+        faults.reset()
