@@ -411,6 +411,22 @@ def run_concurrent(n_docs: int, clients: int, queries_per_client: int,
             out[f"{phase}_p99_ms"] = round(_pctl(lat, 0.99), 1)
             out[f"{phase}_errors"] = errors
             if scheduled:
+                # observability plane (ISSUE 4): tracing is ON at default
+                # sampling during the measured load — these fields prove
+                # it and let runs be compared against the pre-tracing
+                # baselines in serving_results.jsonl (p50 must stay
+                # within noise: the hot-path cost is one ring append +
+                # a few monotonic reads per request)
+                from pathway_tpu.internals.flight_recorder import (
+                    get_recorder,
+                    tracing_settings,
+                )
+
+                out["trace_sample"] = tracing_settings()["sample"]
+                out["trace_header_seen"] = client.last_trace_id is not None
+                out["flight_recorder_spans"] = get_recorder().stats()[
+                    "recorded_total"
+                ]
                 after = sched_mod.get_scheduler().stats()
                 d_batches = after["batches_total"] - before["batches_total"]
                 d_items = (

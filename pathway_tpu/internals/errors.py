@@ -155,6 +155,8 @@ class _ErrorMetrics:
         return error_stats()
 
     def openmetrics_lines(self) -> list[str]:
+        from .metrics_names import escape_label_value
+
         s = error_stats()
         lines = ["# TYPE pathway_errors_total counter"]
         for kind, n in sorted(s.items()):
@@ -162,7 +164,9 @@ class _ErrorMetrics:
                 # "total" is the sum of the kinds — emitting it under the
                 # same label would double any sum() over the series
                 continue
-            lines.append(f'pathway_errors_total{{kind="{kind}"}} {n}')
+            lines.append(
+                f'pathway_errors_total{{kind="{escape_label_value(kind)}"}} {n}'
+            )
         lines.append("# TYPE pathway_errors_last_minute gauge")
         lines.append(f"pathway_errors_last_minute {s['last_minute']}")
         return lines

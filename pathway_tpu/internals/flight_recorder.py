@@ -1,0 +1,708 @@
+"""Request tracing + the in-process flight recorder.
+
+Dapper-style per-request, per-stage attribution with zero external
+infrastructure: every ``PathwayWebserver`` request gets a trace id (W3C
+``traceparent`` honored when the caller sends one, minted otherwise), the
+serving scheduler threads the trace through admission -> batch dispatch,
+and the batch handlers stamp stage spans (queue wait, embed, search,
+serialize).  Finished spans ALWAYS land here — a bounded, lock-cheap ring
+buffer of spans from every plane:
+
+* HTTP requests + their per-stage child spans (``io/http/_server.py``
+  tracing middleware + ``xpacks/llm/_scheduler.py``),
+* engine operator flushes (``internals/engine.py`` ``_flush_node``),
+* connector commits (``io/streaming.py``),
+* scheduler device ticks, breaker transitions, injected faults.
+
+``GET /v1/debug/traces`` (every webserver) filters the ring by trace id /
+duration floor and the ``format=perfetto`` exporter dumps Chrome-tracing
+JSON — a slow window can be captured and opened in ``chrome://tracing`` /
+Perfetto with no collector deployed.  When an OpenTelemetry SDK tracer
+provider is configured in-process, finished request traces are ALSO
+emitted as real OTel spans with correct parentage; with only the OTel API
+installed (this image) that path is skipped entirely.
+
+Env knobs: ``PATHWAY_TRACE_SAMPLE`` (fraction of requests that record
+stage spans, default 1.0 — the ring append is cheap enough to keep on),
+``PATHWAY_FLIGHT_RECORDER_CAPACITY`` (ring size in spans, default 4096,
+0 disables recording; the trace-id header is still returned).
+
+Import discipline: this module is engine-hot-path adjacent and is
+imported at module level by ``internals/engine.py`` — it must only import
+stdlib and the :mod:`metrics_names` leaf, never ``monitoring``/``run``.
+``monitoring.py`` pulls :func:`observability_metrics_lines` lazily
+instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Iterator
+
+from .metrics_names import Histogram, escape_label_value
+
+__all__ = [
+    "Span",
+    "FlightRecorder",
+    "RequestTrace",
+    "get_recorder",
+    "reset_recorder",
+    "configure_tracing",
+    "tracing_settings",
+    "start_request",
+    "trace_stage",
+    "batch_traces",
+    "batch_stage",
+    "new_trace_id",
+    "new_span_id",
+    "parse_traceparent",
+    "format_traceparent",
+    "record_span",
+    "observe_stage",
+    "record_xla_compile",
+    "instrument_jit",
+    "compile_stats",
+    "observability_metrics_lines",
+]
+
+
+# ---------------------------------------------------------------------------
+# W3C trace context
+# ---------------------------------------------------------------------------
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """``(trace_id, parent_span_id)`` from a W3C ``traceparent`` header,
+    or None when absent/malformed (spec: restart the trace, don't fail
+    the request).  All-zero ids are invalid per spec."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None or m.group(1) == "ff":
+        return None
+    trace_id, span_id = m.group(2), m.group(3)
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str, sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def _env_number(name: str, default, parse):
+    """Lenient env parse: a typo in an observability knob must never take
+    down the serving path it observes — warn once and keep the default."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return parse(raw)
+    except (TypeError, ValueError):
+        import logging
+
+        logging.getLogger("pathway_tpu").warning(
+            "ignoring malformed %s=%r (using default %r)", name, raw, default
+        )
+        return default
+
+
+# ---------------------------------------------------------------------------
+# spans + the ring buffer
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """One finished span: wall-clock start + duration, optional trace
+    lineage, small attrs dict."""
+
+    __slots__ = (
+        "name", "category", "start_s", "duration_ms",
+        "trace_id", "span_id", "parent_id", "attrs",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        category: str,
+        start_s: float,
+        duration_ms: float,
+        trace_id: str | None = None,
+        span_id: str | None = None,
+        parent_id: str | None = None,
+        attrs: dict[str, Any] | None = None,
+    ):
+        self.name = name
+        self.category = category
+        self.start_s = start_s
+        self.duration_ms = duration_ms
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "name": self.name,
+            "category": self.category,
+            "start_s": round(self.start_s, 6),
+            "duration_ms": round(self.duration_ms, 3),
+        }
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        if self.span_id is not None:
+            d["span_id"] = self.span_id
+        if self.parent_id is not None:
+            d["parent_id"] = self.parent_id
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+class FlightRecorder:
+    """Bounded ring of finished spans (``deque(maxlen=...)`` appends are
+    O(1) and evict the oldest span automatically — recording can never
+    grow without bound or block a hot path on anything slower than one
+    short lock)."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = _env_number(
+                "PATHWAY_FLIGHT_RECORDER_CAPACITY", 4096, int
+            )
+        self.capacity = max(0, capacity)
+        self.enabled = self.capacity > 0
+        self._lock = threading.Lock()
+        self._ring: deque[Span] = deque(maxlen=self.capacity or 1)
+        self._recorded_total = 0
+
+    def record(
+        self,
+        name: str,
+        category: str,
+        start_s: float,
+        duration_ms: float,
+        trace_id: str | None = None,
+        span_id: str | None = None,
+        parent_id: str | None = None,
+        attrs: dict[str, Any] | None = None,
+    ) -> None:
+        if not self.enabled:
+            return
+        span = Span(
+            name, category, start_s, duration_ms,
+            trace_id, span_id, parent_id, attrs,
+        )
+        with self._lock:
+            self._ring.append(span)
+            self._recorded_total += 1
+
+    def record_span(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append(span)
+            self._recorded_total += 1
+
+    def spans(
+        self,
+        trace_id: str | None = None,
+        min_duration_ms: float | None = None,
+        category: str | None = None,
+        limit: int | None = None,
+    ) -> list[Span]:
+        """Matching spans, oldest first (a trace reads top-down)."""
+        with self._lock:
+            snap = list(self._ring)
+        out = [
+            s
+            for s in snap
+            if (trace_id is None or s.trace_id == trace_id)
+            and (min_duration_ms is None or s.duration_ms >= min_duration_ms)
+            and (category is None or s.category == category)
+        ]
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]  # keep the newest spans under a cap
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "recorded_total": self._recorded_total,
+                "buffered": len(self._ring),
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # -- Perfetto / chrome://tracing export -----------------------------
+    @staticmethod
+    def perfetto(spans: list[Span]) -> dict[str, Any]:
+        """Chrome-tracing JSON: one ``X`` (complete) event per span, one
+        lane (tid) per category — requests with a trace id get their own
+        lane so concurrent requests don't visually overlap."""
+        lanes: dict[str, int] = {}
+        events: list[dict[str, Any]] = []
+
+        def lane(key: str) -> int:
+            if key not in lanes:
+                lanes[key] = len(lanes) + 1
+            return lanes[key]
+
+        for s in spans:
+            key = f"trace:{s.trace_id[:8]}" if s.trace_id else s.category
+            args: dict[str, Any] = dict(s.attrs or {})
+            if s.trace_id:
+                args["trace_id"] = s.trace_id
+            events.append(
+                {
+                    "ph": "X",
+                    "name": s.name,
+                    "cat": s.category,
+                    "ts": s.start_s * 1e6,  # microseconds
+                    "dur": max(s.duration_ms, 1e-3) * 1e3,
+                    "pid": 1,
+                    "tid": lane(key),
+                    "args": args,
+                }
+            )
+        meta = [
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": 1,
+                "tid": tid,
+                "args": {"name": key},
+            }
+            for key, tid in lanes.items()
+        ]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+_recorder_lock = threading.Lock()
+_recorder: FlightRecorder | None = None
+
+
+def get_recorder() -> FlightRecorder:
+    global _recorder
+    rec = _recorder
+    if rec is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+            rec = _recorder
+    return rec
+
+
+def reset_recorder() -> None:
+    """Test isolation hook: drop the ring (re-reads env capacity)."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = None
+
+
+def record_span(
+    name: str,
+    category: str,
+    start_s: float,
+    duration_ms: float,
+    **kwargs: Any,
+) -> None:
+    """Module-level convenience used by the non-request call sites
+    (engine flushes, connector commits, breaker transitions, faults)."""
+    get_recorder().record(name, category, start_s, duration_ms, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# request traces
+# ---------------------------------------------------------------------------
+
+_SETTINGS = {
+    "sample": _env_number("PATHWAY_TRACE_SAMPLE", 1.0, float),
+}
+
+
+def configure_tracing(sample: float | None = None) -> None:
+    """Adjust the live sampling rate (``PATHWAY_TRACE_SAMPLE`` sets the
+    process default)."""
+    if sample is not None:
+        _SETTINGS["sample"] = max(0.0, min(1.0, float(sample)))
+
+
+def tracing_settings() -> dict[str, Any]:
+    return dict(_SETTINGS)
+
+
+#: fixed buckets for request stage latencies (ms)
+_STAGE_BUCKETS_MS = (
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+)
+_stage_lock = threading.Lock()
+_stage_hists: dict[str, Histogram] = {}
+
+
+def observe_stage(stage: str, duration_ms: float) -> None:
+    """Feed ``pathway_request_stage_ms{stage=...}``."""
+    with _stage_lock:
+        hist = _stage_hists.get(stage)
+        if hist is None:
+            hist = _stage_hists[stage] = Histogram(_STAGE_BUCKETS_MS)
+        hist.observe(duration_ms)
+
+
+class RequestTrace:
+    """Mutable per-request trace context.
+
+    Built by the webserver's tracing middleware, carried through the
+    scheduler on the work item, finished by the middleware.  Stage
+    appends come from the scheduler/device thread while the handler
+    coroutine owns the object — the tiny lock keeps the stage list
+    coherent.  ``sampled=False`` traces skip stage collection and
+    recording entirely but still carry the trace id for the response
+    header.
+    """
+
+    __slots__ = (
+        "trace_id", "span_id", "remote_parent", "name", "sampled",
+        "start_s", "start_mono", "attrs", "_stages", "_lock", "_finished",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        remote_parent: str | None,
+        sampled: bool,
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = new_span_id()
+        self.remote_parent = remote_parent
+        self.sampled = sampled
+        self.start_s = time.time()
+        self.start_mono = time.monotonic()
+        self.attrs: dict[str, Any] = {}
+        #: (stage_name, start_s, duration_ms)
+        self._stages: list[tuple[str, float, float]] = []
+        self._lock = threading.Lock()
+        self._finished = False
+
+    # -- stage recording -------------------------------------------------
+    def _mono_to_wall(self, mono: float) -> float:
+        return self.start_s + (mono - self.start_mono)
+
+    def add_stage_mono(self, name: str, mono_start: float, mono_end: float) -> None:
+        """Record a stage from monotonic endpoints (scheduler clocks)."""
+        if not self.sampled:
+            return
+        dur_ms = max(0.0, (mono_end - mono_start) * 1000.0)
+        with self._lock:
+            self._stages.append((name, self._mono_to_wall(mono_start), dur_ms))
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        if not self.sampled:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.add_stage_mono(name, t0, time.monotonic())
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def stages(self) -> list[tuple[str, float, float]]:
+        with self._lock:
+            return list(self._stages)
+
+    # -- completion ------------------------------------------------------
+    def finish(self, status: int | None = None) -> None:
+        """Record the request span + one child span per stage, feed the
+        stage histograms, and emit OTel spans when an SDK is configured.
+        Idempotent (middleware error paths may double-call)."""
+        if self._finished:
+            return
+        self._finished = True
+        duration_ms = (time.monotonic() - self.start_mono) * 1000.0
+        if status is not None:
+            self.attrs["http.status"] = status
+        if not self.sampled:
+            return
+        stages = self.stages()
+        rec = get_recorder()
+        rec.record(
+            self.name,
+            "request",
+            self.start_s,
+            duration_ms,
+            trace_id=self.trace_id,
+            span_id=self.span_id,
+            parent_id=self.remote_parent,
+            attrs=dict(self.attrs) if self.attrs else None,
+        )
+        for name, start_s, dur_ms in stages:
+            rec.record(
+                name,
+                "request",
+                start_s,
+                dur_ms,
+                trace_id=self.trace_id,
+                span_id=new_span_id(),
+                parent_id=self.span_id,
+            )
+            observe_stage(name, dur_ms)
+        observe_stage("total", duration_ms)
+        _emit_otel(self, duration_ms, stages)
+
+
+def start_request(name: str, traceparent: str | None = None) -> RequestTrace:
+    """Mint (or adopt) a trace for one inbound request.  Always returns a
+    trace — the id rides the response header either way; ``sampled``
+    (PATHWAY_TRACE_SAMPLE) and the recorder's capacity decide whether
+    stage spans are collected."""
+    parsed = parse_traceparent(traceparent)
+    if parsed is not None:
+        trace_id, remote_parent = parsed
+    else:
+        trace_id, remote_parent = new_trace_id(), None
+    sample = _SETTINGS["sample"]
+    sampled = (
+        get_recorder().enabled
+        and sample > 0.0
+        and (sample >= 1.0 or random.random() < sample)
+    )
+    return RequestTrace(name, trace_id, remote_parent, sampled)
+
+
+@contextlib.contextmanager
+def trace_stage(trace: RequestTrace | None, name: str) -> Iterator[None]:
+    """No-op-safe stage timer for call sites that may run untraced."""
+    if trace is None or not trace.sampled:
+        yield
+        return
+    with trace.stage(name):
+        yield
+
+
+# -- batch-scoped stage attribution -----------------------------------------
+# A scheduler tick executes ONE device batch on behalf of MANY requests;
+# the batch handler times its internal stages once and the timing is
+# attributed to every trace riding the batch.  Thread-local because batch
+# handlers run on the scheduler thread (or inline on a submitter).
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def batch_traces(traces: list[RequestTrace]) -> Iterator[None]:
+    """Scope: the traces whose work the current batch executes."""
+    prev = getattr(_tls, "traces", None)
+    _tls.traces = traces
+    try:
+        yield
+    finally:
+        _tls.traces = prev
+
+
+@contextlib.contextmanager
+def batch_stage(name: str) -> Iterator[None]:
+    """Time a batch-internal stage (embed, search, ...) and stamp it onto
+    every trace in the current batch scope.  Free when untraced."""
+    traces = getattr(_tls, "traces", None)
+    if not traces:
+        yield
+        return
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        t1 = time.monotonic()
+        for tr in traces:
+            tr.add_stage_mono(name, t0, t1)
+
+
+# ---------------------------------------------------------------------------
+# OTel emission (only when an SDK tracer provider is installed)
+# ---------------------------------------------------------------------------
+
+_otel_tracer: Any = None
+
+
+def _sdk_tracer() -> Any:
+    """A real (SDK-backed) tracer, or None with only the no-op API
+    installed.  Positive result cached; the negative probe is one module
+    check per request — cheap, and it lets a test configure the SDK
+    provider after import."""
+    global _otel_tracer
+    if _otel_tracer is not None:
+        return _otel_tracer
+    try:
+        from opentelemetry import trace as otel_trace
+    except ImportError:
+        return None
+    provider = otel_trace.get_tracer_provider()
+    if not type(provider).__module__.startswith("opentelemetry.sdk"):
+        return None
+    _otel_tracer = otel_trace.get_tracer("pathway_tpu.request")
+    return _otel_tracer
+
+
+def _emit_otel(
+    trace: RequestTrace,
+    duration_ms: float,
+    stages: list[tuple[str, float, float]],
+) -> None:
+    tracer = _sdk_tracer()
+    if tracer is None:
+        return
+    try:
+        from opentelemetry import trace as otel_trace
+        from opentelemetry.trace import (
+            NonRecordingSpan,
+            SpanContext,
+            TraceFlags,
+        )
+
+        parent_ctx = None
+        if trace.remote_parent is not None:
+            parent_ctx = otel_trace.set_span_in_context(
+                NonRecordingSpan(
+                    SpanContext(
+                        int(trace.trace_id, 16),
+                        int(trace.remote_parent, 16),
+                        is_remote=True,
+                        trace_flags=TraceFlags(TraceFlags.SAMPLED),
+                    )
+                )
+            )
+        start_ns = int(trace.start_s * 1e9)
+        root = tracer.start_span(
+            trace.name,
+            context=parent_ctx,
+            start_time=start_ns,
+            attributes={
+                k: v
+                for k, v in trace.attrs.items()
+                if isinstance(v, (str, int, float, bool))
+            },
+        )
+        child_ctx = otel_trace.set_span_in_context(root)
+        for name, start_s, dur_ms in stages:
+            s_ns = int(start_s * 1e9)
+            child = tracer.start_span(name, context=child_ctx, start_time=s_ns)
+            child.end(end_time=s_ns + int(dur_ms * 1e6))
+        root.end(end_time=start_ns + int(duration_ms * 1e6))
+    except Exception:  # noqa: BLE001 — telemetry must never fail a request
+        pass
+
+
+# ---------------------------------------------------------------------------
+# XLA compile counters (pathway_xla_compile_total{site=...})
+# ---------------------------------------------------------------------------
+
+_compile_lock = threading.Lock()
+_compile_counts: dict[str, int] = {}
+
+
+def record_xla_compile(site: str, n: int = 1) -> None:
+    with _compile_lock:
+        _compile_counts[site] = _compile_counts.get(site, 0) + n
+
+
+def compile_stats() -> dict[str, int]:
+    with _compile_lock:
+        return dict(_compile_counts)
+
+
+def instrument_jit(jit_fn: Any, site: str) -> Any:
+    """Wrap a jitted callable so cache growth (``_cache_size()``) bumps
+    ``pathway_xla_compile_total{site=...}`` — the observable form of the
+    bucket_q/bucket_k no-recompile guarantees.  ``_cache_size`` and the
+    underlying function stay reachable on the wrapper (tests poke both).
+    Degrades to a passthrough if the installed JAX drops the API."""
+    state = {"seen": 0}
+
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        out = jit_fn(*args, **kwargs)
+        try:
+            size = jit_fn._cache_size()
+        except Exception:  # noqa: BLE001 — JAX internals moved; stop counting
+            return out
+        if size > state["seen"]:
+            record_xla_compile(site, size - state["seen"])
+            state["seen"] = size
+        return out
+
+    wrapper.__name__ = getattr(jit_fn, "__name__", site)
+    wrapper.__doc__ = getattr(jit_fn, "__doc__", None)
+    wrapper.__wrapped__ = jit_fn
+    try:
+        wrapper._cache_size = jit_fn._cache_size
+    except AttributeError:
+        pass
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics lines pulled by internals/monitoring.py
+# ---------------------------------------------------------------------------
+
+
+def observability_metrics_lines() -> list[str]:
+    """Stage histograms + compile counters + recorder counter, rendered
+    for the ``/status`` exposition (monitoring.py appends these)."""
+    lines: list[str] = []
+    with _stage_lock:
+        stage_items = [(name, hist) for name, hist in sorted(_stage_hists.items())]
+        if stage_items:
+            lines.append("# TYPE pathway_request_stage_ms histogram")
+            for name, hist in stage_items:
+                lines.extend(
+                    hist.openmetrics_lines(
+                        "pathway_request_stage_ms",
+                        f'stage="{escape_label_value(name)}"',
+                    )
+                )
+    compiles = compile_stats()
+    if compiles:
+        lines.append("# TYPE pathway_xla_compile_total counter")
+        for site, n in sorted(compiles.items()):
+            lines.append(
+                f'pathway_xla_compile_total{{site="{escape_label_value(site)}"}} {n}'
+            )
+    rec = get_recorder()
+    lines.append("# TYPE pathway_flight_recorder_spans_total counter")
+    lines.append(
+        f"pathway_flight_recorder_spans_total {rec.stats()['recorded_total']}"
+    )
+    return lines
+
+
+def reset_stage_metrics() -> None:
+    """Test isolation hook."""
+    with _stage_lock:
+        _stage_hists.clear()
+    with _compile_lock:
+        _compile_counts.clear()

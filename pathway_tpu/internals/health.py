@@ -147,6 +147,21 @@ class HealthRegistry:
         from .errors import error_stats
 
         snap["errors"] = error_stats()
+        # observability plane: ring-buffer fill + freshness watermarks ride
+        # the health snapshot so one curl shows "how stale and how traced"
+        try:
+            from .flight_recorder import get_recorder, tracing_settings
+            from .monitoring import get_freshness
+
+            snap["tracing"] = {
+                **tracing_settings(),
+                "flight_recorder": get_recorder().stats(),
+            }
+            freshness = get_freshness().stats()
+            if freshness:
+                snap["freshness"] = freshness
+        except Exception:  # noqa: BLE001 — health must never raise
+            pass
         try:
             from ..testing import faults
 

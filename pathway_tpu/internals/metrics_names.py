@@ -1,0 +1,146 @@
+"""Central registry of every ``pathway_*`` metric family this process emits.
+
+Observability drifts silently: a renamed series breaks dashboards without
+breaking a single test.  Every emitter (operator stats, connectors, the
+serving scheduler, breakers, the error log, tracing stage histograms,
+freshness watermarks, XLA compile counters) declares its families here and
+``tests/test_observability.py`` greps the tree for emitted ``pathway_*``
+literals and fails on any series not declared — the lint that keeps the
+README metric table honest across PRs.
+
+This module is a dependency LEAF (stdlib only): ``flight_recorder.py``,
+``monitoring.py`` and the xpack emitters all import it, so it must never
+import back into the package.  The shared OpenMetrics helpers
+(:func:`escape_label_value`, :class:`Histogram`) live here for the same
+reason — one escaping implementation for every emitter instead of five
+ad-hoc ``.replace()`` calls.
+"""
+
+from __future__ import annotations
+
+__all__ = ["METRICS", "declared_metric_names", "escape_label_value", "Histogram"]
+
+
+#: family name -> (type, help).  ``histogram`` families emit
+#: ``_bucket``/``_sum``/``_count`` samples; everything else emits samples
+#: under the family name itself.
+METRICS: dict[str, tuple[str, str]] = {
+    # engine / operator plane (internals/monitoring.py)
+    "pathway_uptime_seconds": ("gauge", "seconds since the monitor started"),
+    "pathway_current_timestamp": ("gauge", "engine frontier timestamp"),
+    "pathway_operator_rows_total": ("counter", "rows emitted per operator"),
+    "pathway_operator_busy_seconds": ("counter", "cumulative flush time per operator"),
+    "pathway_operator_flush_ms": ("histogram", "per-operator flush latency"),
+    # connector plane (internals/monitoring.py)
+    "pathway_connector_messages_total": ("counter", "messages committed per connector"),
+    "pathway_connector_finished": ("gauge", "1 once a finite connector closed"),
+    # serving scheduler (xpacks/llm/_scheduler.py)
+    "pathway_scheduler_submitted_total": ("counter", "work items admitted"),
+    "pathway_scheduler_completed_total": ("counter", "work items completed"),
+    "pathway_scheduler_failed_total": ("counter", "work items failed"),
+    "pathway_scheduler_shed_deadline_total": ("counter", "items shed past deadline"),
+    "pathway_scheduler_shed_queue_total": ("counter", "admissions refused at max_queue"),
+    "pathway_scheduler_batches_total": ("counter", "device-step batches executed"),
+    "pathway_scheduler_multi_item_batches_total": ("counter", "batches with >1 item"),
+    "pathway_scheduler_queue_depth": ("gauge", "current admission-queue depth"),
+    "pathway_scheduler_queue_depth_max": ("gauge", "high-watermark queue depth"),
+    "pathway_scheduler_batch_occupancy_max": ("gauge", "largest batch executed"),
+    "pathway_scheduler_batch_occupancy_mean": ("gauge", "mean batch occupancy"),
+    "pathway_scheduler_wait_ms": ("histogram", "queue wait before dispatch"),
+    # circuit breakers (xpacks/llm/_breaker.py)
+    "pathway_breaker_state": ("gauge", "0=closed 1=half_open 2=open"),
+    "pathway_breaker_trips_total": ("counter", "closed/half_open -> open transitions"),
+    "pathway_breaker_refused_total": ("counter", "calls refused while open"),
+    "pathway_breaker_failures_total": ("counter", "failures recorded"),
+    "pathway_breaker_successes_total": ("counter", "successes recorded"),
+    # error log (internals/errors.py)
+    "pathway_errors_total": ("counter", "failure-domain events per kind"),
+    "pathway_errors_last_minute": ("gauge", "errors in the trailing 60 s"),
+    # request tracing (internals/flight_recorder.py)
+    "pathway_request_stage_ms": (
+        "histogram",
+        "per-request stage latency (queue_wait / embed / search / serialize / total)",
+    ),
+    "pathway_flight_recorder_spans_total": (
+        "counter",
+        "spans recorded into the in-process ring buffer",
+    ),
+    # data freshness (internals/monitoring.py + stdlib/indexing/lowering.py)
+    "pathway_index_freshness_seconds": (
+        "gauge",
+        "ingest -> queryable lag of the last index update, per index",
+    ),
+    # XLA compilation (internals/flight_recorder.py, wrapped jit entry points)
+    "pathway_xla_compile_total": (
+        "counter",
+        "XLA compilations per jit entry point (bucket_q/bucket_k pin: flat under serving)",
+    ),
+}
+
+
+def declared_metric_names() -> set[str]:
+    """All sample names the registry allows: family names plus the
+    histogram suffixes."""
+    names: set[str] = set()
+    for family, (kind, _help) in METRICS.items():
+        names.add(family)
+        if kind == "histogram":
+            names.update(
+                {f"{family}_bucket", f"{family}_sum", f"{family}_count"}
+            )
+    return names
+
+
+def escape_label_value(value: object) -> str:
+    """Escape a label value per the OpenMetrics exposition format:
+    backslash, double-quote and line feed must be escaped (in that order —
+    escaping ``\\`` last would corrupt the other two)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+class Histogram:
+    """Fixed-bucket histogram with OpenMetrics rendering.
+
+    NOT internally locked — every holder (StatsMonitor, the stage-metrics
+    table in flight_recorder) already serializes observes under its own
+    lock, and double-locking the hot path buys nothing.
+    """
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +1 for the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def openmetrics_lines(self, family: str, labels: str = "") -> list[str]:
+        """``_bucket``/``_sum``/``_count`` samples (no ``# TYPE`` line —
+        the caller declares the family once for all label sets)."""
+        sep = "," if labels else ""
+        lines = []
+        cum = 0
+        for le, n in zip((*self.buckets, float("inf")), self.counts):
+            cum += n
+            le_s = "+Inf" if le == float("inf") else f"{le:g}"
+            lines.append(
+                f'{family}_bucket{{{labels}{sep}le="{le_s}"}} {cum}'
+            )
+        brace = f"{{{labels}}}" if labels else ""
+        lines.append(f"{family}_sum{brace} {self.sum:.3f}")
+        lines.append(f"{family}_count{brace} {self.count}")
+        return lines

@@ -8,7 +8,12 @@ progress_reporter.rs + ``ProberStats`` (graph.rs:533).
 The engine calls :meth:`StatsMonitor.record_flush` per node per
 micro-batch; the HTTP thread renders the same counters as OpenMetrics
 gauges (input/output latency + per-node rows processed), and the rich
-table view mirrors the reference's live dashboard.
+table view mirrors the reference's live dashboard.  Per-operator flush
+latencies render as fixed-bucket histograms (``pathway_operator_flush_ms``)
+— averages hide exactly the tail behavior the serving scheduler exists to
+fix.  The endpoint also exposes the freshness watermarks
+(:class:`FreshnessTracker`) and the tracing/compile series pulled from
+``internals/flight_recorder.py``.
 """
 
 from __future__ import annotations
@@ -16,15 +21,19 @@ from __future__ import annotations
 import threading
 import time
 import weakref
-from collections import defaultdict
+from collections import defaultdict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
+
+from .metrics_names import Histogram, escape_label_value
 
 __all__ = [
     "StatsMonitor",
     "start_http_server_thread",
     "MonitoringLevel",
     "register_metrics_provider",
+    "FreshnessTracker",
+    "get_freshness",
 ]
 
 
@@ -43,6 +52,13 @@ def register_metrics_provider(name: str, provider: Any) -> None:
     _metrics_providers[name] = provider
 
 
+#: flush-latency histogram bucket upper bounds (milliseconds)
+_FLUSH_BUCKETS_MS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    1000.0,
+)
+
+
 class StatsMonitor:
     """Per-node counters: rows, flush latency, last activity."""
 
@@ -51,14 +67,17 @@ class StatsMonitor:
         self.rows: dict[str, int] = defaultdict(int)
         self.flushes: dict[str, int] = defaultdict(int)
         self.busy_s: dict[str, float] = defaultdict(float)
+        self.flush_ms: dict[str, Histogram] = {}
         self.last_time: dict[str, float] = {}
         self.current_timestamp: int = -1
         self.started_at = time.time()
         # per-connector progress (reference: connectors/monitoring.rs
         # ConnectorStats — messages from start / last minute / recently
-        # committed / finished flag)
+        # committed / finished flag).  The sliding window is a deque:
+        # pruning pops from the LEFT, which list.pop(0) made O(n) per
+        # commit on a chatty connector.
         self.connector_total: dict[str, int] = defaultdict(int)
-        self.connector_recent: dict[str, list] = defaultdict(list)
+        self.connector_recent: dict[str, deque] = defaultdict(deque)
         self.connector_last_commit: dict[str, int] = defaultdict(int)
         self.connector_finished: dict[str, bool] = {}
 
@@ -67,6 +86,10 @@ class StatsMonitor:
             self.rows[node_name] += n_rows
             self.flushes[node_name] += 1
             self.busy_s[node_name] += elapsed_s
+            hist = self.flush_ms.get(node_name)
+            if hist is None:
+                hist = self.flush_ms[node_name] = Histogram(_FLUSH_BUCKETS_MS)
+            hist.observe(elapsed_s * 1000.0)
             self.last_time[node_name] = time.time()
 
     def record_step(self, timestamp: int) -> None:
@@ -83,7 +106,7 @@ class StatsMonitor:
             recent.append((now, n_messages))
             cutoff = now - 60.0
             while recent and recent[0][0] < cutoff:
-                recent.pop(0)
+                recent.popleft()
             self.connector_last_commit[name] = n_messages
             self.connector_finished.setdefault(name, False)
 
@@ -94,7 +117,7 @@ class StatsMonitor:
     def _connector_stats_locked(self, name: str, now: float) -> dict[str, Any]:
         """reference: ConnectorStats fields.  Caller holds the lock."""
         recent = [
-            n for t, n in self.connector_recent.get(name, []) if t >= now - 60.0
+            n for t, n in self.connector_recent.get(name, ()) if t >= now - 60.0
         ]
         return {
             "num_messages_from_start": self.connector_total.get(name, 0),
@@ -139,6 +162,9 @@ class StatsMonitor:
                 pass
         if providers:
             snap["providers"] = providers
+        freshness = get_freshness().stats()
+        if freshness:
+            snap["freshness"] = freshness
         return snap
 
     # -- OpenMetrics rendering (reference: http_server.rs:25
@@ -153,26 +179,37 @@ class StatsMonitor:
             "# TYPE pathway_operator_rows_total counter",
         ]
         for name, st in snap["nodes"].items():
-            safe = name.replace('"', "")
+            safe = escape_label_value(name)
             lines.append(
                 f'pathway_operator_rows_total{{operator="{safe}"}} {st["rows"]}'
             )
         lines.append("# TYPE pathway_operator_busy_seconds counter")
         for name, st in snap["nodes"].items():
-            safe = name.replace('"', "")
+            safe = escape_label_value(name)
             lines.append(
                 f'pathway_operator_busy_seconds{{operator="{safe}"}} {st["busy_s"]}'
             )
+        with self._lock:
+            flush_hists = list(self.flush_ms.items())
+        if flush_hists:
+            lines.append("# TYPE pathway_operator_flush_ms histogram")
+            for name, hist in flush_hists:
+                with self._lock:
+                    rendered = hist.openmetrics_lines(
+                        "pathway_operator_flush_ms",
+                        f'operator="{escape_label_value(name)}"',
+                    )
+                lines.extend(rendered)
         lines.append("# TYPE pathway_connector_messages_total counter")
         for name, st in snap.get("connectors", {}).items():
-            safe = name.replace('"', "")
+            safe = escape_label_value(name)
             lines.append(
                 f'pathway_connector_messages_total{{connector="{safe}"}} '
                 f'{st["num_messages_from_start"]}'
             )
         lines.append("# TYPE pathway_connector_finished gauge")
         for name, st in snap.get("connectors", {}).items():
-            safe = name.replace('"', "")
+            safe = escape_label_value(name)
             lines.append(
                 f'pathway_connector_finished{{connector="{safe}"}} '
                 f'{1 if st["finished"] else 0}'
@@ -182,6 +219,13 @@ class StatsMonitor:
                 lines.extend(provider.openmetrics_lines())
             except Exception:  # noqa: BLE001 — a dying provider must not kill /status
                 pass
+        lines.extend(get_freshness().openmetrics_lines())
+        # tracing stage histograms + XLA compile counters + recorder stats
+        # (lazy import: flight_recorder must stay import-light, and
+        # monitoring is the one that renders)
+        from .flight_recorder import observability_metrics_lines
+
+        lines.extend(observability_metrics_lines())
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
@@ -202,12 +246,123 @@ class StatsMonitor:
         return table
 
 
+# ---------------------------------------------------------------------------
+# data-freshness watermarks (ingest -> queryable lag per index)
+# ---------------------------------------------------------------------------
+
+
+class FreshnessTracker:
+    """High-watermark plumbing for ``pathway_index_freshness_seconds``.
+
+    The streaming driver stamps wall-clock ingest time per engine
+    timestamp as it pushes connector batches (:meth:`note_ingest`); when
+    ``ExternalIndexNode.flush`` applies the index updates of that
+    timestamp the rows become queryable and :meth:`note_indexed` turns
+    the pair into an observed ingest->queryable lag, per index.  The
+    timestamp map is bounded — an engine stamping faster than indexes
+    drain simply ages out the oldest entries (their lag would have been
+    reported by a later timestamp anyway).
+
+    ``scope`` disambiguates engines: timestamps are small per-engine
+    integers, so without it a long-lived process running several engines
+    (threaded servers, test suites) would join engine B's ``t=5`` apply
+    against engine A's hours-old ``t=5`` stamp and report phantom lag.
+    Both sides pass ``id(engine)``.
+    """
+
+    MAX_PENDING = 4096
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ingest_wall: dict[tuple[int, int], float] = {}
+        self._ingest_order: deque[tuple[int, int]] = deque()
+        #: index name -> (last observed lag seconds, observed wall time)
+        self._lag: dict[str, tuple[float, float]] = {}
+
+    def note_ingest(
+        self, engine_time: int, wall_time: float | None = None, scope: int = 0
+    ) -> None:
+        if wall_time is None:
+            wall_time = time.time()
+        key = (scope, engine_time)
+        with self._lock:
+            if key in self._ingest_wall:
+                return  # first stamp wins: earliest ingest is the watermark
+            self._ingest_wall[key] = wall_time
+            self._ingest_order.append(key)
+            while len(self._ingest_order) > self.MAX_PENDING:
+                self._ingest_wall.pop(self._ingest_order.popleft(), None)
+
+    def note_indexed(
+        self, index_name: str, engine_time: int, scope: int = 0
+    ) -> float | None:
+        """Record that ``index_name`` applied the updates of
+        ``engine_time``; returns the observed lag (None when the
+        timestamp was never stamped — static/batch data)."""
+        now = time.time()
+        with self._lock:
+            wall = self._ingest_wall.get((scope, engine_time))
+            if wall is None:
+                return None
+            lag = max(0.0, now - wall)
+            self._lag[index_name] = (lag, now)
+            return lag
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                name: {"lag_s": round(lag, 6), "age_s": round(time.time() - at, 3)}
+                for name, (lag, at) in self._lag.items()
+            }
+
+    def openmetrics_lines(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._lag.items())
+        if not items:
+            return []
+        lines = ["# TYPE pathway_index_freshness_seconds gauge"]
+        for name, (lag, _at) in items:
+            lines.append(
+                f'pathway_index_freshness_seconds{{index="{escape_label_value(name)}"}} '
+                f"{lag:.6f}"
+            )
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ingest_wall.clear()
+            self._ingest_order.clear()
+            self._lag.clear()
+
+
+#: process-global: the driver and the index nodes live in different layers
+#: and meet only here (one live engine per process — health.py scope note)
+_freshness = FreshnessTracker()
+
+
+def get_freshness() -> FreshnessTracker:
+    return _freshness
+
+
+# ---------------------------------------------------------------------------
+# the /status HTTP thread
+# ---------------------------------------------------------------------------
+
+_server_lock = threading.Lock()
+_last_server: ThreadingHTTPServer | None = None
+
+
 def start_http_server_thread(
     monitor: StatsMonitor, port: int | None = None, process_id: int = 0
 ) -> ThreadingHTTPServer:
     """Serve ``/status`` OpenMetrics on 127.0.0.1:(20000+process_id)
     (reference: http_server.rs:76-83; PATHWAY_MONITORING_HTTP_PORT
-    overrides)."""
+    overrides).
+
+    One metrics server per process: calling this again (a second
+    ``pw.run`` in the same test process) shuts the previous server down
+    and releases its socket first, instead of leaking the port thread.
+    """
     if port is None:
         import os
 
@@ -232,7 +387,17 @@ def start_http_server_thread(
         def log_message(self, *args):  # silence request logging
             pass
 
-    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    global _last_server
+    with _server_lock:
+        if _last_server is not None:
+            try:
+                _last_server.shutdown()
+                _last_server.server_close()
+            except Exception:  # noqa: BLE001 — an already-dead server is fine
+                pass
+            _last_server = None
+        server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        _last_server = server
     th = threading.Thread(target=server.serve_forever, daemon=True, name="pw-metrics")
     th.start()
     return server

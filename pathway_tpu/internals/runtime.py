@@ -126,8 +126,13 @@ class GraphRunner:
 
     # ---- public ----
     def build(self, output_requests: list[tuple[Any, OutputNode]]) -> Engine:
-        from .config import get_pathway_config
+        import time as _time_mod
 
+        from .config import get_pathway_config
+        from .flight_recorder import record_span
+
+        wall0 = _time_mod.time()
+        t0 = _time_mod.perf_counter()
         self.engine.set_threads(get_pathway_config().threads)
         ops = G.relevant_operators([t._operator for t, _ in output_requests])
         for op in ops:
@@ -136,6 +141,13 @@ class GraphRunner:
             self.engine.add(out_node)
             self._node_of(table).downstream.append((out_node, 0))
         self._feed_static_sources()
+        record_span(
+            "graph.lower",
+            "runtime",
+            wall0,
+            (_time_mod.perf_counter() - t0) * 1000.0,
+            attrs={"operators": len(ops), "nodes": len(self.engine.nodes)},
+        )
         return self.engine
 
     def _feed_static_sources(self):

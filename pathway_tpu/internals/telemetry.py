@@ -14,13 +14,25 @@ off-by-default posture.
 from __future__ import annotations
 
 import contextlib
+import sys
+import time
 from typing import Any, Iterator
 
-__all__ = ["Telemetry", "get_telemetry"]
+__all__ = ["Telemetry", "get_telemetry", "max_rss_bytes"]
+
+
+def max_rss_bytes() -> int:
+    """Peak RSS of this process in BYTES.  ``getrusage().ru_maxrss`` is
+    kilobytes on Linux but bytes on macOS — every consumer must go
+    through this one normalization instead of guessing a unit."""
+    import resource
+
+    ru_maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return ru_maxrss if sys.platform == "darwin" else ru_maxrss * 1024
 
 
 class Telemetry:
-    def __init__(self, enabled: bool | None = None):
+    def __init__(self):
         self._tracer = None
         self._meter = None
         self._monitor = None
@@ -33,27 +45,43 @@ class Telemetry:
 
     @contextlib.contextmanager
     def span(self, name: str, **attributes: Any) -> Iterator[None]:
-        """``with telemetry.span("graph_runner.run"): ...``"""
-        if self._tracer is None:
-            yield
-            return
-        with self._tracer.start_as_current_span(name) as s:
-            for k, v in attributes.items():
-                try:
-                    s.set_attribute(k, v)
-                except Exception:  # noqa: BLE001 — non-serializable attr
-                    pass
-            yield
+        """``with telemetry.span("graph_runner.run"): ...`` — OTel span
+        when a tracer is available, and ALWAYS a flight-recorder span
+        (the zero-infra trace dump must show build/run windows too)."""
+        start_s = time.time()
+        t0 = time.monotonic()
+        try:
+            if self._tracer is None:
+                yield
+                return
+            with self._tracer.start_as_current_span(name) as s:
+                for k, v in attributes.items():
+                    try:
+                        s.set_attribute(k, v)
+                    except Exception:  # noqa: BLE001 — non-serializable attr
+                        pass
+                yield
+        finally:
+            from .flight_recorder import record_span
+
+            record_span(
+                name,
+                "runtime",
+                start_s,
+                (time.monotonic() - t0) * 1000.0,
+                attrs=dict(attributes) if attributes else None,
+            )
 
     def sys_metrics(self) -> dict:
         """Process memory/CPU snapshot (reference telemetry.rs:350
-        ``register_sys_metrics``); resource module, no psutil needed."""
+        ``register_sys_metrics``); resource module, no psutil needed.
+        RSS is normalized to bytes (see :func:`max_rss_bytes`)."""
         import os
         import resource
 
         ru = resource.getrusage(resource.RUSAGE_SELF)
         return {
-            "process.memory.max_rss_kb": ru.ru_maxrss,
+            "process.memory.max_rss_bytes": max_rss_bytes(),
             "process.cpu.user_s": ru.ru_utime,
             "process.cpu.system_s": ru.ru_stime,
             "process.pid": os.getpid(),
@@ -91,9 +119,7 @@ class Telemetry:
 
                 rss = psutil.Process().memory_info().rss
             except Exception:
-                import resource
-
-                rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+                rss = max_rss_bytes()
             return [Observation(rss)]
 
         def observe_cpu(options):

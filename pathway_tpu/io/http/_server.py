@@ -113,6 +113,44 @@ class PathwayWebserver:
         asyncio.set_event_loop(self._loop)
 
         @web.middleware
+        async def tracing_mw(request, handler):
+            """Every request gets a trace: a caller-sent W3C
+            ``traceparent`` is adopted, otherwise a trace id is minted.
+            The id rides back on ``x-pathway-trace-id`` and the finished
+            span (plus any per-stage children the serving planes stamped)
+            lands in the in-process flight recorder — retrievable from
+            ``/v1/debug/traces`` with zero external infra."""
+            if request.path.startswith("/v1/debug/"):
+                # reading the recorder must not write to it
+                return await handler(request)
+            from ...internals.flight_recorder import start_request
+
+            trace = start_request(
+                f"{request.method} {request.path}",
+                request.headers.get("traceparent"),
+            )
+            request["pw_trace"] = trace
+            try:
+                resp = await handler(request)
+            except web.HTTPException as exc:
+                exc.headers["x-pathway-trace-id"] = trace.trace_id
+                trace.finish(status=exc.status)
+                raise
+            except asyncio.CancelledError:
+                # client went away mid-request — no response was sent, so
+                # recording a 500 would plant phantom errors in the trace
+                # dump during load spikes
+                trace.set_attr("cancelled", True)
+                trace.finish()
+                raise
+            except BaseException:
+                trace.finish(status=500)
+                raise
+            resp.headers["x-pathway-trace-id"] = trace.trace_id
+            trace.finish(status=resp.status)
+            return resp
+
+        @web.middleware
         async def sanitize_errors_mw(request, handler):
             """An unhandled handler exception must not leak a traceback
             body to the client: return structured JSON 500, count it, and
@@ -136,15 +174,18 @@ class PathwayWebserver:
                     kind="http",
                     operator=request.path,
                 )
-                return web.json_response(
-                    {
-                        "error": "internal server error",
-                        "route": request.path,
-                    },
-                    status=500,
-                )
+                body = {
+                    "error": "internal server error",
+                    "route": request.path,
+                }
+                trace = request.get("pw_trace")
+                if trace is not None:
+                    # the envelope carries the trace id so a 500 report
+                    # can be joined to its /v1/debug/traces breakdown
+                    body["trace_id"] = trace.trace_id
+                return web.json_response(body, status=500)
 
-        app = web.Application(middlewares=[sanitize_errors_mw])
+        app = web.Application(middlewares=[tracing_mw, sanitize_errors_mw])
         for route, methods, handler in self._routes:
             for m in methods:
                 app.router.add_route(m, route, handler)
@@ -168,8 +209,42 @@ class PathwayWebserver:
                 snap, status=200 if snap["ready"] else 503
             )
 
+        async def debug_traces_handler(request):
+            """Flight-recorder dump: ``?trace_id=`` / ``?min_ms=`` /
+            ``?category=`` / ``?limit=`` filters; ``?format=perfetto``
+            returns Chrome-tracing JSON openable in chrome://tracing or
+            ui.perfetto.dev — per-request stage attribution with no
+            collector deployed."""
+            from ...internals.flight_recorder import FlightRecorder, get_recorder
+
+            q = request.query
+            try:
+                min_ms = float(q["min_ms"]) if "min_ms" in q else None
+                limit = int(q.get("limit", "1000"))
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"detail": "min_ms/limit must be numeric"}, status=400
+                )
+            rec = get_recorder()
+            spans = rec.spans(
+                trace_id=q.get("trace_id"),
+                min_duration_ms=min_ms,
+                category=q.get("category"),
+                limit=limit,
+            )
+            if q.get("format") == "perfetto":
+                return web.json_response(FlightRecorder.perfetto(spans))
+            return web.json_response(
+                {
+                    "spans": [s.to_dict() for s in spans],
+                    "recorder": rec.stats(),
+                }
+            )
+
         if not any(route == "/v1/health" for route, _, _ in self._routes):
             app.router.add_get("/v1/health", health_handler)
+        if not any(route == "/v1/debug/traces" for route, _, _ in self._routes):
+            app.router.add_get("/v1/debug/traces", debug_traces_handler)
         if self.with_cors:
 
             @web.middleware

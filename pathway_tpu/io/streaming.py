@@ -834,7 +834,7 @@ class StreamingDriver:
                 if entries:
                     src.push(t, entries)
                     self._write_snapshot(subject, entries)
-                    self._record_connector(subject, len(entries))
+                    self._record_connector(subject, len(entries), t)
                     pushed = True
             # a finite source next to an unbounded one must report finished
             # while the run continues (reference: ConnectorMonitor finish)
@@ -858,7 +858,7 @@ class StreamingDriver:
                     if entries:
                         src.push(t, entries)
                         self._write_snapshot(subject, entries)
-                        self._record_connector(subject, len(entries))
+                        self._record_connector(subject, len(entries), t)
                         pushed = True
                 if pushed:
                     self.engine.step(t)
@@ -881,10 +881,31 @@ class StreamingDriver:
         idx = self._pid_occurrence.get(id(subject), 0)
         return f"{subject._datasource_name}-{idx}"
 
-    def _record_connector(self, subject: ConnectorSubject, n: int) -> None:
+    def _record_connector(
+        self, subject: ConnectorSubject, n: int, t: int | None = None
+    ) -> None:
+        label = self._connector_label(subject)
         monitor = getattr(self.engine, "monitor", None)
         if monitor is not None:
-            monitor.record_connector_commit(self._connector_label(subject), n)
+            monitor.record_connector_commit(label, n)
+        import time as _time_mod
+
+        from ..internals.flight_recorder import record_span
+        from ..internals.monitoring import get_freshness
+
+        now = _time_mod.time()
+        # commit event into the flight recorder (works without a monitor)
+        record_span(
+            f"commit:{label}", "connector", now, 0.0,
+            attrs={"messages": n, "t": t},
+        )
+        if t is not None:
+            # freshness watermark: these rows entered at `now` under engine
+            # timestamp `t`; when an index node applies timestamp `t` the
+            # ingest->queryable lag becomes observable
+            # (pathway_index_freshness_seconds).  Scoped by engine id —
+            # timestamps restart per engine
+            get_freshness().note_ingest(t, now, scope=id(self.engine))
 
     def _record_finished_connectors(self) -> None:
         monitor = getattr(self.engine, "monitor", None)
@@ -1026,7 +1047,7 @@ class StreamingDriver:
                 if entries:
                     src.push(t, entries)
                     self._write_snapshot(subject, entries)
-                    self._record_connector(subject, len(entries))
+                    self._record_connector(subject, len(entries), t)
                     had_data = True
             done = local_closed and t >= max_static
             # the control flag rides ahead with the data plane; every

@@ -84,10 +84,15 @@ class CrossEncoder:
             self.params, self._data_sharding, self._batch_multiple = (
                 mesh_setup(self.params, mesh)
             )
-        self._apply = jax.jit(
-            lambda params, ids, mask, tids: self.model.apply(
-                {"params": params}, ids, mask, tids
-            )
+        from ..internals.flight_recorder import instrument_jit
+
+        self._apply = instrument_jit(
+            jax.jit(
+                lambda params, ids, mask, tids: self.model.apply(
+                    {"params": params}, ids, mask, tids
+                )
+            ),
+            "cross_encoder.forward",
         )
 
     def predict(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:

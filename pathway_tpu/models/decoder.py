@@ -272,6 +272,14 @@ def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
     return jnp.transpose(toks, (1, 0))  # [B, max_new]
 
 
+# observable compile counts (pathway_xla_compile_total): generation should
+# compile once per (prompt bucket, max_new, sampling mode) — a counter
+# climbing faster than that means the prompt bucketing regressed
+from ..internals.flight_recorder import instrument_jit as _instrument_jit
+
+_generate_jit = _instrument_jit(_generate_jit, "decoder.generate")
+
+
 _PROMPT_BUCKETS = (32, 64, 128, 256, 512, 1024)
 
 

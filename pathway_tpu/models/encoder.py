@@ -358,7 +358,9 @@ class SentenceEncoder:
             self.params, self._data_sharding, self._batch_multiple = (
                 mesh_setup(self.params, mesh)
             )
-        self._apply = functools.partial(jax.jit(self._forward))
+        from ..internals.flight_recorder import instrument_jit
+
+        self._apply = instrument_jit(jax.jit(self._forward), "encoder.forward")
 
     def _forward(self, params, ids, mask):
         return self.model.apply({"params": params}, ids, mask)

@@ -138,8 +138,13 @@ class ImageEncoder:
             self.params, self._data_sharding, self._batch_multiple = (
                 mesh_setup(self.params, mesh)
             )
-        self._apply = jax.jit(
-            lambda params, images: self.model.apply({"params": params}, images)
+        from ..internals.flight_recorder import instrument_jit
+
+        self._apply = instrument_jit(
+            jax.jit(
+                lambda params, images: self.model.apply({"params": params}, images)
+            ),
+            "vision.forward",
         )
 
     @property

@@ -394,3 +394,12 @@ def _scatter_rows(matrix: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Arr
 @jax.jit
 def _scatter_mask(mask: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Array:
     return mask.at[idx].set(vals)
+
+
+# observable compile counts (pathway_xla_compile_total): upsert scatters
+# recompile only on capacity growth/compaction — a climbing counter here
+# under steady traffic means the doubling/rounding invariants broke
+from ..internals.flight_recorder import instrument_jit as _instrument_jit
+
+_scatter_rows = _instrument_jit(_scatter_rows, "knn.scatter_rows")
+_scatter_mask = _instrument_jit(_scatter_mask, "knn.scatter_mask")

@@ -92,6 +92,15 @@ def topk_search(
     return lax.top_k(s, k)
 
 
+# compile counting (pathway_xla_compile_total{site=...}): the serving
+# guarantee that bucket_q/bucket_k keep compiled-program counts flat under
+# heterogeneous (Q, k) traffic becomes an observable series instead of a
+# test-only _cache_size() probe
+from ..internals.flight_recorder import instrument_jit as _instrument_jit
+
+topk_search = _instrument_jit(topk_search, "knn.topk_search")
+
+
 # ---------------------------------------------------------------------------
 # Pallas tiled variant (HBM-resident index streamed through VMEM)
 # ---------------------------------------------------------------------------
@@ -204,3 +213,6 @@ def among_topk_search(
         raise ValueError(f"unknown metric {metric!r}")
     s = jnp.where(v, s, NEG_INF)
     return lax.top_k(s, k)
+
+
+among_topk_search = _instrument_jit(among_topk_search, "knn.among_topk_search")

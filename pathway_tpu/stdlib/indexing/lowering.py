@@ -113,6 +113,16 @@ class ExternalIndexNode(Node):
             else:
                 self.index.remove(key)
                 self.doc_payload.pop(key, None)
+        if index_changed:
+            # freshness watermark: the updates of engine timestamp `time`
+            # are queryable from here on (updates-before-queries), closing
+            # the ingest->queryable loop the driver opened when it stamped
+            # this timestamp (pathway_index_freshness_seconds{index=...})
+            from ...internals.monitoring import get_freshness
+
+            get_freshness().note_indexed(
+                self.name, time, scope=getattr(self, "_freshness_scope", 0)
+            )
         # 2. answer new queries
         new_queries: list[tuple[Any, tuple]] = []
         for key, row, diff in self.take(1):
@@ -240,6 +250,9 @@ def lower_external_index(runner: GraphRunner, op: Operator) -> None:
     runner.engine.add(node)
     runner._connect_inputs(op, node)
     runner._register(op, node)
+    # freshness watermarks are matched per engine (timestamps restart at 1
+    # in every run — see FreshnessTracker's scope note)
+    node._freshness_scope = id(runner.engine)
     # pin the factory on the node: the registry key is id(factory), so the
     # factory must stay alive exactly as long as the entry does — otherwise
     # a recycled id could alias a NEW factory to this stale node

@@ -123,6 +123,14 @@ class _Plan:
             return "ok"
         with self._lock:
             self.injected[site][action] += 1
+        # chaos events land in the flight recorder: a fault-injection run's
+        # trace dump shows exactly which tick/request each injection hit
+        from ..internals.flight_recorder import record_span
+
+        record_span(
+            f"fault:{site}:{action}", "fault", time.time(), 0.0,
+            attrs={"site": site, "action": action, "call": n},
+        )
         if action == "delay":
             time.sleep(rule["delay_ms"] / 1000.0)
             return "ok"

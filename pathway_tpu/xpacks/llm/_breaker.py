@@ -122,15 +122,19 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            prev = self._state
             self._counters["successes_total"] += 1
             self._consecutive_failures = 0
             self._probe_in_flight = False
             if self._state != CLOSED:
                 self._state = CLOSED
+            new = self._state
+        self._note_transition(prev, new)
         self._publish_health()
 
     def record_failure(self, exc: BaseException | None = None) -> None:
         with self._lock:
+            prev = self._state
             self._counters["failures_total"] += 1
             if exc is not None:
                 self._counters["last_error"] = f"{type(exc).__name__}: {exc}"
@@ -149,7 +153,24 @@ class CircuitBreaker:
                     self._state = OPEN
                     self._opened_at = time.monotonic()
                     self._counters["trips_total"] += 1
+            new = self._state
+        self._note_transition(prev, new)
         self._publish_health()
+
+    def _note_transition(self, prev: str, new: str) -> None:
+        """Breaker state changes are flight-recorder events: a degraded
+        window in a trace dump lines up with the trip that caused it."""
+        if prev == new:
+            return
+        from ...internals.flight_recorder import record_span
+
+        record_span(
+            f"breaker:{self.name}:{prev}->{new}",
+            "breaker",
+            time.time(),
+            0.0,
+            attrs={"breaker": self.name, "from": prev, "to": new},
+        )
 
     def call(self, fn, *args, **kwargs):
         """Run ``fn`` through the breaker: refused → :class:`BreakerOpen`;
@@ -186,8 +207,10 @@ class CircuitBreaker:
             }
 
     def openmetrics_lines(self) -> list[str]:
+        from ...internals.metrics_names import escape_label_value
+
         s = self.stats()
-        lbl = f'breaker="{self.name}"'
+        lbl = f'breaker="{escape_label_value(self.name)}"'
         state_code = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}[s["state"]]
         lines = [
             "# TYPE pathway_breaker_state gauge",

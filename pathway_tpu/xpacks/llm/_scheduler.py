@@ -92,14 +92,19 @@ class WorkGroup:
 
 
 class _WorkItem:
-    __slots__ = ("group", "payload", "future", "enqueued_at", "deadline_at")
+    __slots__ = (
+        "group", "payload", "future", "enqueued_at", "deadline_at", "trace",
+    )
 
-    def __init__(self, group, payload, future, enqueued_at, deadline_at):
+    def __init__(self, group, payload, future, enqueued_at, deadline_at,
+                 trace=None):
         self.group = group
         self.payload = payload
         self.future = future
         self.enqueued_at = enqueued_at
         self.deadline_at = deadline_at
+        #: sampled RequestTrace riding this item (internals/flight_recorder)
+        self.trace = trace
 
 
 #: wait-time histogram bucket upper bounds (milliseconds)
@@ -156,6 +161,7 @@ class ServingScheduler:
         *,
         deadline_s: float | None = None,
         sheddable: bool | None = None,
+        trace: Any = None,
     ) -> Future:
         """Enqueue one payload; the future resolves when its batch ran.
 
@@ -169,9 +175,15 @@ class ServingScheduler:
         ``max_queue`` admission control.  Engine-plane work is exempt:
         refusing an ingest micro-batch's embeds would error the engine,
         and its volume is already bounded by engine batch sizes.
+
+        ``trace`` (a sampled ``RequestTrace``) rides the item: the drain
+        stamps its queue wait and the batch handler's stage timers
+        (embed, search) attribute device time back to the request.
         """
         if sheddable is None:
             sheddable = deadline_s is not None
+        if trace is not None and not trace.sampled:
+            trace = None
         fut: Future = Future()
         if self._thread is not None and threading.current_thread() is self._thread:
             # re-entrant submit from inside a batch handler (e.g. a
@@ -179,7 +191,10 @@ class ServingScheduler:
             # batcher): run inline — a queued item could never drain
             # while the loop is inside this very tick.  _execute handles
             # the dispatch lock, result validation and error routing
-            self._execute(group, [_WorkItem(group, payload, fut, time.monotonic(), None)])
+            self._execute(
+                group,
+                [_WorkItem(group, payload, fut, time.monotonic(), None, trace)],
+            )
             return fut
         now = time.monotonic()
         item = _WorkItem(
@@ -188,6 +203,7 @@ class ServingScheduler:
             fut,
             now,
             None if deadline_s is None else now + deadline_s,
+            trace,
         )
         with self._cv:
             if sheddable and len(self._queue) >= self.max_queue:
@@ -217,9 +233,13 @@ class ServingScheduler:
         *,
         deadline_s: float | None = None,
         sheddable: bool | None = None,
+        trace: Any = None,
     ) -> Any:
         return await asyncio.wrap_future(
-            self.submit(group, payload, deadline_s=deadline_s, sheddable=sheddable)
+            self.submit(
+                group, payload,
+                deadline_s=deadline_s, sheddable=sheddable, trace=trace,
+            )
         )
 
     # -- device-step loop ------------------------------------------------
@@ -265,6 +285,8 @@ class ServingScheduler:
             live: list[_WorkItem] = []
             for it in gitems:
                 self._observe_wait((now - it.enqueued_at) * 1000.0)
+                if it.trace is not None:
+                    it.trace.add_stage_mono("queue_wait", it.enqueued_at, now)
                 if it.deadline_at is not None and now > it.deadline_at:
                     with self._mx:
                         self._counters["shed_deadline_total"] += 1
@@ -284,6 +306,8 @@ class ServingScheduler:
     def _execute(self, group: WorkGroup, chunk: list[_WorkItem]) -> None:
         if not chunk:
             return
+        from ...internals.flight_recorder import batch_traces, record_span
+
         with self._mx:
             self._counters["batches_total"] += 1
             if len(chunk) > 1:
@@ -294,6 +318,10 @@ class ServingScheduler:
         # honor the batcher's dispatch lock: build-time probes may call the
         # model off-thread while the loop runs
         lock = getattr(group, "_dispatch_lock", None)
+        traces = [it.trace for it in chunk if it.trace is not None]
+        tick_wall = time.time()
+        tick_t0 = time.monotonic()
+        ok = True
         try:
             from ...testing import faults
 
@@ -301,23 +329,39 @@ class ServingScheduler:
                 # chaos site "scheduler.step": a failed device step fans
                 # out to the batch's waiters like any handler error
                 faults.perturb("scheduler.step")
-            if lock is not None:
-                with lock:
+            # batch-scope the riding traces: the handler's stage timers
+            # (embed, search) stamp onto every request in the tick
+            with batch_traces(traces):
+                if lock is not None:
+                    with lock:
+                        results = group.batch_fn([it.payload for it in chunk])
+                else:
                     results = group.batch_fn([it.payload for it in chunk])
-            else:
-                results = group.batch_fn([it.payload for it in chunk])
             if len(results) != len(chunk):
                 raise RuntimeError(
                     f"batch handler {group.label!r} returned {len(results)} "
                     f"results for {len(chunk)} items"
                 )
         except BaseException as exc:  # noqa: BLE001 — propagate to every waiter
+            ok = False
             with self._mx:
                 self._counters["failed_total"] += len(chunk)
             for it in chunk:
                 if not it.future.done():
                     it.future.set_exception(exc)
             return
+        finally:
+            record_span(
+                f"tick:{group.label}",
+                "scheduler",
+                tick_wall,
+                (time.monotonic() - tick_t0) * 1000.0,
+                attrs={
+                    "scheduler": self.name,
+                    "occupancy": len(chunk),
+                    "ok": ok,
+                },
+            )
         with self._mx:
             self._counters["completed_total"] += len(chunk)
         for it, res in zip(chunk, results):
@@ -361,8 +405,10 @@ class ServingScheduler:
 
     def openmetrics_lines(self) -> list[str]:
         """``pathway_scheduler_*`` series for the /status endpoint."""
+        from ...internals.metrics_names import escape_label_value
+
         s = self.stats()
-        lbl = f'scheduler="{self.name}"'
+        lbl = f'scheduler="{escape_label_value(self.name)}"'
         lines = []
         for metric, kind in (
             ("submitted_total", "counter"),
@@ -620,7 +666,10 @@ class RetrievePlane:
             )
         index = node.index
         if getattr(index, "query_is_text", False):
-            raw = index.search(list(items))
+            from ...internals.flight_recorder import batch_stage as _bs
+
+            with _bs("search"):
+                raw = index.search(list(items))
             return [
                 {"results": self._pack(node, row), "degraded": False}
                 for row in raw
@@ -629,6 +678,8 @@ class RetrievePlane:
             raise RuntimeError(
                 "retrieve plane needs an embedder for a vector index"
             )
+        from ...internals.flight_recorder import batch_stage
+
         raw = None
         if self.breaker is None or self.breaker.allow():
             try:
@@ -636,14 +687,16 @@ class RetrievePlane:
 
                 if faults.enabled:
                     faults.perturb("embedder")
-                embs = _batch_embed(self.embedder, [q for q, _, _ in items])
+                with batch_stage("embed"):
+                    embs = _batch_embed(self.embedder, [q for q, _, _ in items])
                 specs = [(k, flt) for _, k, flt in items]
-                if hasattr(index, "search_embedded"):
-                    raw = index.search_embedded(embs, specs)
-                else:
-                    raw = index.search(
-                        [(embs[i], k, flt) for i, (k, flt) in enumerate(specs)]
-                    )
+                with batch_stage("search"):
+                    if hasattr(index, "search_embedded"):
+                        raw = index.search_embedded(embs, specs)
+                    else:
+                        raw = index.search(
+                            [(embs[i], k, flt) for i, (k, flt) in enumerate(specs)]
+                        )
             except Exception as exc:  # noqa: BLE001 — degrade, don't 5xx
                 # record FIRST: even without a fallback the breaker must
                 # trip so repeated failures fail fast (ServingNotReady)
@@ -675,7 +728,8 @@ class RetrievePlane:
                 "embedder unavailable and lexical fallback disabled",
                 retry_after_s=self.scheduler.retry_after_s,
             )
-        raw = self._mirror.search(node, items)
+        with batch_stage("lexical_search"):
+            raw = self._mirror.search(node, items)
         return [
             {"results": self._pack(node, row), "degraded": True}
             for row in raw
@@ -737,24 +791,40 @@ class RetrievePlane:
                 return web.json_response(
                     {"detail": "invalid deadline_ms"}, status=400
                 )
+            # trace context minted/adopted by the webserver's tracing
+            # middleware: the scheduler stamps queue_wait, the batch
+            # handler embed/search — the full per-stage breakdown lands
+            # in the flight recorder under this request's trace id
+            trace = request.get("pw_trace")
+            from ...internals.flight_recorder import trace_stage
+
             try:
                 result = await self.scheduler.submit_async(
                     self.group, (query, k, flt),
-                    deadline_s=deadline_s, sheddable=True,
+                    deadline_s=deadline_s, sheddable=True, trace=trace,
                 )
             except DeadlineExceeded as exc:
+                shed_body = {"detail": str(exc)}
+                if trace is not None:
+                    shed_body["trace_id"] = trace.trace_id
                 return web.json_response(
-                    {"detail": str(exc)},
+                    shed_body,
                     status=503,
                     headers={"Retry-After": f"{exc.retry_after_s:g}"},
                 )
-            if result["degraded"]:
-                # degraded-mode contract: an object tagging the fallback,
-                # so callers/monitors can tell lexical answers apart; the
-                # healthy path keeps the plain-list shape for back-compat
-                return web.json_response(
-                    {"results": result["results"], "degraded": True}
-                )
-            return web.json_response(result["results"])
+            with trace_stage(trace, "serialize"):
+                if result["degraded"]:
+                    # degraded-mode contract: an object tagging the
+                    # fallback, so callers/monitors can tell lexical
+                    # answers apart; the healthy path keeps the
+                    # plain-list shape for back-compat (the trace id
+                    # rides the x-pathway-trace-id header either way)
+                    body = {"results": result["results"], "degraded": True}
+                    if trace is not None:
+                        body["trace_id"] = trace.trace_id
+                    resp = web.json_response(body)
+                else:
+                    resp = web.json_response(result["results"])
+            return resp
 
         return handle

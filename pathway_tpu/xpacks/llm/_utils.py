@@ -67,6 +67,11 @@ class RestClientBase:
     ``max_retry_after_s`` and the whole retry budget to
     ``retry_deadline_s`` of wall clock — a saturated server makes the
     client fail fast after the deadline instead of piling on.
+
+    Every response's ``x-pathway-trace-id`` header is captured as
+    ``last_trace_id`` — paste it into the server's
+    ``/v1/debug/traces?trace_id=...`` to see where that exact request's
+    time went (queue wait / embed / search / serialize).
     """
 
     def __init__(
@@ -98,6 +103,9 @@ class RestClientBase:
         self.backoff_factor = backoff_factor
         self.backoff_jitter_s = backoff_jitter_s
         self.retry_deadline_s = retry_deadline_s
+        #: trace id of the most recent response (server-minted, or the
+        #: caller's own traceparent's trace id when one was sent)
+        self.last_trace_id: str | None = None
 
     def _post(self, route: str, payload: dict):
         import random
@@ -147,6 +155,9 @@ class RestClientBase:
             method="POST",
         )
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            trace_id = resp.headers.get("x-pathway-trace-id")
+            if trace_id is not None:
+                self.last_trace_id = trace_id
             return json.loads(resp.read().decode())
 
 

@@ -135,8 +135,14 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
 
         @udf(executor=async_executor(), return_type=dt.Optional(dt.STR))
         async def guarded_llm(messages, model: str | None = None):
+            import time as _time_mod
+
+            from ...internals.flight_recorder import observe_stage, record_span
+
             if not breaker.allow():
                 return None
+            wall0 = _time_mod.time()
+            t0 = _time_mod.monotonic()
             try:
                 result = await base(messages, model=model)
             except Exception as exc:  # noqa: BLE001 — degrade, don't poison
@@ -149,8 +155,24 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
                     kind="serving",
                     operator="llm",
                 )
+                dur_ms = (_time_mod.monotonic() - t0) * 1000.0
+                record_span(
+                    "llm", "llm", wall0, dur_ms,
+                    attrs={"model": model, "ok": False},
+                )
+                # failures observe too — a histogram that only sees the
+                # healthy calls hides exactly the timeout tail it exists
+                # to expose
+                observe_stage("llm", dur_ms)
                 return None
             breaker.record_success()
+            # LLM latency is usually the answer path's dominant stage:
+            # span for trace dumps + pathway_request_stage_ms{stage="llm"}
+            dur_ms = (_time_mod.monotonic() - t0) * 1000.0
+            record_span(
+                "llm", "llm", wall0, dur_ms, attrs={"model": model, "ok": True}
+            )
+            observe_stage("llm", dur_ms)
             return result
 
         return guarded_llm

@@ -302,6 +302,12 @@ class VectorStoreServer:
         into one fused embed→search device tick instead of riding engine
         micro-batch cadence — with ``deadline_ms``-based shedding
         (503 + Retry-After).  Statistics/inputs stay engine-routed.
+
+        Every route is traced: responses carry ``x-pathway-trace-id``
+        (a caller-sent W3C ``traceparent`` is honored) and the scheduler
+        path records a per-stage breakdown (queue wait / embed / search /
+        serialize) retrievable from ``GET /v1/debug/traces`` on the same
+        server — see README "Operations: observability".
         """
         from ...io.http import PathwayWebserver, rest_connector
 
@@ -398,7 +404,10 @@ class VectorStoreClient(RestClientBase):
 
     ``retry_on_unavailable=True`` honors the scheduler's
     503 + ``Retry-After`` shedding with one bounded retry (off by
-    default — callers owning their own backoff keep full control)."""
+    default — callers owning their own backoff keep full control).
+    ``last_trace_id`` holds the server's trace id for the most recent
+    call — feed it to ``/v1/debug/traces?trace_id=`` for the per-stage
+    latency breakdown of that exact request."""
 
     def __init__(self, *args, timeout: float = 15.0, **kwargs):
         super().__init__(*args, timeout=timeout, **kwargs)
