@@ -51,14 +51,28 @@ _PEAK_BF16 = {
 }
 
 
-def _corpus(n_docs: int = 2048) -> list[str]:
+#: per-doc word counts, cycle of 4 (tokens ≈ words + CLS/SEP): two short
+#: docs (seq bucket 32), one medium (64), one long (128) — the
+#: mixed-length shape real ingest corpora have (chunked documents +
+#: titles + queries), and the case whole-batch padding pays 2-4x extra
+#: FLOPs on.  Counts per bucket land on exact batch buckets so the
+#: packed path compiles few shapes.
+_MIXED_WORDS = (24, 24, 56, 120)
+
+
+def _corpus(n_docs: int = 2048, mixed: bool = True) -> list[str]:
     import numpy as np
 
     rng = np.random.default_rng(0)
     words = [f"w{i:04d}" for i in range(2000)]
+    if not mixed:
+        return [
+            " ".join(rng.choice(words, size=96))  # ~128 tokens after wordpiece
+            for _ in range(n_docs)
+        ]
     return [
-        " ".join(rng.choice(words, size=96))  # ~128 tokens after wordpiece
-        for _ in range(n_docs)
+        " ".join(rng.choice(words, size=_MIXED_WORDS[i % len(_MIXED_WORDS)]))
+        for i in range(n_docs)
     ]
 
 
@@ -116,8 +130,11 @@ def child_device(seconds: float = 10.0) -> None:
     ids_all, mask_all = enc.tokenizer.encode_batch(docs, max_length=enc.max_length)
     fwd = lambda i, m: enc._apply(enc.params, i, m)  # noqa: E731
     vocab = enc.cfg.vocab_size
+    # headline path: per-seq-bucket packed dispatch (BENCH_PACKED=0 pins
+    # the legacy whole-batch padding for A/B)
+    packed_default = os.environ.get("BENCH_PACKED", "1") != "0"
 
-    def measure(batch: int) -> float:
+    def measure(batch: int, packed: bool = packed_default) -> float:
         """Steady-state forward throughput at one chunk size (already warm)."""
         n_docs = 0
         t0 = time.perf_counter()
@@ -130,11 +147,35 @@ def child_device(seconds: float = 10.0) -> None:
                     mask_all[start:stop],
                     enc.max_length,
                     vocab_size=vocab,
+                    packed=packed,
                 )
                 n_docs += stop - start
             if time.perf_counter() - t0 > seconds:
                 break
         return n_docs / (time.perf_counter() - t0)
+
+    # padding accounting for the headline path: one packed_prepare pass
+    # over the measurement slices tells how many padded tokens the device
+    # actually computes per real token (the packed win over whole-batch)
+    from pathway_tpu.models.encoder import packed_prepare
+
+    def _padding_eff(batch: int) -> float:
+        real = padded = 0
+        for start in range(0, len(docs), batch):
+            _, st = packed_prepare(
+                ids_all[start : start + batch],
+                mask_all[start : start + batch],
+                enc.max_length,
+                vocab_size=vocab,
+            )
+            real += st["real_tokens"]
+            padded += st["padded_tokens"]
+        return round(real / padded, 4) if padded else 1.0
+
+    extra: dict = {
+        "corpus": "mixed_seq32/64/128",
+        "packed": packed_default,
+    }
 
     # escalating warmup: a small bucket compiles fast and guarantees a
     # number even on a slow/contended chip; the big bucket (better RPC
@@ -143,25 +184,38 @@ def child_device(seconds: float = 10.0) -> None:
     # improvement is PRINTED immediately — the parent takes the last
     # JSON line, so a hang mid-escalation still yields a measurement.
     small = 256
-    bucketed_dispatch(fwd, ids_all[:small], mask_all[:small], enc.max_length, vocab_size=vocab)
-    docs_per_sec = _emit_device_result(measure(small), dev, attn)
+    bucketed_dispatch(fwd, ids_all[:small], mask_all[:small], enc.max_length, vocab_size=vocab, packed=packed_default)
+    if packed_default:
+        extra["padding_efficiency"] = _padding_eff(small)
+    docs_per_sec = _emit_device_result(measure(small), dev, attn, **extra)
+    # in-run A/B: the legacy whole-batch path over the SAME mixed corpus
+    # (one extra compile at the (bucket(small), 128) shape) pins the
+    # packed speedup to this run's conditions instead of a stale round
+    if packed_default and time.monotonic() + 60 + seconds < child_deadline:
+        try:
+            bucketed_dispatch(fwd, ids_all[:small], mask_all[:small], enc.max_length, vocab_size=vocab, packed=False)
+            extra["legacy_docs_per_sec"] = round(measure(small, packed=False), 1)
+        except Exception as exc:
+            extra["ab_warning"] = f"legacy A/B failed: {exc!r}"[:300]
+        _emit_device_result(docs_per_sec, dev, attn, **extra)
     big = min(1024, len(docs))
     big_warm = False
     # conservative escalation cost: a fresh-shape compile over the tunnel
     # has been observed north of 150s
     if big > small and time.monotonic() + 180 + seconds < child_deadline:
-        bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
+        bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab, packed=packed_default)
         big_warm = True
+        if packed_default:
+            extra["padding_efficiency"] = _padding_eff(big)
         docs_per_sec = max(docs_per_sec, measure(big))
-        docs_per_sec = _emit_device_result(docs_per_sec, dev, attn)
+        docs_per_sec = _emit_device_result(docs_per_sec, dev, attn, **extra)
         # steady chip + budget to spare: take a second same-length sample
         # (keeps the best of the two against scheduler noise)
         if time.monotonic() + 3 * seconds < child_deadline:
             docs_per_sec = max(docs_per_sec, measure(big))
 
-    _emit_device_result(docs_per_sec, dev, attn)
+    _emit_device_result(docs_per_sec, dev, attn, **extra)
     best_attn = attn
-    extra: dict = {}
 
     # A/B the pallas kernel only after a banked fused measurement and only
     # on a real chip (interpret mode off-TPU is orders slower) — a hang or
@@ -188,7 +242,10 @@ def child_device(seconds: float = 10.0) -> None:
             # be VISIBLE.  ab_warning (not child_warning): the headline
             # measurement is complete, so the parent must surface it
             # without treating the run as degraded and retrying.
-            extra["ab_warning"] = f"pallas A/B failed: {exc!r}"[:300]
+            msg = f"pallas A/B failed: {exc!r}"[:300]
+            extra["ab_warning"] = (
+                f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
+            )
         _emit_device_result(docs_per_sec, dev, best_attn, **extra)
 
     # bf16-wire A/B: over the tunneled chip the device→host download of
@@ -268,6 +325,31 @@ def child_device(seconds: float = 10.0) -> None:
         _emit_device_result(docs_per_sec, dev, best_attn, **extra)
 
 
+def child_probe() -> None:
+    """Bounded TPU-reachability probe: initialize the backend, touch one
+    trivial device computation, print one JSON line.  The parent runs
+    this (cheap, with one retry) BEFORE committing hundreds of seconds to
+    the full device child — a down tunnel now costs two bounded probes
+    instead of two 400s-class timeouts (BENCH_r05: 420s + 271s eaten)."""
+    t0 = time.monotonic()
+    import jax
+
+    dev = jax.devices()[0]
+    import jax.numpy as jnp
+
+    jnp.zeros((8,)).block_until_ready()
+    print(
+        json.dumps(
+            {
+                "platform": dev.platform,
+                "device_kind": getattr(dev, "device_kind", str(dev)),
+                "init_s": round(time.monotonic() - t0, 1),
+            }
+        ),
+        flush=True,
+    )
+
+
 def _mfu(docs_per_sec: float, dev) -> float | None:
     kind = getattr(dev, "device_kind", str(dev))
     for key, peak in _PEAK_BF16.items():
@@ -300,6 +382,10 @@ def _emit_device_result(
 
 
 def child_torch(seconds: float = 8.0) -> None:
+    """Same MiniLM geometry, same mixed length distribution, torch's best
+    CPU practice: length-sorted batches dynamically padded to the batch
+    max (what sentence-transformers' ``encode`` does) — the reference is
+    not handicapped with pad-to-128 on short docs."""
     import numpy as np
     import torch
     from transformers import BertConfig, BertModel
@@ -318,21 +404,30 @@ def child_torch(seconds: float = 8.0) -> None:
 
     rng = np.random.default_rng(0)
     batch = 64
-    ids = torch.from_numpy(
-        rng.integers(4, 30000, size=(batch, _S)).astype(np.int64)
-    )
-    mask = torch.ones((batch, _S), dtype=torch.int64)
+    # token length = words + CLS/SEP, capped at the metric's seq 128;
+    # one homogeneous batch per distinct length = sorted dynamic padding
+    # at its best.  Weights mirror _MIXED_WORDS (two short, one medium,
+    # one long per cycle of 4 docs).
+    batches = []
+    for words in _MIXED_WORDS:
+        seq = min(words + 2, _S)
+        ids = torch.from_numpy(
+            rng.integers(4, 30000, size=(batch, seq)).astype(np.int64)
+        )
+        batches.append((ids, torch.ones((batch, seq), dtype=torch.int64)))
 
     with torch.no_grad():
-        model(input_ids=ids, attention_mask=mask)  # warmup
+        for ids, mask in batches:
+            model(input_ids=ids, attention_mask=mask)  # warmup
         n_docs = 0
         t0 = time.perf_counter()
         while True:
-            out = model(input_ids=ids, attention_mask=mask).last_hidden_state
-            m = mask[:, :, None].float()
-            pooled = (out * m).sum(1) / m.sum(1)
-            torch.nn.functional.normalize(pooled, dim=-1)
-            n_docs += batch
+            for ids, mask in batches:
+                out = model(input_ids=ids, attention_mask=mask).last_hidden_state
+                m = mask[:, :, None].float()
+                pooled = (out * m).sum(1) / m.sum(1)
+                torch.nn.functional.normalize(pooled, dim=-1)
+                n_docs += batch
             elapsed = time.perf_counter() - t0
             if elapsed > seconds:
                 break
@@ -367,6 +462,7 @@ def _run_child(mode: str, env: dict | None, timeout: float) -> dict | None:
     child_env["BENCH_CHILD_BUDGET_S"] = str(max(timeout - 30.0, 30.0))
     if env:
         child_env.update(env)
+    t0 = time.monotonic()
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), mode],
@@ -377,14 +473,23 @@ def _run_child(mode: str, env: dict | None, timeout: float) -> dict | None:
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired as exc:
+        elapsed = time.monotonic() - t0
         # salvage a partial result: the device child prints its
         # guaranteed small-batch measurement BEFORE attempting the big
         # (slow-compiling) bucket, so a hang mid-escalation still counts
         salvaged = _last_json_line(exc.stdout)
         if salvaged is not None:
-            salvaged.setdefault("child_warning", f"timed out after {timeout:.0f}s")
+            salvaged.setdefault(
+                "child_warning",
+                f"timed out (budget {timeout:.0f}s, elapsed {elapsed:.0f}s, "
+                "salvaged last banked line)",
+            )
             return salvaged
-        return {"error": f"{mode} timed out after {timeout:.0f}s"}
+        return {
+            "error": f"{mode} timed out (budget {timeout:.0f}s, "
+            f"elapsed {elapsed:.0f}s, no JSON banked)"
+        }
+    elapsed = time.monotonic() - t0
     if proc.returncode != 0:
         # salvage: the device child prints every banked measurement as it
         # goes, so a crash in a LATER phase (e.g. the pallas A/B) must not
@@ -392,14 +497,22 @@ def _run_child(mode: str, env: dict | None, timeout: float) -> dict | None:
         salvaged = _last_json_line(proc.stdout)
         if salvaged is not None:
             salvaged.setdefault(
-                "child_warning", f"rc={proc.returncode}: {proc.stderr[-200:]}"
+                "child_warning",
+                f"rc={proc.returncode} elapsed={elapsed:.0f}s: "
+                f"{proc.stderr[-200:]}",
             )
             return salvaged
-        return {"error": f"{mode} rc={proc.returncode}: {proc.stderr[-400:]}"}
+        return {
+            "error": f"{mode} rc={proc.returncode} elapsed={elapsed:.0f}s: "
+            f"{proc.stderr[-400:]}"
+        }
     result = _last_json_line(proc.stdout)
     if result is not None:
         return result
-    return {"error": f"{mode} produced no JSON: {proc.stdout[-200:]}"}
+    return {
+        "error": f"{mode} rc=0 elapsed={elapsed:.0f}s produced no JSON: "
+        f"{proc.stdout[-200:]}"
+    }
 
 
 def _run_script(rel_path: str, timeout: float) -> dict | None:
@@ -516,11 +629,35 @@ def main() -> None:
         elif r:
             errors.append(f"{key}: {r.get('error', 'no result')}")
 
-    # 2) TPU attempt with everything that's left: init can hang, so the
-    # child prints every measurement immediately and a timeout salvages
-    # the best line printed so far
-    result = None
+    # 2) TPU attempt with everything that's left: init can hang, so a
+    # BOUNDED probe (one retry) checks the chip is reachable before the
+    # expensive child gets hundreds of seconds — and the child prints
+    # every measurement immediately so a timeout salvages the best line
+    probe = None
     for attempt in range(2):
+        if left() < 120:
+            break
+        probe = _run_child("--child-probe", None, min(left() - 30.0, 90.0))
+        if probe and "platform" in probe:
+            break
+        errors.append(
+            f"device probe attempt {attempt + 1}: "
+            f"{(probe or {}).get('error', 'unknown')}"
+        )
+        probe = None
+        time.sleep(3)
+    # a probe that came up CPU means there is no chip behind this run —
+    # the bounded CPU-fallback measurement above is already the honest
+    # number, and the full 2048-doc device child would only time out at
+    # CPU speed (BENCH_r05's 420s/271s warnings)
+    attempts = 2 if (probe and probe.get("platform") == "tpu") else 0
+    if probe and probe.get("platform") != "tpu":
+        errors.append(
+            f"device probe found platform={probe.get('platform')} "
+            f"(init {probe.get('init_s')}s): skipping the full device child"
+        )
+    result = None
+    for attempt in range(attempts):
         budget = left() - 15.0
         if budget < 75:
             break
@@ -549,6 +686,10 @@ def main() -> None:
         out["mfu"] = result.get("mfu")
         out["attn_impl"] = result.get("attn_impl")
         for opt in (
+            "corpus",
+            "packed",
+            "padding_efficiency",
+            "legacy_docs_per_sec",
             "pallas_docs_per_sec",
             "wire_bf16_docs_per_sec",
             "compute_only_docs_per_sec",
@@ -570,7 +711,8 @@ def main() -> None:
         out["error"] = "; ".join(errors[-3:]) or "no measurement succeeded"
     out["baseline"] = {
         "definition": "same MiniLM-L6 geometry via torch on this container's "
-        "CPUs (reference config #1 compute path), measured in-run, "
+        "CPUs (reference config #1 compute path) over the same mixed-length "
+        "corpus with length-sorted dynamic padding, measured in-run, "
         "best of 2 interleaved A/B reps",
         "docs_per_sec": baseline_dps,
         "spread": baseline_spread,
@@ -628,5 +770,7 @@ if __name__ == "__main__":
         child_device()
     elif len(sys.argv) > 1 and sys.argv[1] == "--child-torch":
         child_torch()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--child-probe":
+        child_probe()
     else:
         main()

@@ -68,6 +68,10 @@ __all__ = [
     "record_xla_compile",
     "instrument_jit",
     "compile_stats",
+    "record_padding",
+    "record_ingest_docs",
+    "record_tokenizer_cache",
+    "ingest_stats",
     "observability_metrics_lines",
 ]
 
@@ -619,6 +623,57 @@ def _emit_otel(
 
 
 # ---------------------------------------------------------------------------
+# ingest-plane counters (padding efficiency, docs ingested, tokenizer cache)
+# ---------------------------------------------------------------------------
+
+_ingest_lock = threading.Lock()
+_ingest_counters = {
+    "docs_total": 0,
+    "real_tokens": 0,
+    "padded_tokens": 0,
+    "tokenizer_cache_hits": 0,
+    "tokenizer_cache_misses": 0,
+}
+
+
+def record_padding(real_tokens: int, padded_tokens: int) -> None:
+    """One packed/legacy dispatch's token accounting — feeds the
+    ``pathway_embed_padding_efficiency`` gauge (real / padded; 1.0 means
+    every FLOP the device spent was on a real token)."""
+    with _ingest_lock:
+        _ingest_counters["real_tokens"] += int(real_tokens)
+        _ingest_counters["padded_tokens"] += int(padded_tokens)
+
+
+def record_ingest_docs(n: int) -> None:
+    """Documents embedded+upserted through an ingest plane
+    (``pathway_ingest_docs_total``)."""
+    with _ingest_lock:
+        _ingest_counters["docs_total"] += int(n)
+
+
+def record_tokenizer_cache(hits: int = 0, misses: int = 0) -> None:
+    with _ingest_lock:
+        _ingest_counters["tokenizer_cache_hits"] += int(hits)
+        _ingest_counters["tokenizer_cache_misses"] += int(misses)
+
+
+def ingest_stats() -> dict[str, Any]:
+    with _ingest_lock:
+        snap = dict(_ingest_counters)
+    snap["padding_efficiency"] = (
+        snap["real_tokens"] / snap["padded_tokens"]
+        if snap["padded_tokens"]
+        else 1.0
+    )
+    hits, misses = snap["tokenizer_cache_hits"], snap["tokenizer_cache_misses"]
+    snap["tokenizer_cache_hit_rate"] = (
+        hits / (hits + misses) if hits + misses else 0.0
+    )
+    return snap
+
+
+# ---------------------------------------------------------------------------
 # XLA compile counters (pathway_xla_compile_total{site=...})
 # ---------------------------------------------------------------------------
 
@@ -697,6 +752,21 @@ def observability_metrics_lines() -> list[str]:
     lines.append(
         f"pathway_flight_recorder_spans_total {rec.stats()['recorded_total']}"
     )
+    ing = ingest_stats()
+    lines.append("# TYPE pathway_ingest_docs_total counter")
+    lines.append(f"pathway_ingest_docs_total {ing['docs_total']}")
+    lines.append("# TYPE pathway_embed_padding_efficiency gauge")
+    lines.append(
+        f"pathway_embed_padding_efficiency {ing['padding_efficiency']:.4f}"
+    )
+    lines.append("# TYPE pathway_tokenizer_cache_hits_total counter")
+    lines.append(
+        f"pathway_tokenizer_cache_hits_total {ing['tokenizer_cache_hits']}"
+    )
+    lines.append("# TYPE pathway_tokenizer_cache_misses_total counter")
+    lines.append(
+        f"pathway_tokenizer_cache_misses_total {ing['tokenizer_cache_misses']}"
+    )
     return lines
 
 
@@ -706,3 +776,6 @@ def reset_stage_metrics() -> None:
         _stage_hists.clear()
     with _compile_lock:
         _compile_counts.clear()
+    with _ingest_lock:
+        for k in _ingest_counters:
+            _ingest_counters[k] = 0

@@ -75,6 +75,25 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "XLA compilations per jit entry point (bucket_q/bucket_k pin: flat under serving)",
     ),
+    # ingest plane (internals/flight_recorder.py accumulators fed by
+    # models/encoder.py packed dispatch, xpacks/llm/_ingest.py pipeline,
+    # stdlib/indexing/lowering.py index adds, models/tokenizer.py cache)
+    "pathway_ingest_docs_total": (
+        "counter",
+        "documents embedded and applied to a live index",
+    ),
+    "pathway_embed_padding_efficiency": (
+        "gauge",
+        "real tokens / padded tokens across embed dispatches (1.0 = no padding waste)",
+    ),
+    "pathway_tokenizer_cache_hits_total": (
+        "counter",
+        "tokenizer LRU memoization hits (dedup-heavy live streams)",
+    ),
+    "pathway_tokenizer_cache_misses_total": (
+        "counter",
+        "tokenizer LRU memoization misses",
+    ),
 }
 
 

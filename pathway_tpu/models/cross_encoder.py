@@ -47,8 +47,18 @@ class CrossEncoder:
         seed: int = 0,
         max_length: int = 256,
         mesh=None,
+        max_tokens: int | None = None,
+        packed: bool | None = None,
     ):
         import dataclasses
+
+        from .encoder import embed_max_tokens
+
+        # rerank pairs are even more length-skewed than documents (query
+        # + doc concatenated): the packed dispatch + token budget apply
+        # exactly as in SentenceEncoder
+        self.max_tokens = max_tokens if max_tokens is not None else embed_max_tokens()
+        self.packed = packed
 
         self.pretrained = False
         params = None
@@ -120,6 +130,8 @@ class CrossEncoder:
             type_ids_all=type_ids_all,
             vocab_size=self.cfg.vocab_size,
             batch_multiple=self._batch_multiple,
+            packed=self.packed,
+            max_tokens=self.max_tokens,
         )
 
     def __call__(self, query: str, doc: str) -> float:

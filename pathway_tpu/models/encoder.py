@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Any, Sequence
 
 import jax
@@ -26,7 +27,15 @@ from flax import linen as nn
 
 from .tokenizer import HashTokenizer, load_tokenizer
 
-__all__ = ["EncoderConfig", "TransformerEncoder", "SentenceEncoder"]
+__all__ = [
+    "EncoderConfig",
+    "TransformerEncoder",
+    "SentenceEncoder",
+    "packed_plan",
+    "packed_prepare",
+    "packed_dispatch_enabled",
+    "embed_max_tokens",
+]
 
 SEQ_BUCKETS = (32, 64, 128, 256, 512)
 # large top buckets matter: the chip may sit behind a network tunnel where
@@ -215,16 +224,193 @@ def dispatch_dtype(vocab_size: int):
     return np.uint16 if vocab_size <= 1 << 16 else np.int32
 
 
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "off", "no", "")
+
+
+def packed_dispatch_enabled() -> bool:
+    """Per-seq-bucket packed dispatch is the default; legacy whole-batch
+    padding stays reachable for A/B runs (``PATHWAY_PACKED_DISPATCH=0``)."""
+    return _env_flag("PATHWAY_PACKED_DISPATCH", True)
+
+
+def embed_max_tokens() -> int | None:
+    """Process-default token budget per device dispatch
+    (``PATHWAY_EMBED_MAX_TOKENS``, unset = batch-bucket sizing only)."""
+    raw = os.environ.get("PATHWAY_EMBED_MAX_TOKENS", "").strip()
+    if not raw:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n > 0 else None
+
+
+def _chunk_sizes(
+    n: int, seq: int, batch_multiple: int, max_tokens: int | None
+) -> list[int]:
+    """Batch-bucket decomposition of an ``n``-row group at seq bucket
+    ``seq``: exact-fill with the largest admissible bucket while at least
+    32 rows remain (a 300-row group becomes 256+32+pad instead of one
+    512-padded launch), then one padded launch for the small tail (the
+    1/2/4/8 buckets exist precisely to keep tiny groups cheap).  A token
+    budget caps the bucket at ``max_tokens // seq`` so batch size adapts
+    to document length."""
+    allowed = list(BATCH_BUCKETS)
+    if max_tokens is not None:
+        cap = max(max_tokens // max(seq, 1), 1)
+        capped = [b for b in allowed if b <= cap]
+        allowed = capped or allowed[:1]
+    out: list[int] = []
+    remaining = n
+    while remaining >= 32 and allowed[-1] >= 32:
+        bb = max(b for b in allowed if b <= remaining) if remaining >= allowed[0] else allowed[0]
+        if bb < 32:
+            break
+        out.append(bb)
+        remaining -= bb
+    while remaining > 0:
+        bb = _bucket(remaining, allowed)
+        out.append(bb)
+        remaining -= min(bb, remaining)
+    if batch_multiple > 1:
+        out = [
+            bb + (batch_multiple - bb % batch_multiple) % batch_multiple
+            for bb in out
+        ]
+    return out
+
+
+def packed_plan(
+    lengths,
+    max_length: int,
+    batch_multiple: int = 1,
+    max_tokens: int | None = None,
+) -> list[tuple[int, int, np.ndarray]]:
+    """Packing plan for per-row token counts: rows grouped by their OWN
+    seq bucket (not the batch max), each group chunked to batch buckets.
+    Returns ``(seq, bb, row_indices)`` triples; row order inside a group
+    preserves submission order so results re-zip deterministically."""
+    lengths = np.asarray(lengths)
+    groups: dict[int, list[int]] = {}
+    for i, ln in enumerate(lengths):
+        seq = min(_bucket(max(int(ln), 1), SEQ_BUCKETS), max_length)
+        groups.setdefault(seq, []).append(i)
+    plan: list[tuple[int, int, np.ndarray]] = []
+    for seq in sorted(groups):
+        rows = np.asarray(groups[seq], dtype=np.int64)
+        start = 0
+        for bb in _chunk_sizes(len(rows), seq, batch_multiple, max_tokens):
+            take = min(bb, len(rows) - start)
+            plan.append((seq, bb, rows[start : start + take]))
+            start += take
+            if start >= len(rows):
+                break
+    return plan
+
+
+def packed_prepare(
+    ids_all,
+    mask_all,
+    max_length: int,
+    type_ids_all=None,
+    vocab_size: int = 1 << 31,
+    batch_multiple: int = 1,
+    max_tokens: int | None = None,
+) -> tuple[list[tuple], dict]:
+    """Host half of the packed dispatch: tokenized rows → padded
+    ``(ids, mask, tids, rows)`` chunks ready for device transfer, plus
+    padding-efficiency stats.  Split out so a pipeline worker can run it
+    one batch ahead of the device (tokenize/pack(N+1) overlaps encode(N))."""
+    lengths = np.asarray(mask_all.sum(axis=1), dtype=np.int64)
+    ids_dtype = dispatch_dtype(vocab_size)
+    prepared: list[tuple] = []
+    padded_tokens = 0
+    for seq, bb, rows in packed_plan(
+        lengths, max_length, batch_multiple, max_tokens
+    ):
+        ids, mask, tids = pad_chunk(
+            ids_all[rows][:, :seq],
+            mask_all[rows][:, :seq],
+            bb,
+            seq,
+            type_ids=None if type_ids_all is None else type_ids_all[rows][:, :seq],
+            ids_dtype=ids_dtype,
+        )
+        prepared.append((ids, mask, tids, rows))
+        padded_tokens += bb * seq
+    stats = {
+        "rows": int(len(lengths)),
+        "real_tokens": int(lengths.sum()),
+        "padded_tokens": int(padded_tokens),
+    }
+    return prepared, stats
+
+
+def _dispatch_prepared(apply_fn, prepared) -> list[tuple[Any, np.ndarray]]:
+    """Device half: launch every prepared chunk (JAX async dispatch queues
+    them back-to-back) and return ``(device_result, rows)`` pairs WITHOUT
+    syncing — the caller decides host collection vs device-resident use."""
+    pending = []
+    for ids, mask, tids, rows in prepared:
+        args = [jnp.asarray(ids), jnp.asarray(mask)]
+        if tids is not None:
+            args.append(jnp.asarray(tids))
+        pending.append((apply_fn(*args), rows))
+    return pending
+
+
 def bucketed_dispatch(
     apply_fn, ids_all, mask_all, max_length: int, type_ids_all=None,
     vocab_size: int = 1 << 31, batch_multiple: int = 1,
+    packed: bool | None = None, max_tokens: int | None = None,
 ) -> np.ndarray:
     """Pad (batch, seq) to buckets and dispatch chunks through a jitted
     ``apply_fn(ids, mask[, type_ids])`` — one compilation per
     (batch_bucket, seq_bucket).  Shared by SentenceEncoder and CrossEncoder.
     ``batch_multiple`` rounds the batch bucket up so the batch dimension
-    divides evenly over a data-parallel mesh axis."""
+    divides evenly over a data-parallel mesh axis.
+
+    ``packed`` (default: :func:`packed_dispatch_enabled`) selects per-row
+    seq bucketing: rows are grouped by their OWN seq bucket and each group
+    dispatched at its bucket shape, so one 256-token chunk no longer
+    inflates a batch of 64-token chunks ~4x in FLOPs.  Both per-bucket
+    shapes come from the same (BATCH_BUCKETS x SEQ_BUCKETS) grid the
+    legacy path compiles, so the compiled-executable set — and
+    ``pathway_xla_compile_total`` — stays flat across mixed-length
+    corpora.  ``max_tokens`` caps ``batch_bucket * seq_bucket`` per
+    launch (token-budget batching, ``PATHWAY_EMBED_MAX_TOKENS``)."""
+    from ..internals.flight_recorder import record_padding
+
+    if packed is None:
+        packed = packed_dispatch_enabled()
+    if packed:
+        prepared, stats = packed_prepare(
+            ids_all, mask_all, max_length,
+            type_ids_all=type_ids_all, vocab_size=vocab_size,
+            batch_multiple=batch_multiple, max_tokens=max_tokens,
+        )
+        record_padding(stats["real_tokens"], stats["padded_tokens"])
+        pending = _dispatch_prepared(apply_fn, prepared)
+        out: np.ndarray | None = None
+        n = ids_all.shape[0]
+        for res, rows in pending:
+            res = np.asarray(res, dtype=np.float32)
+            if out is None:
+                out = np.empty((n,) + res.shape[1:], dtype=np.float32)
+            out[rows] = res[: len(rows)]
+        assert out is not None
+        return out
+
+    # legacy whole-batch path: ONE seq bucket for the whole batch, sized
+    # by its single longest row — kept for A/B measurement and parity
+    # tests (PATHWAY_PACKED_DISPATCH=0 / packed=False)
     longest = int(mask_all.sum(axis=1).max())
+    real_tokens = int(mask_all.sum())
     seq = min(_bucket(longest, SEQ_BUCKETS), max_length)
     ids_all, mask_all = ids_all[:, :seq], mask_all[:, :seq]
     if type_ids_all is not None:
@@ -248,6 +434,7 @@ def bucketed_dispatch(
     ids_dtype = dispatch_dtype(vocab_size)
     pending = []
     start = 0
+    padded_tokens = 0
     while start < b:
         chunk = min(bb, b - start)
         ids, mask, tids = pad_chunk(
@@ -264,7 +451,9 @@ def bucketed_dispatch(
         if tids is not None:
             args.append(jnp.asarray(tids))
         pending.append((apply_fn(*args), chunk))
+        padded_tokens += bb * seq
         start += chunk
+    record_padding(real_tokens, padded_tokens)
     outs = [
         np.asarray(res, dtype=np.float32)[:chunk] for res, chunk in pending
     ]
@@ -287,7 +476,13 @@ class SentenceEncoder:
         max_length: int = 256,
         mesh=None,
         extend_positions: int | None = None,
+        max_tokens: int | None = None,
+        packed: bool | None = None,
     ):
+        #: token budget per device launch (None = PATHWAY_EMBED_MAX_TOKENS)
+        self.max_tokens = max_tokens if max_tokens is not None else embed_max_tokens()
+        #: per-seq-bucket packed dispatch (None = PATHWAY_PACKED_DISPATCH)
+        self.packed = packed
         self.pretrained = False
         params = None
         if model_name is not None:
@@ -416,6 +611,8 @@ class SentenceEncoder:
             self.max_length,
             vocab_size=self.cfg.vocab_size,
             batch_multiple=self._batch_multiple,
+            packed=self.packed,
+            max_tokens=self.max_tokens,
         )
 
     def _encode_ring(self, ids_all, mask_all) -> np.ndarray:

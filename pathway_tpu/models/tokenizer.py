@@ -15,6 +15,10 @@ produce identical ids bit-for-bit.
 
 from __future__ import annotations
 
+import itertools
+import os
+import threading
+from collections import OrderedDict
 from typing import Sequence
 
 import numpy as np
@@ -24,7 +28,82 @@ try:  # hot-path C++ batch encoder
 except Exception:  # pragma: no cover - fallback always works
     _native = None
 
-__all__ = ["HashTokenizer", "load_tokenizer"]
+__all__ = ["HashTokenizer", "load_tokenizer", "token_cache", "TokenCache"]
+
+
+class TokenCache:
+    """LRU memoization of per-text token rows.
+
+    Dedup-heavy live streams (connector re-reads, repeated queries,
+    unchanged chunks across document re-splits) re-tokenize identical
+    text every update; caching the UNPADDED id row makes a repeat hit one
+    dict lookup instead of a wordpiece/hash pass.  Rows are stored
+    trimmed, so one entry serves every ``max_length`` that doesn't
+    truncate differently — the key includes ``max_length`` to stay
+    conservative.  Hit/miss totals feed ``/status``
+    (``pathway_tokenizer_cache_hits_total`` / ``_misses_total``)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._map: OrderedDict = OrderedDict()
+
+    def get_many(self, keys: list) -> list:
+        """Cached values (None for misses), LRU order refreshed; counts
+        one hit/miss per key into the flight-recorder accumulators."""
+        hits = 0
+        out = []
+        with self._lock:
+            for key in keys:
+                row = self._map.get(key)
+                if row is not None:
+                    self._map.move_to_end(key)
+                    hits += 1
+                out.append(row)
+        from ..internals.flight_recorder import record_tokenizer_cache
+
+        record_tokenizer_cache(hits=hits, misses=len(keys) - hits)
+        return out
+
+    def put_many(self, items: list) -> None:
+        with self._lock:
+            for key, row in items:
+                self._map[key] = row
+                self._map.move_to_end(key)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+_cache_lock = threading.Lock()
+_cache: TokenCache | None = None
+
+
+def token_cache() -> TokenCache | None:
+    """Process-global tokenizer cache (``PATHWAY_TOKENIZER_CACHE`` rows,
+    default 4096; 0 disables)."""
+    global _cache
+    if _cache is None:
+        with _cache_lock:
+            if _cache is None:
+                try:
+                    capacity = int(
+                        os.environ.get("PATHWAY_TOKENIZER_CACHE", "4096")
+                    )
+                except ValueError:
+                    capacity = 4096
+                _cache = TokenCache(max(capacity, 0))
+    return _cache if _cache.capacity > 0 else None
+
+
+def reset_token_cache() -> None:
+    """Test isolation hook (re-reads the env capacity)."""
+    global _cache
+    with _cache_lock:
+        _cache = None
 
 _FNV_OFFSET = 1469598103934665603
 _FNV_PRIME = 1099511628211
@@ -83,16 +162,12 @@ class HashTokenizer:
             out.append(self.N_SPECIAL + h % mod)
         return out
 
-    def encode_batch(
+    def _encode_batch_raw(
         self,
         texts: Sequence[str],
-        max_length: int = 256,
-        pair: Sequence[str] | None = None,
-        return_type_ids: bool = False,
-    ) -> tuple[np.ndarray, ...]:
-        """Returns (ids[B,L], mask[B,L]) padded to ``max_length``; with
-        ``return_type_ids`` also the BERT segment ids (0 for
-        ``[CLS] A [SEP]``, 1 for ``B [SEP]``)."""
+        max_length: int,
+        pair: Sequence[str] | None,
+    ) -> tuple[np.ndarray, np.ndarray]:
         if _native is not None:
             batch, mask = _native.tokenize_batch(
                 [t.encode("utf-8") for t in texts],
@@ -115,6 +190,61 @@ class HashTokenizer:
             for i, ids in enumerate(ids_list):
                 batch[i, : len(ids)] = ids
                 mask[i, : len(ids)] = 1
+        return batch, mask
+
+    def encode_batch(
+        self,
+        texts: Sequence[str],
+        max_length: int = 256,
+        pair: Sequence[str] | None = None,
+        return_type_ids: bool = False,
+    ) -> tuple[np.ndarray, ...]:
+        """Returns (ids[B,L], mask[B,L]) padded to ``max_length``; with
+        ``return_type_ids`` also the BERT segment ids (0 for
+        ``[CLS] A [SEP]``, 1 for ``B [SEP]``).  Rows memoize through the
+        process-global :func:`token_cache` — only cache misses pay the
+        tokenize pass; the padded batch is assembled from trimmed rows
+        either way, bit-identical to the uncached path (ids are a
+        contiguous non-zero prefix, so the mask is derivable)."""
+        cache = token_cache()
+        if cache is None:
+            batch, mask = self._encode_batch_raw(texts, max_length, pair)
+        else:
+            keys = [
+                (
+                    "hash", self.vocab_size, self.lowercase, max_length,
+                    t, None if pair is None else pair[i],
+                )
+                for i, t in enumerate(texts)
+            ]
+            rows = cache.get_many(keys)
+            miss = [i for i, r in enumerate(rows) if r is None]
+            if len(miss) == len(texts):
+                # all-miss (cold ingest of unique docs): keep the raw
+                # padded arrays as-is — populate the cache, skip the
+                # per-row reassembly entirely
+                batch, mask = self._encode_batch_raw(texts, max_length, pair)
+                cache.put_many(
+                    [
+                        (keys[i], batch[i, : int(mask[i].sum())].copy())
+                        for i in range(len(texts))
+                    ]
+                )
+            else:
+                if miss:
+                    raw_ids, raw_mask = self._encode_batch_raw(
+                        [texts[i] for i in miss],
+                        max_length,
+                        None if pair is None else [pair[i] for i in miss],
+                    )
+                    for j, i in enumerate(miss):
+                        rows[i] = raw_ids[j, : int(raw_mask[j].sum())].copy()
+                    cache.put_many([(keys[i], rows[i]) for i in miss])
+                batch = np.zeros((len(texts), max_length), dtype=np.int32)
+                mask = np.zeros((len(texts), max_length), dtype=np.int32)
+                for i, row in enumerate(rows):
+                    batch[i, : len(row)] = row
+                    mask[i, : len(row)] = 1
         if not return_type_ids:
             return batch, mask
         if pair is None:
@@ -127,12 +257,20 @@ class HashTokenizer:
         return batch, mask, type_ids
 
 
+_hf_wrapper_ids = itertools.count()
+
+
 class _HFTokenizerWrapper:
     def __init__(self, tok):
         self.tok = tok
         self.vocab_size = tok.vocab_size
+        # cache identity: the checkpoint name when there is one, else a
+        # process-unique token — NEVER id(tok), whose address can be
+        # recycled by a later tokenizer and alias its cached rows
+        name = getattr(tok, "name_or_path", None)
+        self._cache_name = name if name else f"anon#{next(_hf_wrapper_ids)}"
 
-    def encode_batch(self, texts, max_length=256, pair=None, return_type_ids=False):
+    def _encode_batch_raw(self, texts, max_length, pair):
         enc = self.tok(
             list(texts),
             list(pair) if pair is not None else None,
@@ -143,12 +281,60 @@ class _HFTokenizerWrapper:
         )
         ids = enc["input_ids"].astype(np.int32)
         mask = enc["attention_mask"].astype(np.int32)
-        if not return_type_ids:
-            return ids, mask
         type_ids = enc.get("token_type_ids")
         type_ids = (
             type_ids.astype(np.int32) if type_ids is not None else np.zeros_like(ids)
         )
+        return ids, mask, type_ids
+
+    def encode_batch(self, texts, max_length=256, pair=None, return_type_ids=False):
+        cache = token_cache()
+        # left-padding tokenizers (some generation models) break the
+        # trimmed-prefix row representation — bypass the cache for them
+        if cache is None or getattr(self.tok, "padding_side", "right") != "right":
+            ids, mask, type_ids = self._encode_batch_raw(texts, max_length, pair)
+        else:
+            keys = [
+                (
+                    "hf", self._cache_name, max_length,
+                    t, None if pair is None else pair[i],
+                )
+                for i, t in enumerate(texts)
+            ]
+            rows = cache.get_many(keys)
+            miss = [i for i, r in enumerate(rows) if r is None]
+            if len(miss) == len(texts):
+                # all-miss fast path: return the raw padded arrays as-is
+                ids, mask, type_ids = self._encode_batch_raw(
+                    texts, max_length, pair
+                )
+                items = []
+                for i in range(len(texts)):
+                    n = int(mask[i].sum())
+                    items.append(
+                        (keys[i], (ids[i, :n].copy(), type_ids[i, :n].copy()))
+                    )
+                cache.put_many(items)
+            else:
+                if miss:
+                    raw_ids, raw_mask, raw_tids = self._encode_batch_raw(
+                        [texts[i] for i in miss],
+                        max_length,
+                        None if pair is None else [pair[i] for i in miss],
+                    )
+                    for j, i in enumerate(miss):
+                        n = int(raw_mask[j].sum())
+                        rows[i] = (raw_ids[j, :n].copy(), raw_tids[j, :n].copy())
+                    cache.put_many([(keys[i], rows[i]) for i in miss])
+                ids = np.zeros((len(texts), max_length), dtype=np.int32)
+                mask = np.zeros((len(texts), max_length), dtype=np.int32)
+                type_ids = np.zeros((len(texts), max_length), dtype=np.int32)
+                for i, (row, trow) in enumerate(rows):
+                    ids[i, : len(row)] = row
+                    mask[i, : len(row)] = 1
+                    type_ids[i, : len(trow)] = trow
+        if not return_type_ids:
+            return ids, mask
         return ids, mask, type_ids
 
     # unpadded id codec (decoder generation path — GPT-2-family

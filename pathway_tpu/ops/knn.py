@@ -19,6 +19,7 @@ The multi-device sharded variant lives in ``pathway_tpu/parallel/index.py``.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Any, Hashable, Sequence
 
@@ -63,6 +64,10 @@ class DeviceKnnIndex:
         # staged updates applied lazily before the next search
         self._staged_set: dict[int, np.ndarray] = {}
         self._staged_valid: dict[int, bool] = {}
+        # device-resident staged batches: (slots[-1 = pad row], device
+        # array [bb, dim]) applied FIFO before the host dict — keeps
+        # last-write-wins semantics when the same slot is touched by both
+        self._staged_device: list[tuple[np.ndarray, Any]] = []
         # the engine serializes index ops, but REST/serving threads may
         # query while another thread ingests — a coarse reentrant lock
         # keeps every public op a coherent snapshot (cost is ~100ns,
@@ -114,6 +119,62 @@ class DeviceKnnIndex:
             self.key_of_slot[slot] = key
         self._staged_set[slot] = vec
         self._staged_valid[slot] = True
+
+    #: subclasses whose matrices carry a sharding (parallel/index.py)
+    #: fall back to host staging — the padded scatter below would drop
+    #: the placement the sharded scatter fns preserve
+    _device_stage_ok = True
+
+    def upsert_batch(self, keys: Sequence[Hashable], vectors) -> None:
+        """Stage a whole batch of vectors under one lock acquisition.
+
+        ``vectors`` is ``[n, dim]`` — a host array (staged row-by-row like
+        :meth:`upsert`), or a DEVICE array straight off the encoder
+        (``n >= len(keys)``; rows past ``len(keys)`` are dispatch pad rows).
+        Device batches never round-trip to host: they are kept as-is and
+        scattered into the HBM matrix by ``_apply_staged`` in one fused
+        normalize+scatter, with pad rows dropped via an out-of-bounds
+        index (XLA scatter ``mode="drop"``).  This is the ingest-plane
+        embed→upsert fast path — the D2H copy of the embedding and the
+        H2D re-stage of the same bytes both disappear."""
+        with self._lock:
+            if isinstance(vectors, np.ndarray) or not self._device_stage_ok:
+                vecs = np.asarray(vectors, dtype=np.float32)
+                for j, key in enumerate(keys):
+                    self._upsert_locked(key, vecs[j])
+                return
+            if vectors.ndim != 2 or vectors.shape[1] != self.dim:
+                raise ValueError(
+                    f"vector batch shape {vectors.shape} != [n, {self.dim}]"
+                )
+            if vectors.shape[0] < len(keys):
+                raise ValueError(
+                    f"{len(keys)} keys for {vectors.shape[0]} vector rows"
+                )
+            slots = np.full((vectors.shape[0],), -1, dtype=np.int64)
+            row_of_slot: dict[int, int] = {}
+            for j, key in enumerate(keys):
+                slot = self.slot_of_key.get(key)
+                if slot is None:
+                    if not self.free:
+                        self._grow()
+                    slot = self.free.pop()
+                    self.slot_of_key[key] = slot
+                    self.key_of_slot[slot] = key
+                # this device value supersedes any host value staged
+                # earlier for the slot (FIFO batches apply before the dict)
+                self._staged_set.pop(slot, None)
+                self._staged_valid[slot] = True
+                # a repeated key within ONE batch would put the same index
+                # into the scatter twice — XLA applies duplicate updates in
+                # undefined order, so drop the earlier row (last wins, like
+                # the host path)
+                prev = row_of_slot.get(slot)
+                if prev is not None:
+                    slots[prev] = -1
+                row_of_slot[slot] = j
+                slots[j] = slot
+            self._staged_device.append((slots, vectors))
 
     def remove(self, key: Hashable) -> None:
         with self._lock:
@@ -178,9 +239,28 @@ class DeviceKnnIndex:
         self._place()
 
     def _apply_staged(self) -> None:
-        if not self._staged_set and not self._staged_valid:
+        if (
+            not self._staged_set
+            and not self._staged_valid
+            and not self._staged_device
+        ):
             self._maybe_compact()
             return
+        # device batches FIRST (FIFO), host dict after: a host upsert that
+        # landed later than a device batch for the same slot wins, and
+        # upsert_batch already evicts older host entries for its slots
+        for slots, vals in self._staged_device:
+            # pad rows (slot -1) scatter out of bounds and are dropped on
+            # device; resolve the OOB index at apply time — capacity may
+            # have grown since staging
+            idx = np.where(slots >= 0, slots, self.capacity).astype(np.int32)
+            self.vectors = _scatter_rows_dropping(
+                self.vectors,
+                jnp.asarray(idx),
+                vals,
+                normalize=(self.metric == "cos"),
+            )
+        self._staged_device.clear()
         if self._staged_set:
             idx = np.fromiter(self._staged_set.keys(), dtype=np.int32)
             vals = np.stack(list(self._staged_set.values())).astype(self.dtype)
@@ -391,6 +471,21 @@ def _scatter_rows(matrix: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Arr
     return matrix.at[idx].set(vals)
 
 
+@functools.partial(jax.jit, static_argnames=("normalize",))
+def _scatter_rows_dropping(
+    matrix: jax.Array, idx: jax.Array, vals: jax.Array, normalize: bool
+) -> jax.Array:
+    """Device-resident embed→upsert scatter: rows whose index is out of
+    bounds (dispatch pad rows) are dropped by XLA, cos rows are
+    L2-normalized on device (f32 accumulation) — one fused kernel instead
+    of a D2H copy, host normalize, and H2D re-stage."""
+    v = vals.astype(jnp.float32)
+    if normalize:
+        norm = jnp.linalg.norm(v, axis=1, keepdims=True)
+        v = v / jnp.maximum(norm, 1e-30)
+    return matrix.at[idx].set(v.astype(matrix.dtype), mode="drop")
+
+
 @jax.jit
 def _scatter_mask(mask: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Array:
     return mask.at[idx].set(vals)
@@ -403,3 +498,8 @@ from ..internals.flight_recorder import instrument_jit as _instrument_jit
 
 _scatter_rows = _instrument_jit(_scatter_rows, "knn.scatter_rows")
 _scatter_mask = _instrument_jit(_scatter_mask, "knn.scatter_mask")
+# device-batch shapes come from the dispatch bucket grid, so this site is
+# bounded by (#batch_buckets x capacity growths), like the others
+_scatter_rows_dropping = _instrument_jit(
+    _scatter_rows_dropping, "knn.scatter_rows_padded"
+)

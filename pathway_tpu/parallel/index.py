@@ -79,6 +79,10 @@ class ShardedKnnIndex(DeviceKnnIndex):
     replicated over other mesh axes.
     """
 
+    #: device-batch staging would scatter through an unsharded jit and
+    #: drop the mesh placement — sharded indexes stage host-side
+    _device_stage_ok = False
+
     def __init__(
         self,
         dim: int,

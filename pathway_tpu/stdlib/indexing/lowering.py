@@ -86,7 +86,13 @@ class ExternalIndexNode(Node):
     def flush(self, time: int) -> list[Entry]:
         out: list[Entry] = []
         index_changed = False
-        # 1. apply index updates (updates-before-queries)
+        # 1. apply index updates (updates-before-queries).  Within one
+        # timestamp each key's FINAL entry decides its state (add is
+        # upsert, remove of an absent key is a no-op), so adds collapse
+        # into one batched call — a single staged device scatter per
+        # flush instead of one per document
+        last: dict[Any, tuple | None] = {}
+        payloads: dict[Any, tuple] = {}
         for key, row, diff in self.take(0):
             index_changed = True
             ctx = (key, row)
@@ -108,11 +114,30 @@ class ExternalIndexNode(Node):
                     )
                 continue
             if diff > 0:
-                self.index.add(key, data, meta)
-                self.doc_payload[key] = self.doc_payload_fn(ctx)
+                last[key] = (data, meta)
+                payloads[key] = self.doc_payload_fn(ctx)
             else:
+                last[key] = None
+        add_keys = [k for k, v in last.items() if v is not None]
+        for key, action in last.items():
+            if action is None:
                 self.index.remove(key)
                 self.doc_payload.pop(key, None)
+        if add_keys:
+            if hasattr(self.index, "add_batch"):
+                self.index.add_batch(
+                    add_keys,
+                    [last[k][0] for k in add_keys],
+                    [last[k][1] for k in add_keys],
+                )
+            else:  # duck-typed custom index without the batched protocol
+                for key in add_keys:
+                    self.index.add(key, last[key][0], last[key][1])
+            for key in add_keys:
+                self.doc_payload[key] = payloads[key]
+            from ...internals.flight_recorder import record_ingest_docs
+
+            record_ingest_docs(len(add_keys))
         if index_changed:
             # freshness watermark: the updates of engine timestamp `time`
             # are queryable from here on (updates-before-queries), closing

@@ -58,6 +58,12 @@ class InnerIndexImpl:
     def add(self, key: Hashable, data: Any, metadata: Any) -> None:
         raise NotImplementedError
 
+    def add_batch(self, keys, datas, metadatas) -> None:
+        """One flush's worth of adds; implementations that can stage a
+        whole batch (one device scatter instead of N) override this."""
+        for key, data, meta in zip(keys, datas, metadatas):
+            self.add(key, data, meta)
+
     def remove(self, key: Hashable) -> None:
         raise NotImplementedError
 
@@ -133,6 +139,25 @@ class BruteForceKnnIndex(_FilteredMixin, InnerIndexImpl):
     def add(self, key, data, metadata) -> None:
         self.index.upsert(key, np.asarray(data, dtype=np.float32))
         self._store_meta(key, metadata)
+
+    def add_batch(self, keys, datas, metadatas) -> None:
+        """Batched add: one staged scatter for the whole flush.  A DEVICE
+        array batch (the ingest pipeline's encoder output, rows beyond
+        ``len(keys)`` being dispatch pads) is handed to the index without
+        a host round trip (``DeviceKnnIndex.upsert_batch``)."""
+        if hasattr(datas, "shape") and not isinstance(datas, np.ndarray):
+            self.index.upsert_batch(list(keys), datas)  # device batch
+        else:
+            vecs = (
+                datas.astype(np.float32, copy=False)
+                if isinstance(datas, np.ndarray)
+                else np.stack(
+                    [np.asarray(d, dtype=np.float32).reshape(-1) for d in datas]
+                )
+            )
+            self.index.upsert_batch(list(keys), vecs)
+        for key, meta in zip(keys, metadatas):
+            self._store_meta(key, meta)
 
     def remove(self, key) -> None:
         self.index.remove(key)

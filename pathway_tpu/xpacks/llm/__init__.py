@@ -44,10 +44,16 @@ __all__ = [
     "DocTransformer",
     "DocTransformerCallable",
     "servers",
+    "IngestPipeline",
 ]
 
 
 def __getattr__(name: str):
+    if name == "IngestPipeline":
+        from ._ingest import IngestPipeline
+
+        globals()[name] = IngestPipeline
+        return IngestPipeline
     # heavier modules (servers pull in aiohttp) load lazily
     if name in ("vector_store", "document_store", "question_answering", "servers"):
         import importlib

@@ -1,0 +1,292 @@
+"""Packed, pipelined ingest: tokenize → pack → encode → upsert.
+
+The ingest plane (connector → splitter → embedder → index upsert) is
+where the live-RAG loop's freshness budget goes.  This module rebuilds
+its embedding hot path as a producer/consumer pipeline:
+
+* a **host worker** tokenizes and packs (``models/encoder.pad_chunk``
+  via :func:`~pathway_tpu.models.encoder.packed_prepare`) one batch
+  AHEAD of the device — the double-buffered hand-off queue (depth
+  ``PATHWAY_INGEST_PIPELINE_DEPTH``, default 2) means tokenize(N+1)
+  overlaps encode(N) instead of serializing on the embedder thread (the
+  WindVE queue-decoupling argument, arXiv:2504.14941, applied to
+  ingest);
+* the **device worker** transfers, encodes, and — when an index is
+  attached — hands the encoder's DEVICE output straight to the staged
+  scatter (``DeviceKnnIndex.upsert_batch``): the per-micro-batch
+  D2H(embeddings)+H2D(same bytes) round trip disappears, only keys and
+  metadata stay host-side.
+
+Every stage records flight-recorder spans (``tokenize`` / ``h2d`` /
+``encode`` / ``upsert``, category ``ingest``) and documents count into
+``pathway_ingest_docs_total``; packing efficiency feeds
+``pathway_embed_padding_efficiency``.  Under ``PATHWAY_FAULTS`` chaos
+the device stage honors the ``embedder`` site: an injected failure
+fails THAT batch's future and the pipeline keeps draining.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Sequence
+
+import numpy as np
+
+__all__ = ["IngestPipeline", "ingest_pipeline_depth"]
+
+_SENTINEL = object()
+
+
+def ingest_pipeline_depth() -> int:
+    """Prepared-batch hand-off depth (``PATHWAY_INGEST_PIPELINE_DEPTH``,
+    default 2 = double-buffered: host stays exactly one batch ahead)."""
+    try:
+        depth = int(os.environ.get("PATHWAY_INGEST_PIPELINE_DEPTH", "2"))
+    except ValueError:
+        depth = 2
+    return max(depth, 1)
+
+
+class _Batch:
+    __slots__ = ("texts", "keys", "metas", "future", "prepared", "stats")
+
+    def __init__(self, texts, keys, metas, future):
+        self.texts = texts
+        self.keys = keys
+        self.metas = metas
+        self.future = future
+        self.prepared = None
+        self.stats = None
+
+
+class IngestPipeline:
+    """Two-stage tokenize/pack → encode/upsert pipeline over a
+    :class:`~pathway_tpu.models.encoder.SentenceEncoder`.
+
+    ``index`` (optional) is an inner index with ``add_batch`` (e.g.
+    :class:`~pathway_tpu.stdlib.indexing.retrievers.BruteForceKnnIndex`)
+    or a bare :class:`~pathway_tpu.ops.knn.DeviceKnnIndex`; with one
+    attached, futures resolve to the number of documents upserted and
+    embeddings never leave the device.  Without one, futures resolve to
+    the ``[B, dim]`` float32 embeddings in submission order.
+    """
+
+    def __init__(
+        self,
+        encoder: Any,
+        index: Any = None,
+        *,
+        depth: int | None = None,
+        max_tokens: int | None = None,
+    ):
+        from ...models.encoder import embed_max_tokens
+
+        self.encoder = encoder
+        self.index = index
+        self.depth = depth if depth is not None else ingest_pipeline_depth()
+        self.max_tokens = (
+            max_tokens if max_tokens is not None else embed_max_tokens()
+        )
+        self._in: queue.Queue = queue.Queue()
+        # the hand-off: host worker blocks here once it is `depth`
+        # batches ahead — bounded lookahead IS the backpressure
+        self._ready: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._closed = False
+        self._lock = threading.Lock()
+        self._tok_thread: threading.Thread | None = None
+        self._dev_thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------
+    def _ensure_threads_locked(self) -> None:
+        if self._tok_thread is None:
+            self._tok_thread = threading.Thread(
+                target=self._tokenize_loop, daemon=True,
+                name="pw-ingest-tokenize",
+            )
+            self._dev_thread = threading.Thread(
+                target=self._device_loop, daemon=True,
+                name="pw-ingest-device",
+            )
+            self._tok_thread.start()
+            self._dev_thread.start()
+
+    def close(self) -> None:
+        """Drain both stages and join the workers (idempotent)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            started = self._tok_thread is not None
+        if started:
+            self._in.put(_SENTINEL)
+            self._tok_thread.join()
+            self._dev_thread.join()
+
+    def __enter__(self) -> "IngestPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- submission ------------------------------------------------------
+    def submit(
+        self,
+        texts: Sequence[str],
+        keys: Sequence[Any] | None = None,
+        metas: Sequence[Any] | None = None,
+    ) -> Future:
+        """Enqueue one document batch.  With an index attached ``keys``
+        is required (metadata optional); the future resolves once the
+        batch is encoded and staged into the index."""
+        if self.index is not None and keys is None:
+            raise ValueError("keys are required when upserting into an index")
+        if keys is not None and len(keys) != len(texts):
+            raise ValueError(f"{len(keys)} keys for {len(texts)} texts")
+        fut: Future = Future()
+        if not texts:
+            fut.set_result(
+                0 if self.index is not None else np.zeros(
+                    (0, self.encoder.dim), dtype=np.float32
+                )
+            )
+            return fut
+        # closed-check and enqueue under the same lock close() flips the
+        # flag under — a batch can never slip in BEHIND the shutdown
+        # sentinel (its future would hang forever)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ingest pipeline is closed")
+            self._ensure_threads_locked()
+            self._in.put(_Batch(list(texts), keys, metas, fut))
+        return fut
+
+    def encode(self, texts: Sequence[str]) -> Any:
+        """Synchronous convenience: submit one batch and wait."""
+        return self.submit(texts).result()
+
+    # -- stage 1: host tokenize + pack ----------------------------------
+    def _tokenize_loop(self) -> None:
+        from ...internals.flight_recorder import record_span
+        from ...models.encoder import packed_prepare
+
+        enc = self.encoder
+        while True:
+            item = self._in.get()
+            if item is _SENTINEL:
+                self._ready.put(_SENTINEL)
+                return
+            wall = time.time()
+            t0 = time.monotonic()
+            try:
+                ids_all, mask_all = enc.tokenizer.encode_batch(
+                    item.texts, max_length=enc.max_length
+                )
+                record_span(
+                    "tokenize", "ingest", wall,
+                    (time.monotonic() - t0) * 1000.0,
+                    attrs={"docs": len(item.texts)},
+                )
+                item.prepared, item.stats = packed_prepare(
+                    ids_all, mask_all, enc.max_length,
+                    vocab_size=enc.cfg.vocab_size,
+                    batch_multiple=getattr(enc, "_batch_multiple", 1),
+                    max_tokens=self.max_tokens,
+                )
+            except BaseException as exc:  # noqa: BLE001 — fail THIS batch only
+                if not item.future.done():
+                    item.future.set_exception(exc)
+                continue
+            self._ready.put(item)  # blocks at `depth` batches ahead
+
+    # -- stage 2: device transfer + encode + upsert ---------------------
+    def _device_loop(self) -> None:
+        import jax.numpy as jnp
+
+        from ...internals.flight_recorder import (
+            record_ingest_docs,
+            record_padding,
+            record_span,
+        )
+
+        enc = self.encoder
+        while True:
+            item = self._ready.get()
+            if item is _SENTINEL:
+                return
+            try:
+                from ...testing import faults
+
+                if faults.enabled:
+                    # chaos site "embedder": a failed encode fails this
+                    # batch's future; the pipeline keeps draining
+                    faults.perturb("embedder")
+                record_padding(
+                    item.stats["real_tokens"], item.stats["padded_tokens"]
+                )
+                wall = time.time()
+                t0 = time.monotonic()
+                device_args = []
+                for ids, mask, tids, rows in item.prepared:
+                    args = [jnp.asarray(ids), jnp.asarray(mask)]
+                    if tids is not None:
+                        args.append(jnp.asarray(tids))
+                    if getattr(enc, "mesh", None) is not None:
+                        import jax
+
+                        args = [
+                            jax.device_put(a, enc._data_sharding) for a in args
+                        ]
+                    device_args.append((args, rows))
+                record_span(
+                    "h2d", "ingest", wall, (time.monotonic() - t0) * 1000.0,
+                    attrs={"chunks": len(device_args)},
+                )
+                wall = time.time()
+                t0 = time.monotonic()
+                outs = [
+                    (enc._apply(enc.params, *args), rows)
+                    for args, rows in device_args
+                ]
+                record_span(
+                    "encode", "ingest", wall,
+                    (time.monotonic() - t0) * 1000.0,
+                    attrs={"docs": len(item.texts)},
+                )
+                if self.index is not None:
+                    wall = time.time()
+                    t0 = time.monotonic()
+                    for out, rows in outs:
+                        keys = [item.keys[i] for i in rows]
+                        metas = (
+                            [item.metas[i] for i in rows]
+                            if item.metas is not None
+                            else [None] * len(rows)
+                        )
+                        if hasattr(self.index, "add_batch"):
+                            self.index.add_batch(keys, out, metas)
+                        else:
+                            self.index.upsert_batch(keys, out)
+                    record_span(
+                        "upsert", "ingest", wall,
+                        (time.monotonic() - t0) * 1000.0,
+                        attrs={"docs": len(item.texts)},
+                    )
+                    record_ingest_docs(len(item.texts))
+                    result: Any = len(item.texts)
+                else:
+                    emb = np.empty(
+                        (len(item.texts), self.encoder.dim), dtype=np.float32
+                    )
+                    for out, rows in outs:
+                        emb[rows] = np.asarray(out, dtype=np.float32)[: len(rows)]
+                    result = emb
+            except BaseException as exc:  # noqa: BLE001 — fail THIS batch only
+                if not item.future.done():
+                    item.future.set_exception(exc)
+                continue
+            if not item.future.done():
+                item.future.set_result(result)

@@ -91,6 +91,33 @@ class WorkGroup:
         self.max_batch = max_batch
 
 
+def _budget_chunks(group: "WorkGroup", items: list["_WorkItem"]) -> list[list["_WorkItem"]]:
+    """Split a tick's items into execute chunks: ``max_batch`` count cap
+    plus, when the group declares one (``AsyncMicroBatcher.max_tokens``),
+    a token-mass cap so a run of long documents dispatches in
+    length-adapted batches.  Every chunk carries at least one item."""
+    max_tokens = getattr(group, "max_tokens", None)
+    estimate = getattr(group, "token_estimate", None)
+    if max_tokens is None or estimate is None:
+        return [
+            items[start : start + group.max_batch]
+            for start in range(0, len(items), group.max_batch)
+        ]
+    chunks: list[list[_WorkItem]] = []
+    cur: list[_WorkItem] = []
+    cur_tokens = 0
+    for it in items:
+        t = estimate(it.payload)
+        if cur and (len(cur) >= group.max_batch or cur_tokens + t > max_tokens):
+            chunks.append(cur)
+            cur, cur_tokens = [], 0
+        cur.append(it)
+        cur_tokens += t
+    if cur:
+        chunks.append(cur)
+    return chunks
+
+
 class _WorkItem:
     __slots__ = (
         "group", "payload", "future", "enqueued_at", "deadline_at", "trace",
@@ -300,8 +327,8 @@ class ServingScheduler:
                         )
                 else:
                     live.append(it)
-            for start in range(0, len(live), group.max_batch):
-                self._execute(group, live[start : start + group.max_batch])
+            for chunk in _budget_chunks(group, live):
+                self._execute(group, chunk)
 
     def _execute(self, group: WorkGroup, chunk: list[_WorkItem]) -> None:
         if not chunk:

@@ -17,6 +17,7 @@ from typing import Any, Callable, Sequence
 
 __all__ = [
     "coerce_str",
+    "estimate_tokens",
     "AsyncMicroBatcher",
     "RestClientBase",
     "run_with_cache",
@@ -29,6 +30,15 @@ def coerce_str(value: Any) -> str:
     if isinstance(value, bytes):
         return value.decode("utf-8", errors="replace")
     return str(value)
+
+
+def estimate_tokens(item: Any) -> int:
+    """Cheap token-mass estimate for budget batching: whitespace words
+    + CLS/SEP for text (wordpiece splits only lengthen it, which errs on
+    the safe — smaller — batch side), 1 for opaque payloads (images)."""
+    if isinstance(item, (str, bytes)):
+        return len(coerce_str(item).split()) + 2
+    return 1
 
 
 def merge_filter_exprs(
@@ -215,15 +225,26 @@ class AsyncMicroBatcher:
         batch_fn: Callable[[list], Sequence],
         max_batch: int = 1024,
         use_scheduler: bool | None = None,
+        max_tokens: int | None = None,
+        token_estimate: Callable[[Any], int] | None = None,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max_batch
+        # token-budget admission: a flush fires once the PENDING batch's
+        # estimated token mass reaches ``max_tokens`` — batch size adapts
+        # to document length, so a run of long documents flushes small
+        # while a run of tweets still fills ``max_batch``.  The serving
+        # scheduler honors the same attributes when it chunk-drains this
+        # batcher as a WorkGroup.
+        self.max_tokens = max_tokens
+        self.token_estimate = token_estimate or estimate_tokens
         self.label = getattr(batch_fn, "__name__", "batch")
         self.use_scheduler = use_scheduler
         # device dispatch is serialized; the model call itself is not
         # thread-safe across loops
         self._dispatch_lock = threading.Lock()
         self._pending: dict[int, list[tuple[Any, asyncio.Future]]] = {}
+        self._pending_tokens: dict[int, int] = {}
 
     def _scheduler(self):
         from ._scheduler import get_scheduler, scheduler_enabled
@@ -243,7 +264,12 @@ class AsyncMicroBatcher:
         lst = self._pending.setdefault(lid, [])
         fut: asyncio.Future = loop.create_future()
         lst.append((item, fut))
-        if len(lst) >= self.max_batch:
+        over_tokens = False
+        if self.max_tokens is not None:
+            tokens = self._pending_tokens.get(lid, 0) + self.token_estimate(item)
+            self._pending_tokens[lid] = tokens
+            over_tokens = tokens >= self.max_tokens
+        if len(lst) >= self.max_batch or over_tokens:
             self._flush(lid)
         elif len(lst) == 1:
             # flush after the current scheduling round: every concurrent
@@ -256,6 +282,7 @@ class AsyncMicroBatcher:
         if not lst:
             return
         self._pending[lid] = []
+        self._pending_tokens[lid] = 0
         items = [it for it, _ in lst]
         try:
             with self._dispatch_lock:

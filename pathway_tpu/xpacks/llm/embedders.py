@@ -74,6 +74,7 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         device: str = "tpu",  # accepted for API parity; placement is XLA's
         encoder: Any = None,
         max_batch: int = 1024,
+        max_tokens: int | None = None,
         pipelined: bool = False,
         use_scheduler: bool | None = None,
         **init_kwargs,
@@ -84,6 +85,8 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         # use_scheduler: None follows the global serving-scheduler setting
         # (calls coalesce across engine steps and REST planes); False pins
         # the per-loop micro-batching
+        # max_tokens: token-budget admission (None = PATHWAY_EMBED_MAX_TOKENS)
+        # — batch size adapts to document length instead of a bare count cap
         super().__init__(
             executor=(
                 udfs.fully_async_executor() if pipelined else udfs.async_executor()
@@ -95,6 +98,11 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         self._encoder = encoder
         self._batcher: AsyncMicroBatcher | None = None
         self._max_batch = max_batch
+        if max_tokens is None:
+            from ...models.encoder import embed_max_tokens
+
+            max_tokens = embed_max_tokens()
+        self._max_tokens = max_tokens
         self._use_scheduler = use_scheduler
         self._init_kwargs = init_kwargs
 
@@ -112,6 +120,7 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             self._batcher = AsyncMicroBatcher(
                 batch_encode, max_batch=self._max_batch,
                 use_scheduler=self._use_scheduler,
+                max_tokens=self._max_tokens,
             )
         return self._encoder
 
