@@ -1,5 +1,6 @@
 """Serving soak: minutes of continuous churn + queries on the sharded
-mesh index, watching for correctness drift, latency creep, and leaks.
+mesh index, watching for correctness drift, latency creep, and leaks —
+plus a kill/restart crash-consistency mode (``--kill``).
 
 Drives the product stack exactly like a deployment: streaming fs ingest →
 ``VectorStoreServer(mesh=8-device CPU mesh)`` → REST queries, while a
@@ -19,6 +20,18 @@ restart and degraded-response counts alongside the usual metrics; the
 pass criterion becomes "survived the chaos and kept answering", not
 byte-exact final consistency (dropped reads are *supposed* to lose rows).
 Seed: ``SOAK_SEED`` (default 17) — a failing run replays exactly.
+
+``--kill`` runs the crash-consistency harness for the durable index
+recovery plane instead: a ``VectorStoreServer`` under
+``PersistenceMode.OPERATOR_PERSISTING`` is SIGKILLed at random points
+mid-ingest and restarted in a loop, then a final warm restart is
+asserted against a never-killed oracle run over the same corpus —
+restored ``/v1/retrieve`` results must be bit-identical, the restore
+must perform ZERO re-embeddings (encoder call counter flat before the
+probe queries), and ``/v1/health`` must report the restore as ``ok``
+with chunk/row accounting.  ``--mock`` bounds it for CI (2 kill cycles,
+tiny corpus); every run appends its report to
+``benchmarks/soak_results.jsonl`` and prints its seed.
 """
 
 from __future__ import annotations
@@ -230,7 +243,249 @@ def run(soak_secs: float = 180.0, chaos: bool = False) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# --kill: crash-consistency harness for the durable index recovery plane
+# ---------------------------------------------------------------------------
+
+#: child process: durable VectorStoreServer (retrieve-only) over a fixed
+#: corpus, writing a status file so the parent can watch ingest progress
+#: and the encoder-call counter from outside.  argv: docs_dir pstore
+#: status_path port dim
+_KILL_CHILD_PROGRAM = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm import mocks
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+docs_dir, pstore, status_path, port, dim = sys.argv[1:6]
+
+embed_calls = {"n": 0}
+
+
+class CountingEmbedder(mocks.FakeEmbedder):
+    def __wrapped__(self, input, **kwargs):
+        embed_calls["n"] += 1
+        return super().__wrapped__(input, **kwargs)
+
+
+docs = pw.io.fs.read(docs_dir, format="binary", mode="streaming",
+                     with_metadata=True, refresh_interval=0.2)
+vs = VectorStoreServer(docs, embedder=CountingEmbedder(dim=int(dim)))
+cfg = pw.persistence.Config(
+    pw.persistence.Backend.filesystem(pstore),
+    persistence_mode=pw.persistence.PersistenceMode.OPERATOR_PERSISTING)
+vs.run_server(host="127.0.0.1", port=int(port), threaded=True,
+              with_cache=False, aux_endpoints=False, persistence_config=cfg)
+
+from pathway_tpu.stdlib.indexing.lowering import live_index_node
+
+while True:
+    node = live_index_node(vs.index_factory)
+    status = {
+        "pid": os.getpid(),
+        "docs": len(node.doc_payload) if node is not None else 0,
+        "embed_calls": embed_calls["n"],
+        "restored_rows": getattr(node, "restored_rows", 0) if node else 0,
+    }
+    tmp = status_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(status, f)
+    os.replace(tmp, status_path)
+    time.sleep(0.1)
+"""
+
+
+def run_kill(mock: bool = False) -> dict:
+    """Kill-at-random-point restart loop + never-killed oracle parity."""
+    import shutil
+    import signal  # noqa: F401 — SIGKILL via Popen.kill()
+    import subprocess
+    import urllib.request
+
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient
+
+    seed = int(os.environ.get("SOAK_SEED", "17"))
+    print(f"[soak --kill] SOAK_SEED={seed}", flush=True)
+    rng = random.Random(seed)
+    kill_cycles = 2 if mock else 5
+    n_docs = 12 if mock else 80
+    dim = 16
+    n_probes = 5
+
+    base = tempfile.mkdtemp(prefix="soak-kill-")
+    docs_dir = os.path.join(base, "docs")
+    pstore = os.path.join(base, "pstore")
+    oracle_pstore = os.path.join(base, "pstore-oracle")
+    os.makedirs(docs_dir)
+    program = os.path.join(base, "child.py")
+    with open(program, "w") as f:
+        f.write(_KILL_CHILD_PROGRAM)
+
+    texts = []
+    for i in range(n_docs):
+        text = f"document {i:03d} " + " ".join(
+            f"w{rng.randrange(2000)}" for _ in range(24)
+        )
+        with open(os.path.join(docs_dir, f"doc{i:03d}.txt"), "w") as fh:
+            fh.write(text)
+        texts.append(text)
+    probes = rng.sample(texts, n_probes)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    children: list = []
+
+    def start_child(store: str):
+        port = _free_port()
+        idx = len(children)
+        status_path = os.path.join(base, f"status-{idx}.json")
+        # stderr to a FILE, not an undrained PIPE: JAX/absl warnings can
+        # fill the ~64KB pipe buffer and block a child for the whole
+        # wait_status window
+        err_path = os.path.join(base, f"stderr-{idx}.log")
+        err_fh = open(err_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, program, docs_dir, store, status_path,
+                 str(port), str(dim)],
+                env=env, stdout=subprocess.DEVNULL, stderr=err_fh,
+            )
+        finally:
+            err_fh.close()  # the child holds its own dup of the fd
+        proc._err_path = err_path
+        children.append(proc)
+        return proc, port, status_path
+
+    def read_status(path: str) -> dict:
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return {}
+
+    def wait_status(proc, path: str, pred, timeout: float) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                try:
+                    with open(proc._err_path, "rb") as fh:
+                        err = fh.read().decode(errors="replace")[-2000:]
+                except OSError:
+                    err = "<stderr unavailable>"
+                raise RuntimeError(
+                    f"child exited rc={proc.returncode} before becoming "
+                    f"ready: {err}"
+                )
+            status = read_status(path)
+            if status and pred(status):
+                return status
+            time.sleep(0.1)
+        raise RuntimeError(f"timeout waiting for child status at {path}")
+
+    def probe_results(port: int) -> list:
+        client = VectorStoreClient(host="127.0.0.1", port=port, timeout=30)
+        out = []
+        for text in probes:
+            res = client.query(text, k=3)
+            # (text, dist) only: seen_at metadata is wall-clock and
+            # legitimately differs between runs
+            out.append([(r["text"], r["dist"]) for r in res])
+        return out
+
+    def health(port: int) -> dict:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/health", timeout=10
+        ) as resp:
+            return json.load(resp)
+
+    report: dict = {
+        "metric": "kill_restart_recovery",
+        "seed": seed,
+        "mock": mock,
+        "kill_cycles": kill_cycles,
+        "docs": n_docs,
+    }
+    try:
+        # 1. kill-at-random-point loop: SIGKILL mid-startup/mid-ingest
+        for cycle in range(kill_cycles):
+            proc, _port, _status = start_child(pstore)
+            time.sleep(rng.uniform(1.0, 6.0 if mock else 12.0))
+            proc.kill()
+            proc.wait()
+
+        # 2. recovery run: restores whatever committed, ingests the rest,
+        # then is killed once everything is durable
+        proc, port, status_path = start_child(pstore)
+        wait_status(proc, status_path, lambda s: s["docs"] >= n_docs, 150)
+        # durability gate: the status file reports docs MID-step, before
+        # end_of_step writes the delta chunk and the commit record — poll
+        # the on-disk artifacts (commit record stable across a window)
+        # instead of sleeping, or a loaded box would race the kill below
+        from pathway_tpu.persistence import FilesystemKV
+
+        kv = FilesystemKV(pstore)
+        prev_rec = None
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            rec = kv.get("commit/record")
+            chunks = [k for k in kv.list_keys("opstate/") if "chunk-" in k]
+            if rec is not None and chunks and rec == prev_rec:
+                break
+            prev_rec = rec
+            time.sleep(0.5)
+        proc.kill()
+        proc.wait()
+
+        # 3. final warm restart: everything restores from chunks — the
+        # encoder counter must be FLAT until the probe queries run
+        proc, port, status_path = start_child(pstore)
+        final = wait_status(
+            proc, status_path, lambda s: s["docs"] >= n_docs, 150
+        )
+        report["restore_embed_calls"] = final["embed_calls"]
+        report["restored_rows"] = final["restored_rows"]
+        snap = health(port)
+        report["health_status"] = snap.get("status")
+        report["index_restore"] = snap.get("index_restore")
+        report["last_commit_age_s"] = snap.get("last_commit_age_s")
+        restored_results = probe_results(port)
+        proc.kill()
+        proc.wait()
+
+        # 4. never-killed oracle over the same corpus, fresh store
+        proc, port, status_path = start_child(oracle_pstore)
+        wait_status(proc, status_path, lambda s: s["docs"] >= n_docs, 150)
+        oracle_results = probe_results(port)
+        proc.kill()
+        proc.wait()
+
+        report["results_match_oracle"] = restored_results == oracle_results
+        report["zero_reembed_on_restore"] = (
+            final["embed_calls"] == 0 and final["restored_rows"] >= n_docs
+        )
+        report["ok"] = bool(
+            report["results_match_oracle"]
+            and report["zero_reembed_on_restore"]
+            and report["health_status"] in ("ready", "degraded")
+        )
+    finally:
+        for proc in children:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(base, ignore_errors=True)
+
+    results_path = os.path.join(HERE, "soak_results.jsonl")
+    with open(results_path, "a") as fh:
+        fh.write(json.dumps({**report, "ts": time.time()}) + "\n")
+    return report
+
+
 if __name__ == "__main__":
+    if "--kill" in sys.argv:
+        out = run_kill(mock="--mock" in sys.argv)
+        print(json.dumps(out))
+        sys.exit(0 if out.get("ok") else 1)
     chaos = "--chaos" in sys.argv or os.environ.get("SOAK_CHAOS") == "1"
     out = run(float(os.environ.get("SOAK_SECS", "180")), chaos=chaos)
     print(json.dumps(out))

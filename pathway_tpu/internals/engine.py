@@ -368,6 +368,14 @@ class ZipNode(Node):
         self.fn = fn
         self.state: dict[Pointer, list] = {}
         self.last_out: dict[Pointer, tuple] = {}
+        # chunked operator-snapshot plane (OPERATOR_PERSISTING): the
+        # per-key port slots are cross-step state — restarting them empty
+        # would swallow one side's post-restart retractions.  The lowering
+        # assigns a deterministic persistent_id; the streaming driver
+        # attaches the snapshot and restores before data flows.
+        self.persistent_id: str | None = None
+        self._op_snapshot = None
+        self._snap_dirty: set = set()
 
     def flush(self, time: int) -> list[Entry]:
         touched: set[Pointer] = set()
@@ -391,7 +399,39 @@ class ZipNode(Node):
                 out.append((key, row, 1))
             elif slot is not None and all(r is None for r in slot):
                 del self.state[key]
+        if self.persistent_id and self._op_snapshot is not None:
+            self._snap_dirty |= touched
         return consolidate(out)
+
+    def end_of_step(self, time: int) -> None:
+        if not (
+            self._snap_dirty
+            and self._op_snapshot is not None
+            and self.persistent_id
+        ):
+            self._snap_dirty.clear()
+            return
+        upserts = {}
+        deletes = []
+        for key in self._snap_dirty:
+            if key in self.state:
+                upserts[key] = (list(self.state[key]), self.last_out.get(key))
+            else:
+                deletes.append(key)
+        self._op_snapshot.save_delta(
+            self.persistent_id,
+            time,
+            upserts,
+            deletes,
+            live_entries=len(self.state),
+        )
+        self._snap_dirty.clear()
+
+    def restore_snapshot(self, snapshot: dict) -> None:
+        for key, (slot, last) in snapshot.items():
+            self.state[key] = list(slot)
+            if last is not None:
+                self.last_out[key] = last
 
 
 class GroupByNode(Node):

@@ -52,6 +52,26 @@ class HealthRegistry:
         self.engine_stall_s = float(
             os.environ.get("PATHWAY_HEALTH_STALL_S", "10")
         )
+        #: wall clock of the last durable commit record (streaming driver)
+        self._last_commit_at: float | None = None
+        #: per-index restore progress (warm-restart health gate):
+        #: pid -> {state, chunks_replayed, rows_restored, duration_ms}
+        self._restores: dict[str, dict] = {}
+
+    # -- recovery plane -------------------------------------------------
+    def note_commit(self) -> None:
+        """Stamp a durable commit record; ``/v1/health`` reports the age
+        so operators can tell a quiescent pipeline from a stalled one."""
+        self._last_commit_at = time.time()
+
+    def set_restore(self, name: str, **info: Any) -> None:
+        """Merge warm-restart progress for one index keyspace
+        (``state`` restoring/ok/failed, ``chunks_replayed``,
+        ``rows_restored``, ``duration_ms``) into the health snapshot's
+        ``index_restore`` map — the observable that distinguishes
+        "warming" from "stalled"."""
+        with self._lock:
+            self._restores.setdefault(name, {}).update(info)
 
     def set_component(
         self,
@@ -102,6 +122,10 @@ class HealthRegistry:
                 if c.get("scope") != "run"
             }
             self._beats.pop("engine", None)
+            self._restores.clear()
+            # run-scoped like the engine heartbeat: a fresh run must not
+            # inherit the previous run's commit freshness
+            self._last_commit_at = None
 
     # -- snapshot / readiness ------------------------------------------
     def snapshot(self) -> dict[str, Any]:
@@ -144,6 +168,15 @@ class HealthRegistry:
         }
         if engine_age is not None:
             snap["engine_heartbeat_age_s"] = round(engine_age, 3)
+        if self._last_commit_at is not None:
+            snap["last_commit_age_s"] = round(
+                time.time() - self._last_commit_at, 3
+            )
+        with self._lock:
+            if self._restores:
+                snap["index_restore"] = {
+                    n: dict(info) for n, info in self._restores.items()
+                }
         from .errors import error_stats
 
         snap["errors"] = error_stats()

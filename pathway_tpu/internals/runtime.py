@@ -219,6 +219,10 @@ class GraphRunner:
                 fn=lambda key, rows: tuple(v for r in rows for v in r),
                 name=f"zip#{op.id}",
             )
+            # recovery-plane keyspace: op ids are deterministic per
+            # program (graph build order) — the streaming driver restores
+            # the per-key port slots under OPERATOR_PERSISTING
+            zip_node.persistent_id = f"zip#{op.id}"
             self.engine.add(zip_node)
             self._connect_inputs(op, zip_node)
             upstream = zip_node
@@ -307,6 +311,14 @@ class GraphRunner:
                 capacity=capacity,
                 pipelined=pipelined,
                 name=f"async#{op.id}",
+            )
+            # recovery-plane coverage: the node's only cross-step state is
+            # its retraction memo — when every slot UDF is deterministic a
+            # post-restart retraction recomputes the identical value, so
+            # an empty memo is safe and OPERATOR_PERSISTING may cover the
+            # graph (non-deterministic slots keep the refusal)
+            amap._slots_deterministic = all(
+                s.deterministic for s in async_slots
             )
             self.engine.add(amap)
             wrap_in.downstream.append((amap, 0))

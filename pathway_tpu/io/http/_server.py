@@ -285,6 +285,10 @@ def _jsonable(v: Any) -> Any:
 class RestServerSubject(ConnectorSubject):
     """Ingests HTTP requests as rows (reference _server.py:490)."""
 
+    #: rows are in-flight HTTP requests — request-scoped, not durable
+    #: state; clients retry after a restart (recovery-plane coverage)
+    _ephemeral = True
+
     def __init__(
         self,
         webserver: PathwayWebserver,

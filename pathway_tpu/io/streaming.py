@@ -89,6 +89,10 @@ class ConnectorSubject:
     #: re-enterable (emits non-idempotent rows without dedup/upsert).
     _supervised: bool = True
     _max_restarts: int | None = None
+    #: request-scoped sources (REST handlers) whose rows are in-flight
+    #: client requests: nothing to restore on restart (clients retry), so
+    #: OPERATOR_PERSISTING's seekability coverage check exempts them
+    _ephemeral: bool = False
     #: fault-injection site for rows this subject pushes (None = exempt,
     #: e.g. the error-log subjects themselves)
     _fault_site: str | None = "connector.read"
@@ -591,24 +595,22 @@ class StreamingDriver:
                 subject.seek(offsets)
             self._snapshot_writers[id(subject)] = InputSnapshotWriter(storage, pid)
         # restore stateful-operator snapshots before any replayed data flows
-        from ..internals.engine import DeduplicateNode, GroupByNode
+        from ..internals.engine import DeduplicateNode, GroupByNode, ZipNode
 
         committed_t = commit_rec["time"] if commit_rec is not None else 0
         restored_t = 0
         for node in self.engine.nodes:
-            if isinstance(node, (DeduplicateNode, GroupByNode)) and node.persistent_id:
-                if isinstance(node, GroupByNode) and not operator_mode:
-                    # groupby state is rebuilt by input replay in
+            if (
+                isinstance(node, (DeduplicateNode, GroupByNode, ZipNode))
+                and node.persistent_id
+            ):
+                if isinstance(node, (GroupByNode, ZipNode)) and not operator_mode:
+                    # groupby/zip state is rebuilt by input replay in
                     # PERSISTING mode; only OPERATOR_PERSISTING restores
                     # (and writes) it through the snapshot plane
                     continue
-                if self.exchange_plane is not None and not node.persistent_id.endswith(
-                    f"-p{self.exchange_plane.me}"
-                ):
-                    # per-process keyspace, same as the input snapshots
-                    node.persistent_id = (
-                        f"{node.persistent_id}-p{self.exchange_plane.me}"
-                    )
+                # per-process keyspace, same as the input snapshots
+                node.persistent_id = self._scoped_pid(node.persistent_id)
                 # single scan: drops a crashed run's uncommitted tail (its
                 # input offsets were never recorded, so the batch re-reads
                 # and would double-apply on top of orphaned chunks) and
@@ -621,6 +623,10 @@ class StreamingDriver:
                     node.restore_snapshot(state)
                 restored_t = max(restored_t, last_t)
                 node._op_snapshot = self._op_snapshot
+        if operator_mode:
+            restored_t = max(
+                restored_t, self._restore_index_nodes(committed_t)
+            )
         if operator_mode and commit_rec is not None:
             self._op_snapshot.mark_committed(committed_t)
             t = max(t, committed_t + 1)
@@ -638,6 +644,129 @@ class StreamingDriver:
         if self.exchange_plane is not None:
             return f"commit/record-p{self.exchange_plane.me}"
         return "commit/record"
+
+    def _scoped_pid(self, pid: str) -> str:
+        """Per-process snapshot keyspace in multi-process runs: append
+        ``-p{me}`` (idempotent) so shard-filtered state never clobbers
+        another process's chunk counters (reference: worker-keyed
+        snapshots, src/persistence/input_snapshot.rs:56-283)."""
+        if self.exchange_plane is None:
+            return pid
+        suffix = f"-p{self.exchange_plane.me}"
+        return pid if pid.endswith(suffix) else f"{pid}{suffix}"
+
+    def _restore_index_nodes(self, committed_t: int) -> int:
+        """Warm-restart the live vector index behind a health gate
+        (OPERATOR_PERSISTING): stream each covered ``ExternalIndexNode``'s
+        snapshot chunks back into HBM via one bulk upsert — zero encoder
+        calls — while ``/v1/health`` reports ``index: restoring`` and the
+        serving plane answers from the degraded lexical mirror instead of
+        503ing.  Chunk reads retry through the seeded ``index.restore``
+        fault site; a store that stays unreadable fails the run loudly
+        (serving silently empty would look like data loss).  Returns the
+        newest restored finalized time (the driver resumes engine time
+        past it)."""
+        from ..internals.errors import register_error
+        from ..internals.flight_recorder import record_span
+        from ..internals.health import get_health
+        from ..stdlib.indexing.lowering import ExternalIndexNode
+
+        health = get_health()
+        newest = 0
+        attempts = max(1, int(os.environ.get("PATHWAY_RESTORE_ATTEMPTS", "3")))
+        for node in self.engine.nodes:
+            if not isinstance(node, ExternalIndexNode) or not node.persistent_id:
+                continue
+            # per-process keyspace, same as the zip/groupby loop above
+            # (defense-in-depth: OPERATOR_PERSISTING is refused in
+            # multi-process runs today, but the keyspaces must not
+            # collide the day that restriction lifts)
+            node.persistent_id = self._scoped_pid(node.persistent_id)
+            pid = node.persistent_id
+            node._op_snapshot = self._op_snapshot
+            comp = f"index:{pid}"
+            progress = {"chunks": 0, "entries": 0}
+
+            def on_chunk(key, n, ms, progress=progress, pid=pid):
+                progress["chunks"] += 1
+                progress["entries"] += n
+                health.set_restore(
+                    pid, state="restoring",
+                    chunks_replayed=progress["chunks"],
+                )
+                record_span(
+                    "restore:chunk", "restore", _time.time(), ms,
+                    attrs={"key": key, "entries": n, "index": pid},
+                )
+
+            node._restore_state = "restoring"
+            health.set_component(
+                comp, "restoring", ready=True, degraded=True, critical=False,
+                detail="streaming snapshot chunks into the index",
+            )
+            health.set_restore(
+                pid, state="restoring", chunks_replayed=0, rows_restored=0,
+            )
+            wall = _time.time()
+            t0 = _time.monotonic()
+            state = None
+            last_t = 0
+            last_exc: BaseException | None = None
+            for attempt in range(attempts):
+                progress["chunks"] = progress["entries"] = 0
+                try:
+                    if faults.enabled:
+                        faults.perturb("index.restore")
+                    state, last_t = self._op_snapshot.restore(
+                        pid, committed_time=committed_t, on_chunk=on_chunk
+                    )
+                    last_exc = None
+                    break
+                except Exception as exc:  # noqa: BLE001 — bounded retry
+                    last_exc = exc
+                    register_error(
+                        f"index {pid!r} restore attempt {attempt + 1}/"
+                        f"{attempts} failed: {type(exc).__name__}: {exc}",
+                        kind="index",
+                        operator=pid,
+                    )
+            if last_exc is not None:
+                node._restore_state = None
+                health.set_component(
+                    comp, "restore_failed", ready=False, degraded=True,
+                    detail=f"{type(last_exc).__name__}: {last_exc}",
+                )
+                health.set_restore(pid, state="failed")
+                raise RuntimeError(
+                    f"index {pid!r} could not restore its snapshot after "
+                    f"{attempts} attempts — refusing to serve an empty "
+                    "index over durable state (clear the store to rebuild "
+                    f"from replay). Last error: "
+                    f"{type(last_exc).__name__}: {last_exc}"
+                ) from last_exc
+            if state:
+                node.restore_snapshot(state)
+            node._restore_state = None
+            duration_ms = (_time.monotonic() - t0) * 1000.0
+            health.set_component(
+                comp, "ok", ready=True, degraded=False, critical=False,
+            )
+            health.set_restore(
+                pid, state="ok",
+                chunks_replayed=progress["chunks"],
+                rows_restored=node.restored_rows,
+                duration_ms=round(duration_ms, 3),
+            )
+            record_span(
+                f"restore:{pid}", "restore", wall, duration_ms,
+                attrs={
+                    "chunks": progress["chunks"],
+                    "rows": node.restored_rows,
+                    "index": pid,
+                },
+            )
+            newest = max(newest, last_t)
+        return newest
 
     def _check_operator_mode_coverage(self) -> None:
         """OPERATOR_PERSISTING replays no input entries, so every stateful
@@ -672,6 +801,12 @@ class StreamingDriver:
         # operator state it double-applies everything
         unseekable = []
         for subject, _src in self.subject_src:
+            if subject._ephemeral:
+                # request-scoped sources (REST handlers): their rows are
+                # in-flight HTTP requests, gone with the process — there
+                # is nothing to restore and nothing to double-apply
+                # (clients retry); they are exempt from seekability
+                continue
             pid = subject.effective_persistent_id(
                 self._pid_occurrence.get(id(subject))
             )
@@ -691,18 +826,34 @@ class StreamingDriver:
             )
         uncovered = []
         for node in self.engine.nodes:
-            if isinstance(node, (DeduplicateNode, GroupByNode)):
+            if isinstance(node, (DeduplicateNode, GroupByNode, ZipNode)):
                 if not node.persistent_id:
                     uncovered.append(f"{node.name} (no persistent_id)")
+            elif isinstance(node, ExternalIndexNode):
+                # asof_now index nodes are first-class recovery citizens:
+                # their doc state (already-computed vectors + payloads)
+                # checkpoints through the chunked snapshot plane and
+                # restores via one bulk upsert.  live-mode nodes stay
+                # refused — their refresh contract needs the live query
+                # rows, which this mode never replays
+                if node.mode != "asof_now" or not node.persistent_id:
+                    uncovered.append(f"{node.name} (live-mode index)")
+            elif isinstance(node, AsyncMapNode):
+                # the only cross-step state is the retraction memo: with
+                # every slot UDF deterministic, an empty memo recomputes
+                # identical values — safe to restart uncovered
+                if not getattr(node, "_slots_deterministic", False):
+                    uncovered.append(
+                        f"{node.name} (non-deterministic async map)"
+                    )
             elif isinstance(
                 node,
                 # every node whose flush() folds input into cross-step
                 # state: restarting it empty on top of restored downstream
                 # state silently corrupts results (missing retractions,
                 # empty indexes, unpaired non-deterministic recomputes)
-                (JoinNode, BufferNode, ZipNode, UpdateRowsNode,
-                 UpdateCellsNode, SemiJoinNode, AsyncMapNode,
-                 ExternalIndexNode, SortNode),
+                (JoinNode, BufferNode, UpdateRowsNode,
+                 UpdateCellsNode, SemiJoinNode, SortNode),
             ):
                 uncovered.append(node.name)
             elif isinstance(node, RowwiseNode) and node.memoize:
@@ -739,6 +890,9 @@ class StreamingDriver:
             _pickle.dumps({"time": t, "offsets": offsets}),
         )
         self._op_snapshot.mark_committed(t)
+        from ..internals.health import get_health
+
+        get_health().note_commit()
 
     def run(self) -> None:
         from ..internals.health import get_health

@@ -1,0 +1,71 @@
+"""Device-error classification for fault containment.
+
+A live serving tick or ingest upsert can die three ways on an
+accelerator: the XLA runtime throws (compilation/execution failure), HBM
+allocation fails (``_grow``/``_apply_staged`` doubling past free memory),
+or a host↔device transfer breaks (preempted TPU, dead PCIe link).  The
+serving loop and the engine must never die to any of them — the
+containment contract (ROADMAP: "degrade gracefully, don't fail closed")
+is:
+
+* **transient** — a single bad batch (injected chaos fault, flaky
+  dispatch): trip the serving circuit breaker, degrade to the lexical
+  mirror, retry via the breaker's half-open probe;
+* **fatal** — the device arrays themselves are suspect (OOM, XLA runtime
+  error, transfer failure): additionally rebuild the index's device
+  state from the host mirror / snapshot (``DeviceKnnIndex.
+  rebuild_device_arrays``) before the next probe, so recovery does not
+  depend on the poisoned buffers.
+
+Classification is name/message-based on purpose: importing
+``jaxlib.xla_extension`` types here would couple the hot error path to a
+specific jaxlib layout, and the strings below are stable across the
+versions this repo targets.
+"""
+
+from __future__ import annotations
+
+__all__ = ["classify_device_error", "TRANSIENT", "FATAL"]
+
+TRANSIENT = "transient"
+FATAL = "fatal"
+
+#: exception type names raised by the XLA runtime / array transfer layer
+_FATAL_TYPE_NAMES = (
+    "XlaRuntimeError",
+    "JaxRuntimeError",
+    "InternalError",
+)
+
+#: message fragments that mean the device or its memory is gone bad
+_FATAL_FRAGMENTS = (
+    "resource_exhausted",
+    "out of memory",
+    "failed to allocate",
+    "allocation failure",
+    "transfer failed",
+    "transfer from device",
+    "device or resource busy",
+    "failed precondition",
+    "data_loss",
+)
+
+
+def classify_device_error(exc: BaseException) -> str | None:
+    """``"fatal"`` / ``"transient"`` for device-plane failures, ``None``
+    for everything else (plain Python bugs keep their normal routing)."""
+    from ..testing.faults import FaultInjected
+
+    if isinstance(exc, FaultInjected):
+        # chaos-injected faults model a flaky dispatch, not corrupted
+        # HBM — breaker-and-degrade territory
+        return TRANSIENT if exc.site.startswith("device.") else None
+    msg = str(exc).lower()
+    for t in type(exc).__mro__:
+        if t.__name__ in _FATAL_TYPE_NAMES:
+            return FATAL
+    if any(frag in msg for frag in _FATAL_FRAGMENTS):
+        return FATAL
+    if isinstance(exc, MemoryError):
+        return FATAL
+    return None

@@ -76,6 +76,8 @@ class DeviceKnnIndex:
         # scatter fns — subclasses swap in sharding-preserving variants
         self._scatter_rows_fn = _scatter_rows
         self._scatter_mask_fn = _scatter_mask
+        #: fatal-device-fault recoveries performed (rebuild_device_arrays)
+        self.rebuilds = 0
 
     def _round_capacity(self, capacity: int) -> int:
         """Capacities at/above the Pallas threshold are kept at multiples
@@ -246,6 +248,15 @@ class DeviceKnnIndex:
         ):
             self._maybe_compact()
             return
+        from ..testing import faults
+
+        if faults.enabled:
+            # chaos site "device.upsert": the staged scatter is where a
+            # flaky dispatch / HBM allocator failure lands in production —
+            # a "fail" here surfaces through whichever caller (search or
+            # ingest flush) triggered the apply, exercising both
+            # containment paths
+            faults.perturb("device.upsert")
         # device batches FIRST (FIFO), host dict after: a host upsert that
         # landed later than a device batch for the same slot wins, and
         # upsert_batch already evicts older host entries for its slots
@@ -276,6 +287,135 @@ class DeviceKnnIndex:
         self._staged_set.clear()
         self._staged_valid.clear()
         self._maybe_compact()
+
+    # -- fatal-device-fault recovery ------------------------------------
+    def rebuild_device_arrays(self, vectors_by_key=None) -> bool:
+        """Recreate the device-resident arrays after a fatal device fault
+        (HBM OOM, XLA runtime error, failed transfer) without losing the
+        host-side bookkeeping.
+
+        Two recovery sources, tried in order:
+
+        1. **host mirror** — pull the (possibly still readable) matrix
+           back to host and re-place fresh arrays from the copy; the
+           usual path when the fault hit a scatter/launch but the
+           resident buffers survived;
+        2. **snapshot provider** — ``vectors_by_key`` (key → raw vector,
+           e.g. replayed from the operator-snapshot plane by
+           ``ExternalIndexNode``): slots are reassigned and every vector
+           re-staged, the path when the arrays themselves are gone.
+
+        Staged device batches are salvaged to host where their buffers
+        still read; rows that cannot be copied are dropped loudly (the
+        error log) rather than poisoning the rebuild.  ``_place()`` runs
+        at the end so sharded subclasses re-pin to the mesh instead of
+        landing on the default device.  Returns True on success.
+        """
+        with self._lock:
+            return self._rebuild_locked(vectors_by_key)
+
+    def _rebuild_locked(self, vectors_by_key) -> bool:
+        from ..internals.errors import register_error
+
+        salvaged: list[tuple[np.ndarray, np.ndarray]] = []
+        dropped_slots: list[int] = []
+        for slots, vals in self._staged_device:
+            try:
+                salvaged.append((slots, np.asarray(vals, dtype=np.float32)))
+            except Exception:  # noqa: BLE001 — buffer on the dead device
+                dropped_slots.extend(int(s) for s in slots if s >= 0)
+        self._staged_device.clear()
+        if dropped_slots:
+            register_error(
+                f"index rebuild dropped {len(dropped_slots)} staged device "
+                "rows (buffers unreadable after device fault)",
+                kind="index",
+                operator="knn.rebuild",
+            )
+        host = valid = None
+        try:
+            host = np.asarray(self.vectors, dtype=np.float32)
+            valid = np.asarray(self.valid, dtype=bool)
+        except Exception:  # noqa: BLE001 — resident arrays are gone too
+            host = None
+        slots_reassigned = False
+        if host is not None:
+            self.vectors = jnp.asarray(host.astype(np.float32), dtype=self.dtype)
+            self.valid = jnp.asarray(valid)
+        elif vectors_by_key is not None:
+            # arrays unreadable: rebuild bookkeeping + staging from the
+            # snapshot.  Keys absent from the provider (an uncommitted
+            # tail) are lost here and re-enter via replay/re-ingest.
+            lost = len(self.slot_of_key) - sum(
+                1 for k in self.slot_of_key if k in vectors_by_key
+            )
+            if lost:
+                register_error(
+                    f"index rebuild from snapshot lost {lost} uncommitted "
+                    "rows (will re-enter via replay/re-ingest)",
+                    kind="index",
+                    operator="knn.rebuild",
+                )
+            self.slot_of_key = {}
+            self.key_of_slot = [None] * self.capacity
+            self.free = list(range(self.capacity - 1, -1, -1))
+            self._staged_set.clear()
+            self._staged_valid.clear()
+            self.vectors = jnp.zeros((self.capacity, self.dim), dtype=self.dtype)
+            self.valid = jnp.zeros((self.capacity,), dtype=bool)
+            for key, vec in vectors_by_key.items():
+                self._upsert_locked(key, vec)
+            slots_reassigned = True
+        else:
+            return False
+        if slots_reassigned:
+            # the snapshot path reassigned every slot: salvaged batches
+            # carry only PRE-rebuild slot indices, so re-staging them
+            # would write stale vectors into slots now owned by other
+            # keys (or resurrect freed slots).  Drop them loudly — they
+            # belong to an uncommitted tail that re-enters via replay.
+            n = sum(int((slots >= 0).sum()) for slots, _ in salvaged)
+            if n:
+                register_error(
+                    f"index rebuild from snapshot dropped {n} salvaged "
+                    "staged rows (slot layout was reassigned; rows "
+                    "re-enter via replay/re-ingest)",
+                    kind="index",
+                    operator="knn.rebuild",
+                )
+        else:
+            # re-stage salvaged device rows host-side; pre-existing host
+            # staging wins (it was staged AFTER the device batches)
+            host_staged = set(self._staged_set)
+            for slots, vals in salvaged:
+                for j, slot in enumerate(slots):
+                    slot = int(slot)
+                    if slot < 0 or slot in host_staged:
+                        continue
+                    vec = vals[j]
+                    if self.metric == "cos":
+                        norm = float(np.linalg.norm(vec))
+                        if norm > 0:
+                            vec = vec / norm
+                    self._staged_set[slot] = vec.astype(np.float32)
+                    self._staged_valid[slot] = True
+            # dropped rows whose slot holds NO materialized vector (a new
+            # key whose only write was the unreadable batch) must not stay
+            # pending-valid: the scatter would mark a never-written matrix
+            # row live and searches would rank its zeros.  Keys with an
+            # old materialized vector keep it.
+            for slot in dropped_slots:
+                if slot in self._staged_set or bool(valid[slot]):
+                    continue
+                self._staged_valid.pop(slot, None)
+                key = self.key_of_slot[slot]
+                if key is not None:
+                    del self.slot_of_key[key]
+                    self.key_of_slot[slot] = None
+                    self.free.append(slot)
+        self._place()
+        self.rebuilds += 1
+        return True
 
     # -- search --
     def search_among(

@@ -19,7 +19,9 @@ restored from its own device-array dump).  Three cooperating pieces:
 * UDF caching: ``PersistenceMode.UDF_CACHING`` routes ``DefaultCache``
   through the configured backend (reference: vector_store.py:564-567).
 * operator snapshots: stateful operators (deduplicate, persistent
-  groupby state) checkpoint through :class:`ChunkedOperatorSnapshot` —
+  groupby state, request/reply zips, and the live vector index — whose
+  deltas carry ALREADY-COMPUTED embeddings so restore costs zero
+  encoder calls) checkpoint through :class:`ChunkedOperatorSnapshot` —
   per-commit **delta chunks** with background merge compaction
   (reference: operator_snapshot.rs:21-37 chunked writes keyed by
   finalized time, compaction at :337).
@@ -28,7 +30,14 @@ Chunked operator-snapshot on-disk format (format version >= 2)::
 
     opstate/{pid}/chunk-NNNNNNNN   (NNNNNNNN = zero-padded decimal seq)
 
-Each chunk is a pickled dict.  Delta chunks are
+Every chunk (operator and input-snapshot alike) is framed for
+integrity: ``b"PWSC" + blake2b-16(payload) + payload``.  A corrupt or
+truncated chunk fails restore with :class:`SnapshotCorruption` (key
+name, expected/actual digest) instead of an unpickling crash; frameless
+chunks written by earlier builds still read (the framing is
+backward-compatible, FORMAT_VERSION unchanged).
+
+Each chunk payload is a pickled dict.  Delta chunks are
 ``{"kind": "delta", "time": t, "upserts": {k: v}, "deletes": [k, ...]}``
 — the net state-key changes of one finalized engine timestamp, so a
 commit costs O(changed keys), not O(state).  Compaction merges the run
@@ -62,7 +71,57 @@ __all__ = [
     "KVStorage",
     "ChunkedOperatorSnapshot",
     "OperatorSnapshot",
+    "SnapshotCorruption",
 ]
+
+
+class SnapshotCorruption(RuntimeError):
+    """A snapshot chunk failed its integrity check (corrupt or truncated).
+
+    Raised with the chunk's key and the expected/actual digests so the
+    operator can locate the bad object instead of debugging a pickle
+    traceback from the middle of a restore."""
+
+
+#: integrity framing for snapshot chunks: ``MAGIC + blake2b-16(payload)
+#: + payload``.  Chunks written before this framing existed (no magic)
+#: are read as-is — the format stays backward compatible, so
+#: FORMAT_VERSION is unchanged.
+_CHUNK_MAGIC = b"PWSC"
+_CHUNK_DIGEST_SIZE = 16
+
+
+def _seal_chunk(payload: bytes) -> bytes:
+    digest = hashlib.blake2b(payload, digest_size=_CHUNK_DIGEST_SIZE).digest()
+    return _CHUNK_MAGIC + digest + payload
+
+
+def _open_chunk(key: str, data: bytes) -> bytes:
+    """Verify and strip the integrity frame; legacy frameless chunks pass
+    through.  A corrupt or truncated chunk raises :class:`SnapshotCorruption`
+    naming the key and both digests."""
+    if not data.startswith(_CHUNK_MAGIC):
+        return data  # legacy chunk written before checksum framing
+    head = len(_CHUNK_MAGIC) + _CHUNK_DIGEST_SIZE
+    if len(data) < head:
+        raise SnapshotCorruption(
+            f"snapshot chunk {key!r} is truncated: {len(data)} bytes is "
+            f"shorter than the {head}-byte integrity header. The chunk was "
+            "cut off mid-write — restore from a replica or remove the key "
+            "to fall back to replay."
+        )
+    expected = data[len(_CHUNK_MAGIC):head]
+    payload = data[head:]
+    actual = hashlib.blake2b(payload, digest_size=_CHUNK_DIGEST_SIZE).digest()
+    if actual != expected:
+        raise SnapshotCorruption(
+            f"snapshot chunk {key!r} failed its integrity check: expected "
+            f"blake2b {expected.hex()}, got {actual.hex()} over "
+            f"{len(payload)} payload bytes. The chunk is corrupt or "
+            "truncated — restore it from a replica or remove the key to "
+            "fall back to replay."
+        )
+    return payload
 
 
 class PersistenceMode(enum.Enum):
@@ -517,7 +576,9 @@ class InputSnapshotWriter:
 
     def write_batch(self, entries: list, offsets: Any) -> None:
         payload = pickle.dumps({"entries": entries, "offsets": offsets})
-        self.storage.put(f"snap/{self.pid}/chunk-{self._chunk:08d}", payload)
+        self.storage.put(
+            f"snap/{self.pid}/chunk-{self._chunk:08d}", _seal_chunk(payload)
+        )
         self._chunk += 1
 
     def frontier(self) -> Any:
@@ -526,7 +587,7 @@ class InputSnapshotWriter:
         if not keys:
             return None
         data = self.storage.get(keys[-1])
-        return pickle.loads(data)["offsets"] if data else None
+        return pickle.loads(_open_chunk(keys[-1], data))["offsets"] if data else None
 
 
 class InputSnapshotReader:
@@ -541,14 +602,14 @@ class InputSnapshotReader:
         for key in self.storage.list_keys(f"snap/{self.pid}/chunk-"):
             data = self.storage.get(key)
             if data:
-                yield pickle.loads(data)["entries"]
+                yield pickle.loads(_open_chunk(key, data))["entries"]
 
     def last_offsets(self) -> Any:
         keys = self.storage.list_keys(f"snap/{self.pid}/chunk-")
         if not keys:
             return None
         data = self.storage.get(keys[-1])
-        return pickle.loads(data)["offsets"] if data else None
+        return pickle.loads(_open_chunk(keys[-1], data))["offsets"] if data else None
 
 
 # ---------------------------------------------------------------------------
@@ -657,7 +718,7 @@ class ChunkedOperatorSnapshot:
         meta = self._meta_for(pid)
         seq = meta[0]
         meta[0] += 1
-        self.storage.put(f"{self._prefix(pid)}{seq:08d}", payload)
+        self.storage.put(f"{self._prefix(pid)}{seq:08d}", _seal_chunk(payload))
         with self._master:
             self.bytes_written += len(payload)
             self.chunks_written += 1
@@ -778,7 +839,7 @@ class ChunkedOperatorSnapshot:
             data = self.storage.get(key)
             if not data:
                 continue
-            chunk = pickle.loads(data)
+            chunk = pickle.loads(_open_chunk(key, data))
             if bound is not None and chunk.get("time", 0) > bound:
                 continue  # uncommitted tail — stays as-is this round
             folded_keys.append(key)
@@ -795,7 +856,7 @@ class ChunkedOperatorSnapshot:
         payload = pickle.dumps(
             {"kind": "base", "time": last_time, "state": state}
         )
-        self.storage.put(f"{prefix}{base_seq:08d}", payload)
+        self.storage.put(f"{prefix}{base_seq:08d}", _seal_chunk(payload))
         with self._pid_lock(persistent_id):
             meta = self._meta_for(persistent_id)
             meta[1] = max(0, meta[1] - folded_entries)
@@ -819,7 +880,7 @@ class ChunkedOperatorSnapshot:
                 data = self.storage.get(key)
                 if not data:
                     continue
-                if pickle.loads(data).get("time", 0) > time:
+                if pickle.loads(_open_chunk(key, data)).get("time", 0) > time:
                     self.storage.remove(key)
 
     def load(self, persistent_id: str) -> dict | None:
@@ -838,27 +899,38 @@ class ChunkedOperatorSnapshot:
         return self.restore(persistent_id)[0]
 
     def restore(
-        self, persistent_id: str, committed_time: int | None = None
+        self,
+        persistent_id: str,
+        committed_time: int | None = None,
+        *,
+        on_chunk: Any = None,
     ) -> tuple[dict | None, int]:
         """Single-scan restart path: read every chunk once, drop chunks
         newer than ``committed_time`` (a crashed run's uncommitted tail —
         its input offsets were never recorded, so the data replays and
         would double-apply), replay the rest as :meth:`load` does.
 
+        ``on_chunk(key, n_entries, read_ms)`` (optional) is called per
+        replayed chunk — the streaming driver feeds it into the restore
+        progress surfaced on ``/v1/health`` and the flight recorder.
+
         Returns ``(state | None, newest_folded_time)``.  The driver MUST
         resume engine time past the returned time in every persistence
         mode: replay orders deltas by finalized time, so a later run
         re-using earlier times would make a stale delta win (engine times
         restart from 1 per run unless resumed)."""
+        import time as _time
+
         keys = self.storage.list_keys(self._prefix(persistent_id))
         legacy = self.storage.get(f"opstate/{persistent_id}")
         chunks = []
         with self._pid_lock(persistent_id):
             for key in keys:
+                t0 = _time.monotonic()
                 data = self.storage.get(key)
                 if not data:
                     continue
-                chunk = pickle.loads(data)
+                chunk = pickle.loads(_open_chunk(key, data))
                 if (
                     committed_time is not None
                     and chunk.get("time", 0) > committed_time
@@ -866,6 +938,14 @@ class ChunkedOperatorSnapshot:
                     self.storage.remove(key)
                     continue
                 chunks.append(chunk)
+                if on_chunk is not None:
+                    n = (
+                        len(chunk.get("state", ()))
+                        if chunk["kind"] == "base"
+                        else len(chunk.get("upserts", ()))
+                        + len(chunk.get("deletes", ()))
+                    )
+                    on_chunk(key, n, (_time.monotonic() - t0) * 1000.0)
         if not chunks and legacy is None:
             return None, 0
         state, last_time = self._replay(

@@ -17,6 +17,8 @@ from __future__ import annotations
 import weakref
 from typing import Any
 
+import numpy as np
+
 from ...internals.engine import Entry, Node, consolidate
 from ...internals.evaluator import compile_expression
 from ...internals.value import ERROR
@@ -82,6 +84,21 @@ class ExternalIndexNode(Node):
         # For keep-queries streams this grows with total queries, the same
         # asymptotics as the downstream reply table those queries requested.
         self.answered: dict[Any, tuple] = {}
+        #: chunked operator-snapshot plane (streaming driver attaches it
+        #: under OPERATOR_PERSISTING).  Deltas carry the ALREADY-COMPUTED
+        #: doc vectors — restore streams them back into HBM without one
+        #: encoder call (EdgeRAG: persisting embeddings beats online
+        #: regeneration).  ``_snap_pending`` holds this step's net doc
+        #: changes: key -> (data, meta, payload) for upserts, None for
+        #: deletes; cleared only once the delta chunk is durably written.
+        self.persistent_id: str | None = None
+        self._op_snapshot = None
+        self._snap_pending: dict[Any, tuple | None] = {}
+        #: warm-restart health gate: "restoring" while the driver streams
+        #: snapshot chunks back into the index — the serving plane
+        #: (RetrievePlane) answers from the lexical mirror until cleared
+        self._restore_state: str | None = None
+        self.restored_rows = 0
 
     def flush(self, time: int) -> list[Entry]:
         out: list[Entry] = []
@@ -119,25 +136,43 @@ class ExternalIndexNode(Node):
             else:
                 last[key] = None
         add_keys = [k for k, v in last.items() if v is not None]
-        for key, action in last.items():
-            if action is None:
-                self.index.remove(key)
-                self.doc_payload.pop(key, None)
-        if add_keys:
-            if hasattr(self.index, "add_batch"):
-                self.index.add_batch(
-                    add_keys,
-                    [last[k][0] for k in add_keys],
-                    [last[k][1] for k in add_keys],
-                )
-            else:  # duck-typed custom index without the batched protocol
-                for key in add_keys:
-                    self.index.add(key, last[key][0], last[key][1])
-            for key in add_keys:
-                self.doc_payload[key] = payloads[key]
-            from ...internals.flight_recorder import record_ingest_docs
+        try:
+            self._apply_index_updates(last, payloads, add_keys)
+        except Exception as exc:  # noqa: BLE001 — classify before routing
+            if not self._contain_device_fault(exc):
+                raise
+            try:
+                # one retry against the rebuilt arrays (upserts/removes
+                # are idempotent, so a partially-applied first attempt
+                # re-applies cleanly)
+                self._apply_index_updates(last, payloads, add_keys)
+            except Exception as exc2:  # noqa: BLE001
+                from ...ops.device_faults import classify_device_error
 
-            record_ingest_docs(len(add_keys))
+                if classify_device_error(exc2) is None:
+                    raise
+                # still failing on the device plane: drop the batch from
+                # the DEVICE index but keep the run alive — the snapshot
+                # below still records the vectors, so the docs are
+                # durable and re-enter on the next rebuild/restart
+                from ...internals.errors import register_error
+
+                register_error(
+                    f"index update batch dropped after device-fault retry: "
+                    f"{type(exc2).__name__}: {exc2}",
+                    kind="index",
+                    operator=self.name,
+                )
+        if self._op_snapshot is not None and self.persistent_id:
+            for key, action in last.items():
+                if action is None:
+                    self._snap_pending[key] = None
+                else:
+                    self._snap_pending[key] = (
+                        self._snap_value(action[0]),
+                        action[1],
+                        payloads[key],
+                    )
         if index_changed:
             # freshness watermark: the updates of engine timestamp `time`
             # are queryable from here on (updates-before-queries), closing
@@ -195,6 +230,170 @@ class ExternalIndexNode(Node):
                         slot[1] = new_row
         return consolidate(out)
 
+    # -- index-update application + device-fault containment ------------
+    def _apply_index_updates(self, last, payloads, add_keys) -> None:
+        for key, action in last.items():
+            if action is None:
+                self.index.remove(key)
+                self.doc_payload.pop(key, None)
+        if add_keys:
+            if hasattr(self.index, "add_batch"):
+                self.index.add_batch(
+                    add_keys,
+                    [last[k][0] for k in add_keys],
+                    [last[k][1] for k in add_keys],
+                )
+            else:  # duck-typed custom index without the batched protocol
+                for key in add_keys:
+                    self.index.add(key, last[key][0], last[key][1])
+            for key in add_keys:
+                self.doc_payload[key] = payloads[key]
+            from ...internals.flight_recorder import record_ingest_docs
+
+            record_ingest_docs(len(add_keys))
+
+    def _contain_device_fault(self, exc: BaseException) -> bool:
+        """Containment for device errors raised by index mutation/search:
+        transient ones are logged (the caller retries / degrades), fatal
+        ones additionally rebuild the device arrays from the host mirror
+        or the snapshot.  Returns False for non-device exceptions — plain
+        bugs keep their normal routing."""
+        from ...internals.errors import register_error
+        from ...ops.device_faults import FATAL, classify_device_error
+
+        kind = classify_device_error(exc)
+        if kind is None:
+            return False
+        register_error(
+            f"device fault ({kind}) in index {self.name!r}: "
+            f"{type(exc).__name__}: {exc}",
+            kind="index",
+            operator=self.name,
+        )
+        if kind == FATAL:
+            # a rebuild on a still-dead device can itself raise — that
+            # must stay inside the containment boundary (the caller's
+            # retry will fail and take the degraded/drop path), never
+            # escape to kill the engine thread
+            try:
+                self.rebuild_device_state()
+            except Exception as rexc:  # noqa: BLE001 — contained
+                register_error(
+                    f"index rebuild after device fault failed: "
+                    f"{type(rexc).__name__}: {rexc}",
+                    kind="index",
+                    operator=self.name,
+                )
+        return True
+
+    def rebuild_device_state(self) -> bool:
+        """Recreate the inner index's device arrays after a fatal fault —
+        host mirror first, snapshot vectors as the fallback (the
+        ``_place()`` rebuild hook re-pins sharded matrices to the mesh).
+        Returns True when a rebuild happened."""
+        import time as _time
+
+        from ...internals.flight_recorder import record_span
+
+        inner = getattr(self.index, "index", None)
+        if inner is None or not hasattr(inner, "rebuild_device_arrays"):
+            return False
+        wall = _time.time()
+        t0 = _time.monotonic()
+        ok = inner.rebuild_device_arrays()
+        source = "host_mirror"
+        if not ok:
+            vectors = self._snapshot_vectors()
+            if vectors:
+                ok = inner.rebuild_device_arrays(vectors)
+                source = "snapshot"
+        record_span(
+            f"rebuild:{self.name}", "restore", wall,
+            (_time.monotonic() - t0) * 1000.0,
+            attrs={"ok": ok, "source": source, "index": self.name},
+        )
+        return ok
+
+    def _snapshot_vectors(self) -> dict | None:
+        """Doc vectors replayed from the snapshot plane (fatal-rebuild
+        fallback when even a D2H copy of the matrix fails)."""
+        if self._op_snapshot is None or not self.persistent_id:
+            return None
+        state = self._op_snapshot.load(self.persistent_id) or {}
+        out = {
+            key: rec[0]
+            for key, rec in state.items()
+            if isinstance(rec[0], np.ndarray)
+        }
+        return out or None
+
+    @staticmethod
+    def _snap_value(data):
+        """Snapshot representation of one doc's index data: array-likes
+        (embeddings) are pinned as float32 numpy — a device array must
+        not ride a pickle — while text (BM25) passes through."""
+        if isinstance(data, np.ndarray):
+            return np.asarray(data, dtype=np.float32)
+        if hasattr(data, "__array__") or isinstance(data, (list, tuple)):
+            return np.asarray(data, dtype=np.float32)
+        return data
+
+    # -- operator snapshots (reference: operator_snapshot.rs) -----------
+    _SNAPSHOT_WRITE_ATTEMPTS = 3
+
+    def end_of_step(self, time: int) -> None:
+        if not (
+            self._snap_pending
+            and self._op_snapshot is not None
+            and self.persistent_id
+        ):
+            return
+        from ...testing import faults
+
+        upserts = {k: v for k, v in self._snap_pending.items() if v is not None}
+        deletes = [k for k, v in self._snap_pending.items() if v is None]
+        last_exc: BaseException | None = None
+        for _attempt in range(self._SNAPSHOT_WRITE_ATTEMPTS):
+            try:
+                if faults.enabled:
+                    faults.perturb("index.snapshot")
+                self._op_snapshot.save_delta(
+                    self.persistent_id,
+                    time,
+                    upserts,
+                    deletes,
+                    live_entries=len(self.doc_payload),
+                )
+                self._snap_pending.clear()
+                return
+            except Exception as exc:  # noqa: BLE001 — bounded retry
+                last_exc = exc
+        # a snapshot that cannot be written is a durability failure: the
+        # commit record would otherwise advance offsets past rows whose
+        # state never landed — fail LOUDLY rather than break exactly-once
+        raise RuntimeError(
+            f"index {self.name!r} could not write its snapshot delta after "
+            f"{self._SNAPSHOT_WRITE_ATTEMPTS} attempts"
+        ) from last_exc
+
+    def restore_snapshot(self, state: dict) -> None:
+        """Warm restart: stream the snapshotted (vector, metadata,
+        payload) rows back into the index through ONE bulk ``add_batch``
+        (a single staged device scatter) — zero encoder calls."""
+        keys, datas, metas = [], [], []
+        for key, (data, meta, payload) in state.items():
+            keys.append(key)
+            datas.append(data)
+            metas.append(meta)
+            self.doc_payload[key] = payload
+        if keys:
+            if hasattr(self.index, "add_batch"):
+                self.index.add_batch(keys, datas, metas)
+            else:
+                for key, data, meta in zip(keys, datas, metas):
+                    self.index.add(key, data, meta)
+        self.restored_rows = len(keys)
+
     def _answer(self, rows: list[tuple]) -> list[tuple]:
         queries = []
         for row in rows:
@@ -215,7 +414,29 @@ class ExternalIndexNode(Node):
                 queries.append(None)
             else:
                 queries.append((q, int(k), flt))
-        raw = self.index.search([q for q in queries if q is not None])
+        live = [q for q in queries if q is not None]
+        try:
+            raw = self.index.search(live)
+        except Exception as exc:  # noqa: BLE001 — classify before routing
+            if not self._contain_device_fault(exc):
+                raise
+            try:
+                # one retry against rebuilt/recovered arrays
+                raw = self.index.search(live)
+            except Exception as exc2:  # noqa: BLE001
+                from ...ops.device_faults import classify_device_error
+
+                if classify_device_error(exc2) is None:
+                    raise
+                from ...internals.errors import register_error
+
+                register_error(
+                    "query batch answered empty after device fault: "
+                    f"{type(exc2).__name__}: {exc2}",
+                    kind="index",
+                    operator=self.name,
+                )
+                raw = [[] for _ in live]
         raw_iter = iter(raw)
         replies = []
         for q in queries:
@@ -278,6 +499,11 @@ def lower_external_index(runner: GraphRunner, op: Operator) -> None:
     # freshness watermarks are matched per engine (timestamps restart at 1
     # in every run — see FreshnessTracker's scope note)
     node._freshness_scope = id(runner.engine)
+    # snapshot keyspace: op ids are deterministic for a given program
+    # (graph build order), the same stability contract as the default
+    # connector persistent ids — the streaming driver attaches the
+    # snapshot plane under OPERATOR_PERSISTING
+    node.persistent_id = f"index#{op.id}"
     # pin the factory on the node: the registry key is id(factory), so the
     # factory must stay alive exactly as long as the entry does — otherwise
     # a recycled id could alias a NEW factory to this stale node

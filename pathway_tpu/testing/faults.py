@@ -25,6 +25,19 @@ Sites wired into the codebase:
 ``scheduler.step``        every device-step batch the serving scheduler
                           executes — ``fail`` fans the error out to the
                           batch's waiters, ``delay`` stretches the tick
+``device.upsert``         the staged device scatter applying index
+                          upserts (``ops/knn.py _apply_staged``) —
+                          ``fail`` surfaces through whichever caller
+                          (serving search or ingest flush) triggered the
+                          apply, exercising both containment paths
+``index.snapshot``        every index snapshot-delta write
+                          (``ExternalIndexNode.end_of_step``) — retried
+                          in place up to 3 times, then fails the run
+                          loudly (durability over availability)
+``index.restore``         each warm-restart restore attempt of the index
+                          snapshot (streaming driver) — retried with the
+                          same bound; the chaos suite pins that seeded
+                          failures retry cleanly
 ========================  ====================================================
 
 Activation:

@@ -692,21 +692,43 @@ class RetrievePlane:
                 retry_after_s=self.scheduler.retry_after_s,
             )
         index = node.index
+        # warm-restart health gate, checked BEFORE any index read: while
+        # the driver streams snapshot chunks back in, results come from
+        # half-restored state and must never be presented as authoritative
+        restoring = getattr(node, "_restore_state", None) == "restoring"
         if getattr(index, "query_is_text", False):
             from ...internals.flight_recorder import batch_stage as _bs
 
+            # a restoring lexical index still answers (restore is
+            # host-side and monotone) but the reply is tagged degraded —
+            # partial results, not authoritative ones
             with _bs("search"):
                 raw = index.search(list(items))
             return [
-                {"results": self._pack(node, row), "degraded": False}
+                {"results": self._pack(node, row), "degraded": restoring}
+                for row in raw
+            ]
+        from ...internals.flight_recorder import batch_stage
+
+        # vector path while restoring: answer from the lexical mirror
+        # (tagged degraded) until the restored frontier catches the
+        # commit record, never 503
+        if restoring:
+            if self._mirror is None:
+                raise ServingNotReady(
+                    "index is restoring from snapshot",
+                    retry_after_s=self.scheduler.retry_after_s,
+                )
+            with batch_stage("lexical_search"):
+                raw = self._mirror.search(node, items)
+            return [
+                {"results": self._pack(node, row), "degraded": True}
                 for row in raw
             ]
         if self.embedder is None:
             raise RuntimeError(
                 "retrieve plane needs an embedder for a vector index"
             )
-        from ...internals.flight_recorder import batch_stage
-
         raw = None
         if self.breaker is None or self.breaker.allow():
             try:
@@ -738,6 +760,25 @@ class RetrievePlane:
                     kind="serving",
                     operator=self.group.label,
                 )
+                # device-fault containment: a FATAL device error (HBM
+                # OOM, XLA runtime error, dead transfer) means the index
+                # arrays are suspect — rebuild them from the host mirror
+                # / snapshot now, so the breaker's half-open probe runs
+                # against healthy buffers instead of re-tripping forever
+                from ...ops.device_faults import FATAL, classify_device_error
+
+                if classify_device_error(exc) == FATAL and hasattr(
+                    node, "rebuild_device_state"
+                ):
+                    try:
+                        node.rebuild_device_state()
+                    except Exception as rexc:  # noqa: BLE001 — degraded
+                        register_error(
+                            f"index rebuild after device fault failed: "
+                            f"{type(rexc).__name__}: {rexc}",
+                            kind="serving",
+                            operator=self.group.label,
+                        )
                 if self.breaker is None or self._mirror is None:
                     raise
             else:

@@ -176,14 +176,18 @@ def run_with_cache(
     with_cache: bool = True,
     cache_backend: Any = None,
     terminate_on_error: bool = True,
+    persistence_config: Any = None,
 ):
     """Start ``pw.run`` with UDF_CACHING persistence wired (reference:
     vector_store.py:558-582 / servers.py run) — shared by every xpack
-    ``run_server``.  Returns the thread when ``threaded=True``."""
+    ``run_server``.  Returns the thread when ``threaded=True``.
+
+    An explicit ``persistence_config`` (durable serving: the recovery
+    plane under ``PersistenceMode.OPERATOR_PERSISTING``) takes precedence
+    over the default in-memory UDF cache."""
     from ...internals.run import run
 
-    persistence_config = None
-    if with_cache:
+    if persistence_config is None and with_cache:
         from ...persistence import Backend, Config
 
         backend = cache_backend or Backend.mock()

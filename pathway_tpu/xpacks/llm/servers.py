@@ -54,9 +54,13 @@ class BaseRestServer:
         with_cache: bool = True,
         cache_backend: Any = None,
         terminate_on_error: bool = True,
+        persistence_config: Any = None,
         **kwargs,
     ):
-        """reference: servers.py run — wires UDF caching persistence."""
+        """reference: servers.py run — wires UDF caching persistence.
+        An explicit ``persistence_config`` (e.g. the durable
+        OPERATOR_PERSISTING recovery plane) takes precedence over the
+        in-memory UDF cache."""
         from ._utils import run_with_cache
 
         return run_with_cache(
@@ -64,6 +68,7 @@ class BaseRestServer:
             with_cache=with_cache,
             cache_backend=cache_backend,
             terminate_on_error=terminate_on_error,
+            persistence_config=persistence_config,
         )
 
     run_server = run

@@ -58,10 +58,13 @@ class QueryResultSchema(Schema):
     result: Json
 
 
-@udf
+@udf(deterministic=True)
 def _merge_filters(metadata_filter: str | None, filepath_globpattern: str | None) -> str | None:
     """Combine the two request filters into one expression
-    (reference: vector_store.py:358 ``merge_filters``)."""
+    (reference: vector_store.py:358 ``merge_filters``).  Deterministic:
+    a pure string merge — marking it so keeps its select un-memoized,
+    which OPERATOR_PERSISTING's coverage check requires (a memoized map
+    cannot restart empty over restored downstream state)."""
     from ._utils import merge_filter_exprs
 
     return merge_filter_exprs(metadata_filter, filepath_globpattern)
@@ -292,6 +295,7 @@ class VectorStoreServer:
         *,
         with_scheduler: bool | None = None,
         deadline_ms: float | None = None,
+        aux_endpoints: bool = True,
         **rest_kwargs,
     ) -> None:
         """Register the REST routes.
@@ -302,6 +306,14 @@ class VectorStoreServer:
         into one fused embed→search device tick instead of riding engine
         micro-batch cadence — with ``deadline_ms``-based shedding
         (503 + Retry-After).  Statistics/inputs stay engine-routed.
+
+        ``aux_endpoints=False`` registers only ``/v1/retrieve`` (plus the
+        always-on ``/v1/health`` and ``/v1/debug/traces``): the
+        statistics/inputs pipelines join REST queries against engine
+        state, and those joins are not yet covered by the
+        OPERATOR_PERSISTING recovery plane — a durable serving deployment
+        (see README "Operations: recovery & durability") runs
+        retrieve-only.
 
         Every route is traced: responses carry ``x-pathway-trace-id``
         (a caller-sent W3C ``traceparent`` is honored) and the scheduler
@@ -349,6 +361,14 @@ class VectorStoreServer:
             )
             retrieval_writer(self.retrieve_query(retrieval_queries))
 
+        if not aux_endpoints:
+            # no rest_connector subject will start the listener (the
+            # scheduler plane serves /v1/retrieve directly) — bring it up
+            # now so /v1/health is observable through warm restore, with
+            # queries answering degraded until the index is ready
+            webserver._ensure_started()
+            return
+
         stats_queries, stats_writer = rest_connector(
             webserver=webserver,
             route="/v1/statistics",
@@ -377,19 +397,28 @@ class VectorStoreServer:
         terminate_on_error: bool = True,
         with_scheduler: bool | None = None,
         deadline_ms: float | None = None,
+        aux_endpoints: bool = True,
+        persistence_config: Any = None,
     ):
         """Start serving; ``threaded=True`` runs the engine loop on a daemon
         thread and returns it (reference: vector_store.py:558-582).
-        ``with_scheduler``/``deadline_ms`` — see :meth:`build_server`."""
+        ``with_scheduler``/``deadline_ms``/``aux_endpoints`` — see
+        :meth:`build_server`.  ``persistence_config`` (a
+        ``pw.persistence.Config``) makes the server durable: with
+        ``PersistenceMode.OPERATOR_PERSISTING`` the live HBM index
+        checkpoints already-computed vectors per commit and warm-restarts
+        from them (zero re-embeddings) behind the ``/v1/health`` gate."""
         self.build_server(
             host=host, port=port,
             with_scheduler=with_scheduler, deadline_ms=deadline_ms,
+            aux_endpoints=aux_endpoints,
         )
         return run_with_cache(
             threaded=threaded,
             with_cache=with_cache,
             cache_backend=cache_backend,
             terminate_on_error=terminate_on_error,
+            persistence_config=persistence_config,
         )
 
 

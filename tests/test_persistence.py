@@ -227,6 +227,16 @@ while time.monotonic() < deadline:
     if sum(state.values()) >= int(expected_total):
         break
     time.sleep(0.1)
+# durability gate: the subscriber observes counts MID-step, strictly
+# before end_of_step writes the delta chunk and the commit record —
+# exiting on the count alone races the test's on-disk assertions.  Wait
+# for the durable artifacts, then kill suddenly as before.
+kv = pw.persistence.Backend.filesystem(pstore).storage
+deadline = time.monotonic() + 10
+while time.monotonic() < deadline:
+    if kv.list_keys("opstate/wordstate/chunk-") and kv.get("commit/record"):
+        break
+    time.sleep(0.05)
 with open(out_path, "w") as f:
     json.dump(state, f)
 os._exit(9)  # sudden termination, engine gets no chance to clean up
