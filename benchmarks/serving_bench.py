@@ -29,6 +29,20 @@ sequential single-client pass.  ``--mock`` swaps the MiniLM encoder for
 the deterministic hash embedder so the mode also runs in seconds on CPU.
 
 Run: ``JAX_PLATFORMS=cpu python benchmarks/serving_bench.py 120 --clients 8 --mock``
+
+Contention mode (``--clients N --ingest-load D``): the unified
+device-tick runtime's reason to exist (ISSUE 7) measured — N client
+threads hammer ``/v1/retrieve`` WHILE a bulk ingest driver feeds
+documents through an :class:`IngestPipeline` sharing the same device at
+a target rate of D docs/s.  Two passes: runtime ON (ingest chunks ride
+BULK_INGEST ticks, interactive preempts at tick granularity) and
+``PATHWAY_RUNTIME=0`` legacy (the ingest device thread free-runs against
+the serving loop).  Reports per-QoS-class p50/p99, ingest throughput
+alone vs contended (the retained share), and the runtime's preemption /
+starvation-share counters — the artifact that pins "serving p99
+survives ingest bursts".
+
+Run: ``JAX_PLATFORMS=cpu python benchmarks/serving_bench.py 48 --clients 4 --ingest-load 200 --mock``
 """
 
 from __future__ import annotations
@@ -231,7 +245,7 @@ def _make_embedder(mock: bool):
 
 
 def _serve_corpus(base_dir: str, tag: str, docs: list[str], mock: bool,
-                  scheduled: bool):
+                  scheduled: bool, embedder=None):
     """Build + start one server over its own corpus dir; wait until the
     full corpus answers.  Returns (client, first-doc probe)."""
     import pathway_tpu as pw
@@ -249,7 +263,9 @@ def _serve_corpus(base_dir: str, tag: str, docs: list[str], mock: bool,
         corpus, format="binary", mode="streaming", with_metadata=True,
         refresh_interval=0.2,
     )
-    vs = VectorStoreServer(table, embedder=_make_embedder(mock))
+    vs = VectorStoreServer(
+        table, embedder=embedder if embedder is not None else _make_embedder(mock)
+    )
     port = _free_port()
     vs.run_server(
         host="127.0.0.1", port=port, threaded=True, with_cache=False,
@@ -342,6 +358,12 @@ def _load_phase_subprocess(url: str, n_docs: int, clients: int,
 
 def _run_loadgen(url: str, n_docs: int, clients: int,
                  queries_per_client: int, pace_ms: float) -> None:
+    cpu = os.environ.get("SERVING_BENCH_LOADGEN_CPU")
+    if cpu and hasattr(os, "sched_setaffinity"):
+        # contention mode pins the server to one core (the mock
+        # "accelerator"); the load generator takes the other so client
+        # timing is not a casualty of server-side device saturation
+        os.sched_setaffinity(0, {int(cpu)})
     docs = _corpus(n_docs)
     from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient
 
@@ -445,12 +467,417 @@ def run_concurrent(n_docs: int, clients: int, queries_per_client: int,
     return out
 
 
+def _ingest_corpus(n: int, seed: int = 7) -> list[str]:
+    """Mixed-length synthetic docs for the bulk-ingest driver (two
+    short / one medium / one long per 4, like bench.py's headline mix)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    words = [f"ing{i:03d}" for i in range(400)]
+    sizes = [12, 12, 48, 96]
+    return [
+        f"Ingest doc {i}: " + " ".join(rng.choice(words, size=sizes[i % 4]))
+        for i in range(n)
+    ]
+
+
+def _ingest_encoder(mock: bool):
+    """The encoder the bulk driver contends with.  Mock mode uses a
+    small random-init encoder (real compute, seconds not minutes on
+    CPU); real mode the MiniLM-class model."""
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+
+    if mock:
+        import jax.numpy as jnp
+
+        return SentenceEncoder(
+            cfg=EncoderConfig(
+                vocab_size=2048, hidden_dim=64, num_layers=2, num_heads=4,
+                mlp_dim=128, max_len=128, dtype=jnp.float32,
+            ),
+            max_length=128,
+        )
+    return SentenceEncoder("all-MiniLM-L6-v2")
+
+
+class _IngestDriver:
+    """Feeds an IngestPipeline batches at a target docs/s, counting
+    completed documents so throughput can be windowed."""
+
+    def __init__(self, pipeline, docs: list[str], docs_per_s: float,
+                 batch: int = 32, flush_every: int = 16):
+        import threading
+
+        self.pipeline = pipeline
+        self.docs = docs
+        self.docs_per_s = docs_per_s
+        self.batch = batch
+        #: apply the staged device scatters every N batches (a real
+        #: ingest plane pays them; leaving them staged would understate
+        #: the contention AND grow HBM without bound)
+        self.flush_every = flush_every
+        self.completed = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _on_done(self, fut):
+        with self._lock:
+            try:
+                fut.result()
+                self.completed += self.batch
+            except Exception:  # noqa: BLE001 — counted, driver keeps going
+                self.errors += 1
+
+    def _run(self):
+        interval = self.batch / max(self.docs_per_s, 1e-9)
+        i = 0
+        n = len(self.docs)
+        next_at = time.monotonic()
+        while not self._stop.is_set():
+            texts = [self.docs[(i + j) % n] for j in range(self.batch)]
+            keys = [f"ing-{i + j}" for j in range(self.batch)]
+            try:
+                fut = self.pipeline.submit(texts, keys=keys)
+                fut.add_done_callback(self._on_done)
+            except RuntimeError:  # pipeline closed under us
+                return
+            i += self.batch
+            if self.flush_every and (i // self.batch) % self.flush_every == 0:
+                index = self.pipeline.index
+                if index is not None and hasattr(index, "apply_staged_budget"):
+                    try:
+                        # drain scatter debt in tick-sized doses — the
+                        # apply side of preemptible bulk ingest — and
+                        # SYNC it: async scatters would pile into the
+                        # device queue and stall the next serving search
+                        # behind them
+                        index.apply_staged_budget(4)
+                        import jax
+
+                        jax.block_until_ready(index.vectors)
+                    except Exception:  # noqa: BLE001 — bench keeps going
+                        pass
+            next_at += interval
+            delay = next_at - time.monotonic()
+            if delay > 0:
+                self._stop.wait(delay)
+            else:
+                next_at = time.monotonic()  # saturated: go flat out
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def window(self, seconds: float) -> float:
+        """docs/s completed over a fresh window."""
+        with self._lock:
+            before = self.completed
+        time.sleep(seconds)
+        with self._lock:
+            after = self.completed
+        return (after - before) / seconds
+
+    def rate_between(self, before: int, elapsed_s: float) -> float:
+        with self._lock:
+            return (self.completed - before) / max(elapsed_s, 1e-9)
+
+    def snapshot(self) -> int:
+        with self._lock:
+            return self.completed
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+
+def run_contention(n_docs: int, clients: int, queries_per_client: int,
+                   mock: bool, ingest_load: float,
+                   pace_ms: float = 0.0) -> dict:
+    """Ingest+serve contention A/B: runtime ON vs PATHWAY_RUNTIME=0.
+
+    Each phase runs in its OWN subprocess: measuring phase 2 while phase
+    1's server (engine loop, fs poller, webserver) is still alive in the
+    same process skews the A/B by a steady ~10 ms of stolen CPU on a
+    small container — observed before this split as a persistent
+    phase-order bias.  The persistent XLA compile cache keeps the
+    second child's warmup cheap."""
+    import subprocess
+
+    out: dict = {
+        "metric": "rag_serving_contention",
+        "n_docs": n_docs,
+        "clients": clients,
+        "queries_per_client": queries_per_client,
+        "pace_ms": pace_ms,
+        "mock_embedder": mock,
+        "ingest_load_docs_per_s": ingest_load,
+    }
+    for phase in ("legacy", "runtime"):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--contention-phase",
+             phase, str(n_docs), str(clients), str(queries_per_client),
+             str(pace_ms), str(ingest_load), "1" if mock else "0"],
+            capture_output=True, text=True, timeout=2400,
+        )
+        rec = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        if proc.returncode != 0 or rec is None:
+            out["error"] = (
+                f"{phase} phase failed (rc={proc.returncode}): "
+                f"{proc.stderr[-1500:]}"
+            )
+            return out
+        if "error" in rec:
+            out["error"] = f"{phase}: {rec['error']}"
+            return out
+        for meta_key in ("platform", "tick_tokens", "ingest_chunk_tokens",
+                        "min_share_bulk_ingest"):
+            if meta_key in rec:
+                out[meta_key] = rec.pop(meta_key)
+        out[phase] = rec
+    # the headline: how much the runtime shaves off the contended tail
+    out["contended_p99_speedup"] = round(
+        out["legacy"]["contended_p99_ms"]
+        / max(out["runtime"]["contended_p99_ms"], 1e-9),
+        2,
+    )
+    return out
+
+
+def run_contention_phase(phase: str, n_docs: int, clients: int,
+                         queries_per_client: int, mock: bool,
+                         ingest_load: float, pace_ms: float) -> dict:
+    """One contention phase (its own process — see run_contention)."""
+    import tempfile
+
+    import jax
+
+    from pathway_tpu import runtime as rt_mod
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+    from pathway_tpu.utils.compile_cache import enable_compile_cache
+    from pathway_tpu.xpacks.llm._ingest import IngestPipeline
+
+    fused = phase == "runtime"
+    enable_compile_cache()
+    platform = jax.devices()[0].platform
+    docs = _corpus(n_docs)
+    ingest_docs = _ingest_corpus(max(4 * int(ingest_load), 256))
+    # pace the runtime to the device: the tick token budget bounds how
+    # long an arriving query can wait behind in-flight lower-class work,
+    # so it must scale with device speed — a CPU "device" (mock mode)
+    # encodes ~3 orders slower than an MXU, so its ticks must be ~3
+    # orders smaller to keep the same preemption horizon in *time*
+    tick_tokens = int(os.environ.get(
+        "SERVING_BENCH_TICK_TOKENS", "1024" if platform == "cpu" else "16384"
+    ))
+    chunk_tokens = int(os.environ.get(
+        "SERVING_BENCH_INGEST_CHUNK_TOKENS",
+        "256" if platform == "cpu" else "4096",
+    ))
+    rt_mod.configure(tick_tokens=tick_tokens)
+    out_knobs = {"tick_tokens": tick_tokens, "ingest_chunk_tokens": chunk_tokens}
+    enc = _ingest_encoder(mock)
+    serve_enc = _ingest_encoder(mock) if mock else None
+    if mock:
+        # emulate ONE accelerator's serial command queue: every model
+        # dispatch (serving query encodes AND ingest chunk encodes)
+        # takes one device mutex.  A CPU core alone is a bad stand-in —
+        # the OS preempts compute at ms quanta, so an un-preemptible
+        # 100 ms device launch (the thing a real chip's queue gives you,
+        # and the thing the runtime exists to keep OFF the critical
+        # path) never materializes without it.
+        import threading as _threading
+
+        device_mutex = _threading.Lock()
+
+        def _serialize_apply(e):
+            raw = e._apply
+
+            def locked(*a, **k):
+                import jax as _jax
+
+                with device_mutex:
+                    out = raw(*a, **k)
+                    # held through COMPLETION: a real chip is occupied
+                    # until the launch finishes — async dispatch would
+                    # release the "device" in ~1 ms and let the OS
+                    # overlap compute, hiding exactly the occupancy the
+                    # A/B measures
+                    _jax.block_until_ready(out)
+                    return out
+
+            for attr in ("_cache_size",):
+                if hasattr(raw, attr):
+                    setattr(locked, attr, getattr(raw, attr))
+            e._apply = locked
+
+        _serialize_apply(enc)
+        _serialize_apply(serve_enc)
+    # warm the CHUNKED shapes off the measured path, through the same
+    # pipeline + max_tokens the drivers use (the legacy phase runs
+    # first — without this it would eat the compiles the runtime phase
+    # then reuses from the cache, invalidating the A/B: observed 64 vs
+    # 960 docs/s "alone" rates from compile asymmetry alone)
+    for warm_tokens in (chunk_tokens, None):  # both phases' shape sets
+        with IngestPipeline(enc, use_runtime=False,
+                            max_tokens=warm_tokens) as warm:
+            warm.submit(ingest_docs[:128]).result(timeout=600)
+    res: dict = {
+        "platform": platform,
+        "min_share_bulk_ingest": rt_mod.runtime_settings()["min_share"][
+            rt_mod.QoS.BULK_INGEST
+        ],
+        **out_knobs,
+    }
+    rt_mod.configure(enabled=fused)
+    with tempfile.TemporaryDirectory() as base:
+        # contention mode serves with a REAL (mock-mode: small
+        # random-init) encoder, never the hash fake: the story under
+        # test is device-vs-device arbitration — query embeds and
+        # ingest chunks contending for the same accelerator.  A
+        # host-trivial fake embedder would measure GIL sharing, not
+        # the runtime's tick policy.
+        serve_embedder = None
+        if mock:
+            from pathway_tpu.xpacks.llm.embedders import (
+                SentenceTransformerEmbedder,
+            )
+
+            serve_embedder = SentenceTransformerEmbedder(encoder=serve_enc)
+        client = _serve_corpus(base, phase, docs, mock, scheduled=True,
+                               embedder=serve_embedder)
+        for i in range(8):  # warm serving path + small-batch buckets
+            client.query(docs[i % n_docs], k=10)
+        for c in (2, 4, clients):
+            _load_phase(client, docs, min(c, clients), 2)
+
+        reps = int(os.environ.get("SERVING_BENCH_REPS", "1"))
+
+        def _measured_window() -> tuple[float, float, int, float]:
+            """One measured window = median p50/p99 over ``reps``
+            loadgen passes (SERVING_BENCH_REPS, default 1 for the CI
+            smoke; the banked artifact uses 3).  Median keeps a
+            SYSTEMATIC stall (it shows in every pass) while dropping
+            the one-off scheduling hiccups a 2-core container
+            produces — best-of-N would anti-select the stalls, a
+            single pass is hostage to the hiccups."""
+            p50s, p99s = [], []
+            errs = 0
+            elapsed = 0.0
+            for _rep in range(reps):
+                t0 = time.monotonic()
+                lat, errors = _load_phase_subprocess(
+                    client.url, n_docs, clients, queries_per_client,
+                    pace_ms,
+                )
+                elapsed += time.monotonic() - t0
+                if len(lat) < clients * queries_per_client * 0.8:
+                    raise RuntimeError(f"only {len(lat)} queries succeeded")
+                errs += errors
+                p50s.append(_pctl(lat, 0.50))
+                p99s.append(_pctl(lat, 0.99))
+            p50s.sort()
+            p99s.sort()
+            return (
+                p50s[len(p50s) // 2], p99s[len(p99s) // 2], errs, elapsed,
+            )
+
+        # 1) no-ingest interactive baseline
+        try:
+            p50, p99, errors, _el = _measured_window()
+        except RuntimeError as exc:
+            return {"error": f"baseline {exc}"}
+        res["baseline_p50_ms"] = round(p50, 1)
+        res["baseline_p99_ms"] = round(p99, 1)
+        # 2) bulk ingest driver on the same device
+        # system-vs-system: the legacy pipeline dispatches its
+        # natural bucket-sized launches (PR 5 behavior — one
+        # ~max_batch×seq launch occupies the device un-preemptibly);
+        # the runtime phase slices ingest into tick-sized chunks,
+        # which IS the preemptibility mechanism under test
+        pipeline = IngestPipeline(
+            enc,
+            DeviceKnnIndex(dim=enc.dim, capacity=4096),
+            use_runtime=fused,
+            max_tokens=chunk_tokens if fused else None,
+        )
+        driver = _IngestDriver(
+            pipeline, ingest_docs, ingest_load,
+            batch=32,  # one submission = one bucket-sized legacy
+            # launch (the un-preemptible unit the runtime slices);
+            # larger batches mostly measure the GIL cost of
+            # tokenizing them, which both phases pay identically
+            flush_every=1,  # apply each batch's staged scatters as
+            # it lands — many tick-sized applies, never one
+            # 100+-slice burst poisoning both phases' tails
+        ).start()
+        res["ingest_docs_per_sec_alone"] = round(
+            driver.window(2.0 if mock else 4.0), 1
+        )
+        if fused:
+            rt_before = rt_mod.get_runtime().stats()
+        # 3) interactive load UNDER the ingest burst
+        before = driver.snapshot()
+        try:
+            p50, p99, errors, elapsed = _measured_window()
+        except RuntimeError as exc:
+            return {"error": f"contended {exc}"}
+        res["contended_p50_ms"] = round(p50, 1)
+        res["contended_p99_ms"] = round(p99, 1)
+        res["contended_errors"] = errors
+        res["ingest_docs_per_sec_contended"] = round(
+            driver.rate_between(before, elapsed), 1
+        )
+        alone = res["ingest_docs_per_sec_alone"]
+        res["ingest_share_retained"] = round(
+            res["ingest_docs_per_sec_contended"] / alone, 3
+        ) if alone else None
+        res["p99_inflation"] = round(
+            res["contended_p99_ms"] / max(res["baseline_p99_ms"], 1e-9), 2
+        )
+        driver.stop()
+        pipeline.close()
+        res["ingest_errors"] = driver.errors
+        if fused:
+            rt_after = rt_mod.get_runtime().stats()
+            res["preemptions"] = (
+                rt_after["preemptions_total"] - rt_before["preemptions_total"]
+            )
+            res["bulk_share_mean"] = (
+                round(rt_after["bulk_share_mean"], 4)
+                if rt_after["bulk_share_mean"] is not None
+                else None
+            )
+            res["interactive_completed"] = rt_after["classes"]["interactive"][
+                "completed_total"
+            ]
+            res["bulk_completed"] = rt_after["classes"]["bulk_ingest"][
+                "completed_total"
+            ]
+    return res
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--loadgen":
         url, n_docs_s, clients_s, qpc_s, pace_s = sys.argv[2:7]
         _run_loadgen(url, int(n_docs_s), int(clients_s), int(qpc_s),
                      float(pace_s))
         sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "--contention-phase":
+        phase_s, n_s, clients_s, qpc_s, pace_s, load_s, mock_s = sys.argv[2:9]
+        rec = run_contention_phase(
+            phase_s, int(n_s), int(clients_s), int(qpc_s),
+            mock_s == "1", float(load_s), float(pace_s),
+        )
+        print(json.dumps(rec))
+        sys.exit(0 if "error" not in rec else 1)
     args = [a for a in sys.argv[1:]]
     clients = 0
     qpc = 25
@@ -471,12 +898,21 @@ if __name__ == "__main__":
         i = args.index("--pace-ms")
         pace = float(args[i + 1])
         del args[i : i + 2]
+    ingest_load = 0.0
+    if "--ingest-load" in args:
+        i = args.index("--ingest-load")
+        ingest_load = float(args[i + 1])
+        del args[i : i + 2]
     n = int(args[0]) if args else 120
-    out = (
-        run_concurrent(n, clients, qpc, mock, pace_ms=pace)
-        if clients > 0
-        else run(n)
-    )
+    if ingest_load > 0:
+        if clients <= 0:
+            clients = 8
+        out = run_contention(n, clients, qpc, mock, ingest_load,
+                             pace_ms=pace)
+    elif clients > 0:
+        out = run_concurrent(n, clients, qpc, mock, pace_ms=pace)
+    else:
+        out = run(n)
     out["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     line = json.dumps(out)
     print(line)

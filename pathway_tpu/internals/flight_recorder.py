@@ -12,7 +12,13 @@ buffer of spans from every plane:
   tracing middleware + ``xpacks/llm/_scheduler.py``),
 * engine operator flushes (``internals/engine.py`` ``_flush_node``),
 * connector commits (``io/streaming.py``),
-* scheduler device ticks, breaker transitions, injected faults.
+* scheduler device ticks, breaker transitions, injected faults,
+* unified-runtime ticks (``pathway_tpu/runtime/executor.py``): one
+  ``tick:runtime`` span per composed tick (category ``runtime``, attrs:
+  occupancy, token mass, per-QoS-class counts, ``preempted``) plus the
+  per-group ``tick:<label>`` execute spans (category ``scheduler``,
+  now carrying a ``qos`` attr — filter ``/v1/debug/traces?category=``
+  on either to see how interactive/ingest work interleaves).
 
 ``GET /v1/debug/traces`` (every webserver) filters the ring by trace id /
 duration floor and the ``format=perfetto`` exporter dumps Chrome-tracing

@@ -195,6 +195,16 @@ class HealthRegistry:
                 snap["freshness"] = freshness
         except Exception:  # noqa: BLE001 — health must never raise
             pass
+        # unified device-tick runtime: per-QoS-class queue/tick state —
+        # read-only (a health probe must never spawn the runtime thread)
+        try:
+            from ..runtime import runtime_stats_if_active
+
+            runtime_stats = runtime_stats_if_active()
+            if runtime_stats is not None:
+                snap["runtime"] = runtime_stats
+        except Exception:  # noqa: BLE001 — health must never raise
+            pass
         try:
             from ..testing import faults
 

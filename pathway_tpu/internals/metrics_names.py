@@ -47,6 +47,38 @@ METRICS: dict[str, tuple[str, str]] = {
     "pathway_scheduler_batch_occupancy_max": ("gauge", "largest batch executed"),
     "pathway_scheduler_batch_occupancy_mean": ("gauge", "mean batch occupancy"),
     "pathway_scheduler_wait_ms": ("histogram", "queue wait before dispatch"),
+    # unified device-tick runtime (pathway_tpu/runtime/executor.py) —
+    # every series carries a qos label (interactive/llm_rerank/bulk_ingest)
+    # except the tick-level families
+    "pathway_runtime_submitted_total": ("counter", "work items admitted per QoS class"),
+    "pathway_runtime_completed_total": ("counter", "work items completed per QoS class"),
+    "pathway_runtime_failed_total": ("counter", "work items failed per QoS class"),
+    "pathway_runtime_shed_deadline_total": (
+        "counter",
+        "items shed past deadline per QoS class",
+    ),
+    "pathway_runtime_admission_rejected_total": (
+        "counter",
+        "sheddable admissions refused at the class queue-depth target",
+    ),
+    "pathway_runtime_inline_total": (
+        "counter",
+        "re-entrant submits executed inline inside the running tick",
+    ),
+    "pathway_runtime_queue_depth": ("gauge", "current per-class queue depth"),
+    "pathway_runtime_queue_depth_max": ("gauge", "high-watermark per-class queue depth"),
+    "pathway_runtime_ticks_total": ("counter", "device ticks composed and executed"),
+    "pathway_runtime_preemptions_total": (
+        "counter",
+        "ticks where interactive work displaced queued lower-class work",
+    ),
+    "pathway_runtime_wait_ms": ("histogram", "per-class queue wait before dispatch"),
+    "pathway_runtime_tick_occupancy": ("histogram", "work items per device tick"),
+    "pathway_runtime_tick_tokens": ("histogram", "estimated token mass per device tick"),
+    "pathway_runtime_starvation_share": (
+        "histogram",
+        "bulk-ingest share of contended ticks (the starvation bound, observed)",
+    ),
     # circuit breakers (xpacks/llm/_breaker.py)
     "pathway_breaker_state": ("gauge", "0=closed 1=half_open 2=open"),
     "pathway_breaker_trips_total": ("counter", "closed/half_open -> open transitions"),

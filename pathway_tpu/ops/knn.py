@@ -20,6 +20,7 @@ The multi-device sharded variant lives in ``pathway_tpu/parallel/index.py``.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 from typing import Any, Hashable, Sequence
 
@@ -29,7 +30,22 @@ import numpy as np
 
 from .topk import topk_search
 
-__all__ = ["DeviceKnnIndex"]
+__all__ = ["DeviceKnnIndex", "upsert_slice_rows"]
+
+
+def upsert_slice_rows() -> int:
+    """Row cap per staged device scatter (``PATHWAY_UPSERT_SLICE_ROWS``,
+    default 1024 — the largest dispatch batch bucket).  Device batches
+    bigger than this are staged as multiple bounded slices, so (a) the
+    scatter compile set stays on the bounded grid a jumbo bulk load
+    would otherwise blow past, and (b) every individual scatter dispatch
+    is tick-sized: under the unified runtime a bulk backfill becomes a
+    sequence of bounded device steps instead of one monopolizing launch."""
+    try:
+        n = int(os.environ.get("PATHWAY_UPSERT_SLICE_ROWS", "1024"))
+    except ValueError:
+        n = 1024
+    return max(n, 1)
 
 
 class DeviceKnnIndex:
@@ -176,7 +192,19 @@ class DeviceKnnIndex:
                     slots[prev] = -1
                 row_of_slot[slot] = j
                 slots[j] = slot
-            self._staged_device.append((slots, vectors))
+            # tick-granularity staging: bound each staged scatter at
+            # upsert_slice_rows() rows (slicing a device array is lazy —
+            # no host round trip); FIFO order within the batch preserves
+            # last-write-wins exactly
+            step = upsert_slice_rows()
+            n = vectors.shape[0]
+            if n <= step:
+                self._staged_device.append((slots, vectors))
+            else:
+                for s in range(0, n, step):
+                    self._staged_device.append(
+                        (slots[s : s + step], vectors[s : s + step])
+                    )
 
     def remove(self, key: Hashable) -> None:
         with self._lock:
@@ -240,6 +268,43 @@ class DeviceKnnIndex:
         self.free = list(range(new_capacity - 1, len(live_slots) - 1, -1))
         self._place()
 
+    def apply_staged_budget(self, max_entries: int = 8) -> int:
+        """Apply up to ``max_entries`` staged device batches NOW (oldest
+        first) and return how many were applied.
+
+        Incremental, tick-sized flushing for bulk backfills: a search
+        still applies everything pending (as-of-now semantics are
+        untouched — staged rows stay invisible either way until the
+        valid-mask scatter in :meth:`_apply_staged` runs), but a bulk
+        ingest driver can drain its scatter debt in bounded doses
+        between searches instead of handing the next query one
+        100-dispatch apply burst.  FIFO order is preserved, so
+        last-write-wins semantics against later host writes hold."""
+        with self._lock:
+            from ..testing import faults
+
+            if faults.enabled and self._staged_device:
+                faults.perturb("device.upsert")
+            n = 0
+            while self._staged_device and n < max_entries:
+                self._apply_device_entry(*self._staged_device.pop(0))
+                n += 1
+            return n
+
+    def _apply_device_entry(self, slots: np.ndarray, vals: Any) -> None:
+        """Scatter ONE staged device batch into the matrix.  Pad rows
+        (slot -1) scatter out of bounds and are dropped on device; the
+        OOB index is resolved at apply time — capacity may have grown
+        since staging.  Shared by the search-time full apply and the
+        incremental budget apply so their numerics can never diverge."""
+        idx = np.where(slots >= 0, slots, self.capacity).astype(np.int32)
+        self.vectors = _scatter_rows_dropping(
+            self.vectors,
+            jnp.asarray(idx),
+            vals,
+            normalize=(self.metric == "cos"),
+        )
+
     def _apply_staged(self) -> None:
         if (
             not self._staged_set
@@ -261,16 +326,7 @@ class DeviceKnnIndex:
         # landed later than a device batch for the same slot wins, and
         # upsert_batch already evicts older host entries for its slots
         for slots, vals in self._staged_device:
-            # pad rows (slot -1) scatter out of bounds and are dropped on
-            # device; resolve the OOB index at apply time — capacity may
-            # have grown since staging
-            idx = np.where(slots >= 0, slots, self.capacity).astype(np.int32)
-            self.vectors = _scatter_rows_dropping(
-                self.vectors,
-                jnp.asarray(idx),
-                vals,
-                normalize=(self.metric == "cos"),
-            )
+            self._apply_device_entry(slots, vals)
         self._staged_device.clear()
         if self._staged_set:
             idx = np.fromiter(self._staged_set.keys(), dtype=np.int32)

@@ -23,6 +23,20 @@ Every stage records flight-recorder spans (``tokenize`` / ``h2d`` /
 ``pathway_embed_padding_efficiency``.  Under ``PATHWAY_FAULTS`` chaos
 the device stage honors the ``embedder`` site: an injected failure
 fails THAT batch's future and the pipeline keeps draining.
+
+PR 7: with the unified device-tick runtime enabled (default,
+``PATHWAY_RUNTIME=1``) the device worker no longer touches the device
+itself — each prepared chunk (one bounded ``bb×seq`` launch) is
+submitted to the shared executor as a ``BULK_INGEST``-class work item
+whose token estimate is the chunk's padded token mass.  Interactive
+serving ticks preempt the backlog at tick granularity (a query never
+waits behind more than the chunk already on the device) while the
+runtime's starvation bound guarantees ingest forward progress under
+sustained query load.  Upsert staging (host-side bookkeeping; the
+scatter itself runs at the next search) stays on the worker thread so a
+failed chunk still fails its whole batch before anything is staged.
+``PATHWAY_RUNTIME=0`` (or ``use_runtime=False``) restores the in-thread
+device loop for A/B — the two paths are bit-identical by test.
 """
 
 from __future__ import annotations
@@ -82,14 +96,27 @@ class IngestPipeline:
         *,
         depth: int | None = None,
         max_tokens: int | None = None,
+        use_runtime: bool | None = None,
     ):
         from ...models.encoder import embed_max_tokens
+        from ...runtime import WorkGroup, runtime_enabled
 
         self.encoder = encoder
         self.index = index
         self.depth = depth if depth is not None else ingest_pipeline_depth()
         self.max_tokens = (
             max_tokens if max_tokens is not None else embed_max_tokens()
+        )
+        #: device work rides the unified runtime as BULK_INGEST chunks
+        #: (None = follow the global PATHWAY_RUNTIME setting)
+        self.use_runtime = (
+            runtime_enabled() if use_runtime is None else use_runtime
+        )
+        # max_batch=1: every prepared chunk is its own device dispatch
+        # AND its own failure domain — one poisoned chunk must not fail
+        # another pipeline batch sharing the tick
+        self._encode_group = WorkGroup(
+            "ingest-encode", self._encode_chunk_on_runtime, max_batch=1
         )
         self._in: queue.Queue = queue.Queue()
         # the hand-off: host worker blocks here once it is `depth`
@@ -203,16 +230,62 @@ class IngestPipeline:
             self._ready.put(item)  # blocks at `depth` batches ahead
 
     # -- stage 2: device transfer + encode + upsert ---------------------
-    def _device_loop(self) -> None:
+    def _encode_chunk_on_runtime(self, payloads: list) -> list:
+        """BULK_INGEST batch handler (runtime executor thread): one
+        prepared chunk per call (``max_batch=1``) — H2D + encode, the
+        DEVICE output returned as-is so upsert staging keeps the
+        embed→upsert path device-resident.
+
+        The chunk's device work is SYNCHRONIZED before the tick ends:
+        jax dispatches are async, so returning unfinished work would
+        let a bulk backlog pile into the device queue and the next
+        tick's interactive dispatch would wait behind every queued
+        chunk anyway — priority inversion at the device-queue level
+        (observed as 300+ ms serving `search` stages behind a 64-chunk
+        async backlog).  One tick in flight at a time is the executor's
+        whole contract with the device."""
+        assert len(payloads) == 1
+        out = self._encode_chunk(*payloads[0])
+        import jax
+
+        jax.block_until_ready(out)
+        return [out]
+
+    def _encode_chunk(self, ids, mask, tids) -> Any:
         import jax.numpy as jnp
 
+        from ...internals.flight_recorder import record_span
+
+        enc = self.encoder
+        wall = time.time()
+        t0 = time.monotonic()
+        args = [jnp.asarray(ids), jnp.asarray(mask)]
+        if tids is not None:
+            args.append(jnp.asarray(tids))
+        if getattr(enc, "mesh", None) is not None:
+            import jax
+
+            args = [jax.device_put(a, enc._data_sharding) for a in args]
+        record_span(
+            "h2d", "ingest", wall, (time.monotonic() - t0) * 1000.0,
+            attrs={"chunks": 1},
+        )
+        wall = time.time()
+        t0 = time.monotonic()
+        out = enc._apply(enc.params, *args)
+        record_span(
+            "encode", "ingest", wall, (time.monotonic() - t0) * 1000.0,
+            attrs={"rows": int(np.asarray(ids).shape[0])},
+        )
+        return out
+
+    def _device_loop(self) -> None:
         from ...internals.flight_recorder import (
             record_ingest_docs,
             record_padding,
             record_span,
         )
 
-        enc = self.encoder
         while True:
             item = self._ready.get()
             if item is _SENTINEL:
@@ -227,35 +300,37 @@ class IngestPipeline:
                 record_padding(
                     item.stats["real_tokens"], item.stats["padded_tokens"]
                 )
-                wall = time.time()
-                t0 = time.monotonic()
-                device_args = []
-                for ids, mask, tids, rows in item.prepared:
-                    args = [jnp.asarray(ids), jnp.asarray(mask)]
-                    if tids is not None:
-                        args.append(jnp.asarray(tids))
-                    if getattr(enc, "mesh", None) is not None:
-                        import jax
+                if self.use_runtime:
+                    # every prepared chunk is one BULK_INGEST work item:
+                    # tokens = its padded token mass, coalesce 0 (a
+                    # backlog never waits for tick-mates).  Interactive
+                    # ticks slot in between chunks; the min-share bound
+                    # keeps this batch progressing under query floods.
+                    from ...runtime import QoS, get_runtime
 
-                        args = [
-                            jax.device_put(a, enc._data_sharding) for a in args
-                        ]
-                    device_args.append((args, rows))
-                record_span(
-                    "h2d", "ingest", wall, (time.monotonic() - t0) * 1000.0,
-                    attrs={"chunks": len(device_args)},
-                )
-                wall = time.time()
-                t0 = time.monotonic()
-                outs = [
-                    (enc._apply(enc.params, *args), rows)
-                    for args, rows in device_args
-                ]
-                record_span(
-                    "encode", "ingest", wall,
-                    (time.monotonic() - t0) * 1000.0,
-                    attrs={"docs": len(item.texts)},
-                )
+                    rt = get_runtime()
+                    futs = [
+                        (
+                            rt.submit(
+                                self._encode_group,
+                                (ids, mask, tids),
+                                qos=QoS.BULK_INGEST,
+                                tokens=int(np.asarray(ids).size),
+                                coalesce_s=0.0,
+                            ),
+                            rows,
+                        )
+                        for ids, mask, tids, rows in item.prepared
+                    ]
+                    # all chunks must encode before anything stages:
+                    # a failed chunk fails the WHOLE batch pre-upsert,
+                    # exactly like the legacy single-thread path
+                    outs = [(f.result(), rows) for f, rows in futs]
+                else:
+                    outs = [
+                        (self._encode_chunk(ids, mask, tids), rows)
+                        for ids, mask, tids, rows in item.prepared
+                    ]
                 if self.index is not None:
                     wall = time.time()
                     t0 = time.monotonic()

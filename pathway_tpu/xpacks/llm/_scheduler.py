@@ -26,6 +26,15 @@ accelerator:
 Observability (queue depth, batch occupancy, wait-time histogram,
 deadline drops) registers with ``internals/monitoring.py`` and renders on
 the OpenMetrics ``/status`` endpoint as ``pathway_scheduler_*`` series.
+
+PR 7: by default (``PATHWAY_RUNTIME=1``) :class:`ServingScheduler` is a
+**thin facade over the unified device-tick runtime**
+(:mod:`pathway_tpu.runtime`): submissions execute on the shared QoS
+executor as ``INTERACTIVE`` work (so they preempt bulk-ingest chunks at
+tick granularity), while this class keeps its legacy per-instance
+counters, admission cap and ``pathway_scheduler_*`` series via observer
+hooks.  ``PATHWAY_RUNTIME=0`` restores the self-contained device-step
+loop below for A/B.
 """
 
 from __future__ import annotations
@@ -35,9 +44,19 @@ import os
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Callable, Sequence
+from typing import Any
 
 import numpy as np
+
+from ...runtime import (
+    AdmissionRefused,
+    DeadlineExceeded,
+    QoS,
+    WorkGroup,
+    budget_chunks as _budget_chunks,
+    get_runtime,
+    runtime_enabled,
+)
 
 __all__ = [
     "ServingScheduler",
@@ -53,69 +72,13 @@ __all__ = [
 ]
 
 
-class DeadlineExceeded(Exception):
-    """The request was shed: its deadline passed before dispatch.
-
-    ``retry_after_s`` is the server's backoff hint (HTTP ``Retry-After``).
-    """
-
-    def __init__(self, message: str, retry_after_s: float = 1.0):
-        super().__init__(message)
-        self.retry_after_s = retry_after_s
-
-
-class SchedulerOverloaded(DeadlineExceeded):
-    """Admission refused: the queue is at capacity."""
+#: admission refused: the queue is at capacity (the runtime's exception,
+#: kept under its historical serving name)
+SchedulerOverloaded = AdmissionRefused
 
 
 class ServingNotReady(DeadlineExceeded):
     """The live index is not lowered yet (engine still starting up)."""
-
-
-class WorkGroup:
-    """One batchable kind of device work.
-
-    ``batch_fn(list_of_payloads) -> list_of_results`` runs on the
-    scheduler thread; items of the same group drained in one tick execute
-    as one call (chunked at ``max_batch``).
-    """
-
-    def __init__(
-        self,
-        label: str,
-        batch_fn: Callable[[list], Sequence],
-        max_batch: int = 1024,
-    ):
-        self.label = label
-        self.batch_fn = batch_fn
-        self.max_batch = max_batch
-
-
-def _budget_chunks(group: "WorkGroup", items: list["_WorkItem"]) -> list[list["_WorkItem"]]:
-    """Split a tick's items into execute chunks: ``max_batch`` count cap
-    plus, when the group declares one (``AsyncMicroBatcher.max_tokens``),
-    a token-mass cap so a run of long documents dispatches in
-    length-adapted batches.  Every chunk carries at least one item."""
-    max_tokens = getattr(group, "max_tokens", None)
-    estimate = getattr(group, "token_estimate", None)
-    if max_tokens is None or estimate is None:
-        return [
-            items[start : start + group.max_batch]
-            for start in range(0, len(items), group.max_batch)
-        ]
-    chunks: list[list[_WorkItem]] = []
-    cur: list[_WorkItem] = []
-    cur_tokens = 0
-    for it in items:
-        t = estimate(it.payload)
-        if cur and (len(cur) >= group.max_batch or cur_tokens + t > max_tokens):
-            chunks.append(cur)
-            cur, cur_tokens = [], 0
-        cur.append(it)
-        cur_tokens += t
-    if cur:
-        chunks.append(cur)
-    return chunks
 
 
 class _WorkItem:
@@ -158,6 +121,9 @@ class ServingScheduler:
         self._cv = threading.Condition()
         self._queue: list[_WorkItem] = []
         self._thread: threading.Thread | None = None
+        #: facade mode: items currently enqueued on the shared runtime
+        #: on this scheduler's behalf (legacy queue-depth/admission view)
+        self._runtime_pending = 0
         # metrics — guarded by _mx, not _cv: the tick updates them while
         # submitters hold _cv
         self._mx = threading.Lock()
@@ -211,6 +177,43 @@ class ServingScheduler:
             sheddable = deadline_s is not None
         if trace is not None and not trace.sampled:
             trace = None
+        if runtime_enabled():
+            # facade path: execute on the unified QoS runtime as
+            # INTERACTIVE work.  This scheduler keeps its legacy
+            # admission cap (max_queue over ITS OWN pending items) and
+            # its pathway_scheduler_* counters via the observer hooks
+            # below; re-entrant submits from the runtime thread are
+            # handled by the runtime itself (inline, inheriting the
+            # running tick's class — no class inversion, no deadlock).
+            rt = get_runtime()
+            if (
+                sheddable
+                and not rt.on_runtime_thread()
+                and self._runtime_pending >= self.max_queue
+            ):
+                with self._mx:
+                    self._counters["shed_queue_total"] += 1
+                fut: Future = Future()
+                fut.set_exception(
+                    SchedulerOverloaded(
+                        f"scheduler queue full ({self.max_queue} pending)",
+                        retry_after_s=self.retry_after_s,
+                    )
+                )
+                return fut
+            with self._mx:
+                self._counters["submitted_total"] += 1
+            return rt.submit(
+                group,
+                payload,
+                qos=QoS.INTERACTIVE,
+                deadline_s=deadline_s,
+                sheddable=sheddable,
+                trace=trace,
+                coalesce_s=self.max_wait_ms / 1000.0,
+                observer=self,
+                retry_after_s=self.retry_after_s,
+            )
         fut: Future = Future()
         if self._thread is not None and threading.current_thread() is self._thread:
             # re-entrant submit from inside a batch handler (e.g. a
@@ -269,7 +272,56 @@ class ServingScheduler:
             )
         )
 
-    # -- device-step loop ------------------------------------------------
+    def executor_alive(self) -> bool:
+        """Is the device-step executor serving this scheduler alive?
+        Facade mode: the shared runtime's tick thread; legacy mode: this
+        scheduler's own loop thread.  (The containment tests' "the loop
+        survived the fault" observable, architecture-neutral.)"""
+        if runtime_enabled():
+            rt = get_runtime()
+            return rt._thread is not None and rt._thread.is_alive()
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- runtime observer hooks (facade mode) ----------------------------
+    # The shared runtime calls these (never under its condition variable)
+    # so this scheduler's legacy per-instance counters — queue depth,
+    # wait histogram, occupancy, shed/completed/failed — stay truthful
+    # while the actual draining happens on the unified executor.
+    def _obs_enqueued(self) -> None:
+        with self._mx:
+            self._runtime_pending += 1
+            if self._runtime_pending > self._queue_depth_max:
+                self._queue_depth_max = self._runtime_pending
+
+    def _obs_drained(self) -> None:
+        with self._mx:
+            self._runtime_pending -= 1
+
+    def _obs_wait(self, wait_ms: float) -> None:
+        self._observe_wait(wait_ms)
+
+    def _obs_shed_deadline(self) -> None:
+        with self._mx:
+            self._counters["shed_deadline_total"] += 1
+
+    def _obs_refused(self) -> None:
+        with self._mx:
+            self._counters["shed_queue_total"] += 1
+
+    def _obs_batch(self, n: int) -> None:
+        with self._mx:
+            self._counters["batches_total"] += 1
+            if n > 1:
+                self._counters["multi_item_batches_total"] += 1
+            self._occupancy_sum += n
+            if n > self._occupancy_max:
+                self._occupancy_max = n
+
+    def _obs_done(self, n: int, ok: bool) -> None:
+        with self._mx:
+            self._counters["completed_total" if ok else "failed_total"] += n
+
+    # -- device-step loop (legacy, PATHWAY_RUNTIME=0) --------------------
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
@@ -411,6 +463,9 @@ class ServingScheduler:
         with self._cv:
             depth = len(self._queue)
         with self._mx:
+            # facade mode: pending items live on the shared runtime's
+            # interactive queue, tracked per scheduler via the hooks
+            depth += self._runtime_pending
             batches = self._counters["batches_total"]
             return {
                 **self._counters,
@@ -669,7 +724,16 @@ class RetrievePlane:
         )
         if max_batch is None:
             max_batch = self.scheduler.max_batch
-        self.group = WorkGroup(label, self._batch, max_batch=max_batch)
+        from ._utils import estimate_tokens
+
+        # token estimate = the query text's mass: the runtime's tick
+        # budget then sees retrieve work at the same scale as embed work
+        self.group = WorkGroup(
+            label,
+            self._batch,
+            max_batch=max_batch,
+            token_estimate=lambda payload: estimate_tokens(payload[0]),
+        )
 
     @property
     def deadline_ms(self) -> float | None:

@@ -15,6 +15,11 @@ import asyncio
 import threading
 from typing import Any, Callable, Sequence
 
+# ONE token-estimate implementation for every budget-batching plane —
+# it lives in the runtime package now (the unified executor composes
+# ticks from the same estimates); re-exported here for back-compat
+from ...runtime import estimate_tokens
+
 __all__ = [
     "coerce_str",
     "estimate_tokens",
@@ -30,15 +35,6 @@ def coerce_str(value: Any) -> str:
     if isinstance(value, bytes):
         return value.decode("utf-8", errors="replace")
     return str(value)
-
-
-def estimate_tokens(item: Any) -> int:
-    """Cheap token-mass estimate for budget batching: whitespace words
-    + CLS/SEP for text (wordpiece splits only lengthen it, which errs on
-    the safe — smaller — batch side), 1 for opaque payloads (images)."""
-    if isinstance(item, (str, bytes)):
-        return len(coerce_str(item).split()) + 2
-    return 1
 
 
 def merge_filter_exprs(
@@ -216,12 +212,18 @@ class AsyncMicroBatcher:
     the same device batch — the bucketed-padding path of
     ``models/encoder.py`` then compiles once per shape bucket.
 
-    When the serving scheduler is enabled (the default,
-    ``xpacks/llm/_scheduler.py``) calls delegate to the shared scheduler
-    instead: work coalesces ACROSS engine steps and REST planes on its
-    ``max_wait_ms`` window, not just within one loop round, and every
-    device dispatch serializes on the scheduler thread.  ``use_scheduler``
-    pins the behavior per batcher (None = follow the global setting).
+    When shared-executor serving is enabled (the default) calls delegate
+    to the process-wide executor instead: work coalesces ACROSS engine
+    steps and REST planes, not just within one loop round, and every
+    device dispatch serializes on the executor thread.  Under the
+    unified device-tick runtime (``PATHWAY_RUNTIME=1``, default) the
+    batcher submits its items as ``LLM_RERANK``-class work — below
+    interactive serving ticks, above bulk ingest; with
+    ``PATHWAY_RUNTIME=0`` it delegates to the legacy
+    :class:`~pathway_tpu.xpacks.llm._scheduler.ServingScheduler` loop.
+    ``use_scheduler`` pins the behavior per batcher (None = follow the
+    global ``PATHWAY_SERVING_SCHEDULER`` setting; False = per-loop
+    micro-batching only).
     """
 
     def __init__(
@@ -259,7 +261,22 @@ class AsyncMicroBatcher:
         return get_scheduler() if use else None
 
     async def call(self, item: Any) -> Any:
-        sched = self._scheduler()
+        use = self.use_scheduler
+        if use is None:
+            from ._scheduler import scheduler_enabled
+
+            use = scheduler_enabled()
+        if use:
+            from ...runtime import QoS, get_runtime, runtime_enabled
+
+            if runtime_enabled():
+                # engine-plane embed/rerank/LLM-guard work rides the
+                # unified runtime as LLM_RERANK: below interactive
+                # serving, above bulk ingest, never shed (no deadline)
+                return await get_runtime().submit_async(
+                    self, item, qos=QoS.LLM_RERANK
+                )
+        sched = self._scheduler() if use else None
         if sched is not None:
             # engine-plane work carries no deadline: it is never shed
             return await sched.submit_async(self, item)

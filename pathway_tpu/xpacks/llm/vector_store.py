@@ -307,6 +307,13 @@ class VectorStoreServer:
         micro-batch cadence — with ``deadline_ms``-based shedding
         (503 + Retry-After).  Statistics/inputs stay engine-routed.
 
+        Under the unified device-tick runtime (``PATHWAY_RUNTIME=1``,
+        the default) those ticks execute as ``INTERACTIVE``-class work
+        on the process-wide QoS executor: they preempt bulk-ingest
+        chunks at tick granularity, so serving p99 survives ingest
+        bursts (see README "Operations: unified runtime & QoS classes";
+        per-class state rides ``/v1/health`` and ``/status``).
+
         ``aux_endpoints=False`` registers only ``/v1/retrieve`` (plus the
         always-on ``/v1/health`` and ``/v1/debug/traces``): the
         statistics/inputs pipelines join REST queries against engine

@@ -402,7 +402,7 @@ def test_device_oom_in_serving_tick_degrades_and_rebuilds():
         assert out["degraded"] is True  # lexical fallback, not a 5xx
         assert inner.rebuilds == 1      # fatal → host-mirror rebuild
         assert plane.breaker.state in ("open", "half_open")
-        assert plane.scheduler._thread.is_alive()
+        assert plane.scheduler.executor_alive()
 
         # after cooldown the half-open probe runs against rebuilt arrays
         time.sleep(0.06)
@@ -411,7 +411,7 @@ def test_device_oom_in_serving_tick_degrades_and_rebuilds():
         assert out2["degraded"] is False
         assert out2["results"][0]["text"] == "alpha document"
         assert plane.breaker.state == "closed"
-        assert plane.scheduler._thread.is_alive()
+        assert plane.scheduler.executor_alive()
     finally:
         type(inner)._device_search = orig
 
