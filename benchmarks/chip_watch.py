@@ -212,6 +212,35 @@ def fire_decoder() -> bool:
     return _fire_tpu_jsonl(os.path.join(HERE, "decoder_bench.py"), 600.0)
 
 
+def fire_mesh() -> bool:
+    """Multi-chip serving scaling on the real mesh (serving_bench.py
+    --mesh 8: single-device vs 8-way-sharded serving of the same corpus;
+    appends to serving_results.jsonl).  Success requires a
+    platform=="tpu" mesh record with a scaling number — CPU fallbacks
+    measure shared-core contention, not ICI fan-out, and must not bank."""
+    name = "serving_bench.py --mesh 8"
+    _log(f"running {name} (budget 700s)")
+    rc, out = _run(
+        [os.path.join(HERE, "serving_bench.py"), "96", "--mesh", "8"],
+        760.0,
+        {"SERVING_BENCH_BUDGET_S": "700"},
+    )
+    ok = False
+    for line in (out or "").strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if (
+            rec.get("metric") == "rag_serving_mesh"
+            and rec.get("platform") == "tpu"
+            and rec.get("scaling_efficiency") is not None
+        ):
+            ok = True
+    _log(f"{name} rc={rc} tpu={ok} tail: {out[-300:]!r}")
+    return ok
+
+
 def fire_contention() -> bool:
     """Ingest+serve QoS contention A/B on the chip: the unified
     device-tick runtime vs PATHWAY_RUNTIME=0 (serving_bench.py
@@ -332,6 +361,7 @@ def main() -> int:
         "decoder": False,
         "attn": False,
         "contention": False,
+        "mesh": False,
     }
     fire = {
         "bench": fire_bench,
@@ -340,6 +370,7 @@ def main() -> int:
         "decoder": fire_decoder,
         "attn": fire_attn,
         "contention": fire_contention,
+        "mesh": fire_mesh,
     }
     last_bank = None  # monotonic() of the last banked record
     any_banked = False

@@ -205,6 +205,19 @@ class HealthRegistry:
                 snap["runtime"] = runtime_stats
         except Exception:  # noqa: BLE001 — health must never raise
             pass
+        # multi-chip serving: mesh shape + per-shard row counts of every
+        # live sharded index — read-only and gated on the module already
+        # being imported (a health probe must never pull in jax state)
+        try:
+            import sys as _sys
+
+            mod = _sys.modules.get("pathway_tpu.parallel.index")
+            if mod is not None:
+                mesh = mod.mesh_status()
+                if mesh:
+                    snap["mesh"] = mesh
+        except Exception:  # noqa: BLE001 — health must never raise
+            pass
         try:
             from ..testing import faults
 

@@ -79,6 +79,20 @@ METRICS: dict[str, tuple[str, str]] = {
         "histogram",
         "bulk-ingest share of contended ticks (the starvation bound, observed)",
     ),
+    # multi-chip serving mesh (pathway_tpu/parallel/index.py) — every
+    # series carries an index label; shard_rows adds a shard label
+    "pathway_mesh_devices": (
+        "gauge",
+        "devices the sharded KNN index's data axis spans",
+    ),
+    "pathway_mesh_shard_rows": (
+        "gauge",
+        "live rows per shard of a mesh-sharded index (row-balance observable)",
+    ),
+    "pathway_mesh_sharded_ticks_total": (
+        "counter",
+        "fused embed→search ticks answered by a mesh-sharded index",
+    ),
     # circuit breakers (xpacks/llm/_breaker.py)
     "pathway_breaker_state": ("gauge", "0=closed 1=half_open 2=open"),
     "pathway_breaker_trips_total": ("counter", "closed/half_open -> open transitions"),

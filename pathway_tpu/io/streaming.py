@@ -757,6 +757,17 @@ class StreamingDriver:
                 rows_restored=node.restored_rows,
                 duration_ms=round(duration_ms, 3),
             )
+            # a mesh-sharded index re-pins restored rows to its shards
+            # through the placement-preserving scatter; surface the
+            # resulting per-shard layout so a warm restart's balance is
+            # observable next to its chunk/row counts
+            inner = getattr(node.index, "index", None)
+            if inner is not None and hasattr(inner, "shard_row_counts"):
+                health.set_restore(
+                    pid,
+                    mesh_devices=int(inner.n_shards),
+                    rows_per_shard=inner.shard_row_counts(),
+                )
             record_span(
                 f"restore:{pid}", "restore", wall, duration_ms,
                 attrs={

@@ -89,11 +89,14 @@ class CrossEncoder:
         self.mesh = mesh
         self._batch_multiple = 1
         if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
             from ..parallel.sharding import mesh_setup
 
             self.params, self._data_sharding, self._batch_multiple = (
                 mesh_setup(self.params, mesh)
             )
+            self._replicated_sharding = NamedSharding(mesh, PartitionSpec())
         from ..internals.flight_recorder import instrument_jit
 
         self._apply = instrument_jit(
@@ -117,9 +120,17 @@ class CrossEncoder:
 
         def dispatch(ids, mask, tids):
             if self.mesh is not None:
-                ids = jax.device_put(ids, self._data_sharding)
-                mask = jax.device_put(mask, self._data_sharding)
-                tids = jax.device_put(tids, self._data_sharding)
+                # the one shard-vs-replicate rule shared with
+                # SentenceEncoder (encoder.pick_input_sharding)
+                from .encoder import pick_input_sharding
+
+                sharding = pick_input_sharding(
+                    ids.shape[0], self._batch_multiple,
+                    self._data_sharding, self._replicated_sharding,
+                )
+                ids = jax.device_put(ids, sharding)
+                mask = jax.device_put(mask, sharding)
+                tids = jax.device_put(tids, sharding)
             return self._apply(self.params, ids, mask, tids)
 
         return bucketed_dispatch(

@@ -193,6 +193,29 @@ def _bucket(value: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
+def pick_input_sharding(batch: int, multiple: int, data_sharding, replicated_sharding):
+    """Placement half of :func:`round_batch_to_multiple`'s policy: a
+    batch that divides the data axis shards over it, anything else
+    dispatches replicated.  Shared by SentenceEncoder and CrossEncoder
+    so the two dispatch paths cannot drift."""
+    if multiple > 1 and batch % multiple == 0:
+        return data_sharding
+    return replicated_sharding
+
+
+def round_batch_to_multiple(bb: int, multiple: int) -> int:
+    """THE shard-vs-replicate batch policy, in one place: a launch
+    at/above the mesh's data-axis width rounds up to a dividing multiple
+    (its batch dim shards over the axis); a smaller launch keeps its
+    natural bucket and dispatches replicated instead — padding a 1-query
+    serving tick to an 8-row launch is free on one MXU but 8x real
+    compute when each pad row occupies a different chip for nothing.
+    ``_input_sharding`` is the placement half of the same rule."""
+    if multiple > 1 and bb >= multiple:
+        return bb + (multiple - bb % multiple) % multiple
+    return bb
+
+
 def pad_chunk(
     ids,
     mask,
@@ -278,10 +301,7 @@ def _chunk_sizes(
         out.append(bb)
         remaining -= min(bb, remaining)
     if batch_multiple > 1:
-        out = [
-            bb + (batch_multiple - bb % batch_multiple) % batch_multiple
-            for bb in out
-        ]
+        out = [round_batch_to_multiple(bb, batch_multiple) for bb in out]
     return out
 
 
@@ -418,6 +438,9 @@ def bucketed_dispatch(
     b = ids_all.shape[0]
     bb = _bucket(b, BATCH_BUCKETS)
     if bb % batch_multiple:
+        # legacy path rounds UNCONDITIONALLY (pre-PR8 behavior, kept as
+        # the A/B reference) — the conditional shard-vs-replicate policy
+        # is round_batch_to_multiple, used by the packed path only
         bb += batch_multiple - bb % batch_multiple
     # dispatch every chunk before collecting any result: JAX's async
     # dispatch queues the launches back-to-back, so device compute and
@@ -548,11 +571,17 @@ class SentenceEncoder:
         self._batch_multiple = 1
         self._sp_mesh = None
         if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
             from ..parallel.sharding import mesh_setup
 
             self.params, self._data_sharding, self._batch_multiple = (
                 mesh_setup(self.params, mesh)
             )
+            # sub-multiple launches (small serving ticks, packed tails)
+            # replicate their inputs over the data axis instead of
+            # rounding the batch up to it — see _chunk_sizes
+            self._replicated_sharding = NamedSharding(mesh, PartitionSpec())
         from ..internals.flight_recorder import instrument_jit
 
         self._apply = instrument_jit(jax.jit(self._forward), "encoder.forward")
@@ -597,11 +626,21 @@ class SentenceEncoder:
 
         return self._encode_bucketed(ids_all, mask_all)
 
+    def _input_sharding(self, batch: int):
+        """Data-parallel placement rule for one launch: shard the batch
+        dim over the mesh's ``data`` axis when it divides, replicate
+        otherwise (small ticks / packed tails — see _chunk_sizes)."""
+        return pick_input_sharding(
+            batch, self._batch_multiple,
+            self._data_sharding, self._replicated_sharding,
+        )
+
     def _encode_bucketed(self, ids_all, mask_all) -> np.ndarray:
         def dispatch(ids, mask):
             if self.mesh is not None:
-                ids = jax.device_put(ids, self._data_sharding)
-                mask = jax.device_put(mask, self._data_sharding)
+                sharding = self._input_sharding(ids.shape[0])
+                ids = jax.device_put(ids, sharding)
+                mask = jax.device_put(mask, sharding)
             return self._apply(self.params, ids, mask)
 
         return bucketed_dispatch(
@@ -614,6 +653,59 @@ class SentenceEncoder:
             packed=self.packed,
             max_tokens=self.max_tokens,
         )
+
+    def encode_padded(self, texts: Sequence[str]) -> tuple[Any, int]:
+        """Fused-serving embed half: ONE whole-batch launch whose DEVICE
+        output is returned as-is, ``(embeddings [bb, dim], n_real)`` —
+        rows at/after ``n_real`` are dispatch pads.
+
+        The serving tick hands this array straight to the index search
+        (``DeviceKnnIndex.search`` accepts device queries), so the
+        per-tick D2H(embeddings) + H2D(same bytes) round trip disappears;
+        with a mesh the batch shards over the ``data`` axis when it
+        divides and replicates otherwise, and the search side consumes it
+        under its own specs (replicated queries for the sharded index).
+        ``bb`` is a power-of-two batch bucket, i.e. already the shape
+        ``bucket_q`` would pad to — the search compiles no extra shapes.
+
+        Raises ``ValueError`` when the batch exceeds the largest dispatch
+        bucket (callers fall back to :meth:`encode`)."""
+        n = len(texts)
+        if n == 0 or n > BATCH_BUCKETS[-1]:
+            raise ValueError(f"batch of {n} outside the dispatch buckets")
+        ids_all, mask_all = self.tokenizer.encode_batch(
+            list(texts), max_length=self.max_length
+        )
+        longest = int(mask_all.sum(axis=1).max())
+        if self.mesh is not None and longest > SEQ_BUCKETS[-1]:
+            raise ValueError("batch needs the sequence-parallel ring path")
+        seq = min(_bucket(max(longest, 1), SEQ_BUCKETS), self.max_length)
+        bb = round_batch_to_multiple(
+            _bucket(n, BATCH_BUCKETS), self._batch_multiple
+        )
+        if self.max_tokens is not None and bb * seq > self.max_tokens:
+            # the token budget bounds EVERY launch's padded mass
+            # (PATHWAY_EMBED_MAX_TOKENS exists to cap launch memory) —
+            # a tick too big for one budgeted launch falls back to the
+            # packed host path, which splits it under the same cap
+            raise ValueError(
+                f"padded tick {bb}x{seq} exceeds max_tokens={self.max_tokens}"
+            )
+        ids, mask, _ = pad_chunk(
+            ids_all[:, :seq],
+            mask_all[:, :seq],
+            bb,
+            seq,
+            ids_dtype=dispatch_dtype(self.cfg.vocab_size),
+        )
+        from ..internals.flight_recorder import record_padding
+
+        record_padding(int(mask_all.sum()), bb * seq)
+        args = [jnp.asarray(ids), jnp.asarray(mask)]
+        if self.mesh is not None:
+            sharding = self._input_sharding(bb)
+            args = [jax.device_put(a, sharding) for a in args]
+        return self._apply(self.params, *args), n
 
     def _encode_ring(self, ids_all, mask_all) -> np.ndarray:
         """Sequence-parallel path for documents beyond the bucket cap."""

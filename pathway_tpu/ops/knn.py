@@ -30,7 +30,7 @@ import numpy as np
 
 from .topk import topk_search
 
-__all__ = ["DeviceKnnIndex", "upsert_slice_rows"]
+__all__ = ["DeviceKnnIndex", "upsert_slice_rows", "upsert_coalesce_rows"]
 
 
 def upsert_slice_rows() -> int:
@@ -46,6 +46,24 @@ def upsert_slice_rows() -> int:
     except ValueError:
         n = 1024
     return max(n, 1)
+
+
+def upsert_coalesce_rows() -> int:
+    """Row cap per COALESCED apply-time scatter
+    (``PATHWAY_UPSERT_COALESCE_ROWS``, default 8192; 0 disables).
+
+    Staging slices batches to tick-sized chunks (``upsert_slice_rows``)
+    so the runtime can preempt between them — but once a search (or a
+    budget drain) decides to APPLY, issuing one scatter per chunk just
+    multiplies dispatch latency: a 100-chunk bulk backlog pays 100
+    launches where ~12 suffice.  The apply path therefore re-coalesces
+    consecutive staged chunks up to this many rows per scatter (padded
+    to a power of two so the compiled scatter shapes stay bounded)."""
+    try:
+        n = int(os.environ.get("PATHWAY_UPSERT_COALESCE_ROWS", "8192"))
+    except ValueError:
+        n = 8192
+    return max(n, 0)
 
 
 class DeviceKnnIndex:
@@ -92,8 +110,12 @@ class DeviceKnnIndex:
         # scatter fns — subclasses swap in sharding-preserving variants
         self._scatter_rows_fn = _scatter_rows
         self._scatter_mask_fn = _scatter_mask
+        self._scatter_dropping_fn = _scatter_rows_dropping
         #: fatal-device-fault recoveries performed (rebuild_device_arrays)
         self.rebuilds = 0
+        #: staged-device scatters actually dispatched (after coalescing) —
+        #: the observable the coalescing satellite pins by test
+        self.scatter_dispatches = 0
 
     def _round_capacity(self, capacity: int) -> int:
         """Capacities at/above the Pallas threshold are kept at multiples
@@ -138,9 +160,10 @@ class DeviceKnnIndex:
         self._staged_set[slot] = vec
         self._staged_valid[slot] = True
 
-    #: subclasses whose matrices carry a sharding (parallel/index.py)
-    #: fall back to host staging — the padded scatter below would drop
-    #: the placement the sharded scatter fns preserve
+    #: opt-out hook for subclasses that cannot take device-array staging;
+    #: the mesh-sharded index (parallel/index.py) used to set this False —
+    #: since PR 8 its dropping scatter pins ``out_shardings`` to the mesh,
+    #: so device batches stage everywhere
     _device_stage_ok = True
 
     def upsert_batch(self, keys: Sequence[Hashable], vectors) -> None:
@@ -296,14 +319,79 @@ class DeviceKnnIndex:
         (slot -1) scatter out of bounds and are dropped on device; the
         OOB index is resolved at apply time — capacity may have grown
         since staging.  Shared by the search-time full apply and the
-        incremental budget apply so their numerics can never diverge."""
+        incremental budget apply so their numerics can never diverge.
+        Subclasses with sharded matrices point ``_scatter_dropping_fn``
+        at a mesh-pinning variant (``out_shardings``), so device-staged
+        rows land in their owning shard instead of collapsing the
+        placement onto one device."""
         idx = np.where(slots >= 0, slots, self.capacity).astype(np.int32)
-        self.vectors = _scatter_rows_dropping(
+        self.scatter_dispatches += 1
+        self.vectors = self._scatter_dropping_fn(
             self.vectors,
             jnp.asarray(idx),
             vals,
             normalize=(self.metric == "cos"),
         )
+
+    def _coalesce_staged_device(
+        self,
+    ) -> list[tuple[np.ndarray, Any]]:
+        """Re-group the staged device chunks into few large scatters
+        (≤ :func:`upsert_coalesce_rows` rows each, padded to a power of
+        two so compiled scatter shapes stay bounded).
+
+        Only CONSECUTIVE chunks merge, so FIFO order is preserved; a slot
+        written by two coalesced chunks keeps only its LAST row (XLA
+        applies duplicate scatter indices in undefined order), which is
+        exactly the last-write-wins outcome the sequential applies had."""
+        entries = self._staged_device
+        cap = upsert_coalesce_rows()
+        if cap <= 0 or len(entries) <= 1:
+            return list(entries)
+        groups: list[list[tuple[np.ndarray, Any]]] = []
+        cur: list[tuple[np.ndarray, Any]] = []
+        rows = 0
+        for slots, vals in entries:
+            n = int(slots.shape[0])
+            if cur and rows + n > cap:
+                groups.append(cur)
+                cur, rows = [], 0
+            cur.append((slots, vals))
+            rows += n
+        if cur:
+            groups.append(cur)
+        out: list[tuple[np.ndarray, Any]] = []
+        for group in groups:
+            if len(group) == 1:
+                out.append(group[0])
+                continue
+            slots = np.concatenate([s for s, _ in group])
+            # later occurrences win: blank earlier duplicates (walk from
+            # the end; np.concatenate copied, so staged arrays are safe)
+            seen: set[int] = set()
+            for i in range(len(slots) - 1, -1, -1):
+                s = int(slots[i])
+                if s < 0:
+                    continue
+                if s in seen:
+                    slots[i] = -1
+                else:
+                    seen.add(s)
+            total = int(slots.shape[0])
+            padded = 1 << (total - 1).bit_length()
+            parts = [v for _, v in group]
+            if padded > total:
+                slots = np.concatenate(
+                    [slots, np.full((padded - total,), -1, dtype=slots.dtype)]
+                )
+                parts.append(
+                    jnp.zeros(
+                        (padded - total, parts[0].shape[1]),
+                        dtype=parts[0].dtype,
+                    )
+                )
+            out.append((slots, jnp.concatenate(parts)))
+        return out
 
     def _apply_staged(self) -> None:
         if (
@@ -324,8 +412,11 @@ class DeviceKnnIndex:
             faults.perturb("device.upsert")
         # device batches FIRST (FIFO), host dict after: a host upsert that
         # landed later than a device batch for the same slot wins, and
-        # upsert_batch already evicts older host entries for its slots
-        for slots, vals in self._staged_device:
+        # upsert_batch already evicts older host entries for its slots.
+        # A long backlog coalesces into few large scatters here — the
+        # tick-sized chunks existed for preemptibility while QUEUED, not
+        # to be paid one launch each once the apply is committed.
+        for slots, vals in self._coalesce_staged_device():
             self._apply_device_entry(slots, vals)
         self._staged_device.clear()
         if self._staged_set:
@@ -613,37 +704,67 @@ class DeviceKnnIndex:
         )
 
     def search(
-        self, queries: Any, k: int
+        self, queries: Any, k: int, n_valid: int | None = None
     ) -> list[list[tuple[Hashable, float]]]:
-        """Top-k per query as (key, score) lists, higher scores better."""
-        with self._lock:
-            return self._search_locked(queries, k)
+        """Top-k per query as (key, score) lists, higher scores better.
 
-    def _search_locked(self, queries, k):
+        ``queries`` may be a host ``[Q, D]`` array, or a DEVICE array
+        straight off the encoder (the fused serving tick): device
+        queries are normalized and bucket-padded on device — the
+        embed→search handoff never round-trips through host memory.
+        ``n_valid`` caps how many leading rows get host-side result
+        assembly (the fused tick's trailing dispatch-pad rows searched
+        on device anyway, but building and filtering (key, score) lists
+        for them is pure waste)."""
+        with self._lock:
+            return self._search_locked(queries, k, n_valid)
+
+    def _search_locked(self, queries, k, n_valid=None):
         from .topk import bucket_k, bucket_q
 
         self._apply_staged()
+        on_device = isinstance(queries, jax.Array) and not isinstance(
+            queries, np.ndarray
+        )
+        if on_device and queries.ndim == 1:
+            queries = queries[None, :]  # lazy device reshape
         if len(self.slot_of_key) == 0 or k <= 0:
-            q = np.atleast_2d(np.asarray(queries))
-            return [[] for _ in range(q.shape[0])]
-        q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
-        if self.metric == "cos":
-            norms = np.linalg.norm(q, axis=1, keepdims=True)
-            norms[norms == 0] = 1.0
-            q = q / norms
-        n_q = q.shape[0]
-        # bucket BOTH dims that vary under serving traffic: the ragged
-        # scheduler-tick batch size (pad Q to a power of two, slice back)
-        # and the heterogeneous per-request k (bucket_k; top_k rows come
-        # back sorted so slicing recovers the exact result) — without
-        # this every distinct (Q, k) pair compiles a fresh XLA program
-        q_b = bucket_q(n_q)
-        if q_b != n_q:
-            q = np.concatenate(
-                [q, np.zeros((q_b - n_q, q.shape[1]), dtype=q.dtype)]
+            n = (
+                queries.shape[0]
+                if on_device
+                else np.atleast_2d(np.asarray(queries)).shape[0]
             )
+            if n_valid is not None:
+                n = min(n, n_valid)
+            return [[] for _ in range(n)]
+        if on_device:
+            n_q = queries.shape[0]
+            q_b = bucket_q(n_q)
+            q = _prep_queries(
+                queries, q_b=q_b, normalize=(self.metric == "cos")
+            )
+        else:
+            q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+            if self.metric == "cos":
+                norms = np.linalg.norm(q, axis=1, keepdims=True)
+                norms[norms == 0] = 1.0
+                q = q / norms
+            n_q = q.shape[0]
+            # bucket BOTH dims that vary under serving traffic: the ragged
+            # scheduler-tick batch size (pad Q to a power of two, slice
+            # back) and the heterogeneous per-request k (bucket_k; top_k
+            # rows come back sorted so slicing recovers the exact result)
+            # — without this every distinct (Q, k) pair compiles a fresh
+            # XLA program
+            q_b = bucket_q(n_q)
+            if q_b != n_q:
+                q = np.concatenate(
+                    [q, np.zeros((q_b - n_q, q.shape[1]), dtype=q.dtype)]
+                )
         k_req = min(k, self.capacity)
         scores, idx = self._device_search(q, bucket_k(k_req, self.capacity))
+        if n_valid is not None:
+            n_q = min(n_q, n_valid)
         scores = np.asarray(scores)[:n_q]
         idx = np.asarray(idx)[:n_q]
         out: list[list[tuple[Hashable, float]]] = []
@@ -667,19 +788,40 @@ def _scatter_rows(matrix: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Arr
     return matrix.at[idx].set(vals)
 
 
-@functools.partial(jax.jit, static_argnames=("normalize",))
-def _scatter_rows_dropping(
+def _scatter_rows_dropping_body(
     matrix: jax.Array, idx: jax.Array, vals: jax.Array, normalize: bool
 ) -> jax.Array:
     """Device-resident embed→upsert scatter: rows whose index is out of
     bounds (dispatch pad rows) are dropped by XLA, cos rows are
     L2-normalized on device (f32 accumulation) — one fused kernel instead
-    of a D2H copy, host normalize, and H2D re-stage."""
+    of a D2H copy, host normalize, and H2D re-stage.  The un-jitted body
+    is shared with the sharded index's mesh-pinning jit
+    (``out_shardings``) so the two paths can never numerically diverge."""
     v = vals.astype(jnp.float32)
     if normalize:
         norm = jnp.linalg.norm(v, axis=1, keepdims=True)
         v = v / jnp.maximum(norm, 1e-30)
     return matrix.at[idx].set(v.astype(matrix.dtype), mode="drop")
+
+
+_scatter_rows_dropping = functools.partial(jax.jit, static_argnames=("normalize",))(
+    _scatter_rows_dropping_body
+)
+
+
+@functools.partial(jax.jit, static_argnames=("q_b", "normalize"))
+def _prep_queries(q: jax.Array, q_b: int, normalize: bool) -> jax.Array:
+    """Fused-serving query prep, on device: f32 widen, optional L2
+    normalize, pad the ragged tick batch up to its Q bucket.  Shapes come
+    from the same power-of-two grid as the host path, so the compile set
+    stays bounded."""
+    q = q.astype(jnp.float32)
+    if normalize:
+        norm = jnp.linalg.norm(q, axis=1, keepdims=True)
+        q = q / jnp.maximum(norm, 1e-30)
+    if q_b > q.shape[0]:
+        q = jnp.pad(q, ((0, q_b - q.shape[0]), (0, 0)))
+    return q
 
 
 @jax.jit
@@ -694,8 +836,12 @@ from ..internals.flight_recorder import instrument_jit as _instrument_jit
 
 _scatter_rows = _instrument_jit(_scatter_rows, "knn.scatter_rows")
 _scatter_mask = _instrument_jit(_scatter_mask, "knn.scatter_mask")
-# device-batch shapes come from the dispatch bucket grid, so this site is
-# bounded by (#batch_buckets x capacity growths), like the others
+# device-batch shapes come from the dispatch bucket grid (plus the
+# power-of-two coalesce pads), so this site is bounded by
+# (#batch_buckets x capacity growths), like the others
 _scatter_rows_dropping = _instrument_jit(
     _scatter_rows_dropping, "knn.scatter_rows_padded"
 )
+# fused-serving query prep: shapes are (bucket_q, dim) — same grid the
+# search itself compiles over
+_prep_queries = _instrument_jit(_prep_queries, "knn.query_prep")

@@ -13,7 +13,7 @@ program whose cross-device traffic is XLA collectives on ICI
 reference's per-worker replica search.
 """
 
-from .mesh import make_mesh, data_axis, model_axis
+from .mesh import make_mesh, serving_mesh, data_axis, model_axis
 from .sharding import encoder_param_specs, shard_params, batch_spec
 from .index import ShardedKnnIndex
 from .ring_attention import ring_attention, ring_attention_sharded
@@ -21,6 +21,7 @@ from .long_encoder import ring_encode, ring_forward
 
 __all__ = [
     "make_mesh",
+    "serving_mesh",
     "data_axis",
     "model_axis",
     "encoder_param_specs",

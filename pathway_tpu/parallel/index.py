@@ -15,6 +15,9 @@ instead of shipping any index rows.
 from __future__ import annotations
 
 import functools
+import itertools
+import threading
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -22,11 +25,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.knn import DeviceKnnIndex
+from ..ops.knn import DeviceKnnIndex, _scatter_rows_dropping_body
 from ._compat import shard_map
 from .mesh import data_axis
 
-__all__ = ["ShardedKnnIndex"]
+__all__ = ["ShardedKnnIndex", "mesh_status"]
 
 NEG_INF = -jnp.inf
 
@@ -70,6 +73,13 @@ def _sharded_search_fn(mesh: Mesh, k: int, metric: str, n_local: int):
     return jax.jit(mapped)
 
 
+#: live sharded indexes, for /status + /v1/health mesh surfacing (weak:
+#: a finished run's indexes drop out with it)
+_LIVE_SHARDED: "weakref.WeakSet[ShardedKnnIndex]" = weakref.WeakSet()
+_label_seq = itertools.count()
+_provider_lock = threading.Lock()
+
+
 class ShardedKnnIndex(DeviceKnnIndex):
     """KNN index whose vector matrix is sharded over a device mesh.
 
@@ -77,11 +87,13 @@ class ShardedKnnIndex(DeviceKnnIndex):
     tombstones, staging) is inherited; only array placement and the search
     path change.  Works on any mesh with a ``data`` axis; arrays are
     replicated over other mesh axes.
-    """
 
-    #: device-batch staging would scatter through an unsharded jit and
-    #: drop the mesh placement — sharded indexes stage host-side
-    _device_stage_ok = False
+    Device-batch staging (the ingest plane's embed→upsert fast path) is
+    supported since PR 8: the dropping scatter is jitted with
+    ``out_shardings`` pinned to the mesh, so staged rows land in their
+    owning shard — the PR 5 ``_device_stage_ok=False`` restriction is
+    lifted (see MIGRATION).
+    """
 
     def __init__(
         self,
@@ -103,6 +115,19 @@ class ShardedKnnIndex(DeviceKnnIndex):
         self._scatter_mask_fn = jax.jit(
             lambda m, i, v: m.at[i].set(v), out_shardings=self._mask_sharding
         )
+        # device-staged rows scatter through the SAME body as the
+        # single-device path (no numeric divergence) but with the output
+        # pinned to the mesh — GSPMD routes each row to its owning shard
+        self._scatter_dropping_fn = functools.partial(
+            jax.jit,
+            static_argnames=("normalize",),
+            out_shardings=self._vec_sharding,
+        )(_scatter_rows_dropping_body)
+        #: fused embed→search ticks answered by this sharded index
+        self.sharded_ticks = 0
+        self.mesh_label = f"sharded{next(_label_seq)}"
+        _LIVE_SHARDED.add(self)
+        _ensure_mesh_provider()
 
     def _round_capacity(self, capacity: int) -> int:
         """Also keep capacity divisible by the shard count through every
@@ -121,7 +146,111 @@ class ShardedKnnIndex(DeviceKnnIndex):
             self.vectors = jax.device_put(self.vectors, self._vec_sharding)
             self.valid = jax.device_put(self.valid, self._mask_sharding)
 
-    def _device_search(self, q: np.ndarray, k: int):
+    def _device_search(self, q, k: int):
         n_local = self.capacity // self.n_shards
         fn = _sharded_search_fn(self.mesh, int(k), self.metric, n_local)
+        self.sharded_ticks += 1
         return fn(jnp.asarray(q, dtype=self.dtype), self.vectors, self.valid)
+
+    # -- mesh observability ---------------------------------------------
+    def shard_row_counts(self) -> list[int]:
+        """Live rows per shard (row-sharding balance observable — slots
+        are allocated LIFO off one free list, so a heavily skewed profile
+        here means deletes concentrated in one shard's slot range).
+
+        LOCK-FREE on purpose: health probes and metric scrapes call this,
+        and taking ``self._lock`` would block them behind an in-flight
+        search or a long staged apply — exactly the "probe stalls during
+        heavy ingest" failure /v1/health must not have.  ``list(dict
+        .values())`` is one C-level snapshot under the GIL; a concurrent
+        resize raises RuntimeError, so retry a few times and report the
+        last good approximation (it is a gauge, not an invariant)."""
+        n_local = max(self.capacity // self.n_shards, 1)
+        slots: list = []
+        for _attempt in range(4):
+            try:
+                slots = list(self.slot_of_key.values())
+                break
+            except RuntimeError:  # dict resized mid-snapshot
+                continue
+        counts = [0] * self.n_shards
+        for slot in slots:
+            counts[min(slot // n_local, self.n_shards - 1)] += 1
+        return counts
+
+
+# ---------------------------------------------------------------------------
+# mesh observability: pathway_mesh_* series on /status, mesh block on
+# /v1/health (internals/health.py reads mesh_status() only when this
+# module is already imported — a health probe never imports jax state)
+# ---------------------------------------------------------------------------
+
+
+class _MeshMetricsProvider:
+    """``pathway_mesh_*`` OpenMetrics series over every live sharded
+    index: mesh width, per-shard live rows, fused sharded-tick count."""
+
+    def stats(self) -> dict:
+        return mesh_status() or {}
+
+    def openmetrics_lines(self) -> list[str]:
+        from ..internals.metrics_names import escape_label_value
+
+        indexes = sorted(_LIVE_SHARDED, key=lambda i: i.mesh_label)
+        if not indexes:
+            return []
+        lines = [
+            "# TYPE pathway_mesh_devices gauge",
+        ]
+        for idx in indexes:
+            lbl = f'index="{escape_label_value(idx.mesh_label)}"'
+            lines.append(f"pathway_mesh_devices{{{lbl}}} {idx.n_shards}")
+        lines.append("# TYPE pathway_mesh_shard_rows gauge")
+        for idx in indexes:
+            lbl = f'index="{escape_label_value(idx.mesh_label)}"'
+            for shard, rows in enumerate(idx.shard_row_counts()):
+                lines.append(
+                    f'pathway_mesh_shard_rows{{{lbl},shard="{shard}"}} {rows}'
+                )
+        lines.append("# TYPE pathway_mesh_sharded_ticks_total counter")
+        for idx in indexes:
+            lbl = f'index="{escape_label_value(idx.mesh_label)}"'
+            lines.append(
+                f"pathway_mesh_sharded_ticks_total{{{lbl}}} {idx.sharded_ticks}"
+            )
+        return lines
+
+
+#: strong module-level ref: the provider registry is weak-valued, so an
+#: unheld provider would vanish before its first scrape
+_mesh_provider: _MeshMetricsProvider | None = None
+
+
+def _ensure_mesh_provider() -> None:
+    global _mesh_provider
+    with _provider_lock:
+        if _mesh_provider is not None:
+            return
+        from ..internals.monitoring import register_metrics_provider
+
+        _mesh_provider = _MeshMetricsProvider()
+        register_metrics_provider("mesh", _mesh_provider)
+
+
+def mesh_status() -> dict | None:
+    """Mesh shape + per-shard row counts for ``/v1/health`` (None when no
+    sharded index is live)."""
+    indexes = sorted(_LIVE_SHARDED, key=lambda i: i.mesh_label)
+    if not indexes:
+        return None
+    return {
+        idx.mesh_label: {
+            "devices": int(idx.n_shards),
+            "capacity_rows": int(idx.capacity),
+            "rows_per_shard": idx.shard_row_counts(),
+            "sharded_ticks": int(idx.sharded_ticks),
+            "metric": idx.metric,
+            "dim": int(idx.dim),
+        }
+        for idx in indexes
+    }

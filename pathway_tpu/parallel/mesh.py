@@ -16,7 +16,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "data_axis", "model_axis"]
+__all__ = ["make_mesh", "serving_mesh", "data_axis", "model_axis"]
 
 data_axis = "data"
 model_axis = "model"
@@ -44,3 +44,56 @@ def make_mesh(
         raise ValueError(f"n_devices={n_devices} not divisible by model_parallel={mp}")
     grid = np.array(devices).reshape(n_devices // mp, mp)
     return Mesh(grid, (data_axis, model_axis))
+
+
+#: cached default serving mesh, keyed by the env value that built it —
+#: Mesh identity matters: the sharded search is lru-cached per mesh, so
+#: every server constructed under one setting must share one object
+_serving_mesh_cache: dict[str, Mesh] = {}
+
+
+def serving_mesh() -> Mesh | None:
+    """Process-default serving mesh from ``PATHWAY_SERVING_MESH``.
+
+    ``N`` (an int > 1) builds a data-parallel mesh over the first N
+    devices; ``all`` uses every visible device; unset/``0``/``1`` means
+    single-device serving (returns ``None``).  ``VectorStoreServer`` and
+    ``DocumentStore`` consult this when no explicit ``mesh=`` is passed —
+    the env knob that turns a one-chip deployment into a sharded one
+    without touching code.  ``PATHWAY_MODEL_PARALLEL`` composes: it
+    splits the tensor-parallel axis off the same device set."""
+    raw = os.environ.get("PATHWAY_SERVING_MESH", "").strip().lower()
+    if not raw or raw in ("0", "1", "none", "off"):
+        return None
+    cached = _serving_mesh_cache.get(raw)
+    if cached is not None:
+        return cached
+    if raw == "all":
+        n: int | None = None
+    else:
+        try:
+            n = int(raw)
+        except ValueError:
+            import warnings
+
+            warnings.warn(
+                f"PATHWAY_SERVING_MESH={raw!r} is not an int or 'all' — "
+                "serving single-device",
+                stacklevel=2,
+            )
+            return None
+        if n <= 1:
+            return None
+    avail = len(jax.devices())
+    if n is not None and n > avail:
+        import warnings
+
+        warnings.warn(
+            f"PATHWAY_SERVING_MESH={n} > {avail} visible devices — "
+            f"serving over all {avail}",
+            stacklevel=2,
+        )
+        n = avail
+    mesh = make_mesh(n)
+    _serving_mesh_cache[raw] = mesh
+    return mesh

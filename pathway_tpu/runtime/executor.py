@@ -111,6 +111,22 @@ class AdmissionRefused(DeadlineExceeded):
     """Admission refused: the class queue is at its depth target."""
 
 
+def _device_count() -> int | None:
+    """How many accelerator devices the executor's ticks dispatch over
+    (mesh-sharded ticks fan each dispatch across all of them).  Reported
+    only when jax is already imported — a bare stats/health probe must
+    not pull in (or initialize) a backend."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return int(jax.device_count())
+    except Exception:  # noqa: BLE001 — stats must never raise
+        return None
+
+
 def estimate_tokens(item: Any) -> int:
     """Cheap token-mass estimate for budget batching: whitespace words
     + CLS/SEP for text (wordpiece splits only lengthen it, which errs on
@@ -784,11 +800,15 @@ class DeviceTickRuntime:
                 "tick_tokens_budget": self.tick_tokens,
                 "min_share": {c.label: self.min_share[c] for c in QoS},
                 "depth_targets": {c.label: self.depth[c] for c in QoS},
+                "devices": _device_count(),
             }
 
     def openmetrics_lines(self) -> list[str]:
         """``pathway_runtime_*`` series for the /status endpoint."""
         from ..internals.metrics_names import escape_label_value
+        # (mesh-sharded tick series live with the sharded index itself —
+        # parallel/index.py's provider — since a tick is mesh-wide work
+        # regardless of which QoS class submitted it)
 
         with self._cv:
             depths = {c: len(self._queues[c]) for c in QoS}

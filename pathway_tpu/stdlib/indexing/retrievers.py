@@ -179,7 +179,9 @@ class BruteForceKnnIndex(_FilteredMixin, InnerIndexImpl):
             return []
         max_k = max(k for k, _ in specs)
         oversample = self.OVERSAMPLE if any(flt for _, flt in specs) else 1
-        raw = self.index.search(vecs, max_k * oversample)
+        # n_valid: a fused device batch carries dispatch-pad rows past
+        # len(specs) — skip their host-side result assembly entirely
+        raw = self.index.search(vecs, max_k * oversample, n_valid=len(specs))
         return [
             self._apply_filter(row, flt, k)
             for row, (k, flt) in zip(raw, specs)

@@ -265,7 +265,14 @@ class IngestPipeline:
         if getattr(enc, "mesh", None) is not None:
             import jax
 
-            args = [jax.device_put(a, enc._data_sharding) for a in args]
+            # the encoder's own data-parallel rule: shard chunks that
+            # divide the data axis, replicate small tails
+            rule = getattr(enc, "_input_sharding", None)
+            sharding = (
+                rule(args[0].shape[0]) if rule is not None
+                else enc._data_sharding
+            )
+            args = [jax.device_put(a, sharding) for a in args]
         record_span(
             "h2d", "ingest", wall, (time.monotonic() - t0) * 1000.0,
             attrs={"chunks": 1},

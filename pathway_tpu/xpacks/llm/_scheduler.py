@@ -602,6 +602,23 @@ def get_scheduler() -> ServingScheduler:
 # ---------------------------------------------------------------------------
 
 
+def _encode_under_dispatch_lock(embedder, encode_fn, texts: list[str]):
+    """Run one model encode holding the batcher's dispatch lock: with a
+    mixed configuration (e.g. use_scheduler=False on the embedder)
+    engine-plane encodes run off this thread under the same lock, and the
+    model is not thread-safe across concurrent callers.  The one lock
+    contract for both the host and the fused device embed paths."""
+    from ._utils import coerce_str
+
+    batcher = getattr(embedder, "_batcher", None)
+    lock = getattr(batcher, "_dispatch_lock", None)
+    coerced = [coerce_str(t) for t in texts]
+    if lock is not None:
+        with lock:
+            return encode_fn(coerced)
+    return encode_fn(coerced)
+
+
 def _batch_embed(embedder, texts: list[str]):
     """One padded device dispatch for a batch of query texts.
 
@@ -616,22 +633,42 @@ def _batch_embed(embedder, texts: list[str]):
     ensure = getattr(embedder, "_ensure_encoder", None)
     if ensure is not None:
         enc = ensure()
-        # hold the batcher's dispatch lock: with a mixed configuration
-        # (e.g. use_scheduler=False on the embedder) engine-plane encodes
-        # run off this thread under the same lock, and the model is not
-        # thread-safe across concurrent callers
-        batcher = getattr(embedder, "_batcher", None)
-        lock = getattr(batcher, "_dispatch_lock", None)
-        if lock is not None:
-            with lock:
-                return enc.encode([coerce_str(t) for t in texts])
-        return enc.encode([coerce_str(t) for t in texts])
+        return _encode_under_dispatch_lock(embedder, enc.encode, texts)
     from .embedders import _call_sync
 
     fn = getattr(embedder, "__wrapped__", embedder)
     return np.stack(
         [np.asarray(_call_sync(fn, coerce_str(t))).reshape(-1) for t in texts]
     )
+
+
+def _batch_embed_device(embedder, texts: list[str]):
+    """Device-resident variant of :func:`_batch_embed` for the fused
+    embed→search tick: ONE whole-batch launch whose device output is
+    handed straight to the index search (``SentenceEncoder.encode_padded``
+    — rows past ``len(texts)`` are dispatch pads the search discards by
+    construction).  Returns ``None`` when the embedder has no model-backed
+    encoder or the batch falls outside the padded dispatch's envelope —
+    callers fall back to the host path.  ``PATHWAY_FUSED_SERVING=0``
+    disables the device handoff for A/B runs (the host path is the
+    pre-PR8 behavior: embeddings round-trip D2H then re-stage H2D for
+    the search — one extra wire round trip per tick on a remote chip)."""
+    if not _env_flag("PATHWAY_FUSED_SERVING", True):
+        return None
+    ensure = getattr(embedder, "_ensure_encoder", None)
+    if ensure is None:
+        return None
+    enc = ensure()
+    encode_padded = getattr(enc, "encode_padded", None)
+    if encode_padded is None:
+        return None
+    try:
+        embs, _n = _encode_under_dispatch_lock(
+            embedder, encode_padded, texts
+        )
+    except ValueError:
+        return None  # outside the dispatch buckets — host path handles it
+    return embs
 
 
 class _LexicalMirror:
@@ -800,8 +837,18 @@ class RetrievePlane:
 
                 if faults.enabled:
                     faults.perturb("embedder")
+                texts = [q for q, _, _ in items]
                 with batch_stage("embed"):
-                    embs = _batch_embed(self.embedder, [q for q, _, _ in items])
+                    # fused handoff: keep the tick's embeddings ON DEVICE
+                    # between encode and search when the index consumes
+                    # whole-batch queries (search discards the dispatch
+                    # pad rows; the sharded index replicates the batch
+                    # across the mesh and merges per-shard top-k over ICI)
+                    embs = None
+                    if hasattr(index, "search_embedded"):
+                        embs = _batch_embed_device(self.embedder, texts)
+                    if embs is None:
+                        embs = _batch_embed(self.embedder, texts)
                 specs = [(k, flt) for _, k, flt in items]
                 with batch_stage("search"):
                     if hasattr(index, "search_embedded"):

@@ -37,6 +37,39 @@ def coerce_str(value: Any) -> str:
     return str(value)
 
 
+def seed_embedder_mesh(embedder: Any, mesh: Any) -> None:
+    """Thread a serving mesh into a model-backed embedder whose encoder
+    is not built yet (``_encoder is None`` + ``_init_kwargs``): query and
+    ingest encodes then run data-parallel over the same device set the
+    index shards on.  Already-built encoders and plain UDF embedders are
+    left alone.  Shared by ``VectorStoreServer`` and ``DocumentStore``
+    so the ``mesh=``/``PATHWAY_SERVING_MESH`` knob behaves identically
+    through both entry points."""
+    if (
+        mesh is not None
+        and embedder is not None
+        and getattr(embedder, "_encoder", "-") is None
+        and hasattr(embedder, "_init_kwargs")
+    ):
+        existing = embedder._init_kwargs.get("mesh")
+        if existing is None:
+            embedder._init_kwargs["mesh"] = mesh
+        elif existing is not mesh:
+            # one embedder reused across servers with DIFFERENT meshes
+            # keeps the first mesh it bound — its encoder is (or will
+            # be) committed to those devices, and silently rebinding
+            # would feed one server queries placed on the other's mesh.
+            # Loud, because the fused tick will degrade on the mismatch.
+            import warnings
+
+            warnings.warn(
+                "embedder already bound to a different serving mesh; "
+                "reusing one embedder across servers with different "
+                "meshes keeps the first — pass a fresh embedder per mesh",
+                stacklevel=3,
+            )
+
+
 def merge_filter_exprs(
     metadata_filter: str | None, filepath_globpattern: str | None
 ) -> str | None:

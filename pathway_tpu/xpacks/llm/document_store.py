@@ -66,6 +66,12 @@ class DocumentStore:
         mesh: Any = None,
     ):
         self.docs = [docs] if isinstance(docs, Table) else list(docs)
+        if mesh is None:
+            # env-default multi-chip serving (PATHWAY_SERVING_MESH) —
+            # same knob as VectorStoreServer
+            from ...parallel.mesh import serving_mesh
+
+            mesh = serving_mesh()
         if mesh is not None:
             # device-mesh knob: row-shard any KNN retriever over the mesh
             # (parallel/index.py) — applied to every sub-factory of a
@@ -74,6 +80,8 @@ class DocumentStore:
             # mutated, so reuse with another server keeps its own mesh.
             import copy
             import dataclasses as _dc
+
+            from ._utils import seed_embedder_mesh
 
             subs = getattr(retriever_factory, "retriever_factories", None)
             if subs is not None:
@@ -84,8 +92,18 @@ class DocumentStore:
                     else f
                     for f in subs
                 ]
+                meshed = retriever_factory.retriever_factories
             elif getattr(retriever_factory, "mesh", "-") is None:
                 retriever_factory = _dc.replace(retriever_factory, mesh=mesh)
+                meshed = [retriever_factory]
+            else:
+                meshed = []
+            # same knob, same reach as VectorStoreServer: an unbuilt
+            # model-backed embedder on a sharded KNN factory encodes
+            # data-parallel over the mesh too
+            for f in meshed:
+                if getattr(f, "mesh", None) is mesh:
+                    seed_embedder_mesh(getattr(f, "embedder", None), mesh)
         self.mesh = mesh
         self.retriever_factory = retriever_factory
         self.parser = parser if parser is not None else Utf8Parser()

@@ -105,6 +105,19 @@ class VectorStoreServer:
         self.parser = parser if parser is not None else Utf8Parser()
         self.splitter = splitter if splitter is not None else null_splitter
         self.doc_post_processors = [p for p in (doc_post_processors or []) if p is not None]
+        if mesh is None:
+            # PATHWAY_SERVING_MESH: env-default multi-chip serving — the
+            # live index shards over the mesh's data axis and every fused
+            # serving tick merges per-shard top-k over ICI
+            from ...parallel.mesh import serving_mesh
+
+            mesh = serving_mesh()
+        # a model-backed embedder whose encoder is not built yet inherits
+        # the serving mesh: query/ingest encodes then run data-parallel
+        # over the same device set the index shards on
+        from ._utils import seed_embedder_mesh
+
+        seed_embedder_mesh(embedder, mesh)
         if index_factory is None:
             if embedder is None:
                 raise ValueError("provide embedder= or index_factory=")

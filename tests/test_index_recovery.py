@@ -535,8 +535,17 @@ def test_sharded_restore_and_rebuild_keep_mesh_placement(mesh):
     assert idx.vectors.sharding == idx._vec_sharding
     assert idx.valid.sharding == idx._mask_sharding
 
-    # fatal rebuild: host-mirror resurrection must re-pin via _place()
+    # fatal rebuild: host-mirror resurrection must re-pin via _place(),
+    # salvaging DEVICE-staged rows (PR 8 lifts the sharded staging
+    # restriction, so a fault can now land with sharded staged batches
+    # pending) — the salvaged rows survive the rebuild
+    import jax.numpy as jnp
+
+    staged_vec = rng.standard_normal(8).astype(np.float32)
+    idx.upsert_batch(["staged-key"], jnp.asarray(staged_vec[None, :]))
     assert idx.rebuild_device_arrays() is True
+    got = idx.search(staged_vec, k=1)
+    assert got[0][0][0] == "staged-key"
     assert idx.vectors.sharding == idx._vec_sharding
     assert idx.valid.sharding == idx._mask_sharding
     out2 = idx.search(vecs["k3"], k=2)
