@@ -134,21 +134,35 @@ def child_device(seconds: float = 10.0) -> None:
     # the legacy whole-batch padding for A/B)
     packed_default = os.environ.get("BENCH_PACKED", "1") != "0"
 
-    def measure(batch: int, packed: bool = packed_default) -> float:
-        """Steady-state forward throughput at one chunk size (already warm)."""
+    def measure(
+        batch: int, packed: bool = packed_default, ragged_enc=None
+    ) -> float:
+        """Steady-state forward throughput at one chunk size (already
+        warm).  ``ragged_enc`` (or a ragged headline, BENCH_ATTN=ragged)
+        routes through that encoder's own ragged dispatch —
+        bucketed_dispatch has no packed-token path.  ONE timing loop for
+        headline and every A/B variant, so the measurement protocol
+        can't drift between them."""
+        if ragged_enc is None and attn == "ragged":
+            ragged_enc = enc
         n_docs = 0
         t0 = time.perf_counter()
         while True:
             for start in range(0, len(docs), batch):
                 stop = min(start + batch, len(docs))
-                bucketed_dispatch(
-                    fwd,
-                    ids_all[start:stop],
-                    mask_all[start:stop],
-                    enc.max_length,
-                    vocab_size=vocab,
-                    packed=packed,
-                )
+                if ragged_enc is not None:
+                    ragged_enc.encode_tokenized(
+                        ids_all[start:stop], mask_all[start:stop]
+                    )
+                else:
+                    bucketed_dispatch(
+                        fwd,
+                        ids_all[start:stop],
+                        mask_all[start:stop],
+                        enc.max_length,
+                        vocab_size=vocab,
+                        packed=packed,
+                    )
                 n_docs += stop - start
             if time.perf_counter() - t0 > seconds:
                 break
@@ -175,7 +189,45 @@ def child_device(seconds: float = 10.0) -> None:
     extra: dict = {
         "corpus": "mixed_seq32/64/128",
         "packed": packed_default,
+        # every measured variant labels the attention impl it ran —
+        # BENCH_r05's unlabeled 420s/271s timeouts cost a round of
+        # guessing which path hung
+        "attn_impl_by_variant": {"headline": attn},
     }
+
+    def _ragged_ab(enc_ragged, batch: int, baseline_dps: float) -> None:
+        """In-run ragged-vs-packed A/B over the same mixed corpus: docs/s
+        ratio, the intra-bucket padding decomposition (ragged pins ~1.0
+        where the packed-bucket path sits ~0.906), and XLA compile-count
+        flatness across the measured reps."""
+        from pathway_tpu.internals.flight_recorder import compile_stats
+
+        enc_ragged.encode_tokenized(ids_all[:batch], mask_all[:batch])  # warm
+        before = compile_stats().get("encoder.forward_ragged", 0)
+        ragged_dps = max(
+            measure(batch, ragged_enc=enc_ragged),
+            measure(batch, ragged_enc=enc_ragged),
+        )
+        flat = compile_stats().get("encoder.forward_ragged", 0) == before
+        real = row = padded = 0
+        for start in range(0, len(docs), batch):
+            _, rst = enc_ragged.prepare_chunks(
+                ids_all[start : start + batch], mask_all[start : start + batch]
+            )
+            real += rst["real_tokens"]
+            row += rst["row_tokens"]
+            padded += rst["padded_tokens"]
+        extra["ragged_docs_per_sec"] = round(ragged_dps, 1)
+        extra["ragged_intra_bucket_efficiency"] = (
+            round(real / row, 4) if row else 1.0
+        )
+        extra["ragged_padding_efficiency"] = (
+            round(real / padded, 4) if padded else 1.0
+        )
+        extra["ragged_compile_flat"] = flat
+        if baseline_dps:
+            extra["ragged_vs_packed"] = round(ragged_dps / baseline_dps, 3)
+        extra["attn_impl_by_variant"]["ragged"] = "ragged"
 
     # escalating warmup: a small bucket compiles fast and guarantees a
     # number even on a slow/contended chip; the big bucket (better RPC
@@ -184,28 +236,59 @@ def child_device(seconds: float = 10.0) -> None:
     # improvement is PRINTED immediately — the parent takes the last
     # JSON line, so a hang mid-escalation still yields a measurement.
     small = 256
-    bucketed_dispatch(fwd, ids_all[:small], mask_all[:small], enc.max_length, vocab_size=vocab, packed=packed_default)
-    if packed_default:
+    if attn == "ragged":
+        enc.encode_tokenized(ids_all[:small], mask_all[:small])
+    else:
+        bucketed_dispatch(fwd, ids_all[:small], mask_all[:small], enc.max_length, vocab_size=vocab, packed=packed_default)
+    if packed_default and attn != "ragged":
         extra["padding_efficiency"] = _padding_eff(small)
     docs_per_sec = _emit_device_result(measure(small), dev, attn, **extra)
     # in-run A/B: the legacy whole-batch path over the SAME mixed corpus
     # (one extra compile at the (bucket(small), 128) shape) pins the
     # packed speedup to this run's conditions instead of a stale round
-    if packed_default and time.monotonic() + 60 + seconds < child_deadline:
+    if packed_default and attn != "ragged" and time.monotonic() + 60 + seconds < child_deadline:
         try:
             bucketed_dispatch(fwd, ids_all[:small], mask_all[:small], enc.max_length, vocab_size=vocab, packed=False)
             extra["legacy_docs_per_sec"] = round(measure(small, packed=False), 1)
+            extra["attn_impl_by_variant"]["legacy"] = attn
         except Exception as exc:
             extra["ab_warning"] = f"legacy A/B failed: {exc!r}"[:300]
+        _emit_device_result(docs_per_sec, dev, attn, **extra)
+    # ragged packed-batch A/B (ISSUE 9: one launch per budget window,
+    # near-zero padding).  On the CPU fallback this exercises the XLA
+    # reference; the real Pallas kernel's chip A/B runs in the TPU branch
+    # below and in benchmarks/ragged_ab.py's four-way suite.
+    if (
+        attn != "ragged"
+        and os.environ.get("BENCH_CPU_FALLBACK")
+        and time.monotonic() + 60 + 2 * seconds < child_deadline
+    ):
+        try:
+            import jax.numpy as jnp
+
+            enc_r = SentenceEncoder(
+                max_length=128,
+                cfg=EncoderConfig(dtype=jnp.float32, attention_impl="ragged"),
+            )
+            enc_r.params = enc.params
+            _ragged_ab(enc_r, small, docs_per_sec)
+        except Exception as exc:
+            msg = f"ragged A/B failed: {exc!r}"[:300]
+            extra["ab_warning"] = (
+                f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
+            )
         _emit_device_result(docs_per_sec, dev, attn, **extra)
     big = min(1024, len(docs))
     big_warm = False
     # conservative escalation cost: a fresh-shape compile over the tunnel
     # has been observed north of 150s
     if big > small and time.monotonic() + 180 + seconds < child_deadline:
-        bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab, packed=packed_default)
+        if attn == "ragged":
+            enc.encode_tokenized(ids_all[:big], mask_all[:big])
+        else:
+            bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab, packed=packed_default)
         big_warm = True
-        if packed_default:
+        if packed_default and attn != "ragged":
             extra["padding_efficiency"] = _padding_eff(big)
         docs_per_sec = max(docs_per_sec, measure(big))
         docs_per_sec = _emit_device_result(docs_per_sec, dev, attn, **extra)
@@ -235,6 +318,7 @@ def child_device(seconds: float = 10.0) -> None:
             bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
             pallas_dps = measure(big)
             extra["pallas_docs_per_sec"] = round(pallas_dps, 1)
+            extra["attn_impl_by_variant"]["pallas"] = "pallas"
             if pallas_dps > docs_per_sec:
                 docs_per_sec, best_attn = pallas_dps, "pallas"
         except Exception as exc:  # a pallas lowering failure must never
@@ -243,6 +327,29 @@ def child_device(seconds: float = 10.0) -> None:
             # measurement is complete, so the parent must surface it
             # without treating the run as degraded and retrying.
             msg = f"pallas A/B failed: {exc!r}"[:300]
+            extra["ab_warning"] = (
+                f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
+            )
+        _emit_device_result(docs_per_sec, dev, best_attn, **extra)
+
+    # ragged packed-batch A/B on the chip: the REAL Pallas ragged kernel
+    # (one launch per budget window, block-aligned ragged masks) vs the
+    # banked packed number — the MFU headline this PR is about
+    if (
+        attn != "ragged"
+        and dev.platform == "tpu"
+        and time.monotonic() + 180 + 2 * seconds < child_deadline
+    ):
+        try:
+            enc_r = SentenceEncoder(
+                max_length=128, cfg=EncoderConfig(attention_impl="ragged")
+            )
+            enc_r.params = enc.params
+            _ragged_ab(enc_r, big, docs_per_sec)
+            if extra["ragged_docs_per_sec"] > docs_per_sec:
+                docs_per_sec, best_attn = extra["ragged_docs_per_sec"], "ragged"
+        except Exception as exc:
+            msg = f"ragged A/B failed: {exc!r}"[:300]
             extra["ab_warning"] = (
                 f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
             )
@@ -257,13 +364,20 @@ def child_device(seconds: float = 10.0) -> None:
     # the cast composes OUTSIDE the forward's jit (the cached executable
     # is reused), so warmup compiles only a trivial convert kernel —
     # 60 s covers it even over the tunnel.
-    if dev.platform == "tpu" and time.monotonic() + 60 + 3 * seconds < child_deadline:
+    if (
+        attn != "ragged"  # ragged has no dense fwd warmed to cast through
+        and dev.platform == "tpu"
+        and time.monotonic() + 60 + 3 * seconds < child_deadline
+    ):
         try:
             import jax.numpy as jnp
 
             fwd = lambda i, m: fused_fwd(i, m).astype(jnp.bfloat16)  # noqa: E731
             bucketed_dispatch(fwd, ids_all[:big], mask_all[:big], enc.max_length, vocab_size=vocab)
             extra["wire_bf16_docs_per_sec"] = round(measure(big), 1)
+            # fused_fwd is the HEADLINE-impl encoder (bound before the
+            # pallas/ragged A/Bs reassign fwd) — label it as such
+            extra["attn_impl_by_variant"]["wire_bf16"] = attn
         except Exception as exc:
             msg = f"bf16-wire A/B failed: {exc!r}"[:300]
             extra["ab_warning"] = (
@@ -280,7 +394,12 @@ def child_device(seconds: float = 10.0) -> None:
     # executable is hit — a fresh big-bucket compile is only paid when
     # the escalation never warmed it, and then only with compile budget.
     margin = 30 if big_warm else 180
-    if dev.platform == "tpu" and time.monotonic() + margin + seconds < child_deadline:
+    if (
+        attn != "ragged"  # dense-executable probe; a ragged headline
+        # never warmed it and the fallback would mislabel the number
+        and dev.platform == "tpu"
+        and time.monotonic() + margin + seconds < child_deadline
+    ):
         try:
             import jax
 
@@ -317,6 +436,7 @@ def child_device(seconds: float = 10.0) -> None:
             co = n / (time.perf_counter() - t0)
             extra["compute_only_docs_per_sec"] = round(co, 1)
             extra["mfu_compute_only"] = _mfu(co, dev)
+            extra["attn_impl_by_variant"]["compute_only"] = attn
         except Exception as exc:
             msg = f"compute-only probe failed: {exc!r}"[:300]
             extra["ab_warning"] = (
@@ -344,6 +464,10 @@ def child_probe() -> None:
                 "platform": dev.platform,
                 "device_kind": getattr(dev, "device_kind", str(dev)),
                 "init_s": round(time.monotonic() - t0, 1),
+                # which impl the full child would measure — probe timeout
+                # warnings must name it (an unlabeled hang cost BENCH_r05
+                # a round of guessing which attention path was at fault)
+                "attn_impl": os.environ.get("BENCH_ATTN", "fused"),
             }
         ),
         flush=True,
@@ -657,7 +781,8 @@ def main() -> None:
         if probe and "platform" in probe:
             break
         errors.append(
-            f"device probe attempt {attempt + 1}: "
+            f"device probe attempt {attempt + 1} "
+            f"(impl={os.environ.get('BENCH_ATTN', 'fused')}): "
             f"{(probe or {}).get('error', 'unknown')}"
         )
         probe = None
@@ -707,9 +832,15 @@ def main() -> None:
             "padding_efficiency",
             "legacy_docs_per_sec",
             "pallas_docs_per_sec",
+            "ragged_docs_per_sec",
+            "ragged_vs_packed",
+            "ragged_intra_bucket_efficiency",
+            "ragged_padding_efficiency",
+            "ragged_compile_flat",
             "wire_bf16_docs_per_sec",
             "compute_only_docs_per_sec",
             "mfu_compute_only",
+            "attn_impl_by_variant",
         ):
             if result.get(opt) is not None:
                 out[opt] = result[opt]

@@ -212,6 +212,19 @@ def fire_decoder() -> bool:
     return _fire_tpu_jsonl(os.path.join(HERE, "decoder_bench.py"), 600.0)
 
 
+def fire_ragged() -> bool:
+    """Four-way attention A/B (flax/fused/pallas/ragged docs/s + MFU over
+    the mixed-length corpus, benchmarks/ragged_ab.py): the consolidated
+    ``ragged_ab`` record lands directly in chip_results.jsonl per healthy
+    window — the pallas/ragged-vs-fused ratio ROADMAP item 2 wants
+    banked automatically."""
+    return _fire_tpu_jsonl(
+        os.path.join(HERE, "ragged_ab.py"),
+        600.0,
+        {"RAGGED_AB_BUDGET_S": "560"},
+    )
+
+
 def fire_mesh() -> bool:
     """Multi-chip serving scaling on the real mesh (serving_bench.py
     --mesh 8: single-device vs 8-way-sharded serving of the same corpus;
@@ -305,6 +318,10 @@ def bank_chip_summary(probe_dev: dict) -> bool:
         os.path.join(HERE, "attn_probe_results.jsonl"),
         lambda r: r.get("platform") == "tpu",
     )
+    ragged = _latest_jsonl(
+        RESULTS,
+        lambda r: r.get("metric") == "ragged_ab" and r.get("platform") == "tpu",
+    )
     rec = {
         "metric": "chip_bank",
         "platform": "tpu",
@@ -321,6 +338,13 @@ def bank_chip_summary(probe_dev: dict) -> bool:
             if attn and ("pallas" in k or "fused" in k or "docs" in k)
         }
         if attn
+        else None,
+        "ragged_ab": {
+            k: ragged[k]
+            for k in ragged
+            if "docs_per_sec" in k or "mfu" in k or k == "ragged_vs_fused"
+        }
+        if ragged
         else None,
         "source_ts": bench.get("ts"),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -360,6 +384,7 @@ def main() -> int:
         "serving": False,
         "decoder": False,
         "attn": False,
+        "ragged": False,
         "contention": False,
         "mesh": False,
     }
@@ -369,6 +394,7 @@ def main() -> int:
         "serving": fire_serving,
         "decoder": fire_decoder,
         "attn": fire_attn,
+        "ragged": fire_ragged,
         "contention": fire_contention,
         "mesh": fire_mesh,
     }
@@ -392,6 +418,10 @@ def main() -> int:
                         or time.monotonic() - last_bank >= rebank_interval):
                     done["bench"] = fire_bench()
                     done["attn"] = fire_attn()
+                    # the four-way flax/fused/pallas/ragged record re-banks
+                    # with every healthy window too (its consolidated line
+                    # goes straight into chip_results.jsonl)
+                    done["ragged"] = fire_ragged()
                     if bank_chip_summary(dev):
                         last_bank = time.monotonic()
                         any_banked = True

@@ -75,6 +75,9 @@ __all__ = [
     "instrument_jit",
     "compile_stats",
     "record_padding",
+    "record_attention_impl",
+    "attention_impl_stats",
+    "active_attention_impl",
     "record_ingest_docs",
     "record_tokenizer_cache",
     "ingest_stats",
@@ -637,18 +640,61 @@ _ingest_counters = {
     "docs_total": 0,
     "real_tokens": 0,
     "padded_tokens": 0,
+    "row_tokens": 0,
     "tokenizer_cache_hits": 0,
     "tokenizer_cache_misses": 0,
 }
 
+#: attention implementations active in this process (impl -> encoders
+#: built with it); surfaced on /status and the /v1/health runtime block
+_attn_impls: dict[str, int] = {}
 
-def record_padding(real_tokens: int, padded_tokens: int) -> None:
-    """One packed/legacy dispatch's token accounting — feeds the
+
+def record_padding(
+    real_tokens: int, padded_tokens: int, row_tokens: int | None = None
+) -> None:
+    """One dispatch's token accounting — feeds the
     ``pathway_embed_padding_efficiency`` gauge (real / padded; 1.0 means
-    every FLOP the device spent was on a real token)."""
+    every FLOP the device spent was on a real token).
+
+    ``row_tokens`` decomposes the waste: the token mass attributable to
+    REAL rows at their dispatch layout (rows x their seq bucket on the
+    packed-bucket path; exactly ``real_tokens`` on the ragged path).
+    ``real/row`` is then the INTRA-BUCKET token padding (short rows
+    inside their bucket — ~0.906 packed, ~1.0 ragged) and ``row/padded``
+    the bucket-level waste (pad rows + tail alignment).  Callers that
+    don't decompose (legacy external callers) default ``row_tokens`` to
+    ``padded_tokens`` — intra-bucket then degrades to the old
+    whole-ratio semantics instead of lying."""
     with _ingest_lock:
         _ingest_counters["real_tokens"] += int(real_tokens)
         _ingest_counters["padded_tokens"] += int(padded_tokens)
+        _ingest_counters["row_tokens"] += int(
+            padded_tokens if row_tokens is None else row_tokens
+        )
+
+
+def record_attention_impl(impl: str) -> None:
+    """An encoder was built with ``impl`` (flax/fused/pallas/ragged) —
+    the observable form of the PATHWAY_ATTENTION_IMPL knob."""
+    with _ingest_lock:
+        # pop+reinsert: dict order then IS build recency, which
+        # active_attention_impl leans on
+        _attn_impls[str(impl)] = _attn_impls.pop(str(impl), 0) + 1
+
+
+def attention_impl_stats() -> dict[str, int]:
+    with _ingest_lock:
+        return dict(_attn_impls)
+
+
+def active_attention_impl() -> str | None:
+    """The attention impl serving this process (the most-recently built
+    encoder's), for the /v1/health runtime block."""
+    with _ingest_lock:
+        if not _attn_impls:
+            return None
+        return next(reversed(_attn_impls))
 
 
 def record_ingest_docs(n: int) -> None:
@@ -667,9 +713,19 @@ def record_tokenizer_cache(hits: int = 0, misses: int = 0) -> None:
 def ingest_stats() -> dict[str, Any]:
     with _ingest_lock:
         snap = dict(_ingest_counters)
+        if _attn_impls:
+            snap["attention_impls"] = dict(_attn_impls)
     snap["padding_efficiency"] = (
         snap["real_tokens"] / snap["padded_tokens"]
         if snap["padded_tokens"]
+        else 1.0
+    )
+    # intra-bucket token padding only (short rows inside their seq
+    # bucket): ~0.906 packed-bucket, ~1.0 ragged — the decomposition the
+    # total gauge can't show once pad rows/tail alignment mix in
+    snap["intra_bucket_efficiency"] = (
+        snap["real_tokens"] / snap["row_tokens"]
+        if snap["row_tokens"]
         else 1.0
     )
     hits, misses = snap["tokenizer_cache_hits"], snap["tokenizer_cache_misses"]
@@ -765,6 +821,18 @@ def observability_metrics_lines() -> list[str]:
     lines.append(
         f"pathway_embed_padding_efficiency {ing['padding_efficiency']:.4f}"
     )
+    lines.append("# TYPE pathway_embed_intra_bucket_efficiency gauge")
+    lines.append(
+        "pathway_embed_intra_bucket_efficiency "
+        f"{ing['intra_bucket_efficiency']:.4f}"
+    )
+    impls = attention_impl_stats()
+    if impls:
+        lines.append("# TYPE pathway_attention_impl gauge")
+        for impl, n in sorted(impls.items()):
+            lines.append(
+                f'pathway_attention_impl{{impl="{escape_label_value(impl)}"}} {n}'
+            )
     lines.append("# TYPE pathway_tokenizer_cache_hits_total counter")
     lines.append(
         f"pathway_tokenizer_cache_hits_total {ing['tokenizer_cache_hits']}"
@@ -785,3 +853,7 @@ def reset_stage_metrics() -> None:
     with _ingest_lock:
         for k in _ingest_counters:
             _ingest_counters[k] = 0
+        # _attn_impls is deliberately NOT cleared: it is configuration
+        # state (which kernel the live encoders serve with), recorded
+        # only at construction — a stats reset must not blank the
+        # /v1/health attention_impl while the same encoder keeps serving

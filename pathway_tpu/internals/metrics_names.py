@@ -132,6 +132,15 @@ METRICS: dict[str, tuple[str, str]] = {
         "gauge",
         "real tokens / padded tokens across embed dispatches (1.0 = no padding waste)",
     ),
+    "pathway_embed_intra_bucket_efficiency": (
+        "gauge",
+        "real tokens / row-layout tokens: token padding INSIDE buckets only "
+        "(~0.906 packed-bucket, ~1.0 ragged)",
+    ),
+    "pathway_attention_impl": (
+        "gauge",
+        "encoders built per attention implementation (flax/fused/pallas/ragged)",
+    ),
     "pathway_tokenizer_cache_hits_total": (
         "counter",
         "tokenizer LRU memoization hits (dedup-heavy live streams)",

@@ -46,9 +46,20 @@ _metrics_providers: "weakref.WeakValueDictionary[str, Any]" = (
 )
 
 
-def register_metrics_provider(name: str, provider: Any) -> None:
+def register_metrics_provider(
+    name: str, provider: Any, replace: bool = True
+) -> None:
     """Surface an external component's counters on every
-    :class:`StatsMonitor` snapshot and the OpenMetrics endpoint."""
+    :class:`StatsMonitor` snapshot and the OpenMetrics endpoint.
+
+    ``replace=False`` keeps an existing LIVE registration: because the
+    table is weak-valued, a transient object replacing an established
+    provider's entry would DELETE the name when it is collected — the
+    established provider's series would silently vanish from /status.
+    Authoritative owners (e.g. the process-global runtime) register with
+    the default ``replace=True``."""
+    if not replace and _metrics_providers.get(name) is not None:
+        return
     _metrics_providers[name] = provider
 
 

@@ -17,7 +17,13 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from .encoder import EncoderConfig, TransformerEncoder, bucketed_dispatch
+from .encoder import (
+    EncoderConfig,
+    PackedTransformerEncoder,
+    TransformerEncoder,
+    bucketed_dispatch,
+    default_attention_impl,
+)
 from .tokenizer import load_tokenizer
 
 __all__ = ["CrossEncoder"]
@@ -35,6 +41,24 @@ class _ScoredEncoder(nn.Module):
         # BERT pooler (tanh dense on CLS) then the classifier head — the
         # exact stack BertForSequenceClassification scores with, so
         # converted HF cross-encoder checkpoints are weight-compatible
+        pooled = jnp.tanh(nn.Dense(self.cfg.hidden_dim, name="pooler")(cls))
+        return nn.Dense(1, name="score_head")(pooled)[:, 0]
+
+
+class _PackedScoredEncoder(nn.Module):
+    """Ragged-layout twin of :class:`_ScoredEncoder` (identical param
+    tree): pairs concatenated along one token axis, ONE launch per
+    batch, each row's CLS gathered at its ``starts`` offset."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, pos, seg, type_ids, starts, bounds, *, dense_s):
+        hidden = PackedTransformerEncoder(self.cfg, name="encoder")(
+            ids, pos, seg, starts, bounds, type_ids=type_ids,
+            dense_s=dense_s, pool=False,
+        )  # [1, T, H]
+        cls = hidden[0, starts.astype(jnp.int32), :].astype(jnp.float32)
         pooled = jnp.tanh(nn.Dense(self.cfg.hidden_dim, name="pooler")(cls))
         return nn.Dense(1, name="score_head")(pooled)[:, 0]
 
@@ -62,6 +86,9 @@ class CrossEncoder:
 
         self.pretrained = False
         params = None
+        impl = (
+            cfg.attention_impl if cfg is not None else default_attention_impl()
+        )
         if model_name is not None:
             from . import checkpoint
 
@@ -69,10 +96,12 @@ class CrossEncoder:
             if loaded is not None:
                 loaded_cfg, params = loaded
                 cfg = dataclasses.replace(
-                    loaded_cfg, dtype=(cfg or EncoderConfig()).dtype
+                    loaded_cfg,
+                    dtype=(cfg or EncoderConfig()).dtype,
+                    attention_impl=impl,
                 )
                 self.pretrained = True
-        self.cfg = cfg or EncoderConfig()
+        self.cfg = cfg or EncoderConfig(attention_impl=impl)
         self.max_length = min(max_length, self.cfg.max_len)
         self.tokenizer = load_tokenizer(model_name, vocab_size=self.cfg.vocab_size)
         self.model = _ScoredEncoder(self.cfg)
@@ -97,8 +126,12 @@ class CrossEncoder:
                 mesh_setup(self.params, mesh)
             )
             self._replicated_sharding = NamedSharding(mesh, PartitionSpec())
-        from ..internals.flight_recorder import instrument_jit
+        from ..internals.flight_recorder import (
+            instrument_jit,
+            record_attention_impl,
+        )
 
+        record_attention_impl(self.cfg.attention_impl)
         self._apply = instrument_jit(
             jax.jit(
                 lambda params, ids, mask, tids: self.model.apply(
@@ -107,6 +140,55 @@ class CrossEncoder:
             ),
             "cross_encoder.forward",
         )
+        self._packed_model = _PackedScoredEncoder(self.cfg)
+        self._apply_ragged = instrument_jit(
+            jax.jit(self._forward_ragged, static_argnames=("dense_s",)),
+            "cross_encoder.forward_ragged",
+        )
+
+    def _forward_ragged(
+        self, params, ids, pos, seg, tids, starts, bounds, *, dense_s
+    ):
+        return self._packed_model.apply(
+            {"params": params}, ids, pos, seg, tids, starts, bounds,
+            dense_s=dense_s,
+        )
+
+    def _predict_ragged(self, ids_all, mask_all, type_ids_all) -> np.ndarray:
+        """Ragged rerank dispatch: (query, doc) pairs concatenated along
+        one token axis, one launch per token-budget group, scores
+        collected in submission order."""
+        from ..internals.flight_recorder import record_padding
+        from .encoder import ragged_prepare
+
+        prepared, stats = ragged_prepare(
+            ids_all, mask_all, self.max_length,
+            type_ids_all=type_ids_all,
+            vocab_size=self.cfg.vocab_size,
+            max_tokens=self.max_tokens,
+        )
+        record_padding(
+            stats["real_tokens"], stats["padded_tokens"], stats["row_tokens"]
+        )
+        pending = []
+        for payload, rows, _tokens in prepared:
+            args = payload.device_args(include_type_ids=True)
+            if self.mesh is not None:
+                args = [
+                    jax.device_put(a, self._replicated_sharding) for a in args
+                ]
+            pending.append(
+                (
+                    self._apply_ragged(
+                        self.params, *args, dense_s=payload.dense_s
+                    ),
+                    rows,
+                )
+            )
+        out = np.empty((ids_all.shape[0],), dtype=np.float32)
+        for res, rows in pending:
+            out[rows] = np.asarray(res, dtype=np.float32)[: len(rows)]
+        return out
 
     def predict(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
         """Scores for (query, doc) pairs, higher = more relevant."""
@@ -117,6 +199,8 @@ class CrossEncoder:
         ids_all, mask_all, type_ids_all = self.tokenizer.encode_batch(
             queries, max_length=self.max_length, pair=docs, return_type_ids=True
         )
+        if self.cfg.attention_impl == "ragged":
+            return self._predict_ragged(ids_all, mask_all, type_ids_all)
 
         def dispatch(ids, mask, tids):
             if self.mesh is not None:

@@ -30,11 +30,17 @@ from .tokenizer import HashTokenizer, load_tokenizer
 __all__ = [
     "EncoderConfig",
     "TransformerEncoder",
+    "PackedTransformerEncoder",
     "SentenceEncoder",
     "packed_plan",
     "packed_prepare",
     "packed_dispatch_enabled",
     "embed_max_tokens",
+    "default_attention_impl",
+    "ragged_plan",
+    "ragged_prepare",
+    "RaggedChunk",
+    "TOKEN_BUCKETS",
 ]
 
 SEQ_BUCKETS = (32, 64, 128, 256, 512)
@@ -68,8 +74,12 @@ class EncoderConfig:
     #: attention kernel: "flax" (flax's unfused einsum chain — the
     #: golden-parity reference), "fused" (jax.nn.dot_product_attention,
     #: one XLA custom-call the compiler fuses QK^T→softmax→AV through —
-    #: no S² intermediate round-trips to HBM), or "pallas" (our explicit
-    #: flash-style TPU kernel, ops/flash_attention.py)
+    #: no S² intermediate round-trips to HBM), "pallas" (our explicit
+    #: flash-style TPU kernel, ops/flash_attention.py), or "ragged"
+    #: (packed ragged-batch dispatch: rows concatenated along one token
+    #: axis with segment ids, ONE Pallas launch per tick through
+    #: ops/ragged_attention.py, near-zero padding).  Process default via
+    #: PATHWAY_ATTENTION_IMPL (see :func:`default_attention_impl`).
     attention_impl: str = "flax"
 
 
@@ -113,7 +123,33 @@ _ATTENTION_FNS = {
     "flax": None,
     "fused": _fused_attention_fn,
     "pallas": _pallas_attention_fn,
+    # "ragged" selects the packed-layout forward (PackedTransformerEncoder
+    # + ops/ragged_attention.py); when the DENSE model is applied anyway
+    # (the sequence-parallel ring path for over-cap documents, direct
+    # bench probes of `_apply`) it degrades to the fused XLA kernel —
+    # same numerics, no packed layout required
+    "ragged": _fused_attention_fn,
 }
+
+
+def default_attention_impl() -> str:
+    """Process-default attention implementation
+    (``PATHWAY_ATTENTION_IMPL``: flax | fused | pallas | ragged).
+    Applied when an encoder is built without an explicit config; a
+    garbage value warns and falls back to the flax golden path."""
+    raw = os.environ.get("PATHWAY_ATTENTION_IMPL", "").strip().lower()
+    if not raw:
+        return "flax"
+    if raw in _ATTENTION_FNS:
+        return raw
+    import warnings
+
+    warnings.warn(
+        f"PATHWAY_ATTENTION_IMPL={raw!r} is not one of "
+        f"{sorted(_ATTENTION_FNS)} — using 'flax'",
+        stacklevel=2,
+    )
+    return "flax"
 
 
 class Block(nn.Module):
@@ -182,6 +218,121 @@ class TransformerEncoder(nn.Module):
         if cfg.emb_dim is not None and cfg.emb_dim != cfg.hidden_dim:
             pooled = nn.Dense(cfg.emb_dim, dtype=jnp.float32, name="proj")(pooled)
         # L2 normalize (sentence-transformers convention)
+        norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+        return pooled / jnp.maximum(norm, 1e-12)
+
+
+def _ragged_attention_fn(
+    query, key, value, bias=None, mask=None, *,
+    seg, pos, starts, bounds, num_rows, dense_s, **_kw,
+):
+    """flax ``attention_fn`` adapter over the packed ragged kernel
+    (ops/ragged_attention.py).  ``query`` is ``[1, T, heads, dh]`` —
+    the packed token axis has no batch dim; segment ids carry the row
+    structure, so a padding mask is meaningless here."""
+    from ..ops.ragged_attention import ragged_attention
+
+    if bias is not None or mask is not None:
+        raise ValueError(
+            "attention_impl='ragged' encodes row boundaries in segment "
+            "ids; bias/mask terms are not supported"
+        )
+    out = ragged_attention(
+        query[0], key[0], value[0], seg,
+        pos=pos, starts=starts, bounds=bounds,
+        num_rows=num_rows, dense_s=dense_s,
+    )
+    return out[None]
+
+
+class PackedBlock(nn.Module):
+    """One transformer layer over the packed ragged layout — the exact
+    parameter tree of :class:`Block` (attention/ln1/mlp_in/mlp_out/ln2),
+    so the two forwards share one checkpoint."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, seg, pos, starts, bounds, num_rows, dense_s):
+        cfg = self.cfg
+        fn = functools.partial(
+            _ragged_attention_fn, seg=seg, pos=pos, starts=starts,
+            bounds=bounds, num_rows=num_rows, dense_s=dense_s,
+        )
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.num_heads,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name="attention",
+            attention_fn=fn,
+        )(x, x)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln1")(x + h)
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlp_in")(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(cfg.hidden_dim, dtype=cfg.dtype, param_dtype=jnp.float32, name="mlp_out")(h)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln2")(x + h)
+        return x
+
+
+class PackedTransformerEncoder(nn.Module):
+    """BERT-style encoder over a PACKED RAGGED batch: rows concatenated
+    along one token axis (segment ids mark boundaries), ONE launch per
+    batch, per-token compute with zero intra-row padding, and masked
+    mean pooling done SEGMENT-WISE on device (``jax.ops.segment_sum``
+    over the row bucket — pad-tail tokens carry an out-of-bounds segment
+    id and drop structurally).
+
+    Parameter tree is IDENTICAL to :class:`TransformerEncoder` (tok_emb,
+    pos_emb, type_emb, ln_emb, layer_i.*, proj), so the same params /
+    checkpoints serve both layouts."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(
+        self, ids, pos, seg, starts, bounds, type_ids=None, *,
+        dense_s: int, pool: bool = True,
+    ):
+        cfg = self.cfg
+        # callers transfer narrow dtypes (u16 ids/pos/seg) to cut
+        # host↔device bytes; widen on device where it is free
+        ids = ids.astype(jnp.int32)
+        pos = pos.astype(jnp.int32)
+        seg = seg.astype(jnp.int32)
+        num_rows = starts.shape[0]
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_dim, param_dtype=jnp.float32, name="tok_emb"
+        )(ids[None, :]).astype(cfg.dtype)
+        x = x + nn.Embed(
+            cfg.max_len, cfg.hidden_dim, param_dtype=jnp.float32, name="pos_emb"
+        )(pos[None, :]).astype(cfg.dtype)
+        if cfg.type_vocab_size:
+            tids = (
+                jnp.zeros_like(ids) if type_ids is None
+                else type_ids.astype(jnp.int32)
+            )
+            x = x + nn.Embed(
+                cfg.type_vocab_size, cfg.hidden_dim, param_dtype=jnp.float32,
+                name="type_emb",
+            )(tids[None, :]).astype(cfg.dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln_emb")(x)
+        for i in range(cfg.num_layers):
+            x = PackedBlock(cfg, name=f"layer_{i}")(
+                x, seg, pos, starts, bounds, num_rows, dense_s
+            )
+        if not pool:
+            return x  # [1, T, H] packed hidden states
+        # segment-wise masked mean pooling: pad tokens (seg == num_rows)
+        # are out of bounds for the scatter-add and drop silently — no
+        # mask multiply, no 0/0 (pad ROWS pool to the zero vector)
+        xf = x[0].astype(jnp.float32)
+        sums = jax.ops.segment_sum(xf, seg, num_segments=num_rows)
+        counts = jax.ops.segment_sum(
+            jnp.ones((xf.shape[0],), jnp.float32), seg, num_segments=num_rows
+        )
+        pooled = sums / jnp.maximum(counts[:, None], 1.0)
+        if cfg.emb_dim is not None and cfg.emb_dim != cfg.hidden_dim:
+            pooled = nn.Dense(cfg.emb_dim, dtype=jnp.float32, name="proj")(pooled)
         norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
         return pooled / jnp.maximum(norm, 1e-12)
 
@@ -350,6 +501,7 @@ def packed_prepare(
     ids_dtype = dispatch_dtype(vocab_size)
     prepared: list[tuple] = []
     padded_tokens = 0
+    row_tokens = 0
     for seq, bb, rows in packed_plan(
         lengths, max_length, batch_multiple, max_tokens
     ):
@@ -363,10 +515,221 @@ def packed_prepare(
         )
         prepared.append((ids, mask, tids, rows))
         padded_tokens += bb * seq
+        row_tokens += len(rows) * seq
     stats = {
         "rows": int(len(lengths)),
         "real_tokens": int(lengths.sum()),
         "padded_tokens": int(padded_tokens),
+        # real rows × their seq bucket: the intra-bucket share of the
+        # padding accounting (real/row = token padding inside buckets,
+        # row/padded = pad-row + tail waste) — see flight_recorder
+        "row_tokens": int(row_tokens),
+    }
+    return prepared, stats
+
+
+# ---------------------------------------------------------------------------
+# packed RAGGED dispatch (attention_impl="ragged"): rows concatenated along
+# one token axis, ONE launch per tick, near-zero padding
+# ---------------------------------------------------------------------------
+
+#: launch sizes for the packed token axis: fine 128-token steps (the
+#: ragged kernel's block) up to 4096, then 512 steps to the VMEM cap —
+#: a FINITE shape set (the compile-flatness pin), with only tail-block
+#: alignment as padding (<=3% at any size, <1% amortized on full
+#: launches).  The 32/64 sub-block buckets keep a 1-row tick from
+#: padding to a full 128-token block.
+TOKEN_BUCKETS: tuple[int, ...] = (
+    (32, 64)
+    + tuple(range(128, 4096 + 1, 128))
+    + tuple(range(4608, 8192 + 1, 512))
+)
+
+
+class RaggedChunk:
+    """One prepared ragged launch: rows concatenated along the token
+    axis.  ``ids``/``pos``/``seg`` are per-token (pad tail carries
+    ``seg == num_rows``); ``starts`` is the per-row token offset (the
+    CLS position — cross-encoder scoring gathers it); ``bounds`` is the
+    per-q-block kv block range for the Pallas kernel
+    (ops/ragged_attention.ragged_bounds); ``dense_s`` is the seq bucket
+    the XLA reference unpacks to off-TPU."""
+
+    __slots__ = ("ids", "pos", "seg", "type_ids", "starts", "bounds", "dense_s")
+
+    def __init__(self, ids, pos, seg, type_ids, starts, bounds, dense_s):
+        self.ids = ids
+        self.pos = pos
+        self.seg = seg
+        self.type_ids = type_ids
+        self.starts = starts
+        self.bounds = bounds
+        self.dense_s = dense_s
+
+    def device_args(self, include_type_ids: bool = False) -> list:
+        """THE launch argument marshalling, in one place (the forward's
+        positional order) — SentenceEncoder, CrossEncoder and the bench
+        probes all launch through this so a new field can't be threaded
+        through one site and missed at another."""
+        args = [jnp.asarray(self.ids), jnp.asarray(self.pos),
+                jnp.asarray(self.seg)]
+        if include_type_ids:
+            args.append(jnp.asarray(self.type_ids))
+        args += [jnp.asarray(self.starts), jnp.asarray(self.bounds)]
+        return args
+
+
+def ragged_mixes_buckets() -> bool:
+    """Whether one ragged launch may mix rows from different seq buckets.
+
+    On TPU — or when the Pallas kernel is forced — yes: the kernel's
+    block-skipping makes mixed-length launches cheap, and ONE launch per
+    tick is the whole point.  Under the XLA reference (off-TPU), a mixed
+    launch would unpack EVERY row to the longest row's seq bucket for
+    the attention stage, paying 2-6x the packed path's attention pairs
+    on short rows — so the plan groups rows by their own seq bucket
+    first (attention cost then matches the packed path exactly, and the
+    per-token 96% of the FLOPs still runs unpadded on the ragged axis).
+    Numerics are identical either way; this is purely launch geometry."""
+    from ..ops.ragged_attention import kernel_mode
+
+    mode = kernel_mode()
+    if mode == "auto":
+        return jax.default_backend() == "tpu"
+    return mode == "pallas"
+
+
+def ragged_plan(
+    lengths,
+    max_length: int,
+    max_tokens: int | None = None,
+    mix_buckets: bool | None = None,
+) -> list[np.ndarray]:
+    """Launch plan for the ragged layout: rows greedily packed until the
+    token budget (``max_tokens``, capped by the kernel's VMEM bound) or
+    the row bucket ceiling.  With ``mix_buckets`` (the TPU default, see
+    :func:`ragged_mixes_buckets`) rows pack in submission order into ONE
+    launch per budget window; without it rows group by their own seq
+    bucket first (the XLA reference's attention-cost guard).  Row order
+    inside a group preserves submission order so results re-zip
+    deterministically."""
+    from ..ops.ragged_attention import MAX_PACKED_TOKENS
+
+    if mix_buckets is None:
+        mix_buckets = ragged_mixes_buckets()
+    # same row cap as the bucketed dispatch: sequences truncate at the
+    # largest seq bucket (over-cap documents go sequence-parallel via
+    # the ring path, never through a single-device launch)
+    lengths = np.minimum(
+        np.maximum(np.asarray(lengths, dtype=np.int64), 1),
+        min(max_length, SEQ_BUCKETS[-1]),
+    )
+    cap = MAX_PACKED_TOKENS if max_tokens is None else min(
+        int(max_tokens), MAX_PACKED_TOKENS
+    )
+    # a single row must always fit (its length is bounded by the seq cap)
+    cap = max(cap, int(lengths.max()) if len(lengths) else 1)
+    groups: list[np.ndarray] = []
+    if mix_buckets:
+        # one launch per token-budget window, submission order preserved
+        rows = np.arange(len(lengths), dtype=np.int64)
+        start = 0
+        total = 0
+        for j, r in enumerate(rows):
+            if j > start and (
+                total + int(lengths[r]) > cap
+                or j - start >= BATCH_BUCKETS[-1]
+            ):
+                groups.append(rows[start:j])
+                start, total = j, 0
+            total += int(lengths[r])
+        if start < len(rows):
+            groups.append(rows[start:])
+        return groups
+    # reference-mode plan: group by seq bucket, then chunk each group on
+    # the BATCH_BUCKETS grid exactly like the packed path (_chunk_sizes)
+    # — so the attention unpack's [row_bucket, seq_bucket] shape carries
+    # no pad rows (a 64-row group must not round to a 128-row unpack)
+    by_bucket: dict[int, list[int]] = {}
+    for i, ln in enumerate(lengths):
+        seq = min(_bucket(int(ln), SEQ_BUCKETS), max_length)
+        by_bucket.setdefault(seq, []).append(i)
+    for seq in sorted(by_bucket):
+        rows = np.asarray(by_bucket[seq], dtype=np.int64)
+        # bb*seq bounds the chunk's real tokens, so the VMEM/budget cap
+        # holds a fortiori on the ragged axis
+        start = 0
+        for bb in _chunk_sizes(len(rows), seq, 1, cap):
+            take = min(bb, len(rows) - start)
+            groups.append(rows[start : start + take])
+            start += take
+            if start >= len(rows):
+                break
+    return groups
+
+
+def ragged_prepare(
+    ids_all,
+    mask_all,
+    max_length: int,
+    type_ids_all=None,
+    vocab_size: int = 1 << 31,
+    max_tokens: int | None = None,
+    mix_buckets: bool | None = None,
+) -> tuple[list[tuple], dict]:
+    """Host half of the ragged dispatch: tokenized rows → packed
+    ``(RaggedChunk, rows, tokens)`` launches plus padding stats.  Every
+    row occupies exactly its own length on the token axis (intra-bucket
+    token padding is structurally zero — ``row_tokens == real_tokens``);
+    only the tail block's bucket alignment pads."""
+    from ..ops.ragged_attention import ragged_block, ragged_bounds
+
+    lengths = np.minimum(
+        np.maximum(np.asarray(mask_all.sum(axis=1), dtype=np.int64), 1),
+        min(max_length, SEQ_BUCKETS[-1]),
+    )
+    ids_dtype = dispatch_dtype(vocab_size)
+    prepared: list[tuple] = []
+    padded_tokens = 0
+    for rows in ragged_plan(lengths, max_length, max_tokens, mix_buckets):
+        t_real = int(lengths[rows].sum())
+        t_bucket = _bucket(t_real, TOKEN_BUCKETS)
+        n_rows = _bucket(len(rows), BATCH_BUCKETS)
+        dense_s = min(
+            _bucket(int(lengths[rows].max()), SEQ_BUCKETS), max_length
+        )
+        ids = np.zeros(t_bucket, ids_dtype)
+        pos = np.zeros(t_bucket, np.uint16)
+        seg = np.full(t_bucket, n_rows, np.uint16)  # pad tail: OOB segment
+        tids = None if type_ids_all is None else np.zeros(t_bucket, np.uint8)
+        starts = np.zeros(n_rows, np.int32)
+        cu = np.zeros(len(rows) + 1, np.int64)
+        off = 0
+        for j, r in enumerate(rows):
+            ln = int(lengths[r])
+            ids[off : off + ln] = ids_all[r, :ln]
+            pos[off : off + ln] = np.arange(ln, dtype=np.uint16)
+            seg[off : off + ln] = j
+            if tids is not None:
+                tids[off : off + ln] = type_ids_all[r, :ln]
+            starts[j] = off
+            off += ln
+            cu[j + 1] = off
+        bounds = ragged_bounds(cu, t_bucket, ragged_block(t_bucket))
+        prepared.append(
+            (
+                RaggedChunk(ids, pos, seg, tids, starts, bounds, dense_s),
+                rows,
+                t_bucket,
+            )
+        )
+        padded_tokens += t_bucket
+    real = int(lengths.sum())
+    stats = {
+        "rows": int(len(lengths)),
+        "real_tokens": real,
+        "padded_tokens": int(padded_tokens),
+        "row_tokens": real,  # rows occupy exactly their length
     }
     return prepared, stats
 
@@ -414,7 +777,9 @@ def bucketed_dispatch(
             type_ids_all=type_ids_all, vocab_size=vocab_size,
             batch_multiple=batch_multiple, max_tokens=max_tokens,
         )
-        record_padding(stats["real_tokens"], stats["padded_tokens"])
+        record_padding(
+            stats["real_tokens"], stats["padded_tokens"], stats["row_tokens"]
+        )
         pending = _dispatch_prepared(apply_fn, prepared)
         out: np.ndarray | None = None
         n = ids_all.shape[0]
@@ -476,7 +841,7 @@ def bucketed_dispatch(
         pending.append((apply_fn(*args), chunk))
         padded_tokens += bb * seq
         start += chunk
-    record_padding(real_tokens, padded_tokens)
+    record_padding(real_tokens, padded_tokens, b * seq)
     outs = [
         np.asarray(res, dtype=np.float32)[:chunk] for res, chunk in pending
     ]
@@ -508,6 +873,12 @@ class SentenceEncoder:
         self.packed = packed
         self.pretrained = False
         params = None
+        # attention impl: explicit cfg wins; otherwise the process-wide
+        # PATHWAY_ATTENTION_IMPL knob (checkpoints pin geometry, never
+        # the kernel choice)
+        impl = (
+            cfg.attention_impl if cfg is not None else default_attention_impl()
+        )
         if model_name is not None:
             from . import checkpoint
 
@@ -520,10 +891,11 @@ class SentenceEncoder:
                     loaded_cfg,
                     dtype=(cfg or EncoderConfig()).dtype,
                     emb_dim=(cfg.emb_dim if cfg is not None else None),
+                    attention_impl=impl,
                 )
                 cfg = loaded_cfg
                 self.pretrained = True
-        self.cfg = cfg or EncoderConfig()
+        self.cfg = cfg or EncoderConfig(attention_impl=impl)
         if (
             extend_positions is not None
             and extend_positions > SEQ_BUCKETS[-1]
@@ -582,12 +954,32 @@ class SentenceEncoder:
             # replicate their inputs over the data axis instead of
             # rounding the batch up to it — see _chunk_sizes
             self._replicated_sharding = NamedSharding(mesh, PartitionSpec())
-        from ..internals.flight_recorder import instrument_jit
+        from ..internals.flight_recorder import (
+            instrument_jit,
+            record_attention_impl,
+        )
 
+        record_attention_impl(self.cfg.attention_impl)
         self._apply = instrument_jit(jax.jit(self._forward), "encoder.forward")
+        # packed ragged forward: same params, concatenated-token layout —
+        # built unconditionally (construction is free until first trace)
+        # so probes can A/B both layouts on one encoder
+        self._packed_model = PackedTransformerEncoder(self.cfg)
+        self._apply_ragged = instrument_jit(
+            jax.jit(self._forward_ragged, static_argnames=("dense_s",)),
+            "encoder.forward_ragged",
+        )
 
     def _forward(self, params, ids, mask):
         return self.model.apply({"params": params}, ids, mask)
+
+    def _forward_ragged(
+        self, params, ids, pos, seg, starts, bounds, *, dense_s
+    ):
+        return self._packed_model.apply(
+            {"params": params}, ids, pos, seg, starts, bounds,
+            dense_s=dense_s,
+        )
 
     @property
     def dim(self) -> int:
@@ -636,6 +1028,9 @@ class SentenceEncoder:
         )
 
     def _encode_bucketed(self, ids_all, mask_all) -> np.ndarray:
+        if self.cfg.attention_impl == "ragged":
+            return self._encode_ragged(ids_all, mask_all)
+
         def dispatch(ids, mask):
             if self.mesh is not None:
                 sharding = self._input_sharding(ids.shape[0])
@@ -653,6 +1048,96 @@ class SentenceEncoder:
             packed=self.packed,
             max_tokens=self.max_tokens,
         )
+
+    def encode_tokenized(self, ids_all, mask_all) -> np.ndarray:
+        """Encode already-tokenized rows through this encoder's dispatch
+        path (bucketed or ragged, per ``cfg.attention_impl``) — the bench
+        harness entry, so A/B runs meter dispatch without re-tokenizing."""
+        return self._encode_bucketed(ids_all, mask_all)
+
+    # -- prepared-chunk protocol (shared by the ingest pipeline and the
+    #    runtime's BULK_INGEST plane: host half / device half split) -----
+    def prepare_chunks(
+        self, ids_all, mask_all, max_tokens: int | None = None
+    ) -> tuple[list[tuple], dict]:
+        """Host half of dispatch for THIS encoder's impl: returns
+        ``([(payload, rows, tokens)], stats)`` where ``payload`` feeds
+        :meth:`encode_prepared` (one device launch), ``rows`` are the
+        submission-order indices the launch covers, and ``tokens`` is its
+        padded token mass (the runtime's budget estimate).
+        ``max_tokens`` overrides the encoder's own budget (the ingest
+        pipeline's knob wins over the encoder default)."""
+        if max_tokens is None:
+            max_tokens = self.max_tokens
+        if self.cfg.attention_impl == "ragged":
+            return ragged_prepare(
+                ids_all, mask_all, self.max_length,
+                vocab_size=self.cfg.vocab_size, max_tokens=max_tokens,
+            )
+        prepared, stats = packed_prepare(
+            ids_all, mask_all, self.max_length,
+            vocab_size=self.cfg.vocab_size,
+            batch_multiple=self._batch_multiple,
+            max_tokens=max_tokens,
+        )
+        return (
+            [
+                ((ids, mask, tids), rows, int(ids.size))
+                for ids, mask, tids, rows in prepared
+            ],
+            stats,
+        )
+
+    def encode_prepared(self, payload) -> Any:
+        """Device half for ONE prepared chunk: H2D + forward, the DEVICE
+        output returned as-is (rows past ``len(rows)`` are pads).  Packed
+        payloads are ``(ids, mask, tids)``; ragged payloads are
+        :class:`RaggedChunk` (one concatenated-token launch)."""
+        if isinstance(payload, RaggedChunk):
+            args = payload.device_args()
+            if self.mesh is not None:
+                # the packed token axis has no batch dim to shard —
+                # ragged launches dispatch replicated over the mesh
+                args = [
+                    jax.device_put(a, self._replicated_sharding) for a in args
+                ]
+            return self._apply_ragged(
+                self.params, *args, dense_s=payload.dense_s
+            )
+        ids, mask, tids = payload
+        args = [jnp.asarray(ids), jnp.asarray(mask)]
+        if tids is not None:
+            args.append(jnp.asarray(tids))
+        if self.mesh is not None:
+            sharding = self._input_sharding(args[0].shape[0])
+            args = [jax.device_put(a, sharding) for a in args]
+        return self._apply(self.params, *args)
+
+    def _encode_ragged(self, ids_all, mask_all) -> np.ndarray:
+        """Ragged dispatch: one launch per token-budget group (ONE for a
+        whole serving tick), order-preserving collection."""
+        from ..internals.flight_recorder import record_padding
+
+        prepared, stats = ragged_prepare(
+            ids_all, mask_all, self.max_length,
+            vocab_size=self.cfg.vocab_size, max_tokens=self.max_tokens,
+        )
+        record_padding(
+            stats["real_tokens"], stats["padded_tokens"], stats["row_tokens"]
+        )
+        pending = [
+            (self.encode_prepared(payload), rows)
+            for payload, rows, _tokens in prepared
+        ]
+        out: np.ndarray | None = None
+        n = ids_all.shape[0]
+        for res, rows in pending:
+            res = np.asarray(res, dtype=np.float32)
+            if out is None:
+                out = np.empty((n,) + res.shape[1:], dtype=np.float32)
+            out[rows] = res[: len(rows)]
+        assert out is not None
+        return out
 
     def encode_padded(self, texts: Sequence[str]) -> tuple[Any, int]:
         """Fused-serving embed half: ONE whole-batch launch whose DEVICE
@@ -676,6 +1161,8 @@ class SentenceEncoder:
         ids_all, mask_all = self.tokenizer.encode_batch(
             list(texts), max_length=self.max_length
         )
+        if self.cfg.attention_impl == "ragged":
+            return self._encode_padded_ragged(ids_all, mask_all, n)
         longest = int(mask_all.sum(axis=1).max())
         if self.mesh is not None and longest > SEQ_BUCKETS[-1]:
             raise ValueError("batch needs the sequence-parallel ring path")
@@ -700,12 +1187,47 @@ class SentenceEncoder:
         )
         from ..internals.flight_recorder import record_padding
 
-        record_padding(int(mask_all.sum()), bb * seq)
+        record_padding(int(mask_all.sum()), bb * seq, n * seq)
         args = [jnp.asarray(ids), jnp.asarray(mask)]
         if self.mesh is not None:
             sharding = self._input_sharding(bb)
             args = [jax.device_put(a, sharding) for a in args]
         return self._apply(self.params, *args), n
+
+    def _encode_padded_ragged(self, ids_all, mask_all, n: int):
+        """Fused-serving embed half, ragged layout: the whole tick is ONE
+        concatenated-token launch (vs one per (batch, seq) bucket), and
+        the ``[row_bucket, dim]`` device output keeps the
+        :meth:`encode_padded` contract — rows at/after ``n`` are pads the
+        search discards, and the row bucket is the same power-of-two grid
+        ``bucket_q`` pads to."""
+        from ..internals.flight_recorder import record_padding
+
+        longest = int(mask_all.sum(axis=1).max())
+        if self.mesh is not None and longest > SEQ_BUCKETS[-1]:
+            # same refusal as the bucketed tick: over-cap documents go
+            # sequence-parallel, not silently truncated
+            raise ValueError("batch needs the sequence-parallel ring path")
+        prepared, stats = ragged_prepare(
+            ids_all, mask_all, self.max_length,
+            vocab_size=self.cfg.vocab_size, max_tokens=self.max_tokens,
+            # the fused tick IS the one-launch case — never split it by
+            # seq bucket (the whole-tick launch is the contract)
+            mix_buckets=True,
+        )
+        if len(prepared) != 1:
+            # a tick too big for one launch (token budget / VMEM cap)
+            # falls back to the multi-launch host path, same as the
+            # bucketed impl's max_tokens refusal
+            raise ValueError(
+                f"padded tick of {stats['real_tokens']} tokens needs "
+                f"{len(prepared)} ragged launches; fused tick wants one"
+            )
+        payload, _rows, _tokens = prepared[0]
+        record_padding(
+            stats["real_tokens"], stats["padded_tokens"], stats["row_tokens"]
+        )
+        return self.encode_prepared(payload), n
 
     def _encode_ring(self, ids_all, mask_all) -> np.ndarray:
         """Sequence-parallel path for documents beyond the bucket cap."""

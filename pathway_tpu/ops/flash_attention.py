@@ -13,13 +13,19 @@ Design (see /opt/skills/guides/pallas_guide.md):
   writes only the [seq, head_dim] output to HBM.  No S² intermediate
   ever touches HBM, which is the entire memory win of "flash" attention;
   the streaming/online-softmax machinery only pays off when S² outgrows
-  VMEM (seq ≳ 2k), which this encoder never reaches.
+  VMEM (seq ≳ 2k), which this encoder never reaches.  (For the packed
+  ragged layout — one launch per tick, near-zero padding — see
+  ops/ragged_attention.py, which DOES stream kv blocks.)
 * Softmax accumulates in f32 regardless of input dtype (bf16 on chip).
-* grid = (batch, heads): each program owns one head of one row, so the
-  MXU sees [seq, head_dim] × [head_dim, seq] and [seq, seq] × [seq,
-  head_dim] matmuls back-to-back.  head_dim 32 underfills the 128-lane
-  tile (pallas pads); the matmuls still land on the MXU and the S×S
-  softmax — the part XLA-CPU/HBM handles worst — stays vectorized.
+* grid = (batch·heads,): programs tile over the FLATTENED batch×head
+  axis — one grid dimension Mosaic can pipeline freely instead of a
+  (batch, heads) nest whose inner dimension is tiny (12 heads), and the
+  same geometry the ragged kernel launches with.  Each program owns one
+  head of one row: the MXU sees [seq, head_dim] × [head_dim, seq] and
+  [seq, seq] × [seq, head_dim] matmuls back-to-back.  head_dim 32
+  underfills the 128-lane tile (pallas pads); the matmuls still land on
+  the MXU and the S×S softmax — the part XLA-CPU/HBM handles worst —
+  stays vectorized.
 * Padding mask is per-key ([batch, kv]); the encoder never uses causal
   or pairwise masks.
 
@@ -36,15 +42,17 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from .ragged_attention import validate_attention_geometry
+
 __all__ = ["flash_attention"]
 
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, *, sm_scale: float):
-    q = q_ref[0, 0].astype(jnp.float32)  # [sq, dh]
-    k = k_ref[0, 0].astype(jnp.float32)  # [skv, dh]
-    v = v_ref[0, 0].astype(jnp.float32)  # [skv, dh]
+    q = q_ref[0].astype(jnp.float32)  # [sq, dh]
+    k = k_ref[0].astype(jnp.float32)  # [skv, dh]
+    v = v_ref[0].astype(jnp.float32)  # [skv, dh]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -54,55 +62,87 @@ def _attn_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, *, sm_scale: float):
     s = s - jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s)
     p = p / jnp.sum(p, axis=-1, keepdims=True)
-    o_ref[0, 0] = jax.lax.dot(
+    o_ref[0] = jax.lax.dot(
         p, v, preferred_element_type=jnp.float32
     ).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
-def _flash(q, k, v, kv_mask, sm_scale: float, interpret: bool):
-    batch, heads, sq, dh = q.shape
-    skv = k.shape[2]
-    grid = (batch, heads)
+@functools.partial(jax.jit, static_argnames=("heads", "sm_scale", "interpret"))
+def _flash(q, k, v, kv_mask, heads: int, sm_scale: float, interpret: bool):
+    # q/k/v arrive flattened [batch*heads, seq, dh]: ONE grid dimension
+    # tiling batch×head programs (launch-geometry rework, ISSUE 9)
+    bh, sq, dh = q.shape
+    skv = k.shape[1]
+    grid = (bh,)
 
     def spec(seq):
-        return pl.BlockSpec((1, 1, seq, dh), lambda b, h: (b, h, 0, 0))
+        return pl.BlockSpec((1, seq, dh), lambda i: (i, 0, 0))
 
     # Mosaic requires each of a block's last two dims to be a multiple of
     # the dtype tile OR the full array dim.  A (1, skv) block over a
     # (batch, skv) mask violates that (second-minor 1 ∉ {32k, batch}), so
     # the mask rides as [batch, 1, skv]: block (1, 1, skv) has second-minor
     # == full dim 1 and minor == skv (a 128-multiple bucket) — both legal.
-    mask_spec = pl.BlockSpec((1, 1, skv), lambda b, h: (b, 0, 0))
+    # Programs i..i+heads-1 share row i // heads of the mask.
+    mask_spec = pl.BlockSpec((1, 1, skv), lambda i: (i // heads, 0, 0))
     return pl.pallas_call(
         functools.partial(_attn_kernel, sm_scale=sm_scale),
         grid=grid,
         in_specs=[spec(sq), spec(skv), spec(skv), mask_spec],
         out_specs=spec(sq),
-        out_shape=jax.ShapeDtypeStruct((batch, heads, sq, dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, dh), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * sq * skv * dh,
+            bytes_accessed=(bh * (sq + 2 * skv) * dh + bh * sq * dh)
+            * q.dtype.itemsize,
+            transcendentals=bh * sq * skv,
+        ),
         interpret=interpret,
     )(q, k, v, kv_mask)
 
 
-def flash_attention(query, key, value, kv_mask=None, sm_scale=None):
+def flash_attention(
+    query, key, value, kv_mask=None, sm_scale=None, pre_scaled: bool = False
+):
     """Fused attention over flax layout ``[batch, seq, heads, head_dim]``.
 
     ``kv_mask``: optional per-key padding mask ``[batch, kv_len]`` (nonzero
     = attend).  Returns ``[batch, q_len, heads, head_dim]`` in the input
     dtype.  Off-TPU the kernel runs in pallas interpret mode (slow but
     exact) so correctness is testable on the CPU mesh.
+
+    ``pre_scaled=True`` declares the caller already folded the softmax
+    scale into ``query`` — combining it with an explicit ``sm_scale``
+    raises instead of silently double-scaling (flax does NOT pre-scale
+    when a custom ``attention_fn`` is supplied, but direct callers have
+    been bitten).  Geometry is validated up front: a ``head_dim`` the
+    128-lane MXU tile can't divide fails here with the knob named
+    instead of deep inside Mosaic lowering.
     """
-    if sm_scale is None:
+    if pre_scaled:
+        if sm_scale is not None:
+            raise ValueError(
+                "flash_attention: pre_scaled=True with an explicit sm_scale "
+                "would double-scale the logits — pass one or the other"
+            )
+        sm_scale = 1.0
+    elif sm_scale is None:
         sm_scale = 1.0 / math.sqrt(query.shape[-1])
+    validate_attention_geometry(
+        int(query.shape[-1]), float(sm_scale), knob="attention_impl='pallas'"
+    )
     if kv_mask is None:
         kv_mask = jnp.ones(key.shape[:2], jnp.int32)
     # int32 (not int8): sub-word dtypes hit stricter Mosaic tiling rules
     # and buy nothing here (mask is batch×skv ≤ a few KB per block)
     kv_mask = kv_mask.astype(jnp.int32)[:, None, :]
-    # [b, s, h, d] → [b, h, s, d]
-    q = jnp.transpose(query, (0, 2, 1, 3))
-    k = jnp.transpose(key, (0, 2, 1, 3))
-    v = jnp.transpose(value, (0, 2, 1, 3))
+    batch, sq, heads, dh = query.shape
+    skv = key.shape[1]
+    # [b, s, h, d] → [b, h, s, d] → [b·h, s, d]
+    q = jnp.transpose(query, (0, 2, 1, 3)).reshape(batch * heads, sq, dh)
+    k = jnp.transpose(key, (0, 2, 1, 3)).reshape(batch * heads, skv, dh)
+    v = jnp.transpose(value, (0, 2, 1, 3)).reshape(batch * heads, skv, dh)
     interpret = jax.default_backend() != "tpu"
-    out = _flash(q, k, v, kv_mask, float(sm_scale), interpret)
+    out = _flash(q, k, v, kv_mask, heads, float(sm_scale), interpret)
+    out = out.reshape(batch, heads, sq, dh)
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -127,6 +127,17 @@ def _device_count() -> int | None:
         return None
 
 
+def _active_attention_impl() -> str | None:
+    """The process's serving attention impl (most recently built
+    encoder), for the runtime stats/health block."""
+    try:
+        from ..internals.flight_recorder import active_attention_impl
+
+        return active_attention_impl()
+    except Exception:  # noqa: BLE001 — stats must never raise
+        return None
+
+
 def estimate_tokens(item: Any) -> int:
     """Cheap token-mass estimate for budget batching: whitespace words
     + CLS/SEP for text (wordpiece splits only lengthen it, which errs on
@@ -352,7 +363,11 @@ class DeviceTickRuntime:
         self._share_hist = Histogram(_SHARE_BUCKETS)
         from ..internals.monitoring import register_metrics_provider
 
-        register_metrics_provider(name, self)
+        # replace=False: an ad-hoc instance must not steal (and, being
+        # weakly held, later delete) an established registration under
+        # the same name — the process-global runtime re-registers
+        # authoritatively in get_runtime()
+        register_metrics_provider(name, self, replace=False)
 
     # -- submission ------------------------------------------------------
     def on_runtime_thread(self) -> bool:
@@ -801,6 +816,10 @@ class DeviceTickRuntime:
                 "min_share": {c.label: self.min_share[c] for c in QoS},
                 "depth_targets": {c.label: self.depth[c] for c in QoS},
                 "devices": _device_count(),
+                # which attention kernel the tick's embed work runs on
+                # (PATHWAY_ATTENTION_IMPL observable; None = no encoder
+                # built in this process yet)
+                "attention_impl": _active_attention_impl(),
             }
 
     def openmetrics_lines(self) -> list[str]:
@@ -968,6 +987,12 @@ def get_runtime() -> DeviceTickRuntime:
                 depth=dict(_SETTINGS["depth"]),
                 min_share=dict(_SETTINGS["min_share"]),
             )
+            # the global runtime is the authoritative "runtime" metrics
+            # provider — claim the name even if an ad-hoc instance
+            # registered first
+            from ..internals.monitoring import register_metrics_provider
+
+            register_metrics_provider(_GLOBAL.name, _GLOBAL)
         return _GLOBAL
 
 

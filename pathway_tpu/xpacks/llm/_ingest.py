@@ -196,9 +196,35 @@ class IngestPipeline:
         return self.submit(texts).result()
 
     # -- stage 1: host tokenize + pack ----------------------------------
+    def _prepare(self, ids_all, mask_all):
+        """Host half of the dispatch in the ENCODER's layout: the
+        prepared-chunk protocol (``prepare_chunks``: packed (bb, seq)
+        buckets or the ragged concatenated-token layout, per
+        ``attention_impl``) when the encoder speaks it; the legacy
+        packed_prepare shape for bare duck-typed encoders.  Either way
+        every entry is ``(payload, rows, tokens)``."""
+        enc = self.encoder
+        prepare = getattr(enc, "prepare_chunks", None)
+        if prepare is not None:
+            return prepare(ids_all, mask_all, max_tokens=self.max_tokens)
+        from ...models.encoder import packed_prepare
+
+        prepared, stats = packed_prepare(
+            ids_all, mask_all, enc.max_length,
+            vocab_size=enc.cfg.vocab_size,
+            batch_multiple=getattr(enc, "_batch_multiple", 1),
+            max_tokens=self.max_tokens,
+        )
+        return (
+            [
+                ((ids, mask, tids), rows, int(np.asarray(ids).size))
+                for ids, mask, tids, rows in prepared
+            ],
+            stats,
+        )
+
     def _tokenize_loop(self) -> None:
         from ...internals.flight_recorder import record_span
-        from ...models.encoder import packed_prepare
 
         enc = self.encoder
         while True:
@@ -217,12 +243,7 @@ class IngestPipeline:
                     (time.monotonic() - t0) * 1000.0,
                     attrs={"docs": len(item.texts)},
                 )
-                item.prepared, item.stats = packed_prepare(
-                    ids_all, mask_all, enc.max_length,
-                    vocab_size=enc.cfg.vocab_size,
-                    batch_multiple=getattr(enc, "_batch_multiple", 1),
-                    max_tokens=self.max_tokens,
-                )
+                item.prepared, item.stats = self._prepare(ids_all, mask_all)
             except BaseException as exc:  # noqa: BLE001 — fail THIS batch only
                 if not item.future.done():
                     item.future.set_exception(exc)
@@ -245,20 +266,35 @@ class IngestPipeline:
         async backlog).  One tick in flight at a time is the executor's
         whole contract with the device."""
         assert len(payloads) == 1
-        out = self._encode_chunk(*payloads[0])
+        out = self._encode_chunk(payloads[0])
         import jax
 
         jax.block_until_ready(out)
         return [out]
 
-    def _encode_chunk(self, ids, mask, tids) -> Any:
-        import jax.numpy as jnp
-
+    def _encode_chunk(self, payload) -> Any:
         from ...internals.flight_recorder import record_span
 
         enc = self.encoder
+        encode_prepared = getattr(enc, "encode_prepared", None)
         wall = time.time()
         t0 = time.monotonic()
+        if encode_prepared is not None:
+            # the encoder's own device half: packed (bb, seq) launch or
+            # ONE ragged concatenated-token launch, H2D + mesh placement
+            # included (attention_impl-aware)
+            out = encode_prepared(payload)
+            record_span(
+                "encode", "ingest", wall,
+                (time.monotonic() - t0) * 1000.0,
+                attrs={"tokens": int(np.asarray(payload[0]).size)
+                       if isinstance(payload, tuple)
+                       else int(np.asarray(payload.ids).size)},
+            )
+            return out
+        import jax.numpy as jnp
+
+        ids, mask, tids = payload
         args = [jnp.asarray(ids), jnp.asarray(mask)]
         if tids is not None:
             args.append(jnp.asarray(tids))
@@ -305,14 +341,17 @@ class IngestPipeline:
                     # batch's future; the pipeline keeps draining
                     faults.perturb("embedder")
                 record_padding(
-                    item.stats["real_tokens"], item.stats["padded_tokens"]
+                    item.stats["real_tokens"],
+                    item.stats["padded_tokens"],
+                    item.stats.get("row_tokens"),
                 )
                 if self.use_runtime:
                     # every prepared chunk is one BULK_INGEST work item:
-                    # tokens = its padded token mass, coalesce 0 (a
-                    # backlog never waits for tick-mates).  Interactive
-                    # ticks slot in between chunks; the min-share bound
-                    # keeps this batch progressing under query floods.
+                    # tokens = its padded token mass (one ragged launch
+                    # == one item too), coalesce 0 (a backlog never
+                    # waits for tick-mates).  Interactive ticks slot in
+                    # between chunks; the min-share bound keeps this
+                    # batch progressing under query floods.
                     from ...runtime import QoS, get_runtime
 
                     rt = get_runtime()
@@ -320,14 +359,14 @@ class IngestPipeline:
                         (
                             rt.submit(
                                 self._encode_group,
-                                (ids, mask, tids),
+                                payload,
                                 qos=QoS.BULK_INGEST,
-                                tokens=int(np.asarray(ids).size),
+                                tokens=int(tokens),
                                 coalesce_s=0.0,
                             ),
                             rows,
                         )
-                        for ids, mask, tids, rows in item.prepared
+                        for payload, rows, tokens in item.prepared
                     ]
                     # all chunks must encode before anything stages:
                     # a failed chunk fails the WHOLE batch pre-upsert,
@@ -335,8 +374,8 @@ class IngestPipeline:
                     outs = [(f.result(), rows) for f, rows in futs]
                 else:
                     outs = [
-                        (self._encode_chunk(ids, mask, tids), rows)
-                        for ids, mask, tids, rows in item.prepared
+                        (self._encode_chunk(payload), rows)
+                        for payload, rows, _tokens in item.prepared
                     ]
                 if self.index is not None:
                     wall = time.time()
