@@ -229,6 +229,73 @@ def child_device(seconds: float = 10.0) -> None:
             extra["ragged_vs_packed"] = round(ragged_dps / baseline_dps, 3)
         extra["attn_impl_by_variant"]["ragged"] = "ragged"
 
+    def _quant_ab(n_rows: int, reps: int = 5) -> None:
+        """In-run f32-vs-int8 brute-force search A/B (ISSUE 11): the
+        same seeded corpus resident both ways, the same query batches,
+        docs/s (= corpus rows scored per second), recall@10 of the
+        quantized path against the f32 oracle, and HBM bytes/vector.
+        On CPU this exercises the XLA reference scoring — the honest
+        caveat is that XLA-CPU has no vectorized int8 path, so the
+        bandwidth win is a TPU/HBM property (like the mesh suite, the
+        real-chip number banks via chip_watch's quant suite)."""
+        import numpy as np
+
+        import jax as _jax
+        from pathway_tpu.ops.knn import DeviceKnnIndex
+
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(7)
+        dim, n_q, k = 384, 8, 10
+        corpus = rng.standard_normal((n_rows, dim)).astype(np.float32)
+        queries = rng.standard_normal((16, n_q, dim)).astype(np.float32)
+        keys = list(range(n_rows))
+        corpus_dev = jnp.asarray(corpus)  # device staging: the ingest plane
+        results = {}
+        recall_base = None
+        for label, kwargs in (
+            ("f32", {}),
+            ("int8", {"index_dtype": "int8"}),
+        ):
+            idx = DeviceKnnIndex(dim=dim, capacity=n_rows, **kwargs)
+            idx.upsert_batch(keys, corpus_dev)
+            idx.search(queries[0], k)  # apply + compile warm
+            t0 = time.perf_counter()
+            total_q = 0
+            all_res = []
+            for rep in range(reps):
+                for qb in queries:
+                    res = idx.search(qb, k)
+                    total_q += n_q
+                    if rep == reps - 1:  # recall over EVERY query batch
+                        all_res.extend(res)
+            elapsed = time.perf_counter() - t0
+            results[label] = {
+                "search_docs_per_sec": round(n_rows * total_q / elapsed, 1),
+                "ms_per_query_batch": round(
+                    elapsed / (reps * len(queries)) * 1000, 3
+                ),
+                "hbm_bytes_per_vector": round(idx.hbm_bytes() / n_rows, 2),
+            }
+            if label == "f32":
+                recall_base = [{key for key, _s in row} for row in all_res]
+            else:
+                hits = sum(
+                    len(truth & {key for key, _s in row})
+                    for truth, row in zip(recall_base, all_res)
+                )
+                results["recall_at_10"] = round(
+                    hits / (len(all_res) * k), 4
+                )
+        results["int8_vs_f32"] = round(
+            results["int8"]["search_docs_per_sec"]
+            / results["f32"]["search_docs_per_sec"],
+            3,
+        )
+        results["corpus_rows"] = n_rows
+        results["platform"] = _jax.devices()[0].platform
+        extra["quant_ab"] = results
+
     # escalating warmup: a small bucket compiles fast and guarantees a
     # number even on a slow/contended chip; the big bucket (better RPC
     # amortization + MXU fill) upgrades the number only if the child's
@@ -274,6 +341,21 @@ def child_device(seconds: float = 10.0) -> None:
             _ragged_ab(enc_r, small, docs_per_sec)
         except Exception as exc:
             msg = f"ragged A/B failed: {exc!r}"[:300]
+            extra["ab_warning"] = (
+                f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
+            )
+        _emit_device_result(docs_per_sec, dev, attn, **extra)
+    # quantized-index search A/B (ISSUE 11) on the CPU fallback: XLA
+    # reference scoring + recall/bytes — the real-chip kernel number
+    # banks in the TPU branch below and via chip_watch's quant suite
+    if (
+        os.environ.get("BENCH_CPU_FALLBACK")
+        and time.monotonic() + 45 < child_deadline
+    ):
+        try:
+            _quant_ab(16384, reps=3)
+        except Exception as exc:
+            msg = f"quant A/B failed: {exc!r}"[:300]
             extra["ab_warning"] = (
                 f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
             )
@@ -439,6 +521,22 @@ def child_device(seconds: float = 10.0) -> None:
             extra["attn_impl_by_variant"]["compute_only"] = attn
         except Exception as exc:
             msg = f"compute-only probe failed: {exc!r}"[:300]
+            extra["ab_warning"] = (
+                f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
+            )
+        _emit_device_result(docs_per_sec, dev, best_attn, **extra)
+
+    # quantized-index search A/B on the REAL chip: the Pallas asymmetric
+    # kernel streaming int8 codes from HBM vs the f32 tiled path — the
+    # memory-bandwidth headline of ISSUE 11 (4x fewer bytes/vector)
+    if (
+        dev.platform == "tpu"
+        and time.monotonic() + 180 + seconds < child_deadline
+    ):
+        try:
+            _quant_ab(131072)
+        except Exception as exc:
+            msg = f"quant A/B failed: {exc!r}"[:300]
             extra["ab_warning"] = (
                 f"{extra['ab_warning']}; {msg}" if "ab_warning" in extra else msg
             )
@@ -840,6 +938,7 @@ def main() -> None:
             "wire_bf16_docs_per_sec",
             "compute_only_docs_per_sec",
             "mfu_compute_only",
+            "quant_ab",
             "attn_impl_by_variant",
         ):
             if result.get(opt) is not None:

@@ -28,6 +28,12 @@ moment a probe succeeds it fires the full chip measurement stack:
      ``PATHWAY_RUNTIME=0``), appended to
      ``benchmarks/serving_results.jsonl``.
 
+  7. ``benchmarks/knn_crossover.py 65536 262144`` (the ``quant``
+     suite) → int8-vs-f32 brute-force search A/B with the Pallas
+     asymmetric-distance kernel on real HBM, per-size rows + the
+     quantized crossover summary appended to
+     ``benchmarks/chip_results.jsonl`` (metric ``knn_quant``).
+
 After every window in which the measurement stack ran, a consolidated
 **chip-bank record** (``{"metric": "chip_bank", docs_per_sec, mfu,
 pallas_docs_per_sec, fused_docs_per_sec, ...}``) is appended to
@@ -225,6 +231,47 @@ def fire_ragged() -> bool:
     )
 
 
+def fire_quant() -> bool:
+    """int8-vs-f32 brute-force search A/B on the real chip
+    (benchmarks/knn_crossover.py: exact f32, exact int8 via the Pallas
+    asymmetric-distance kernel, LSH — per-size rows + the quantized
+    crossover summary).  Every real-TPU number so far predates the
+    quantized index; this banks the first one.  Success requires a
+    platform=="tpu" line carrying the int8 measurement — a CPU fallback
+    measures XLA's conversion path, not HBM bandwidth, and must not
+    bank.  TPU rows land in chip_results.jsonl tagged metric=knn_quant."""
+    name = "knn_crossover.py 65536 262144"
+    _log(f"running {name} (budget 700s)")
+    rc, out = _run(
+        [os.path.join(HERE, "knn_crossover.py"), "65536", "262144"],
+        760.0,
+        {"KNN_BUDGET_S": "700"},
+    )
+    # keep only the LAST line per corpus size: knn_crossover prints each
+    # size's row twice (the int8-stage salvage point, then the final row
+    # with the LSH fields) — banking both would duplicate records
+    by_key: dict = {}
+    for line in (out or "").strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("platform") != "tpu":
+            continue
+        if "int8_ms_per_query" in rec or rec.get("metric") == "knn_quant_crossover":
+            rec.setdefault("metric", "knn_quant")
+            by_key[(rec["metric"], rec.get("n"))] = rec
+    ok = False
+    for rec in by_key.values():
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        with open(RESULTS, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        if "int8_ms_per_query" in rec:
+            ok = True
+    _log(f"{name} rc={rc} tpu={ok} tail: {out[-300:]!r}")
+    return ok
+
+
 def fire_mesh() -> bool:
     """Multi-chip serving scaling on the real mesh (serving_bench.py
     --mesh 8: single-device vs 8-way-sharded serving of the same corpus;
@@ -387,6 +434,7 @@ def main() -> int:
         "ragged": False,
         "contention": False,
         "mesh": False,
+        "quant": False,
     }
     fire = {
         "bench": fire_bench,
@@ -397,6 +445,7 @@ def main() -> int:
         "ragged": fire_ragged,
         "contention": fire_contention,
         "mesh": fire_mesh,
+        "quant": fire_quant,
     }
     last_bank = None  # monotonic() of the last banked record
     any_banked = False

@@ -460,7 +460,34 @@ def run_kill(mock: bool = False) -> dict:
         proc.kill()
         proc.wait()
 
-        report["results_match_oracle"] = restored_results == oracle_results
+        # bit-identity is the f32/bf16 contract.  At int8 the codes
+        # restore bit-identical (pinned by test) but the f32 rescore
+        # RING is a non-durable cache tier: the never-killed oracle
+        # answers ring-exact scores for recently-written rows where the
+        # restarted process answers the quantized score until rewrites
+        # re-warm the ring — so the harness compares keys exactly and
+        # scores within quantization tolerance there (mode reported).
+        if os.environ.get("PATHWAY_INDEX_DTYPE", "f32").lower() == "int8":
+            # key SETS, not key order: the same score divergence the
+            # tolerance admits can also swap near-tied neighbors' ranks
+            report["match_mode"] = "keys+quantized-score-tolerance"
+
+            def _rows_match(row_r, row_o):
+                dr, do = dict(row_r), dict(row_o)
+                return set(dr) == set(do) and all(
+                    abs(dr[t] - do[t]) <= 0.02 + 1e-6 * abs(do[t])
+                    for t in do
+                )
+
+            report["results_match_oracle"] = len(restored_results) == len(
+                oracle_results
+            ) and all(
+                _rows_match(row_r, row_o)
+                for row_r, row_o in zip(restored_results, oracle_results)
+            )
+        else:
+            report["match_mode"] = "bit-identical"
+            report["results_match_oracle"] = restored_results == oracle_results
         report["zero_reembed_on_restore"] = (
             final["embed_calls"] == 0 and final["restored_rows"] >= n_docs
         )

@@ -218,6 +218,20 @@ class HealthRegistry:
                     snap["mesh"] = mesh
         except Exception:  # noqa: BLE001 — health must never raise
             pass
+        # index quantization: storage dtype, HBM footprint and rescore
+        # configuration of every live device index — read-only and gated
+        # on ops/knn already being imported (a health probe never pulls
+        # in jax state)
+        try:
+            import sys as _sys
+
+            mod = _sys.modules.get("pathway_tpu.ops.knn")
+            if mod is not None:
+                quant = mod.quantization_status()
+                if quant:
+                    snap["quantization"] = quant
+        except Exception:  # noqa: BLE001 — health must never raise
+            pass
         try:
             from ..testing import faults
 

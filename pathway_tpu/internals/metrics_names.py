@@ -116,6 +116,20 @@ METRICS: dict[str, tuple[str, str]] = {
         "gauge",
         "ingest -> queryable lag of the last index update, per index",
     ),
+    # index quantization (pathway_tpu/ops/knn.py) — every series carries
+    # an index label; dtype adds a dtype label
+    "pathway_index_dtype": (
+        "gauge",
+        "resident storage dtype of each live KNN index (f32/bf16/int8)",
+    ),
+    "pathway_index_hbm_bytes": (
+        "gauge",
+        "resident device bytes per index (codes+scales+rescore ring when int8)",
+    ),
+    "pathway_index_rescore_depth": (
+        "gauge",
+        "stage-1 candidate funnel depth of the quantized rescore (0 = unquantized)",
+    ),
     # XLA compilation (internals/flight_recorder.py, wrapped jit entry points)
     "pathway_xla_compile_total": (
         "counter",

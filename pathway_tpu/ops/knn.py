@@ -20,8 +20,10 @@ The multi-device sharded variant lives in ``pathway_tpu/parallel/index.py``.
 from __future__ import annotations
 
 import functools
+import itertools
 import os
 import threading
+import weakref
 from typing import Any, Hashable, Sequence
 
 import jax
@@ -29,8 +31,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from .topk import topk_search
+from .quantized_scoring import (
+    dequantize_record,
+    is_quant_record,
+    kernel_mode,
+    quantize_jnp,
+    rescore_cache_rows_default,
+    rescore_depth_default,
+    resolve_index_dtype,
+)
 
-__all__ = ["DeviceKnnIndex", "upsert_slice_rows", "upsert_coalesce_rows"]
+__all__ = [
+    "DeviceKnnIndex",
+    "upsert_slice_rows",
+    "upsert_coalesce_rows",
+    "quantization_status",
+]
 
 
 def upsert_slice_rows() -> int:
@@ -82,15 +98,59 @@ class DeviceKnnIndex:
         dim: int,
         metric: str = "cos",
         capacity: int = 1024,
-        dtype=jnp.float32,
+        dtype=None,
+        index_dtype: str | None = None,
+        rescore_depth: int | None = None,
+        rescore_cache_rows: int | None = None,
     ):
         if metric not in ("cos", "l2sq", "dot"):
             raise ValueError(f"unknown metric {metric!r}")
         self.dim = dim
         self.metric = metric
-        self.dtype = dtype
+        #: storage-dtype knob value ("f32" / "bf16" / "int8"); explicit
+        #: arg > explicit jnp dtype > PATHWAY_INDEX_DTYPE process default
+        self.index_dtype = resolve_index_dtype(index_dtype, dtype)
+        self.quantized = self.index_dtype == "int8"
+        if self.quantized:
+            # compute dtype for queries/rescoring; codes live in int8
+            self.dtype = jnp.float32
+        else:
+            self.dtype = jnp.bfloat16 if self.index_dtype == "bf16" else jnp.float32
         self.capacity = self._round_capacity(int(capacity))
-        self.vectors = jnp.zeros((self.capacity, dim), dtype=dtype)
+        if self.quantized:
+            self.vectors = None  # stale f32 paths must fail loudly
+            self.codes = jnp.zeros((self.capacity, dim), dtype=jnp.int8)
+            self.scales = jnp.zeros((self.capacity,), dtype=jnp.float32)
+            #: stage-1 candidate funnel depth (effective per-search depth
+            #: is bucket_k(max(k, rescore_depth)))
+            self.rescore_depth = (
+                int(rescore_depth)
+                if rescore_depth is not None
+                else rescore_depth_default()
+            )
+            #: f32 rescore ring: recently written rows keep an exact
+            #: full-precision copy (the latency-critical tier)
+            self.rescore_cache_rows = (
+                int(rescore_cache_rows)
+                if rescore_cache_rows is not None
+                else rescore_cache_rows_default()
+            )
+            r = self.rescore_cache_rows
+            self.rescore_vecs = jnp.zeros((r, dim), dtype=jnp.float32)
+            self.cache_map = jnp.full((self.capacity,), -1, dtype=jnp.int32)
+            # host mirrors of the ring (truth for rebuilds/compaction):
+            # slot -> ring row, ring row -> slot (-1 empty), next ring pos
+            self._cache_row_of_slot: dict[int, int] = {}
+            self._cache_slot_of_row = np.full((r,), -1, dtype=np.int64)
+            self._cache_next = 0
+            # snapshot-restored rows staged as ready-made codes (zero
+            # re-quantization): slot -> (codes int8 [dim], scale f32)
+            self._staged_coded: dict[int, tuple[np.ndarray, np.float32]] = {}
+        else:
+            self.vectors = jnp.zeros((self.capacity, dim), dtype=self.dtype)
+            self.rescore_depth = 0
+            self.rescore_cache_rows = 0
+            self._staged_coded = {}
         self.valid = jnp.zeros((self.capacity,), dtype=bool)
         self.key_of_slot: list[Hashable | None] = [None] * self.capacity
         self.slot_of_key: dict[Hashable, int] = {}
@@ -111,11 +171,18 @@ class DeviceKnnIndex:
         self._scatter_rows_fn = _scatter_rows
         self._scatter_mask_fn = _scatter_mask
         self._scatter_dropping_fn = _scatter_rows_dropping
+        self._quant_scatter_fn = _quant_scatter
+        self._coded_scatter_fn = _coded_scatter
         #: fatal-device-fault recoveries performed (rebuild_device_arrays)
         self.rebuilds = 0
         #: staged-device scatters actually dispatched (after coalescing) —
         #: the observable the coalescing satellite pins by test
         self.scatter_dispatches = 0
+        #: quantized searches answered (quantization-block observable)
+        self.quant_searches = 0
+        self.quant_label = f"knn{next(_quant_label_seq)}"
+        _LIVE_INDEXES.add(self)
+        _ensure_index_provider()
 
     def _round_capacity(self, capacity: int) -> int:
         """Capacities at/above the Pallas threshold are kept at multiples
@@ -135,6 +202,26 @@ class DeviceKnnIndex:
     def __len__(self) -> int:
         return len(self.slot_of_key)
 
+    def hbm_bytes(self) -> int:
+        """Resident device bytes of this index (matrix + tombstones +,
+        when quantized, scales, rescore ring and slot→ring table) — the
+        ``pathway_index_hbm_bytes`` observable."""
+        cap = self.capacity
+        if self.quantized:
+            # the ring and the slot→ring table REPLICATE on a mesh (see
+            # ShardedKnnIndex) — count every copy, or an operator sizing
+            # corpus-per-chip from this gauge overcommits HBM
+            repl = getattr(self, "n_shards", 1)
+            return (
+                cap * self.dim  # int8 codes
+                + cap * 4  # f32 scales
+                + repl * cap * 4  # int32 cache map (replicated)
+                + repl * self.rescore_cache_rows * self.dim * 4  # f32 ring
+                + cap  # bool tombstones
+            )
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return cap * self.dim * itemsize + cap
+
     # -- mutation --
     def upsert(self, key: Hashable, vector: Any) -> None:
         with self._lock:
@@ -146,7 +233,12 @@ class DeviceKnnIndex:
             raise ValueError(
                 f"vector dim {vec.shape[0]} != index dim {self.dim}"
             )
-        if self.metric == "cos":
+        if self.metric == "cos" and not self.quantized:
+            # quantized rows stage RAW and normalize inside the fused
+            # device quantize scatter instead — host- and device-staged
+            # rows then share ONE normalization arithmetic, so their
+            # codes and scales are bit-identical (the invariant the
+            # snapshot plane's verbatim code export rests on)
             norm = float(np.linalg.norm(vec))
             if norm > 0:
                 vec = vec / norm
@@ -158,7 +250,45 @@ class DeviceKnnIndex:
             self.slot_of_key[key] = slot
             self.key_of_slot[slot] = key
         self._staged_set[slot] = vec
+        self._staged_coded.pop(slot, None)
         self._staged_valid[slot] = True
+
+    def upsert_coded(self, key: Hashable, record: dict) -> None:
+        """Stage one snapshot record (``quantize_record_np`` output) —
+        the zero-re-quantization restore path: a quantized index scatters
+        the codes straight back into HBM; any other dtype dequantizes
+        once and takes the normal upsert path."""
+        with self._lock:
+            if not self.quantized:
+                self._upsert_locked(key, dequantize_record(record))
+                return
+            codes = np.asarray(record["codes"], dtype=np.int8).reshape(-1)
+            if codes.shape[0] != self.dim:
+                raise ValueError(
+                    f"record dim {codes.shape[0]} != index dim {self.dim}"
+                )
+            slot = self.slot_of_key.get(key)
+            if slot is None:
+                if not self.free:
+                    self._grow()
+                slot = self.free.pop()
+                self.slot_of_key[key] = slot
+                self.key_of_slot[slot] = key
+            self._staged_coded[slot] = (codes, np.float32(record["scale"]))
+            self._staged_set.pop(slot, None)
+            self._staged_valid[slot] = True
+            # a coded write supersedes any cached f32 copy of the slot's
+            # previous value — drop the host mapping and force a device
+            # cache_map rebuild at apply time.  The rebuild is marked
+            # UNCONDITIONALLY: a slot recycled from the free list may
+            # still carry a stale DEVICE mapping from a deleted key
+            # (harmless while tombstoned, but a coded revive would score
+            # the new key against the old key's ring vector), and the
+            # host mirror cannot see that entry.
+            pos = self._cache_row_of_slot.pop(slot, None)
+            if pos is not None and self._cache_slot_of_row[pos] == slot:
+                self._cache_slot_of_row[pos] = -1
+            self._cache_map_dirty = True
 
     #: opt-out hook for subclasses that cannot take device-array staging;
     #: the mesh-sharded index (parallel/index.py) used to set this False —
@@ -205,6 +335,7 @@ class DeviceKnnIndex:
                 # this device value supersedes any host value staged
                 # earlier for the slot (FIFO batches apply before the dict)
                 self._staged_set.pop(slot, None)
+                self._staged_coded.pop(slot, None)
                 self._staged_valid[slot] = True
                 # a repeated key within ONE batch would put the same index
                 # into the scatter twice — XLA applies duplicate updates in
@@ -241,15 +372,35 @@ class DeviceKnnIndex:
         self.free.append(slot)
         self._staged_valid[slot] = False
         self._staged_set.pop(slot, None)
+        self._staged_coded.pop(slot, None)
+        if self.quantized:
+            # ring hygiene only: the device cache_map entry may stay —
+            # a tombstoned slot scores -inf in stage 1 and the rescore
+            # keeps -inf for invalid candidates, so a stale mapping can
+            # never resurrect the row
+            pos = self._cache_row_of_slot.pop(slot, None)
+            if pos is not None and self._cache_slot_of_row[pos] == slot:
+                self._cache_slot_of_row[pos] = -1
 
     def _grow(self) -> None:
         """Double capacity (reference: brute_force add :113-120)."""
         old = self.capacity
         self.capacity = self._round_capacity(old * 2)
         extra = self.capacity - old
-        self.vectors = jnp.concatenate(
-            [self.vectors, jnp.zeros((extra, self.dim), dtype=self.dtype)]
-        )
+        if self.quantized:
+            self.codes = jnp.concatenate(
+                [self.codes, jnp.zeros((extra, self.dim), dtype=jnp.int8)]
+            )
+            self.scales = jnp.concatenate(
+                [self.scales, jnp.zeros((extra,), dtype=jnp.float32)]
+            )
+            self.cache_map = jnp.concatenate(
+                [self.cache_map, jnp.full((extra,), -1, dtype=jnp.int32)]
+            )
+        else:
+            self.vectors = jnp.concatenate(
+                [self.vectors, jnp.zeros((extra, self.dim), dtype=self.dtype)]
+            )
         self.valid = jnp.concatenate([self.valid, jnp.zeros((extra,), dtype=bool)])
         self.key_of_slot.extend([None] * extra)
         self.free.extend(range(self.capacity - 1, old - 1, -1))
@@ -269,13 +420,27 @@ class DeviceKnnIndex:
             return
         live_slots = sorted(self.slot_of_key.values())
         idx = jnp.asarray(np.asarray(live_slots, dtype=np.int32))
-        gathered = self.vectors[idx] if live_slots else jnp.zeros(
-            (0, self.dim), dtype=self.dtype
-        )
         pad = new_capacity - len(live_slots)
-        self.vectors = jnp.concatenate(
-            [gathered, jnp.zeros((pad, self.dim), dtype=self.dtype)]
-        )
+        if self.quantized:
+            gathered_c = self.codes[idx] if live_slots else jnp.zeros(
+                (0, self.dim), dtype=jnp.int8
+            )
+            gathered_s = self.scales[idx] if live_slots else jnp.zeros(
+                (0,), dtype=jnp.float32
+            )
+            self.codes = jnp.concatenate(
+                [gathered_c, jnp.zeros((pad, self.dim), dtype=jnp.int8)]
+            )
+            self.scales = jnp.concatenate(
+                [gathered_s, jnp.zeros((pad,), dtype=jnp.float32)]
+            )
+        else:
+            gathered = self.vectors[idx] if live_slots else jnp.zeros(
+                (0, self.dim), dtype=self.dtype
+            )
+            self.vectors = jnp.concatenate(
+                [gathered, jnp.zeros((pad, self.dim), dtype=self.dtype)]
+            )
         self.valid = jnp.concatenate(
             [
                 jnp.ones((len(live_slots),), dtype=bool),
@@ -283,6 +448,25 @@ class DeviceKnnIndex:
             ]
         )
         remap = {old: new for new, old in enumerate(live_slots)}
+        if self.quantized:
+            # remap the rescore ring's slot side; the ring rows (and the
+            # f32 vectors they hold) are untouched — only slot indices
+            # moved
+            new_row_of_slot: dict[int, int] = {}
+            slot_of_row = np.full_like(self._cache_slot_of_row, -1)
+            for slot, row in self._cache_row_of_slot.items():
+                ns = remap.get(slot)
+                if ns is not None:
+                    new_row_of_slot[ns] = row
+                    slot_of_row[row] = ns
+            self._cache_row_of_slot = new_row_of_slot
+            self._cache_slot_of_row = slot_of_row
+            self._staged_coded = {
+                remap[s]: v
+                for s, v in self._staged_coded.items()
+                if s in remap
+            }
+            self._rebuild_cache_map(new_capacity)
         self.slot_of_key = {k: remap[s] for k, s in self.slot_of_key.items()}
         self.key_of_slot = [None] * new_capacity
         for key, slot in self.slot_of_key.items():
@@ -314,6 +498,63 @@ class DeviceKnnIndex:
                 n += 1
             return n
 
+    def _rebuild_cache_map(self, capacity: int) -> None:
+        """Re-materialize the device slot→ring-row table from the host
+        mirror (capacity changes and rebuilds rewrite slot indices
+        wholesale — one H2D of ``[capacity]`` int32 beats scatter
+        surgery)."""
+        m = np.full((capacity,), -1, dtype=np.int32)
+        for slot, row in self._cache_row_of_slot.items():
+            if 0 <= slot < capacity:
+                m[slot] = row
+        self.cache_map = jnp.asarray(m)
+
+    def _assign_cache_rows(
+        self, slots: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Ring-assign rescore-cache rows for one apply batch (host
+        bookkeeping under the index lock).  Returns ``(rows, map_idx,
+        evict_idx)`` aligned with ``slots``: ``rows[j]`` is the cache row
+        receiving row j's f32 vector (``R`` = none, dropped by the OOB
+        scatter), ``map_idx[j]`` the slot whose mapping is set (capacity
+        = none), ``evict_idx[j]`` a slot whose mapping must clear first
+        (capacity = none).  A slot already resident reuses its row; a
+        batch larger than the ring keeps only its newest R rows."""
+        r = self.rescore_cache_rows
+        n = int(slots.shape[0])
+        rows = np.full((n,), r, dtype=np.int32)
+        map_idx = np.full((n,), self.capacity, dtype=np.int32)
+        evict_idx = np.full((n,), self.capacity, dtype=np.int32)
+        if r <= 0:
+            return rows, map_idx, evict_idx
+        last_j_of_row: dict[int, int] = {}
+        for j in range(n):
+            slot = int(slots[j])
+            if slot < 0:
+                continue
+            pos = self._cache_row_of_slot.get(slot)
+            if pos is None:
+                pos = self._cache_next
+                self._cache_next = (pos + 1) % r
+                old = int(self._cache_slot_of_row[pos])
+                if old >= 0 and self._cache_row_of_slot.get(old) == pos:
+                    del self._cache_row_of_slot[old]
+                    evict_idx[j] = old
+            prev_j = last_j_of_row.get(pos)
+            if prev_j is not None:
+                # the ring wrapped within this one batch: the earlier
+                # row's write must be blanked — duplicate scatter rows
+                # apply in undefined order, and its mapping would
+                # otherwise resurrect after the evict pass
+                rows[prev_j] = r
+                map_idx[prev_j] = self.capacity
+            last_j_of_row[pos] = j
+            self._cache_slot_of_row[pos] = slot
+            self._cache_row_of_slot[slot] = pos
+            rows[j] = pos
+            map_idx[j] = slot
+        return rows, map_idx, evict_idx
+
     def _apply_device_entry(self, slots: np.ndarray, vals: Any) -> None:
         """Scatter ONE staged device batch into the matrix.  Pad rows
         (slot -1) scatter out of bounds and are dropped on device; the
@@ -323,14 +564,49 @@ class DeviceKnnIndex:
         Subclasses with sharded matrices point ``_scatter_dropping_fn``
         at a mesh-pinning variant (``out_shardings``), so device-staged
         rows land in their owning shard instead of collapsing the
-        placement onto one device."""
+        placement onto one device.
+
+        A quantized index routes through the fused quantize+scatter
+        instead: rows normalize (cos) and quantize ON DEVICE, codes and
+        scales scatter into their matrices, and the f32 rows land in the
+        rescore ring — still one launch, no host round trip."""
         idx = np.where(slots >= 0, slots, self.capacity).astype(np.int32)
         self.scatter_dispatches += 1
+        if self.quantized:
+            self._apply_quantized_rows(
+                idx, slots, vals, normalize=(self.metric == "cos")
+            )
+            return
         self.vectors = self._scatter_dropping_fn(
             self.vectors,
             jnp.asarray(idx),
             vals,
             normalize=(self.metric == "cos"),
+        )
+
+    def _apply_quantized_rows(
+        self, idx: np.ndarray, slots: np.ndarray, vals: Any, normalize: bool
+    ) -> None:
+        """One fused quantize+scatter of ``vals`` rows into (codes,
+        scales, rescore ring, cache map).  ``idx`` is the drop-resolved
+        scatter index (pad rows already at capacity)."""
+        rows, map_idx, evict_idx = self._assign_cache_rows(slots)
+        (
+            self.codes,
+            self.scales,
+            self.rescore_vecs,
+            self.cache_map,
+        ) = self._quant_scatter_fn(
+            self.codes,
+            self.scales,
+            self.rescore_vecs,
+            self.cache_map,
+            jnp.asarray(idx),
+            jnp.asarray(rows),
+            jnp.asarray(map_idx),
+            jnp.asarray(evict_idx),
+            vals,
+            normalize=normalize,
         )
 
     def _coalesce_staged_device(
@@ -398,6 +674,7 @@ class DeviceKnnIndex:
             not self._staged_set
             and not self._staged_valid
             and not self._staged_device
+            and not self._staged_coded
         ):
             self._maybe_compact()
             return
@@ -421,10 +698,41 @@ class DeviceKnnIndex:
         self._staged_device.clear()
         if self._staged_set:
             idx = np.fromiter(self._staged_set.keys(), dtype=np.int32)
-            vals = np.stack(list(self._staged_set.values())).astype(self.dtype)
-            self.vectors = self._scatter_rows_fn(
-                self.vectors, jnp.asarray(idx), jnp.asarray(vals)
+            if self.quantized:
+                # host rows staged RAW: the fused scatter normalizes
+                # (cos) and quantizes on device — the same arithmetic
+                # the device-batch path runs, so host- and device-staged
+                # rows can never diverge in their codes or scales
+                vals = np.stack(list(self._staged_set.values())).astype(
+                    np.float32
+                )
+                self._apply_quantized_rows(
+                    idx, idx.astype(np.int64), jnp.asarray(vals),
+                    normalize=(self.metric == "cos"),
+                )
+            else:
+                vals = np.stack(list(self._staged_set.values())).astype(self.dtype)
+                self.vectors = self._scatter_rows_fn(
+                    self.vectors, jnp.asarray(idx), jnp.asarray(vals)
+                )
+        if self._staged_coded:
+            cidx = np.fromiter(self._staged_coded.keys(), dtype=np.int32)
+            ccodes = np.stack([c for c, _ in self._staged_coded.values()])
+            cscales = np.asarray(
+                [s for _, s in self._staged_coded.values()], dtype=np.float32
             )
+            self.codes, self.scales = self._coded_scatter_fn(
+                self.codes,
+                self.scales,
+                jnp.asarray(cidx),
+                jnp.asarray(ccodes),
+                jnp.asarray(cscales),
+            )
+            self._staged_coded.clear()
+            if getattr(self, "_cache_map_dirty", False):
+                self._rebuild_cache_map(self.capacity)
+                self._cache_map_dirty = False
+                self._place()
         if self._staged_valid:
             vidx = np.fromiter(self._staged_valid.keys(), dtype=np.int32)
             vvals = np.fromiter(self._staged_valid.values(), dtype=bool)
@@ -434,6 +742,39 @@ class DeviceKnnIndex:
         self._staged_set.clear()
         self._staged_valid.clear()
         self._maybe_compact()
+
+    def export_records(self, keys: Sequence[Hashable]) -> dict:
+        """Snapshot records for ``keys`` holding the EXACT resident
+        bytes (codes + scale) the index serves — one batched gather +
+        D2H for the whole delta.  Applying staged first is deliberate:
+        a snapshot must describe committed rows, and the apply was due
+        at the next search anyway.  Restore scatters these bytes back
+        verbatim (``upsert_coded``): bit-identical, zero re-embeds,
+        zero re-quantization.  Empty for unquantized indexes."""
+        with self._lock:
+            if not self.quantized:
+                return {}
+            self._apply_staged()
+            present = [
+                (k, self.slot_of_key[k]) for k in keys if k in self.slot_of_key
+            ]
+            if not present:
+                return {}
+            slots = jnp.asarray(
+                np.asarray([s for _, s in present], dtype=np.int32)
+            )
+            codes = np.asarray(self.codes[slots])
+            scales = np.asarray(self.scales[slots])
+            from .quantized_scoring import QUANT_RECORD_KEY
+
+            return {
+                k: {
+                    QUANT_RECORD_KEY: 1,
+                    "codes": codes[i],
+                    "scale": np.float32(scales[i]),
+                }
+                for i, (k, _slot) in enumerate(present)
+            }
 
     # -- fatal-device-fault recovery ------------------------------------
     def rebuild_device_arrays(self, vectors_by_key=None) -> bool:
@@ -481,13 +822,30 @@ class DeviceKnnIndex:
             )
         host = valid = None
         try:
-            host = np.asarray(self.vectors, dtype=np.float32)
+            if self.quantized:
+                # the quantized resident state is codes+scales (+ the f32
+                # rescore ring): pull ALL of it back — a rebuild that
+                # resurrected only an f32 matrix would silently lose the
+                # codes the searches actually scan (the PR 6 device-fault
+                # path predating quantization did exactly that)
+                host_codes = np.asarray(self.codes, dtype=np.int8)
+                host_scales = np.asarray(self.scales, dtype=np.float32)
+                host_cache = np.asarray(self.rescore_vecs, dtype=np.float32)
+                host = True
+            else:
+                host = np.asarray(self.vectors, dtype=np.float32)
             valid = np.asarray(self.valid, dtype=bool)
         except Exception:  # noqa: BLE001 — resident arrays are gone too
             host = None
         slots_reassigned = False
         if host is not None:
-            self.vectors = jnp.asarray(host.astype(np.float32), dtype=self.dtype)
+            if self.quantized:
+                self.codes = jnp.asarray(host_codes)
+                self.scales = jnp.asarray(host_scales)
+                self.rescore_vecs = jnp.asarray(host_cache)
+                self._rebuild_cache_map(self.capacity)
+            else:
+                self.vectors = jnp.asarray(host.astype(np.float32), dtype=self.dtype)
             self.valid = jnp.asarray(valid)
         elif vectors_by_key is not None:
             # arrays unreadable: rebuild bookkeeping + staging from the
@@ -508,10 +866,30 @@ class DeviceKnnIndex:
             self.free = list(range(self.capacity - 1, -1, -1))
             self._staged_set.clear()
             self._staged_valid.clear()
-            self.vectors = jnp.zeros((self.capacity, self.dim), dtype=self.dtype)
+            self._staged_coded.clear()
+            if self.quantized:
+                self.codes = jnp.zeros((self.capacity, self.dim), dtype=jnp.int8)
+                self.scales = jnp.zeros((self.capacity,), dtype=jnp.float32)
+                self.rescore_vecs = jnp.zeros(
+                    (self.rescore_cache_rows, self.dim), dtype=jnp.float32
+                )
+                self._cache_row_of_slot = {}
+                self._cache_slot_of_row = np.full(
+                    (self.rescore_cache_rows,), -1, dtype=np.int64
+                )
+                self._cache_next = 0
+                self._rebuild_cache_map(self.capacity)
+            else:
+                self.vectors = jnp.zeros((self.capacity, self.dim), dtype=self.dtype)
             self.valid = jnp.zeros((self.capacity,), dtype=bool)
             for key, vec in vectors_by_key.items():
-                self._upsert_locked(key, vec)
+                # snapshot records restore their codes verbatim (zero
+                # re-quantization); raw f32 vectors re-code through the
+                # normal staged path
+                if is_quant_record(vec):
+                    self.upsert_coded(key, vec)
+                else:
+                    self._upsert_locked(key, vec)
             slots_reassigned = True
         else:
             return False
@@ -533,14 +911,16 @@ class DeviceKnnIndex:
         else:
             # re-stage salvaged device rows host-side; pre-existing host
             # staging wins (it was staged AFTER the device batches)
-            host_staged = set(self._staged_set)
+            host_staged = set(self._staged_set) | set(self._staged_coded)
             for slots, vals in salvaged:
                 for j, slot in enumerate(slots):
                     slot = int(slot)
                     if slot < 0 or slot in host_staged:
                         continue
                     vec = vals[j]
-                    if self.metric == "cos":
+                    if self.metric == "cos" and not self.quantized:
+                        # quantized rows stay RAW — the fused scatter
+                        # normalizes on device (see _upsert_locked)
                         norm = float(np.linalg.norm(vec))
                         if norm > 0:
                             vec = vec / norm
@@ -552,7 +932,11 @@ class DeviceKnnIndex:
             # row live and searches would rank its zeros.  Keys with an
             # old materialized vector keep it.
             for slot in dropped_slots:
-                if slot in self._staged_set or bool(valid[slot]):
+                if (
+                    slot in self._staged_set
+                    or slot in self._staged_coded
+                    or bool(valid[slot])
+                ):
                     continue
                 self._staged_valid.pop(slot, None)
                 key = self.key_of_slot[slot]
@@ -584,7 +968,12 @@ class DeviceKnnIndex:
             if norm > 0:
                 q = q / norm
         idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
-        sub_vectors = self.vectors[idx]
+        if self.quantized:
+            from .quantized_scoring import dequant_gather
+
+            sub_vectors = dequant_gather(self.codes, self.scales, idx)
+        else:
+            sub_vectors = self.vectors[idx]
         sub_valid = self.valid[idx]
         k_eff = min(k, len(slots))
         scores, sub_idx = topk_search(
@@ -654,15 +1043,29 @@ class DeviceKnnIndex:
             # each compile a fresh kernel — top_k rows come back sorted,
             # so slicing recovers the exact k-result (ADVICE #2)
             k_eff = min(k, c_b)
-            scores, sub_idx = among_topk_search(
-                jnp.asarray(q, dtype=self.dtype),
-                self.vectors,
-                self.valid,
-                jnp.asarray(idx),
-                jnp.asarray(pad_valid),
-                bucket_k(k_eff, c_b),
-                self.metric,
-            )
+            if self.quantized:
+                from .quantized_scoring import quant_among_topk_search
+
+                scores, sub_idx = quant_among_topk_search(
+                    jnp.asarray(q, dtype=jnp.float32),
+                    self.codes,
+                    self.scales,
+                    self.valid,
+                    jnp.asarray(idx),
+                    jnp.asarray(pad_valid),
+                    bucket_k(k_eff, c_b),
+                    self.metric,
+                )
+            else:
+                scores, sub_idx = among_topk_search(
+                    jnp.asarray(q, dtype=self.dtype),
+                    self.vectors,
+                    self.valid,
+                    jnp.asarray(idx),
+                    jnp.asarray(pad_valid),
+                    bucket_k(k_eff, c_b),
+                    self.metric,
+                )
             scores = np.asarray(scores)[:, :k_eff]
             sub_idx = np.asarray(sub_idx)[:, :k_eff]
             for i in range(len(chunk)):
@@ -676,6 +1079,19 @@ class DeviceKnnIndex:
                 results.append(row)
         return results
 
+    def quant_depth(self, k: int) -> int:
+        """Stage-1 candidate count for a quantized search: the rescore
+        funnel never narrows below ``k`` and rides the same power-of-two
+        bucket grid as ``k`` itself."""
+        from .topk import bucket_k
+
+        return bucket_k(max(k, self.rescore_depth), self.capacity)
+
+    def _quant_device_search(self, q) -> Any:
+        """Shared quantized stage-1 inputs: queries as a device f32
+        array (kernel/reference cast per mode inside the jit)."""
+        return jnp.asarray(q, dtype=jnp.float32)
+
     def _device_search(self, q: np.ndarray, k: int) -> tuple[jax.Array, jax.Array]:
         """(scores, slot indices) for normalized queries — subclasses
         override with the mesh-sharded path.  Large cos/dot indexes take
@@ -683,10 +1099,33 @@ class DeviceKnnIndex:
         ones stay on the plain fused XLA path."""
         from .topk import PALLAS_MIN_ROWS, pallas_topk_search
 
+        if self.quantized:
+            from .quantized_scoring import quant_search
+
+            self.quant_searches += 1
+            return quant_search(
+                self._quant_device_search(q),
+                self.codes,
+                self.scales,
+                self.valid,
+                self.rescore_vecs,
+                self.cache_map,
+                c=self.quant_depth(k),
+                k=min(k, self.capacity),
+                metric=self.metric,
+                mode=kernel_mode(),
+                use_cache=self.rescore_cache_rows > 0,
+            )
         if (
             self.metric in ("cos", "dot")
             and self.capacity >= PALLAS_MIN_ROWS
             and self.capacity % 1024 == 0
+            # compiled Mosaic only: off-TPU the "kernel" would run in
+            # interpret mode — a per-element Python-level evaluator meant
+            # for test coverage, ~40x slower than the fused XLA path at
+            # this size (it silently dominated the CPU exact-search
+            # numbers in knn_crossover before the quantized A/B caught it)
+            and jax.default_backend() == "tpu"
         ):
             return pallas_topk_search(
                 jnp.asarray(q, dtype=self.dtype),
@@ -809,6 +1248,60 @@ _scatter_rows_dropping = functools.partial(jax.jit, static_argnames=("normalize"
 )
 
 
+def _quant_scatter_body(
+    codes: jax.Array,  # [cap, D] int8
+    scales: jax.Array,  # [cap] f32
+    cache_vecs: jax.Array,  # [R, D] f32
+    cache_map: jax.Array,  # [cap] int32
+    idx: jax.Array,  # [n] scatter slots (cap = dropped pad row)
+    rows: jax.Array,  # [n] ring rows (R = no cache row)
+    map_idx: jax.Array,  # [n] slots whose mapping is set (cap = none)
+    evict_idx: jax.Array,  # [n] slots whose mapping clears first (cap = none)
+    vals: jax.Array,  # [n, D] raw rows (device or host-staged)
+    normalize: bool,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Quantized twin of the dropping scatter: normalize (cos) and
+    symmetric-scale quantize the rows ON DEVICE, scatter codes+scales
+    into the resident matrices, and land the exact f32 rows in the
+    rescore ring — one fused launch, the embed→upsert fast path never
+    round-trips to host.  All out-of-bounds indices drop, so pad rows
+    and no-cache rows cost nothing.  The un-jitted body is shared with
+    the sharded index's mesh-pinning jit (``out_shardings``) so the two
+    paths can never numerically diverge."""
+    v = vals.astype(jnp.float32)
+    if normalize:
+        norm = jnp.linalg.norm(v, axis=1, keepdims=True)
+        v = v / jnp.maximum(norm, 1e-30)
+    c, s = quantize_jnp(v)
+    codes = codes.at[idx].set(c, mode="drop")
+    scales = scales.at[idx].set(s, mode="drop")
+    cache_vecs = cache_vecs.at[rows].set(v, mode="drop")
+    cache_map = cache_map.at[evict_idx].set(-1, mode="drop")
+    cache_map = cache_map.at[map_idx].set(rows.astype(jnp.int32), mode="drop")
+    return codes, scales, cache_vecs, cache_map
+
+
+_quant_scatter = functools.partial(jax.jit, static_argnames=("normalize",))(
+    _quant_scatter_body
+)
+
+
+def _coded_scatter_body(
+    codes: jax.Array, scales: jax.Array, idx: jax.Array,
+    new_codes: jax.Array, new_scales: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Snapshot-restore scatter: ready-made codes land verbatim (zero
+    re-quantization — the bytes that were durable are the bytes that
+    serve)."""
+    return (
+        codes.at[idx].set(new_codes, mode="drop"),
+        scales.at[idx].set(new_scales, mode="drop"),
+    )
+
+
+_coded_scatter = jax.jit(_coded_scatter_body)
+
+
 @functools.partial(jax.jit, static_argnames=("q_b", "normalize"))
 def _prep_queries(q: jax.Array, q_b: int, normalize: bool) -> jax.Array:
     """Fused-serving query prep, on device: f32 widen, optional L2
@@ -829,6 +1322,104 @@ def _scatter_mask(mask: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Array
     return mask.at[idx].set(vals)
 
 
+# ---------------------------------------------------------------------------
+# quantization observability: pathway_index_* series on /status, the
+# "quantization" block on /v1/health (internals/health.py reads
+# quantization_status() only when this module is already imported — a
+# health probe never pulls in jax state)
+# ---------------------------------------------------------------------------
+
+#: live device indexes, for /status + /v1/health quantization surfacing
+#: (weak: a finished run's indexes drop out with it)
+_LIVE_INDEXES: "weakref.WeakSet[DeviceKnnIndex]" = weakref.WeakSet()
+_quant_label_seq = itertools.count()
+_index_provider_lock = threading.Lock()
+
+
+def _live_indexes() -> list["DeviceKnnIndex"]:
+    return sorted(_LIVE_INDEXES, key=lambda i: i.quant_label)
+
+
+class _IndexMetricsProvider:
+    """``pathway_index_dtype`` / ``pathway_index_hbm_bytes`` /
+    ``pathway_index_rescore_depth`` OpenMetrics series over every live
+    device index."""
+
+    def stats(self) -> dict:
+        return quantization_status() or {}
+
+    def openmetrics_lines(self) -> list[str]:
+        from ..internals.metrics_names import escape_label_value
+
+        indexes = _live_indexes()
+        if not indexes:
+            return []
+        lines = ["# TYPE pathway_index_dtype gauge"]
+        for idx in indexes:
+            lines.append(
+                f'pathway_index_dtype{{index="'
+                f'{escape_label_value(idx.quant_label)}",dtype="'
+                f'{escape_label_value(idx.index_dtype)}"}} 1'
+            )
+        lines.append("# TYPE pathway_index_hbm_bytes gauge")
+        for idx in indexes:
+            lines.append(
+                f'pathway_index_hbm_bytes{{index="'
+                f'{escape_label_value(idx.quant_label)}"}} {idx.hbm_bytes()}'
+            )
+        lines.append("# TYPE pathway_index_rescore_depth gauge")
+        for idx in indexes:
+            lines.append(
+                f'pathway_index_rescore_depth{{index="'
+                f'{escape_label_value(idx.quant_label)}"}} '
+                f"{idx.rescore_depth}"
+            )
+        return lines
+
+
+#: strong module-level ref: the provider registry is weak-valued, so an
+#: unheld provider would vanish before its first scrape
+_index_provider: _IndexMetricsProvider | None = None
+
+
+def _ensure_index_provider() -> None:
+    global _index_provider
+    with _index_provider_lock:
+        if _index_provider is not None:
+            return
+        from ..internals.monitoring import register_metrics_provider
+
+        _index_provider = _IndexMetricsProvider()
+        register_metrics_provider("index_quant", _index_provider)
+
+
+def quantization_status() -> dict | None:
+    """Per-index storage dtype + byte footprint + rescore configuration
+    for ``/v1/health`` (None when no device index is live)."""
+    indexes = _live_indexes()
+    if not indexes:
+        return None
+    out = {}
+    for idx in indexes:
+        cap = max(int(idx.capacity), 1)
+        info = {
+            "dtype": idx.index_dtype,
+            "metric": idx.metric,
+            "dim": int(idx.dim),
+            "capacity_rows": int(idx.capacity),
+            "live_rows": len(idx),
+            "hbm_bytes": int(idx.hbm_bytes()),
+            "bytes_per_vector": round(idx.hbm_bytes() / cap, 2),
+        }
+        if idx.quantized:
+            info["rescore_depth"] = int(idx.rescore_depth)
+            info["rescore_cache_rows"] = int(idx.rescore_cache_rows)
+            info["cache_rows_live"] = len(idx._cache_row_of_slot)
+            info["quant_searches"] = int(idx.quant_searches)
+        out[idx.quant_label] = info
+    return out
+
+
 # observable compile counts (pathway_xla_compile_total): upsert scatters
 # recompile only on capacity growth/compaction — a climbing counter here
 # under steady traffic means the doubling/rounding invariants broke
@@ -842,6 +1433,9 @@ _scatter_mask = _instrument_jit(_scatter_mask, "knn.scatter_mask")
 _scatter_rows_dropping = _instrument_jit(
     _scatter_rows_dropping, "knn.scatter_rows_padded"
 )
+# quantized twins: same bounded shape grids as their f32 counterparts
+_quant_scatter = _instrument_jit(_quant_scatter, "knn.quant_scatter")
+_coded_scatter = _instrument_jit(_coded_scatter, "knn.coded_scatter")
 # fused-serving query prep: shapes are (bucket_q, dim) — same grid the
 # search itself compiles over
 _prep_queries = _instrument_jit(_prep_queries, "knn.query_prep")

@@ -1,0 +1,471 @@
+"""int8 symmetric-scale quantization + asymmetric-distance scoring.
+
+The brute-force KNN scan is memory-bandwidth-bound: every search streams
+the whole ``[N, D]`` resident matrix out of HBM, so bytes-per-vector is
+the lever for both corpus scale and docs/s (EdgeRAG's
+compression-for-retrieval observation; the banked bf16-wire A/B already
+showed precision reduction paying on this exact path).  This module holds
+the quantized half of ``DeviceKnnIndex``:
+
+* **codes** — one int8 code per element with ONE f32 scale per vector
+  (symmetric scalar quantization: ``v ≈ codes * scale``,
+  ``scale = max|v| / 127``).  4x fewer HBM bytes than f32; for
+  L2-normalized embedding rows the per-element error is ≤ scale/2
+  ≈ 0.4 % of the row's max component, which keeps recall@10 ≥ 0.95
+  against the f32 oracle without any rescoring;
+* **asymmetric distance** — queries stay full precision (f32 host-side,
+  bf16 on the MXU) and score directly against the int8 codes:
+  ``score(q, v) = scale_v * (q · codes_v)``.  Only the index side is
+  quantized, so query error never compounds with code error;
+* **Pallas kernel** — tiles the score computation through VMEM exactly
+  like ``ops/topk.pallas_masked_scores``: the int8 code tiles stream out
+  of HBM (the 4x byte win IS the speedup — the dot itself runs bf16 on
+  the MXU with f32 accumulation, scale + tombstone mask in the epilogue);
+* **rescore cache** — a small f32 ring of the most recently written rows
+  (``PATHWAY_INDEX_RESCORE_CACHE``), the latency-critical slice
+  VectorLiteRAG argues deserves its own resource tier.  Stage 1 takes
+  top-``c`` candidates from the quantized scores
+  (``c = bucket_k(max(k, PATHWAY_INDEX_RESCORE_DEPTH))``); stage 2
+  rescores candidates present in the cache against their exact f32 rows
+  and re-ranks.  Rows not in the cache keep their quantized score, so
+  the cache only ever sharpens the ranking.
+
+Off-TPU an XLA reference computes the same masked scale*dot scores
+(``PATHWAY_QUANT_KERNEL=auto|pallas|reference``, the
+``PATHWAY_RAGGED_KERNEL`` idiom): ``auto`` picks the Pallas kernel on
+TPU and the reference elsewhere, ``pallas`` forces the kernel (interpret
+mode off-TPU — how tier-1 exercises the real kernel body on CPU), and
+``reference`` forces the XLA path everywhere.
+
+Snapshot records: a quantized index persists ``(codes, scale)`` per row
+through the PR 6 chunked-snapshot plane (``quantize_record_np`` /
+``dequantize_record``) — restore streams codes straight back into HBM
+with zero re-embeds AND zero re-quantization; legacy f32 snapshots load
+into a quantized index by re-coding once through the normal upsert path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "INDEX_DTYPES",
+    "index_dtype_default",
+    "resolve_index_dtype",
+    "kernel_mode",
+    "rescore_depth_default",
+    "rescore_cache_rows_default",
+    "quantize_rows_np",
+    "quantize_record_np",
+    "is_quant_record",
+    "dequantize_record",
+    "quantized_scores",
+    "pallas_quantized_scores",
+    "quant_search",
+    "rescore_topk",
+    "dequant_gather",
+    "quant_among_topk_search",
+]
+
+NEG_INF = -jnp.inf
+
+INDEX_DTYPES = ("f32", "bf16", "int8")
+
+#: snapshot-record marker key (rides a plain dict so the PR 6 pickle
+#: framing needs no format-version bump; readers that predate it never
+#: see one because only int8 indexes write them)
+QUANT_RECORD_KEY = "__pw_sq8__"
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+
+def index_dtype_default() -> str:
+    """``PATHWAY_INDEX_DTYPE``: resident-matrix storage dtype for every
+    index built without an explicit ``index_dtype=`` — ``f32`` (default),
+    ``bf16`` (half the bytes, same code path), or ``int8``
+    (symmetric-scale codes + asymmetric-distance scoring)."""
+    raw = os.environ.get("PATHWAY_INDEX_DTYPE", "f32").strip().lower()
+    if raw in INDEX_DTYPES:
+        return raw
+    warnings.warn(
+        f"PATHWAY_INDEX_DTYPE={raw!r} is not one of "
+        f"{'/'.join(INDEX_DTYPES)} — using f32",
+        stacklevel=2,
+    )
+    return "f32"
+
+
+def resolve_index_dtype(index_dtype, dtype) -> str:
+    """Resolve the storage-dtype knob: explicit ``index_dtype`` wins,
+    else an explicit jnp ``dtype`` maps onto the equivalent knob value,
+    else the ``PATHWAY_INDEX_DTYPE`` process default."""
+    if index_dtype is not None:
+        value = str(index_dtype).strip().lower()
+        if value not in INDEX_DTYPES:
+            raise ValueError(
+                f"index_dtype={index_dtype!r} is not one of "
+                f"{'/'.join(INDEX_DTYPES)}"
+            )
+        return value
+    if dtype is not None:
+        if jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16):
+            return "bf16"
+        return "f32"
+    return index_dtype_default()
+
+
+def kernel_mode() -> str:
+    """``PATHWAY_QUANT_KERNEL``: ``auto`` (Pallas compiled on TPU, XLA
+    reference elsewhere), ``pallas`` (force the kernel; interpret mode
+    off-TPU — slow but exact, how tier-1 exercises it on CPU), or
+    ``reference`` (force the XLA path everywhere)."""
+    raw = os.environ.get("PATHWAY_QUANT_KERNEL", "auto").strip().lower()
+    if raw in ("auto", "pallas", "reference"):
+        return raw
+    warnings.warn(
+        f"PATHWAY_QUANT_KERNEL={raw!r} is not one of auto/pallas/reference"
+        " — using auto",
+        stacklevel=2,
+    )
+    return "auto"
+
+
+def rescore_depth_default() -> int:
+    """``PATHWAY_INDEX_RESCORE_DEPTH`` (default 32): how many stage-1
+    quantized candidates survive into the exact-rescore stage.  The
+    effective depth per search is ``bucket_k(max(k, depth))`` — a larger
+    ``k`` always widens the funnel with it."""
+    try:
+        n = int(os.environ.get("PATHWAY_INDEX_RESCORE_DEPTH", "32"))
+    except ValueError:
+        n = 32
+    return max(n, 1)
+
+
+def rescore_cache_rows_default() -> int:
+    """``PATHWAY_INDEX_RESCORE_CACHE`` (default 8192; 0 disables): rows
+    of the f32 rescore ring.  Sized independently of capacity on purpose
+    — it is the bounded full-precision tier, not a mirror."""
+    try:
+        n = int(os.environ.get("PATHWAY_INDEX_RESCORE_CACHE", "8192"))
+    except ValueError:
+        n = 8192
+    return max(n, 0)
+
+
+def compute_dtype():
+    """Dtype the asymmetric dot runs in: bf16 on the MXU (codes convert
+    lane-local from VMEM — HBM still reads int8 bytes), f32 elsewhere
+    (emulated bf16 on XLA-CPU is pathologically slow and the reference
+    doubles as the parity oracle)."""
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize
+# ---------------------------------------------------------------------------
+
+
+def quantize_rows_np(vecs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host canonical quantizer: ``codes[i] = round(v[i] / scale_i)``
+    with ``scale_i = max|v[i]| / 127``.  Elementwise arithmetic only
+    (exact max, IEEE divide, round-half-even), so given identical input
+    bits it produces the same codes as the jitted device quantizer."""
+    v = np.asarray(vecs, dtype=np.float32)
+    if v.ndim == 1:
+        v = v[None, :]
+    amax = np.max(np.abs(v), axis=1)
+    scales = (amax / np.float32(127.0)).astype(np.float32)
+    safe = np.where(scales > 0, scales, np.float32(1.0))
+    codes = np.clip(np.round(v / safe[:, None]), -127, 127).astype(np.int8)
+    return codes, scales
+
+
+def quantize_jnp(v: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Device half of the canonical quantizer (same arithmetic as
+    :func:`quantize_rows_np`); ``v`` is f32 ``[n, d]``."""
+    amax = jnp.max(jnp.abs(v), axis=1)
+    scales = amax / np.float32(127.0)
+    safe = jnp.where(scales > 0, scales, 1.0)
+    codes = jnp.clip(jnp.round(v / safe[:, None]), -127, 127).astype(jnp.int8)
+    return codes, scales
+
+
+def quantize_record_np(vec: np.ndarray, normalize: bool) -> dict:
+    """Snapshot representation of one quantized row: the codes + scale
+    exactly as the index stores them (``normalize`` mirrors the cos
+    insert-time L2 normalization so restore is re-coding-free)."""
+    v = np.asarray(vec, dtype=np.float32).reshape(-1)
+    if normalize:
+        norm = float(np.linalg.norm(v))
+        if norm > 0:
+            v = v / norm
+    codes, scales = quantize_rows_np(v)
+    return {
+        QUANT_RECORD_KEY: 1,
+        "codes": codes[0],
+        "scale": np.float32(scales[0]),
+    }
+
+
+def is_quant_record(obj) -> bool:
+    return isinstance(obj, dict) and QUANT_RECORD_KEY in obj
+
+
+def dequantize_record(rec: dict) -> np.ndarray:
+    """f32 row back from a snapshot record (the int8→f32/bf16 load
+    direction of the snapshot round trip)."""
+    return rec["codes"].astype(np.float32) * np.float32(rec["scale"])
+
+
+# ---------------------------------------------------------------------------
+# scoring: XLA reference + Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _reference_scores(q, codes, scales, valid, metric: str) -> jax.Array:
+    """XLA asymmetric-distance scores ``[Q, N]`` (higher = better).  The
+    per-row reduction is a plain length-D dot, so per-shard slices of
+    this computation are bit-identical to the whole-matrix form — the
+    sharded local search calls this SAME function on its shard slice,
+    which is what the merge's bit-exact parity rests on."""
+    ct = compute_dtype()
+    dots = jnp.dot(
+        q.astype(ct), codes.astype(ct).T, preferred_element_type=jnp.float32
+    )
+    s = dots * scales[None, :]
+    if metric == "l2sq":
+        # -||q - v||^2 with v = codes*scale: 2 q·v - ||q||^2 - ||v||^2.
+        # The code norm reduces in int32 (exact for any dim < ~133k, and
+        # XLA fuses the int8→int32 widen into the reduction — no [N, D]
+        # f32 materialization on a per-search quantity)
+        qn = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+        sq = jnp.sum(
+            jnp.square(codes.astype(jnp.int32)), axis=-1
+        ).astype(jnp.float32)
+        cn = sq * (scales.astype(jnp.float32) ** 2)
+        s = 2.0 * s - qn - cn[None, :]
+    elif metric not in ("cos", "dot"):
+        raise ValueError(f"unknown metric {metric!r}")
+    return jnp.where(valid[None, :], s, NEG_INF)
+
+
+def pick_block_n(n: int, cap: int = 1024) -> int | None:
+    """Largest power-of-two vector-block size dividing ``n`` (≥ 32, the
+    int8 sublane tile) — None when no tile fits and the kernel must
+    fall back to the reference."""
+    b = cap
+    while b >= 32:
+        if n % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def pallas_quantized_scores(
+    q: jax.Array,  # [Q, D] f32 (cast to compute dtype in-kernel)
+    codes: jax.Array,  # [N, D] int8
+    scales: jax.Array,  # [N] f32
+    valid: jax.Array,  # [N] f32 {0,1}
+    *,
+    block_n: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Tiled asymmetric-distance kernel: for each (query-block,
+    code-block) grid cell, stream the int8 code tile from HBM, dot it
+    against the resident query tile on the MXU (bf16 x bf16 → f32
+    accumulate; the int8→bf16 convert is lane-local in VMEM so HBM only
+    ever moves 1 byte/element), then scale + tombstone-mask in the
+    epilogue.  Same launch geometry as ``ops/topk.pallas_masked_scores``
+    — the grid iterates code blocks minor so each query tile stays
+    resident while code tiles stream."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nq, d = q.shape
+    n = codes.shape[0]
+    block_q = min(nq, 256)
+    if block_n is None:
+        block_n = pick_block_n(n)
+    assert block_n is not None and n % block_n == 0, "pad codes to block multiples"
+    assert nq % block_q == 0, "pad queries to block multiples"
+    ct = compute_dtype()
+    qc = q.astype(ct)
+
+    def kernel(q_ref, c_ref, s_ref, m_ref, o_ref):
+        dots = jnp.dot(
+            q_ref[:], c_ref[:].astype(q_ref.dtype).T,
+            preferred_element_type=jnp.float32,
+        )
+        scored = dots * s_ref[:][None, :]
+        o_ref[:] = jnp.where(m_ref[:][None, :] > 0, scored, NEG_INF)
+
+    grid = (nq // block_q, n // block_n)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((nq, n), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_n,), lambda i, j: (j,)),
+            pl.BlockSpec((block_n,), lambda i, j: (j,)),
+        ],
+        out_specs=pl.BlockSpec((block_q, block_n), lambda i, j: (i, j)),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * nq * n * d,
+            bytes_accessed=n * d + n * 8 + nq * d * qc.dtype.itemsize + nq * n * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(qc, codes, scales, valid.astype(jnp.float32))
+
+
+def quantized_scores(
+    q, codes, scales, valid, metric: str, mode: str
+) -> jax.Array:
+    """Masked asymmetric scores ``[Q, N]``, dispatching kernel vs
+    reference per ``mode`` (a static string under jit).  l2sq always
+    takes the reference (the kernel is cos/dot-only, like the f32 tiled
+    path); ``auto`` requires a real TPU and a fitting tile."""
+    use_kernel = False
+    if metric in ("cos", "dot") and pick_block_n(codes.shape[0]) is not None:
+        if mode == "pallas":
+            use_kernel = True
+        elif mode == "auto" and jax.default_backend() == "tpu":
+            use_kernel = True
+    if use_kernel:
+        return pallas_quantized_scores(q, codes, scales, valid)
+    return _reference_scores(q, codes, scales, valid, metric)
+
+
+# ---------------------------------------------------------------------------
+# rescore stage
+# ---------------------------------------------------------------------------
+
+
+def _rescore_body(q, cand_scores, cand_idx, cache_vecs, cache_map, k, metric):
+    """Stage 2: re-rank the top-c candidates, replacing the quantized
+    score with the exact f32 score wherever the row is resident in the
+    rescore cache.  Invalid candidates (tombstones / -inf pads) keep
+    -inf — a deleted row must never resurrect through a stale cache
+    entry."""
+    rows = cache_map[cand_idx]  # [Q, C]
+    present = (rows >= 0) & (cand_scores > NEG_INF)
+    r = cache_vecs.shape[0]
+    safe = jnp.clip(rows, 0, max(r - 1, 0))
+    vecs = cache_vecs[safe]  # [Q, C, D]
+    dots = jnp.einsum(
+        "qd,qcd->qc", q.astype(jnp.float32), vecs,
+        preferred_element_type=jnp.float32,
+    )
+    if metric == "l2sq":
+        qn = jnp.sum(q.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+        vn = jnp.sum(vecs ** 2, axis=-1)
+        exact = 2.0 * dots - qn - vn
+    else:
+        exact = dots
+    final = jnp.where(present, exact, cand_scores)
+    scores, pos = lax.top_k(final, k)
+    idx = jnp.take_along_axis(cand_idx, pos, axis=1)
+    return scores, idx
+
+
+rescore_topk = functools.partial(
+    jax.jit, static_argnames=("k", "metric")
+)(_rescore_body)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("c", "k", "metric", "mode", "use_cache")
+)
+def quant_search(
+    q,  # [Q, D] f32, pre-normalized for cos
+    codes,  # [N, D] int8
+    scales,  # [N] f32
+    valid,  # [N] bool
+    cache_vecs,  # [R, D] f32
+    cache_map,  # [N] int32, -1 = not cached
+    *,
+    c: int,
+    k: int,
+    metric: str,
+    mode: str,
+    use_cache: bool,
+):
+    """One fused quantized search: asymmetric scores over all N codes →
+    top-c candidates → exact rescore of cache-resident candidates →
+    top-k.  ``c``/``k`` arrive bucketed (``bucket_k``) so heterogeneous
+    serving (Q, k) stays on a bounded compile grid."""
+    s = quantized_scores(q, codes, scales, valid, metric, mode)
+    cand_scores, cand_idx = lax.top_k(s, c)
+    if not use_cache:
+        return cand_scores[:, :k], cand_idx[:, :k]
+    return _rescore_body(q, cand_scores, cand_idx, cache_vecs, cache_map, k, metric)
+
+
+# ---------------------------------------------------------------------------
+# candidate-subset paths (LSH rescoring)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def dequant_gather(codes, scales, idx):
+    """Gathered rows dequantized to f32 (``[..., D]``) — the LSH
+    candidate-rescoring paths score small gathered subsets, where the
+    f32 materialization is bounded by the candidate budget."""
+    return codes[idx].astype(jnp.float32) * scales[idx][..., None]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def quant_among_topk_search(
+    queries,  # [Q, D]
+    codes,  # [N, D] int8
+    scales,  # [N] f32
+    valid,  # [N] bool
+    idx,  # [Q, C] candidate slots
+    pad_valid,  # [Q, C]
+    k: int,
+    metric: str = "cos",
+):
+    """Quantized twin of ``ops/topk.among_topk_search``: per-query
+    candidate subsets scored against dequantized rows in ONE device
+    call."""
+    sub = codes[idx].astype(jnp.float32) * scales[idx][..., None]
+    v = valid[idx] & pad_valid
+    dots = jnp.einsum(
+        "qd,qcd->qc", queries.astype(jnp.float32), sub,
+        preferred_element_type=jnp.float32,
+    )
+    if metric in ("cos", "dot"):
+        s = dots
+    elif metric == "l2sq":
+        qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+        vn = jnp.sum(sub ** 2, axis=-1)
+        s = 2.0 * dots - qn - vn
+    else:
+        raise ValueError(f"unknown metric {metric!r}")
+    s = jnp.where(v, s, NEG_INF)
+    return lax.top_k(s, k)
+
+
+# observable compile counts: the quantized search sites share the same
+# bucket_q/bucket_k flatness contract as knn.topk_search
+from ..internals.flight_recorder import instrument_jit as _instrument_jit
+
+quant_search = _instrument_jit(quant_search, "knn.quant_search")
+rescore_topk = _instrument_jit(rescore_topk, "knn.quant_rescore")
+quant_among_topk_search = _instrument_jit(
+    quant_among_topk_search, "knn.quant_among_topk_search"
+)

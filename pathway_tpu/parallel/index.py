@@ -25,7 +25,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.knn import DeviceKnnIndex, _scatter_rows_dropping_body
+from ..ops.knn import (
+    DeviceKnnIndex,
+    _coded_scatter_body,
+    _quant_scatter_body,
+    _scatter_rows_dropping_body,
+)
 from ._compat import shard_map
 from .mesh import data_axis
 
@@ -73,6 +78,60 @@ def _sharded_search_fn(mesh: Mesh, k: int, metric: str, n_local: int):
     return jax.jit(mapped)
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_quant_search_fn(
+    mesh: Mesh, c: int, metric: str, n_local: int, mode: str
+):
+    """Per-shard asymmetric int8 scoring + ICI top-c merge for one
+    (mesh, c, metric, kernel mode).  Each shard scores its slice through
+    the SAME dispatcher the single-device path uses
+    (``quantized_scoring.quantized_scores``) — on a real TPU the Pallas
+    kernel streams the shard's int8 code tiles from HBM, off-TPU the XLA
+    reference runs (interpret mode never executes inside shard_map), so
+    single-vs-sharded scores come from one scoring body per platform and
+    the merged candidate list is bit-identical to the single-device
+    stage 1 — the property the quantized parity tests pin.  The rescore
+    stage runs OUTSIDE the shard_map against the replicated f32 ring
+    (``ops/quantized_scoring.rescore_topk``), exactly as on one
+    device."""
+    from ..ops.quantized_scoring import _reference_scores, quantized_scores
+
+    on_tpu = jax.default_backend() == "tpu"
+
+    def local_search(q, codes, scales, valid):
+        # q: [Q, D] replicated; codes: [n_local, D]; scales/valid:
+        # [n_local] — the shard slice through the shared dispatcher
+        if on_tpu:
+            s = quantized_scores(q, codes, scales, valid, metric, mode)
+        else:
+            s = _reference_scores(q, codes, scales, valid, metric)
+        c_local = min(c, n_local)
+        cand, idx = lax.top_k(s, c_local)
+        shard = lax.axis_index(data_axis)
+        gidx = idx + shard * n_local
+        all_s = lax.all_gather(cand, data_axis)
+        all_i = lax.all_gather(gidx, data_axis)
+        n_shards = all_s.shape[0]
+        all_s = jnp.transpose(all_s, (1, 0, 2)).reshape(
+            q.shape[0], n_shards * c_local
+        )
+        all_i = jnp.transpose(all_i, (1, 0, 2)).reshape(
+            q.shape[0], n_shards * c_local
+        )
+        c_out = min(c, n_shards * c_local)
+        ms, pos = lax.top_k(all_s, c_out)
+        mi = jnp.take_along_axis(all_i, pos, axis=1)
+        return ms, mi
+
+    specs = dict(
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, None), P(data_axis), P(data_axis)),
+        out_specs=(P(), P()),
+    )
+    mapped = shard_map(local_search, check_replication=False, **specs)
+    return jax.jit(mapped)
+
+
 #: live sharded indexes, for /status + /v1/health mesh surfacing (weak:
 #: a finished run's indexes drop out with it)
 _LIVE_SHARDED: "weakref.WeakSet[ShardedKnnIndex]" = weakref.WeakSet()
@@ -101,13 +160,29 @@ class ShardedKnnIndex(DeviceKnnIndex):
         mesh: Mesh,
         metric: str = "cos",
         capacity: int = 1024,
-        dtype=jnp.float32,
+        dtype=None,
+        index_dtype: str | None = None,
+        rescore_depth: int | None = None,
+        rescore_cache_rows: int | None = None,
     ):
         self.mesh = mesh
         self.n_shards = mesh.shape[data_axis]
-        super().__init__(dim, metric=metric, capacity=int(capacity), dtype=dtype)
+        super().__init__(
+            dim,
+            metric=metric,
+            capacity=int(capacity),
+            dtype=dtype,
+            index_dtype=index_dtype,
+            rescore_depth=rescore_depth,
+            rescore_cache_rows=rescore_cache_rows,
+        )
         self._vec_sharding = NamedSharding(mesh, P(data_axis, None))
         self._mask_sharding = NamedSharding(mesh, P(data_axis))
+        #: the f32 rescore ring and the slot→ring table replicate (they
+        #: are small by construction, and the post-merge rescore gathers
+        #: arbitrary global slots — a replicated read beats an
+        #: all-to-all per search)
+        self._repl_sharding = NamedSharding(mesh, P())
         self._place()
         self._scatter_rows_fn = jax.jit(
             lambda m, i, v: m.at[i].set(v), out_shardings=self._vec_sharding
@@ -123,6 +198,22 @@ class ShardedKnnIndex(DeviceKnnIndex):
             static_argnames=("normalize",),
             out_shardings=self._vec_sharding,
         )(_scatter_rows_dropping_body)
+        # quantized twins: codes shard row-wise like the f32 matrix,
+        # scales like the tombstone mask, ring + map replicated
+        self._quant_scatter_fn = functools.partial(
+            jax.jit,
+            static_argnames=("normalize",),
+            out_shardings=(
+                self._vec_sharding,
+                self._mask_sharding,
+                self._repl_sharding,
+                self._repl_sharding,
+            ),
+        )(_quant_scatter_body)
+        self._coded_scatter_fn = jax.jit(
+            _coded_scatter_body,
+            out_shardings=(self._vec_sharding, self._mask_sharding),
+        )
         #: fused embed→search ticks answered by this sharded index
         self.sharded_ticks = 0
         self.mesh_label = f"sharded{next(_label_seq)}"
@@ -143,13 +234,46 @@ class ShardedKnnIndex(DeviceKnnIndex):
         # the shardings exist; the explicit _place() call after they do
         # pins both arrays to the mesh
         if hasattr(self, "_vec_sharding"):
-            self.vectors = jax.device_put(self.vectors, self._vec_sharding)
+            if self.quantized:
+                self.codes = jax.device_put(self.codes, self._vec_sharding)
+                self.scales = jax.device_put(self.scales, self._mask_sharding)
+                self.rescore_vecs = jax.device_put(
+                    self.rescore_vecs, self._repl_sharding
+                )
+                self.cache_map = jax.device_put(
+                    self.cache_map, self._repl_sharding
+                )
+            else:
+                self.vectors = jax.device_put(self.vectors, self._vec_sharding)
             self.valid = jax.device_put(self.valid, self._mask_sharding)
 
     def _device_search(self, q, k: int):
         n_local = self.capacity // self.n_shards
-        fn = _sharded_search_fn(self.mesh, int(k), self.metric, n_local)
         self.sharded_ticks += 1
+        if self.quantized:
+            from ..ops.quantized_scoring import kernel_mode, rescore_topk
+
+            self.quant_searches += 1
+            k_eff = min(int(k), self.capacity)
+            c = self.quant_depth(k_eff)
+            fn = _sharded_quant_search_fn(
+                self.mesh, c, self.metric, n_local, kernel_mode()
+            )
+            cand_scores, cand_idx = fn(
+                self._quant_device_search(q), self.codes, self.scales, self.valid
+            )
+            if self.rescore_cache_rows > 0:
+                return rescore_topk(
+                    jnp.asarray(q, dtype=jnp.float32),
+                    cand_scores,
+                    cand_idx,
+                    self.rescore_vecs,
+                    self.cache_map,
+                    k=k_eff,
+                    metric=self.metric,
+                )
+            return cand_scores[:, :k_eff], cand_idx[:, :k_eff]
+        fn = _sharded_search_fn(self.mesh, int(k), self.metric, n_local)
         return fn(jnp.asarray(q, dtype=self.dtype), self.vectors, self.valid)
 
     # -- mesh observability ---------------------------------------------
@@ -251,6 +375,7 @@ def mesh_status() -> dict | None:
             "sharded_ticks": int(idx.sharded_ticks),
             "metric": idx.metric,
             "dim": int(idx.dim),
+            "index_dtype": idx.index_dtype,
         }
         for idx in indexes
     }

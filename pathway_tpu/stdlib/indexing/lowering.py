@@ -164,12 +164,13 @@ class ExternalIndexNode(Node):
                     operator=self.name,
                 )
         if self._op_snapshot is not None and self.persistent_id:
+            snap_vals = self._snap_values(last)
             for key, action in last.items():
                 if action is None:
                     self._snap_pending[key] = None
                 else:
                     self._snap_pending[key] = (
-                        self._snap_value(action[0]),
+                        snap_vals[key],
                         action[1],
                         payloads[key],
                     )
@@ -316,16 +317,25 @@ class ExternalIndexNode(Node):
 
     def _snapshot_vectors(self) -> dict | None:
         """Doc vectors replayed from the snapshot plane (fatal-rebuild
-        fallback when even a D2H copy of the matrix fails)."""
+        fallback when even a D2H copy of the matrix fails).  Quantized
+        indexes snapshot ``(codes, scale)`` records — those replay
+        straight back as codes (``DeviceKnnIndex.upsert_coded``)."""
+        from ...ops.quantized_scoring import is_quant_record
+
         if self._op_snapshot is None or not self.persistent_id:
             return None
         state = self._op_snapshot.load(self.persistent_id) or {}
         out = {
             key: rec[0]
             for key, rec in state.items()
-            if isinstance(rec[0], np.ndarray)
+            if isinstance(rec[0], np.ndarray) or is_quant_record(rec[0])
         }
         return out or None
+
+    def _inner_device_index(self):
+        """The inner ``DeviceKnnIndex`` behind this node's index, if
+        any (duck-typed custom indexes return None)."""
+        return getattr(self.index, "index", None)
 
     @staticmethod
     def _snap_value(data):
@@ -337,6 +347,52 @@ class ExternalIndexNode(Node):
         if hasattr(data, "__array__") or isinstance(data, (list, tuple)):
             return np.asarray(data, dtype=np.float32)
         return data
+
+    def _snap_values(self, last: dict) -> dict:
+        """Snapshot values for one flush's net doc changes.
+
+        Unquantized indexes pin raw f32 vectors (``_snap_value``).  A
+        QUANTIZED inner index instead exports the EXACT resident
+        codes+scale per key in ONE batched gather
+        (``DeviceKnnIndex.export_records``): the snapshot then holds
+        precisely the bytes the index serves — restore is bit-identical
+        with zero re-embeds and zero re-quantization, and the snapshot
+        itself shrinks ~4x with the matrix.  If the export fails (the
+        device plane may be faulting — durability must not die with it),
+        the host-side quantizer produces an equivalent record from the
+        raw vector."""
+        inner = self._inner_device_index()
+        quantized = inner is not None and getattr(inner, "quantized", False)
+        out: dict = {}
+        vec_keys: list = []
+        for key, action in last.items():
+            if action is None:
+                continue
+            data = action[0]
+            if quantized and (
+                isinstance(data, np.ndarray)
+                or hasattr(data, "__array__")
+                or isinstance(data, (list, tuple))
+            ):
+                vec_keys.append(key)
+            else:
+                out[key] = self._snap_value(data)
+        if vec_keys:
+            try:
+                records = inner.export_records(vec_keys)
+            except Exception:  # noqa: BLE001 — device fault: host fallback
+                records = {}
+            if len(records) < len(vec_keys):
+                from ...ops.quantized_scoring import quantize_record_np
+
+                for key in vec_keys:
+                    if key not in records:
+                        records[key] = quantize_record_np(
+                            np.asarray(last[key][0], dtype=np.float32),
+                            normalize=inner.metric == "cos",
+                        )
+            out.update(records)
+        return out
 
     # -- operator snapshots (reference: operator_snapshot.rs) -----------
     _SNAPSHOT_WRITE_ATTEMPTS = 3

@@ -24,6 +24,7 @@ import numpy as np
 
 from ...ops.knn import DeviceKnnIndex
 from ...ops.lsh import LshProjector
+from ...ops.quantized_scoring import is_quant_record
 from ...ops.topk import topk_search
 from ...utils.jmespath_lite import compile_filter
 
@@ -124,38 +125,71 @@ class BruteForceKnnIndex(_FilteredMixin, InnerIndexImpl):
     design (src/engine/dataflow/operators/external_index.rs:95-98)."""
 
     def __init__(
-        self, dim: int, metric: str = "cos", capacity: int = 1024, mesh=None
+        self,
+        dim: int,
+        metric: str = "cos",
+        capacity: int = 1024,
+        mesh=None,
+        index_dtype: str | None = None,
     ):
         _FilteredMixin.__init__(self)
         if mesh is not None:
             from ...parallel.index import ShardedKnnIndex
 
             self.index = ShardedKnnIndex(
-                dim=dim, mesh=mesh, metric=metric, capacity=capacity
+                dim=dim, mesh=mesh, metric=metric, capacity=capacity,
+                index_dtype=index_dtype,
             )
         else:
-            self.index = DeviceKnnIndex(dim=dim, metric=metric, capacity=capacity)
+            self.index = DeviceKnnIndex(
+                dim=dim, metric=metric, capacity=capacity,
+                index_dtype=index_dtype,
+            )
 
     def add(self, key, data, metadata) -> None:
-        self.index.upsert(key, np.asarray(data, dtype=np.float32))
+        if is_quant_record(data):
+            self.index.upsert_coded(key, data)
+        else:
+            self.index.upsert(key, np.asarray(data, dtype=np.float32))
         self._store_meta(key, metadata)
 
     def add_batch(self, keys, datas, metadatas) -> None:
         """Batched add: one staged scatter for the whole flush.  A DEVICE
         array batch (the ingest pipeline's encoder output, rows beyond
         ``len(keys)`` being dispatch pads) is handed to the index without
-        a host round trip (``DeviceKnnIndex.upsert_batch``)."""
+        a host round trip (``DeviceKnnIndex.upsert_batch``).  Snapshot
+        restore batches may carry quantized records (possibly mixed with
+        raw f32 rows across a dtype transition) — records go straight to
+        the coded staging path, zero re-quantization."""
         if hasattr(datas, "shape") and not isinstance(datas, np.ndarray):
             self.index.upsert_batch(list(keys), datas)  # device batch
-        else:
-            vecs = (
-                datas.astype(np.float32, copy=False)
-                if isinstance(datas, np.ndarray)
-                else np.stack(
-                    [np.asarray(d, dtype=np.float32).reshape(-1) for d in datas]
-                )
+        elif isinstance(datas, np.ndarray):
+            self.index.upsert_batch(
+                list(keys), datas.astype(np.float32, copy=False)
             )
-            self.index.upsert_batch(list(keys), vecs)
+        else:
+            # stage in ORDER, flushing buffered raw rows before each
+            # record — a key appearing twice in one batch (raw then
+            # record or vice versa) must keep its LAST value, the same
+            # last-write-wins contract upsert_batch documents
+            raw_keys, raw_rows = [], []
+
+            def _flush_raw():
+                if raw_keys:
+                    self.index.upsert_batch(list(raw_keys), np.stack(raw_rows))
+                    raw_keys.clear()
+                    raw_rows.clear()
+
+            for key, data in zip(keys, datas):
+                if is_quant_record(data):
+                    _flush_raw()
+                    self.index.upsert_coded(key, data)
+                else:
+                    raw_keys.append(key)
+                    raw_rows.append(
+                        np.asarray(data, dtype=np.float32).reshape(-1)
+                    )
+            _flush_raw()
         for key, meta in zip(keys, metadatas):
             self._store_meta(key, meta)
 
@@ -419,12 +453,14 @@ class BruteForceKnnFactory(InnerIndexFactory):
     metric: str = USearchMetricKind.COS
     embedder: Any = None
     mesh: Any = None
+    #: "f32" / "bf16" / "int8"; None = the PATHWAY_INDEX_DTYPE default
+    index_dtype: str | None = None
 
     def build_inner_index(self) -> InnerIndexImpl:
         dim = self._resolve_dim(self.dimensions, self.embedder)
         return BruteForceKnnIndex(
             dim=dim, metric=self.metric, capacity=self.reserved_space,
-            mesh=self.mesh,
+            mesh=self.mesh, index_dtype=self.index_dtype,
         )
 
 
@@ -442,12 +478,14 @@ class UsearchKnnFactory(InnerIndexFactory):
     expansion_search: int = 0
     embedder: Any = None
     mesh: Any = None
+    #: "f32" / "bf16" / "int8"; None = the PATHWAY_INDEX_DTYPE default
+    index_dtype: str | None = None
 
     def build_inner_index(self) -> InnerIndexImpl:
         dim = self._resolve_dim(self.dimensions, self.embedder)
         return BruteForceKnnIndex(
             dim=dim, metric=self.metric, capacity=self.reserved_space,
-            mesh=self.mesh,
+            mesh=self.mesh, index_dtype=self.index_dtype,
         )
 
 

@@ -1,0 +1,777 @@
+"""Quantized HBM index (ISSUE 11): int8 symmetric-scale codes + the
+asymmetric-distance scoring path, end to end.
+
+Covers the quantization contract:
+
+* quantize/dequantize round-trip error bounds and host↔device code
+  bit-parity (the snapshot plane's zero-re-quantization guarantee rests
+  on the two quantizers being arithmetic twins);
+* recall@10 ≥ 0.95 vs the f32 oracle on a seeded corpus — with the
+  rescore cache DISABLED (the pure-int8 floor) and enabled;
+* the rescore-depth funnel (a wider funnel never hurts recall);
+* staged upsert/delete/growth parity at int8 (host- vs device-staged
+  rows produce bit-identical codes);
+* bit-exact single-vs-sharded parity across mesh 1/2/8;
+* snapshot round trips in both directions (int8→int8 restores codes
+  verbatim with zero re-quantization; legacy f32 snapshots re-code once;
+  int8 records load into an f32 index by dequantizing);
+* interpret-mode Pallas kernel vs the XLA reference
+  (``PATHWAY_QUANT_KERNEL``, the ``PATHWAY_RAGGED_KERNEL`` idiom);
+* the PR 6 device-fault rebuild path rebuilding codes+scales (fake-OOM);
+* ``pathway_index_*`` metrics on /status and the ``"quantization"``
+  block on /v1/health.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pathway_tpu.ops.knn import DeviceKnnIndex, quantization_status
+from pathway_tpu.ops.quantized_scoring import (
+    dequantize_record,
+    is_quant_record,
+    quantize_jnp,
+    quantize_record_np,
+    quantize_rows_np,
+)
+
+
+def _vecs(n: int, dim: int = 64, seed: int = 0) -> np.ndarray:
+    return (
+        np.random.default_rng(seed).standard_normal((n, dim)).astype(np.float32)
+    )
+
+
+def _keys(results):
+    return [[k for k, _ in row] for row in results]
+
+
+def _recall(oracle, got) -> float:
+    hits = total = 0
+    for a, b in zip(oracle, got):
+        truth = {k for k, _ in a}
+        hits += len(truth & {k for k, _ in b})
+        total += len(truth)
+    return hits / max(total, 1)
+
+
+def _pair(dim=64, capacity=256, metric="cos", **quant_kwargs):
+    f32 = DeviceKnnIndex(dim=dim, metric=metric, capacity=capacity)
+    q8 = DeviceKnnIndex(
+        dim=dim, metric=metric, capacity=capacity, index_dtype="int8",
+        **quant_kwargs,
+    )
+    return f32, q8
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_round_trip_error_bound():
+    """Per-element reconstruction error is ≤ scale/2 (round-to-nearest
+    with scale = max|v|/127), and the all-zero row is representable."""
+    v = _vecs(32, dim=48)
+    v[5] = 0.0  # degenerate row
+    codes, scales = quantize_rows_np(v)
+    assert codes.dtype == np.int8 and scales.dtype == np.float32
+    recon = codes.astype(np.float32) * scales[:, None]
+    err = np.abs(recon - v)
+    bound = np.maximum(scales[:, None] / 2, 1e-9)
+    assert np.all(err <= bound + 1e-7)
+    assert np.all(codes[5] == 0) and scales[5] == 0.0
+
+
+def test_host_and_device_quantizers_are_bit_identical():
+    """quantize_rows_np and the jitted quantize_jnp are arithmetic twins
+    given the same input bits — the invariant that lets host-staged and
+    device-staged rows (and snapshot records) share one code space."""
+    v = _vecs(64, dim=96, seed=3)
+    nc, ns = quantize_rows_np(v)
+    jc, js = quantize_jnp(jnp.asarray(v))
+    assert np.array_equal(nc, np.asarray(jc))
+    assert np.array_equal(ns, np.asarray(js))
+
+
+# ---------------------------------------------------------------------------
+# recall vs the f32 oracle
+# ---------------------------------------------------------------------------
+
+
+def test_recall_at_10_vs_f32_oracle_pure_int8():
+    """The pure asymmetric-distance floor (rescore cache DISABLED) holds
+    recall@10 ≥ 0.95 on a seeded embedding-like corpus."""
+    dim, n = 96, 600
+    f32 = DeviceKnnIndex(dim=dim, capacity=1024)
+    q8 = DeviceKnnIndex(
+        dim=dim, capacity=1024, index_dtype="int8", rescore_cache_rows=0
+    )
+    vecs = _vecs(n, dim=dim, seed=7)
+    keys = [f"d{i}" for i in range(n)]
+    f32.upsert_batch(keys, vecs)
+    q8.upsert_batch(keys, vecs)
+    q = _vecs(32, dim=dim, seed=11)
+    recall = _recall(f32.search(q, 10), q8.search(q, 10))
+    assert recall >= 0.95, f"pure-int8 recall@10 {recall}"
+
+
+def test_recall_with_rescore_cache_is_exact_for_resident_rows():
+    """With every row resident in the f32 ring, the rescore returns the
+    ORACLE ordering and the oracle scores for everything the stage-1
+    funnel surfaces — recall can only be bounded by funnel depth, and
+    at depth ≥ corpus it is exact."""
+    dim, n = 64, 300
+    f32, q8 = _pair(dim=dim, capacity=512, rescore_depth=512)
+    vecs = _vecs(n, dim=dim, seed=5)
+    keys = [f"d{i}" for i in range(n)]
+    f32.upsert_batch(keys, vecs)
+    q8.upsert_batch(keys, vecs)
+    q = _vecs(16, dim=dim, seed=6)
+    a, b = f32.search(q, 10), q8.search(q, 10)
+    assert _keys(a) == _keys(b)
+    for ra, rb in zip(a, b):
+        for (_, sa), (_, sb) in zip(ra, rb):
+            assert abs(sa - sb) < 1e-5
+
+
+def test_rescore_depth_sweep_never_hurts():
+    """Widening the stage-1 funnel monotonically improves (or preserves)
+    recall — and the depth knob actually changes the funnel."""
+    dim, n = 64, 500
+    vecs = _vecs(n, dim=dim, seed=9)
+    keys = [f"d{i}" for i in range(n)]
+    oracle = DeviceKnnIndex(dim=dim, capacity=1024)
+    oracle.upsert_batch(keys, vecs)
+    q = _vecs(16, dim=dim, seed=10)
+    truth = oracle.search(q, 10)
+    recalls = []
+    for depth in (10, 64, 512):
+        q8 = DeviceKnnIndex(
+            dim=dim, capacity=1024, index_dtype="int8", rescore_depth=depth
+        )
+        q8.upsert_batch(keys, vecs)
+        assert q8.quant_depth(16) >= 16
+        recalls.append(_recall(truth, q8.search(q, 10)))
+    assert recalls == sorted(recalls), f"recall fell with depth: {recalls}"
+    assert recalls[-1] >= 0.95
+
+
+# ---------------------------------------------------------------------------
+# staging parity (host vs device, deletes, growth)
+# ---------------------------------------------------------------------------
+
+
+def test_host_and_device_staging_bit_identical_codes():
+    """The same rows staged as a host batch and as a device batch land
+    with IDENTICAL codes and scales — both route through the same fused
+    quantize scatter."""
+    dim = 32
+    a = DeviceKnnIndex(dim=dim, capacity=64, index_dtype="int8")
+    b = DeviceKnnIndex(dim=dim, capacity=64, index_dtype="int8")
+    vecs = _vecs(20, dim=dim, seed=1)
+    keys = [f"k{i}" for i in range(20)]
+    a.upsert_batch(keys, vecs)  # host staging
+    b.upsert_batch(keys, jnp.asarray(vecs))  # device staging
+    q = _vecs(4, dim=dim, seed=2)
+    assert a.search(q, 5) == b.search(q, 5)
+    for k in keys:
+        sa, sb = a.slot_of_key[k], b.slot_of_key[k]
+        assert np.array_equal(np.asarray(a.codes[sa]), np.asarray(b.codes[sb]))
+        assert float(a.scales[sa]) == float(b.scales[sb])
+
+
+def test_int8_upsert_delete_growth_parity_with_oracle():
+    """Interleaved upserts (both staging paths), overwrites, deletes and
+    growth past the initial capacity track the f32 oracle's result
+    keys."""
+    dim = 64
+    f32, q8 = _pair(dim=dim, capacity=64, rescore_depth=1024)
+    vecs = _vecs(120, dim=dim, seed=4)
+    keys = [f"k{i}" for i in range(120)]
+    q = _vecs(6, dim=dim, seed=8)
+    for idx in (f32, q8):
+        idx.upsert_batch(keys[:40], vecs[:40])
+        idx.upsert_batch(keys[40:80], jnp.asarray(vecs[40:80]))
+        # overwrite staged keys from the other plane
+        idx.upsert_batch(keys[:2], jnp.asarray(vecs[100:102]))
+        idx.upsert(keys[45], vecs[102])
+        for k in keys[10:30]:
+            idx.remove(k)
+        # growth: push past capacity 64
+        idx.upsert_batch(keys[80:100], vecs[80:100])
+    assert q8.capacity == f32.capacity  # grew identically
+    assert _recall(f32.search(q, 10), q8.search(q, 10)) == 1.0
+    # deleted keys never surface
+    got = {k for row in q8.search(q, 50) for k, _ in row}
+    assert not (got & set(keys[10:30]))
+
+
+def test_last_write_wins_within_one_device_batch():
+    f32, q8 = _pair(dim=16, capacity=32, rescore_depth=32)
+    vecs = _vecs(3, dim=16)
+    for idx in (f32, q8):
+        idx.upsert_batch(["a", "b", "a"], jnp.asarray(vecs))
+    q = vecs[2][None, :]
+    a, b = f32.search(q, 1), q8.search(q, 1)
+    assert _keys(a) == _keys(b) == [["a"]]
+
+
+# ---------------------------------------------------------------------------
+# sharded parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh_n", [1, 2, 8])
+@pytest.mark.parametrize("metric", ["cos", "l2sq"])
+def test_sharded_parity_int8(mesh_n, metric):
+    """Bit-exact single-vs-sharded parity at int8: per-shard asymmetric
+    scores are the same length-D reductions, the ICI merge concatenates
+    shards in global-slot order, and the rescore runs identically on the
+    replicated ring — keys AND scores match to the last bit."""
+    from pathway_tpu.parallel import make_mesh
+    from pathway_tpu.parallel.index import ShardedKnnIndex
+
+    shard = ShardedKnnIndex(
+        dim=32, mesh=make_mesh(mesh_n), metric=metric, capacity=64,
+        index_dtype="int8",
+    )
+    single = DeviceKnnIndex(
+        dim=32, metric=metric, capacity=shard.capacity, index_dtype="int8"
+    )
+    assert single.capacity == shard.capacity
+    vecs = _vecs(40, dim=32)
+    keys = [f"k{i}" for i in range(40)]
+    for idx in (single, shard):
+        idx.upsert_batch(keys[:20], vecs[:20])
+        idx.upsert_batch(keys[20:], jnp.asarray(vecs[20:]))
+    q = _vecs(5, dim=32, seed=3)
+    assert single.search(q, 7) == shard.search(q, 7)  # keys AND scores
+    for idx in (single, shard):
+        for k in keys[5:15]:
+            idx.remove(k)
+    assert single.search(q, 7) == shard.search(q, 7)
+    # sharded placement held through the staged applies
+    assert shard.codes.sharding == shard._vec_sharding
+    assert shard.scales.sharding == shard._mask_sharding
+
+
+def test_sharded_int8_growth_keeps_placement_and_parity():
+    from pathway_tpu.parallel import make_mesh
+    from pathway_tpu.parallel.index import ShardedKnnIndex
+
+    shard = ShardedKnnIndex(
+        dim=16, mesh=make_mesh(2), capacity=16, index_dtype="int8"
+    )
+    single = DeviceKnnIndex(
+        dim=16, capacity=shard.capacity, index_dtype="int8"
+    )
+    vecs = _vecs(80, dim=16, seed=5)
+    keys = list(range(80))
+    for idx in (single, shard):
+        idx.upsert_batch(keys, jnp.asarray(vecs))
+    q = _vecs(4, dim=16, seed=6)
+    assert single.search(q, 9) == shard.search(q, 9)
+    assert shard.capacity % shard.n_shards == 0
+    assert shard.codes.sharding == shard._vec_sharding
+
+
+# ---------------------------------------------------------------------------
+# snapshot round trips (PR 6 chunk plane)
+# ---------------------------------------------------------------------------
+
+
+def _make_index_node(pid="quant-test", dim=16, index_dtype=None):
+    from pathway_tpu.stdlib.indexing.lowering import ExternalIndexNode
+    from pathway_tpu.stdlib.indexing.retrievers import BruteForceKnnFactory
+
+    factory = BruteForceKnnFactory(
+        dimensions=dim, reserved_space=64, index_dtype=index_dtype
+    )
+    node = ExternalIndexNode(
+        factory.build_inner_index(),
+        doc_data_fn=lambda ctx: ctx[1][0],
+        doc_meta_fn=lambda ctx: ctx[1][1],
+        query_data_fn=lambda ctx: ctx[1][0],
+        query_k_fn=lambda ctx: 3,
+        query_filter_fn=lambda ctx: None,
+        doc_payload_fn=lambda ctx: (ctx[1][2],),
+        name=pid,
+    )
+    node.persistent_id = pid
+    return node, factory
+
+
+def _doc_entries(n, dim=16, rev=0):
+    rng = np.random.default_rng(42 + rev)
+    return [
+        (f"doc{i}", (rng.standard_normal(dim).astype(np.float32),
+                     {"i": i}, f"text {i}"), 1)
+        for i in range(n)
+    ]
+
+
+def test_snapshot_int8_to_int8_zero_requantization(tmp_path):
+    """A quantized index snapshots (codes, scale) records; restoring into
+    a fresh quantized index scatters the SAME codes back — bit-identical,
+    no re-embeds, no re-quantization."""
+    from pathway_tpu.persistence import ChunkedOperatorSnapshot, MemoryKV
+
+    kv = MemoryKV()
+    snap = ChunkedOperatorSnapshot(kv, background=False)
+    node, _f = _make_index_node(index_dtype="int8")
+    node._op_snapshot = snap
+    entries = _doc_entries(20)
+    node.receive(0, entries)
+    node.flush(1)
+    node.end_of_step(1)
+
+    state, last_t = ChunkedOperatorSnapshot(kv).restore("quant-test")
+    assert last_t == 1
+    assert all(is_quant_record(rec[0]) for rec in state.values())
+
+    restored, _f2 = _make_index_node(index_dtype="int8")
+    restored.restore_snapshot(state)
+    assert restored.restored_rows == 20
+    q = entries[3][1][0]
+    src, dst = node.index.index, restored.index.index
+    # force applies, then compare the resident codes per key: verbatim
+    node._answer([(q,)])
+    restored._answer([(q,)])
+    for key in src.slot_of_key:
+        cs = np.asarray(src.codes[src.slot_of_key[key]])
+        cd = np.asarray(dst.codes[dst.slot_of_key[key]])
+        assert np.array_equal(cs, cd), f"codes re-coded for {key}"
+        assert float(src.scales[src.slot_of_key[key]]) == float(
+            dst.scales[dst.slot_of_key[key]]
+        )
+    # result parity: same keys (the restored ring is cold, so scores are
+    # quantized where the source may answer exact — keys must still match)
+    a = [[k for k, _s, _p in row] for row in node._answer([(q,)])]
+    b = [[k for k, _s, _p in row] for row in restored._answer([(q,)])]
+    assert a == b
+
+
+def test_snapshot_f32_to_int8_recode_once(tmp_path):
+    """Legacy f32 snapshots load into a quantized index by re-coding
+    once through the normal upsert path: the restored codes equal a
+    fresh quantization of the snapshotted (normalized) vectors."""
+    from pathway_tpu.persistence import ChunkedOperatorSnapshot, MemoryKV
+
+    kv = MemoryKV()
+    snap = ChunkedOperatorSnapshot(kv, background=False)
+    node, _f = _make_index_node()  # f32 writer
+    node._op_snapshot = snap
+    entries = _doc_entries(12)
+    node.receive(0, entries)
+    node.flush(1)
+    node.end_of_step(1)
+
+    state, _t = ChunkedOperatorSnapshot(kv).restore("quant-test")
+    assert all(isinstance(rec[0], np.ndarray) for rec in state.values())
+    restored, _f2 = _make_index_node(index_dtype="int8")
+    restored.restore_snapshot(state)
+    q = entries[2][1][0]
+    restored._answer([(q,)])  # apply
+    inner = restored.index.index
+    # re-coding equals a FRESH quantized ingest of the same raw vectors
+    # bit-for-bit (one shared device quantization arithmetic)
+    fresh = DeviceKnnIndex(dim=16, capacity=64, index_dtype="int8")
+    for e in entries:
+        fresh.upsert(e[0], e[1][0])
+    fresh.search(q[None, :], 1)  # apply
+    for e in entries:
+        key = e[0]
+        got = np.asarray(inner.codes[inner.slot_of_key[key]])
+        exp = np.asarray(fresh.codes[fresh.slot_of_key[key]])
+        assert np.array_equal(exp, got)
+        assert float(inner.scales[inner.slot_of_key[key]]) == float(
+            fresh.scales[fresh.slot_of_key[key]]
+        )
+    # answers track the f32 node's keys
+    a = [[k for k, _s, _p in row] for row in node._answer([(q,)])]
+    b = [[k for k, _s, _p in row] for row in restored._answer([(q,)])]
+    assert a == b
+
+
+def test_snapshot_int8_records_load_into_f32_index():
+    """The other direction: int8 records restore into an f32/bf16 index
+    by dequantizing once (operator downgraded the dtype knob)."""
+    rec_vec = _vecs(1, dim=16, seed=13)[0]
+    rec = quantize_record_np(rec_vec, normalize=True)
+    f32 = DeviceKnnIndex(dim=16, capacity=32)
+    f32.upsert_coded("a", rec)
+    out = f32.search(rec_vec[None, :], 1)
+    assert _keys(out) == [["a"]]
+    # the stored row is the dequantized record, re-normalized
+    v = dequantize_record(rec)
+    assert np.allclose(
+        np.asarray(f32.vectors[f32.slot_of_key["a"]]),
+        v / np.linalg.norm(v),
+        atol=1e-6,
+    )
+
+
+# ---------------------------------------------------------------------------
+# kernel modes (PATHWAY_QUANT_KERNEL)
+# ---------------------------------------------------------------------------
+
+
+def test_interpret_kernel_matches_reference(monkeypatch):
+    """``pallas`` mode runs the real kernel body (interpret mode on CPU)
+    and must reproduce the XLA reference's keys, with scores equal to
+    f32 tolerance."""
+    dim, n = 128, 256
+    vecs = _vecs(n, dim=dim, seed=21)
+    keys = [f"k{i}" for i in range(n)]
+    q = _vecs(8, dim=dim, seed=22)
+
+    monkeypatch.setenv("PATHWAY_QUANT_KERNEL", "reference")
+    ref = DeviceKnnIndex(dim=dim, capacity=256, index_dtype="int8")
+    ref.upsert_batch(keys, vecs)
+    r_ref = ref.search(q, 10)
+
+    monkeypatch.setenv("PATHWAY_QUANT_KERNEL", "pallas")
+    pal = DeviceKnnIndex(dim=dim, capacity=256, index_dtype="int8")
+    pal.upsert_batch(keys, vecs)
+    r_pal = pal.search(q, 10)
+
+    assert _keys(r_ref) == _keys(r_pal)
+    for a, b in zip(r_ref, r_pal):
+        for (_, sa), (_, sb) in zip(a, b):
+            assert abs(sa - sb) < 1e-5
+
+
+def test_pallas_scores_unit_vs_reference():
+    """Kernel-level check: pallas_quantized_scores (interpret) equals the
+    reference scores including the tombstone mask."""
+    from pathway_tpu.ops.quantized_scoring import (
+        _reference_scores,
+        pallas_quantized_scores,
+    )
+
+    vecs = _vecs(128, dim=128, seed=31)
+    codes, scales = quantize_rows_np(vecs)
+    valid = np.ones(128, bool)
+    valid[7] = valid[100] = False
+    q = _vecs(8, dim=128, seed=32)
+    ref = _reference_scores(
+        jnp.asarray(q), jnp.asarray(codes), jnp.asarray(scales),
+        jnp.asarray(valid), "cos",
+    )
+    pal = pallas_quantized_scores(
+        jnp.asarray(q), jnp.asarray(codes), jnp.asarray(scales),
+        jnp.asarray(valid),
+    )
+    assert np.allclose(np.asarray(ref), np.asarray(pal), atol=1e-5)
+    assert np.all(np.asarray(pal)[:, 7] == -np.inf)
+
+
+def test_quant_kernel_env_garbage_warns(monkeypatch):
+    from pathway_tpu.ops.quantized_scoring import kernel_mode
+
+    monkeypatch.setenv("PATHWAY_QUANT_KERNEL", "hexagonal")
+    with pytest.warns(UserWarning, match="PATHWAY_QUANT_KERNEL"):
+        assert kernel_mode() == "auto"
+
+
+# ---------------------------------------------------------------------------
+# dtype knob
+# ---------------------------------------------------------------------------
+
+
+def test_index_dtype_env_default(monkeypatch):
+    monkeypatch.setenv("PATHWAY_INDEX_DTYPE", "int8")
+    idx = DeviceKnnIndex(dim=8, capacity=16)
+    assert idx.quantized and idx.index_dtype == "int8"
+    monkeypatch.setenv("PATHWAY_INDEX_DTYPE", "bf16")
+    idx = DeviceKnnIndex(dim=8, capacity=16)
+    assert not idx.quantized and idx.dtype == jnp.bfloat16
+    monkeypatch.setenv("PATHWAY_INDEX_DTYPE", "float128")
+    with pytest.warns(UserWarning, match="PATHWAY_INDEX_DTYPE"):
+        idx = DeviceKnnIndex(dim=8, capacity=16)
+    assert idx.index_dtype == "f32"
+
+
+def test_bf16_dtype_serves_and_parities():
+    """bf16 storage rides the existing machinery: same keys as f32 on a
+    separated corpus, half the matrix bytes."""
+    f32 = DeviceKnnIndex(dim=32, capacity=64)
+    b16 = DeviceKnnIndex(dim=32, capacity=64, index_dtype="bf16")
+    vecs = _vecs(40, dim=32, seed=41)
+    keys = [f"k{i}" for i in range(40)]
+    f32.upsert_batch(keys, vecs)
+    b16.upsert_batch(keys, vecs)
+    q = _vecs(5, dim=32, seed=42)
+    assert _recall(f32.search(q, 5), b16.search(q, 5)) >= 0.9
+    assert b16.hbm_bytes() < f32.hbm_bytes()
+
+
+def test_hbm_bytes_accounting():
+    f32 = DeviceKnnIndex(dim=128, capacity=1024)
+    q8 = DeviceKnnIndex(
+        dim=128, capacity=1024, index_dtype="int8", rescore_cache_rows=0
+    )
+    # codes are 4x smaller than the f32 matrix; scales/map/valid ride on top
+    assert q8.hbm_bytes() < f32.hbm_bytes() / 3
+    q8c = DeviceKnnIndex(
+        dim=128, capacity=1024, index_dtype="int8", rescore_cache_rows=256
+    )
+    assert q8c.hbm_bytes() == q8.hbm_bytes() + 256 * 128 * 4
+
+
+# ---------------------------------------------------------------------------
+# device-fault rebuild (PR 6 path) — the bugfix satellite
+# ---------------------------------------------------------------------------
+
+
+def test_fake_oom_rebuild_restores_codes_and_scales():
+    """The device-fault rebuild must resurrect the QUANTIZED resident
+    state (codes+scales+ring), not just an f32 matrix: after a fake OOM
+    poisons the arrays, the host-mirror rebuild path answers bit-
+    identically and ``.rebuilds`` increments."""
+    idx = DeviceKnnIndex(dim=32, capacity=64, index_dtype="int8")
+    vecs = _vecs(30, dim=32, seed=51)
+    keys = [f"k{i}" for i in range(30)]
+    idx.upsert_batch(keys[:15], vecs[:15])
+    idx.upsert_batch(keys[15:], jnp.asarray(vecs[15:]))
+    q = _vecs(4, dim=32, seed=52)
+    before = idx.search(q, 8)
+    codes_before = np.asarray(idx.codes)
+
+    assert idx.rebuild_device_arrays() is True
+    assert idx.rebuilds == 1
+    assert np.array_equal(np.asarray(idx.codes), codes_before)
+    assert idx.search(q, 8) == before
+
+    # arrays actually dead (np.asarray raises): the snapshot-provider
+    # path re-stages records with zero re-quantization
+    class _Dead:
+        ndim = 2
+
+        def __array__(self, *a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+
+    provider = {
+        k: quantize_record_np(vecs[i], normalize=True)
+        for i, k in enumerate(keys)
+    }
+    idx.codes = _Dead()
+    idx.scales = _Dead()
+    idx.valid = _Dead()
+    assert idx.rebuild_device_arrays(provider) is True
+    assert idx.rebuilds == 2
+    after = idx.search(q, 8)
+    assert _keys(after) == _keys(before)
+    # restored codes are the PROVIDER's codes verbatim (zero re-quantization)
+    got = np.asarray(idx.codes)[[idx.slot_of_key[k] for k in keys]]
+    assert np.array_equal(got, np.stack([provider[k]["codes"] for k in keys]))
+
+
+def test_coded_revive_of_deleted_slot_clears_stale_ring_entry():
+    """A slot recycled from the free list may still carry a stale DEVICE
+    cache_map entry from its deleted key (harmless while tombstoned).
+    Reviving it through the CODED path (snapshot restore) must not score
+    the new key against the old key's ring vector."""
+    idx = DeviceKnnIndex(dim=16, capacity=8, index_dtype="int8")
+    a_vec = np.zeros(16, np.float32)
+    a_vec[0] = 1.0
+    b_vec = np.zeros(16, np.float32)
+    b_vec[1] = 1.0  # orthogonal to a_vec
+    idx.upsert("A", a_vec)
+    idx.search(a_vec[None, :], 1)  # apply: A lands in the ring
+    slot_a = idx.slot_of_key["A"]
+    idx.remove("A")
+    idx.upsert_coded("B", quantize_record_np(b_vec, normalize=True))
+    assert idx.slot_of_key["B"] == slot_a  # slot recycled
+    out = idx.search(a_vec[None, :], 8)[0]
+    scores = dict(out)
+    # before the fix, B inherited A's ring row and scored exact 1.0
+    # against A's own query vector
+    assert abs(scores.get("B", 0.0)) < 0.1, scores
+    out_b = idx.search(b_vec[None, :], 1)[0]
+    assert out_b[0][0] == "B" and out_b[0][1] > 0.95
+
+
+def test_mixed_raw_and_record_batch_keeps_last_write_wins():
+    """add_batch with a key appearing as BOTH a raw vector and a
+    quantized record in one batch keeps the LAST occurrence, whichever
+    form it takes."""
+    from pathway_tpu.stdlib.indexing.retrievers import BruteForceKnnIndex
+
+    v1 = np.zeros(16, np.float32)
+    v1[0] = 1.0
+    v2 = np.zeros(16, np.float32)
+    v2[1] = 1.0
+    # raw then record: record wins
+    a = BruteForceKnnIndex(dim=16, capacity=16, index_dtype="int8")
+    a.add_batch(
+        ["x", "x"], [v1, quantize_record_np(v2, normalize=True)], [None, None]
+    )
+    assert a.search_embedded(v2[None, :], [(1, None)])[0][0][1] > 0.95
+    # record then raw: raw wins
+    b = BruteForceKnnIndex(dim=16, capacity=16, index_dtype="int8")
+    b.add_batch(
+        ["x", "x"], [quantize_record_np(v2, normalize=True), v1], [None, None]
+    )
+    assert b.search_embedded(v1[None, :], [(1, None)])[0][0][1] > 0.95
+
+
+def test_sharded_int8_rebuild_keeps_mesh_placement():
+    from pathway_tpu.parallel import make_mesh
+    from pathway_tpu.parallel.index import ShardedKnnIndex
+
+    idx = ShardedKnnIndex(
+        dim=16, mesh=make_mesh(8), capacity=64, index_dtype="int8"
+    )
+    vecs = _vecs(20, dim=16, seed=61)
+    idx.upsert_batch([f"k{i}" for i in range(20)], jnp.asarray(vecs))
+    q = _vecs(3, dim=16, seed=62)
+    before = idx.search(q, 5)
+    assert idx.rebuild_device_arrays() is True
+    assert idx.search(q, 5) == before
+    assert idx.codes.sharding == idx._vec_sharding
+    assert idx.scales.sharding == idx._mask_sharding
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_quantization_status_and_metrics_lines():
+    idx = DeviceKnnIndex(
+        dim=32, capacity=64, index_dtype="int8", rescore_depth=48
+    )
+    idx.upsert_batch([f"k{i}" for i in range(10)], _vecs(10, dim=32))
+    idx.search(_vecs(1, dim=32), 3)
+
+    status = quantization_status()
+    assert status is not None
+    info = status[idx.quant_label]
+    assert info["dtype"] == "int8"
+    assert info["rescore_depth"] == 48
+    assert info["hbm_bytes"] == idx.hbm_bytes()
+    assert info["quant_searches"] >= 1
+    assert info["cache_rows_live"] == 10
+
+    from pathway_tpu.ops.knn import _index_provider
+
+    lines = _index_provider.openmetrics_lines()
+    text = "\n".join(lines)
+    assert (
+        f'pathway_index_dtype{{index="{idx.quant_label}",dtype="int8"}} 1'
+        in text
+    )
+    assert f'pathway_index_hbm_bytes{{index="{idx.quant_label}"}}' in text
+    assert f'pathway_index_rescore_depth{{index="{idx.quant_label}"}} 48' in text
+
+
+def test_health_snapshot_gains_quantization_block():
+    from pathway_tpu.internals.health import get_health, reset_health
+
+    reset_health()
+    idx = DeviceKnnIndex(dim=16, capacity=32, index_dtype="int8")
+    idx.upsert("a", _vecs(1, dim=16)[0])
+    snap = get_health().snapshot()
+    assert "quantization" in snap
+    assert snap["quantization"][idx.quant_label]["dtype"] == "int8"
+    reset_health()
+
+
+def test_quant_search_compile_set_flat_under_heterogeneous_qk():
+    """bucket_q/bucket_k keep the quantized search on a bounded compile
+    grid: a second sweep over heterogeneous (Q, k) serving shapes
+    compiles NOTHING new."""
+    from pathway_tpu.internals.flight_recorder import compile_stats
+
+    idx = DeviceKnnIndex(dim=32, capacity=64, index_dtype="int8")
+    idx.upsert_batch([f"k{i}" for i in range(40)], _vecs(40, dim=32))
+
+    def sweep():
+        for n_q in (1, 2, 3, 5, 8):
+            for k in (1, 3, 7, 10):
+                idx.search(_vecs(n_q, dim=32, seed=n_q * k), k)
+
+    sweep()
+    before = dict(compile_stats())
+    sweep()
+    after = dict(compile_stats())
+    quant_sites = {
+        s: n for s, n in after.items() if s.startswith("knn.quant")
+    }
+    assert quant_sites, "quant search sites never compiled"
+    for site in quant_sites:
+        assert after[site] == before.get(site), (
+            f"{site} recompiled on the second (Q, k) sweep"
+        )
+
+
+def test_env_knob_reaches_serving_retrieve(monkeypatch, tmp_path):
+    """PATHWAY_INDEX_DTYPE=int8 flows through the product API with zero
+    plumbing (the factory default lands in DeviceKnnIndex): the same
+    corpus retrieves the same documents through VectorStoreServer, and
+    the live index really is quantized."""
+    import pathway_tpu as pw
+    import pathway_tpu.debug as dbg
+    from pathway_tpu.internals.graph import G
+    from pathway_tpu.xpacks.llm import mocks
+    from pathway_tpu.xpacks.llm.vector_store import (
+        RetrieveQuerySchema,
+        VectorStoreServer,
+    )
+
+    corpus = {
+        "doc1.txt": "Berlin is the capital of Germany.",
+        "doc2.txt": "Paris is the capital of France.",
+        "doc3.txt": "The quick brown fox jumps over the lazy dog.",
+    }
+    for name, text in corpus.items():
+        (tmp_path / name).write_text(text)
+    queries = ["Which city is the capital of France?", "fox jumping"]
+
+    def run():
+        docs = pw.io.fs.read(
+            tmp_path, format="binary", mode="static", with_metadata=True
+        )
+        vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=16))
+        qt = dbg.table_from_rows(
+            RetrieveQuerySchema, [(q, 2, None, None) for q in queries]
+        )
+        _, cols = dbg.table_to_dicts(vs.retrieve_query(qt))
+        return sorted(
+            [[r["text"] for r in res.value] for res in cols["result"].values()]
+        )
+
+    base = run()
+    G.clear()
+    monkeypatch.setenv("PATHWAY_INDEX_DTYPE", "int8")
+    before = {
+        label for label in (quantization_status() or {})
+    }
+    quant = run()
+    assert quant == base
+    status = quantization_status() or {}
+    new_int8 = [
+        info for label, info in status.items()
+        if label not in before and info["dtype"] == "int8"
+    ]
+    assert new_int8 and new_int8[0]["quant_searches"] >= 1
+
+
+def test_status_openmetrics_includes_index_series():
+    """The registered provider feeds /status: the OpenMetrics exposition
+    carries the pathway_index_* families (and they are registry-declared,
+    so the lint in test_observability stays green)."""
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    idx = DeviceKnnIndex(dim=16, capacity=32, index_dtype="int8")
+    idx.upsert("a", _vecs(1, dim=16)[0])
+    mon = StatsMonitor()
+    text = mon.openmetrics()
+    assert "pathway_index_dtype" in text
+    assert "pathway_index_hbm_bytes" in text
+    assert "pathway_index_rescore_depth" in text
