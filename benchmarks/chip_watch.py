@@ -34,6 +34,14 @@ moment a probe succeeds it fires the full chip measurement stack:
      quantized crossover summary appended to
      ``benchmarks/chip_results.jsonl`` (metric ``knn_quant``).
 
+  8. ``benchmarks/knn_crossover.py 262144`` with the tiering probe
+     pinned to its default 8/64 (the ``tiered`` suite) → tiered-index
+     recall/latency vs the full-HBM f32 oracle across hot-fraction
+     sweeps with the hot tier on REAL HBM, appended to
+     ``benchmarks/chip_results.jsonl`` (metric ``knn_tiered``).  Every
+     real-TPU number predates the tiered index; this banks the first
+     one.
+
 After every window in which the measurement stack ran, a consolidated
 **chip-bank record** (``{"metric": "chip_bank", docs_per_sec, mfu,
 pallas_docs_per_sec, fused_docs_per_sec, ...}``) is appended to
@@ -245,7 +253,9 @@ def fire_quant() -> bool:
     rc, out = _run(
         [os.path.join(HERE, "knn_crossover.py"), "65536", "262144"],
         760.0,
-        {"KNN_BUDGET_S": "700"},
+        # int8+lsh only: the tiered stage has its own suite (fire_tiered)
+        # — running it here too would spend the scarce window twice
+        {"KNN_BUDGET_S": "700", "KNN_STAGES": "int8,lsh"},
     )
     # keep only the LAST line per corpus size: knn_crossover prints each
     # size's row twice (the int8-stage salvage point, then the final row
@@ -268,6 +278,53 @@ def fire_quant() -> bool:
             f.write(json.dumps(rec) + "\n")
         if "int8_ms_per_query" in rec:
             ok = True
+    _log(f"{name} rc={rc} tpu={ok} tail: {out[-300:]!r}")
+    return ok
+
+
+def fire_tiered() -> bool:
+    """Tiered-index recall/latency on the real chip: the hot tier's
+    brute-force tick runs on REAL HBM while the cold probe pays actual
+    PCIe/host-memory cost — the CPU shape (where "HBM" is host RAM too)
+    says nothing about the hot tick's bandwidth advantage, so only
+    platform=="tpu" rows bank.  Rows land in chip_results.jsonl tagged
+    metric=knn_tiered (knn_crossover banks its own CPU-shape rows to
+    bench_results.jsonl either way).  The probe knob is PINNED to its
+    8/64 default so a stray operator-exported
+    PATHWAY_TIER_PROBE_PARTITIONS can't silently skew the banked
+    recall/latency point."""
+    name = "knn_crossover.py 262144 (tiered)"
+    _log(f"running {name} (budget 700s)")
+    rc, out = _run(
+        [os.path.join(HERE, "knn_crossover.py"), "262144"],
+        760.0,
+        # tiered stage only (exact always runs — it is the oracle); the
+        # int8/LSH stages belong to fire_quant's window
+        {
+            "KNN_BUDGET_S": "700",
+            "KNN_STAGES": "tiered",
+            "PATHWAY_TIER_PROBE_PARTITIONS": "8",
+        },
+    )
+    ok = False
+    # keep the LAST tiered-carrying row per corpus size (salvage points
+    # print the row more than once)
+    by_n: dict = {}
+    for line in (out or "").strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("platform") != "tpu":
+            continue
+        if "tiered_ms_per_query" in rec:
+            by_n[rec.get("n")] = rec
+    for rec in by_n.values():
+        rec["metric"] = "knn_tiered"
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        with open(RESULTS, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        ok = True
     _log(f"{name} rc={rc} tpu={ok} tail: {out[-300:]!r}")
     return ok
 
@@ -435,6 +492,7 @@ def main() -> int:
         "contention": False,
         "mesh": False,
         "quant": False,
+        "tiered": False,
     }
     fire = {
         "bench": fire_bench,
@@ -446,6 +504,7 @@ def main() -> int:
         "contention": fire_contention,
         "mesh": fire_mesh,
         "quant": fire_quant,
+        "tiered": fire_tiered,
     }
     last_bank = None  # monotonic() of the last banked record
     any_banked = False

@@ -32,6 +32,12 @@ probe queries), and ``/v1/health`` must report the restore as ``ok``
 with chunk/row accounting.  ``--mock`` bounds it for CI (2 kill cycles,
 tiny corpus); every run appends its report to
 ``benchmarks/soak_results.jsonl`` and prints its seed.
+
+With ``PATHWAY_TIER_HOT_ROWS>0`` exported, ``--kill`` runs the TIERED
+index through the same harness and additionally asserts the restored
+process rebuilt the exact pre-kill tier placement (hot key set + router
+spec, compared by digest) — ``match_mode`` reports
+``tiered+bit-identical+placement``.
 """
 
 from __future__ import annotations
@@ -288,6 +294,13 @@ while True:
         "embed_calls": embed_calls["n"],
         "restored_rows": getattr(node, "restored_rows", 0) if node else 0,
     }
+    # tiered index (PATHWAY_TIER_HOT_ROWS>0): surface the placement
+    # digest so the parent can assert the SIGKILL restore rebuilt the
+    # same hot set + routing bit-for-bit
+    inner = getattr(node.index, "index", None) if node is not None else None
+    if inner is not None and hasattr(inner, "placement_digest"):
+        status["tier_digest"] = inner.placement_digest()
+        status["tier_hot_rows"] = len(inner._hot_keys)
     tmp = status_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(status, f)
@@ -334,6 +347,19 @@ def run_kill(mock: bool = False) -> dict:
 
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    tiered = int(os.environ.get("PATHWAY_TIER_HOT_ROWS", "0") or 0) > 0
+    if tiered:
+        # exhaustive cold probe for the harness: placement can legally
+        # differ between the restored process (pinned by the durable
+        # blob) and the never-killed oracle (insert-order fill over a
+        # nondeterministic fs-stream order) — with every partition
+        # probed, results are placement-independent and the comparison
+        # pins the DURABILITY of tiering, while placement itself is
+        # pinned restored-vs-pre-kill via the digest.  Pinned
+        # unconditionally (not setdefault): a stray operator export of a
+        # narrow serving probe would silently re-couple the comparison
+        # to placement and fail it spuriously
+        env["PATHWAY_TIER_PROBE_PARTITIONS"] = "1024"
     children: list = []
 
     def start_child(store: str):
@@ -434,17 +460,33 @@ def run_kill(mock: bool = False) -> dict:
                 break
             prev_rec = rec
             time.sleep(0.5)
+        # tier placement as of the durable state — the restore must
+        # rebuild exactly this
+        pre_kill = read_status(status_path)
+        if "tier_digest" in pre_kill:
+            report["tier_digest_pre_kill"] = pre_kill["tier_digest"]
+            report["tier_hot_rows_pre_kill"] = pre_kill.get("tier_hot_rows")
         proc.kill()
         proc.wait()
 
         # 3. final warm restart: everything restores from chunks — the
-        # encoder counter must be FLAT until the probe queries run
+        # encoder counter must be FLAT until the probe queries run.
+        # Wait for restored_rows too: doc_payload fills DURING
+        # restore_snapshot, so a docs-only predicate can sample the
+        # status file mid-restore (restored_rows/tier digest not yet
+        # final)
         proc, port, status_path = start_child(pstore)
         final = wait_status(
-            proc, status_path, lambda s: s["docs"] >= n_docs, 150
+            proc, status_path,
+            lambda s: s["docs"] >= n_docs
+            and s.get("restored_rows", 0) >= n_docs,
+            150,
         )
         report["restore_embed_calls"] = final["embed_calls"]
         report["restored_rows"] = final["restored_rows"]
+        if "tier_digest" in final:
+            report["tier_digest_restored"] = final["tier_digest"]
+            report["tier_hot_rows_restored"] = final.get("tier_hot_rows")
         snap = health(port)
         report["health_status"] = snap.get("status")
         report["index_restore"] = snap.get("index_restore")
@@ -467,7 +509,20 @@ def run_kill(mock: bool = False) -> dict:
         # restarted process answers the quantized score until rewrites
         # re-warm the ring — so the harness compares keys exactly and
         # scores within quantization tolerance there (mode reported).
-        if os.environ.get("PATHWAY_INDEX_DTYPE", "f32").lower() == "int8":
+        if tiered:
+            # tiered serving scores EVERY candidate from the host f32
+            # mirror (tier-independent scores), so restored results are
+            # bit-identical to the oracle at any hot dtype — and the
+            # placement itself must match the pre-kill durable state
+            # exactly (digest over hot key set + router spec)
+            report["match_mode"] = "tiered+bit-identical+placement"
+            report["results_match_oracle"] = restored_results == oracle_results
+            report["placement_match"] = (
+                report.get("tier_digest_restored") is not None
+                and report.get("tier_digest_restored")
+                == report.get("tier_digest_pre_kill")
+            )
+        elif os.environ.get("PATHWAY_INDEX_DTYPE", "f32").lower() == "int8":
             # key SETS, not key order: the same score divergence the
             # tolerance admits can also swap near-tied neighbors' ranks
             report["match_mode"] = "keys+quantized-score-tolerance"
@@ -495,6 +550,7 @@ def run_kill(mock: bool = False) -> dict:
             report["results_match_oracle"]
             and report["zero_reembed_on_restore"]
             and report["health_status"] in ("ready", "degraded")
+            and report.get("placement_match", True)
         )
     finally:
         for proc in children:

@@ -1654,6 +1654,17 @@ class Engine:
             isinstance(n, AsyncMapNode) and n.async_ready() for n in self.nodes
         )
 
+    def has_placement_flush_pending(self) -> bool:
+        """Any index node with an unstaged tier-placement change (duck-
+        typed — ExternalIndexNode lives a layer above this module).  The
+        streaming driver steps once while idle so end_of_step persists
+        it; see lowering.ExternalIndexNode.placement_flush_pending."""
+        for n in self.nodes:
+            fn = getattr(n, "placement_flush_pending", None)
+            if fn is not None and fn():
+                return True
+        return False
+
     def run_all(self) -> None:
         """Batch mode: drain all queued source times, then close."""
         with gc_batch_mode():

@@ -232,6 +232,20 @@ class HealthRegistry:
                     snap["quantization"] = quant
         except Exception:  # noqa: BLE001 — health must never raise
             pass
+        # tiered index: per-tier row counts, migration counters, probe
+        # configuration of every live tiered index — read-only and gated
+        # on the module already being imported (a health probe never
+        # pulls in jax state)
+        try:
+            import sys as _sys
+
+            mod = _sys.modules.get("pathway_tpu.tiering.index")
+            if mod is not None:
+                tiering = mod.tiering_status()
+                if tiering:
+                    snap["tiering"] = tiering
+        except Exception:  # noqa: BLE001 — health must never raise
+            pass
         try:
             from ..testing import faults
 

@@ -130,6 +130,20 @@ METRICS: dict[str, tuple[str, str]] = {
         "gauge",
         "stage-1 candidate funnel depth of the quantized rescore (0 = unquantized)",
     ),
+    # tiered index (pathway_tpu/tiering/index.py) — every series carries
+    # an index label; rows adds a tier label, migrations a direction label
+    "pathway_tier_rows": (
+        "gauge",
+        "live rows per tier (hot = HBM-resident, cold = host-RAM) of each tiered index",
+    ),
+    "pathway_tier_migrations_total": (
+        "counter",
+        "online tier reassignments per direction (promote = cold→HBM, demote = HBM→cold)",
+    ),
+    "pathway_tier_probe_partitions": (
+        "gauge",
+        "cold partitions probed per query (the routing fan-out knob, observed config)",
+    ),
     # XLA compilation (internals/flight_recorder.py, wrapped jit entry points)
     "pathway_xla_compile_total": (
         "counter",

@@ -744,6 +744,13 @@ class StreamingDriver:
                     f"from replay). Last error: "
                     f"{type(last_exc).__name__}: {last_exc}"
                 ) from last_exc
+            # routing spec first: the delta-chunk header carries the LSH
+            # projector / partition-router specs, and the index must
+            # route (and partition) the restored rows exactly as the
+            # process that wrote them did
+            header = self._op_snapshot.last_restored_header(pid)
+            if header:
+                node.apply_snapshot_header(header)
             if state:
                 node.restore_snapshot(state)
             node._restore_state = None
@@ -1009,9 +1016,15 @@ class StreamingDriver:
                 self._write_commit_record(t)
                 t += 1
                 continue
-            if self.engine.has_async_ready():
-                # a pipelined async batch resolved while sources are idle:
-                # step once so its results emit now, not at the next input
+            if self.engine.has_async_ready() or (
+                self.persistence_config is not None
+                and self.engine.has_placement_flush_pending()
+            ):
+                # step once while sources are idle: a pipelined async
+                # batch resolved (its results should emit now, not at
+                # the next input), or a tiered index migrated under pure
+                # query traffic (end_of_step must stage + persist the
+                # new placement — waiting for input could be forever)
                 self.engine.step(t)
                 self._write_commit_record(t)
                 t += 1

@@ -1404,6 +1404,9 @@ def quantization_status() -> dict | None:
         cap = max(int(idx.capacity), 1)
         info = {
             "dtype": idx.index_dtype,
+            # "hot" when the index serves as a tiered index's HBM tier
+            # (pathway_tpu/tiering), "primary" when it IS the corpus
+            "role": getattr(idx, "tier_role", "primary"),
             "metric": idx.metric,
             "dim": int(idx.dim),
             "capacity_rows": int(idx.capacity),

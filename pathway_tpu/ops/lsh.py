@@ -1,4 +1,4 @@
-"""LSH (random-projection) approximate KNN.
+"""LSH (random-projection) approximate KNN + tier routing.
 
 reference semantics: python/pathway/stdlib/ml/classifiers/_knn_lsh.py
 (random projections :50-56, band/bucket grouping :64, candidate generation
@@ -9,6 +9,19 @@ TPU design: signatures for all vectors are computed on device in one matmul
 buckets are a host-side dict (pointer sets are tiny); exact rescoring of the
 candidate set runs through the same fused masked top-k as the brute-force
 index.  Cosine and euclidean metrics as in the reference.
+
+Since the tiered index (``pathway_tpu/tiering``) this module is also the
+ROUTING stage for the host-RAM cold tier: :class:`PartitionRouter` holds a
+small ``[C, D]`` matrix of seeded random unit centroids (spherical LSH —
+one random hyperplane codebook instead of banded sign bits), assigns every
+vector to its best-scoring centroid's partition, and routes a query to the
+top-``n_probe`` partitions with one tiny device matmul.  A search then
+probes only the routed cold partitions instead of the whole host matrix.
+
+Both the projector and the router are DETERMINISTIC functions of their
+``spec()`` (dim, shape params, seed) — the spec rides the index snapshot's
+delta-chunk header so a restored process routes queries to the very same
+partitions (see stdlib/indexing/lowering.py).
 """
 
 from __future__ import annotations
@@ -20,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["LshProjector"]
+__all__ = ["LshProjector", "PartitionRouter"]
 
 
 @functools.partial(jax.jit, static_argnames=("n_or", "n_and"))
@@ -40,9 +53,110 @@ class LshProjector:
         self.dim = dim
         self.n_or = n_or
         self.n_and = n_and
-        key = jax.random.PRNGKey(seed)
+        self.seed = int(seed)
+        key = jax.random.PRNGKey(self.seed)
         self.projections = jax.random.normal(key, (n_or * n_and, dim), dtype=jnp.float32)
 
     def signatures(self, vectors) -> np.ndarray:
         v = jnp.asarray(np.atleast_2d(np.asarray(vectors, dtype=np.float32)))
         return np.asarray(_band_signatures(v, self.projections, self.n_or, self.n_and))
+
+    # -- snapshot spec ---------------------------------------------------
+    # The projections are a pure function of (dim, n_or, n_and, seed):
+    # persisting the spec in the index snapshot's delta-chunk header is
+    # enough for a restored process to rebuild bit-identical projections
+    # and therefore route every query to the same buckets.
+    def spec(self) -> dict:
+        return {
+            "kind": "lsh",
+            "dim": self.dim,
+            "n_or": self.n_or,
+            "n_and": self.n_and,
+            "seed": self.seed,
+        }
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "LshProjector":
+        if spec.get("kind") != "lsh":
+            raise ValueError(f"not an LshProjector spec: {spec!r}")
+        return cls(
+            dim=int(spec["dim"]),
+            n_or=int(spec["n_or"]),
+            n_and=int(spec["n_and"]),
+            seed=int(spec["seed"]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# tier routing: seeded random-centroid partitions (spherical LSH / IVF-lite)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_probe",))
+def _route_topk(q: jax.Array, centroids: jax.Array, n_probe: int) -> jax.Array:
+    """Top-``n_probe`` partition ids per query: one [Q, C] matmul +
+    top-k over the (tiny, HBM-resident) centroid matrix."""
+    scores = jnp.dot(q, centroids.T, preferred_element_type=jnp.float32)
+    _, idx = jax.lax.top_k(scores, n_probe)
+    return idx
+
+
+@jax.jit
+def _assign_argmax(v: jax.Array, centroids: jax.Array) -> jax.Array:
+    """Best-scoring centroid per vector (partition assignment)."""
+    return jnp.argmax(
+        jnp.dot(v, centroids.T, preferred_element_type=jnp.float32), axis=-1
+    ).astype(jnp.int32)
+
+
+class PartitionRouter:
+    """Seeded random-centroid partitioner for the cold tier.
+
+    ``C`` random unit centroids partition the vector space; a vector
+    belongs to the partition of its highest-scoring centroid, and a query
+    probes the top-``n_probe`` partitions by the same score — dot against
+    unit centroids, which for unit centroids is monotone with negative L2
+    distance too, so one scoring rule covers cos/dot/l2sq.  Scoring runs
+    on device (one ``[Q, C]`` matmul over a matrix that is kilobytes),
+    per the tiering design: routing is device work, the probe it selects
+    is host work.
+    """
+
+    def __init__(self, dim: int, n_partitions: int = 64, seed: int = 0):
+        self.dim = int(dim)
+        self.n_partitions = int(n_partitions)
+        self.seed = int(seed)
+        key = jax.random.PRNGKey(self.seed)
+        c = jax.random.normal(key, (self.n_partitions, dim), dtype=jnp.float32)
+        norm = jnp.linalg.norm(c, axis=1, keepdims=True)
+        self.centroids = c / jnp.maximum(norm, 1e-30)
+
+    def assign(self, vectors) -> np.ndarray:
+        """Partition id per vector, ``[B]`` int32."""
+        v = jnp.asarray(np.atleast_2d(np.asarray(vectors, dtype=np.float32)))
+        return np.asarray(_assign_argmax(v, self.centroids))
+
+    def route(self, queries, n_probe: int) -> np.ndarray:
+        """Top-``n_probe`` partition ids per query, ``[Q, n_probe]``."""
+        n_probe = max(1, min(int(n_probe), self.n_partitions))
+        q = jnp.asarray(np.atleast_2d(np.asarray(queries, dtype=np.float32)))
+        return np.asarray(_route_topk(q, self.centroids, n_probe))
+
+    # -- snapshot spec ---------------------------------------------------
+    def spec(self) -> dict:
+        return {
+            "kind": "router",
+            "dim": self.dim,
+            "n_partitions": self.n_partitions,
+            "seed": self.seed,
+        }
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "PartitionRouter":
+        if spec.get("kind") != "router":
+            raise ValueError(f"not a PartitionRouter spec: {spec!r}")
+        return cls(
+            dim=int(spec["dim"]),
+            n_partitions=int(spec["n_partitions"]),
+            seed=int(spec["seed"]),
+        )

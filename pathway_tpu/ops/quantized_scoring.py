@@ -67,6 +67,7 @@ __all__ = [
     "is_quant_record",
     "dequantize_record",
     "quantized_scores",
+    "host_exact_scores",
     "pallas_quantized_scores",
     "quant_search",
     "rescore_topk",
@@ -413,6 +414,33 @@ def quant_search(
     if not use_cache:
         return cand_scores[:, :k], cand_idx[:, :k]
     return _rescore_body(q, cand_scores, cand_idx, cache_vecs, cache_map, k, metric)
+
+
+# ---------------------------------------------------------------------------
+# host rescore (tiered merge)
+# ---------------------------------------------------------------------------
+
+
+def host_exact_scores(q: np.ndarray, rows: np.ndarray, metric: str) -> np.ndarray:
+    """Exact f32 scores of ONE query against gathered host-resident rows
+    (``[C, D]`` → ``[C]``, higher = better) — the rescore-against-host
+    half of the tiered index's merge: candidates from the HBM hot tick
+    and the routed cold partitions all take their FINAL score from the
+    host f32 mirror through this one function, so a key's score can
+    never depend on which tier currently holds it (the invariant the
+    migration-parity tests pin).  Plain numpy on purpose: the candidate
+    set is bounded (top-k + probe budget), and host arithmetic is
+    deterministic across restarts."""
+    q = np.asarray(q, dtype=np.float32).reshape(-1)
+    rows = np.asarray(rows, dtype=np.float32)
+    dots = rows @ q
+    if metric in ("cos", "dot"):
+        return dots
+    if metric == "l2sq":
+        qn = np.float32(np.dot(q, q))
+        vn = np.einsum("cd,cd->c", rows, rows)
+        return 2.0 * dots - qn - vn
+    raise ValueError(f"unknown metric {metric!r}")
 
 
 # ---------------------------------------------------------------------------

@@ -376,6 +376,9 @@ def mesh_status() -> dict | None:
             "metric": idx.metric,
             "dim": int(idx.dim),
             "index_dtype": idx.index_dtype,
+            # "hot" when this mesh-sharded index is a tiered index's
+            # per-shard HBM hot tier (pathway_tpu/tiering)
+            "role": getattr(idx, "tier_role", "primary"),
         }
         for idx in indexes
     }

@@ -676,6 +676,9 @@ class ChunkedOperatorSnapshot:
         #: the uncommitted tail (``truncate_after``) without a base having
         #: swallowed it.
         self._committed_time: int | None = None
+        #: newest chunk header seen by the latest restore/load, per pid
+        #: (``last_restored_header``)
+        self._restored_headers: dict[str, dict | None] = {}
         #: write-side counters (surfaced by benchmarks/checkpoint_bench.py)
         self.bytes_written = 0
         self.chunks_written = 0
@@ -731,14 +734,24 @@ class ChunkedOperatorSnapshot:
         deletes: Iterable = (),
         *,
         live_entries: int | None = None,
+        header: dict | None = None,
     ) -> None:
-        """Append one finalized-time delta chunk; may schedule compaction."""
+        """Append one finalized-time delta chunk; may schedule compaction.
+
+        ``header`` (optional) is a small writer-owned dict riding the
+        chunk next to the delta — the index plane persists its routing
+        state there (LSH projector / partition-router specs), so a
+        restored process routes queries to the same partitions.  An
+        extra dict key in the pickled chunk: readers that predate it
+        ignore it, FORMAT_VERSION unchanged.  Replay keeps the
+        newest-by-time header (compaction folds it into the base)."""
         deletes = list(deletes)
         if not upserts and not deletes:
             return
-        payload = pickle.dumps(
-            {"kind": "delta", "time": time, "upserts": upserts, "deletes": deletes}
-        )
+        chunk = {"kind": "delta", "time": time, "upserts": upserts, "deletes": deletes}
+        if header is not None:
+            chunk["header"] = header
+        payload = pickle.dumps(chunk)
         want_compact = False
         with self._pid_lock(persistent_id):
             meta = self._meta_for(persistent_id)
@@ -850,12 +863,14 @@ class ChunkedOperatorSnapshot:
                 folded_entries += len(chunk["upserts"]) + len(chunk["deletes"])
         if legacy is None and folded_entries == 0 and folded_bases <= 1:
             return  # nothing to merge — don't rewrite a lone base forever
-        state, last_time = self._replay(
+        state, last_time, header = self._replay(
             folded_chunks, pickle.loads(legacy) if legacy else {}
         )
-        payload = pickle.dumps(
-            {"kind": "base", "time": last_time, "state": state}
-        )
+        base_chunk = {"kind": "base", "time": last_time, "state": state}
+        if header is not None:
+            # the newest folded header survives compaction in the base
+            base_chunk["header"] = header
+        payload = pickle.dumps(base_chunk)
         self.storage.put(f"{prefix}{base_seq:08d}", _seal_chunk(payload))
         with self._pid_lock(persistent_id):
             meta = self._meta_for(persistent_id)
@@ -897,6 +912,13 @@ class ChunkedOperatorSnapshot:
         driver resumes engine time past :meth:`restore`'s returned time),
         so time is."""
         return self.restore(persistent_id)[0]
+
+    def last_restored_header(self, persistent_id: str) -> dict | None:
+        """The newest chunk header folded by the most recent
+        :meth:`restore`/:meth:`load` of ``persistent_id`` (None when no
+        chunk carried one) — the streaming driver re-applies it to the
+        index node before the restored rows flow back in."""
+        return self._restored_headers.get(persistent_id)
 
     def restore(
         self,
@@ -947,14 +969,16 @@ class ChunkedOperatorSnapshot:
                     )
                     on_chunk(key, n, (_time.monotonic() - t0) * 1000.0)
         if not chunks and legacy is None:
+            self._restored_headers.pop(persistent_id, None)
             return None, 0
-        state, last_time = self._replay(
+        state, last_time, header = self._replay(
             chunks, pickle.loads(legacy) if legacy else {}
         )
+        self._restored_headers[persistent_id] = header
         return state, max(last_time, 0)
 
     @staticmethod
-    def _replay(chunks: list[dict], state: dict) -> tuple[dict, int]:
+    def _replay(chunks: list[dict], state: dict) -> tuple[dict, int, dict | None]:
         """Merge ``chunks`` (sequence order) over ``state``: the newest
         base — the one at the highest sequence — wins, then deltas whose
         finalized time exceeds the base's replay on top in time order.
@@ -962,13 +986,20 @@ class ChunkedOperatorSnapshot:
         can leave an uncommitted-tail delta at a LOWER sequence than a
         base that later folded older chunks; per-pid delta times are
         strictly monotone, so time disambiguates.  Returns the merged
-        state and the newest folded time (-1 when ``chunks`` is empty —
-        below every real engine time, so any later delta applies)."""
+        state, the newest folded time (-1 when ``chunks`` is empty —
+        below every real engine time, so any later delta applies), and
+        the newest-by-time chunk header (None when no chunk carried
+        one)."""
         base_time = -1
+        header: dict | None = None
+        header_time = -1
         for chunk in chunks:
             if chunk["kind"] == "base":
                 state = dict(chunk["state"])
                 base_time = chunk.get("time", 0)
+                if chunk.get("header") is not None:
+                    header = chunk["header"]
+                    header_time = base_time
         last_time = base_time
         deltas = [c for c in chunks if c["kind"] != "base"]
         deltas.sort(key=lambda c: c.get("time", 0))
@@ -978,7 +1009,13 @@ class ChunkedOperatorSnapshot:
                 for k in chunk["deletes"]:
                     state.pop(k, None)
                 last_time = max(last_time, chunk.get("time", 0))
-        return state, last_time
+                if (
+                    chunk.get("header") is not None
+                    and chunk.get("time", 0) > header_time
+                ):
+                    header = chunk["header"]
+                    header_time = chunk.get("time", 0)
+        return state, last_time, header
 
     def chunk_count(self, persistent_id: str) -> int:
         return len(self.storage.list_keys(self._prefix(persistent_id)))

@@ -389,6 +389,7 @@ class DeviceTickRuntime:
         coalesce_s: float | None = None,
         observer: Any = None,
         retry_after_s: float | None = None,
+        defer: bool = False,
     ) -> Future:
         """Enqueue one payload under a QoS class; the future resolves
         when its batch ran.
@@ -408,6 +409,17 @@ class DeviceTickRuntime:
         composition (``group.token_estimate`` / :func:`estimate_tokens`
         otherwise).  ``coalesce_s`` is how long the item will wait for
         tick-mates (default: the runtime's ``max_wait_ms``).
+
+        ``defer=True`` marks FIRE-AND-FORGET work: a submit from the
+        executor thread itself ENQUEUES for a later tick instead of
+        running inline.  The inline shortcut exists for handlers that
+        block on the returned future (a queued item could never drain
+        while the loop is inside the current tick); background work
+        nobody waits on inside the tick — e.g. a tier-migration batch
+        triggered by a serving search — must NOT ride the triggering
+        tick's class/budget, or an INTERACTIVE query pays for
+        BULK_INGEST work in its own latency.  Never block on a
+        defer=True future from a batch handler.
         """
         qos = QoS(qos)
         if sheddable is None:
@@ -418,7 +430,7 @@ class DeviceTickRuntime:
             estimate = getattr(group, "token_estimate", None)
             tokens = (estimate or estimate_tokens)(payload)
         fut: Future = Future()
-        if self.on_runtime_thread():
+        if self.on_runtime_thread() and not defer:
             # re-entrant submit from inside a batch handler (e.g. a
             # rerank fired by a retrieve handler): run inline — a queued
             # item could never drain while the loop is inside this very

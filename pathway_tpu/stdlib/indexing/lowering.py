@@ -397,7 +397,59 @@ class ExternalIndexNode(Node):
     # -- operator snapshots (reference: operator_snapshot.rs) -----------
     _SNAPSHOT_WRITE_ATTEMPTS = 3
 
+    #: reserved snapshot-state key for the tiered index's placement blob
+    #: (== pathway_tpu.tiering.TIER_PLACEMENT_KEY — duplicated literally
+    #: so reading a snapshot never imports the jax-backed tiering module)
+    _TIER_PLACEMENT_KEY = "__pw_tier_placement__"
+
+    def _maybe_stage_placement(self) -> None:
+        """Tiered inner index: when the tier assignment changed since the
+        last snapshot (online promotions/demotions, hot fills), stage the
+        placement blob as a reserved state row so the NEXT delta carries
+        it — a warm restart then rebuilds the exact same placement."""
+        fn = getattr(self.index, "placement_blob_if_dirty", None)
+        if fn is None:
+            return
+        blob = fn()
+        if blob is not None:
+            self._snap_pending[self._TIER_PLACEMENT_KEY] = (blob, None, None)
+
+    def placement_flush_pending(self) -> bool:
+        """A tiered inner index changed its placement and the change is
+        not yet staged for the snapshot plane.  The streaming driver
+        checks this while sources are idle: migrations are driven by
+        QUERY traffic, so without an idle step a placement mutated
+        during an ingest lull would never be persisted and a kill in
+        that window would restore the older placement."""
+        if self._op_snapshot is None or not self.persistent_id:
+            return False
+        return bool(getattr(self.index, "placement_dirty", False))
+
+    def _snap_header(self) -> dict | None:
+        """Delta-chunk header: the index's routing spec (LSH projector /
+        partition router seeds), persisted so a restored process routes
+        queries to the same partitions."""
+        fn = getattr(self.index, "snapshot_header", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — a header must never block a delta
+            return None
+
+    def apply_snapshot_header(self, header: dict | None) -> None:
+        """Re-apply a restored delta-chunk header (routing specs) to the
+        inner index — called by the streaming driver BEFORE the restored
+        rows stream back in."""
+        if not header:
+            return
+        fn = getattr(self.index, "apply_snapshot_header", None)
+        if fn is not None:
+            fn(header)
+
     def end_of_step(self, time: int) -> None:
+        if self._op_snapshot is not None and self.persistent_id:
+            self._maybe_stage_placement()
         if not (
             self._snap_pending
             and self._op_snapshot is not None
@@ -419,6 +471,7 @@ class ExternalIndexNode(Node):
                     upserts,
                     deletes,
                     live_entries=len(self.doc_payload),
+                    header=self._snap_header(),
                 )
                 self._snap_pending.clear()
                 return
@@ -435,7 +488,17 @@ class ExternalIndexNode(Node):
     def restore_snapshot(self, state: dict) -> None:
         """Warm restart: stream the snapshotted (vector, metadata,
         payload) rows back into the index through ONE bulk ``add_batch``
-        (a single staged device scatter) — zero encoder calls."""
+        (a single staged device scatter) — zero encoder calls.
+
+        A tiered index additionally restores its tier placement: the
+        reserved placement row (hot key set + router spec) is popped
+        from the state and pinned BEFORE the rows flow in, so every
+        restored key lands straight in the tier it held when the
+        snapshot was cut — placement is bit-for-bit, not re-derived
+        from restore iteration order."""
+        placement = state.pop(self._TIER_PLACEMENT_KEY, None)
+        if placement is not None and hasattr(self.index, "restore_placement"):
+            self.index.restore_placement(placement[0])
         keys, datas, metas = [], [], []
         for key, (data, meta, payload) in state.items():
             keys.append(key)
@@ -448,6 +511,8 @@ class ExternalIndexNode(Node):
             else:
                 for key, data, meta in zip(keys, datas, metas):
                     self.index.add(key, data, meta)
+        if placement is not None and hasattr(self.index, "finish_restore"):
+            self.index.finish_restore()
         self.restored_rows = len(keys)
 
     def _answer(self, rows: list[tuple]) -> list[tuple]:

@@ -131,9 +131,24 @@ class BruteForceKnnIndex(_FilteredMixin, InnerIndexImpl):
         capacity: int = 1024,
         mesh=None,
         index_dtype: str | None = None,
+        hot_rows: int | None = None,
     ):
         _FilteredMixin.__init__(self)
-        if mesh is not None:
+        if hot_rows is None:
+            from ...tiering import tier_hot_rows_default
+
+            hot_rows = tier_hot_rows_default()
+        if hot_rows and hot_rows > 0:
+            # tiered serving: HBM hot tier (per-shard when a mesh is
+            # given) + routed host-RAM cold tier — the corpus is no
+            # longer bounded by device HBM (pathway_tpu/tiering)
+            from ...tiering import TieredKnnIndex
+
+            self.index = TieredKnnIndex(
+                dim=dim, hot_rows=int(hot_rows), metric=metric,
+                capacity=capacity, mesh=mesh, index_dtype=index_dtype,
+            )
+        elif mesh is not None:
             from ...parallel.index import ShardedKnnIndex
 
             self.index = ShardedKnnIndex(
@@ -221,6 +236,37 @@ class BruteForceKnnIndex(_FilteredMixin, InnerIndexImpl):
             for row, (k, flt) in zip(raw, specs)
         ]
 
+    # -- snapshot routing/placement protocol (tiered inner index) -------
+    # ExternalIndexNode persists the routing spec in the delta-chunk
+    # header and the tier placement as a reserved state row; these
+    # delegations surface the inner index's half of that contract.
+    def snapshot_header(self) -> dict | None:
+        fn = getattr(self.index, "snapshot_header", None)
+        return fn() if fn is not None else None
+
+    def apply_snapshot_header(self, header: dict) -> None:
+        fn = getattr(self.index, "apply_snapshot_header", None)
+        if fn is not None:
+            fn(header)
+
+    @property
+    def placement_dirty(self) -> bool:
+        return bool(getattr(self.index, "placement_dirty", False))
+
+    def placement_blob_if_dirty(self) -> dict | None:
+        fn = getattr(self.index, "placement_blob_if_dirty", None)
+        return fn() if fn is not None else None
+
+    def restore_placement(self, blob: dict) -> None:
+        fn = getattr(self.index, "restore_placement", None)
+        if fn is not None:
+            fn(blob)
+
+    def finish_restore(self) -> None:
+        fn = getattr(self.index, "finish_restore", None)
+        if fn is not None:
+            fn()
+
 
 class LshKnnIndex(_FilteredMixin, InnerIndexImpl):
     """LSH bucketed KNN (reference: _knn_lsh.py semantics; device scoring)."""
@@ -233,9 +279,10 @@ class LshKnnIndex(_FilteredMixin, InnerIndexImpl):
         n_and: int = 10,
         bucket_length: float = 10.0,
         capacity: int = 1024,
+        seed: int = 0,
     ):
         _FilteredMixin.__init__(self)
-        self.projector = LshProjector(dim, n_or=n_or, n_and=n_and)
+        self.projector = LshProjector(dim, n_or=n_or, n_and=n_and, seed=seed)
         self.index = DeviceKnnIndex(dim=dim, metric=metric, capacity=capacity)
         self.buckets: dict[tuple[int, int], set] = defaultdict(set)
         self.sig_of_key: dict[Hashable, np.ndarray] = {}
@@ -321,6 +368,34 @@ class LshKnnIndex(_FilteredMixin, InnerIndexImpl):
             oversample = self.OVERSAMPLE if flt else 1
             results.append(self._apply_filter(raw[: k * oversample], flt, k))
         return results
+
+    # -- snapshot routing spec ------------------------------------------
+    # Bugfix (ISSUE 12): the projector's seed/projections were not part
+    # of any snapshot — a process restored from a snapshot written under
+    # a different seed (or a changed code default) would bucket the SAME
+    # vectors differently and route queries to the wrong partitions.
+    # The spec now rides the index delta-chunk header (PR 6 framing,
+    # FORMAT_VERSION-compatible) and is re-applied before restore.
+    def snapshot_header(self) -> dict:
+        return {"lsh": self.projector.spec()}
+
+    def apply_snapshot_header(self, header: dict) -> None:
+        spec = (header or {}).get("lsh")
+        if not spec or self.projector.spec() == spec:
+            return
+        with self._lock:
+            if self.sig_of_key or self._pending:
+                # applied mid-life (not the usual empty-at-restore case):
+                # existing signatures were computed under the old
+                # projections and must not mix with new ones — the raw
+                # vectors needed to recompute them are not retained, so
+                # refuse (BEFORE touching the projector — a half-applied
+                # swap would corrupt the very buckets the guard protects)
+                raise RuntimeError(
+                    "LSH projector spec can only be applied to an empty "
+                    "index (restore order applies the header before rows)"
+                )
+            self.projector = LshProjector.from_spec(spec)
 
 
 class BM25Index(_FilteredMixin, InnerIndexImpl):
@@ -455,12 +530,16 @@ class BruteForceKnnFactory(InnerIndexFactory):
     mesh: Any = None
     #: "f32" / "bf16" / "int8"; None = the PATHWAY_INDEX_DTYPE default
     index_dtype: str | None = None
+    #: >0 = tiered index with this HBM hot-row budget;
+    #: None = the PATHWAY_TIER_HOT_ROWS default (0 keeps it untiered)
+    hot_rows: int | None = None
 
     def build_inner_index(self) -> InnerIndexImpl:
         dim = self._resolve_dim(self.dimensions, self.embedder)
         return BruteForceKnnIndex(
             dim=dim, metric=self.metric, capacity=self.reserved_space,
             mesh=self.mesh, index_dtype=self.index_dtype,
+            hot_rows=self.hot_rows,
         )
 
 
@@ -480,12 +559,16 @@ class UsearchKnnFactory(InnerIndexFactory):
     mesh: Any = None
     #: "f32" / "bf16" / "int8"; None = the PATHWAY_INDEX_DTYPE default
     index_dtype: str | None = None
+    #: >0 = tiered index with this HBM hot-row budget;
+    #: None = the PATHWAY_TIER_HOT_ROWS default (0 keeps it untiered)
+    hot_rows: int | None = None
 
     def build_inner_index(self) -> InnerIndexImpl:
         dim = self._resolve_dim(self.dimensions, self.embedder)
         return BruteForceKnnIndex(
             dim=dim, metric=self.metric, capacity=self.reserved_space,
             mesh=self.mesh, index_dtype=self.index_dtype,
+            hot_rows=self.hot_rows,
         )
 
 
@@ -499,13 +582,16 @@ class LshKnnFactory(InnerIndexFactory):
     bucket_length: float = 10.0
     distance_type: str = "cosine"
     embedder: Any = None
+    #: projection seed — persisted in the snapshot header so a restored
+    #: process routes queries to the same buckets
+    seed: int = 0
 
     def build_inner_index(self) -> InnerIndexImpl:
         dim = self._resolve_dim(self.dimensions, self.embedder)
         metric = "cos" if self.distance_type.startswith("cos") else "l2sq"
         return LshKnnIndex(
             dim=dim, metric=metric, n_or=self.n_or, n_and=self.n_and,
-            bucket_length=self.bucket_length,
+            bucket_length=self.bucket_length, seed=self.seed,
         )
 
 

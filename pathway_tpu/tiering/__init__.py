@@ -1,0 +1,35 @@
+"""Tiered vector index: HBM hot tier + host-RAM cold tier.
+
+One device's HBM — even mesh-sharded (PR 8) and int8-quantized (PR 11) —
+is still a hard ceiling on corpus size.  This package holds the tiering
+layer above it: :class:`TieredKnnIndex` keeps a bounded HOT tier resident
+in HBM behind the existing ``DeviceKnnIndex`` / ``ShardedKnnIndex``
+machinery (any ``index_dtype``), the full corpus in a host-RAM f32
+matrix, and routes each query's cold probe through the seeded
+:class:`~pathway_tpu.ops.lsh.PartitionRouter` — a search is one HBM
+brute-force tick plus a bounded host-side probe of the routed partitions,
+merged into one top-k.  Access counts drive online promotions/demotions
+scheduled as ``BULK_INGEST`` work items on the PR 7 runtime (no new
+loops); PR 6 chunked snapshots cover both tiers plus the tier assignment
+so a warm restart rebuilds the same placement bit-for-bit.
+
+See README "Operations: tiered index" for the operator view.
+"""
+
+from .index import (
+    TIER_PLACEMENT_KEY,
+    TieredKnnIndex,
+    tier_hot_rows_default,
+    tier_migrate_batch_default,
+    tier_probe_default,
+    tiering_status,
+)
+
+__all__ = [
+    "TIER_PLACEMENT_KEY",
+    "TieredKnnIndex",
+    "tier_hot_rows_default",
+    "tier_migrate_batch_default",
+    "tier_probe_default",
+    "tiering_status",
+]
