@@ -187,24 +187,37 @@ def fire_serving() -> bool:
 
 
 def _fire_tpu_jsonl(
-    script: str, timeout: float, env: dict | None = None
+    script: str | list[str],
+    timeout: float,
+    env: dict | None = None,
+    bank_metric: str | None = None,
 ) -> bool:
-    """Run a bench script; success requires a platform=="tpu" JSON line —
-    JAX silently falls back to CPU if the tunnel drops between the probe
-    and the run, and a CPU number must not be banked as the chip
-    measurement.  Shared by decoder_bench and attn_probe (each script
-    appends its own results file)."""
-    name = os.path.basename(script)
+    """Run a bench script (path or full argv list); success requires a
+    platform=="tpu" JSON line — JAX silently falls back to CPU if the
+    tunnel drops between the probe and the run, and a CPU number must not
+    be banked as the chip measurement.  Shared by decoder_bench,
+    attn_probe and the cache suite (each script appends its own results
+    file); with ``bank_metric`` the matching tpu rows additionally land
+    in chip_results.jsonl."""
+    argv = [script] if isinstance(script, str) else list(script)
+    name = " ".join([os.path.basename(argv[0]), *argv[1:]])
     _log(f"running {name} (budget {timeout:.0f}s)")
-    rc, out = _run([script], timeout, env)
+    rc, out = _run(argv, timeout, env)
     ok = False
     for line in (out or "").strip().splitlines():
         try:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if rec.get("platform") == "tpu":
-            ok = True
+        if rec.get("platform") != "tpu":
+            continue
+        if bank_metric is not None:
+            if rec.get("metric") != bank_metric:
+                continue
+            rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            with open(RESULTS, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        ok = True
     _log(f"{name} rc={rc} tpu={ok} tail: {out[-300:]!r}")
     return ok
 
@@ -327,6 +340,22 @@ def fire_tiered() -> bool:
         ok = True
     _log(f"{name} rc={rc} tpu={ok} tail: {out[-300:]!r}")
     return ok
+
+
+def fire_cache() -> bool:
+    """Serving cache stack on the real chip (serving_bench.py --zipf:
+    cached-vs-uncached QPS over a Zipf-repeated stream with the REAL
+    MiniLM encoder — the uncached side pays real HBM/MXU ticks, so the
+    banked speedup is the number the CPU mock can only approximate).
+    Success requires a platform=="tpu" zipf record; the consolidated row
+    additionally lands in chip_results.jsonl."""
+    return _fire_tpu_jsonl(
+        [os.path.join(HERE, "serving_bench.py"), "120", "--zipf", "1.1",
+         "--clients", "8"],
+        960.0,
+        {"SERVING_BENCH_BUDGET_S": "900"},
+        bank_metric="rag_serving_zipf",
+    )
 
 
 def fire_mesh() -> bool:
@@ -493,6 +522,7 @@ def main() -> int:
         "mesh": False,
         "quant": False,
         "tiered": False,
+        "cache": False,
     }
     fire = {
         "bench": fire_bench,
@@ -505,6 +535,7 @@ def main() -> int:
         "mesh": fire_mesh,
         "quant": fire_quant,
         "tiered": fire_tiered,
+        "cache": fire_cache,
     }
     last_bank = None  # monotonic() of the last banked record
     any_banked = False

@@ -43,6 +43,18 @@ starvation-share counters — the artifact that pins "serving p99
 survives ingest bursts".
 
 Run: ``JAX_PLATFORMS=cpu python benchmarks/serving_bench.py 48 --clients 4 --ingest-load 200 --mock``
+
+Zipf mode (``--zipf S``): the serving query-cache stack (ISSUE 13)
+measured — a seeded Zipf(S)-distributed stream of repeated and
+near-duplicate queries (casing/whitespace variants that tokenize
+identically) hammers ``/v1/retrieve`` twice, once with the cache stack
+pinned OFF and once ON, each phase in its own subprocess.  Reports QPS +
+p50/p99 both ways, the cache hit/miss/stale counters, and the
+``qps_speedup`` A/B ratio (acceptance: ≥2× at p99 parity).  ``--mock``
+swaps MiniLM for a small random-init REAL encoder (the token-hash cache
+key needs a real tokenizer, so this mode never uses the hash-only fake).
+
+Run: ``JAX_PLATFORMS=cpu python benchmarks/serving_bench.py 120 --zipf 1.1 --clients 8 --mock``
 """
 
 from __future__ import annotations
@@ -619,6 +631,243 @@ def run_mesh(n_docs: int, mesh_n: int, mock: bool,
     return out
 
 
+def _zipf_embedder(mock: bool):
+    """The zipf mode needs a REAL tokenizer+encoder (the embedding cache
+    keys on token-id hashes): mock = small random-init encoder, real =
+    the MiniLM-class model."""
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    if not mock:
+        return SentenceTransformerEmbedder("all-MiniLM-L6-v2")
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+
+    # MiniLM GEOMETRY at random init (f32 — bf16 emulation is unfairly
+    # slow on CPU): the uncached phase must pay realistic encoder FLOPs
+    # per tick, because that is exactly the work the cache absorbs — a
+    # toy 2-layer encoder would leave both phases at the HTTP floor and
+    # understate the A/B to ~1×
+    return SentenceTransformerEmbedder(
+        encoder=SentenceEncoder(
+            cfg=EncoderConfig(dtype=jnp.float32), max_length=128,
+        )
+    )
+
+
+def _zipf_stream(n_docs: int, zipf_s: float, count: int, seed: int):
+    """Seeded Zipf(S) stream over the corpus: ``[(query, expected_text)]``.
+    Repeats follow rank^-S popularity; each sampled query randomly takes
+    a near-duplicate surface form (UPPERCASED / extra whitespace) that
+    tokenizes identically for the wordpiece-uncased and hash tokenizers
+    alike — the post-tokenization cache key must hit all three forms."""
+    import numpy as np
+
+    docs = _corpus(n_docs)
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_docs + 1, dtype=np.float64)
+    p = ranks ** (-float(zipf_s))
+    p /= p.sum()
+    picks = rng.choice(n_docs, size=count, p=p)
+    variants = rng.integers(0, 3, size=count)
+    out = []
+    for doc_i, var in zip(picks, variants):
+        text = docs[int(doc_i)]
+        if var == 1:
+            q = text.upper()
+        elif var == 2:
+            q = "  " + text.replace(" ", "  ")
+        else:
+            q = text
+        out.append((q, text))
+    return out
+
+
+def _run_zipf_loadgen(url: str, n_docs: int, zipf_s: float, clients: int,
+                      queries_per_client: int, seed: int) -> None:
+    """Loadgen child for one zipf phase: regenerates the SAME seeded
+    stream, splits it across client threads, prints latencies + wall
+    elapsed (the QPS denominator)."""
+    import threading
+
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient
+
+    stream = _zipf_stream(
+        n_docs, zipf_s, clients * queries_per_client, seed
+    )
+    client = VectorStoreClient(url=url)
+    lat: list[float] = []
+    errors = [0]
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients + 1)
+
+    def worker(wid: int):
+        mine = stream[
+            wid * queries_per_client : (wid + 1) * queries_per_client
+        ]
+        barrier.wait()
+        for q, expected in mine:
+            t0 = time.perf_counter()
+            try:
+                res = client.query(q, k=10)
+                ok = bool(res) and res[0]["text"] == expected
+            except Exception:  # noqa: BLE001 — counted
+                ok = False
+            dt = (time.perf_counter() - t0) * 1000.0
+            with lock:
+                if ok:
+                    lat.append(dt)
+                else:
+                    errors[0] += 1
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    print(json.dumps({"lat": lat, "errors": errors[0],
+                      "elapsed_s": elapsed}))
+
+
+def run_zipf_phase(phase: str, n_docs: int, zipf_s: float, clients: int,
+                   queries_per_client: int, mock: bool, seed: int) -> dict:
+    """One zipf-mode phase in its own process: pin the cache knobs ON or
+    OFF (explicitly both ways — a hostile operator export must not
+    corrupt either side of the A/B), serve the corpus, run the seeded
+    stream from a loadgen subprocess, and report QPS + cache counters."""
+    cached = phase == "cached"
+    if cached:
+        os.environ["PATHWAY_EMBED_CACHE"] = "8192"
+        os.environ["PATHWAY_RESULT_CACHE"] = "8192"
+        os.environ["PATHWAY_COLLAB_DEPTH"] = "8"
+    else:
+        os.environ["PATHWAY_EMBED_CACHE"] = "0"
+        os.environ["PATHWAY_RESULT_CACHE"] = "0"
+        os.environ["PATHWAY_COLLAB_DEPTH"] = "0"
+    # exact invalidation only: the stream has no mid-run ingest, so a
+    # stale window would never engage — pin it so an export can't skew
+    os.environ["PATHWAY_RESULT_CACHE_STALE_S"] = "0"
+    import subprocess
+
+    import jax
+
+    from pathway_tpu.utils.compile_cache import enable_compile_cache
+    from pathway_tpu.xpacks.llm import _query_cache as qc
+
+    enable_compile_cache()
+    rec: dict = {"platform": jax.devices()[0].platform}
+    docs = _corpus(n_docs)
+    with tempfile.TemporaryDirectory() as base:
+        try:
+            client = _serve_corpus(
+                base, phase, docs, mock, scheduled=True,
+                embedder=_zipf_embedder(mock),
+            )
+        except TimeoutError as exc:
+            rec["error"] = str(exc)
+            return rec
+        # warm EVERY shape the measured window will hit — sequential
+        # 1-row ticks, then a full same-distribution load at a DIFFERENT
+        # seed (run_concurrent's lesson: one mid-measurement XLA compile
+        # poisons the tail; the cached phase additionally compiles its
+        # hit/miss combine shapes only on MIXED ticks, which only a
+        # realistic warm stream produces).  Warming from the same Zipf
+        # pool is also the honest steady state: production caches are
+        # warm on the popular head, misses still happen in the tail
+        for i in range(8):
+            try:
+                client.query(f"warmup probe {i} off stream", k=10)
+            except Exception:  # noqa: BLE001 — warmup only
+                pass
+
+        def _loadgen(use_seed: int):
+            return subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--zipf-loadgen", client.url, str(n_docs), str(zipf_s),
+                 str(clients), str(queries_per_client), str(use_seed)],
+                capture_output=True, text=True, timeout=900,
+            )
+
+        warm = _loadgen(seed + 1)
+        if warm.returncode != 0:
+            rec["error"] = f"warm loadgen failed: {warm.stderr[-1500:]}"
+            return rec
+        qc.reset_query_cache_counters()
+        proc = _loadgen(seed)
+        if proc.returncode != 0:
+            rec["error"] = f"loadgen failed: {proc.stderr[-1500:]}"
+            return rec
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        lat, errors = out["lat"], out["errors"]
+        total = clients * queries_per_client
+        if len(lat) < total * 0.8:
+            rec["error"] = f"{phase}: only {len(lat)}/{total} succeeded"
+            return rec
+        rec["queries_per_sec"] = round(len(lat) / out["elapsed_s"], 2)
+        rec["query_p50_ms"] = round(_pctl(lat, 0.50), 1)
+        rec["query_p99_ms"] = round(_pctl(lat, 0.99), 1)
+        rec["errors"] = errors
+        stats = qc.query_cache_stats()
+        rec["result_hits"] = stats["result"]["hits"]
+        rec["result_misses"] = stats["result"]["misses"]
+        rec["result_hit_rate"] = stats["result"]["hit_rate"]
+        rec["stale_served"] = stats["result"]["stale_served"]
+        rec["embed_hits"] = stats["embed"]["hits"]
+        rec["embed_misses"] = stats["embed"]["misses"]
+        rec["collab_embeds"] = stats["collab"]["embeds_total"]
+    return rec
+
+
+def run_zipf(n_docs: int, zipf_s: float, clients: int,
+             queries_per_client: int, mock: bool, seed: int = 20260803) -> dict:
+    """Cache-stack A/B over the SAME seeded Zipf stream: phase
+    subprocesses (the PR 7/8 isolation lesson — a still-running phase-1
+    server would depress the phase-2 number), cached vs uncached QPS at
+    p99 parity, appended to serving_results.jsonl."""
+    out: dict = {
+        "metric": "rag_serving_zipf",
+        "n_docs": n_docs,
+        "zipf_s": zipf_s,
+        "clients": clients,
+        "queries_per_client": queries_per_client,
+        "mock_embedder": mock,
+        "seed": seed,
+    }
+    for phase in ("uncached", "cached"):
+        rec, err = _phase_child(
+            ["--zipf-phase", phase, str(n_docs), str(zipf_s), str(clients),
+             str(queries_per_client), "1" if mock else "0", str(seed)],
+            timeout=1800,
+        )
+        if err is not None:
+            out["error"] = f"{phase}: {err}"
+            return out
+        if "platform" in rec:
+            out["platform"] = rec.pop("platform")
+        for key, value in rec.items():
+            out[f"{phase}_{key}"] = value
+    out["qps_speedup"] = round(
+        out["cached_queries_per_sec"]
+        / max(out["uncached_queries_per_sec"], 1e-9),
+        2,
+    )
+    out["p99_ratio"] = round(
+        out["cached_query_p99_ms"] / max(out["uncached_query_p99_ms"], 1e-9),
+        3,
+    )
+    # acceptance shape (ROADMAP item 5): ≥2× QPS at p99 parity (cached
+    # p99 no worse than 1.1× uncached — hits should only ever help)
+    out["meets_acceptance"] = bool(
+        out["qps_speedup"] >= 2.0 and out["p99_ratio"] <= 1.1
+    )
+    return out
+
+
 def _phase_child(argv: list[str], timeout: float) -> tuple[dict | None, str | None]:
     """Run this script as a one-phase child process and parse its last
     JSON-object stdout line.  Returns ``(record, None)`` on success or
@@ -1040,6 +1289,19 @@ if __name__ == "__main__":
         )
         print(json.dumps(rec))
         sys.exit(0 if "error" not in rec else 1)
+    if len(sys.argv) > 1 and sys.argv[1] == "--zipf-loadgen":
+        url, n_s, s_s, clients_s, qpc_s, seed_s = sys.argv[2:8]
+        _run_zipf_loadgen(url, int(n_s), float(s_s), int(clients_s),
+                          int(qpc_s), int(seed_s))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "--zipf-phase":
+        phase_s, n_s, s_s, clients_s, qpc_s, mock_s, seed_s = sys.argv[2:9]
+        rec = run_zipf_phase(
+            phase_s, int(n_s), float(s_s), int(clients_s), int(qpc_s),
+            mock_s == "1", int(seed_s),
+        )
+        print(json.dumps(rec))
+        sys.exit(0 if "error" not in rec else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "--contention-phase":
         phase_s, n_s, clients_s, qpc_s, pace_s, load_s, mock_s = sys.argv[2:9]
         rec = run_contention_phase(
@@ -1078,8 +1340,17 @@ if __name__ == "__main__":
         i = args.index("--mesh")
         mesh_n = int(args[i + 1])
         del args[i : i + 2]
+    zipf_s = 0.0
+    if "--zipf" in args:
+        i = args.index("--zipf")
+        zipf_s = float(args[i + 1])
+        del args[i : i + 2]
     n = int(args[0]) if args else 120
-    if mesh_n > 1:
+    if zipf_s > 0:
+        if clients <= 0:
+            clients = 8
+        out = run_zipf(n, zipf_s, clients, qpc, mock)
+    elif mesh_n > 1:
         out = run_mesh(n, mesh_n, mock)
     elif ingest_load > 0:
         if clients <= 0:

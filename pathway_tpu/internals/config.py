@@ -14,9 +14,48 @@ from ``jax.device_count`` (parallel/mesh.py) rather than env vars.
 from __future__ import annotations
 
 import os
+import warnings
 from dataclasses import dataclass, field
 
-__all__ = ["PathwayConfig", "get_pathway_config", "MAX_WORKERS"]
+__all__ = [
+    "PathwayConfig",
+    "get_pathway_config",
+    "MAX_WORKERS",
+    "env_int",
+    "env_float",
+]
+
+
+def env_int(name: str, default: int, lo: int | None = None) -> int:
+    """``int(os.environ[name])`` with the repo-wide garbage idiom: unset
+    or blank reads the default, garbage warns loudly and falls back to
+    the default (never raises at import/serve time), ``lo`` clamps."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        warnings.warn(
+            f"{name}={raw!r} is not an integer; using {default}", stacklevel=2
+        )
+        return default
+    return val if lo is None else max(val, lo)
+
+
+def env_float(name: str, default: float, lo: float | None = None) -> float:
+    """Float twin of :func:`env_int` (same warn-and-default contract)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        warnings.warn(
+            f"{name}={raw!r} is not a number; using {default}", stacklevel=2
+        )
+        return default
+    return val if lo is None else max(val, lo)
 
 # reference caps non-enterprise runs at 8 workers (config.rs:7-11); kept as
 # a constant for parity, not enforced as a license gate

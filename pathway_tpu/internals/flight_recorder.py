@@ -645,6 +645,13 @@ _ingest_counters = {
     "tokenizer_cache_misses": 0,
 }
 
+#: per-encoder tokenizer-cache counters (encoder label -> [hits, misses]).
+#: The shared TokenCache serves every tokenizer in the process; without
+#: the label one server running the hashing tokenizer AND an HF one (or
+#: the query-embedding cache next to an ingest encoder) would alias their
+#: hit rates into one number.
+_tokenizer_cache_by_encoder: dict[str, list[int]] = {}
+
 #: attention implementations active in this process (impl -> encoders
 #: built with it); surfaced on /status and the /v1/health runtime block
 _attn_impls: dict[str, int] = {}
@@ -704,10 +711,21 @@ def record_ingest_docs(n: int) -> None:
         _ingest_counters["docs_total"] += int(n)
 
 
-def record_tokenizer_cache(hits: int = 0, misses: int = 0) -> None:
+def record_tokenizer_cache(
+    hits: int = 0, misses: int = 0, encoder: str = "default"
+) -> None:
+    """One tokenizer-cache lookup batch's accounting, labeled by the
+    encoder it served (``pathway_tokenizer_cache_*_total{encoder=}``).
+    The unlabeled process totals stay available in :func:`ingest_stats`
+    (and render on the exposition only until the first labeled lookup —
+    the labeled series REPLACE the unlabeled one there, so a
+    ``sum()`` over the family never double-counts; see MIGRATION)."""
     with _ingest_lock:
         _ingest_counters["tokenizer_cache_hits"] += int(hits)
         _ingest_counters["tokenizer_cache_misses"] += int(misses)
+        slot = _tokenizer_cache_by_encoder.setdefault(str(encoder), [0, 0])
+        slot[0] += int(hits)
+        slot[1] += int(misses)
 
 
 def ingest_stats() -> dict[str, Any]:
@@ -732,6 +750,12 @@ def ingest_stats() -> dict[str, Any]:
     snap["tokenizer_cache_hit_rate"] = (
         hits / (hits + misses) if hits + misses else 0.0
     )
+    with _ingest_lock:
+        if _tokenizer_cache_by_encoder:
+            snap["tokenizer_cache_by_encoder"] = {
+                enc: {"hits": s[0], "misses": s[1]}
+                for enc, s in _tokenizer_cache_by_encoder.items()
+            }
     return snap
 
 
@@ -833,14 +857,37 @@ def observability_metrics_lines() -> list[str]:
             lines.append(
                 f'pathway_attention_impl{{impl="{escape_label_value(impl)}"}} {n}'
             )
+    # per-encoder labels so two caches in one server (e.g. the ingest
+    # tokenizer next to the query-embedding cache's key pass) don't
+    # alias; the unlabeled process total is the no-label-set fallback
+    # when nothing recorded an encoder yet
+    with _ingest_lock:
+        by_encoder = {
+            enc: tuple(s) for enc, s in _tokenizer_cache_by_encoder.items()
+        }
     lines.append("# TYPE pathway_tokenizer_cache_hits_total counter")
-    lines.append(
-        f"pathway_tokenizer_cache_hits_total {ing['tokenizer_cache_hits']}"
-    )
+    if by_encoder:
+        for enc in sorted(by_encoder):
+            lines.append(
+                f'pathway_tokenizer_cache_hits_total{{encoder="'
+                f'{escape_label_value(enc)}"}} {by_encoder[enc][0]}'
+            )
+    else:
+        lines.append(
+            f"pathway_tokenizer_cache_hits_total {ing['tokenizer_cache_hits']}"
+        )
     lines.append("# TYPE pathway_tokenizer_cache_misses_total counter")
-    lines.append(
-        f"pathway_tokenizer_cache_misses_total {ing['tokenizer_cache_misses']}"
-    )
+    if by_encoder:
+        for enc in sorted(by_encoder):
+            lines.append(
+                f'pathway_tokenizer_cache_misses_total{{encoder="'
+                f'{escape_label_value(enc)}"}} {by_encoder[enc][1]}'
+            )
+    else:
+        lines.append(
+            "pathway_tokenizer_cache_misses_total "
+            f"{ing['tokenizer_cache_misses']}"
+        )
     return lines
 
 
@@ -853,6 +900,7 @@ def reset_stage_metrics() -> None:
     with _ingest_lock:
         for k in _ingest_counters:
             _ingest_counters[k] = 0
+        _tokenizer_cache_by_encoder.clear()
         # _attn_impls is deliberately NOT cleared: it is configuration
         # state (which kernel the live encoders serve with), recorded
         # only at construction — a stats reset must not blank the

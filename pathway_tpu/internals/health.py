@@ -246,6 +246,19 @@ class HealthRegistry:
                     snap["tiering"] = tiering
         except Exception:  # noqa: BLE001 — health must never raise
             pass
+        # serving query cache: per-plane cache configuration + process
+        # hit/miss/stale counters — read-only and gated on the module
+        # already being imported (a health probe never pulls in jax)
+        try:
+            import sys as _sys
+
+            mod = _sys.modules.get("pathway_tpu.xpacks.llm._query_cache")
+            if mod is not None:
+                qcache = mod.query_cache_status()
+                if qcache:
+                    snap["query_cache"] = qcache
+        except Exception:  # noqa: BLE001 — health must never raise
+            pass
         try:
             from ..testing import faults
 

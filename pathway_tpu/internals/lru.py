@@ -1,0 +1,75 @@
+"""One bounded, locked LRU map for every cache in the tree.
+
+Both the tokenizer row cache (``models/tokenizer.py`` ``TokenCache``)
+and the serving query-cache layers (``xpacks/llm/_query_cache.py``)
+need the same mechanics — capacity-bounded OrderedDict, move-to-end on
+touch, oldest-first eviction, one lock — and differ only in which
+counter sink the accounting feeds.  Keeping the mechanics here means an
+eviction or locking fix reaches every cache at once; subclasses layer
+their own hit/miss recording on the returned accounting.
+
+Stdlib-only leaf: importable from the tokenizer hot path and from
+health probes without pulling jax/numpy.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+__all__ = ["BoundedLru"]
+
+
+class BoundedLru:
+    """Capacity-bounded LRU map.  All methods are thread-safe; the
+    batch methods return their accounting (hit/eviction counts) instead
+    of recording it, so each subclass can feed its own counter sink."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._map: OrderedDict = OrderedDict()
+
+    def get(self, key):
+        """Value or None, LRU order refreshed on hit."""
+        with self._lock:
+            ent = self._map.get(key)
+            if ent is not None:
+                self._map.move_to_end(key)
+            return ent
+
+    def put(self, key, value) -> int:
+        """Insert/update one entry; returns how many entries were
+        evicted to stay within capacity."""
+        return self.put_many([(key, value)])
+
+    def get_many(self, keys: list) -> tuple[list, int]:
+        """``(values, hits)`` — one value (or None) per key, LRU order
+        refreshed on each hit, all under one lock acquisition."""
+        hits = 0
+        out = []
+        with self._lock:
+            for key in keys:
+                ent = self._map.get(key)
+                if ent is not None:
+                    self._map.move_to_end(key)
+                    hits += 1
+                out.append(ent)
+        return out, hits
+
+    def put_many(self, items: list) -> int:
+        """Insert/update ``(key, value)`` pairs; returns the eviction
+        count (oldest-first once over capacity)."""
+        evicted = 0
+        with self._lock:
+            for key, value in items:
+                self._map[key] = value
+                self._map.move_to_end(key)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+                evicted += 1
+        return evicted
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)

@@ -171,11 +171,33 @@ METRICS: dict[str, tuple[str, str]] = {
     ),
     "pathway_tokenizer_cache_hits_total": (
         "counter",
-        "tokenizer LRU memoization hits (dedup-heavy live streams)",
+        "tokenizer LRU memoization hits per encoder (dedup-heavy live streams)",
     ),
     "pathway_tokenizer_cache_misses_total": (
         "counter",
-        "tokenizer LRU memoization misses",
+        "tokenizer LRU memoization misses per encoder",
+    ),
+    # serving query cache stack (xpacks/llm/_query_cache.py) — every
+    # series carries a layer label (embed / result)
+    "pathway_query_cache_hits_total": (
+        "counter",
+        "serving-cache hits per layer (embed = encoder skipped, result = whole query skipped)",
+    ),
+    "pathway_query_cache_misses_total": (
+        "counter",
+        "serving-cache misses per layer (includes watermark-invalidated entries)",
+    ),
+    "pathway_query_cache_stale_served_total": (
+        "counter",
+        "result-cache entries served inside the stale-while-revalidate window",
+    ),
+    "pathway_query_cache_evictions_total": (
+        "counter",
+        "LRU evictions per cache layer",
+    ),
+    "pathway_collab_embeds_total": (
+        "counter",
+        "queries embedded on host CPU by the WindVE collaborative path under queue pressure",
     ),
 }
 

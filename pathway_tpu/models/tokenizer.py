@@ -18,10 +18,11 @@ from __future__ import annotations
 import itertools
 import os
 import threading
-from collections import OrderedDict
 from typing import Sequence
 
 import numpy as np
+
+from ..internals.lru import BoundedLru
 
 try:  # hot-path C++ batch encoder
     from pathway_tpu import _native
@@ -31,7 +32,7 @@ except Exception:  # pragma: no cover - fallback always works
 __all__ = ["HashTokenizer", "load_tokenizer", "token_cache", "TokenCache"]
 
 
-class TokenCache:
+class TokenCache(BoundedLru):
     """LRU memoization of per-text token rows.
 
     Dedup-heavy live streams (connector re-reads, repeated queries,
@@ -43,39 +44,18 @@ class TokenCache:
     conservative.  Hit/miss totals feed ``/status``
     (``pathway_tokenizer_cache_hits_total`` / ``_misses_total``)."""
 
-    def __init__(self, capacity: int):
-        self.capacity = capacity
-        self._lock = threading.Lock()
-        self._map: OrderedDict = OrderedDict()
-
-    def get_many(self, keys: list) -> list:
+    def get_many(self, keys: list, encoder: str = "default") -> list:
         """Cached values (None for misses), LRU order refreshed; counts
-        one hit/miss per key into the flight-recorder accumulators."""
-        hits = 0
-        out = []
-        with self._lock:
-            for key in keys:
-                row = self._map.get(key)
-                if row is not None:
-                    self._map.move_to_end(key)
-                    hits += 1
-                out.append(row)
+        one hit/miss per key into the flight-recorder accumulators under
+        ``encoder`` (the cache is process-global and shared — without the
+        label two tokenizers in one server alias their hit rates)."""
+        out, hits = super().get_many(keys)
         from ..internals.flight_recorder import record_tokenizer_cache
 
-        record_tokenizer_cache(hits=hits, misses=len(keys) - hits)
+        record_tokenizer_cache(
+            hits=hits, misses=len(keys) - hits, encoder=encoder
+        )
         return out
-
-    def put_many(self, items: list) -> None:
-        with self._lock:
-            for key, row in items:
-                self._map[key] = row
-                self._map.move_to_end(key)
-            while len(self._map) > self.capacity:
-                self._map.popitem(last=False)
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._map)
 
 
 _cache_lock = threading.Lock()
@@ -217,7 +197,7 @@ class HashTokenizer:
                 )
                 for i, t in enumerate(texts)
             ]
-            rows = cache.get_many(keys)
+            rows = cache.get_many(keys, encoder="hash")
             miss = [i for i, r in enumerate(rows) if r is None]
             if len(miss) == len(texts):
                 # all-miss (cold ingest of unique docs): keep the raw
@@ -301,7 +281,7 @@ class _HFTokenizerWrapper:
                 )
                 for i, t in enumerate(texts)
             ]
-            rows = cache.get_many(keys)
+            rows = cache.get_many(keys, encoder=self._cache_name)
             miss = [i for i, r in enumerate(rows) if r is None]
             if len(miss) == len(texts):
                 # all-miss fast path: return the raw padded arrays as-is

@@ -61,7 +61,6 @@ import enum
 import os
 import threading
 import time
-import warnings
 from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, Sequence
@@ -266,26 +265,10 @@ _TICK_TOKEN_BUCKETS = (64.0, 256.0, 1024.0, 4096.0, 16384.0, 65536.0)
 _SHARE_BUCKETS = (0.01, 0.02, 0.05, 0.1, 0.2, 0.35, 0.5, 0.75, 1.0)
 
 
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if raw is None or not raw.strip():
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        warnings.warn(f"{name}={raw!r} is not a number; using {default}")
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if raw is None or not raw.strip():
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        warnings.warn(f"{name}={raw!r} is not an integer; using {default}")
-        return default
+# one shared warn-and-default parser for the whole repo (also used by
+# the serving query-cache knobs)
+from ..internals.config import env_float as _env_float  # noqa: E402
+from ..internals.config import env_int as _env_int  # noqa: E402
 
 
 def _env_flag(name: str, default: bool) -> bool:
@@ -370,6 +353,13 @@ class DeviceTickRuntime:
         register_metrics_provider(name, self, replace=False)
 
     # -- submission ------------------------------------------------------
+    def queue_depth(self, qos: QoS) -> int:
+        """Current queued (not yet drained) items of one class — the
+        WindVE-style pressure signal the serving cache stack's
+        collaborative CPU embed path keys on.  A GIL-atomic ``len`` read:
+        no lock, never spawns the executor thread."""
+        return len(self._queues[QoS(qos)])
+
     def on_runtime_thread(self) -> bool:
         return (
             self._thread is not None

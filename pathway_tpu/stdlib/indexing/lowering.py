@@ -14,7 +14,10 @@ timestamp is answered — the invariant the reference gets from
 
 from __future__ import annotations
 
+import threading
+import time
 import weakref
+from collections import deque
 from typing import Any
 
 import numpy as np
@@ -99,6 +102,18 @@ class ExternalIndexNode(Node):
         #: (RetrievePlane) answers from the lexical mirror until cleared
         self._restore_state: str | None = None
         self.restored_rows = 0
+        #: serving-cache freshness watermark: a monotone per-index commit
+        #: sequence advanced EXACTLY when the corpus visible to queries
+        #: changes (flush-applied upserts/deletes, snapshot restore).
+        #: Tier migrations (pathway_tpu/tiering) deliberately never pass
+        #: through here — scores are tier-independent by construction, so
+        #: a migration storm must not flush the result cache.
+        self.commit_seq = 0
+        #: bounded (seq, wall-time) history backing stale-while-revalidate;
+        #: the lock covers bump (engine flush thread) vs read (serving
+        #: scheduler thread) — iterating a deque mid-append raises
+        self._commit_times: deque[tuple[int, float]] = deque(maxlen=256)
+        self._commit_times_lock = threading.Lock()
 
     def flush(self, time: int) -> list[Entry]:
         out: list[Entry] = []
@@ -136,6 +151,16 @@ class ExternalIndexNode(Node):
             else:
                 last[key] = None
         add_keys = [k for k, v in last.items() if v is not None]
+        # the corpus visible to queries changes only when something real
+        # applies: an upsert, or a remove of a key actually present.
+        # ERROR-skipped docs and removes of absent keys must NOT bump the
+        # watermark — a stream of failing UDF docs would otherwise
+        # invalidate the whole result cache every flush while serving the
+        # exact same corpus (computed BEFORE applying: the apply pops
+        # removed keys from doc_payload)
+        corpus_changed = bool(add_keys) or any(
+            v is None and k in self.doc_payload for k, v in last.items()
+        )
         try:
             self._apply_index_updates(last, payloads, add_keys)
         except Exception as exc:  # noqa: BLE001 — classify before routing
@@ -184,6 +209,10 @@ class ExternalIndexNode(Node):
             get_freshness().note_indexed(
                 self.name, time, scope=getattr(self, "_freshness_scope", 0)
             )
+        if corpus_changed:
+            # serving result cache: entries cached at an older commit_seq
+            # are no longer exact from this point (xpacks/llm/_query_cache)
+            self.bump_commit_seq()
         # 2. answer new queries
         new_queries: list[tuple[Any, tuple]] = []
         for key, row, diff in self.take(1):
@@ -230,6 +259,31 @@ class ExternalIndexNode(Node):
                         out.append((key, new_row, 1))
                         slot[1] = new_row
         return consolidate(out)
+
+    # -- serving-cache freshness watermark -------------------------------
+    def bump_commit_seq(self) -> None:
+        """Advance the per-index commit sequence (see the attribute doc:
+        corpus-changing flushes and snapshot restores only — NEVER tier
+        migrations)."""
+        with self._commit_times_lock:
+            self.commit_seq += 1
+            self._commit_times.append((self.commit_seq, time.time()))
+
+    def stale_age(self, watermark: int) -> float | None:
+        """Seconds since the index FIRST advanced past ``watermark`` —
+        i.e. how stale a result cached at that watermark is now.  None
+        when unknown (no history, or the advance aged out of the bounded
+        ring): callers must treat unknown as too stale."""
+        with self._commit_times_lock:
+            times = tuple(self._commit_times)
+        if not times:
+            return None
+        if times[0][0] > watermark + 1:
+            return None  # the true first-advance time was evicted
+        for seq, t in times:
+            if seq > watermark:
+                return max(0.0, time.time() - t)
+        return None
 
     # -- index-update application + device-fault containment ------------
     def _apply_index_updates(self, last, payloads, add_keys) -> None:
@@ -514,6 +568,9 @@ class ExternalIndexNode(Node):
         if placement is not None and hasattr(self.index, "finish_restore"):
             self.index.finish_restore()
         self.restored_rows = len(keys)
+        # restore invalidates any serving-cache entry from a previous
+        # engine life in this process (xpacks/llm/_query_cache)
+        self.bump_commit_seq()
 
     def _answer(self, rows: list[tuple]) -> list[tuple]:
         queries = []

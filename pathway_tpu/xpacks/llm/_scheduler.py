@@ -771,6 +771,13 @@ class RetrievePlane:
             max_batch=max_batch,
             token_estimate=lambda payload: estimate_tokens(payload[0]),
         )
+        # serving cache stack (xpacks/llm/_query_cache): embedding +
+        # result caches and the collaborative CPU embed path, built
+        # lazily on first healthy batch so env knobs read at serve time
+        self._query_cache_stack = None
+        self._query_cache_tried = False
+        self._query_cache_build_logged = False
+        self._refresh_group: WorkGroup | None = None
 
     @property
     def deadline_ms(self) -> float | None:
@@ -838,25 +845,41 @@ class RetrievePlane:
                 if faults.enabled:
                     faults.perturb("embedder")
                 texts = [q for q, _, _ in items]
-                with batch_stage("embed"):
-                    # fused handoff: keep the tick's embeddings ON DEVICE
-                    # between encode and search when the index consumes
-                    # whole-batch queries (search discards the dispatch
-                    # pad rows; the sharded index replicates the batch
-                    # across the mesh and merges per-shard top-k over ICI)
-                    embs = None
-                    if hasattr(index, "search_embedded"):
-                        embs = _batch_embed_device(self.embedder, texts)
-                    if embs is None:
-                        embs = _batch_embed(self.embedder, texts)
                 specs = [(k, flt) for _, k, flt in items]
-                with batch_stage("search"):
-                    if hasattr(index, "search_embedded"):
-                        raw = index.search_embedded(embs, specs)
-                    else:
-                        raw = index.search(
-                            [(embs[i], k, flt) for i, (k, flt) in enumerate(specs)]
-                        )
+                stack = self._cache_stack()
+                # the cache stack fronts only the fully-healthy fused
+                # path: a half-open breaker's probe batch must actually
+                # probe the device (a cache hit would "heal" a dead
+                # embedder), and custom indexes without search_embedded
+                # keep the legacy per-row path
+                use_stack = (
+                    stack is not None
+                    and hasattr(index, "search_embedded")
+                    and getattr(node, "commit_seq", None) is not None
+                    and (self.breaker is None or self.breaker.state == "closed")
+                )
+                if use_stack:
+                    raw = stack.serve(self, node, index, texts, specs, items)
+                else:
+                    with batch_stage("embed"):
+                        # fused handoff: keep the tick's embeddings ON
+                        # DEVICE between encode and search when the index
+                        # consumes whole-batch queries (search discards
+                        # the dispatch pad rows; the sharded index
+                        # replicates the batch across the mesh and merges
+                        # per-shard top-k over ICI)
+                        embs = None
+                        if hasattr(index, "search_embedded"):
+                            embs = _batch_embed_device(self.embedder, texts)
+                        if embs is None:
+                            embs = _batch_embed(self.embedder, texts)
+                    with batch_stage("search"):
+                        if hasattr(index, "search_embedded"):
+                            raw = index.search_embedded(embs, specs)
+                        else:
+                            raw = index.search(
+                                [(embs[i], k, flt) for i, (k, flt) in enumerate(specs)]
+                            )
             except Exception as exc:  # noqa: BLE001 — degrade, don't 5xx
                 # record FIRST: even without a fallback the breaker must
                 # trip so repeated failures fail fast (ServingNotReady)
@@ -913,6 +936,92 @@ class RetrievePlane:
             {"results": self._pack(node, row), "degraded": True}
             for row in raw
         ]
+
+    # -- serving cache stack (xpacks/llm/_query_cache) -------------------
+    def _cache_stack(self):
+        """The plane's cache stack, built once (None when every layer is
+        disabled or the embedder can't be keyed).  A build failure (e.g.
+        the embedder's lazy model load hiccuping) must neither ride the
+        serving tick's except — a cache is an optimization, charging the
+        breaker for it would degrade a healthy device — nor latch: the
+        tried-flag is set only on success, so the next batch retries
+        (the same lazy load _batch_embed is about to do anyway)."""
+        if not self._query_cache_tried:
+            from ._query_cache import build_stack
+
+            try:
+                self._query_cache_stack = build_stack(
+                    self.embedder, label=self.group.label
+                )
+            except Exception as exc:  # noqa: BLE001 — cache is optional
+                if not self._query_cache_build_logged:
+                    self._query_cache_build_logged = True
+                    from ...internals.errors import register_error
+
+                    register_error(
+                        f"query-cache stack build failed (serving "
+                        f"uncached, will retry): "
+                        f"{type(exc).__name__}: {exc}",
+                        kind="serving",
+                        operator=self.group.label,
+                    )
+            else:
+                self._query_cache_tried = True
+        return self._query_cache_stack
+
+    def _cache_refresh_group(self) -> WorkGroup:
+        """WorkGroup for deferred stale-entry refreshes: same handler
+        surface as the serving group but its batches recompute WITHOUT
+        reading the result cache (a read would re-serve the same stale
+        entry and never converge)."""
+        if self._refresh_group is None:
+            from ._utils import estimate_tokens
+
+            self._refresh_group = WorkGroup(
+                f"{self.group.label}:cache_refresh",
+                self._refresh_batch,
+                max_batch=self.group.max_batch,
+                token_estimate=lambda payload: estimate_tokens(payload[0]),
+            )
+        return self._refresh_group
+
+    def _refresh_batch(self, payloads: list[tuple]):
+        """Deferred-refresh batch handler (BULK_INGEST class, nobody
+        waits on the futures): payloads are ``(query, k, filter, rkey)``.
+        Best-effort — a failure or bypass (restoring, breaker open)
+        keeps the stale entry in place for its window and is logged,
+        never raised into the runtime loop — but the in-flight markers
+        are ALWAYS released, so the next stale serve can re-schedule."""
+        from ...stdlib.indexing.lowering import live_index_node
+
+        out = [None] * len(payloads)
+        stack = self._query_cache_stack
+        if stack is None:
+            return out
+        rkeys = [p[3] for p in payloads]
+        try:
+            node = live_index_node(self.index_factory)
+            if node is None:
+                return out
+            if getattr(node, "_restore_state", None) == "restoring":
+                return out
+            if self.breaker is not None and self.breaker.state != "closed":
+                return out
+            stack.refresh(
+                self, node, node.index, [p[:3] for p in payloads], rkeys
+            )
+        except Exception as exc:  # noqa: BLE001 — best-effort
+            from ...internals.errors import register_error
+
+            register_error(
+                f"query-cache deferred refresh failed: "
+                f"{type(exc).__name__}: {exc}",
+                kind="serving",
+                operator=self.group.label,
+            )
+        finally:
+            stack.release_refresh(rkeys)
+        return out
 
     def _pack(self, node, row) -> list[dict]:
         from ...internals.value import Json

@@ -1,0 +1,698 @@
+"""Serving query cache stack tests (ISSUE 13): embedding/result cache
+hit/miss/watermark exactness (an upsert between identical queries MUST
+miss; a tier migration MUST NOT invalidate), stale-while-revalidate with
+a real deferred runtime refresh, partial-batch dispatch parity bit-exact
+vs cache-off, the collaborative CPU embed path (parity + engages only
+under queue depth), degraded/restore/mesh/int8 interaction pins, env-knob
+garbage handling, and the observability surface."""
+
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+from pathway_tpu.stdlib.indexing.lowering import (
+    ExternalIndexNode,
+    _LIVE_INDEX_NODES,
+)
+from pathway_tpu.stdlib.indexing.retrievers import BruteForceKnnIndex
+from pathway_tpu.xpacks.llm import _query_cache as qc
+from pathway_tpu.xpacks.llm._scheduler import RetrievePlane, ServingScheduler
+from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    qc.reset_query_cache_counters()
+    yield
+    qc.reset_query_cache_counters()
+
+
+def _small_encoder(**cfg_kw):
+    cfg = EncoderConfig(
+        vocab_size=512, hidden_dim=32, num_layers=1, num_heads=4,
+        mlp_dim=64, max_len=64, dtype=jnp.float32, **cfg_kw,
+    )
+    return SentenceEncoder(cfg=cfg, max_length=64)
+
+
+class _Harness:
+    """A RetrievePlane over a hand-built live index node — the serving
+    surface without the engine/webserver around it."""
+
+    def __init__(self, *, docs=None, embedder=None, metric="cos",
+                 index_dtype=None, mesh=None, hot_rows=None, capacity=64,
+                 name="qc-test", lexical_fallback=True):
+        self.encoder = None
+        if embedder is None:
+            self.encoder = _small_encoder()
+            embedder = SentenceTransformerEmbedder(encoder=self.encoder)
+        elif getattr(embedder, "_ensure_encoder", None) is not None:
+            self.encoder = embedder._ensure_encoder()
+        self.embedder = embedder
+        self.docs = docs if docs is not None else [
+            f"doc number {i} about topic {i}" for i in range(10)
+        ]
+        dim = (
+            self.encoder.dim
+            if self.encoder is not None
+            else embedder.get_embedding_dimension()
+        )
+        self.index = BruteForceKnnIndex(
+            dim=dim, metric=metric, capacity=capacity, mesh=mesh,
+            index_dtype=index_dtype, hot_rows=hot_rows,
+        )
+        if self.encoder is not None:
+            vecs = self.encoder.encode(self.docs)
+        else:
+            vecs = np.stack(
+                [np.asarray(embedder.__wrapped__(t)) for t in self.docs]
+            )
+        keys = list(range(len(self.docs)))
+        self.index.add_batch(keys, vecs, [{} for _ in self.docs])
+        self.node = ExternalIndexNode(
+            self.index, None, None, None, None, None, None, name=name,
+        )
+        self.node.doc_payload = {
+            i: (self.docs[i], {}) for i in range(len(self.docs))
+        }
+        self.node.bump_commit_seq()
+        self.factory = object()  # identity key for live_index_node
+        _LIVE_INDEX_NODES[id(self.factory)] = self.node
+        self.scheduler = ServingScheduler(name=f"sched-{name}")
+        self.plane = RetrievePlane(
+            index_factory=self.factory,
+            embedder=embedder,
+            payload_columns=["text", "metadata"],
+            scheduler=self.scheduler,
+            lexical_fallback=lexical_fallback,
+        )
+
+    def batch(self, queries, k=3):
+        return self.plane._batch([(q, k, None) for q in queries])
+
+    def cache_off_plane(self):
+        plane = RetrievePlane(
+            index_factory=self.factory,
+            embedder=self.embedder,
+            payload_columns=["text", "metadata"],
+            scheduler=self.scheduler,
+        )
+        plane._query_cache_tried = True  # stack stays None: cache off
+        return plane
+
+
+def _dists(rows):
+    return [[(r["text"], r["dist"]) for r in row["results"]] for row in rows]
+
+
+# ---------------------------------------------------------------------------
+# hit/miss + watermark exactness
+# ---------------------------------------------------------------------------
+
+
+def test_result_cache_hit_and_upsert_invalidates():
+    """Identical queries hit; an upsert between them (the flush bumping
+    commit_seq) MUST miss and recompute against the new corpus."""
+    h = _Harness(name="wm")
+    queries = [h.docs[0], h.docs[1]]
+    r1 = h.batch(queries)
+    s0 = qc.query_cache_stats()["result"]
+    assert s0["misses"] == 2 and s0["hits"] == 0
+    r2 = h.batch(queries)
+    s1 = qc.query_cache_stats()["result"]
+    assert s1["hits"] == 2
+    assert _dists(r2) == _dists(r1)
+    # upsert a doc that outranks everything for query 0 (identical text)
+    newvec = h.encoder.encode([h.docs[0]])
+    h.index.add_batch([99], newvec, [{}])
+    h.node.doc_payload[99] = (h.docs[0], {})
+    h.node.bump_commit_seq()  # what ExternalIndexNode.flush does
+    r3 = h.batch(queries)
+    s2 = qc.query_cache_stats()["result"]
+    assert s2["misses"] == 4, "watermark advance must MISS, not serve stale"
+    texts0 = [r["text"] for r in r3[0]["results"]]
+    assert texts0.count(h.docs[0]) == 2, "recompute must see the new doc"
+
+
+def test_flush_bumps_commit_seq_and_stale_age():
+    """The node-level watermark contract: flush-applied changes advance
+    commit_seq with a wall-clock history; stale_age measures from the
+    FIRST advance past the entry's watermark and reports unknown once
+    history ages out."""
+    h = _Harness(name="seq")
+    node = h.node
+    seq0 = node.commit_seq
+    before = time.time()
+    node.bump_commit_seq()
+    assert node.commit_seq == seq0 + 1
+    age = node.stale_age(seq0)
+    assert age is not None and 0 <= age <= time.time() - before + 1.0
+    # watermark at the current seq: not stale at all (no advance past it)
+    assert node.stale_age(node.commit_seq) is None
+    # aged-out history must read as unknown, never as "fresh enough"
+    node._commit_times.clear()
+    node._commit_times.append((node.commit_seq, time.time()))
+    assert node.stale_age(0) is None
+
+
+def test_embedding_cache_lru_bounds_and_evictions():
+    cache = qc.EmbeddingCache(capacity=2)
+    rows = [np.full(4, i, dtype=np.float32) for i in range(3)]
+    cache.put_many([(b"a", rows[0]), (b"b", rows[1])])
+    assert len(cache) == 2
+    got = cache.get_many([b"a", b"b", b"c"])
+    assert got[0] is rows[0] and got[2] is None
+    cache.put_many([(b"c", rows[2])])  # evicts LRU (b"a" is oldest-touched)
+    assert len(cache) == 2
+    assert cache.get_many([b"a"])[0] is None
+    assert qc.query_cache_stats()["embed"]["evictions"] == 1
+
+
+def test_token_hash_near_duplicates_hit():
+    """Whitespace/casing variants that tokenize identically share the
+    embedding- and result-cache key (post-tokenization hashing — the
+    hash tokenizer lowercases and splits on whitespace)."""
+    h = _Harness(name="neardup")
+    h.batch(["doc number 3 about topic 3"])
+    r2 = h.batch(["DOC  number 3   ABOUT topic 3"])
+    s = qc.query_cache_stats()
+    assert s["result"]["hits"] == 1 and s["result"]["misses"] == 1
+    assert r2[0]["results"][0]["text"] == h.docs[3]
+
+
+# ---------------------------------------------------------------------------
+# tier migrations must NOT invalidate
+# ---------------------------------------------------------------------------
+
+
+def test_tier_migration_does_not_invalidate():
+    """A tiered index's online migration (PR 12) moves rows between HBM
+    and host RAM without changing any score — it must neither bump the
+    watermark nor change what a cached result serves."""
+    h = _Harness(name="tiermig", hot_rows=4, capacity=64)
+    tiered = h.index.index  # TieredKnnIndex behind the inner index
+    q = h.docs[2]
+    r1 = h.batch([q])
+    seq_before = h.node.commit_seq
+    # drive access drift directly on the tiered index (engine-path
+    # traffic) and force the migration batch inline
+    probe = h.encoder.encode([h.docs[7], h.docs[8]])
+    for _ in range(20):
+        tiered.search(probe, 2)
+    moved = tiered.migrate()
+    assert h.node.commit_seq == seq_before, "migration must not bump"
+    r2 = h.batch([q])
+    s = qc.query_cache_stats()["result"]
+    assert s["hits"] >= 1, "cached entry must survive the migration"
+    assert _dists(r2) == _dists(r1)
+    assert moved["promoted"] + moved["demoted"] > 0 or (
+        tiered.migrations["promote"] + tiered.migrations["demote"] > 0
+    ), "the migration path never actually moved a row"
+
+
+# ---------------------------------------------------------------------------
+# stale-while-revalidate
+# ---------------------------------------------------------------------------
+
+
+def test_stale_served_and_deferred_refresh_runs(monkeypatch):
+    """Within PATHWAY_RESULT_CACHE_STALE_S a watermark-mismatched entry
+    is served verbatim and the query resubmits as a DEFERRED runtime
+    item (BULK_INGEST — completed_total must actually advance); the
+    refreshed entry then serves fresh."""
+    from pathway_tpu.runtime import get_runtime
+
+    monkeypatch.setenv("PATHWAY_RESULT_CACHE_STALE_S", "30")
+    h = _Harness(name="swr")
+    q = h.docs[0]
+    r1 = h.batch([q])
+    # corpus change: an identical-text doc under a new key
+    h.index.add_batch([99], h.encoder.encode([q]), [{}])
+    h.node.doc_payload[99] = (q, {})
+    h.node.bump_commit_seq()
+    rt = get_runtime()
+    bulk_before = rt.stats()["classes"]["bulk_ingest"]["completed_total"]
+    r2 = h.batch([q])
+    assert _dists(r2) == _dists(r1), "stale entry must serve verbatim"
+    assert qc.query_cache_stats()["result"]["stale_served"] == 1
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if (
+            rt.stats()["classes"]["bulk_ingest"]["completed_total"]
+            > bulk_before
+        ):
+            break
+        time.sleep(0.05)
+    assert (
+        rt.stats()["classes"]["bulk_ingest"]["completed_total"] > bulk_before
+    ), "deferred refresh never ran as a runtime item"
+    r3 = h.batch([q])
+    s = qc.query_cache_stats()["result"]
+    assert s["stale_served"] == 1, "post-refresh lookup must be a FRESH hit"
+    assert s["hits"] >= 1
+    texts = [r["text"] for r in r3[0]["results"]]
+    assert texts.count(q) == 2, "refreshed entry must include the new doc"
+
+
+def test_stale_window_zero_recomputes(monkeypatch):
+    """With the window disabled (default), a watermark mismatch is a
+    plain miss — exact invalidation only."""
+    monkeypatch.delenv("PATHWAY_RESULT_CACHE_STALE_S", raising=False)
+    h = _Harness(name="swr0")
+    h.batch([h.docs[0]])
+    h.node.bump_commit_seq()
+    h.batch([h.docs[0]])
+    s = qc.query_cache_stats()["result"]
+    assert s["stale_served"] == 0 and s["misses"] == 2
+
+
+# ---------------------------------------------------------------------------
+# partial-batch dispatch parity
+# ---------------------------------------------------------------------------
+
+
+def test_partial_batch_parity_bit_exact_vs_cache_off():
+    """A tick where most queries hit launches only the misses (a smaller
+    batch bucket) — keys AND scores must be BIT-EXACT vs the same tick
+    with the cache off (PR 5 bucket decomposition + device-array re-entry
+    make this exact, not approximate)."""
+    h = _Harness(name="partial")
+    warm = [h.docs[i] for i in range(6)]
+    h.batch(warm)  # fills embed+result caches for 6/8 of the next tick
+    tick = warm + ["completely fresh query alpha", "another fresh beta"]
+    r_on = h.plane._batch([(q, 3, None) for q in tick])
+    off = h.cache_off_plane()
+    r_off = off._batch([(q, 3, None) for q in tick])
+    assert _dists(r_on) == _dists(r_off)
+    s = qc.query_cache_stats()
+    assert s["result"]["hits"] >= 6
+    assert s["embed"]["misses"] >= 2
+
+
+def test_int8_index_parity_cached_vs_off():
+    """The cache layers sit above the index dtype (PR 11): cached and
+    uncached answers are identical at int8 too."""
+    h = _Harness(name="int8", index_dtype="int8")
+    queries = [h.docs[1], h.docs[4]]
+    r1 = h.batch(queries)
+    r2 = h.batch(queries)  # result-cache hits
+    off = h.cache_off_plane()
+    r_off = off._batch([(q, 3, None) for q in queries])
+    assert _dists(r1) == _dists(r_off)
+    assert _dists(r2) == _dists(r_off)
+    assert qc.query_cache_stats()["result"]["hits"] == 2
+
+
+def test_sharded_mesh_parity_cached_vs_off():
+    """Per-mesh-identity pin (PR 8): a sharded index's plane caches its
+    own entries and cached answers equal the sharded recompute."""
+    from pathway_tpu.parallel import make_mesh
+
+    h = _Harness(name="mesh2", mesh=make_mesh(2), capacity=64)
+    queries = [h.docs[2], h.docs[6]]
+    r1 = h.batch(queries)
+    r2 = h.batch(queries)
+    off = h.cache_off_plane()
+    r_off = off._batch([(q, 3, None) for q in queries])
+    assert _dists(r1) == _dists(r_off)
+    assert _dists(r2) == _dists(r_off)
+
+
+# ---------------------------------------------------------------------------
+# collaborative CPU path
+# ---------------------------------------------------------------------------
+
+
+def test_collab_twin_matches_device_encoder():
+    enc = _small_encoder()
+    twin = qc.CollabEncoder(enc)
+    texts = ["short query one", "a slightly longer collab query two"]
+    ids, mask = enc.tokenizer.encode_batch(texts, max_length=enc.max_length)
+    got = twin.encode_rows(ids, mask)
+    want = enc.encode(texts)
+    assert np.allclose(got, want, atol=2e-5), np.abs(got - want).max()
+
+
+def test_collab_engages_only_under_depth():
+    """Queue shallow -> everything rides the device tick; queue deep ->
+    short cold queries take the CPU twin (counted), and the answers stay
+    within tolerance of the device path."""
+    h = _Harness(name="collab")
+    stack = h.plane._cache_stack()
+    assert stack is not None and stack.collab is not None
+    stack._depth_fn = lambda: 0  # shallow: below PATHWAY_COLLAB_DEPTH
+    h.batch(["cold query shallow one"])
+    assert qc.query_cache_stats()["collab"]["embeds_total"] == 0
+    stack._depth_fn = lambda: 100  # deep: engage
+    h.batch(["cold query deep one"])  # first engagement = parity probe
+    assert stack.collab.parity_ok is True
+    h.batch(["cold query deep two"])  # twin actually embeds now
+    s = qc.query_cache_stats()["collab"]
+    assert s["embeds_total"] >= 1 and s["engaged_ticks"] >= 1
+    # parity of the served answers vs the device-only plane
+    off = h.cache_off_plane()
+    r_on = h.batch(["cold query deep three"])
+    r_off = off._batch([("cold query deep three", 3, None)])
+    on_rows = r_on[0]["results"]
+    off_rows = r_off[0]["results"]
+    assert [r["text"] for r in on_rows] == [r["text"] for r in off_rows]
+    for a, b in zip(on_rows, off_rows):
+        assert a["dist"] == pytest.approx(b["dist"], abs=5e-2)
+
+
+def test_collab_long_queries_stay_on_device(monkeypatch):
+    monkeypatch.setenv("PATHWAY_COLLAB_MAX_TOKENS", "4")
+    h = _Harness(name="collab-long")
+    stack = h.plane._cache_stack()
+    stack._depth_fn = lambda: 100
+    long_q = " ".join(f"tok{i}" for i in range(30))
+    h.batch([long_q])
+    assert qc.query_cache_stats()["collab"]["embeds_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# degraded / restore interaction
+# ---------------------------------------------------------------------------
+
+
+class _FailingEmbedder:
+    deterministic = True
+
+    def __init__(self):
+        self.healthy = False
+        self.dim = 8
+
+    def __wrapped__(self, text, **kw):
+        if not self.healthy:
+            raise RuntimeError("embedder down")
+        rng = np.random.default_rng(abs(hash(text)) % (2**32))
+        return rng.normal(size=self.dim).astype(np.float32)
+
+    def get_embedding_dimension(self, **kw):
+        return self.dim
+
+
+def test_degraded_answers_never_cached():
+    """While the embedder fails, answers come from the BM25 mirror tagged
+    degraded — and nothing lands in the result cache as authoritative."""
+    emb = _FailingEmbedder()
+    emb.healthy = True  # the harness embeds the corpus through it
+    h = _Harness(name="degraded", embedder=emb)
+    emb.healthy = False
+    stack = h.plane._cache_stack()
+    assert stack is not None
+    r = h.batch(["doc number 1 about topic 1"])
+    assert r[0]["degraded"] is True
+    assert len(stack.result_cache) == 0
+    # repeated degraded queries must not become cache hits
+    h.batch(["doc number 1 about topic 1"])
+    assert qc.query_cache_stats()["result"]["hits"] == 0
+    assert len(stack.result_cache) == 0
+
+
+def test_restore_invalidates_cached_results():
+    """restore_snapshot (PR 6) bumps the watermark: entries from the
+    previous engine life in this process cannot serve as exact hits."""
+    h = _Harness(name="restore")
+    h.batch([h.docs[0]])
+    seq = h.node.commit_seq
+    h.node.restore_snapshot({})
+    assert h.node.commit_seq == seq + 1
+    h.batch([h.docs[0]])
+    s = qc.query_cache_stats()["result"]
+    assert s["hits"] == 0 and s["misses"] == 2
+
+
+def test_new_engine_life_cannot_serve_old_entries():
+    """commit_seq restarts near 0 for every engine life: when a new node
+    (same factory) counts back up to the old entry's watermark over a
+    DIFFERENT corpus, the per-node epoch must keep the old entry from
+    reading as exactly fresh."""
+    h = _Harness(name="epoch")
+    q = h.docs[0]
+    for _ in range(4):
+        h.node.bump_commit_seq()
+    h.batch([q])  # cached at life 1's current seq
+    seq = h.node.commit_seq
+    idx2 = BruteForceKnnIndex(dim=h.encoder.dim, metric="cos", capacity=64)
+    docs2 = ["a totally different corpus row"]
+    idx2.add_batch([0], h.encoder.encode(docs2), [{}])
+    node2 = ExternalIndexNode(
+        idx2, None, None, None, None, None, None, name="epoch2",
+    )
+    node2.doc_payload = {0: (docs2[0], {})}
+    for _ in range(seq):
+        node2.bump_commit_seq()
+    assert node2.commit_seq == seq  # the counter collision, manufactured
+    _LIVE_INDEX_NODES[id(h.factory)] = node2
+    h.node2 = node2  # keep the weak-valued registry entry alive
+    r = h.batch([q])
+    assert qc.query_cache_stats()["result"]["hits"] == 0
+    assert r[0]["results"][0]["text"] == docs2[0]
+
+
+def test_restoring_gate_bypasses_cache():
+    """While the node restores, the plane answers from the mirror
+    (degraded) without consulting or filling the caches."""
+    h = _Harness(name="restoring")
+    h.node._restore_state = "restoring"
+    r = h.batch([h.docs[0]])
+    assert r[0]["degraded"] is True
+    stack = h.plane._cache_stack()
+    assert stack is None or len(stack.result_cache) == 0
+    s = qc.query_cache_stats()["result"]
+    assert s["hits"] == 0 and s["misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# knobs + observability
+# ---------------------------------------------------------------------------
+
+
+def test_env_knob_garbage_warns_and_defaults(monkeypatch):
+    monkeypatch.setenv("PATHWAY_EMBED_CACHE", "banana")
+    monkeypatch.setenv("PATHWAY_RESULT_CACHE_STALE_S", "soon")
+    monkeypatch.setenv("PATHWAY_COLLAB_DEPTH", "deep")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert qc.embed_cache_rows() == 4096
+        assert qc.result_cache_stale_s() == 0.0
+        assert qc.collab_depth() == 8
+    msgs = " ".join(str(w.message) for w in caught)
+    assert "PATHWAY_EMBED_CACHE" in msgs
+    assert "PATHWAY_RESULT_CACHE_STALE_S" in msgs
+    assert "PATHWAY_COLLAB_DEPTH" in msgs
+
+
+def test_zero_knobs_disable_stack(monkeypatch):
+    monkeypatch.setenv("PATHWAY_EMBED_CACHE", "0")
+    monkeypatch.setenv("PATHWAY_RESULT_CACHE", "0")
+    monkeypatch.setenv("PATHWAY_COLLAB_DEPTH", "0")
+    h = _Harness(name="disabled")
+    assert h.plane._cache_stack() is None
+    r = h.batch([h.docs[0]])  # legacy path still serves
+    assert r[0]["results"][0]["text"] == h.docs[0]
+    s = qc.query_cache_stats()
+    assert s["result"]["misses"] == 0 and s["embed"]["misses"] == 0
+
+
+def test_metrics_provider_and_health_block():
+    h = _Harness(name="obs")
+    h.batch([h.docs[0]])
+    h.batch([h.docs[0]])
+    prov = qc._QueryCacheMetricsProvider()
+    lines = prov.openmetrics_lines()
+    text = "\n".join(lines)
+    assert 'pathway_query_cache_hits_total{layer="result"} 1' in text
+    assert 'pathway_query_cache_misses_total{layer="embed"} 1' in text
+    assert "pathway_collab_embeds_total 0" in text
+    # /v1/health block, gated on module import (it IS imported here)
+    from pathway_tpu.internals.health import get_health
+
+    snap = get_health().snapshot()
+    assert "query_cache" in snap
+    block = snap["query_cache"]
+    assert block["counters"]["result"]["hits"] == 1
+    # THIS harness's stack must be in the block with its real capacities
+    # (other tests' long-lived server planes may coexist in the weak set)
+    stack = h.plane._cache_stack()
+    mine = [
+        p for p in block["planes"].values()
+        if p["result_rows"] == stack.result_cache.capacity
+        and p["embed_rows"] == stack.embed_cache.capacity
+    ]
+    assert mine, block["planes"]
+
+
+def test_status_endpoint_carries_query_cache_series():
+    from pathway_tpu.internals.monitoring import StatsMonitor
+
+    h = _Harness(name="status")
+    h.batch([h.docs[0]])
+    text = StatsMonitor().openmetrics()
+    assert "pathway_query_cache_hits_total" in text
+    assert "pathway_collab_embeds_total" in text
+
+
+def test_tokenizer_cache_counters_split_per_encoder():
+    """The bugfix satellite: the process-global TokenCache counters carry
+    an encoder label so the query-cache's tokenize pass and an HF ingest
+    tokenizer don't alias in one server."""
+    from pathway_tpu.internals.flight_recorder import (
+        ingest_stats,
+        observability_metrics_lines,
+    )
+    from pathway_tpu.models.tokenizer import HashTokenizer
+
+    tok = HashTokenizer(vocab_size=256)
+    tok.encode_batch(["alpha beta"], max_length=16)
+    tok.encode_batch(["alpha beta"], max_length=16)  # hit
+    stats = ingest_stats()
+    by_enc = stats.get("tokenizer_cache_by_encoder", {})
+    assert "hash" in by_enc and by_enc["hash"]["hits"] >= 1
+    text = "\n".join(observability_metrics_lines())
+    assert 'pathway_tokenizer_cache_hits_total{encoder="hash"}' in text
+    # the unlabeled total stays as the sum for back-compat dashboards
+    assert stats["tokenizer_cache_hits"] >= by_enc["hash"]["hits"]
+
+
+def test_refresh_dedup_single_inflight(monkeypatch):
+    """A stale key schedules at most one deferred refresh at a time."""
+    from concurrent.futures import Future
+
+    from pathway_tpu.runtime import get_runtime
+
+    monkeypatch.setenv("PATHWAY_RESULT_CACHE_STALE_S", "30")
+    h = _Harness(name="dedup")
+    q = h.docs[0]
+    h.batch([q])
+    h.node.bump_commit_seq()
+    submits = []
+    rt = get_runtime()
+
+    def spy_submit(group, payload, **kw):
+        submits.append(payload)
+        return Future()  # never runs: the key stays in flight
+
+    monkeypatch.setattr(rt, "submit", spy_submit)
+    h.batch([q])
+    h.batch([q])
+    assert len(submits) == 1, "same stale key must not double-submit"
+    assert qc.query_cache_stats()["result"]["stale_served"] == 2
+
+
+def test_noop_flush_does_not_bump_watermark():
+    """ERROR-skipped docs and removes of absent keys leave the corpus
+    visible to queries unchanged — the watermark must NOT advance (a
+    stream of failing-UDF docs would otherwise invalidate the whole
+    result cache every flush); a real upsert still bumps exactly once."""
+    from pathway_tpu.internals.value import ERROR
+
+    h = _Harness(name="wm-noop")
+    node = h.node
+    node.doc_meta_fn = lambda ctx: {}
+    node.doc_payload_fn = lambda ctx: (ctx[1], {})
+    seq0 = node.commit_seq
+    # 1. a flush whose only doc ERRORs out (failed embedding UDF)
+    node.doc_data_fn = lambda ctx: ERROR
+    node.pending[0] = [("bad-doc", "text that failed to embed", 1)]
+    node.flush(1)
+    assert node.commit_seq == seq0
+    # 2. a remove of a key that was never in the index
+    node.doc_data_fn = lambda ctx: h.encoder.encode([ctx[1]])[0]
+    node.pending[0] = [("never-there", "absent", -1)]
+    node.flush(2)
+    assert node.commit_seq == seq0
+    # 3. a genuine upsert bumps exactly once
+    node.pending[0] = [("new-key", "a genuinely new document", 1)]
+    node.flush(3)
+    assert node.commit_seq == seq0 + 1
+    # 4. and a genuine delete of that key bumps too
+    node.pending[0] = [("new-key", "a genuinely new document", -1)]
+    node.flush(4)
+    assert node.commit_seq == seq0 + 2
+
+
+def test_collab_rows_never_cached():
+    """Twin-embedded rows are tolerance-bounded, not bit-exact: they are
+    served under pressure but must fill NEITHER cache layer — a repeat
+    on a calm queue recomputes on the device (and only that fills)."""
+    h = _Harness(name="collab-nofill")
+    stack = h.plane._cache_stack()
+    assert stack is not None and stack.collab is not None
+    stack._depth_fn = lambda: 100
+    h.batch(["collab nofill probe"])  # first engagement = parity probe
+    assert stack.collab.parity_ok is True
+    qc.reset_query_cache_counters()
+    q = "collab nofill target"
+    r_deep = h.batch([q])  # twin embeds + serves
+    assert qc.query_cache_stats()["collab"]["embeds_total"] == 1
+    tkey = stack._tokenize_keys([q])[0][0]
+    assert tkey not in stack.embed_cache._map, "twin row cached"
+    # the result wasn't cached either: the calm-queue repeat is a MISS
+    # that recomputes on device
+    stack._depth_fn = lambda: 0
+    before = qc.query_cache_stats()["result"]["misses"]
+    r_calm = h.batch([q])
+    stats = qc.query_cache_stats()
+    assert stats["result"]["misses"] == before + 1
+    assert stats["collab"]["embeds_total"] == 1  # stayed on device
+    assert tkey in stack.embed_cache._map  # device row DID fill
+    # twin answer was within tolerance of the authoritative device one
+    deep = [x["dist"] for x in r_deep[0]["results"]]
+    calm = [x["dist"] for x in r_calm[0]["results"]]
+    assert calm == pytest.approx(deep, abs=5e-2)
+    # and the device recompute is what later hits serve
+    h.batch([q])
+    assert qc.query_cache_stats()["result"]["hits"] >= 1
+
+
+def test_collab_probe_tick_results_not_cached():
+    """The parity-probe tick serves its collab-eligible rows from the
+    HOST embed path — on a fused plane those differ from the device
+    encode at ~1e-7, enough to swap a near-tie rank — so its results
+    must not freeze into the result cache either; the calm-queue repeat
+    recomputes on device and only THAT fills."""
+    h = _Harness(name="collab-probe-nofill")
+    stack = h.plane._cache_stack()
+    assert stack is not None and stack.collab is not None
+    stack._depth_fn = lambda: 100
+    q = "collab probe nofill target"
+    h.batch([q])  # first engagement = the probe tick rides host rows
+    assert stack.collab.parity_ok is True
+    stack._depth_fn = lambda: 0
+    before = qc.query_cache_stats()["result"]["misses"]
+    h.batch([q])  # probe result was NOT cached: this is a device MISS
+    assert qc.query_cache_stats()["result"]["misses"] == before + 1
+    h.batch([q])  # ...and the device recompute is what hits serve
+    assert qc.query_cache_stats()["result"]["hits"] >= 1
+
+
+def test_collab_twin_error_fallback_not_cached():
+    """A twin that dies mid-flight falls back to the HOST embed path for
+    its rows — same ~1e-7 divergence story as the probe tick, so those
+    results must not be cached as authoritative."""
+    h = _Harness(name="collab-err-nofill")
+    stack = h.plane._cache_stack()
+    assert stack is not None and stack.collab is not None
+    stack._depth_fn = lambda: 100
+    h.batch(["collab err probe"])  # probe passes, twin armed
+    assert stack.collab.parity_ok is True
+
+    def _boom(ids, mask):
+        raise RuntimeError("twin died mid-flight")
+
+    stack.collab.encode_rows = _boom
+    q = "collab err target"
+    h.batch([q])  # twin errors -> host fallback serves, path disables
+    assert stack.collab.parity_ok is False
+    stack._depth_fn = lambda: 0
+    before = qc.query_cache_stats()["result"]["misses"]
+    h.batch([q])  # fallback result was NOT cached: device MISS
+    assert qc.query_cache_stats()["result"]["misses"] == before + 1
