@@ -358,6 +358,20 @@ def fire_cache() -> bool:
     )
 
 
+def fire_decode_cb() -> bool:
+    """Paged-KV continuous-batching decode vs sequential dense-scan on
+    the real chip (benchmarks/decode_bench.py: aggregate tokens/s +
+    inter-token p50/p99 at batch 1/4/8, gpt2 geometry on TPU).  Success
+    requires a platform=="tpu" consolidated record; it additionally
+    lands in chip_results.jsonl."""
+    return _fire_tpu_jsonl(
+        os.path.join(HERE, "decode_bench.py"),
+        840.0,
+        {"DECODE_BENCH_BUDGET_S": "780"},
+        bank_metric="decode_continuous_batching",
+    )
+
+
 def fire_mesh() -> bool:
     """Multi-chip serving scaling on the real mesh (serving_bench.py
     --mesh 8: single-device vs 8-way-sharded serving of the same corpus;
@@ -523,6 +537,7 @@ def main() -> int:
         "quant": False,
         "tiered": False,
         "cache": False,
+        "decode": False,
     }
     fire = {
         "bench": fire_bench,
@@ -536,6 +551,7 @@ def main() -> int:
         "quant": fire_quant,
         "tiered": fire_tiered,
         "cache": fire_cache,
+        "decode": fire_decode_cb,
     }
     last_bank = None  # monotonic() of the last banked record
     any_banked = False

@@ -1,0 +1,199 @@
+"""Continuous-batching paged decode vs sequential dense-scan decode.
+
+The serving A/B for ISSUE 14: the pre-PR decode shape is ONE request at
+a time through ``CausalLM.generate_ids`` (a private dense KV cache per
+launch, no cross-request batching) — the paged path admits the whole
+request set into one :class:`DecodeSession` and advances EVERY live
+sequence one token per launch.  Measures:
+
+* aggregate tokens/s for both paths over the same request set
+  (acceptance: paged ≥ 2x sequential at batch ≥ 4 on this box's CPU
+  reference path);
+* inter-token latency p50/p99 of the paged stream (per-token callbacks)
+  vs the dense path's effective per-token time (a client staring at a
+  sequential queue waits for every request ahead of it).
+
+Prints one JSON line per batch size and a consolidated
+``decode_continuous_batching`` record; both append to
+``benchmarks/bench_results.jsonl``.
+
+Run: ``JAX_PLATFORMS=cpu python benchmarks/decode_bench.py [geometry]``
+(geometry: "tiny" | "small" (default off-TPU) | "gpt2" (default on TPU))
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+RESULTS = os.path.join(HERE, "bench_results.jsonl")
+
+
+def _pctl(xs: list[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def run(geometry: str | None = None) -> dict:
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from pathway_tpu.generation import DecodeSession
+    from pathway_tpu.models.decoder import CausalLM, DecoderConfig
+    from pathway_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    platform = jax.devices()[0].platform
+    if geometry is None:
+        geometry = "gpt2" if platform == "tpu" else "small"
+    if geometry == "tiny":
+        cfg = DecoderConfig(
+            vocab_size=512, hidden_dim=128, num_layers=4, num_heads=4,
+            mlp_dim=512, max_len=512,
+            dtype=jnp.float32 if platform == "cpu" else jnp.bfloat16,
+        )
+    elif geometry == "small":
+        # compute-dominated on CPU: per-step matmul work outweighs the
+        # per-launch dispatch overhead, so the A/B measures the batching
+        # lever (the thing paged decode exists for), not Python overhead.
+        # max_len sized to the workload: off-TPU the functional KV pool
+        # update pays a copy per tick (donation is TPU-only), so block
+        # tables/pool are kept at the served horizon like an operator
+        # would (PATHWAY_DECODE_POOL_TOKENS)
+        cfg = DecoderConfig(
+            vocab_size=4096, hidden_dim=512, num_layers=8, num_heads=8,
+            mlp_dim=2048, max_len=128,
+            dtype=jnp.float32 if platform == "cpu" else jnp.bfloat16,
+        )
+    else:
+        cfg = DecoderConfig(
+            dtype=jnp.float32 if platform == "cpu" else jnp.bfloat16
+        )
+    lm = CausalLM(cfg=cfg)
+    rng = np.random.default_rng(0)
+    max_new = int(os.environ.get("DECODE_BENCH_MAX_NEW", "32"))
+    budget = float(os.environ.get("DECODE_BENCH_BUDGET_S", "420"))
+    deadline = time.monotonic() + budget
+    # mixed prompt lengths — the serving-shaped workload
+    lens = (12, 24, 40, 18, 32, 48, 20, 28)
+
+    def prompts_for(batch: int) -> list[list[int]]:
+        return [
+            rng.integers(1, cfg.vocab_size, size=lens[i % len(lens)]).tolist()
+            for i in range(batch)
+        ]
+
+    rows = []
+    consolidated: dict = {
+        "metric": "decode_continuous_batching",
+        "geometry": geometry,
+        "platform": platform,
+        "max_new_tokens": max_new,
+    }
+    for batch in (1, 4, 8):
+        reqs = prompts_for(batch)
+
+        # -- sequential dense-scan baseline: one request per launch --
+        for p in reqs:
+            lm.generate_ids([p], max_new_tokens=max_new)  # warm EVERY bucket
+        t0 = time.perf_counter()
+        for p in reqs:
+            lm.generate_ids([p], max_new_tokens=max_new)
+        dense_s = time.perf_counter() - t0
+        dense_tps = batch * max_new / dense_s
+
+        # -- paged continuous batching: one session, shared ticks --
+        def paged_run(measure: bool) -> tuple[float, list[float]]:
+            # pool sized to the admitted set (+25% slack): off-TPU every
+            # tick copies the pool arrays, so an oversized pool taxes the
+            # CPU A/B with memcpy the TPU path never pays
+            need = sum(
+                -(-(len(p) + max_new) // 16) for p in reqs
+            )
+            sess = DecodeSession(
+                cfg, lm.params, auto=False, use_runtime=False,
+                pool_tokens=16 * (need + max(2, need // 4)),
+                block_size=16,
+            )
+            stamps: dict[int, list[float]] = {i: [] for i in range(batch)}
+            handles = []
+            t0 = time.perf_counter()
+            for i, p in enumerate(reqs):
+                handles.append(
+                    sess.submit(
+                        p, max_new_tokens=max_new,
+                        stream_cb=(
+                            (lambda tok, i=i: stamps[i].append(
+                                time.perf_counter()
+                            )) if measure else None
+                        ),
+                    )
+                )
+            sess.drain(timeout=600)
+            elapsed = time.perf_counter() - t0
+            for h in handles:
+                assert len(h.result()) == max_new
+            sess.close()
+            gaps = []
+            for ts in stamps.values():
+                gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+            return elapsed, gaps
+
+        paged_run(measure=False)  # warm every launch shape
+        paged_s, gaps = paged_run(measure=True)
+        paged_tps = batch * max_new / paged_s
+        row = {
+            "metric": "decode_cb_point",
+            "platform": platform,
+            "geometry": geometry,
+            "batch": batch,
+            "max_new_tokens": max_new,
+            "dense_sequential_tokens_per_sec": round(dense_tps, 1),
+            "paged_cb_tokens_per_sec": round(paged_tps, 1),
+            "paged_vs_dense": round(paged_tps / dense_tps, 3),
+            "inter_token_p50_ms": round(_pctl(gaps, 0.50) * 1e3, 2),
+            "inter_token_p99_ms": round(_pctl(gaps, 0.99) * 1e3, 2),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        consolidated[f"paged_vs_dense_b{batch}"] = row["paged_vs_dense"]
+        consolidated[f"paged_tokens_per_sec_b{batch}"] = row[
+            "paged_cb_tokens_per_sec"
+        ]
+        consolidated[f"inter_token_p99_ms_b{batch}"] = row[
+            "inter_token_p99_ms"
+        ]
+        if time.monotonic() > deadline:
+            break
+    # acceptance: ≥2x aggregate tokens/s at batch ≥ 4
+    ratios = [
+        consolidated.get(f"paged_vs_dense_b{b}")
+        for b in (4, 8)
+        if consolidated.get(f"paged_vs_dense_b{b}") is not None
+    ]
+    consolidated["meets_acceptance"] = bool(ratios) and max(ratios) >= 2.0
+    consolidated["rows"] = rows
+    return consolidated
+
+
+if __name__ == "__main__":
+    out = run(sys.argv[1] if len(sys.argv) > 1 else None)
+    out["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    line = json.dumps(out)
+    print(line)
+    with open(RESULTS, "a") as f:
+        f.write(line + "\n")

@@ -1,0 +1,46 @@
+"""Paged-KV continuous-batching decode (ROADMAP item 3).
+
+Lazy package facade: importing ``pathway_tpu.generation`` stays
+stdlib-only — the jax-backed engine loads on first attribute access, and
+``/v1/health``'s ``generation`` block gates on
+``pathway_tpu.generation.engine`` being in ``sys.modules`` so a bare
+probe never pulls jax.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+__all__ = [
+    "BlockAllocator",
+    "DecodeSession",
+    "GenerationHandle",
+    "PagedDecoder",
+    "PagedKVPool",
+    "decode_kernel_mode",
+    "generation_status",
+    "iter_text_pieces",
+    "paged_decode_attention",
+    "validate_decoder_geometry",
+]
+
+_EXPORTS = {
+    "BlockAllocator": ".paged_kv",
+    "PagedKVPool": ".paged_kv",
+    "decode_kernel_mode": ".decode_kernel",
+    "paged_decode_attention": ".decode_kernel",
+    "validate_decoder_geometry": ".decode_kernel",
+    "DecodeSession": ".engine",
+    "GenerationHandle": ".engine",
+    "PagedDecoder": ".engine",
+    "generation_status": ".engine",
+    "iter_text_pieces": ".engine",
+}
+
+
+def __getattr__(name: str) -> Any:
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    return getattr(importlib.import_module(mod, __name__), name)

@@ -1,0 +1,268 @@
+"""Paged decode-step attention: one query token per sequence over its
+own KV blocks, gathered via a scalar-prefetch block table.
+
+This is the decode half of "Ragged Paged Attention" (PAPERS.md); PR 9's
+ragged prefill kernel covered the other half.  Per decode tick every
+LIVE sequence advances one token in a single launch:
+
+* grid = ``(rows, table_width)`` — program ``(r, j)`` owns sequence
+  ``r``'s ``j``-th KV block.  The physical block id rides in through the
+  scalar-prefetch block-table array (the same idiom as the prefill
+  kernel's ``ragged_bounds``), so the BlockSpec index map gathers each
+  sequence's blocks from anywhere in the pool with no host-side copy.
+* the per-row online-softmax accumulators live in VMEM scratch and
+  carry across the (sequential) block axis; blocks wholly past the
+  sequence's live length are skipped with ``@pl.when`` (a retired or
+  short row costs nothing but the descriptor).
+* masking: position ``>=`` the sequence's live length is invalid — this
+  is what makes block reuse safe: a freed block's stale tail can never
+  be attended by its new tenant.
+
+``PATHWAY_DECODE_KERNEL`` selects the implementation exactly like
+``PATHWAY_RAGGED_KERNEL``: ``auto`` (Pallas compiled on TPU, XLA gather
+reference elsewhere), ``pallas`` (force; interpret mode off-TPU — how
+tier-1 exercises the kernel body on CPU), ``reference`` (XLA
+everywhere).  The reference gathers ``pool[table]`` into the dense
+per-row layout and runs the same masked softmax the dense ``lax.scan``
+decoder uses — the bit-parity oracle path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+__all__ = [
+    "decode_kernel_mode",
+    "resolve_decode_mode",
+    "validate_decoder_geometry",
+    "paged_decode_attention",
+]
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def decode_kernel_mode() -> str:
+    """``PATHWAY_DECODE_KERNEL``: ``auto`` | ``pallas`` | ``reference``
+    (the ``PATHWAY_RAGGED_KERNEL`` idiom; garbage warns → auto)."""
+    raw = os.environ.get("PATHWAY_DECODE_KERNEL", "auto").strip().lower()
+    if raw in ("auto", "pallas", "reference"):
+        return raw
+    import warnings
+
+    warnings.warn(
+        f"PATHWAY_DECODE_KERNEL={raw!r} is not one of auto/pallas/reference"
+        " — using auto",
+        stacklevel=2,
+    )
+    return "auto"
+
+
+def resolve_decode_mode(mode: str | None = None) -> str:
+    """Resolve ``auto`` against the live backend → pallas|reference."""
+    if mode is None:
+        mode = decode_kernel_mode()
+    if mode == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "reference"
+    return mode
+
+
+def validate_decoder_geometry(head_dim: int, *, knob: str = "paged decode") -> None:
+    """Up-front geometry check for the paged decode Pallas path.  Mosaic
+    tiles the minor dimension in 128-wide lanes; a head_dim that neither
+    divides nor is a multiple of the lane tile fails deep inside
+    lowering with an opaque error — refuse here, naming the knob that
+    selects a working implementation instead."""
+    if head_dim <= 0 or (128 % head_dim != 0 and head_dim % 128 != 0):
+        raise ValueError(
+            f"{knob} requires head_dim to divide (or be a multiple of) the "
+            f"128-lane MXU tile; got head_dim={head_dim}.  Set "
+            "PATHWAY_DECODE_KERNEL=reference (XLA gather path) or use the "
+            "dense lax.scan decoder (CausalLM.generate_ids) for this "
+            "geometry."
+        )
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(
+    bt_ref,   # scalar-prefetch [R, table_width] physical block ids (SMEM)
+    len_ref,  # scalar-prefetch [R] tokens to attend per row (SMEM)
+    q_ref,    # [1, H, Dh]
+    k_ref,    # [1, 1, block_size, H, Dh] — this program's gathered block
+    v_ref,    # [1, 1, block_size, H, Dh]
+    o_ref,    # [1, H, Dh]
+    m_sc,     # VMEM [1, H] f32 running max
+    l_sc,     # VMEM [1, H] f32 running denominator
+    acc_sc,   # VMEM [H, Dh] f32 running numerator
+    *,
+    block_size: int,
+    sm_scale: float,
+):
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    n_tok = len_ref[r]
+
+    @pl.when(j * block_size < n_tok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale          # [H, Dh]
+        kb = k_ref[0, 0].astype(jnp.float32)                 # [bs, H, Dh]
+        vb = v_ref[0, 0].astype(jnp.float32)
+        s = jnp.sum(q[None, :, :] * kb, axis=-1)             # [bs, H]
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (block_size, 1), 0
+        )
+        valid = pos < n_tok                                   # [bs, 1]
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_sc[...]                                    # [1, H]
+        l_prev = l_sc[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+        # masked lanes must contribute 0 even while m_new is still
+        # _NEG_INF (exp(s - m_new) == 1 there)
+        p = jnp.exp(s - m_new) * valid.astype(jnp.float32)    # [bs, H]
+        alpha = jnp.exp(m_prev - m_new)                       # [1, H]
+        l_new = l_prev * alpha + jnp.sum(p, axis=0, keepdims=True)
+        acc_new = acc_sc[...] * alpha.reshape(-1, 1) + jnp.sum(
+            p[:, :, None] * vb, axis=0
+        )                                                     # [H, Dh]
+        m_sc[...] = m_new
+        l_sc[...] = l_new
+        acc_sc[...] = acc_new
+
+    # write the running answer every visit (the final visit wins; rows
+    # with n_tok == 0 keep l == 0 and emit exact zeros)
+    o_ref[0] = (
+        acc_sc[...] / jnp.maximum(l_sc[...].reshape(-1, 1), 1e-30)
+    ).astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k_pool, v_pool, block_tables, lengths, layer,
+                  block_size, sm_scale, interpret):
+    rows, heads, dh = q.shape
+    table_w = block_tables.shape[1]
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(rows, table_w),
+        in_specs=[
+            pl.BlockSpec((1, heads, dh), lambda r, j, bt, ln: (r, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, block_size, heads, dh),
+                lambda r, j, bt, ln: (layer, bt[r, j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_size, heads, dh),
+                lambda r, j, bt, ln: (layer, bt[r, j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, heads, dh), lambda r, j, bt, ln: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, heads), jnp.float32),
+            pltpu.VMEM((1, heads), jnp.float32),
+            pltpu.VMEM((heads, dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, block_size=block_size, sm_scale=sm_scale
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, heads, dh), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * rows * table_w * block_size * heads * dh,
+            bytes_accessed=(
+                2 * rows * table_w * block_size * heads * dh
+                * q.dtype.itemsize
+                + 2 * rows * heads * dh * q.dtype.itemsize
+            ),
+            transcendentals=rows * table_w * block_size * heads,
+        ),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+# ---------------------------------------------------------------------------
+# XLA gather reference — the dense-scan-parity oracle path
+# ---------------------------------------------------------------------------
+
+
+def _paged_reference(q, k_pool, v_pool, block_tables, lengths, layer,
+                     block_size, sm_scale):
+    rows, heads, dh = q.shape
+    table_w = block_tables.shape[1]
+    seq_cap = table_w * block_size
+    # gather this layer's blocks for every row: [R, W, bs, H, Dh] →
+    # the per-row dense layout [R, S, H, Dh] the lax.scan oracle reads
+    kc = k_pool[layer][block_tables].reshape(rows, seq_cap, heads, dh)
+    vc = v_pool[layer][block_tables].reshape(rows, seq_cap, heads, dh)
+    # the EXACT masked-softmax formulation of models/decoder.py's scan
+    # step (einsum then DIVIDE by sqrt(dh), f32 accumulate) —
+    # paged-vs-dense token parity is pinned against it
+    s = jnp.einsum(
+        "rhd,rthd->rht", q, kc, preferred_element_type=jnp.float32,
+    )
+    if sm_scale is None:
+        s = s / np.sqrt(dh)
+    else:
+        s = s * sm_scale
+    t_iota = jnp.arange(seq_cap)
+    mask = t_iota[None, :] < lengths[:, None]
+    s = jnp.where(mask[:, None, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("rht,rthd->rhd", probs, vc)
+
+
+def paged_decode_attention(
+    q,
+    k_pool,
+    v_pool,
+    block_tables,
+    lengths,
+    layer: int,
+    *,
+    block_size: int,
+    sm_scale: float | None = None,
+    mode: str,
+):
+    """One decode step of attention for every row.
+
+    ``q``: ``[rows, heads, head_dim]`` — each row's single new-token
+    query.  ``k_pool``/``v_pool``: ``[layers, num_blocks, block_size,
+    heads, head_dim]``.  ``block_tables``: ``[rows, table_width]`` int32
+    physical block ids (rows pad with 0 — masked structurally).
+    ``lengths``: tokens to attend per row, INCLUSIVE of the token just
+    written (0 ⇒ inactive row, output is zeros).  ``mode`` must already
+    be resolved (:func:`resolve_decode_mode`).  ``sm_scale=None`` means
+    "divide scores by sqrt(head_dim)" — bit-identical to the dense
+    ``lax.scan`` decoder's formulation on the reference path.
+    """
+    if mode == "reference":
+        return _paged_reference(
+            q, k_pool, v_pool, block_tables, lengths, layer, block_size,
+            None if sm_scale is None else float(sm_scale),
+        )
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    interpret = jax.default_backend() != "tpu"
+    return _paged_pallas(
+        q, k_pool, v_pool, block_tables, lengths, layer, block_size,
+        float(sm_scale), interpret,
+    )

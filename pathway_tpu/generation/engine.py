@@ -1,0 +1,1048 @@
+"""Continuous-batching paged-KV decode: the generation workload.
+
+The dense path (models/decoder.py) decodes one request batch at a time
+over a preallocated contiguous KV cache — no cross-request batching, and
+a running batch cannot admit a newcomer or retire a finished row.  This
+module is the serving-shaped alternative (ROADMAP item 3):
+
+* :class:`PagedDecoder` — the functional model ops.  Prefill rides
+  PR 9's ragged packed attention (``causal=True``) so ONE launch covers
+  mixed prompt lengths, writing K/V straight into paged pool blocks;
+  each decode step advances ALL live sequences one token in a single
+  launch at a pow2 row bucket (compile set flat by construction), with
+  the paged-attention gather in ``decode_kernel.py``.
+* :class:`DecodeSession` — the continuous-batching table: admit/retire
+  per tick, free-list block accounting (token-budget admission →
+  :class:`AdmissionRefused`), deadline shedding of queued requests,
+  per-token streaming callbacks, and ``extend()`` — a finished-but-
+  retained sequence continues from its LIVE KV blocks (the adaptive-RAG
+  re-ask path: escalation context rides the decode steps instead of
+  re-prefilling the whole prompt).
+* Scheduling: each tick is ONE ``GENERATE``-class work item on the
+  shared :class:`DeviceTickRuntime` — decode interleaves with
+  ``INTERACTIVE`` retrieval at tick granularity on one device, below
+  rerank and above bulk ingest.
+
+Numerics contract: prefill/step reuse the dense decoder's ``_ln`` /
+``_logits_of`` / masked-softmax formulations verbatim, so greedy decode
+is token-for-token identical to the ``lax.scan`` dense-KV oracle
+(pinned in tests/test_paged_decode.py, incl. mid-stream admit/retire
+and block reuse after free).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..internals.config import env_int as _env_int
+from ..models.decoder import DecoderConfig, _ln, _logits_of
+from ..ops.ragged_attention import (
+    MAX_PACKED_TOKENS,
+    ragged_attention,
+    ragged_block,
+    ragged_bounds,
+)
+from .decode_kernel import (
+    decode_kernel_mode,
+    paged_decode_attention,
+    resolve_decode_mode,
+    validate_decoder_geometry,
+)
+from .paged_kv import PagedKVPool
+
+__all__ = [
+    "PagedDecoder",
+    "DecodeSession",
+    "GenerationHandle",
+    "generation_status",
+]
+
+
+# ---------------------------------------------------------------------------
+# functional model ops (module-level jits: one compile set per process)
+# ---------------------------------------------------------------------------
+
+#: packed-prefill token buckets: small sub-blocks so a 1-row admit does
+#: not pad to a full 128-token block, then 128-steps (the kernel block)
+_PREFILL_TOKEN_BUCKETS: tuple[int, ...] = (32, 64) + tuple(
+    range(128, MAX_PACKED_TOKENS + 1, 128)
+)
+#: dense_s grid for the XLA reference's per-row unpack
+_DENSE_BUCKETS: tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
+
+
+def _bucket_of(n: int, grid: Sequence[int]) -> int:
+    for b in grid:
+        if b >= n:
+            return b
+    return grid[-1]
+
+
+def _pow2_bucket(n: int) -> int:
+    return 1 if n <= 1 else 1 << (int(n) - 1).bit_length()
+
+
+def _pick_token(logits, seed, count, temperature):
+    """One row's next token — greedy argmax at temperature<=0, else a
+    seeded categorical draw keyed on (seq seed, step count) so sampling
+    is deterministic regardless of batch composition."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), count)
+    sampled = jax.random.categorical(
+        key, logits / jnp.maximum(temperature, 1e-6)
+    ).astype(jnp.int32)
+    return jnp.where(
+        temperature <= 0.0, jnp.argmax(logits).astype(jnp.int32), sampled
+    )
+
+
+@jax.jit
+def _sample_rows(logits, seeds, counts, temps):
+    return jax.vmap(_pick_token)(logits, seeds, counts, temps)
+
+
+def _paged_prefill_impl(
+    params, k_pool, v_pool, ids, pos, seg, starts, bounds, dest_block,
+    dest_slot, last_idx, *, cfg: DecoderConfig, num_rows: int, dense_s: int,
+    mode: str,
+):
+    """Packed ragged prefill over admitted prompts: ONE launch for mixed
+    lengths, K/V scattered straight into the paged pools (pad tokens
+    carry an out-of-range dest block → ``mode="drop"``)."""
+    T = ids.shape[0]
+    D = cfg.hidden_dim
+    H = cfg.num_heads
+    Dh = D // H
+    x = (
+        params["wte"]["embedding"][ids]
+        + params["wpe"]["embedding"][jnp.minimum(pos, cfg.max_len - 1)]
+    ).astype(cfg.dtype)
+    for li in range(cfg.num_layers):
+        p = params[f"h_{li}"]
+        h = _ln(x, p["ln_1"], cfg.ln_eps).astype(cfg.dtype)
+        qkv = h @ p["c_attn"]["kernel"] + p["c_attn"]["bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(T, H, Dh)
+        k = k.reshape(T, H, Dh)
+        v = v.reshape(T, H, Dh)
+        k_pool = k_pool.at[li, dest_block, dest_slot].set(
+            k.astype(k_pool.dtype), mode="drop"
+        )
+        v_pool = v_pool.at[li, dest_block, dest_slot].set(
+            v.astype(v_pool.dtype), mode="drop"
+        )
+        ctx = ragged_attention(
+            q, k, v, seg,
+            pos=pos, starts=starts, bounds=bounds,
+            num_rows=num_rows, dense_s=dense_s,
+            causal=True, mode=mode,
+        )
+        x = x + ctx.reshape(T, D) @ p["attn_proj"]["kernel"] + p["attn_proj"]["bias"]
+        h2 = _ln(x, p["ln_2"], cfg.ln_eps).astype(cfg.dtype)
+        m = jax.nn.gelu(
+            h2 @ p["c_fc"]["kernel"] + p["c_fc"]["bias"], approximate=True
+        )
+        x = x + m @ p["mlp_proj"]["kernel"] + p["mlp_proj"]["bias"]
+    x = _ln(x, params["ln_f"], cfg.ln_eps)
+    last = x[last_idx]  # [num_rows, D] — each row's final real token
+    return k_pool, v_pool, _logits_of(last, params)
+
+
+def _paged_step_impl(
+    params, k_pool, v_pool, bt, lengths, toks, active, seeds, counts, temps,
+    *, cfg: DecoderConfig, block_size: int, mode: str,
+):
+    """One decode tick: every live row consumes its input token (written
+    into its current KV block) and emits the next one — a single launch
+    at the pow2 row bucket."""
+    R = toks.shape[0]
+    D = cfg.hidden_dim
+    H = cfg.num_heads
+    Dh = D // H
+    NB = k_pool.shape[1]
+    pos = lengths  # the incoming token's write position
+    x = (
+        params["wte"]["embedding"][toks]
+        + params["wpe"]["embedding"][jnp.minimum(pos, cfg.max_len - 1)]
+    ).astype(cfg.dtype)
+    blk = pos // block_size
+    slot = pos % block_size
+    bidx = jnp.take_along_axis(bt, blk[:, None], axis=1)[:, 0]
+    bidx = jnp.where(active, bidx, NB)  # dead rows: dropped write
+    att_len = jnp.where(active, lengths + 1, 0)
+    for li in range(cfg.num_layers):
+        p = params[f"h_{li}"]
+        h = _ln(x, p["ln_1"], cfg.ln_eps).astype(cfg.dtype)
+        qkv = h @ p["c_attn"]["kernel"] + p["c_attn"]["bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(R, H, Dh)
+        k_pool = k_pool.at[li, bidx, slot].set(
+            k.reshape(R, H, Dh).astype(k_pool.dtype), mode="drop"
+        )
+        v_pool = v_pool.at[li, bidx, slot].set(
+            v.reshape(R, H, Dh).astype(v_pool.dtype), mode="drop"
+        )
+        ctx = paged_decode_attention(
+            q, k_pool, v_pool, bt, att_len, li,
+            block_size=block_size, mode=mode,
+        )
+        x = x + ctx.reshape(R, D) @ p["attn_proj"]["kernel"] + p["attn_proj"]["bias"]
+        h2 = _ln(x, p["ln_2"], cfg.ln_eps).astype(cfg.dtype)
+        m = jax.nn.gelu(
+            h2 @ p["c_fc"]["kernel"] + p["c_fc"]["bias"], approximate=True
+        )
+        x = x + m @ p["mlp_proj"]["kernel"] + p["mlp_proj"]["bias"]
+    x = _ln(x, params["ln_f"], cfg.ln_eps)
+    logits = _logits_of(x, params)  # [R, V]
+    toks_next = jax.vmap(_pick_token)(logits, seeds, counts, temps)
+    return k_pool, v_pool, toks_next
+
+
+_JIT_LOCK = threading.Lock()
+_PREFILL_JIT: Any = None
+_STEP_JIT: Any = None
+
+
+def _donate() -> tuple[int, ...]:
+    # donation is a no-op (with a warning per call) on CPU — only donate
+    # where the backend honors it, so a CPU tick does not warn-spam
+    return (1, 2) if jax.default_backend() == "tpu" else ()
+
+
+def _prefill_jit():
+    global _PREFILL_JIT
+    with _JIT_LOCK:
+        if _PREFILL_JIT is None:
+            from ..internals.flight_recorder import instrument_jit
+
+            fn = jax.jit(
+                _paged_prefill_impl,
+                static_argnames=("cfg", "num_rows", "dense_s", "mode"),
+                donate_argnums=_donate(),
+            )
+            _PREFILL_JIT = instrument_jit(fn, "decoder.paged_prefill")
+        return _PREFILL_JIT
+
+
+def _step_jit():
+    global _STEP_JIT
+    with _JIT_LOCK:
+        if _STEP_JIT is None:
+            from ..internals.flight_recorder import instrument_jit
+
+            fn = jax.jit(
+                _paged_step_impl,
+                static_argnames=("cfg", "block_size", "mode"),
+                donate_argnums=_donate(),
+            )
+            _STEP_JIT = instrument_jit(fn, "decoder.paged_step")
+        return _STEP_JIT
+
+
+# ---------------------------------------------------------------------------
+# process-wide observability (metrics provider + health block)
+# ---------------------------------------------------------------------------
+
+_MX = threading.Lock()
+_COUNTERS = {
+    "tokens_generated_total": 0,
+    "prefill_tokens_total": 0,
+    "shed_total": 0,
+    "retired_total": 0,
+}
+_SESSIONS: "weakref.WeakSet[DecodeSession]" = weakref.WeakSet()
+
+
+def _bump(name: str, n: int = 1) -> None:
+    with _MX:
+        _COUNTERS[name] += n
+
+
+class _GenerationMetricsProvider:
+    """``pathway_decode_*`` series for /status; also the ``generation``
+    block on ``/v1/health`` (internals/health.py gates on this module
+    being imported, so a bare probe never pulls jax)."""
+
+    def stats(self) -> dict[str, Any]:
+        return generation_status()
+
+    def openmetrics_lines(self) -> list[str]:
+        s = generation_status()
+        with _MX:
+            counters = dict(_COUNTERS)
+        lines = [
+            "# TYPE pathway_decode_live_sequences gauge",
+            f"pathway_decode_live_sequences {s.get('live_sequences', 0)}",
+            "# TYPE pathway_decode_kv_blocks gauge",
+            f'pathway_decode_kv_blocks{{state="used"}} '
+            f"{s.get('kv_blocks_used', 0)}",
+            f'pathway_decode_kv_blocks{{state="free"}} '
+            f"{s.get('kv_blocks_free', 0)}",
+            "# TYPE pathway_decode_tokens_total counter",
+            f"pathway_decode_tokens_total {counters['tokens_generated_total']}",
+            "# TYPE pathway_decode_prefill_tokens_total counter",
+            f"pathway_decode_prefill_tokens_total "
+            f"{counters['prefill_tokens_total']}",
+            "# TYPE pathway_decode_shed_total counter",
+            f"pathway_decode_shed_total {counters['shed_total']}",
+            "# TYPE pathway_decode_retired_total counter",
+            f"pathway_decode_retired_total {counters['retired_total']}",
+        ]
+        return lines
+
+
+#: strong module-level ref — monitoring's provider table is weak-valued
+_PROVIDER = _GenerationMetricsProvider()
+
+
+def generation_status() -> dict[str, Any]:
+    """Aggregate snapshot over every live session (health/status)."""
+    sessions = list(_SESSIONS)
+    with _MX:
+        counters = dict(_COUNTERS)
+    status: dict[str, Any] = {
+        "sessions": len(sessions),
+        "kernel_mode": decode_kernel_mode(),
+        **counters,
+    }
+    live = pending = used = free = 0
+    block_size = None
+    for s in sessions:
+        st = s.stats()
+        live += st["live_sequences"]
+        pending += st["pending"]
+        used += st["kv_blocks_used"]
+        free += st["kv_blocks_free"]
+        block_size = st["block_size"]
+    status.update(
+        live_sequences=live,
+        pending=pending,
+        kv_blocks_used=used,
+        kv_blocks_free=free,
+    )
+    if block_size is not None:
+        status["block_size"] = block_size
+    return status
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching session
+# ---------------------------------------------------------------------------
+
+
+class _Seq:
+    __slots__ = (
+        "ids", "max_new", "eos_id", "temperature", "seed", "blocks",
+        "length", "next_input", "generated", "count", "handle",
+        "deadline_at", "retain", "forced", "submitted_at",
+    )
+
+    def __init__(self, ids, max_new, eos_id, temperature, seed,
+                 deadline_at, retain):
+        self.ids = list(ids)
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.blocks: list[int] = []
+        self.length = 0          # tokens resident in KV
+        self.next_input = None   # last sampled (or forced) token, not yet consumed
+        self.generated: list[int] = []
+        self.count = 0           # sampling counter (rng fold key)
+        self.handle: GenerationHandle | None = None
+        self.deadline_at = deadline_at
+        self.retain = bool(retain)
+        self.forced: deque[int] = deque()
+        self.submitted_at = time.monotonic()
+
+
+class GenerationHandle:
+    """Client-facing handle: blocking result, or per-token streaming."""
+
+    _DONE = object()
+
+    def __init__(self, session: "DecodeSession"):
+        self._session = session
+        self._q: "queue.Queue[Any]" = queue.Queue()
+        self._done = threading.Event()
+        self._tokens: list[int] = []
+        self.error: BaseException | None = None
+
+    def _on_token(self, tok: int) -> None:
+        self._tokens.append(tok)
+        self._q.put(tok)
+
+    def _finish(self, error: BaseException | None = None) -> None:
+        self.error = error
+        self._done.set()
+        self._q.put(self._DONE)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def tokens(self) -> list[int]:
+        return list(self._tokens)
+
+    def stream(self) -> Iterator[int]:
+        """Yield generated token ids as they land (ends when the
+        sequence retires; raises the sequence's error, if any)."""
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                break
+            yield item
+        if self.error is not None:
+            raise self.error
+
+    def result(self, timeout: float | None = 30.0) -> list[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.error is not None:
+            raise self.error
+        return list(self._tokens)
+
+
+def iter_text_pieces(
+    handle: GenerationHandle,
+    decode_tokens: Callable[[list[int]], str],
+    eos_id: int | None,
+) -> Iterator[str]:
+    """Incrementally detokenize a handle's token stream: yields the text
+    DELTA each token adds (re-decoding the whole prefix every step, so
+    multi-token graphemes resolve correctly); ``eos_id`` terminates the
+    stream and never contributes text.  The full decoded text is exactly
+    the concatenation of the yielded pieces — one implementation shared
+    by every streaming surface (``CausalLM.generate_stream`` and both QA
+    ``_stream_rounds``)."""
+    toks: list[int] = []
+    emitted = ""
+    for tok in handle.stream():
+        if eos_id is not None and tok == eos_id:
+            break
+        toks.append(tok)
+        full = decode_tokens(toks)
+        piece, emitted = full[len(emitted):], full
+        if piece:
+            yield piece
+
+
+class DecodeSession:
+    """Continuous-batching table over one :class:`PagedKVPool`.
+
+    ``auto=True`` (default) runs a pump thread that drives one tick per
+    loop — through the shared :class:`DeviceTickRuntime` as a
+    ``GENERATE``-class item when the runtime is enabled, else directly.
+    ``auto=False`` is the test/bench mode: the caller steps with
+    :meth:`tick` / :meth:`drain`.
+    """
+
+    def __init__(
+        self,
+        cfg: DecoderConfig,
+        params: Any,
+        *,
+        tokenizer: Any = None,
+        block_size: int | None = None,
+        pool_tokens: int | None = None,
+        mode: str | None = None,
+        max_live: int | None = None,
+        max_pending: int | None = None,
+        use_runtime: bool | None = None,
+        auto: bool = True,
+        name: str = "decode",
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.mode = resolve_decode_mode(mode)
+        head_dim = cfg.hidden_dim // cfg.num_heads
+        if self.mode == "pallas":
+            validate_decoder_geometry(
+                head_dim, knob="PATHWAY_DECODE_KERNEL=pallas (paged decode)"
+            )
+        self.pool = PagedKVPool(
+            cfg, block_size=block_size, pool_tokens=pool_tokens
+        )
+        self.max_live = (
+            _env_int("PATHWAY_DECODE_MAX_LIVE", 64)
+            if max_live is None else int(max_live)
+        )
+        self.max_pending = (
+            _env_int("PATHWAY_DECODE_PENDING", 256)
+            if max_pending is None else int(max_pending)
+        )
+        self.name = name
+        self._auto = bool(auto)
+        self._use_runtime = use_runtime
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._pending: deque[_Seq] = deque()
+        self._live: list[_Seq] = []
+        self._retained: dict[int, _Seq] = {}
+        self._closed = False
+        self._pump: threading.Thread | None = None
+        self._group = None
+        self.ticks_total = 0
+        from ..internals.monitoring import register_metrics_provider
+
+        _SESSIONS.add(self)
+        register_metrics_provider("generation", _PROVIDER, replace=False)
+
+    # -- submission ------------------------------------------------------
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        max_new_tokens: int = 32,
+        *,
+        temperature: float = 0.0,
+        seed: int = 0,
+        eos_id: int | None = None,
+        deadline_s: float | None = None,
+        stream_cb: Callable[[int], None] | None = None,
+        retain: bool = False,
+    ) -> GenerationHandle:
+        """Queue one sequence; admission happens at the next tick once
+        the free list covers its worst case.  Raises
+        :class:`AdmissionRefused` immediately when the request can NEVER
+        fit the pool, or when the pending queue is at its depth target
+        (backpressure, not collapse — HTTP planes map it to
+        503 + Retry-After)."""
+        from ..runtime import AdmissionRefused
+
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if int(max_new_tokens) > self.cfg.max_len:
+            # past max_len the per-sequence block table (blocks_per_seq =
+            # ceil(max_len/block_size) entries) can NEVER hold the
+            # sequence — admitted, it would overflow the decode tick's
+            # block-table row and _fail_all every in-flight sequence
+            raise AdmissionRefused(
+                f"max_new_tokens={max_new_tokens} exceeds the model's "
+                f"max_len={self.cfg.max_len}; lower max_new_tokens",
+                retry_after_s=0.0,
+            )
+        if eos_id is None and self.tokenizer is not None:
+            eos_id = getattr(self.tokenizer, "eos_token_id", None)
+            if eos_id is None:
+                # HF wrapper nests the real tokenizer at .tok (the same
+                # two-level lookup CausalLM.eos_id performs)
+                eos_id = getattr(
+                    getattr(self.tokenizer, "tok", None),
+                    "eos_token_id", None,
+                )
+        # over-long prompts keep their TAIL, like the dense path
+        cap = max(1, self.cfg.max_len - int(max_new_tokens))
+        prompt_ids = list(prompt_ids)[-cap:]
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if len(prompt_ids) > MAX_PACKED_TOKENS:
+            # a prompt the packed prefill cannot hold must be refused
+            # HERE — admitted, it would blow up inside tick() and
+            # _fail_all every in-flight sequence with it
+            raise AdmissionRefused(
+                f"prompt of {len(prompt_ids)} tokens exceeds the packed "
+                f"prefill launch cap ({MAX_PACKED_TOKENS}); use the dense "
+                "decoder (CausalLM.generate_ids) for this geometry",
+                retry_after_s=0.0,
+            )
+        need = self.pool.blocks_for(len(prompt_ids) + max_new_tokens - 1)
+        if need > self.pool.num_blocks:
+            raise AdmissionRefused(
+                f"request needs {need} KV blocks but the pool holds "
+                f"{self.pool.num_blocks} (PATHWAY_DECODE_POOL_TOKENS)",
+                retry_after_s=0.0,
+            )
+        seq = _Seq(
+            prompt_ids, max_new_tokens, eos_id, temperature, seed,
+            None if deadline_s is None
+            else time.monotonic() + float(deadline_s),
+            retain,
+        )
+        handle = GenerationHandle(self)
+        if stream_cb is not None:
+            orig = handle._on_token
+
+            def _tee(tok: int, _orig=orig, _cb=stream_cb) -> None:
+                _orig(tok)
+                _cb(tok)
+
+            handle._on_token = _tee  # type: ignore[method-assign]
+        seq.handle = handle
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("DecodeSession is closed")
+            if len(self._pending) >= self.max_pending:
+                _bump("shed_total")
+                raise AdmissionRefused(
+                    f"decode pending queue full ({self.max_pending})",
+                    retry_after_s=1.0,
+                )
+            self._pending.append(seq)
+            if self._auto:
+                self._ensure_pump_locked()
+            self._work.notify_all()
+        return handle
+
+    def extend(
+        self,
+        handle: GenerationHandle,
+        extra_ids: Sequence[int],
+        max_new_tokens: int = 32,
+    ) -> GenerationHandle:
+        """Continue a RETAINED finished sequence from its live KV blocks:
+        the extra tokens (an adaptive-RAG escalation, a follow-up turn)
+        ride the decode steps — the original prompt is never
+        re-prefilled.  Returns a fresh handle for the continuation."""
+        from ..runtime import AdmissionRefused
+
+        extra_ids = list(extra_ids)
+        with self._lock:
+            seq = self._retained.pop(id(handle), None)
+            if seq is None:
+                raise ValueError(
+                    "extend() needs a finished handle submitted with "
+                    "retain=True (and not yet released)"
+                )
+            total = seq.length + 1 + len(extra_ids) + max_new_tokens - 1
+            if total > self.cfg.max_len:
+                self._retained[id(handle)] = seq
+                raise ValueError(
+                    f"extension would exceed max_len={self.cfg.max_len}"
+                )
+            need = self.pool.blocks_for(total) - len(seq.blocks)
+            if need > 0:
+                t0 = time.monotonic()
+                more = self.pool.allocator.alloc(need)
+                self._record_span(
+                    "kv:alloc", t0,
+                    {"blocks": need, "ok": more is not None},
+                )
+                if more is None:
+                    self._retained[id(handle)] = seq
+                    raise AdmissionRefused(
+                        f"KV pool cannot grow the sequence by {need} blocks",
+                        retry_after_s=1.0,
+                    )
+                seq.blocks.extend(more)
+            new_handle = GenerationHandle(self)
+            seq.handle = new_handle
+            seq.max_new = int(max_new_tokens)
+            seq.generated = []
+            seq.forced = deque(extra_ids)
+            seq.count += 1  # fresh sampling stream for the continuation
+            self._live.append(seq)
+            self._work.notify_all()
+        return new_handle
+
+    def release(self, handle: GenerationHandle) -> None:
+        """Free a retained sequence's blocks."""
+        with self._lock:
+            seq = self._retained.pop(id(handle), None)
+            if seq is not None and seq.blocks:
+                self.pool.allocator.free(seq.blocks)
+                seq.blocks = []
+            self._work.notify_all()  # freed blocks may unblock admission
+
+    def cancel(self, handle: GenerationHandle) -> None:
+        """Stop and forget a sequence in ANY state (queued, live,
+        retained or finished) and free its blocks — the abandoned-stream
+        path: a client that disconnects mid-round must not park a
+        retain=True sequence in the retained table forever."""
+        with self._lock:
+            seq = self._retained.pop(id(handle), None)
+            if seq is None:
+                for s in self._live:
+                    if s.handle is handle:
+                        seq = s
+                        self._live.remove(s)
+                        break
+            if seq is None:
+                for s in self._pending:
+                    if s.handle is handle:
+                        seq = s
+                        self._pending.remove(s)
+                        break
+            if seq is None:
+                return
+            seq.retain = False
+            if seq.blocks:
+                self.pool.allocator.free(seq.blocks)
+                seq.blocks = []
+            if seq.handle is not None and not seq.handle.done:
+                seq.handle._finish()
+            self._work.notify_all()
+
+    # -- tick engine -----------------------------------------------------
+    def _record_span(self, name: str, t0: float, attrs: dict) -> None:
+        from ..internals.flight_recorder import record_span
+
+        record_span(
+            name, "generate", time.time(),
+            (time.monotonic() - t0) * 1000.0, attrs=attrs,
+        )
+
+    def _has_work_locked(self) -> bool:
+        return bool(self._pending) or bool(self._live)
+
+    def tick(self) -> bool:
+        """One tick: shed expired, admit+prefill what fits, advance every
+        live row one token.  Returns whether anything progressed."""
+        with self._lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> bool:
+        self.ticks_total += 1
+        progressed = self._admit_and_prefill_locked()
+        if self._live:
+            self._decode_step_locked()
+            progressed = True
+        return progressed
+
+    def _admit_and_prefill_locked(self) -> bool:
+        from ..runtime import DeadlineExceeded
+
+        now = time.monotonic()
+        # deadline shedding: queued work whose budget passed never runs
+        kept: deque[_Seq] = deque()
+        for seq in self._pending:
+            if seq.deadline_at is not None and now > seq.deadline_at:
+                _bump("shed_total")
+                seq.handle._finish(
+                    DeadlineExceeded(
+                        "decode request shed: deadline passed while queued",
+                        retry_after_s=1.0,
+                    )
+                )
+            else:
+                kept.append(seq)
+        self._pending = kept
+        admitted: list[_Seq] = []
+        while self._pending and len(self._live) + len(admitted) < self.max_live:
+            seq = self._pending[0]
+            need = self.pool.blocks_for(len(seq.ids) + seq.max_new - 1)
+            t0 = time.monotonic()
+            blocks = self.pool.allocator.alloc(need)
+            self._record_span(
+                "kv:alloc", t0, {"blocks": need, "ok": blocks is not None}
+            )
+            if blocks is None:
+                break  # pool full: stays queued until retirements free blocks
+            seq.blocks = blocks
+            self._pending.popleft()
+            admitted.append(seq)
+        if not admitted:
+            return False
+        # pack admitted prompts into bounded ragged launches
+        start = 0
+        try:
+            while start < len(admitted):
+                batch: list[_Seq] = []
+                total = 0
+                while start < len(admitted):
+                    ln = len(admitted[start].ids)
+                    if batch and total + ln > MAX_PACKED_TOKENS:
+                        break
+                    batch.append(admitted[start])
+                    total += ln
+                    start += 1
+                self._prefill_batch_locked(batch)
+        except BaseException as exc:
+            # a failed prefill launch must not orphan the admitted batch:
+            # these sequences are in neither _live nor _pending, so the
+            # pump's _fail_all would miss them — their blocks would leak
+            # (the pool permanently shrinks) and their handles' waiters
+            # would block forever.  Free + fail them here, then re-raise
+            # so the pump fails the rest consistently.
+            for seq in admitted:
+                if seq.handle is not None and seq.handle.done:
+                    continue  # retired during its batch (e.g. instant EOS)
+                if any(s is seq for s in self._live):
+                    continue  # made it live: _fail_all covers it
+                if seq.blocks:
+                    self.pool.allocator.free(seq.blocks)
+                    seq.blocks = []
+                if seq.handle is not None:
+                    seq.handle._finish(exc)
+            raise
+        return True
+
+    def _prefill_batch_locked(self, batch: list[_Seq]) -> None:
+        bs = self.pool.block_size
+        NB = self.pool.num_blocks
+        lens = [len(s.ids) for s in batch]
+        t_real = sum(lens)
+        T = _bucket_of(t_real, _PREFILL_TOKEN_BUCKETS)
+        R = _pow2_bucket(len(batch))
+        dense_s = _bucket_of(max(lens), _DENSE_BUCKETS)
+        if dense_s < max(lens):
+            # reference-mode unpack must hold the longest row: past the
+            # grid, fall back to the next pow2 (never clip silently)
+            dense_s = 1 << (max(lens) - 1).bit_length()
+        ids = np.zeros(T, np.int32)
+        pos = np.zeros(T, np.int32)
+        seg = np.full(T, R, np.int32)
+        dest_block = np.full(T, NB, np.int32)  # pads: dropped write
+        dest_slot = np.zeros(T, np.int32)
+        starts = np.zeros(R, np.int32)
+        last_idx = np.zeros(R, np.int32)
+        cu = np.zeros(len(batch) + 1, np.int64)
+        off = 0
+        for j, seq in enumerate(batch):
+            ln = lens[j]
+            ids[off : off + ln] = seq.ids
+            p = np.arange(ln, dtype=np.int32)
+            pos[off : off + ln] = p
+            seg[off : off + ln] = j
+            blocks = np.asarray(seq.blocks, np.int32)
+            dest_block[off : off + ln] = blocks[p // bs]
+            dest_slot[off : off + ln] = p % bs
+            starts[j] = off
+            last_idx[j] = off + ln - 1
+            off += ln
+            cu[j + 1] = off
+        bounds = ragged_bounds(cu, T, ragged_block(T))
+        t0 = time.monotonic()
+        k_pool, v_pool, logits = _prefill_jit()(
+            self.params, self.pool.k_pool, self.pool.v_pool,
+            jnp.asarray(ids), jnp.asarray(pos), jnp.asarray(seg),
+            jnp.asarray(starts), jnp.asarray(bounds),
+            jnp.asarray(dest_block), jnp.asarray(dest_slot),
+            jnp.asarray(last_idx),
+            cfg=self.cfg, num_rows=R, dense_s=dense_s, mode=self.mode,
+        )
+        self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
+        seeds = np.zeros(R, np.int32)
+        counts = np.zeros(R, np.int32)
+        temps = np.zeros(R, np.float32)
+        for j, seq in enumerate(batch):
+            seeds[j] = seq.seed
+            temps[j] = seq.temperature
+        first = np.asarray(
+            _sample_rows(
+                logits, jnp.asarray(seeds), jnp.asarray(counts),
+                jnp.asarray(temps),
+            )
+        )
+        self._record_span(
+            "prefill", t0,
+            {"rows": len(batch), "tokens": t_real, "bucket": T},
+        )
+        _bump("prefill_tokens_total", t_real)
+        for j, seq in enumerate(batch):
+            seq.length = lens[j]
+            seq.count = 1
+            tok = int(first[j])
+            self._consume_token_locked(seq, tok)
+            if seq.handle is not None and not seq.handle.done:
+                self._live.append(seq)
+
+    def _consume_token_locked(self, seq: _Seq, tok: int) -> None:
+        """Route one sampled token: discarded while forced (extension)
+        input remains, else appended/streamed; retires on EOS/max_new."""
+        if seq.forced:
+            seq.next_input = seq.forced.popleft()
+            return
+        seq.generated.append(tok)
+        seq.next_input = tok
+        _bump("tokens_generated_total")
+        seq.handle._on_token(tok)
+        if len(seq.generated) >= seq.max_new or (
+            seq.eos_id is not None and tok == seq.eos_id
+        ):
+            self._retire_locked(seq)
+
+    def _retire_locked(self, seq: _Seq) -> None:
+        _bump("retired_total")
+        if seq in self._live:
+            self._live.remove(seq)
+        if seq.retain:
+            self._retained[id(seq.handle)] = seq
+        elif seq.blocks:
+            self.pool.allocator.free(seq.blocks)
+            seq.blocks = []
+        seq.handle._finish()
+
+    def _decode_step_locked(self) -> None:
+        rows = list(self._live)
+        R = _pow2_bucket(len(rows))
+        W = self.pool.blocks_per_seq
+        bt = np.zeros((R, W), np.int32)
+        lengths = np.zeros(R, np.int32)
+        toks = np.zeros(R, np.int32)
+        active = np.zeros(R, bool)
+        seeds = np.zeros(R, np.int32)
+        counts = np.zeros(R, np.int32)
+        temps = np.zeros(R, np.float32)
+        for r, seq in enumerate(rows):
+            blocks = seq.blocks
+            bt[r, : len(blocks)] = blocks
+            lengths[r] = seq.length
+            toks[r] = seq.next_input
+            active[r] = True
+            seeds[r] = seq.seed
+            counts[r] = seq.count
+            temps[r] = seq.temperature
+        t0 = time.monotonic()
+        k_pool, v_pool, toks_next = _step_jit()(
+            self.params, self.pool.k_pool, self.pool.v_pool,
+            jnp.asarray(bt), jnp.asarray(lengths), jnp.asarray(toks),
+            jnp.asarray(active), jnp.asarray(seeds), jnp.asarray(counts),
+            jnp.asarray(temps),
+            cfg=self.cfg, block_size=self.pool.block_size, mode=self.mode,
+        )
+        self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
+        out = np.asarray(toks_next)  # host read = device sync (handler contract)
+        self._record_span(
+            "decode:step", t0, {"rows": len(rows), "bucket": R}
+        )
+        for r, seq in enumerate(rows):
+            seq.length += 1
+            seq.count += 1
+            self._consume_token_locked(seq, int(out[r]))
+
+    # -- pump / runtime integration -------------------------------------
+    def _ensure_pump_locked(self) -> None:
+        if self._pump is None or not self._pump.is_alive():
+            self._pump = threading.Thread(
+                target=self._pump_loop, daemon=True,
+                name=f"pw-{self.name}-pump",
+            )
+            self._pump.start()
+
+    def _runtime(self):
+        from ..runtime import get_runtime, runtime_enabled
+
+        use = (
+            runtime_enabled() if self._use_runtime is None
+            else self._use_runtime
+        )
+        return get_runtime() if use else None
+
+    def _pump_loop(self) -> None:
+        from ..runtime import QoS, WorkGroup
+
+        if self._group is None:
+            self._group = WorkGroup(
+                f"{self.name}:tick",
+                lambda payloads: [self.tick() for _ in payloads],
+                max_batch=1,
+            )
+        while True:
+            with self._lock:
+                while not self._closed and not self._has_work_locked():
+                    self._work.wait()
+                if self._closed:
+                    return
+                live = len(self._live)
+            rt = self._runtime()
+            try:
+                if rt is not None:
+                    # ONE decode step per GENERATE item: INTERACTIVE
+                    # retrieval preempts between steps, never mid-step
+                    progressed = rt.submit(
+                        self._group, None, qos=QoS.GENERATE,
+                        tokens=max(1, live), coalesce_s=0.0,
+                    ).result()
+                else:
+                    progressed = self.tick()
+            except BaseException as exc:  # noqa: BLE001 — fail waiters, keep pumping
+                self._fail_all(exc)
+                continue
+            if not progressed:
+                # pending work that cannot be admitted yet (pool held by
+                # retained sequences): poll at a bounded rate — deadline
+                # shedding still needs periodic ticks — instead of
+                # busy-spinning no-op ticks at 100% CPU; release/cancel/
+                # submit notify the condition to wake us early
+                with self._lock:
+                    if not self._closed:
+                        self._work.wait(timeout=0.05)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            seqs = list(self._live) + list(self._pending)
+            self._live.clear()
+            self._pending.clear()
+            for seq in seqs:
+                if seq.blocks:
+                    self.pool.allocator.free(seq.blocks)
+                    seq.blocks = []
+                if seq.handle is not None and not seq.handle.done:
+                    seq.handle._finish(exc)
+        from ..internals.errors import register_error
+
+        register_error(
+            f"decode tick failed: {type(exc).__name__}: {exc}",
+            kind="serving",
+            operator=self.name,
+        )
+
+    def drain(self, timeout: float | None = 60.0) -> None:
+        """Manual mode: run ticks inline until idle."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if not self._has_work_locked():
+                    return
+            self.tick()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("decode session did not drain in time")
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._work.notify_all()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def live_count(self) -> int:
+        return len(self._live)
+
+    def stats(self) -> dict[str, Any]:
+        alloc = self.pool.allocator
+        return {
+            "live_sequences": len(self._live),
+            "pending": len(self._pending),
+            "retained": len(self._retained),
+            "kv_blocks_used": alloc.used_count,
+            "kv_blocks_free": alloc.free_count,
+            "block_size": self.pool.block_size,
+            "pool_blocks": self.pool.num_blocks,
+            "ticks_total": self.ticks_total,
+            "mode": self.mode,
+            "hbm_bytes": self.pool.hbm_bytes(),
+        }
+
+
+class PagedDecoder:
+    """Thin convenience wrapper: a :class:`DecodeSession` plus one-shot
+    batch generation (the bench entry point)."""
+
+    def __init__(self, cfg: DecoderConfig, params: Any, **session_kwargs):
+        session_kwargs.setdefault("auto", False)
+        self.session = DecodeSession(cfg, params, **session_kwargs)
+
+    def generate_ids(
+        self,
+        prompts_ids: Sequence[Sequence[int]],
+        max_new_tokens: int = 32,
+        **submit_kwargs,
+    ) -> list[list[int]]:
+        handles = [
+            self.session.submit(
+                p, max_new_tokens=max_new_tokens, **submit_kwargs
+            )
+            for p in prompts_ids
+        ]
+        self.session.drain()
+        return [h.result(timeout=5.0) for h in handles]

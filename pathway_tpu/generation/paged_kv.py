@@ -1,0 +1,148 @@
+"""Paged KV cache: a preallocated HBM block pool + host-side allocator.
+
+The dense decode path (models/decoder.py) preallocates one contiguous
+``[B, Tmax, H, Dh]`` cache per launch — every sequence pays ``Tmax``
+tokens of HBM whether it generates 3 tokens or 300, and sequences cannot
+join or leave a running batch.  Paged KV (vLLM's PagedAttention, carried
+to TPU by "Ragged Paged Attention", PAPERS.md) splits the cache into
+fixed-size blocks:
+
+* the DEVICE side is two preallocated pools ``[layers, num_blocks,
+  block_size, heads, head_dim]`` (layer-major so a per-layer decode step
+  addresses a contiguous major-axis slice; the per-block gather rides a
+  scalar-prefetch block-table array exactly like the ragged kernel's
+  ``ragged_bounds``);
+* the HOST side is this module: a free-list :class:`BlockAllocator` and
+  per-sequence block tables.  Admission allocates a sequence's worst-case
+  block count up front (prompt + ``max_new_tokens``), retirement frees
+  them — so "can this request run now" is a pure host-side free-list
+  check, the token-budget admission signal the serving plane sheds on.
+
+A freed block is reused verbatim (no zeroing): a new tenant overwrites
+it from position 0 and every attention read is masked to the OWNING
+sequence's live length, so stale tail data is structurally unreachable
+(pinned by the block-reuse test in tests/test_paged_decode.py).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from collections import deque
+
+from ..internals.config import env_int as _env_int
+
+__all__ = [
+    "BlockAllocator",
+    "PagedKVPool",
+    "decode_block_size",
+    "decode_pool_tokens",
+]
+
+
+def decode_block_size() -> int:
+    """``PATHWAY_DECODE_BLOCK_SIZE``: tokens per KV block (default 16).
+    Smaller blocks waste less tail capacity per sequence; larger blocks
+    mean fewer gather descriptors per attention step."""
+    v = _env_int("PATHWAY_DECODE_BLOCK_SIZE", 16)
+    return max(1, v)
+
+
+def decode_pool_tokens() -> int:
+    """``PATHWAY_DECODE_POOL_TOKENS``: total KV pool capacity in tokens
+    (default 16384).  Divided by the block size this is the pool's block
+    count; admission refuses work that cannot fit."""
+    v = _env_int("PATHWAY_DECODE_POOL_TOKENS", 16384)
+    return max(1, v)
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` KV blocks.
+
+    NOT internally locked — the owning :class:`DecodeSession` serializes
+    alloc/free under its session lock.  FIFO reuse (a deque) keeps the
+    reuse order deterministic, which the block-reuse parity test relies
+    on to actually exercise reuse."""
+
+    __slots__ = ("num_blocks", "_free")
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = int(num_blocks)
+        self._free: deque[int] = deque(range(self.num_blocks))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` blocks, or ``None`` when the pool cannot satisfy the
+        request right now (the caller keeps the work queued)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(f"free of out-of-range block {b}")
+            self._free.append(b)
+
+
+class PagedKVPool:
+    """The device half: K and V block pools plus the allocator.
+
+    Pools are ordinary jax arrays carried FUNCTIONALLY — each jitted
+    prefill/step returns updated pools and the session swaps its
+    references (donated on TPU so the update is in place).
+    """
+
+    def __init__(self, cfg, *, block_size: int | None = None,
+                 pool_tokens: int | None = None):
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.block_size = (
+            decode_block_size() if block_size is None else int(block_size)
+        )
+        tokens = (
+            decode_pool_tokens() if pool_tokens is None else int(pool_tokens)
+        )
+        self.num_blocks = max(1, tokens // self.block_size)
+        #: block-table width: enough entries for a max_len sequence
+        self.blocks_per_seq = -(-int(cfg.max_len) // self.block_size)
+        head_dim = cfg.hidden_dim // cfg.num_heads
+        shape = (
+            cfg.num_layers,
+            self.num_blocks,
+            self.block_size,
+            cfg.num_heads,
+            head_dim,
+        )
+        self.k_pool = jnp.zeros(shape, cfg.dtype)
+        self.v_pool = jnp.zeros(shape, cfg.dtype)
+        self.allocator = BlockAllocator(self.num_blocks)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` KV entries."""
+        return max(1, -(-int(n_tokens) // self.block_size))
+
+    def hbm_bytes(self) -> int:
+        import numpy as np
+
+        itemsize = np.dtype(self.cfg.dtype).itemsize
+        per_pool = math.prod(
+            (
+                self.cfg.num_layers,
+                self.num_blocks,
+                self.block_size,
+                self.cfg.num_heads,
+                self.cfg.hidden_dim // self.cfg.num_heads,
+            )
+        )
+        return 2 * per_pool * itemsize

@@ -259,6 +259,20 @@ class HealthRegistry:
                     snap["query_cache"] = qcache
         except Exception:  # noqa: BLE001 — health must never raise
             pass
+        # paged-KV decode: live sequences, block-pool occupancy and
+        # generation counters across every DecodeSession — read-only and
+        # gated on the module already being imported (a health probe
+        # never pulls in jax)
+        try:
+            import sys as _sys
+
+            mod = _sys.modules.get("pathway_tpu.generation.engine")
+            if mod is not None:
+                gen = mod.generation_status()
+                if gen:
+                    snap["generation"] = gen
+        except Exception:  # noqa: BLE001 — health must never raise
+            pass
         try:
             from ..testing import faults
 

@@ -199,6 +199,31 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "queries embedded on host CPU by the WindVE collaborative path under queue pressure",
     ),
+    # paged-KV continuous-batching decode (pathway_tpu/generation/)
+    "pathway_decode_live_sequences": (
+        "gauge",
+        "sequences currently advancing per decode tick across live DecodeSessions",
+    ),
+    "pathway_decode_kv_blocks": (
+        "gauge",
+        "paged KV pool blocks per state (used / free) — the token-budget admission signal",
+    ),
+    "pathway_decode_tokens_total": (
+        "counter",
+        "tokens generated by the paged continuous-batching decode path",
+    ),
+    "pathway_decode_prefill_tokens_total": (
+        "counter",
+        "prompt tokens prefilled into paged KV blocks (ragged packed launches)",
+    ),
+    "pathway_decode_shed_total": (
+        "counter",
+        "decode requests shed (queue-depth backpressure or deadline passed while queued)",
+    ),
+    "pathway_decode_retired_total": (
+        "counter",
+        "sequences retired (EOS or max_new_tokens reached; blocks freed unless retained)",
+    ),
 }
 
 

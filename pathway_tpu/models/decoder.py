@@ -4,9 +4,13 @@ TPU-native replacement for the reference's ``HFPipelineChat`` compute
 path (xpacks/llm/llms.py:441 — a torch ``transformers`` text-generation
 pipeline on CPU).  Decoding is the classic TPU recipe: static shapes
 everywhere, one prefill over the padded prompt, then a ``lax.scan`` over
-generation steps reading/writing a preallocated kv cache — no Python
-control flow inside jit, one compilation per (prompt bucket,
-max_new_tokens).
+FIXED-STEP decode chunks reading/writing a preallocated kv cache — no
+Python control flow inside jit, compile set keyed on the (prompt bucket,
+pow2 chunk-count) grid rather than each request's ``max_new_tokens``,
+with an EOS early-exit between chunks.  The serving-shaped alternative
+(cross-request continuous batching over paged KV blocks) lives in
+``pathway_tpu/generation/``; ``CausalLM.paged_session()`` /
+``generate_stream()`` bridge to it.
 
 Weight layout follows HF GPT-2 conventions (pre-LN blocks, fused c_attn,
 tanh-approx GELU, tied output head) so converted checkpoints are
@@ -18,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading as _threading_mod
 from typing import Any, Sequence
 
 import jax
@@ -164,20 +169,17 @@ def _filter_logits(logits, top_k: int, top_p: float):
     return logits
 
 
-@functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new", "greedy", "top_k", "top_p")
-)
-def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
-                  greedy: bool, rng, temperature, top_k: int = 0,
-                  top_p: float = 1.0):
-    """Prefill + scan decode.  ids: ``[B, Tp]`` left-padded to a static
-    prompt bucket with real length per row in ``length``; returns
-    ``[B, max_new]`` generated ids."""
+@functools.partial(jax.jit, static_argnames=("cfg", "cache_len"))
+def _prefill_jit(params, ids, length, cfg: DecoderConfig, cache_len: int):
+    """Prompt prefill.  ids: ``[B, Tp]`` right-padded to a static prompt
+    bucket with real length per row in ``length``.  Returns the
+    last-real-token logits plus KV stacks sized ``cache_len`` — the FULL
+    decode horizon, so the chunked decode below never reshapes (and
+    never recompiles) as generation advances."""
     B, Tp = ids.shape
     D = cfg.hidden_dim
     H = cfg.num_heads
     Dh = D // H
-    Tmax = Tp + max_new
     pos_mask = jnp.arange(Tp)[None, :] < length[:, None]
     positions = jnp.arange(Tp)[None, :]
     x = (
@@ -191,12 +193,12 @@ def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
         # cast before the scatter: future JAX errors on implicit
         # f32->bf16 value demotion in .at[].set
         k_pad = (
-            jnp.zeros((B, Tmax, H, Dh), cfg.dtype)
+            jnp.zeros((B, cache_len, H, Dh), cfg.dtype)
             .at[:, :Tp]
             .set(k.astype(cfg.dtype))
         )
         v_pad = (
-            jnp.zeros((B, Tmax, H, Dh), cfg.dtype)
+            jnp.zeros((B, cache_len, H, Dh), cfg.dtype)
             .at[:, :Tp]
             .set(v.astype(cfg.dtype))
         )
@@ -206,8 +208,24 @@ def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
     # logits at each row's LAST real token
     last = jnp.take_along_axis(x, (length - 1)[:, None, None], axis=1)[:, 0]
     logits = _logits_of(last, params)
-    k_stack = jnp.stack(k_caches)  # [L, B, Tmax, H, Dh]
+    k_stack = jnp.stack(k_caches)  # [L, B, cache_len, H, Dh]
     v_stack = jnp.stack(v_caches)
+    return logits, k_stack, v_stack
+
+
+def _decode_chunk_impl(params, logits, k_stack, v_stack, length, base, rng,
+                       temperature, cfg: DecoderConfig, chunk: int,
+                       greedy: bool, top_k: int = 0, top_p: float = 1.0):
+    """``chunk`` scan decode steps starting ``base`` tokens past the
+    prompt.  The compiled program is keyed on the CHUNK size, never on a
+    request's ``max_new_tokens`` — callers loop chunks (with an
+    early-exit on EOS between them), so the compile count stays flat
+    across request-level generation lengths."""
+    B = logits.shape[0]
+    D = cfg.hidden_dim
+    H = cfg.num_heads
+    Dh = D // H
+    Tmax = k_stack.shape[2]
 
     def pick(logits, rng):
         if greedy:
@@ -221,7 +239,7 @@ def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
         logits, k_stack, v_stack, rng = carry
         rng, sub = jax.random.split(rng)
         tok = pick(logits, sub)
-        pos = length + i  # per-row write position
+        pos = length + base + i  # per-row write position
         # embed the new token at its per-row position
         x = (
             params["wte"]["embedding"][tok]
@@ -266,18 +284,57 @@ def _generate_jit(params, ids, length, cfg: DecoderConfig, max_new: int,
         logits = _logits_of(x, params)
         return (logits, k_stack, v_stack, rng), tok
 
-    (_, _, _, _), toks = lax.scan(
-        step, (logits, k_stack, v_stack, rng), jnp.arange(max_new)
+    (logits, k_stack, v_stack, rng), toks = lax.scan(
+        step, (logits, k_stack, v_stack, rng), jnp.arange(chunk)
     )
-    return jnp.transpose(toks, (1, 0))  # [B, max_new]
+    return logits, k_stack, v_stack, rng, jnp.transpose(toks, (1, 0))
 
 
 # observable compile counts (pathway_xla_compile_total): generation should
-# compile once per (prompt bucket, max_new, sampling mode) — a counter
-# climbing faster than that means the prompt bucketing regressed
+# compile once per (prompt bucket, sampling mode) — NOT per distinct
+# max_new_tokens (the fixed-step chunk absorbs that); a counter climbing
+# faster means the prompt bucketing or chunking regressed
 from ..internals.flight_recorder import instrument_jit as _instrument_jit
 
-_generate_jit = _instrument_jit(_generate_jit, "decoder.generate")
+_prefill_jit = _instrument_jit(_prefill_jit, "decoder.prefill")
+
+_CHUNK_JIT_LOCK = _threading_mod.Lock()
+_CHUNK_JIT: Any = None
+
+
+def _decode_chunk_jit(*args, **kwargs):
+    """Lazily-built jitted decode chunk.  The KV stacks are donated so a
+    chunk updates the cache in place instead of copying it per call, but
+    donation is a warn-spammed no-op on CPU — and deciding requires
+    ``jax.default_backend()``, which INITIALIZES the platform.  Deferring
+    the jit to first use (the generation/engine ``_donate`` idiom) keeps
+    importing this module side-effect free, so apps can still configure
+    ``jax_platforms`` / distributed init after importing pathway_tpu."""
+    global _CHUNK_JIT
+    if _CHUNK_JIT is None:
+        with _CHUNK_JIT_LOCK:
+            if _CHUNK_JIT is None:
+                fn = jax.jit(
+                    _decode_chunk_impl,
+                    static_argnames=(
+                        "cfg", "chunk", "greedy", "top_k", "top_p"
+                    ),
+                    donate_argnums=(
+                        (2, 3) if jax.default_backend() == "tpu" else ()
+                    ),
+                )
+                _CHUNK_JIT = _instrument_jit(fn, "decoder.generate")
+    return _CHUNK_JIT(*args, **kwargs)
+
+
+def decode_step_chunk() -> int:
+    """``PATHWAY_DECODE_STEP_CHUNK``: scan steps per compiled decode
+    chunk (default 32).  Request-level ``max_new_tokens`` rounds up to a
+    multiple of this; the EOS early-exit between chunks bounds the
+    wasted steps."""
+    from ..internals.config import env_int
+
+    return max(1, env_int("PATHWAY_DECODE_STEP_CHUNK", 32))
 
 
 _PROMPT_BUCKETS = (32, 64, 128, 256, 512, 1024)
@@ -340,6 +397,10 @@ class CausalLM:
             from ..parallel.sharding import shard_decoder_params
 
             self.params = shard_decoder_params(self.params, mesh)
+        #: lazily-built paged-KV continuous-batching session
+        #: (pathway_tpu.generation) — the serving-shaped decode path
+        self._paged_session: Any = None
+        self._paged_lock = _threading_mod.Lock()
 
     def logits(self, ids) -> jax.Array:
         """Full-sequence logits (scoring path)."""
@@ -353,8 +414,16 @@ class CausalLM:
         seed: int = 0,
         top_k: int = 0,
         top_p: float = 1.0,
+        eos_id: int | None = None,
     ) -> np.ndarray:
-        """Generate token ids for a batch of prompts -> [B, max_new]."""
+        """Generate token ids for a batch of prompts -> [B, max_new].
+
+        Decoding runs in fixed-step chunks (``PATHWAY_DECODE_STEP_CHUNK``)
+        with an early exit between chunks once every row has emitted
+        ``eos_id`` — the compiled-program set is keyed on the (prompt
+        bucket, pow2 chunk-count) grid, never on a request's raw
+        ``max_new_tokens``.  With ``eos_id`` set, tokens after a row's
+        first EOS are reported as ``eos_id``."""
         if max_new_tokens >= self.cfg.max_len:
             raise ValueError(
                 f"max_new_tokens={max_new_tokens} must leave room for a "
@@ -374,19 +443,51 @@ class CausalLM:
             tail = np.asarray(p[-bucket:], np.int32)
             ids[i, : len(tail)] = tail
         lengths = np.minimum(lengths, bucket)
-        out = _generate_jit(
-            self.params,
-            jnp.asarray(ids),
-            jnp.asarray(lengths),
-            self.cfg,
-            int(max_new_tokens),
-            temperature <= 0.0,
-            jax.random.PRNGKey(seed),
-            jnp.float32(max(temperature, 1e-6)),
-            top_k=int(top_k),
-            top_p=float(top_p),
+        chunk = decode_step_chunk()
+        n_chunks = -(-int(max_new_tokens) // chunk)
+        horizon = chunk * (
+            1 if n_chunks <= 1 else 1 << (n_chunks - 1).bit_length()
         )
-        return np.asarray(out)
+        length_arr = jnp.asarray(lengths)
+        logits, k_stack, v_stack = _prefill_jit(
+            self.params, jnp.asarray(ids), length_arr, self.cfg,
+            bucket + horizon,
+        )
+        rng = jax.random.PRNGKey(seed)
+        temp = jnp.float32(max(temperature, 1e-6))
+        pieces: list[np.ndarray] = []
+        produced = 0
+        eos_seen = np.zeros(len(prompts_ids), bool)
+        base = 0
+        while produced < max_new_tokens:
+            logits, k_stack, v_stack, rng, toks = _decode_chunk_jit(
+                self.params, logits, k_stack, v_stack, length_arr,
+                jnp.int32(base), rng, temp, self.cfg, chunk,
+                temperature <= 0.0, top_k=int(top_k), top_p=float(top_p),
+            )
+            toks_np = np.asarray(toks)
+            pieces.append(toks_np)
+            produced += chunk
+            base += chunk
+            if eos_id is not None:
+                eos_seen |= (toks_np == eos_id).any(axis=1)
+                if eos_seen.all():
+                    break  # every row closed: skip the remaining chunks
+        out = np.concatenate(pieces, axis=1)
+        if out.shape[1] < max_new_tokens:
+            # early exit: report the unreached tail as EOS
+            pad = np.full(
+                (out.shape[0], max_new_tokens - out.shape[1]),
+                eos_id, np.int32,
+            )
+            out = np.concatenate([out, pad], axis=1)
+        out = out[:, :max_new_tokens]
+        if eos_id is not None:
+            # mask everything after a row's first EOS to EOS
+            hit = out == eos_id
+            after = np.cumsum(hit, axis=1) - hit.astype(int) > 0
+            out = np.where(after, eos_id, out)
+        return np.ascontiguousarray(out)
 
     def generate(
         self,
@@ -417,3 +518,125 @@ class CausalLM:
         if decode is not None:
             return [decode(row.tolist()) for row in toks]
         return [" ".join(f"<{t}>" for t in row.tolist()) for row in toks]
+
+    # -- paged-KV continuous batching (pathway_tpu.generation) ----------
+    def eos_id(self) -> int | None:
+        """The tokenizer's EOS id when it has one (HF wrapper), else
+        ``None`` (the hashing fallback has no EOS semantics)."""
+        tok = self.tokenizer
+        eos = getattr(tok, "eos_token_id", None)
+        if eos is None:
+            eos = getattr(getattr(tok, "tok", None), "eos_token_id", None)
+        return None if eos is None else int(eos)
+
+    def encode_prompt(self, prompt: str) -> list[int]:
+        encode = getattr(self.tokenizer, "encode_ids", None)
+        if encode is not None:
+            return list(encode(prompt))
+        ids_all, mask_all = self.tokenizer.encode_batch(
+            [prompt], max_length=self.cfg.max_len
+        )
+        return ids_all[0, : int(mask_all[0].sum())].tolist()
+
+    def decode_tokens(self, ids: Sequence[int]) -> str:
+        decode = getattr(self.tokenizer, "decode_ids", None)
+        if decode is not None:
+            return decode(list(ids))
+        return " ".join(f"<{t}>" for t in ids)
+
+    def paged_session(self, **session_kwargs):
+        """The shared :class:`pathway_tpu.generation.DecodeSession` over
+        this model's params — continuous batching with paged KV blocks,
+        scheduled as ``GENERATE``-class runtime work.  Built once;
+        ``session_kwargs`` apply only to the first call."""
+        with self._paged_lock:
+            if self._paged_session is None:
+                from ..generation import DecodeSession
+
+                self._paged_session = DecodeSession(
+                    self.cfg, self.params, tokenizer=self.tokenizer,
+                    **session_kwargs,
+                )
+            return self._paged_session
+
+    def generate_stream(
+        self,
+        prompt: str,
+        max_new_tokens: int = 64,
+        *,
+        temperature: float = 0.0,
+        seed: int = 0,
+        eos_id: int | None = None,
+        paged: bool | None = None,
+        deadline_s: float | None = None,
+    ):
+        """Stream the completion as text pieces (an iterator of str).
+
+        ``paged=None`` (auto) rides the paged-KV continuous-batching
+        session — per-TOKEN streaming, concurrent requests share decode
+        ticks — and falls back to the dense chunked path (per-CHUNK
+        pieces) when the paged session refuses this geometry.
+        """
+        if eos_id is None:
+            eos_id = self.eos_id()
+        prompt_ids = self.encode_prompt(prompt)
+        session = None
+        if paged is not False:
+            try:
+                session = self.paged_session()
+            except ValueError:
+                if paged is True:
+                    raise
+        handle = None
+        if session is not None:
+            from ..runtime import AdmissionRefused
+
+            try:
+                handle = session.submit(
+                    prompt_ids, max_new_tokens=max_new_tokens,
+                    temperature=temperature, seed=seed, eos_id=eos_id,
+                    deadline_s=deadline_s,
+                )
+            except AdmissionRefused as exc:
+                # PERMANENT refusals (retry_after_s == 0: geometry the
+                # pool/packed prefill can never hold) fall back to the
+                # dense chunked path in auto mode, honoring the docstring
+                # contract.  Transient backpressure (pending queue full,
+                # retry_after_s > 0) re-raises — serving planes map it to
+                # 503 + Retry-After; silently absorbing it on the dense
+                # path would defeat admission control.
+                if paged is True or getattr(exc, "retry_after_s", 1.0) > 0:
+                    raise
+        if handle is not None:
+
+            def _paged_iter():
+                from ..generation.engine import iter_text_pieces
+
+                try:
+                    yield from iter_text_pieces(
+                        handle, self.decode_tokens, eos_id
+                    )
+                finally:
+                    # abandoned iterator (caller broke out / client went
+                    # away): stop decoding, free the KV blocks
+                    if not handle.done:
+                        session.cancel(handle)
+
+            return _paged_iter()
+
+        def _dense_iter():
+            emitted = ""
+            toks = self.generate_ids(
+                [prompt_ids], max_new_tokens=max_new_tokens,
+                temperature=temperature, seed=seed, eos_id=eos_id,
+            )[0].tolist()
+            if eos_id is not None and eos_id in toks:
+                toks = toks[: toks.index(eos_id)]
+            chunk = decode_step_chunk()
+            for start in range(0, len(toks), chunk):
+                full = self.decode_tokens(toks[: start + chunk])
+                piece, emitted = full[len(emitted):], full
+                if piece:
+                    yield piece
+
+        return _dense_iter()

@@ -156,17 +156,20 @@ def _ragged_kernel(
     k_ref,  # [1, T, dh] (whole kv for this head)
     v_ref,  # [1, T, dh]
     seg_ref,  # [1, T] int32 segment ids (pads = num_rows)
+    pos_ref,  # [1, T] int32 position-within-row (causal masking)
     o_ref,  # [1, block_q, dh]
     *,
     block_q: int,
     block_k: int,
     sm_scale: float,
+    causal: bool,
 ):
     i = pl.program_id(1)
     lo = bounds_ref[i, 0]
     hi = bounds_ref[i, 1]
     q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, dh]
     seg_q = seg_ref[0, pl.ds(i * block_q, block_q)]  # [bq]
+    pos_q = pos_ref[0, pl.ds(i * block_q, block_q)]  # [bq]
 
     def body(j, carry):
         m, l, acc = carry
@@ -178,6 +181,12 @@ def _ragged_kernel(
         )  # [bq, bk]
         seg_k = seg_ref[0, pl.ds(j * block_k, block_k)]
         valid = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            # decoder prefill: a token attends only to its own row's
+            # PREFIX (pos_q >= pos_k); the block-skip bounds stay the
+            # bidirectional row bounds — future blocks mask, not skip
+            pos_k = pos_ref[0, pl.ds(j * block_k, block_k)]
+            valid &= pos_q[:, None] >= pos_k[None, :]
         s = jnp.where(valid, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # masked entries must contribute 0 even when a row has seen no
@@ -199,15 +208,19 @@ def _ragged_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "sm_scale", "interpret")
+    jax.jit, static_argnames=("block", "sm_scale", "interpret", "causal")
 )
-def _ragged_pallas(q, k, v, seg, bounds, block, sm_scale, interpret):
+def _ragged_pallas(q, k, v, seg, pos, bounds, block, sm_scale, interpret,
+                   causal=False):
     # layout: [T, h, dh] -> [h, T, dh]; one program per (head, q block)
     total, heads, dh = q.shape
     qh = jnp.transpose(q, (1, 0, 2))
     kh = jnp.transpose(k, (1, 0, 2))
     vh = jnp.transpose(v, (1, 0, 2))
     seg2 = seg.astype(jnp.int32)[None, :]  # [1, T]
+    if pos is None:
+        pos = jnp.zeros((total,), jnp.int32)
+    pos2 = pos.astype(jnp.int32)[None, :]  # [1, T]
     n_blocks = total // block
     from jax.experimental.pallas import tpu as pltpu
 
@@ -219,6 +232,7 @@ def _ragged_pallas(q, k, v, seg, bounds, block, sm_scale, interpret):
             pl.BlockSpec((1, total, dh), lambda h, i, b: (h, 0, 0)),
             pl.BlockSpec((1, total, dh), lambda h, i, b: (h, 0, 0)),
             pl.BlockSpec((1, total), lambda h, i, b: (0, 0)),
+            pl.BlockSpec((1, total), lambda h, i, b: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block, dh), lambda h, i, b: (h, i, 0)),
     )
@@ -228,6 +242,7 @@ def _ragged_pallas(q, k, v, seg, bounds, block, sm_scale, interpret):
             block_q=block,
             block_k=block,
             sm_scale=sm_scale,
+            causal=causal,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((heads, total, dh), q.dtype),
@@ -240,7 +255,7 @@ def _ragged_pallas(q, k, v, seg, bounds, block, sm_scale, interpret):
             transcendentals=heads * total * total,
         ),
         interpret=interpret,
-    )(bounds, qh, kh, vh, seg2)
+    )(bounds, qh, kh, vh, seg2, pos2)
     return jnp.transpose(out, (1, 0, 2))
 
 
@@ -249,8 +264,11 @@ def _ragged_pallas(q, k, v, seg, bounds, block, sm_scale, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("num_rows", "dense_s", "sm_scale"))
-def _ragged_reference(q, k, v, seg, pos, starts, num_rows, dense_s, sm_scale):
+@functools.partial(
+    jax.jit, static_argnames=("num_rows", "dense_s", "sm_scale", "causal")
+)
+def _ragged_reference(q, k, v, seg, pos, starts, num_rows, dense_s, sm_scale,
+                      causal=False):
     """Gather the packed tokens into the bucketed dense layout
     ``[rows, seq_bucket]`` the legacy dispatch uses, run the flax-exact
     masked softmax there, gather back to the packed axis.  GATHERS, not
@@ -292,6 +310,11 @@ def _ragged_reference(q, k, v, seg, pos, starts, num_rows, dense_s, sm_scale):
         "rqhd,rkhd->rhqk", qd, kd, preferred_element_type=jnp.float32
     ) * sm_scale
     s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
+    if causal:
+        # in the dense unpack the lane index IS the within-row position,
+        # so causal masking is a plain lower-triangular mask
+        tri = jnp.tril(jnp.ones((dense_s, dense_s), bool))
+        s = jnp.where(tri[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     od = jnp.einsum("rhqk,rkhd->rqhd", p, vd.astype(p.dtype))
     # gather back (pads clamp to the last row — their output is
@@ -313,9 +336,15 @@ def ragged_attention(
     dense_s: int | None = None,
     sm_scale: float | None = None,
     pre_scaled: bool = False,
+    causal: bool = False,
     mode: str | None = None,
 ):
     """Attention over a packed ragged batch.
+
+    ``causal=True`` additionally masks each token to its own row's
+    prefix (``pos_q >= pos_k``) — the decoder-prefill contract (the
+    paged-KV generation subsystem rides this for its one-launch
+    mixed-length prefill).  Requires ``pos`` in BOTH modes.
 
     ``q``/``k``/``v``: ``[T, heads, head_dim]`` — rows concatenated along
     the token axis, ``T`` padded to a token bucket.  ``seg``: ``[T]``
@@ -366,7 +395,7 @@ def ragged_attention(
             )
         return _ragged_reference(
             q, k, v, seg, pos, starts, int(num_rows), int(dense_s),
-            float(scale),
+            float(scale), causal=causal,
         )
     block = ragged_block(total)
     if total % block:
@@ -379,7 +408,13 @@ def ragged_attention(
             "ragged_attention pallas mode needs the per-q-block kv bounds "
             "(ragged_bounds)"
         )
+    if causal and pos is None:
+        raise ValueError(
+            "ragged_attention causal=True needs pos (position within row) "
+            "for the prefix mask"
+        )
     interpret = jax.default_backend() != "tpu"
     return _ragged_pallas(
-        q, k, v, seg, bounds, block, float(scale), interpret
+        q, k, v, seg, pos, bounds, block, float(scale), interpret,
+        causal=causal,
     )

@@ -16,7 +16,8 @@ executor composes each device tick from the class queues under a
 **strict-priority-with-budget** policy:
 
 * classes drain in priority order ``INTERACTIVE > LLM_RERANK >
-  BULK_INGEST`` — an interactive query arriving while an ingest backlog
+  GENERATE > BULK_INGEST`` — an interactive query arriving while an
+  ingest (or decode) backlog
   is queued rides the very next tick, ahead of every queued ingest
   chunk (preemption at tick granularity; ingest submits tick-sized
   chunks precisely so a tick is never longer than one bounded dispatch);
@@ -88,11 +89,22 @@ class QoS(enum.IntEnum):
 
     INTERACTIVE = 0  # latency-critical serving (/v1/retrieve ticks)
     LLM_RERANK = 1   # engine-plane embed/rerank/LLM-guard micro-batches
-    BULK_INGEST = 2  # backlog-tolerant bulk embed→upsert chunks
+    GENERATE = 2     # paged-KV decode ticks (token streams tolerate a
+                     # bounded inter-token gap; retrieval p99 does not)
+    BULK_INGEST = 3  # backlog-tolerant bulk embed→upsert chunks
 
     @property
     def label(self) -> str:
         return self.name.lower()
+
+
+#: every class an INTERACTIVE tick may preempt (strict-priority order)
+_LOWER_CLASSES = (QoS.LLM_RERANK, QoS.GENERATE, QoS.BULK_INGEST)
+#: classes whose "highest nonempty" tick is share-capped so the
+#: preemption horizon an arriving query faces stays one short tick —
+#: decode steps and ingest chunks are independent dispatches with no
+#: cross-item fusion benefit, so a budget-full train only adds latency
+_SHARE_CAPPED_CLASSES = (QoS.GENERATE, QoS.BULK_INGEST)
 
 
 class DeadlineExceeded(Exception):
@@ -301,6 +313,7 @@ class DeviceTickRuntime:
         self.depth = {
             QoS.INTERACTIVE: 1024,
             QoS.LLM_RERANK: 4096,
+            QoS.GENERATE: 256,
             QoS.BULK_INGEST: 512,
             **(depth or {}),
         }
@@ -309,6 +322,7 @@ class DeviceTickRuntime:
         self.min_share = {
             QoS.INTERACTIVE: 1.0,
             QoS.LLM_RERANK: 0.2,
+            QoS.GENERATE: 0.15,
             QoS.BULK_INGEST: 0.1,
             **(min_share or {}),
         }
@@ -575,11 +589,11 @@ class DeviceTickRuntime:
         tick, never a budget-full train of chunks — back-to-back ticks
         keep idle-device ingest throughput identical."""
         reserved: dict[QoS, int] = {}
-        for c in (QoS.LLM_RERANK, QoS.BULK_INGEST):
+        for c in _LOWER_CLASSES:
             if self._queues[c] and self.min_share.get(c, 0.0) > 0.0:
                 reserved[c] = max(1, int(self.min_share[c] * self.tick_tokens))
         lower_pending_at_start = {
-            c: bool(self._queues[c]) for c in (QoS.LLM_RERANK, QoS.BULK_INGEST)
+            c: bool(self._queues[c]) for c in _LOWER_CLASSES
         }
         highest = next((c for c in QoS if self._queues[c]), None)
         take: list[WorkItem] = []
@@ -590,11 +604,11 @@ class DeviceTickRuntime:
             guaranteed = reserved.pop(c, 0)
             if not q:
                 continue
-            if c == highest and c is not QoS.BULK_INGEST:
+            if c == highest and c not in _SHARE_CAPPED_CLASSES:
                 allowed = remaining - sum(reserved.values())
             elif c == highest:
-                # bulk-only tick: one share's worth, then recompose —
-                # the horizon for a preempting query stays one short tick
+                # decode/bulk-only tick: one share's worth, then recompose
+                # — the horizon for a preempting query stays one short tick
                 allowed = max(
                     guaranteed,
                     max(1, int(self.min_share.get(c, 0.0) * self.tick_tokens)),
@@ -662,8 +676,7 @@ class DeviceTickRuntime:
         # a tick that carries interactive work while lower-class work
         # stays queued behind it preempted that work at tick granularity
         preempted = per_class[QoS.INTERACTIVE][0] > 0 and any(
-            tick_stats["leftover"][c] > 0
-            for c in (QoS.LLM_RERANK, QoS.BULK_INGEST)
+            tick_stats["leftover"][c] > 0 for c in _LOWER_CLASSES
         )
         with self._mx:
             self._ticks_total += 1
@@ -919,11 +932,13 @@ _SETTINGS: dict[str, Any] = {
             _env_int("PATHWAY_SERVING_MAX_QUEUE", 1024),
         ),
         QoS.LLM_RERANK: _env_int("PATHWAY_RUNTIME_DEPTH_LLM_RERANK", 4096),
+        QoS.GENERATE: _env_int("PATHWAY_RUNTIME_DEPTH_GENERATE", 256),
         QoS.BULK_INGEST: _env_int("PATHWAY_RUNTIME_DEPTH_BULK_INGEST", 512),
     },
     "min_share": {
         QoS.INTERACTIVE: 1.0,
         QoS.LLM_RERANK: _env_float("PATHWAY_RUNTIME_MIN_SHARE_LLM_RERANK", 0.2),
+        QoS.GENERATE: _env_float("PATHWAY_RUNTIME_MIN_SHARE_GENERATE", 0.15),
         QoS.BULK_INGEST: _env_float(
             "PATHWAY_RUNTIME_MIN_SHARE_BULK_INGEST", 0.1
         ),

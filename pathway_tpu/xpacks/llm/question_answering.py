@@ -10,6 +10,7 @@ prompt → LLM; ``summarize_query``:491; ``build_server``/``run_server``),
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable
 
 from ...internals import dtype as dt
@@ -118,6 +119,10 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
         self.search_topk = search_topk
         self.server: Any = None
         self._pending_endpoints: list = []
+        # streamed-answer lazy builds run on worker threads
+        # (asyncio.to_thread) — serialize concurrent first requests so
+        # two planes (each with its own scheduler) are never built
+        self._stream_plane_lock = threading.Lock()
         if llm_breaker is None:
             from ._breaker import CircuitBreaker
 
@@ -301,11 +306,383 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
     def list_documents(self, queries: Table) -> Table:
         return self.indexer.inputs_query(queries)
 
+    # -- TPU-native streamed answers (pathway_tpu.generation) -----------
+    #
+    # ``/v1/pw_ai_answer_stream`` serves end-to-end RAG answers with the
+    # tokens generated ON the TPU by the paged-KV continuous-batching
+    # decode subsystem: retrieval rides the serving scheduler as an
+    # INTERACTIVE tick, generation rides the shared DecodeSession whose
+    # ticks are GENERATE-class runtime work, and the answer streams back
+    # over the existing webserver as chunked NDJSON lines.  The
+    # external-UDF ``/v1/pw_ai_answer`` path is untouched — it remains
+    # the fallback for non-TPU LLMs, and the breaker/degraded contract
+    # is shared: a refused/failed generation answers retrieval-only with
+    # ``"degraded": true`` instead of 5xx-ing.
+
+    def _tpu_lm(self):
+        """The TPU-native ``CausalLM`` when ``self.llm`` is a
+        :class:`~pathway_tpu.xpacks.llm.llms.JaxPipelineChat` (duck-typed
+        on ``_ensure_lm``), else ``None`` — streaming then answers 501
+        and clients use the external-UDF endpoint."""
+        ensure = getattr(self.llm, "_ensure_lm", None)
+        if ensure is None:
+            return None
+        lm = ensure()
+        return lm if hasattr(lm, "paged_session") else None
+
+    def _stream_retrieve_plane(self):
+        """A direct (non-dataflow) retrieval plane for the streaming
+        handler, built once: DocumentStore exposes one; a
+        VectorStoreServer-shaped indexer gets a fresh
+        :class:`~pathway_tpu.xpacks.llm._scheduler.RetrievePlane` over
+        its live index factory (same INTERACTIVE scheduling, breaker and
+        BM25-degraded semantics as ``/v1/retrieve``)."""
+        plane = getattr(self, "_stream_plane", None)
+        if plane is not None or getattr(self, "_stream_plane_tried", False):
+            return plane
+        with getattr(self, "_stream_plane_lock", None) or threading.Lock():
+            return self._stream_retrieve_plane_locked()
+
+    def _stream_retrieve_plane_locked(self):
+        plane = getattr(self, "_stream_plane", None)
+        if plane is not None or getattr(self, "_stream_plane_tried", False):
+            return plane
+        ds_plane = getattr(self.indexer, "scheduler_retrieve_plane", None)
+        try:
+            if ds_plane is not None:
+                plane = ds_plane()
+            else:
+                index_factory = getattr(self.indexer, "index_factory", None)
+                graph = getattr(self.indexer, "_graph", None)
+                embedder = getattr(self.indexer, "embedder", None) or getattr(
+                    index_factory, "embedder", None
+                )
+                if index_factory is not None and graph is not None:
+                    from ._scheduler import RetrievePlane
+
+                    plane = RetrievePlane(
+                        index_factory=index_factory,
+                        embedder=embedder,
+                        payload_columns=graph["chunked_docs"].column_names(),
+                        label="qa_stream_retrieve",
+                    )
+        except Exception as exc:  # noqa: BLE001 — optional surface
+            # a FAILED build stays retryable: latching the tried flag
+            # here would turn one transient error (e.g. a lazy embedder
+            # load hiccup) into a permanent 501 for the server's
+            # lifetime — the tried-flag-on-success pattern from
+            # RetrievePlane._cache_stack.  Logged once, not per request.
+            if not getattr(self, "_stream_plane_err_logged", False):
+                self._stream_plane_err_logged = True
+                from ...internals.errors import register_error
+
+                register_error(
+                    f"streaming retrieve plane build failed (will retry "
+                    f"on the next request): {type(exc).__name__}: {exc}",
+                    kind="serving",
+                    operator="pw_ai_answer_stream",
+                )
+            return None
+        self._stream_plane = plane
+        self._stream_plane_tried = True
+        return plane
+
+    def _stream_docs_k(self) -> int:
+        """Context docs to retrieve for a streamed answer (the adaptive
+        subclass needs its full escalation depth)."""
+        return self.search_topk
+
+    def _stream_rounds(
+        self, lm, question: str, docs: list[str], *,
+        max_new_tokens: int, temperature: float, seed: int,
+        deadline_s: float | None,
+    ):
+        """Yield ``("token", round, piece)`` events then one
+        ``("final", round, answer)``.  Base: a single round over the
+        paged continuous-batching session — per-TOKEN streaming, decode
+        ticks shared with every concurrent request."""
+        session = lm.paged_session()
+        prompt = prompts.prompt_qa_geometric_rag(
+            question, docs, information_not_found_response=_NO_INFO,
+        )
+        eos = lm.eos_id()
+        handle = session.submit(
+            lm.encode_prompt(prompt), max_new_tokens=max_new_tokens,
+            temperature=temperature, seed=seed, eos_id=eos,
+            deadline_s=deadline_s,
+        )
+        try:
+            from ...generation.engine import iter_text_pieces
+
+            parts: list[str] = []
+            for piece in iter_text_pieces(handle, lm.decode_tokens, eos):
+                parts.append(piece)
+                yield ("token", 0, piece)
+            yield ("final", 0, "".join(parts).strip())
+        finally:
+            # abandoned stream (client disconnect closes the generator
+            # at a yield): stop decoding, free the blocks
+            if not handle.done:
+                session.cancel(handle)
+
+    def answer_stream_handler(self):
+        """The raw aiohttp handler behind ``/v1/pw_ai_answer_stream``:
+        chunked ``application/x-ndjson`` — a ``context`` line (when
+        requested), ``token`` lines as the device emits them, one
+        terminal ``done`` line."""
+        import asyncio
+        import json as _json
+
+        from ._utils import merge_filter_exprs
+
+        _SENTINEL = object()
+
+        async def handle(request):
+            from aiohttp import web
+
+            if request.method in ("POST", "PUT", "PATCH"):
+                try:
+                    payload = await request.json()
+                except Exception:  # noqa: BLE001 — malformed body
+                    return web.json_response(
+                        {"detail": "request body is not valid JSON"},
+                        status=400,
+                    )
+            else:
+                payload = dict(request.query)
+            prompt = coerce_str(payload.get("prompt", "")).strip()
+            if not prompt:
+                return web.json_response(
+                    {"detail": "prompt is required"}, status=400
+                )
+            try:
+                max_new = int(payload.get("max_new_tokens", 64))
+                temperature = float(payload.get("temperature", 0.0))
+                seed = int(payload.get("seed", 0))
+                k = int(payload.get("k", self._stream_docs_k()))
+                deadline_ms = payload.get("deadline_ms")
+                deadline_s = (
+                    None if deadline_ms is None
+                    else float(deadline_ms) / 1000.0
+                )
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"detail": "invalid numeric parameter"}, status=400
+                )
+            raw_docs_flag = payload.get("return_context_docs", False)
+            # GET requests deliver query-string values: "false"/"0" must
+            # not truthy their way into the docs line
+            return_docs = (
+                raw_docs_flag.strip().lower() in ("1", "true", "yes")
+                if isinstance(raw_docs_flag, str)
+                else bool(raw_docs_flag)
+            )
+            # first-request lazy builds (CausalLM weight load, retrieve-
+            # plane/embedder construction) can take tens of seconds —
+            # off the event loop, or every concurrent /v1/retrieve and
+            # /v1/pw_ai_answer response stalls behind them
+            lm = await asyncio.to_thread(self._tpu_lm)
+            if lm is None:
+                return web.json_response(
+                    {
+                        "detail": "streaming requires a TPU-native LLM "
+                        "(JaxPipelineChat); use /v1/pw_ai_answer",
+                    },
+                    status=501,
+                )
+            plane = await asyncio.to_thread(self._stream_retrieve_plane)
+            if plane is None:
+                return web.json_response(
+                    {
+                        "detail": "indexer exposes no direct retrieval "
+                        "plane; use /v1/pw_ai_answer",
+                    },
+                    status=501,
+                )
+            flt = merge_filter_exprs(payload.get("filters"), None)
+            from ._scheduler import DeadlineExceeded
+
+            try:
+                retrieved = await plane.scheduler.submit_async(
+                    plane.group, (prompt, k, flt),
+                    deadline_s=deadline_s, sheddable=True,
+                    trace=request.get("pw_trace"),
+                )
+            except DeadlineExceeded as exc:
+                return web.json_response(
+                    {"detail": str(exc)},
+                    status=503,
+                    headers={"Retry-After": str(exc.retry_after_s)},
+                )
+            docs = [
+                coerce_str(d.get("text", ""))
+                for d in retrieved.get("results", ())
+            ]
+            # breaker contract shared with the UDF path: while open,
+            # answer retrieval-only (degraded), never 5xx.  Checked
+            # BEFORE the stream opens — one plain JSON line, which a
+            # line-iterating stream client parses identically
+            if not self.llm_breaker.allow():
+                return web.json_response(
+                    {
+                        "event": "done",
+                        "response": None,
+                        "degraded": True,
+                        "context_docs": docs,
+                    }
+                )
+            import time as _time_mod
+
+            from ...internals.flight_recorder import observe_stage, record_span
+            from ...runtime import AdmissionRefused
+
+            wall0 = _time_mod.time()
+            t0 = _time_mod.monotonic()
+            rounds_it = iter(
+                self._stream_rounds(
+                    lm, prompt, docs, max_new_tokens=max_new,
+                    temperature=temperature, seed=seed, deadline_s=deadline_s,
+                )
+            )
+
+            def _gen_failed(exc: BaseException) -> dict:
+                """Charge the LLM breaker (generation is actually sick)
+                and build the degraded terminal line."""
+                self.llm_breaker.record_failure(exc)
+                from ...internals.errors import register_error
+
+                register_error(
+                    f"streamed generation failed, degraded to "
+                    f"retrieval-only: {type(exc).__name__}: {exc}",
+                    kind="serving",
+                    operator="pw_ai_answer_stream",
+                )
+                dur_ms = (_time_mod.monotonic() - t0) * 1000.0
+                record_span("llm", "llm", wall0, dur_ms, attrs={"ok": False})
+                observe_stage("llm", dur_ms)
+                return {
+                    "event": "done",
+                    "response": None,
+                    "degraded": True,
+                    "context_docs": docs,
+                }
+
+            # the FIRST pull runs decode admission: queue backpressure /
+            # deadline sheds surface as real 503 + Retry-After (the
+            # retrieval stage's contract) BEFORE headers go out, and are
+            # never charged to the LLM breaker — shed ≠ sick
+            try:
+                first_ev = await asyncio.to_thread(next, rounds_it, _SENTINEL)
+            except (AdmissionRefused, DeadlineExceeded) as exc:
+                return web.json_response(
+                    {"detail": str(exc)},
+                    status=503,
+                    headers={
+                        "Retry-After": str(getattr(exc, "retry_after_s", 1.0))
+                    },
+                )
+            except Exception as exc:  # noqa: BLE001 — degrade, don't 5xx
+                return web.json_response(_gen_failed(exc))
+            resp = web.StreamResponse(
+                status=200,
+                headers={
+                    "Content-Type": "application/x-ndjson",
+                    "Cache-Control": "no-cache",
+                },
+            )
+            await resp.prepare(request)
+
+            async def emit(obj: dict) -> None:
+                await resp.write(
+                    (_json.dumps(obj, ensure_ascii=False) + "\n").encode()
+                )
+
+            if return_docs or retrieved.get("degraded"):
+                await emit(
+                    {
+                        "event": "context",
+                        "context_docs": docs,
+                        "retrieval_degraded": bool(retrieved.get("degraded")),
+                    }
+                )
+            answer = None
+            rounds = 0
+            ev = first_ev
+            while True:
+                if ev is _SENTINEL:
+                    break
+                kind, rnd, text = ev
+                rounds = max(rounds, rnd + 1)
+                if kind == "token":
+                    try:
+                        await emit(
+                            {"event": "token", "round": rnd, "text": text}
+                        )
+                    except Exception:  # noqa: BLE001 — client went away
+                        # stop the generator (its finally cancels any
+                        # live/retained sequence) and bail quietly — the
+                        # generation path is healthy
+                        await asyncio.to_thread(rounds_it.close)
+                        return resp
+                else:
+                    answer = text
+                # ONLY the generation pull is breaker-scoped — a client-
+                # side write failure must not charge the LLM breaker
+                # (the UDF path scopes record_failure the same way); a
+                # mid-stream shed (e.g. an adaptive extend() the pool
+                # cannot grow for) degrades without a breaker charge
+                try:
+                    ev = await asyncio.to_thread(next, rounds_it, _SENTINEL)
+                except (AdmissionRefused, DeadlineExceeded):
+                    await emit(
+                        {
+                            "event": "done",
+                            "response": None,
+                            "degraded": True,
+                            "shed": True,
+                            "context_docs": docs,
+                        }
+                    )
+                    await resp.write_eof()
+                    return resp
+                except Exception as exc:  # noqa: BLE001 — degrade, don't 5xx
+                    await emit(_gen_failed(exc))
+                    await resp.write_eof()
+                    return resp
+            self.llm_breaker.record_success()
+            dur_ms = (_time_mod.monotonic() - t0) * 1000.0
+            record_span("llm", "llm", wall0, dur_ms, attrs={"ok": True})
+            observe_stage("llm", dur_ms)
+            await emit(
+                {
+                    "event": "done",
+                    "response": answer,
+                    "degraded": False,
+                    "rounds": rounds,
+                }
+            )
+            await resp.write_eof()
+            return resp
+
+        return handle
+
     # -- serving (reference: build_server/run_server) --
     def build_server(self, host: str, port: int, **rest_kwargs) -> None:
         from .servers import QASummaryRestServer
 
         self.server = QASummaryRestServer(host, port, self, **rest_kwargs)
+        from ...io.http import EndpointDocumentation
+
+        self.server.webserver.add_raw_route(
+            "/v1/pw_ai_answer_stream",
+            ("GET", "POST"),
+            self.answer_stream_handler(),
+            EndpointDocumentation(
+                summary="Ask a question, stream the answer tokens "
+                "(TPU-native paged decode)",
+                tags=["pathway"],
+            ),
+        )
 
     def run_server(self, host: str = "0.0.0.0", port: int = 8000, **kwargs):
         if self.server is None:
@@ -481,6 +858,79 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
         )
         return pw_ai_queries.with_universe_of(packed).select(result=packed.result)
 
+    def _stream_docs_k(self) -> int:
+        """Full escalation depth — the non-streaming adaptive path
+        retrieves the same amount (answer_query's max_docs)."""
+        return self.n_starting_documents * self.factor ** (
+            self.max_iterations - 1
+        )
+
+    def _stream_rounds(
+        self, lm, question: str, docs: list[str], *,
+        max_new_tokens: int, temperature: float, seed: int,
+        deadline_s: float | None,
+    ):
+        """Geometric escalation over LIVE KV blocks: round 1 prefills
+        the n_starting-docs prompt with ``retain=True``; an unanswered
+        round does NOT re-queue from scratch — :meth:`DecodeSession.extend`
+        appends only the NEW sources + re-ask to the retained sequence's
+        paged blocks, so escalation cost is the delta, not the whole
+        prompt again (pinned: prefill token counter advances once)."""
+        session = lm.paged_session()
+        eos = lm.eos_id()
+        n = self.n_starting_documents
+        handle = None
+        consumed = 0
+        try:
+            for rnd in range(self.max_iterations):
+                if handle is None:
+                    prompt = prompts.prompt_qa_geometric_rag(
+                        question, docs[:n],
+                        information_not_found_response=_NO_INFO,
+                        strict_prompt=self.strict_prompt,
+                    )
+                    handle = session.submit(
+                        lm.encode_prompt(prompt),
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature, seed=seed, eos_id=eos,
+                        deadline_s=deadline_s, retain=True,
+                    )
+                else:
+                    extra = docs[consumed:n]
+                    cont = (
+                        "\n"
+                        + "\n".join(
+                            f"Source {consumed + i + 1}: {d}"
+                            for i, d in enumerate(extra)
+                        )
+                        + f"\nQuestion: {question}\nAnswer:"
+                    )
+                    handle = session.extend(
+                        handle, lm.encode_prompt(cont),
+                        max_new_tokens=max_new_tokens,
+                    )
+                consumed = min(n, len(docs))
+                from ...generation.engine import iter_text_pieces
+
+                parts: list[str] = []
+                for piece in iter_text_pieces(handle, lm.decode_tokens, eos):
+                    parts.append(piece)
+                    yield ("token", rnd, piece)
+                answer = "".join(parts).strip()
+                if answer and answer != _NO_INFO:
+                    yield ("final", rnd, answer)
+                    return
+                if consumed >= len(docs):
+                    # no new sources left to escalate with
+                    break
+                n *= self.factor
+            yield ("final", rnd, _NO_INFO)
+        finally:
+            # cancel() covers every state: retained (normal end), still
+            # live (client abandoned the stream mid-round), queued
+            if handle is not None:
+                session.cancel(handle)
+
 
 class DeckRetriever(BaseRAGQuestionAnswerer):
     """Slide-deck retrieval app (reference: question_answering.py:736)."""
@@ -570,6 +1020,48 @@ class RAGClient(RestClientBase):
         return self._post("/v1/pw_ai_answer", payload)
 
     answer = pw_ai_answer
+
+    def pw_ai_answer_stream(
+        self,
+        prompt: str,
+        filters: str | None = None,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        seed: int = 0,
+        return_context_docs: bool = False,
+        deadline_ms: float | None = None,
+    ):
+        """Stream ``/v1/pw_ai_answer_stream`` NDJSON events as dicts
+        (``context`` / ``token`` / ``done``) as the server emits them."""
+        import json as _json
+        import urllib.request
+
+        payload: dict = {
+            "prompt": prompt,
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature,
+            "seed": seed,
+            "return_context_docs": return_context_docs,
+        }
+        if filters is not None:
+            payload["filters"] = filters
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        req = urllib.request.Request(
+            f"{self.url}/v1/pw_ai_answer_stream",
+            data=_json.dumps(payload).encode(),
+            headers={
+                "Content-Type": "application/json",
+                **self.additional_headers,
+            },
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            self.last_trace_id = resp.headers.get("x-pathway-trace-id")
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield _json.loads(line)
 
     def pw_ai_summary(self, text_list: list[str], model: str | None = None):
         payload: dict = {"text_list": text_list}
