@@ -372,6 +372,39 @@ def fire_decode_cb() -> bool:
     )
 
 
+def fire_profile() -> bool:
+    """On-demand device profiling on the real chip (ISSUE 15):
+    benchmarks/obs_overhead.py --profile-probe starts a live webserver
+    next to device KNN churn and captures one REAL /v1/debug/profile
+    window (jax.profiler trace); the banked record pins the artifact's
+    existence + size per healthy window.  Success requires
+    platform=="tpu" AND kind=="jax" — the flight-recorder fallback is
+    tier-1's job, not a chip measurement."""
+    name = "obs_overhead.py --profile-probe"
+    _log(f"running {name} (budget 300s)")
+    rc, out = _run(
+        [os.path.join(HERE, "obs_overhead.py"), "--profile-probe"], 300.0
+    )
+    ok = False
+    for line in (out or "").strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if (
+            rec.get("metric") == "device_profile"
+            and rec.get("platform") == "tpu"
+            and rec.get("kind") == "jax"
+            and rec.get("size_bytes", 0) > 0
+        ):
+            rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            with open(RESULTS, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            ok = True
+    _log(f"{name} rc={rc} tpu={ok} tail: {out[-300:]!r}")
+    return ok
+
+
 def fire_mesh() -> bool:
     """Multi-chip serving scaling on the real mesh (serving_bench.py
     --mesh 8: single-device vs 8-way-sharded serving of the same corpus;
@@ -538,6 +571,7 @@ def main() -> int:
         "tiered": False,
         "cache": False,
         "decode": False,
+        "profile": False,
     }
     fire = {
         "bench": fire_bench,
@@ -552,6 +586,7 @@ def main() -> int:
         "tiered": fire_tiered,
         "cache": fire_cache,
         "decode": fire_decode_cb,
+        "profile": fire_profile,
     }
     last_bank = None  # monotonic() of the last banked record
     any_banked = False
@@ -577,6 +612,10 @@ def main() -> int:
                     # with every healthy window too (its consolidated line
                     # goes straight into chip_results.jsonl)
                     done["ragged"] = fire_ragged()
+                    # one real device-profile window per healthy window:
+                    # the banked record pins the capture path stays alive
+                    # (existence + artifact size)
+                    done["profile"] = fire_profile()
                     if bank_chip_summary(dev):
                         last_bank = time.monotonic()
                         any_banked = True

@@ -261,6 +261,12 @@ _COUNTERS = {
 _SESSIONS: "weakref.WeakSet[DecodeSession]" = weakref.WeakSet()
 
 
+def _kv_pool_hbm_bytes(session: "DecodeSession") -> int:
+    """HBM ledger ``bytes_fn`` (module-level: the ledger's weak owner
+    ref must stay the only reference to the session)."""
+    return int(session.pool.hbm_bytes())
+
+
 def _bump(name: str, n: int = 1) -> None:
     with _MX:
         _COUNTERS[name] += n
@@ -494,9 +500,17 @@ class DecodeSession:
         self._group = None
         self.ticks_total = 0
         from ..internals.monitoring import register_metrics_provider
+        from ..observability.hbm_ledger import get_ledger
 
         _SESSIONS.add(self)
         register_metrics_provider("generation", _PROVIDER, replace=False)
+        # unified HBM ledger: the paged K/V block pools are the largest
+        # single generation allocation and must show up next to the
+        # index tiers (register_unique: same-named "decode" sessions
+        # must not collide)
+        get_ledger().register_unique(
+            f"kv_pool:{self.name}", self, _kv_pool_hbm_bytes
+        )
 
     # -- submission ------------------------------------------------------
     def submit(

@@ -206,6 +206,23 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._ring: deque[Span] = deque(maxlen=self.capacity or 1)
         self._recorded_total = 0
+        # overflow visibility: a span evicted before ANY spans() read was
+        # never observable — without a counter, drops under load are
+        # silent and a "no slow spans found" answer can be a lie.
+        # Sequence arithmetic instead of per-span flags: the oldest
+        # buffered span's append-seq is recorded_total - len(ring), and
+        # spans() advances the read watermark to recorded_total.
+        self._read_seq = 0
+        self._dropped: dict[str, int] = {}
+
+    def _note_evict_locked(self) -> None:
+        """Caller holds the lock and is about to append while full."""
+        if len(self._ring) == self.capacity and self.capacity > 0:
+            evicted = self._ring[0]
+            evict_seq = self._recorded_total - len(self._ring)
+            if evict_seq >= self._read_seq:
+                cat = evicted.category
+                self._dropped[cat] = self._dropped.get(cat, 0) + 1
 
     def record(
         self,
@@ -225,6 +242,7 @@ class FlightRecorder:
             trace_id, span_id, parent_id, attrs,
         )
         with self._lock:
+            self._note_evict_locked()
             self._ring.append(span)
             self._recorded_total += 1
 
@@ -232,6 +250,7 @@ class FlightRecorder:
         if not self.enabled:
             return
         with self._lock:
+            self._note_evict_locked()
             self._ring.append(span)
             self._recorded_total += 1
 
@@ -241,10 +260,37 @@ class FlightRecorder:
         min_duration_ms: float | None = None,
         category: str | None = None,
         limit: int | None = None,
+        mark_read: bool = True,
     ) -> list[Span]:
-        """Matching spans, oldest first (a trace reads top-down)."""
+        """Matching spans, oldest first (a trace reads top-down).
+
+        ``mark_read=False`` is for INTERNAL consumers (the profiler's
+        window export) whose read is not an operator looking at the
+        evidence — they must not advance the drop watermark, or a
+        periodic profile capture would silently zero
+        ``pathway_trace_dropped_total``."""
+        # the drop watermark advances only when the reader receives the
+        # WHOLE buffer: a filtered or limit-capped read delivers a
+        # subset, and marking the undelivered spans "read" would make
+        # pathway_trace_dropped_total undercount exactly the silent
+        # drops it exists to expose.  (The scalar watermark cannot
+        # represent a sparse read, so partial reads leave it alone —
+        # drops may overcount for a reader who filters aggressively,
+        # which is the safe direction for an alarm signal.)  The advance
+        # happens INSIDE the snapshot's lock section: a second
+        # acquisition would race record() and count spans evicted
+        # mid-serialization as dropped even though this read returns
+        # them.
+        full_read = (
+            trace_id is None
+            and min_duration_ms is None
+            and category is None
+            and limit is None
+        )
         with self._lock:
             snap = list(self._ring)
+            if mark_read and full_read:
+                self._read_seq = self._recorded_total
         out = [
             s
             for s in snap
@@ -258,11 +304,19 @@ class FlightRecorder:
 
     def stats(self) -> dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "capacity": self.capacity,
                 "recorded_total": self._recorded_total,
                 "buffered": len(self._ring),
+                "dropped_before_read_total": sum(self._dropped.values()),
             }
+            if self._dropped:
+                out["dropped_by_category"] = dict(self._dropped)
+            return out
+
+    def dropped_by_category(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._dropped)
 
     def clear(self) -> None:
         with self._lock:
@@ -398,6 +452,7 @@ class RequestTrace:
     __slots__ = (
         "trace_id", "span_id", "remote_parent", "name", "sampled",
         "start_s", "start_mono", "attrs", "_stages", "_lock", "_finished",
+        "duration_ms",
     )
 
     def __init__(
@@ -419,6 +474,10 @@ class RequestTrace:
         self._stages: list[tuple[str, float, float]] = []
         self._lock = threading.Lock()
         self._finished = False
+        #: total request latency, set by finish() even when unsampled —
+        #: the SLO engine observes latency for EVERY request, tracing
+        #: sample rate only decides whether stage spans are collected
+        self.duration_ms: float | None = None
 
     # -- stage recording -------------------------------------------------
     def _mono_to_wall(self, mono: float) -> float:
@@ -459,6 +518,7 @@ class RequestTrace:
             return
         self._finished = True
         duration_ms = (time.monotonic() - self.start_mono) * 1000.0
+        self.duration_ms = duration_ms
         if status is not None:
             self.attrs["http.status"] = status
         if not self.sampled:
@@ -838,6 +898,18 @@ def observability_metrics_lines() -> list[str]:
     lines.append(
         f"pathway_flight_recorder_spans_total {rec.stats()['recorded_total']}"
     )
+    # ring-overflow visibility: spans evicted before any read, per
+    # category — the "did we silently drop the evidence" counter
+    dropped = rec.dropped_by_category()
+    lines.append("# TYPE pathway_trace_dropped_total counter")
+    if dropped:
+        for cat in sorted(dropped):
+            lines.append(
+                f'pathway_trace_dropped_total{{category="'
+                f'{escape_label_value(cat)}"}} {dropped[cat]}'
+            )
+    else:
+        lines.append("pathway_trace_dropped_total 0")
     ing = ingest_stats()
     lines.append("# TYPE pathway_ingest_docs_total counter")
     lines.append(f"pathway_ingest_docs_total {ing['docs_total']}")

@@ -40,6 +40,25 @@ from typing import Any
 __all__ = ["HealthRegistry", "get_health", "reset_health"]
 
 
+def _attach_module_block(
+    snap: dict, key: str, module_name: str, fn_name: str
+) -> None:
+    """Read-only status block gated on ``module_name`` ALREADY being
+    imported — a health probe must never pull in jax state just by
+    probing, and a subsystem that was never used contributes nothing.
+    Any failure is swallowed: health must never raise."""
+    try:
+        import sys as _sys
+
+        mod = _sys.modules.get(module_name)
+        if mod is not None:
+            block = getattr(mod, fn_name)()
+            if block:
+                snap[key] = block
+    except Exception:  # noqa: BLE001 — health must never raise
+        pass
+
+
 class HealthRegistry:
     """Thread-safe component/heartbeat registry (see module docstring)."""
 
@@ -205,74 +224,44 @@ class HealthRegistry:
                 snap["runtime"] = runtime_stats
         except Exception:  # noqa: BLE001 — health must never raise
             pass
-        # multi-chip serving: mesh shape + per-shard row counts of every
-        # live sharded index — read-only and gated on the module already
-        # being imported (a health probe must never pull in jax state)
-        try:
-            import sys as _sys
-
-            mod = _sys.modules.get("pathway_tpu.parallel.index")
-            if mod is not None:
-                mesh = mod.mesh_status()
-                if mesh:
-                    snap["mesh"] = mesh
-        except Exception:  # noqa: BLE001 — health must never raise
-            pass
-        # index quantization: storage dtype, HBM footprint and rescore
-        # configuration of every live device index — read-only and gated
-        # on ops/knn already being imported (a health probe never pulls
-        # in jax state)
-        try:
-            import sys as _sys
-
-            mod = _sys.modules.get("pathway_tpu.ops.knn")
-            if mod is not None:
-                quant = mod.quantization_status()
-                if quant:
-                    snap["quantization"] = quant
-        except Exception:  # noqa: BLE001 — health must never raise
-            pass
-        # tiered index: per-tier row counts, migration counters, probe
-        # configuration of every live tiered index — read-only and gated
-        # on the module already being imported (a health probe never
-        # pulls in jax state)
-        try:
-            import sys as _sys
-
-            mod = _sys.modules.get("pathway_tpu.tiering.index")
-            if mod is not None:
-                tiering = mod.tiering_status()
-                if tiering:
-                    snap["tiering"] = tiering
-        except Exception:  # noqa: BLE001 — health must never raise
-            pass
-        # serving query cache: per-plane cache configuration + process
-        # hit/miss/stale counters — read-only and gated on the module
-        # already being imported (a health probe never pulls in jax)
-        try:
-            import sys as _sys
-
-            mod = _sys.modules.get("pathway_tpu.xpacks.llm._query_cache")
-            if mod is not None:
-                qcache = mod.query_cache_status()
-                if qcache:
-                    snap["query_cache"] = qcache
-        except Exception:  # noqa: BLE001 — health must never raise
-            pass
-        # paged-KV decode: live sequences, block-pool occupancy and
-        # generation counters across every DecodeSession — read-only and
-        # gated on the module already being imported (a health probe
-        # never pulls in jax)
-        try:
-            import sys as _sys
-
-            mod = _sys.modules.get("pathway_tpu.generation.engine")
-            if mod is not None:
-                gen = mod.generation_status()
-                if gen:
-                    snap["generation"] = gen
-        except Exception:  # noqa: BLE001 — health must never raise
-            pass
+        # sys.modules-gated subsystem blocks (see _attach_module_block):
+        # mesh shape/shard rows, quantization dtype/footprint, tiered
+        # rows/migrations, serving query-cache counters, SLO burn-rate
+        # verdicts (the middleware imports slo on the first request — a
+        # bare probe never mints empty series), the capacity payload a
+        # least-loaded fleet router places load on (HBM ledger totals +
+        # free HBM + runtime occupancy, ROADMAP item 4), and paged-KV
+        # generation counters
+        _attach_module_block(
+            snap, "mesh", "pathway_tpu.parallel.index", "mesh_status"
+        )
+        _attach_module_block(
+            snap, "quantization", "pathway_tpu.ops.knn", "quantization_status"
+        )
+        _attach_module_block(
+            snap, "tiering", "pathway_tpu.tiering.index", "tiering_status"
+        )
+        _attach_module_block(
+            snap,
+            "query_cache",
+            "pathway_tpu.xpacks.llm._query_cache",
+            "query_cache_status",
+        )
+        _attach_module_block(
+            snap, "slo", "pathway_tpu.observability.slo", "slo_status"
+        )
+        _attach_module_block(
+            snap,
+            "capacity",
+            "pathway_tpu.observability.hbm_ledger",
+            "capacity_status",
+        )
+        _attach_module_block(
+            snap,
+            "generation",
+            "pathway_tpu.generation.engine",
+            "generation_status",
+        )
         try:
             from ..testing import faults
 

@@ -111,6 +111,44 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "spans recorded into the in-process ring buffer",
     ),
+    "pathway_trace_dropped_total": (
+        "counter",
+        "spans evicted from the flight-recorder ring before any read — "
+        'nonzero means a "no slow spans found" answer may be a lie',
+    ),
+    # observability plane (pathway_tpu/observability/) — the unified HBM
+    # ledger; every series carries a component label, shard optional
+    "pathway_hbm_bytes": (
+        "gauge",
+        "device-resident bytes per registered allocation (component=, shard=)",
+    ),
+    "pathway_hbm_total_bytes": (
+        "gauge",
+        "sum of every ledger-attributed device allocation in this process",
+    ),
+    "pathway_hbm_unattributed_bytes": (
+        "gauge",
+        "device bytes_in_use minus the attributed total, emitted only while "
+        "drift exceeds PATHWAY_HBM_DRIFT_FRAC (TPU reconcile)",
+    ),
+    # SLO engine (pathway_tpu/observability/slo.py) — endpoint label on
+    # the histogram; burn gauges carry slo/objective/window labels
+    "pathway_endpoint_latency_ms": (
+        "histogram",
+        "per-endpoint request latency with trace-id exemplars on buckets",
+    ),
+    "pathway_slo_burn_rate": (
+        "gauge",
+        "error-budget burn rate per SLO/objective/window (SRE workbook: "
+        "both windows >= 14.4 means the budget is burning)",
+    ),
+    # end-to-end freshness (io/streaming.py read-time stamps through
+    # internals/monitoring.py) — connector label
+    "pathway_freshness_seconds": (
+        "gauge",
+        "connector read-time -> queryable lag, end to end per connector "
+        "(the index-level freshness gauge is one stage of this)",
+    ),
     # data freshness (internals/monitoring.py + stdlib/indexing/lowering.py)
     "pathway_index_freshness_seconds": (
         "gauge",

@@ -32,6 +32,7 @@ __all__ = [
     "start_http_server_thread",
     "MonitoringLevel",
     "register_metrics_provider",
+    "register_metrics_provider_once",
     "FreshnessTracker",
     "get_freshness",
 ]
@@ -61,6 +62,28 @@ def register_metrics_provider(
     if not replace and _metrics_providers.get(name) is not None:
         return
     _metrics_providers[name] = provider
+
+
+#: strong refs for providers registered via the once-helper (the table
+#: above is weak-valued, so an unheld provider would vanish before its
+#: first scrape)
+_strong_providers: dict[str, Any] = {}
+_strong_providers_lock = threading.Lock()
+
+
+def register_metrics_provider_once(name: str, factory: Any) -> Any:
+    """Idempotent, strong-ref provider registration — the shared form of
+    the ``_provider`` / ``_provider_lock`` / ``_ensure_provider``
+    boilerplate every metrics-emitting module used to copy.  ``factory``
+    is called once, the instance is held strongly here for the process
+    lifetime (exactly what the per-module globals did), and repeated
+    calls return the existing instance."""
+    with _strong_providers_lock:
+        provider = _strong_providers.get(name)
+        if provider is None:
+            provider = _strong_providers[name] = factory()
+            register_metrics_provider(name, provider)
+        return provider
 
 
 #: flush-latency histogram bucket upper bounds (milliseconds)
@@ -289,6 +312,16 @@ class FreshnessTracker:
         self._ingest_order: deque[tuple[int, int]] = deque()
         #: index name -> (last observed lag seconds, observed wall time)
         self._lag: dict[str, tuple[float, float]] = {}
+        #: (scope, engine_time) -> {connector label: earliest READ wall}
+        #: — the end-to-end half: connectors stamp when the row was READ
+        #: from the source (io/streaming.py ``_push``), not when the
+        #: driver pushed the batch, so the freshness SLO covers
+        #: parse→split→embed→upsert→commit including connector-side
+        #: batching delay
+        self._source_read: dict[tuple[int, int], dict[str, float]] = {}
+        self._source_order: deque[tuple[int, int]] = deque()
+        #: connector label -> (end-to-end lag seconds, observed wall)
+        self._source_lag: dict[str, tuple[float, float]] = {}
 
     def note_ingest(
         self, engine_time: int, wall_time: float | None = None, scope: int = 0
@@ -304,12 +337,38 @@ class FreshnessTracker:
             while len(self._ingest_order) > self.MAX_PENDING:
                 self._ingest_wall.pop(self._ingest_order.popleft(), None)
 
+    def note_source(
+        self,
+        connector: str,
+        engine_time: int,
+        read_wall: float,
+        scope: int = 0,
+    ) -> None:
+        """Stamp the earliest connector READ time contributing to
+        ``engine_time`` — the start of the end-to-end freshness span
+        (``pathway_freshness_seconds{connector=}``).  Earliest wins, as
+        with :meth:`note_ingest`."""
+        key = (scope, engine_time)
+        with self._lock:
+            per_conn = self._source_read.get(key)
+            if per_conn is None:
+                per_conn = self._source_read[key] = {}
+                self._source_order.append(key)
+                while len(self._source_order) > self.MAX_PENDING:
+                    self._source_read.pop(self._source_order.popleft(), None)
+            prev = per_conn.get(connector)
+            if prev is None or read_wall < prev:
+                per_conn[connector] = read_wall
+
     def note_indexed(
         self, index_name: str, engine_time: int, scope: int = 0
     ) -> float | None:
         """Record that ``index_name`` applied the updates of
         ``engine_time``; returns the observed lag (None when the
-        timestamp was never stamped — static/batch data)."""
+        timestamp was never stamped — static/batch data).  Also closes
+        the END-TO-END loop per connector: read-time stamps for this
+        timestamp become ``pathway_freshness_seconds{connector=}``
+        observations and feed the freshness SLO burn windows."""
         now = time.time()
         with self._lock:
             wall = self._ingest_wall.get((scope, engine_time))
@@ -317,26 +376,73 @@ class FreshnessTracker:
                 return None
             lag = max(0.0, now - wall)
             self._lag[index_name] = (lag, now)
-            return lag
+            # CONSUME the read stamps: the end-to-end lag closes when the
+            # timestamp FIRST becomes queryable — without the pop, a
+            # pipeline with k index nodes would feed the freshness burn
+            # ring k times per ingest batch (k−1 of them fresh), diluting
+            # a stale connector's bad fraction k-fold and flapping the
+            # gauge to whichever index flushed last.  Per-index staleness
+            # stays on pathway_index_freshness_seconds{index=}.
+            sources = self._source_read.pop((scope, engine_time), None) or {}
+            for connector, read_wall in sources.items():
+                self._source_lag[connector] = (max(0.0, now - read_wall), now)
+        # burn-rate treatment (observability/slo.py) — lazy and fail-open:
+        # freshness accounting must never take down an index flush
+        if sources:
+            try:
+                from ..observability import slo
+
+                for connector, read_wall in sources.items():
+                    slo.observe_freshness(connector, max(0.0, now - read_wall))
+            except Exception:  # noqa: BLE001
+                pass
+        return lag
 
     def stats(self) -> dict[str, Any]:
+        """Per-INDEX lag view (shape unchanged since PR 4 — consumers
+        iterate it; the per-connector end-to-end view lives in
+        :meth:`connector_stats`)."""
         with self._lock:
             return {
                 name: {"lag_s": round(lag, 6), "age_s": round(time.time() - at, 3)}
                 for name, (lag, at) in self._lag.items()
             }
 
+    def connector_stats(self) -> dict[str, Any]:
+        """End-to-end (connector read → queryable) lag per connector."""
+        with self._lock:
+            return {
+                name: {
+                    "lag_s": round(lag, 6),
+                    "age_s": round(time.time() - at, 3),
+                }
+                for name, (lag, at) in self._source_lag.items()
+            }
+
+    def connector_lags(self) -> dict[str, float]:
+        """Latest end-to-end (read→queryable) lag per connector."""
+        with self._lock:
+            return {name: lag for name, (lag, _at) in self._source_lag.items()}
+
     def openmetrics_lines(self) -> list[str]:
         with self._lock:
             items = sorted(self._lag.items())
-        if not items:
-            return []
-        lines = ["# TYPE pathway_index_freshness_seconds gauge"]
-        for name, (lag, _at) in items:
-            lines.append(
-                f'pathway_index_freshness_seconds{{index="{escape_label_value(name)}"}} '
-                f"{lag:.6f}"
-            )
+            sources = sorted(self._source_lag.items())
+        lines: list[str] = []
+        if items:
+            lines.append("# TYPE pathway_index_freshness_seconds gauge")
+            for name, (lag, _at) in items:
+                lines.append(
+                    f'pathway_index_freshness_seconds{{index="{escape_label_value(name)}"}} '
+                    f"{lag:.6f}"
+                )
+        if sources:
+            lines.append("# TYPE pathway_freshness_seconds gauge")
+            for name, (lag, _at) in sources:
+                lines.append(
+                    f'pathway_freshness_seconds{{connector="{escape_label_value(name)}"}} '
+                    f"{lag:.6f}"
+                )
         return lines
 
     def reset(self) -> None:
@@ -344,6 +450,9 @@ class FreshnessTracker:
             self._ingest_wall.clear()
             self._ingest_order.clear()
             self._lag.clear()
+            self._source_read.clear()
+            self._source_order.clear()
+            self._source_lag.clear()
 
 
 #: process-global: the driver and the index nodes live in different layers

@@ -80,6 +80,20 @@ class PathwayWebserver:
                 raise RuntimeError("cannot add routes after the server started")
             self._routes.append((route, methods, handler))
             entry: dict[str, Any] = {}
+            # SLO discoverability: the exact env knob names that put this
+            # route under burn-rate evaluation ride the OpenAPI entry, so
+            # `curl /_schema` answers "what do I export to SLO this
+            # endpoint" without reading the docs
+            try:
+                from ...observability.slo import endpoint_env_key
+
+                key = endpoint_env_key(route)
+                slo_knobs = [
+                    f"PATHWAY_SLO_{key}_P99_MS",
+                    f"PATHWAY_SLO_{key}_AVAIL",
+                ]
+            except Exception:  # noqa: BLE001 — schema must never fail a route add
+                slo_knobs = []
             for m in methods:
                 entry[m.lower()] = {
                     "summary": getattr(doc, "summary", None) or route,
@@ -87,6 +101,8 @@ class PathwayWebserver:
                     "tags": list(getattr(doc, "tags", []) or []),
                     "responses": {"200": {"description": "OK"}},
                 }
+                if slo_knobs:
+                    entry[m.lower()]["x-pathway-slo-knobs"] = slo_knobs
             self._openapi_routes[route] = entry
 
     def openapi_description_json(self) -> dict:
@@ -130,24 +146,49 @@ class PathwayWebserver:
                 request.headers.get("traceparent"),
             )
             request["pw_trace"] = trace
+
+            def observe_slo(status: int | None) -> None:
+                """Feed the SLO engine for EVERY finished request —
+                latency observation is independent of trace sampling,
+                and the trace id becomes the histogram exemplar linking
+                a burning bucket to /v1/debug/traces."""
+                try:
+                    from ...observability import slo
+
+                    slo.observe_request(
+                        request.path,
+                        trace.duration_ms or 0.0,
+                        status,
+                        # exemplars must link to traces that EXIST: an
+                        # unsampled request records no spans, so its id
+                        # would dead-end in /v1/debug/traces
+                        trace.trace_id if trace.sampled else None,
+                    )
+                except Exception:  # noqa: BLE001 — SLOs must never fail a request
+                    pass
+
             try:
                 resp = await handler(request)
             except web.HTTPException as exc:
                 exc.headers["x-pathway-trace-id"] = trace.trace_id
                 trace.finish(status=exc.status)
+                observe_slo(exc.status)
                 raise
             except asyncio.CancelledError:
                 # client went away mid-request — no response was sent, so
                 # recording a 500 would plant phantom errors in the trace
-                # dump during load spikes
+                # dump during load spikes (and the SLO engine skips it:
+                # an aborted client is not a server availability event)
                 trace.set_attr("cancelled", True)
                 trace.finish()
                 raise
             except BaseException:
                 trace.finish(status=500)
+                observe_slo(500)
                 raise
             resp.headers["x-pathway-trace-id"] = trace.trace_id
             trace.finish(status=resp.status)
+            observe_slo(resp.status)
             return resp
 
         @web.middleware
@@ -220,7 +261,13 @@ class PathwayWebserver:
             q = request.query
             try:
                 min_ms = float(q["min_ms"]) if "min_ms" in q else None
-                limit = int(q.get("limit", "1000"))
+                # default: the WHOLE ring (it is already bounded by
+                # PATHWAY_FLIGHT_RECORDER_CAPACITY).  A sub-ring default
+                # would silently truncate every read once the ring fills,
+                # and truncated reads deliberately do not clear the
+                # dropped-before-read watermark — the drop alarm would
+                # then read permanently hot under steady load
+                limit = int(q["limit"]) if "limit" in q else None
             except (TypeError, ValueError):
                 return web.json_response(
                     {"detail": "min_ms/limit must be numeric"}, status=400
@@ -241,10 +288,55 @@ class PathwayWebserver:
                 }
             )
 
+        async def debug_profile_handler(request):
+            """On-demand device profiling: capture a ``?ms=`` trace
+            window (``jax.profiler`` on TPU, flight-recorder Perfetto
+            export elsewhere) and serve the artifact.  Single-flight —
+            409 while a capture is running; 503 when
+            ``PATHWAY_PROFILE_DIR=off``.  The capture sleeps through the
+            window off the event loop, so concurrent serving requests
+            are untouched (that is the point: profile the LIVE load)."""
+            from ...observability import profiler
+
+            import math
+
+            try:
+                ms = float(request.query.get("ms", "500"))
+            except (TypeError, ValueError):
+                ms = float("nan")
+            if not math.isfinite(ms):
+                # nan/inf parse as floats but would blow up the sleep —
+                # they are the caller's mistake, not a 500
+                return web.json_response(
+                    {"detail": "ms must be a finite number"}, status=400
+                )
+            try:
+                res = await asyncio.to_thread(profiler.capture, ms)
+            except profiler.ProfileInFlight as exc:
+                return web.json_response({"detail": str(exc)}, status=409)
+            except profiler.ProfilerDisabled as exc:
+                return web.json_response({"detail": str(exc)}, status=503)
+            # FileResponse streams the artifact in chunks off disk — a
+            # TPU trace zip can be tens of MB, and a blocking whole-file
+            # read here would stall the very serving traffic being
+            # profiled (content type comes from the extension:
+            # .json = flight-recorder export, .zip = jax trace)
+            return web.FileResponse(
+                res["path"],
+                headers={
+                    "x-pathway-profile-kind": res["kind"],
+                    "x-pathway-profile-ms": f'{res["duration_ms"]:g}',
+                    "x-pathway-profile-path": res["path"],
+                },
+            )
+
         if not any(route == "/v1/health" for route, _, _ in self._routes):
             app.router.add_get("/v1/health", health_handler)
         if not any(route == "/v1/debug/traces" for route, _, _ in self._routes):
             app.router.add_get("/v1/debug/traces", debug_traces_handler)
+        if not any(route == "/v1/debug/profile" for route, _, _ in self._routes):
+            app.router.add_get("/v1/debug/profile", debug_profile_handler)
+            app.router.add_post("/v1/debug/profile", debug_profile_handler)
         if self.with_cors:
 
             @web.middleware

@@ -128,6 +128,15 @@ class ConnectorSubject:
         #: without it the frontier snapshot in commit() is never consumed,
         #: so the (possibly large) current_offsets() copy is skipped
         self._record_offsets = False
+        # end-to-end freshness stamps (pathway_freshness_seconds): the
+        # wall clock of the FIRST row read into the current pending
+        # batch, carried through commit() and _drain() so the driver can
+        # hand the earliest read time of each engine timestamp to the
+        # freshness tracker — measuring from source READ, not from the
+        # driver push, covers connector-side batching delay too
+        self._pending_read_wall: float | None = None
+        self._committed_read_walls: list[float] = []
+        self._read_wall_at_drain: float | None = None
 
     # -- to be implemented by subclasses --
     def run(self) -> None:
@@ -197,6 +206,9 @@ class ConnectorSubject:
             if self._pending:
                 self._committed.append(self._pending)
                 self._pending = []
+                if self._pending_read_wall is not None:
+                    self._committed_read_walls.append(self._pending_read_wall)
+                    self._pending_read_wall = None
             # every connector updates its offsets before its own commit()
             # (fs: _seen per emitted file; kafka: per consumed message),
             # so this snapshot is exactly the frontier of the batches
@@ -261,6 +273,8 @@ class ConnectorSubject:
             if faults.perturb(self._fault_site) == "drop":
                 return
         with self._lock:
+            if not self._pending:
+                self._pending_read_wall = _time.time()
             self._pending.append((op, key, values))
 
     def _configure(self, schema, primary_key: list[str] | None) -> None:
@@ -279,6 +293,10 @@ class ConnectorSubject:
             # pair the batch with the frontier of its last commit — a
             # commit landing after this point belongs to the NEXT drain
             self._offsets_at_drain = self._offsets_at_commit
+            # earliest read time across the drained batches: the start of
+            # the end-to-end freshness span for this engine timestamp
+            walls, self._committed_read_walls = self._committed_read_walls, []
+            self._read_wall_at_drain = min(walls) if walls else None
         entries: list[Entry] = []
         for batch in batches:
             for op, key, values in batch:
@@ -1084,6 +1102,15 @@ class StreamingDriver:
             # (pathway_index_freshness_seconds).  Scoped by engine id —
             # timestamps restart per engine
             get_freshness().note_ingest(t, now, scope=id(self.engine))
+            # end-to-end variant: the earliest CONNECTOR READ time of the
+            # drained batches — closes as
+            # pathway_freshness_seconds{connector=} when the index
+            # applies timestamp t (read→parse→split→embed→upsert→commit)
+            read_wall = getattr(subject, "_read_wall_at_drain", None)
+            if read_wall is not None:
+                get_freshness().note_source(
+                    label, t, read_wall, scope=id(self.engine)
+                )
 
     def _record_finished_connectors(self) -> None:
         monitor = getattr(self.engine, "monitor", None)

@@ -340,6 +340,14 @@ def decode_step_chunk() -> int:
 _PROMPT_BUCKETS = (32, 64, 128, 256, 512, 1024)
 
 
+def _decoder_params_nbytes(lm: "CausalLM") -> int:
+    """HBM ledger ``bytes_fn`` (module-level: the weak owner ref must
+    stay the only reference to the model)."""
+    from ..observability.hbm_ledger import tree_nbytes
+
+    return tree_nbytes(lm.params)
+
+
 class CausalLM:
     """Host-facing generator: tokenize, bucket, jit-generate, detokenize.
 
@@ -401,6 +409,15 @@ class CausalLM:
         #: (pathway_tpu.generation) — the serving-shaped decode path
         self._paged_session: Any = None
         self._paged_lock = _threading_mod.Lock()
+        # unified HBM ledger: decoder weights sit in HBM next to the KV
+        # pools they feed — register so the capacity block sums them
+        from ..observability.hbm_ledger import get_ledger
+
+        get_ledger().register_unique(
+            f"decoder_params:{model_name or 'custom'}",
+            self,
+            _decoder_params_nbytes,
+        )
 
     def logits(self, ids) -> jax.Array:
         """Full-sequence logits (scoring path)."""

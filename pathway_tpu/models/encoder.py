@@ -848,6 +848,14 @@ def bucketed_dispatch(
     return np.concatenate(outs, axis=0)
 
 
+def _encoder_params_nbytes(enc: "SentenceEncoder") -> int:
+    """HBM ledger ``bytes_fn`` (module-level: the weak owner ref must
+    stay the only reference to the encoder)."""
+    from ..observability.hbm_ledger import tree_nbytes
+
+    return tree_nbytes(enc.params)
+
+
 class SentenceEncoder:
     """Host-facing embedder: tokenization + bucketed jit dispatch.
 
@@ -960,6 +968,17 @@ class SentenceEncoder:
         )
 
         record_attention_impl(self.cfg.attention_impl)
+        # unified HBM ledger: the parameter tree is device-resident from
+        # first apply — register it next to the index/KV allocations so
+        # the process total is honest (sharded params report their
+        # GLOBAL logical bytes; the ledger documents that convention)
+        from ..observability.hbm_ledger import get_ledger
+
+        get_ledger().register_unique(
+            f"encoder_params:{model_name or 'custom'}",
+            self,
+            _encoder_params_nbytes,
+        )
         self._apply = instrument_jit(jax.jit(self._forward), "encoder.forward")
         # packed ragged forward: same params, concatenated-token layout —
         # built unconditionally (construction is free until first trace)

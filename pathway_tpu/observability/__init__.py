@@ -1,0 +1,39 @@
+"""Production observability plane (ISSUE 15).
+
+Four pillars, all riding the existing flight-recorder/metrics
+discipline (families declared in ``internals/metrics_names.py``,
+weak-registry providers on ``/status``, gated blocks on ``/v1/health``,
+health probes never import jax):
+
+* :mod:`~pathway_tpu.observability.hbm_ledger` — ONE process-wide
+  registry of device-resident allocations.  Every HBM-holding subsystem
+  (KNN indexes + their staged-scatter debt, sharded shards, tiered
+  routers, paged-KV block pools, encoder/decoder param trees) registers
+  a named entry; the ledger emits ``pathway_hbm_bytes{component=,shard=}``
+  plus a process total, reconciled against the device runtime's
+  ``memory_stats()`` when the backend exposes it.
+* :mod:`~pathway_tpu.observability.slo` — per-endpoint latency
+  histograms with OpenMetrics *exemplars* (a burning p99 bucket links
+  straight to ``/v1/debug/traces?trace_id=``), SLO targets from
+  ``PATHWAY_SLO_*`` knobs, multi-window burn rates (fast/slow, Google
+  SRE workbook semantics) and ``ok|warn|burning`` verdicts on
+  ``/v1/health`` — the payload a fleet router places load on.
+* freshness SLO — connector read-time stamped through
+  parse→split→embed→upsert→commit (``io/streaming.py`` +
+  ``internals/monitoring.py``) so ``pathway_freshness_seconds``
+  measures ingest→queryable lag end to end per connector, with the
+  same burn-rate treatment.
+* :mod:`~pathway_tpu.observability.profiler` — on-demand device
+  profiling (``GET/POST /v1/debug/profile?ms=``): a bounded-spool
+  ``jax.profiler`` trace window on TPU, a pure flight-recorder Perfetto
+  export everywhere else; single-flight, capped duration.
+
+Import discipline: every module here is stdlib-only at import time
+(plus the :mod:`internals.metrics_names` leaf) — jax is touched only
+behind ``sys.modules`` gates, so health probes and metric scrapes never
+initialize a device runtime.
+"""
+
+from __future__ import annotations
+
+__all__ = ["hbm_ledger", "slo", "profiler"]

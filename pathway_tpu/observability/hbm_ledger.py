@@ -1,0 +1,391 @@
+"""Unified HBM ledger: one registry for every device-resident byte.
+
+Before this module each subsystem reported its HBM footprint
+independently (``pathway_index_hbm_bytes``, the tiering/generation
+status blocks, ...) with no total and no reconciliation — an operator
+sizing corpus-per-chip had to add four gauges by hand and still could
+not see staged-scatter debt or parameter trees.  Now every
+device-resident subsystem registers a named allocation here:
+
+* ``DeviceKnnIndex`` matrix/codes/scales/rescore-ring (+ a separate
+  ``knn_staged:*`` entry for device-staged scatter debt),
+* ``ShardedKnnIndex`` per-shard (the ``shard`` label),
+* the tiered index's router centroid matrix (its hot tier is itself a
+  ``DeviceKnnIndex`` and registers through that path — no double count),
+* paged-KV block pools (``kv_pool:*``),
+* encoder/decoder parameter trees (``encoder_params:*`` /
+  ``decoder_params:*``).
+
+The ledger emits ``pathway_hbm_bytes{component=,shard=}`` plus
+``pathway_hbm_total_bytes`` and, when the device runtime exposes
+``memory_stats()`` (TPU), reconciles the attributed total against the
+device's ``bytes_in_use``: drift beyond ``PATHWAY_HBM_DRIFT_FRAC``
+(default 0.15) flags an ``unattributed`` component LOUDLY (log + metric
++ health block).  Off-TPU the ledger is exact by construction — every
+entry reads the owning subsystem's own ``hbm_bytes()`` — and the
+reconcile is skipped.
+
+Entries hold a WEAK reference to their owner plus a pure function
+``bytes_fn(owner) -> int | dict[shard_label, int]``: a collected index
+drops out of the ledger with its owner, and registering can never
+extend an owner's lifetime.  Import discipline: stdlib only; jax is
+touched exclusively behind a ``sys.modules`` gate inside
+:func:`device_memory_view` (health probes never initialize a backend).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import sys
+import threading
+import weakref
+from typing import Any, Callable
+
+from ..internals.config import env_float as _env_float
+
+__all__ = [
+    "HbmLedger",
+    "get_ledger",
+    "reset_ledger",
+    "hbm_status",
+    "capacity_status",
+    "device_memory_view",
+]
+
+logger = logging.getLogger("pathway_tpu")
+
+
+def drift_frac() -> float:
+    """``PATHWAY_HBM_DRIFT_FRAC``: reconcile tolerance as a fraction of
+    the device's ``bytes_in_use`` (default 0.15 — XLA scratch, compiled
+    executables and allocator slack legitimately sit outside any
+    subsystem's ledger entry)."""
+    return max(0.0, _env_float("PATHWAY_HBM_DRIFT_FRAC", 0.15))
+
+
+class HbmLedger:
+    """Process-wide registry of named device allocations.
+
+    ``register`` returns a token for explicit :meth:`release`; entries
+    also vanish automatically when their (weakly-held) owner is
+    collected.  ``bytes_fn`` is called at snapshot time so entries track
+    live growth (capacity doublings, pool swaps) with zero bookkeeping
+    at the allocation site."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: token -> (component, weakref(owner), bytes_fn)
+        self._entries: dict[int, tuple[str, weakref.ref, Callable]] = {}
+        self._seq = itertools.count()
+        #: sticky reconcile flag: flips are logged once per transition,
+        #: not once per scrape
+        self._drift_flagged = False
+        #: size trigger for the in-register dead-entry sweep (doubles
+        #: after each sweep so churn-heavy registration stays O(1)
+        #: amortized)
+        self._sweep_at = 64
+
+    def register(
+        self, component: str, owner: Any, bytes_fn: Callable[[Any], Any]
+    ) -> int:
+        """Add one named allocation.  ``bytes_fn(owner)`` must return an
+        ``int`` (single allocation) or a ``dict[shard_label, int]``
+        (per-shard breakdown; the labels become the ``shard`` label on
+        the emitted series).
+
+        Deliberately NO weakref callback: a finalizer firing from
+        cyclic GC mid-``register``/``entries`` would re-enter this
+        non-reentrant lock on the same thread and deadlock the scrape.
+        Dead entries are skipped at snapshot time and swept there."""
+        return self._register(owner, bytes_fn, lambda _t: str(component))
+
+    def register_unique(
+        self, prefix: str, owner: Any, bytes_fn: Callable[[Any], Any]
+    ) -> int:
+        """:meth:`register` with a process-unique ``#<seq>`` label
+        suffix — for registrants whose natural name can repeat (two
+        default-named decode sessions, two encoders of one checkpoint):
+        duplicate identical-label series would make the whole
+        OpenMetrics exposition invalid, and every module re-growing its
+        own counter for this was the same idiom copied three times."""
+        return self._register(owner, bytes_fn, lambda t: f"{prefix}#{t}")
+
+    def _register(
+        self, owner: Any, bytes_fn: Callable[[Any], Any], label_fn: Callable
+    ) -> int:
+        token = next(self._seq)
+        ref = weakref.ref(owner)
+        with self._lock:
+            self._entries[token] = (label_fn(token), ref, bytes_fn)
+            # size-triggered sweep: snapshot surfaces also sweep, but a
+            # headless process that churns owners WITHOUT ever being
+            # scraped must not accumulate dead tuples unboundedly
+            if len(self._entries) >= self._sweep_at:
+                for t in [
+                    t
+                    for t, (_c, r, _f) in self._entries.items()
+                    if r() is None
+                ]:
+                    del self._entries[t]
+                self._sweep_at = max(64, 2 * len(self._entries))
+        _ensure_provider()
+        return token
+
+    def release(self, token: int) -> None:
+        with self._lock:
+            self._entries.pop(token, None)
+
+    # -- snapshots -------------------------------------------------------
+    def entries(self) -> list[tuple[str, str | None, int]]:
+        """``(component, shard, bytes)`` rows over every live entry,
+        sorted for stable exposition.  A ``bytes_fn`` that raises drops
+        that entry from the snapshot (never from the ledger — a
+        transient failure must not unregister the owner) rather than
+        failing the scrape."""
+        with self._lock:
+            snap = list(self._entries.items())
+        rows: list[tuple[str, str | None, int]] = []
+        dead: list[int] = []
+        for token, (component, ref, fn) in snap:
+            owner = ref()
+            if owner is None:
+                dead.append(token)
+                continue
+            try:
+                val = fn(owner)
+            except Exception:  # noqa: BLE001 — a dying owner must not kill /status
+                continue
+            if isinstance(val, dict):
+                for shard, b in val.items():
+                    rows.append((component, str(shard), int(b)))
+            else:
+                rows.append((component, None, int(val)))
+        if dead:
+            # sweep collected owners here, NOT via weakref finalizers —
+            # see register() for why
+            with self._lock:
+                for token in dead:
+                    self._entries.pop(token, None)
+        rows.sort(key=lambda r: (r[0], r[1] or ""))
+        return rows
+
+    def total_bytes(self) -> int:
+        return sum(b for _, _, b in self.entries())
+
+    def reconcile(self, attributed: int | None = None) -> dict[str, Any] | None:
+        """Compare the attributed total against the device runtime's own
+        accounting.  ``None`` when the backend exposes no memory stats
+        (CPU/interpret — the ledger is exact by construction there).
+        Drift beyond ``PATHWAY_HBM_DRIFT_FRAC`` flags ``unattributed``
+        loudly; re-converging logs the all-clear once.  Callers that
+        already walked the entries pass ``attributed`` so a scrape runs
+        every ``bytes_fn`` (param-tree walks included) once, not twice."""
+        view = device_memory_view()
+        if view is None:
+            return None
+        if attributed is None:
+            attributed = self.total_bytes()
+        in_use = int(view["bytes_in_use"])
+        unattributed = max(0, in_use - attributed)
+        frac = unattributed / max(in_use, 1)
+        flagged = frac > drift_frac()
+        with self._lock:
+            # check-then-set under the lock: concurrent /status and
+            # /v1/health probes crossing the threshold together must log
+            # the transition once, as the docstring promises
+            transition = flagged != self._drift_flagged
+            self._drift_flagged = flagged
+        if transition:
+            if flagged:
+                logger.warning(
+                    "HBM ledger drift: device reports %d bytes in use but "
+                    "only %d are attributed (unattributed %d = %.1f%% > "
+                    "PATHWAY_HBM_DRIFT_FRAC=%.2f) — a device-resident "
+                    "allocation is missing its ledger registration",
+                    in_use, attributed, unattributed, 100 * frac, drift_frac(),
+                )
+            else:
+                logger.info(
+                    "HBM ledger drift cleared (unattributed %.1f%%)", 100 * frac
+                )
+        return {
+            "bytes_in_use": in_use,
+            "bytes_limit": int(view["bytes_limit"]) if view.get("bytes_limit") else None,
+            "attributed_bytes": attributed,
+            "unattributed_bytes": unattributed,
+            "unattributed_frac": round(frac, 4),
+            "drift_frac_limit": drift_frac(),
+            "flagged": flagged,
+        }
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Sum of ``.nbytes`` over a pytree's array leaves — the ledger
+    ``bytes_fn`` body for model parameter trees.  Gated on jax already
+    being imported (a tree only exists if it is), 0 otherwise."""
+    if "jax" not in sys.modules:
+        return 0
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:  # noqa: BLE001 — a torn-down runtime must not kill /status
+        return 0
+    return int(sum(int(getattr(x, "nbytes", 0)) for x in leaves))
+
+
+def device_memory_view() -> dict[str, int] | None:
+    """Aggregate ``memory_stats()`` over the local devices, or ``None``
+    when unavailable.  Gated on jax ALREADY being imported — a metrics
+    scrape or health probe must never initialize the device runtime."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — backend not initialized / gone
+        return None
+    in_use = 0
+    limit = 0
+    seen = False
+    for dev in devices:
+        stats_fn = getattr(dev, "memory_stats", None)
+        if stats_fn is None:
+            continue
+        try:
+            stats = stats_fn()
+        except Exception:  # noqa: BLE001 — CPU backends raise/return None
+            continue
+        if not stats or "bytes_in_use" not in stats:
+            continue
+        seen = True
+        in_use += int(stats.get("bytes_in_use", 0))
+        limit += int(stats.get("bytes_limit", 0))
+    if not seen:
+        return None
+    return {"bytes_in_use": in_use, "bytes_limit": limit}
+
+
+_ledger_lock = threading.Lock()
+_ledger: HbmLedger | None = None
+
+
+def get_ledger() -> HbmLedger:
+    global _ledger
+    led = _ledger
+    if led is None:
+        with _ledger_lock:
+            if _ledger is None:
+                _ledger = HbmLedger()
+            led = _ledger
+    return led
+
+
+def reset_ledger() -> None:
+    """Test isolation hook: drop every registration."""
+    global _ledger
+    with _ledger_lock:
+        _ledger = None
+
+
+# ---------------------------------------------------------------------------
+# /status provider + /v1/health capacity block
+# ---------------------------------------------------------------------------
+
+
+class _LedgerMetricsProvider:
+    """``pathway_hbm_bytes{component=,shard=}`` + ``pathway_hbm_total_bytes``
+    (+ ``pathway_hbm_unattributed_bytes`` while the reconcile is flagged)."""
+
+    def stats(self) -> dict:
+        return hbm_status() or {}
+
+    def openmetrics_lines(self) -> list[str]:
+        from ..internals.metrics_names import escape_label_value
+
+        led = get_ledger()
+        rows = led.entries()
+        lines = ["# TYPE pathway_hbm_bytes gauge"]
+        total = 0
+        for component, shard, b in rows:
+            total += b
+            labels = f'component="{escape_label_value(component)}"'
+            if shard is not None:
+                labels += f',shard="{escape_label_value(shard)}"'
+            lines.append(f"pathway_hbm_bytes{{{labels}}} {b}")
+        recon = led.reconcile(attributed=total)
+        if recon is not None and recon["flagged"]:
+            lines.append(
+                'pathway_hbm_bytes{component="unattributed"} '
+                f'{recon["unattributed_bytes"]}'
+            )
+            lines.append("# TYPE pathway_hbm_unattributed_bytes gauge")
+            lines.append(
+                f'pathway_hbm_unattributed_bytes {recon["unattributed_bytes"]}'
+            )
+        lines.append("# TYPE pathway_hbm_total_bytes gauge")
+        lines.append(f"pathway_hbm_total_bytes {total}")
+        return lines
+
+
+def _ensure_provider() -> None:
+    from ..internals.monitoring import register_metrics_provider_once
+
+    register_metrics_provider_once("hbm_ledger", _LedgerMetricsProvider)
+
+
+def hbm_status() -> dict[str, Any] | None:
+    """Ledger snapshot for surfaces: per-component bytes (shard entries
+    keyed ``component/shard``), the attributed total, and the reconcile
+    result when a device runtime exposes one."""
+    led = get_ledger()
+    rows = led.entries()
+    if not rows:
+        return None
+    components: dict[str, int] = {}
+    for component, shard, b in rows:
+        key = component if shard is None else f"{component}/{shard}"
+        components[key] = components.get(key, 0) + b
+    total = sum(components.values())
+    out: dict[str, Any] = {
+        "total_bytes": total,
+        "components": components,
+    }
+    recon = led.reconcile(attributed=total)
+    if recon is not None:
+        out["device"] = recon
+    return out
+
+
+def capacity_status() -> dict[str, Any] | None:
+    """The ``"capacity"`` block on ``/v1/health`` — the per-replica
+    payload a least-loaded fleet router (ROADMAP item 4) places load on:
+    attributed HBM total + free HBM (when the runtime reports it) +
+    device-tick runtime occupancy (queue depths per QoS class)."""
+    out: dict[str, Any] = {}
+    hbm = hbm_status()
+    if hbm is not None:
+        cap: dict[str, Any] = {
+            "hbm_total_bytes": hbm["total_bytes"],
+            "hbm_components": hbm["components"],
+        }
+        device = hbm.get("device")
+        if device is not None:
+            if device.get("bytes_limit"):
+                cap["hbm_free_bytes"] = max(
+                    0, device["bytes_limit"] - device["bytes_in_use"]
+                )
+            cap["hbm_device"] = device
+        out.update(cap)
+    # runtime occupancy: read-only, never spawns the executor thread
+    try:
+        mod = sys.modules.get("pathway_tpu.runtime.executor")
+        if mod is not None:
+            occ = mod.runtime_capacity_if_active()
+            if occ is not None:
+                out["runtime"] = occ
+    except Exception:  # noqa: BLE001 — capacity must never raise
+        pass
+    return out or None

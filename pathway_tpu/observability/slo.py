@@ -1,0 +1,453 @@
+"""SLO engine: per-endpoint burn rates with exemplar-linked histograms.
+
+The flight recorder answers "where did THIS request's time go"; nothing
+answered "is the service healthy".  This module grows per-endpoint
+latency histograms from the request spans the tracing middleware already
+finishes, attaches OpenMetrics *exemplars* carrying the trace id (a
+burning p99 bucket links straight to ``/v1/debug/traces?trace_id=``),
+and evaluates SLO targets as multi-window burn rates — Google SRE
+workbook semantics, no collector required:
+
+* targets: ``PATHWAY_SLO_<ENDPOINT>_P99_MS`` (latency: at most 1% of
+  requests may exceed the target) and ``PATHWAY_SLO_<ENDPOINT>_AVAIL``
+  (availability: at most ``1 - target`` of requests may 5xx), where
+  ``<ENDPOINT>`` is the route with the ``/v1/`` prefix stripped,
+  non-alphanumerics mapped to ``_`` and uppercased
+  (``/v1/retrieve`` → ``RETRIEVE``, ``/v1/pw_ai_answer`` →
+  ``PW_AI_ANSWER``);
+* burn rate = (bad fraction in window) / (error budget): a steady burn
+  of 1.0 spends exactly the budget over the SLO period;
+* two windows — fast ``PATHWAY_SLO_FAST_S`` (default 300 s) and slow
+  ``PATHWAY_SLO_SLOW_S`` (default 3600 s) — over a bounded in-process
+  ring of PER-SECOND aggregate buckets (``PATHWAY_SLO_RING`` buckets,
+  default 8192 ≈ 2.3 h of retention at ANY request rate).  Verdict per endpoint: ``burning`` when BOTH windows
+  burn at ≥ ``PATHWAY_SLO_BURN_HOT`` (14.4), ``warn`` when both ≥
+  ``PATHWAY_SLO_BURN_WARN`` (6.0) or either ≥ the hot threshold, else
+  ``ok``.  The multi-window AND is what makes the verdict flip to
+  burning within the fast window under an incident and recover within
+  the slow window after it — a one-window rule either pages late or
+  flaps.
+
+Freshness rides the same machinery: the streaming driver's end-to-end
+connector lag observations (``pathway_freshness_seconds{connector=}``)
+feed per-connector series with ``PATHWAY_SLO_FRESHNESS_S`` as the
+target.  ``slo_status()`` is the ``"slo"`` block on ``/v1/health`` —
+next to the ``"capacity"`` block, the exact payload a fleet router
+consumes.
+
+Import discipline: stdlib + the :mod:`internals.metrics_names` leaf
+only; this module never imports jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from ..internals.config import env_float as _env_float
+from ..internals.config import env_int as _env_int
+from ..internals.metrics_names import Histogram, escape_label_value
+
+__all__ = [
+    "observe_request",
+    "observe_freshness",
+    "slo_status",
+    "slo_metrics_lines",
+    "endpoint_env_key",
+    "reset_slo",
+]
+
+#: latency histogram bucket upper bounds (ms) — wider than the stage
+#: buckets: endpoint totals include model calls and decode streams
+_LATENCY_BUCKETS_MS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+#: cardinality bound: an unknown-path scan must not mint unbounded
+#: series — beyond the cap, observations aggregate under "other"
+_MAX_ENDPOINTS = 64
+
+#: fixed latency-objective budget: a p99 target means 1% of requests may
+#: exceed it
+_LATENCY_BUDGET = 0.01
+
+
+def _settings() -> dict[str, float]:
+    return {
+        "fast_s": max(0.001, _env_float("PATHWAY_SLO_FAST_S", 300.0)),
+        "slow_s": max(0.001, _env_float("PATHWAY_SLO_SLOW_S", 3600.0)),
+        "burn_hot": _env_float("PATHWAY_SLO_BURN_HOT", 14.4),
+        "burn_warn": _env_float("PATHWAY_SLO_BURN_WARN", 6.0),
+        "ring": max(16, _env_int("PATHWAY_SLO_RING", 8192)),
+    }
+
+
+def endpoint_env_key(path: str) -> str:
+    """``/v1/pw_ai_answer`` → ``PW_AI_ANSWER`` (the ``<ENDPOINT>`` part
+    of the knob names)."""
+    p = path.strip("/")
+    if p.startswith("v1/"):
+        p = p[3:]
+    return "".join(c if c.isalnum() else "_" for c in p).upper() or "ROOT"
+
+
+class ExemplarHistogram:
+    """Fixed-bucket histogram whose ``_bucket`` lines carry OpenMetrics
+    exemplars: the last (trace_id, value, wall time) observed in each
+    bucket.  One exemplar per bucket keeps the exposition bounded while
+    still linking every latency regime — including the burning tail —
+    to a concrete trace."""
+
+    __slots__ = ("hist", "exemplars")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.hist = Histogram(buckets)
+        #: bucket index (incl. +Inf) -> (trace_id, value, wall_ts)
+        self.exemplars: list[tuple[str, float, float] | None] = [None] * (
+            len(buckets) + 1
+        )
+
+    def observe(self, value: float, trace_id: str | None) -> None:
+        self.hist.observe(value)
+        if trace_id:
+            for i, le in enumerate(self.hist.buckets):
+                if value <= le:
+                    self.exemplars[i] = (trace_id, value, time.time())
+                    return
+            self.exemplars[-1] = (trace_id, value, time.time())
+
+    def openmetrics_lines(self, family: str, labels: str) -> list[str]:
+        base = self.hist.openmetrics_lines(family, labels)
+        out = []
+        bucket_i = 0
+        for line in base:
+            if line.startswith(f"{family}_bucket"):
+                ex = self.exemplars[bucket_i]
+                bucket_i += 1
+                if ex is not None:
+                    tid, val, ts = ex
+                    line += (
+                        f' # {{trace_id="{escape_label_value(tid)}"}} '
+                        f"{val:.3f} {ts:.3f}"
+                    )
+            out.append(line)
+        return out
+
+
+class _Series:
+    """One SLO-tracked series: the exemplar histogram plus the bounded
+    sample ring burn rates are computed over.  Targets are read from the
+    env once at series creation (``reset_slo()`` re-reads them)."""
+
+    __slots__ = (
+        "name", "kind", "p99_ms", "avail", "freshness_s",
+        "hist", "ring", "lock",
+    )
+
+    def __init__(self, name: str, kind: str, ring: int):
+        self.name = name
+        self.kind = kind  # "endpoint" | "freshness"
+        env = endpoint_env_key(name)
+        if kind == "endpoint":
+            self.p99_ms = _env_float(f"PATHWAY_SLO_{env}_P99_MS", 0.0)
+            self.avail = _env_float(f"PATHWAY_SLO_{env}_AVAIL", 0.0)
+            self.freshness_s = 0.0
+        else:
+            self.p99_ms = 0.0
+            self.avail = 0.0
+            self.freshness_s = _env_float("PATHWAY_SLO_FRESHNESS_S", 0.0)
+        # endpoint series render their histogram (with exemplars) on
+        # /status; freshness series feed ONLY the burn ring — the gauge
+        # family pathway_freshness_seconds is the exported surface, so a
+        # per-connector histogram here would be dead weight
+        self.hist = (
+            ExemplarHistogram(_LATENCY_BUCKETS_MS)
+            if kind == "endpoint"
+            else None
+        )
+        #: PER-SECOND aggregate buckets ``[second, n, slow_bad, unavail]``
+        #: — NOT per-sample entries: at production QPS a per-sample ring
+        #: holds seconds of history and silently collapses the slow
+        #: window onto the fast one (a 25 s blip would then burn BOTH
+        #: windows and page).  Per-second buckets make retention
+        #: time-bounded regardless of rate: the default 8192 buckets
+        #: cover ~2.3 h, comfortably past the 1 h slow window.
+        self.ring: deque[list] = deque(maxlen=ring)
+        self.lock = threading.Lock()
+
+    def _append_locked(self, mono: float, slow_bad: bool, unavail: bool) -> None:
+        sec = int(mono)
+        if self.ring and self.ring[-1][0] >= sec:
+            slot = self.ring[-1]
+            slot[1] += 1
+            slot[2] += int(slow_bad)
+            slot[3] += int(unavail)
+        else:
+            self.ring.append([sec, 1, int(slow_bad), int(unavail)])
+
+    # -- recording -------------------------------------------------------
+    def observe(
+        self,
+        duration_ms: float,
+        status: int | None,
+        trace_id: str | None,
+        now: float | None,
+    ) -> None:
+        mono = time.monotonic() if now is None else now
+        slow_bad = self.p99_ms > 0.0 and duration_ms > self.p99_ms
+        unavail = status is not None and status >= 500
+        with self.lock:
+            self.hist.observe(duration_ms, trace_id)
+            self._append_locked(mono, slow_bad, unavail)
+
+    def observe_lag(self, lag_s: float, now: float | None) -> None:
+        mono = time.monotonic() if now is None else now
+        stale = self.freshness_s > 0.0 and lag_s > self.freshness_s
+        with self.lock:
+            self._append_locked(mono, stale, False)
+
+    # -- burn-rate math --------------------------------------------------
+    def _window_burn(
+        self, window_s: float, budget: float, field: int, now: float
+    ) -> tuple[float, int]:
+        """(burn rate, sample count) over the trailing ``window_s``
+        (cost bounded by window seconds, not sample count)."""
+        n = 0
+        bad = 0
+        for sec, cnt, bad_slow, bad_unavail in reversed(self.ring):
+            if now - sec > window_s:
+                break  # ring is append-ordered: everything older too
+            n += cnt
+            bad += (bad_slow, bad_unavail)[field]
+        if n == 0:
+            return 0.0, 0
+        return (bad / n) / max(budget, 1e-9), n
+
+    def evaluate(self, cfg: dict[str, float], now: float) -> dict[str, Any]:
+        with self.lock:
+            objectives: dict[str, Any] = {}
+            if self.p99_ms > 0.0 or self.freshness_s > 0.0:
+                fast, n_fast = self._window_burn(
+                    cfg["fast_s"], _LATENCY_BUDGET, 0, now
+                )
+                slow, n_slow = self._window_burn(
+                    cfg["slow_s"], _LATENCY_BUDGET, 0, now
+                )
+                key = "latency" if self.kind == "endpoint" else "freshness"
+                target = (
+                    {"p99_ms": self.p99_ms}
+                    if self.kind == "endpoint"
+                    else {"max_lag_s": self.freshness_s}
+                )
+                objectives[key] = {
+                    **target,
+                    "burn_fast": round(fast, 3),
+                    "burn_slow": round(slow, 3),
+                    "samples_fast": n_fast,
+                    "samples_slow": n_slow,
+                }
+            if self.avail > 0.0:
+                budget = max(1.0 - self.avail, 1e-9)
+                fast, n_fast = self._window_burn(cfg["fast_s"], budget, 1, now)
+                slow, n_slow = self._window_burn(cfg["slow_s"], budget, 1, now)
+                objectives["availability"] = {
+                    "target": self.avail,
+                    "burn_fast": round(fast, 3),
+                    "burn_slow": round(slow, 3),
+                    "samples_fast": n_fast,
+                    "samples_slow": n_slow,
+                }
+        verdict = "ok"
+        for obj in objectives.values():
+            verdict = _worse(
+                verdict,
+                _verdict(obj["burn_fast"], obj["burn_slow"], cfg),
+            )
+        out: dict[str, Any] = {"verdict": verdict}
+        if objectives:
+            out["objectives"] = objectives
+        else:
+            out["objectives"] = {}
+            out["note"] = "no SLO target configured (PATHWAY_SLO_* knobs)"
+        return out
+
+
+_RANK = {"ok": 0, "warn": 1, "burning": 2}
+
+
+def _worse(a: str, b: str) -> str:
+    return a if _RANK[a] >= _RANK[b] else b
+
+
+def _verdict(fast: float, slow: float, cfg: dict[str, float]) -> str:
+    """Multi-window verdict (SRE workbook): page only when BOTH windows
+    burn hot — the fast window gives response time, the slow window
+    keeps a transient spike from paging and lets recovery show."""
+    if fast >= cfg["burn_hot"] and slow >= cfg["burn_hot"]:
+        return "burning"
+    if (fast >= cfg["burn_warn"] and slow >= cfg["burn_warn"]) or max(
+        fast, slow
+    ) >= cfg["burn_hot"]:
+        return "warn"
+    return "ok"
+
+
+# ---------------------------------------------------------------------------
+# engine singleton
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_endpoints: dict[str, _Series] = {}
+_freshness: dict[str, _Series] = {}
+
+
+def _series(table: dict[str, _Series], name: str, kind: str) -> _Series:
+    s = table.get(name)
+    if s is not None:
+        return s
+    with _lock:
+        s = table.get(name)
+        if s is None:
+            # cap INCLUDES the "other" overflow series: once 63 real
+            # endpoints exist, the 64th distinct path creates "other"
+            # and everything beyond lands there — total series <= 64
+            if kind == "endpoint" and len(table) >= _MAX_ENDPOINTS - 1:
+                name = "other"
+                s = table.get(name)
+                if s is not None:
+                    return s
+            s = table[name] = _Series(name, kind, int(_settings()["ring"]))
+    _ensure_provider()
+    return s
+
+
+def observe_request(
+    path: str,
+    duration_ms: float,
+    status: int | None = None,
+    trace_id: str | None = None,
+    now: float | None = None,
+) -> None:
+    """One finished HTTP request (called by the tracing middleware for
+    every endpoint, sampled or not — SLOs observe latency, not traces).
+    ``now`` (monotonic seconds) is a test hook."""
+    _series(_endpoints, path, "endpoint").observe(
+        duration_ms, status, trace_id, now
+    )
+
+
+def observe_freshness(
+    connector: str, lag_s: float, now: float | None = None
+) -> None:
+    """One end-to-end ingest→queryable lag observation for a connector
+    (fed by ``FreshnessTracker.note_indexed``)."""
+    _series(_freshness, connector, "freshness").observe_lag(lag_s, now)
+
+
+def slo_status(now: float | None = None) -> dict[str, Any] | None:
+    """The ``"slo"`` block on ``/v1/health``: per-endpoint (and
+    per-connector freshness) burn rates + verdicts, plus the worst
+    verdict overall — what a router checks before placing load."""
+    with _lock:
+        endpoints = dict(_endpoints)
+        freshness = dict(_freshness)
+    if not endpoints and not freshness:
+        return None
+    cfg = _settings()
+    mono = time.monotonic() if now is None else now
+    out: dict[str, Any] = {
+        "windows": {"fast_s": cfg["fast_s"], "slow_s": cfg["slow_s"]},
+        "thresholds": {"hot": cfg["burn_hot"], "warn": cfg["burn_warn"]},
+    }
+    verdict = "ok"
+    if endpoints:
+        out["endpoints"] = {}
+        for name in sorted(endpoints):
+            ev = endpoints[name].evaluate(cfg, mono)
+            out["endpoints"][name] = ev
+            verdict = _worse(verdict, ev["verdict"])
+    if freshness:
+        out["freshness"] = {}
+        for name in sorted(freshness):
+            ev = freshness[name].evaluate(cfg, mono)
+            out["freshness"][name] = ev
+            verdict = _worse(verdict, ev["verdict"])
+    out["verdict"] = verdict
+    return out
+
+
+def reset_slo() -> None:
+    """Test isolation hook: drop every series (targets re-read from the
+    env on the next observation)."""
+    with _lock:
+        _endpoints.clear()
+        _freshness.clear()
+
+
+# ---------------------------------------------------------------------------
+# /status provider
+# ---------------------------------------------------------------------------
+
+
+class _SloMetricsProvider:
+    """``pathway_endpoint_latency_ms{endpoint=}`` exemplar histograms +
+    ``pathway_slo_burn_rate{slo=,window=}`` gauges."""
+
+    def stats(self) -> dict:
+        return slo_status() or {}
+
+    def openmetrics_lines(self) -> list[str]:
+        return slo_metrics_lines()
+
+
+def slo_metrics_lines(now: float | None = None) -> list[str]:
+    with _lock:
+        endpoints = dict(_endpoints)
+        freshness = dict(_freshness)
+    lines: list[str] = []
+    if endpoints:
+        lines.append("# TYPE pathway_endpoint_latency_ms histogram")
+        for name in sorted(endpoints):
+            s = endpoints[name]
+            with s.lock:
+                lines.extend(
+                    s.hist.openmetrics_lines(
+                        "pathway_endpoint_latency_ms",
+                        f'endpoint="{escape_label_value(name)}"',
+                    )
+                )
+    cfg = _settings()
+    mono = time.monotonic() if now is None else now
+    burn_lines: list[str] = []
+    for table in (endpoints, freshness):
+        for name in sorted(table):
+            ev = table[name].evaluate(cfg, mono)
+            slo_label = (
+                name if table is endpoints else f"freshness:{name}"
+            )
+            for obj_name, obj in ev["objectives"].items():
+                base = (
+                    f'slo="{escape_label_value(slo_label)}",objective="'
+                    f'{escape_label_value(obj_name)}"'
+                )
+                burn_lines.append(
+                    f'pathway_slo_burn_rate{{{base},window="fast"}} '
+                    f'{obj["burn_fast"]}'
+                )
+                burn_lines.append(
+                    f'pathway_slo_burn_rate{{{base},window="slow"}} '
+                    f'{obj["burn_slow"]}'
+                )
+    if burn_lines:
+        lines.append("# TYPE pathway_slo_burn_rate gauge")
+        lines.extend(burn_lines)
+    return lines
+
+
+def _ensure_provider() -> None:
+    from ..internals.monitoring import register_metrics_provider_once
+
+    register_metrics_provider_once("slo", _SloMetricsProvider)

@@ -183,6 +183,7 @@ class DeviceKnnIndex:
         self.quant_label = f"knn{next(_quant_label_seq)}"
         _LIVE_INDEXES.add(self)
         _ensure_index_provider()
+        _register_hbm_ledger(self)
 
     def _round_capacity(self, capacity: int) -> int:
         """Capacities at/above the Pallas threshold are kept at multiples
@@ -221,6 +222,28 @@ class DeviceKnnIndex:
             )
         itemsize = jnp.dtype(self.dtype).itemsize
         return cap * self.dim * itemsize + cap
+
+    def hbm_ledger_entries(self):
+        """This index's entry in the unified HBM ledger
+        (``pathway_hbm_bytes{component="knn:<label>"}``) — an ``int``
+        here; :class:`~pathway_tpu.parallel.index.ShardedKnnIndex`
+        overrides with a per-shard dict that sums to EXACTLY the same
+        total, so the ledger and the legacy ``pathway_index_hbm_bytes``
+        gauge can never disagree (one source of truth: this method
+        family)."""
+        return self.hbm_bytes()
+
+    def staged_hbm_bytes(self) -> int:
+        """Device-staged scatter debt: embed→upsert batches that landed
+        on device but have not been applied into the matrix yet hold
+        their OWN device arrays until the next search drains them —
+        invisible to :meth:`hbm_bytes`, real to the allocator."""
+        return int(
+            sum(
+                int(getattr(arr, "nbytes", 0))
+                for _slots, arr in list(self._staged_device)
+            )
+        )
 
     # -- mutation --
     def upsert(self, key: Hashable, vector: Any) -> None:
@@ -1333,7 +1356,6 @@ def _scatter_mask(mask: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Array
 #: (weak: a finished run's indexes drop out with it)
 _LIVE_INDEXES: "weakref.WeakSet[DeviceKnnIndex]" = weakref.WeakSet()
 _quant_label_seq = itertools.count()
-_index_provider_lock = threading.Lock()
 
 
 def _live_indexes() -> list["DeviceKnnIndex"]:
@@ -1377,20 +1399,33 @@ class _IndexMetricsProvider:
         return lines
 
 
-#: strong module-level ref: the provider registry is weak-valued, so an
-#: unheld provider would vanish before its first scrape
-_index_provider: _IndexMetricsProvider | None = None
+def _ledger_index_bytes(idx: "DeviceKnnIndex"):
+    return idx.hbm_ledger_entries()
+
+
+def _ledger_staged_bytes(idx: "DeviceKnnIndex") -> int:
+    return idx.staged_hbm_bytes()
+
+
+def _register_hbm_ledger(idx: "DeviceKnnIndex") -> None:
+    """Every device index is a unified-HBM-ledger client: the resident
+    matrix/codes/ring under ``knn:<label>`` and the transient
+    staged-scatter debt under ``knn_staged:<label>`` (module-level
+    ``bytes_fn``s so the ledger's weak owner ref stays the only
+    reference — a bound method would pin the index alive)."""
+    from ..observability.hbm_ledger import get_ledger
+
+    led = get_ledger()
+    led.register(f"knn:{idx.quant_label}", idx, _ledger_index_bytes)
+    led.register(f"knn_staged:{idx.quant_label}", idx, _ledger_staged_bytes)
 
 
 def _ensure_index_provider() -> None:
-    global _index_provider
-    with _index_provider_lock:
-        if _index_provider is not None:
-            return
-        from ..internals.monitoring import register_metrics_provider
+    # once-registration with a strong ref held by monitoring (the
+    # provider table itself is weak-valued)
+    from ..internals.monitoring import register_metrics_provider_once
 
-        _index_provider = _IndexMetricsProvider()
-        register_metrics_provider("index_quant", _index_provider)
+    register_metrics_provider_once("index_quant", _IndexMetricsProvider)
 
 
 def quantization_status() -> dict | None:

@@ -136,7 +136,6 @@ def _sharded_quant_search_fn(
 #: a finished run's indexes drop out with it)
 _LIVE_SHARDED: "weakref.WeakSet[ShardedKnnIndex]" = weakref.WeakSet()
 _label_seq = itertools.count()
-_provider_lock = threading.Lock()
 
 
 class ShardedKnnIndex(DeviceKnnIndex):
@@ -277,6 +276,20 @@ class ShardedKnnIndex(DeviceKnnIndex):
         return fn(jnp.asarray(q, dtype=self.dtype), self.vectors, self.valid)
 
     # -- mesh observability ---------------------------------------------
+    def hbm_ledger_entries(self) -> dict[str, int]:
+        """Per-shard breakdown for the unified HBM ledger
+        (``pathway_hbm_bytes{component="knn:<label>",shard=}``).  The
+        shard rows sum to EXACTLY :meth:`hbm_bytes` — the replicated
+        rescore ring/cache-map copies are already counted per shard
+        there, so an even split (remainder on shard 0) attributes every
+        byte exactly once."""
+        total = int(self.hbm_bytes())
+        n = max(int(self.n_shards), 1)
+        base = total // n
+        out = {str(i): base for i in range(n)}
+        out["0"] = base + (total - base * n)
+        return out
+
     def shard_row_counts(self) -> list[int]:
         """Live rows per shard (row-sharding balance observable — slots
         are allocated LIFO off one free list, so a heavily skewed profile
@@ -345,20 +358,12 @@ class _MeshMetricsProvider:
         return lines
 
 
-#: strong module-level ref: the provider registry is weak-valued, so an
-#: unheld provider would vanish before its first scrape
-_mesh_provider: _MeshMetricsProvider | None = None
-
-
 def _ensure_mesh_provider() -> None:
-    global _mesh_provider
-    with _provider_lock:
-        if _mesh_provider is not None:
-            return
-        from ..internals.monitoring import register_metrics_provider
+    # once-registration with a strong ref held by monitoring (the
+    # provider table itself is weak-valued)
+    from ..internals.monitoring import register_metrics_provider_once
 
-        _mesh_provider = _MeshMetricsProvider()
-        register_metrics_provider("mesh", _mesh_provider)
+    register_metrics_provider_once("mesh", _MeshMetricsProvider)
 
 
 def mesh_status() -> dict | None:

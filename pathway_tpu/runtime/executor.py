@@ -1022,6 +1022,24 @@ def runtime_stats_if_active() -> dict[str, Any] | None:
     return None if rt is None else rt.stats()
 
 
+def runtime_capacity_if_active() -> dict[str, Any] | None:
+    """Lean occupancy view for the ``/v1/health`` ``"capacity"`` block
+    (observability/hbm_ledger.capacity_status): per-class queue depth +
+    depth targets + the tick token budget — the admission headroom a
+    fleet router compares across replicas.  Lock-light (GIL-atomic len
+    reads) and never spawns the executor thread."""
+    with _GLOBAL_LOCK:
+        rt = _GLOBAL
+    if rt is None:
+        return None
+    return {
+        "queue_depth": {c.label: len(rt._queues[c]) for c in QoS},
+        "depth_targets": {c.label: rt.depth[c] for c in QoS},
+        "tick_tokens_budget": rt.tick_tokens,
+        "ticks_total": rt._ticks_total,
+    }
+
+
 def reset_runtime() -> None:
     """Test-isolation hook: forget the process-global runtime (its
     daemon thread parks forever on an abandoned condition variable)."""

@@ -205,6 +205,15 @@ class TieredKnnIndex:
         self._migrate_group = None  # built lazily (runtime import)
         _LIVE_TIERED.add(self)
         _ensure_tier_provider()
+        # unified HBM ledger: the hot tier registers itself through the
+        # DeviceKnnIndex/ShardedKnnIndex constructor, so the ONLY
+        # device-resident bytes still unaccounted here are the router's
+        # centroid matrix (the [C, D] routing matmul operand)
+        from ..observability.hbm_ledger import get_ledger
+
+        get_ledger().register(
+            f"tier_router:{self.tier_label}", self, _router_hbm_bytes
+        )
 
     # -- sizing ----------------------------------------------------------
     def __len__(self) -> int:
@@ -734,7 +743,14 @@ class TieredKnnIndex:
 
 _LIVE_TIERED: "weakref.WeakSet[TieredKnnIndex]" = weakref.WeakSet()
 _tier_label_seq = itertools.count()
-_tier_provider_lock = threading.Lock()
+
+
+def _router_hbm_bytes(idx: "TieredKnnIndex") -> int:
+    """HBM ledger ``bytes_fn`` (module-level so the ledger's weak owner
+    ref stays the only reference): the router's centroid matrix.  Reads
+    ``idx.router`` at call time — a restore that swaps the router spec
+    is tracked automatically."""
+    return int(getattr(idx.router.centroids, "nbytes", 0))
 
 
 def _live_tiered() -> list[TieredKnnIndex]:
@@ -780,19 +796,12 @@ class _TierMetricsProvider:
         return lines
 
 
-#: strong module-level ref: the provider registry is weak-valued
-_tier_provider: _TierMetricsProvider | None = None
-
-
 def _ensure_tier_provider() -> None:
-    global _tier_provider
-    with _tier_provider_lock:
-        if _tier_provider is not None:
-            return
-        from ..internals.monitoring import register_metrics_provider
+    # once-registration with a strong ref held by monitoring (the
+    # provider table itself is weak-valued)
+    from ..internals.monitoring import register_metrics_provider_once
 
-        _tier_provider = _TierMetricsProvider()
-        register_metrics_provider("tiering", _tier_provider)
+    register_metrics_provider_once("tiering", _TierMetricsProvider)
 
 
 def tiering_status() -> dict | None:

@@ -111,6 +111,13 @@ class RestClientBase:
     ``last_trace_id`` — paste it into the server's
     ``/v1/debug/traces?trace_id=...`` to see where that exact request's
     time went (queue wait / embed / search / serialize).
+
+    Every logical call mints ONE W3C ``traceparent`` and reuses it
+    across its 503 retries: the retried attempts stitch into a single
+    trace on the server instead of minting a fresh id per attempt — a
+    retried request used to be invisible as such in the trace dump,
+    which hid exactly the client-side pile-on behavior the retry knobs
+    bound.
     """
 
     def __init__(
@@ -146,6 +153,18 @@ class RestClientBase:
         #: caller's own traceparent's trace id when one was sent)
         self.last_trace_id: str | None = None
 
+    def _new_traceparent(self) -> str:
+        """One trace context per LOGICAL call (shared by every retry of
+        it; adaptive re-ask rounds that reuse one client call stitch in
+        too)."""
+        from ...internals.flight_recorder import (
+            format_traceparent,
+            new_span_id,
+            new_trace_id,
+        )
+
+        return format_traceparent(new_trace_id(), new_span_id())
+
     def _post(self, route: str, payload: dict):
         import random
         import time
@@ -153,9 +172,10 @@ class RestClientBase:
 
         deadline = time.monotonic() + self.retry_deadline_s
         attempt = 0
+        traceparent = self._new_traceparent()
         while True:
             try:
-                return self._post_once(route, payload)
+                return self._post_once(route, payload, traceparent=traceparent)
             except urllib.error.HTTPError as exc:
                 if not (self.retry_on_unavailable and exc.code == 503):
                     raise
@@ -183,14 +203,21 @@ class RestClientBase:
                 time.sleep(delay)
                 attempt += 1
 
-    def _post_once(self, route: str, payload: dict):
+    def _post_once(
+        self, route: str, payload: dict, traceparent: str | None = None
+    ):
         import json
         import urllib.request
 
+        headers = {"Content-Type": "application/json", **self.additional_headers}
+        if traceparent is not None and "traceparent" not in {
+            k.lower() for k in headers
+        }:
+            headers["traceparent"] = traceparent
         req = urllib.request.Request(
             self.url + route,
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json", **self.additional_headers},
+            headers=headers,
             method="POST",
         )
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:

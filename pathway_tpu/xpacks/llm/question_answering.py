@@ -1052,6 +1052,10 @@ class RAGClient(RestClientBase):
             data=_json.dumps(payload).encode(),
             headers={
                 "Content-Type": "application/json",
+                # client-minted W3C context, same contract as _post:
+                # the server adopts it and the stream's retrieval +
+                # decode spans land under ONE client-known trace id
+                "traceparent": self._new_traceparent(),
                 **self.additional_headers,
             },
             method="POST",

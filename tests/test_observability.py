@@ -381,12 +381,20 @@ _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|inf|nan))$"
 )
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+#: OpenMetrics exemplar: `# {labelset} value [timestamp]` after a sample
+_EXEMPLAR_RE = re.compile(
+    r'^\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\} '
+    r"-?\d+\.?\d*(?:[eE][+-]?\d+)?(?: \d+\.?\d*)?$"
+)
 
 
 def _strict_parse(body: str):
     """Strict-ish OpenMetrics text parse: TYPE declared before samples,
     consistent re-declarations only, parseable samples/labels, histogram
-    bucket monotonicity and _bucket/_sum/_count consistency, # EOF last."""
+    bucket monotonicity and _bucket/_sum/_count consistency, exemplar
+    syntax on ``# {...}``-suffixed samples (histogram buckets only),
+    # EOF last."""
     lines = body.rstrip("\n").split("\n")
     assert lines[-1] == "# EOF", "exposition must end with # EOF"
     types: dict[str, str] = {}
@@ -404,6 +412,14 @@ def _strict_parse(body: str):
         if line.startswith("#"):
             continue
         m = _SAMPLE_RE.match(line)
+        if m is None and " # " in line:
+            # exemplar-carrying sample: validate the exemplar half, then
+            # parse the sample half normally (only histogram _bucket
+            # lines carry exemplars here)
+            line, exemplar = line.split(" # ", 1)
+            assert _EXEMPLAR_RE.match(exemplar), f"malformed exemplar: {exemplar!r}"
+            assert "_bucket" in line, f"exemplar on a non-bucket sample: {line!r}"
+            m = _SAMPLE_RE.match(line)
         assert m, f"unparseable sample: {line!r}"
         name, labels_raw, value = m.group(1), m.group(2) or "", m.group(3)
         labels = dict(_LABEL_RE.findall(labels_raw))
@@ -609,3 +625,596 @@ def test_xla_compile_counter_pins_no_recompile_buckets():
     )
     # scatter sites counted too (upserts compiled at least once)
     assert fr.compile_stats().get("knn.scatter_rows", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 tentpole: unified HBM ledger
+# ---------------------------------------------------------------------------
+
+
+def _ledger_map():
+    from pathway_tpu.observability.hbm_ledger import get_ledger
+
+    out = {}
+    for component, shard, b in get_ledger().entries():
+        out.setdefault(component, {})[shard] = b
+    return out
+
+
+def test_hbm_ledger_exact_for_device_index_dtypes():
+    """Off-TPU the ledger is exact by construction: each index's
+    component entry equals its own hbm_bytes() self-report, across
+    storage dtypes, and the staged-scatter debt entry drains to zero
+    once a search applies the staged rows."""
+    import numpy as np
+
+    from pathway_tpu.ops.knn import DeviceKnnIndex
+
+    rng = np.random.default_rng(0)
+    f32 = DeviceKnnIndex(dim=8, capacity=64)
+    i8 = DeviceKnnIndex(dim=8, capacity=64, index_dtype="int8")
+    for i in range(16):
+        f32.upsert(i, rng.standard_normal(8))
+        i8.upsert(i, rng.standard_normal(8))
+    ledger = _ledger_map()
+    for idx in (f32, i8):
+        assert ledger[f"knn:{idx.quant_label}"][None] == idx.hbm_bytes()
+        assert ledger[f"knn_staged:{idx.quant_label}"][None] == idx.staged_hbm_bytes()
+    # staged-debt entry drains with the apply (search flushes staging)
+    f32.search(rng.standard_normal((1, 8)), k=2)
+    assert _ledger_map()[f"knn_staged:{f32.quant_label}"][None] == 0
+
+
+@pytest.mark.parametrize("mesh_n", [1, 2])
+def test_hbm_ledger_sharded_shards_sum_exactly(mesh_n):
+    import numpy as np
+
+    from pathway_tpu.parallel import make_mesh
+    from pathway_tpu.parallel.index import ShardedKnnIndex
+
+    idx = ShardedKnnIndex(dim=16, mesh=make_mesh(mesh_n), capacity=64)
+    rng = np.random.default_rng(1)
+    for i in range(24):
+        idx.upsert(i, rng.standard_normal(16))
+    shards = _ledger_map()[f"knn:{idx.quant_label}"]
+    assert set(shards) == {str(i) for i in range(mesh_n)}
+    assert sum(shards.values()) == idx.hbm_bytes(), (
+        "per-shard ledger rows must sum to the index's own self-report"
+    )
+
+
+def test_hbm_ledger_tiered_and_paged_kv_session():
+    """The tiered index's hot tier registers through its DeviceKnnIndex,
+    the router's centroid matrix registers separately, and a live
+    paged-KV session's block pools appear under kv_pool:<name> — each
+    equal to the subsystem's own report."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pathway_tpu.generation.engine import DecodeSession
+    from pathway_tpu.models.decoder import CausalLM, DecoderConfig
+    from pathway_tpu.tiering.index import TieredKnnIndex
+
+    tiered = TieredKnnIndex(
+        dim=16, hot_rows=32, capacity=128, n_partitions=8,
+        probe_partitions=2, migrate_batch=0,
+    )
+    rng = np.random.default_rng(2)
+    tiered.upsert_batch(list(range(48)), rng.standard_normal((48, 16)))
+    cfg = DecoderConfig(
+        vocab_size=97, hidden_dim=32, num_layers=1, num_heads=2, mlp_dim=64,
+        max_len=64, dtype=jnp.float32,
+    )
+    lm = CausalLM(cfg=cfg, seed=0)
+    session = DecodeSession(
+        cfg, lm.params, auto=False, pool_tokens=256, block_size=16,
+        name="ledger-test",
+    )
+    ledger = _ledger_map()
+    assert (
+        ledger[f"knn:{tiered.hot.quant_label}"][None] == tiered.hbm_bytes()
+    ), "the hot tier IS the tiered index's HBM bill"
+    assert ledger[f"tier_router:{tiered.tier_label}"][None] == int(
+        tiered.router.centroids.nbytes
+    )
+    kv_components = {
+        c: v for c, v in ledger.items() if c.startswith("kv_pool:ledger-test#")
+    }
+    assert len(kv_components) == 1
+    assert next(iter(kv_components.values()))[None] == session.pool.hbm_bytes()
+    # decoder params registered too, equal to the tree's own byte count
+    from pathway_tpu.observability.hbm_ledger import get_ledger, tree_nbytes
+
+    decoder_rows = [
+        b
+        for c, _s, b in get_ledger().entries()
+        if c.startswith("decoder_params:")
+    ]
+    assert tree_nbytes(lm.params) in decoder_rows
+    # the process total is the plain sum of every entry
+    assert get_ledger().total_bytes() == sum(
+        b for _c, _s, b in get_ledger().entries()
+    )
+    session.close()
+
+
+def test_hbm_ledger_release_and_weak_owner():
+    import gc
+
+    from pathway_tpu.observability.hbm_ledger import get_ledger
+
+    class Owner:
+        pass
+
+    led = get_ledger()
+    o1, o2 = Owner(), Owner()
+    t1 = led.register("test_comp:a", o1, lambda _o: 123)
+    led.register("test_comp:b", o2, lambda _o: {"0": 10, "1": 20})
+    rows = {(c, s): b for c, s, b in led.entries()}
+    assert rows[("test_comp:a", None)] == 123
+    assert rows[("test_comp:b", "0")] == 10 and rows[("test_comp:b", "1")] == 20
+    led.release(t1)
+    assert ("test_comp:a", None) not in {
+        (c, s) for c, s, _ in led.entries()
+    }
+    del o2
+    gc.collect()
+    assert not any(c == "test_comp:b" for c, _s, _b in led.entries())
+
+
+def test_hbm_ledger_reconcile_drift_flags_unattributed(monkeypatch):
+    """Fake device memory stats: drift beyond PATHWAY_HBM_DRIFT_FRAC
+    flags an `unattributed` component loudly (status + metric line);
+    within tolerance nothing is flagged."""
+    from pathway_tpu.observability import hbm_ledger as hl
+
+    led = hl.get_ledger()
+
+    class Owner:
+        pass
+
+    owner = Owner()
+    led.register("test_recon", owner, lambda _o: 1000)
+    total = led.total_bytes()
+    # 50% unattributed -> flagged
+    monkeypatch.setattr(
+        hl, "device_memory_view",
+        lambda: {"bytes_in_use": total * 2, "bytes_limit": total * 4},
+    )
+    recon = led.reconcile()
+    assert recon["flagged"] and recon["unattributed_bytes"] == total
+    status = hl.hbm_status()
+    assert status["device"]["flagged"]
+    lines = hl._LedgerMetricsProvider().openmetrics_lines()
+    assert any("pathway_hbm_unattributed_bytes" in ln for ln in lines)
+    assert any('component="unattributed"' in ln for ln in lines)
+    # capacity block reports free HBM for the router
+    cap = hl.capacity_status()
+    assert cap["hbm_free_bytes"] == total * 2
+    # within tolerance -> clear
+    monkeypatch.setattr(
+        hl, "device_memory_view",
+        lambda: {"bytes_in_use": int(total * 1.05), "bytes_limit": total * 4},
+    )
+    recon = led.reconcile()
+    assert not recon["flagged"]
+    lines = hl._LedgerMetricsProvider().openmetrics_lines()
+    assert not any("pathway_hbm_unattributed_bytes" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 tentpole: SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def slo_reset():
+    from pathway_tpu.observability import slo
+
+    slo.reset_slo()
+    yield slo
+    slo.reset_slo()
+
+
+def test_slo_burn_rate_hand_computed(monkeypatch, slo_reset):
+    """Window math pinned against hand-computed fixtures: burn =
+    (bad fraction) / (error budget), latency budget fixed at 1% for a
+    p99 target, availability budget = 1 - target."""
+    slo = slo_reset
+    monkeypatch.setenv("PATHWAY_SLO_RETRIEVE_P99_MS", "50")
+    monkeypatch.setenv("PATHWAY_SLO_RETRIEVE_AVAIL", "0.99")
+    monkeypatch.setenv("PATHWAY_SLO_FAST_S", "60")
+    monkeypatch.setenv("PATHWAY_SLO_SLOW_S", "600")
+    now = 1000.0
+    for _ in range(90):
+        slo.observe_request("/v1/retrieve", 10.0, 200, None, now=now)
+    for _ in range(10):
+        slo.observe_request("/v1/retrieve", 100.0, 200, None, now=now)
+    ev = slo.slo_status(now=now)["endpoints"]["/v1/retrieve"]
+    lat = ev["objectives"]["latency"]
+    # 10 of 100 over target -> bad_frac 0.10 -> burn 0.10/0.01 = 10.0
+    assert lat["burn_fast"] == pytest.approx(10.0)
+    assert lat["burn_slow"] == pytest.approx(10.0)
+    assert lat["samples_fast"] == 100
+    # 10 >= warn(6) in both windows but < hot(14.4) -> warn
+    assert ev["verdict"] == "warn"
+    # availability: 5 of 105 five-hundreds -> bad_frac ~0.0476 -> /0.01
+    for _ in range(5):
+        slo.observe_request("/v1/retrieve", 10.0, 503, None, now=now)
+    av = slo.slo_status(now=now)["endpoints"]["/v1/retrieve"]["objectives"][
+        "availability"
+    ]
+    assert av["burn_fast"] == pytest.approx((5 / 105) / 0.01, abs=0.01)
+    # push latency past hot in both windows -> burning
+    for _ in range(20):
+        slo.observe_request("/v1/retrieve", 100.0, 200, None, now=now)
+    ev = slo.slo_status(now=now)["endpoints"]["/v1/retrieve"]
+    assert ev["objectives"]["latency"]["burn_fast"] >= 14.4
+    assert ev["verdict"] == "burning"
+
+
+def test_slo_verdict_flips_burning_and_recovers(monkeypatch, slo_reset):
+    """The acceptance timeline with explicit clocks: injection flips
+    ok->burning within the fast window; after it stops, the fast window
+    drains first (warn) and the slow window drains last (ok)."""
+    slo = slo_reset
+    monkeypatch.setenv("PATHWAY_SLO_RETRIEVE_P99_MS", "50")
+    monkeypatch.setenv("PATHWAY_SLO_FAST_S", "10")
+    monkeypatch.setenv("PATHWAY_SLO_SLOW_S", "100")
+    status = lambda t: slo.slo_status(now=t)["endpoints"]["/v1/retrieve"]
+    for _ in range(50):
+        slo.observe_request("/v1/retrieve", 10.0, 200, None, now=5.0)
+    assert status(5.0)["verdict"] == "ok"
+    # synthetic latency injection: 30 slow requests at t=6
+    for _ in range(30):
+        slo.observe_request("/v1/retrieve", 500.0, 200, None, now=6.0)
+    assert status(6.0)["verdict"] == "burning", (
+        "both windows see 30/80 bad -> burn 37.5 >= 14.4"
+    )
+    # injection stops; healthy traffic continues
+    for _ in range(20):
+        slo.observe_request("/v1/retrieve", 10.0, 200, None, now=15.0)
+    ev = status(20.0)
+    assert ev["objectives"]["latency"]["burn_fast"] == 0.0, (
+        "fast window drained: only the t=15 good samples remain in it"
+    )
+    assert ev["verdict"] == "warn", "slow window still carries the incident"
+    # past the slow window everything ages out
+    assert status(200.0)["verdict"] == "ok"
+
+
+def test_slo_endpoint_env_key():
+    from pathway_tpu.observability.slo import endpoint_env_key
+
+    assert endpoint_env_key("/v1/retrieve") == "RETRIEVE"
+    assert endpoint_env_key("/v1/pw_ai_answer") == "PW_AI_ANSWER"
+    assert endpoint_env_key("/v1/pw_ai_answer_stream") == "PW_AI_ANSWER_STREAM"
+    assert endpoint_env_key("/v2/weird-path") == "V2_WEIRD_PATH"
+    assert endpoint_env_key("/") == "ROOT"
+
+
+def test_slo_endpoint_cardinality_bounded(slo_reset):
+    """Unknown-path scans must not mint unbounded series: past the cap
+    observations aggregate under 'other'."""
+    slo = slo_reset
+    for i in range(200):
+        slo.observe_request(f"/scan/{i}", 1.0, 404, None, now=1.0)
+    status = slo.slo_status(now=1.0)
+    assert len(status["endpoints"]) <= 64, "cap includes the overflow series"
+    assert "other" in status["endpoints"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: e2e — health slo/capacity blocks, exemplars, freshness, profile
+# ---------------------------------------------------------------------------
+
+
+def _get_json(url, timeout=10):
+    req = urllib.request.Request(url)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode()), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode() or "{}"), dict(exc.headers)
+
+
+def test_health_slo_capacity_blocks_and_exemplar_resolution(
+    corpus_dir, monkeypatch, slo_reset
+):
+    """Acceptance: after real traffic the /v1/health payload carries an
+    "slo" block (burning under an aggressive target) and a "capacity"
+    block (ledger totals + runtime occupancy); at least one burning
+    histogram bucket line carries a parseable exemplar whose trace id
+    resolves in /v1/debug/traces."""
+    # every request is "bad" against a sub-microsecond target -> the
+    # verdict burns within the fast window of real traffic
+    monkeypatch.setenv("PATHWAY_SLO_RETRIEVE_P99_MS", "0.0001")
+    monkeypatch.setenv("PATHWAY_SLO_FAST_S", "30")
+    monkeypatch.setenv("PATHWAY_SLO_SLOW_S", "300")
+    _vs, client, port = _start_server(corpus_dir)
+    probe = "Document 2 about topic-0 with unique marker m2."
+    _wait(lambda: client.query(probe, k=2))
+    for _ in range(5):
+        client.query(probe, k=1)
+
+    code, health, _ = _get_json(f"http://127.0.0.1:{port}/v1/health")
+    assert code == 200
+    slo_block = health["slo"]
+    ep = slo_block["endpoints"]["/v1/retrieve"]
+    assert ep["verdict"] == "burning"
+    assert ep["objectives"]["latency"]["burn_fast"] >= 14.4
+    cap = health["capacity"]
+    assert cap["hbm_total_bytes"] > 0
+    assert any(c.startswith("knn:") for c in cap["hbm_components"])
+    # (encoder_params:* appears too when the embedder is model-backed;
+    # this server runs the mock UDF embedder, which holds no param tree)
+    if "runtime" in cap:
+        assert "queue_depth" in cap["runtime"]
+
+    # /status scrape: exemplar-carrying burning bucket -> resolvable trace
+    monitor = StatsMonitor()
+    server = start_http_server_thread(monitor, port=_free_port())
+    try:
+        status = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.server_address[1]}/status", timeout=10
+        ).read().decode()
+    finally:
+        server.shutdown()
+    _strict_parse(status)  # exemplar syntax round-trips the strict parser
+    exemplar_lines = [
+        ln
+        for ln in status.splitlines()
+        if ln.startswith("pathway_endpoint_latency_ms_bucket")
+        and 'endpoint="/v1/retrieve"' in ln
+        and " # {trace_id=" in ln
+    ]
+    assert exemplar_lines, "no exemplar on the retrieve latency histogram"
+    tid = re.search(r'trace_id="([0-9a-f]{32})"', exemplar_lines[-1]).group(1)
+    body = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/debug/traces?trace_id={tid}",
+            timeout=10,
+        ).read()
+    )
+    assert body["spans"], "exemplar trace id must resolve in the recorder"
+    # burn-rate gauges render too
+    assert 'pathway_slo_burn_rate{slo="/v1/retrieve"' in status
+
+
+def test_freshness_end_to_end_live_file_drop(corpus_dir):
+    """pathway_freshness_seconds measures connector READ -> queryable,
+    per connector, through a live file drop."""
+    _vs, client, port = _start_server(corpus_dir)
+    probe = "Document 2 about topic-0 with unique marker m2."
+    _wait(lambda: client.query(probe, k=2))
+    drop_marker = "Fresh document with unique marker freshdrop77."
+    (corpus_dir / "fresh.txt").write_text(drop_marker)
+    # FakeEmbedder is content-hash based: the exact text ranks itself
+    # first once (and only once) the drop is ingested and queryable
+    _wait(
+        lambda: any(
+            "freshdrop77" in r["text"]
+            for r in client.query(drop_marker, k=1)
+        )
+    )
+    lags = get_freshness().connector_lags()
+    assert lags, "no end-to-end connector freshness recorded"
+    # the fs connector's read->queryable lag is recent and sane
+    stats = get_freshness().connector_stats()
+    fresh = {k: v for k, v in stats.items() if v["age_s"] < 60.0}
+    assert fresh, f"no fresh connector lag: {stats}"
+    assert min(v["lag_s"] for v in fresh.values()) < 30.0
+    # and it renders on the exposition under the new family
+    lines = "\n".join(get_freshness().openmetrics_lines())
+    assert "pathway_freshness_seconds{connector=" in lines
+
+
+def test_debug_profile_endpoint_single_flight_and_artifact(
+    tmp_path, monkeypatch
+):
+    """/v1/debug/profile: single-flight (409 for the overlapping call),
+    artifact served (off-TPU: flight-recorder Perfetto JSON), 400 on a
+    garbage ms, 503 when disabled."""
+    import threading as _threading
+
+    from pathway_tpu.io.http import PathwayWebserver
+
+    monkeypatch.setenv("PATHWAY_PROFILE_DIR", str(tmp_path / "spool"))
+    ws = PathwayWebserver(host="127.0.0.1", port=_free_port())
+    ws._ensure_started()
+    base = f"http://127.0.0.1:{ws.port}"
+
+    results: dict = {}
+
+    def long_capture():
+        results["long"] = _get_json(f"{base}/v1/debug/profile?ms=900", timeout=30)
+
+    th = _threading.Thread(target=long_capture)
+    th.start()
+    time.sleep(0.3)  # the long capture is inside its sleep window
+    # a span recorded DURING the window must land in the export
+    fr.record_span("bench:seed", "test", time.time(), 5.0)
+    code409, body409, _ = _get_json(f"{base}/v1/debug/profile?ms=50")
+    th.join(timeout=30)
+    assert code409 == 409, f"overlapping capture must 409: {body409}"
+    code, doc, headers = results["long"]
+    assert code == 200
+    assert headers.get("x-pathway-profile-kind") == "flight_recorder"
+    assert "traceEvents" in doc and doc["pw_profile"]["spans"] >= 1
+    # follow-up capture succeeds (single-flight released)
+    code, doc, _ = _get_json(f"{base}/v1/debug/profile?ms=30")
+    assert code == 200 and "traceEvents" in doc
+    # garbage duration -> 400 (incl. nan/inf, which parse as floats but
+    # would blow up the capture sleep)
+    for bad in ("abc", "nan", "inf"):
+        code, _, _ = _get_json(f"{base}/v1/debug/profile?ms={bad}")
+        assert code == 400, f"ms={bad} must 400"
+    # spool stays bounded
+    from pathway_tpu.observability.profiler import keep_artifacts
+
+    import os as _os
+
+    assert len(_os.listdir(tmp_path / "spool")) <= keep_artifacts()
+    # disabled -> 503
+    monkeypatch.setenv("PATHWAY_PROFILE_DIR", "off")
+    code, _, _ = _get_json(f"{base}/v1/debug/profile?ms=30")
+    assert code == 503
+
+
+def test_profile_duration_capped(tmp_path, monkeypatch):
+    from pathway_tpu.observability import profiler
+
+    monkeypatch.setenv("PATHWAY_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("PATHWAY_PROFILE_MAX_MS", "50")
+    t0 = time.monotonic()
+    res = profiler.capture(60_000)
+    assert time.monotonic() - t0 < 5.0, "cap must bound the window"
+    assert res["duration_ms"] == 50.0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 satellites: ring-drop counter, client traceparent, reverse lint
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_counts_evictions_before_read():
+    rec = fr.FlightRecorder(capacity=4)
+    for i in range(4):
+        rec.record(f"a{i}", "catA", 0.0, 1.0)
+    rec.spans()  # everything buffered has now been read
+    for i in range(4):
+        rec.record(f"b{i}", "catB", 0.0, 1.0)
+    # the 4 evicted catA spans were read first -> not drops
+    assert rec.stats()["dropped_before_read_total"] == 0
+    for i in range(6):
+        rec.record(f"c{i}", "catC", 0.0, 1.0)
+    # 4 catB + 2 catC evicted without any intervening read
+    assert rec.dropped_by_category() == {"catB": 4, "catC": 2}
+    assert rec.stats()["dropped_before_read_total"] == 6
+    # a filtered / limit-truncated read does NOT clear the watermark —
+    # the undelivered spans were never seen, and marking them read would
+    # make the counter undercount the next overflow
+    rec.spans(limit=2)
+    rec.spans(category="nope")
+    for i in range(4):
+        rec.record(f"d{i}", "catD", 0.0, 1.0)
+    assert rec.stats()["dropped_before_read_total"] == 10
+    # an unfiltered full read clears; the next overflow counts fresh
+    rec.spans()
+    rec.record("e0", "catE", 0.0, 1.0)
+    assert rec.stats()["dropped_before_read_total"] == 10
+    # the family renders on the exposition (global recorder; zero-safe)
+    lines = "\n".join(fr.observability_metrics_lines())
+    assert "pathway_trace_dropped_total" in lines
+
+
+def test_rest_client_traceparent_stitches_retries():
+    """A retried logical call carries ONE trace id across attempts: the
+    server sees the same traceparent on the 503'd attempt and the
+    successful retry."""
+    import threading as _threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from pathway_tpu.xpacks.llm._utils import RestClientBase
+
+    seen: list = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            seen.append(self.headers.get("traceparent"))
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            if len(seen) == 1:
+                self.send_response(503)
+                self.send_header("Retry-After", "0.01")
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+            else:
+                tid = fr.parse_traceparent(seen[-1])[0]
+                body = b'{"ok": true}'
+                self.send_response(200)
+                self.send_header("x-pathway-trace-id", tid)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = HTTPServer(("127.0.0.1", 0), Handler)
+    th = _threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    try:
+        client = RestClientBase(
+            host="127.0.0.1", port=server.server_address[1],
+            retry_on_unavailable=True, backoff_initial_s=0.01,
+        )
+        out = client._post("/x", {"q": 1})
+        assert out == {"ok": True}
+    finally:
+        server.shutdown()
+    assert len(seen) == 2
+    assert seen[0] is not None and seen[0] == seen[1], (
+        f"retry minted a fresh trace: {seen}"
+    )
+    tid = fr.parse_traceparent(seen[0])[0]
+    assert client.last_trace_id == tid
+    # a second logical call mints a NEW trace (no accidental reuse)
+    seen.clear()
+    try:
+        client._post("/x", {"q": 2})
+    except Exception:
+        pass
+
+
+#: declared families whose emission is gated on real-TPU-only paths —
+#: the reverse lint skips them so tier-1 stays green off-chip.  Keep
+#: this list SHORT: a family lands here only when its emitting literal
+#: genuinely cannot appear in off-TPU-importable code.
+_TPU_GATED_FAMILIES: set = set()
+
+
+def test_metric_registry_lint_no_orphan_declared_families():
+    """Reverse of the undeclared-series lint: every family declared in
+    METRICS must be emitted somewhere in the package (full literal, or a
+    `stem_` format-string prefix) — a declared-but-never-emitted family
+    is dashboard documentation for a series that does not exist."""
+    import pathlib
+
+    root = pathlib.Path(pw.__file__).parent
+    pattern = re.compile(r"(?<![A-Za-z0-9_])pathway_[a-z][a-z0-9_]*")
+    tokens: set = set()
+    for path in sorted(root.rglob("*.py")):
+        if path.name == "metrics_names.py":
+            continue  # the declaration itself is not an emission
+        tokens |= set(pattern.findall(path.read_text()))
+    orphans = []
+    for family in METRICS:
+        if family in _TPU_GATED_FAMILIES:
+            continue
+        emitted = family in tokens or any(
+            t.endswith("_") and family.startswith(t) and t != family
+            for t in tokens
+        )
+        if not emitted:
+            orphans.append(family)
+    assert not orphans, (
+        "declared but never emitted (remove from metrics_names.py or add "
+        f"the emitter; TPU-gated families go in _TPU_GATED_FAMILIES): {orphans}"
+    )
+
+
+def test_openapi_schema_advertises_slo_knobs(corpus_dir):
+    """Route registration stamps the exact PATHWAY_SLO_* knob names into
+    /_schema — SLO discoverability without reading the README."""
+    _vs, client, port = _start_server(corpus_dir)
+    _wait(lambda: client.query("Document 2", k=1))
+    schema = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/_schema", timeout=10
+        ).read()
+    )
+    retrieve = schema["paths"]["/v1/retrieve"]
+    knobs = next(iter(retrieve.values()))["x-pathway-slo-knobs"]
+    assert "PATHWAY_SLO_RETRIEVE_P99_MS" in knobs
+    assert "PATHWAY_SLO_RETRIEVE_AVAIL" in knobs

@@ -658,9 +658,11 @@ def test_quantization_status_and_metrics_lines():
     assert info["quant_searches"] >= 1
     assert info["cache_rows_live"] == 10
 
-    from pathway_tpu.ops.knn import _index_provider
+    from pathway_tpu.internals.monitoring import register_metrics_provider_once
+    from pathway_tpu.ops.knn import _IndexMetricsProvider
 
-    lines = _index_provider.openmetrics_lines()
+    provider = register_metrics_provider_once("index_quant", _IndexMetricsProvider)
+    lines = provider.openmetrics_lines()
     text = "\n".join(lines)
     assert (
         f'pathway_index_dtype{{index="{idx.quant_label}",dtype="int8"}} 1'

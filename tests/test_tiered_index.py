@@ -631,7 +631,10 @@ def test_hot_tier_rebuilds_from_host_mirror(monkeypatch):
 
 def test_tiering_status_metrics_and_health():
     from pathway_tpu.internals.health import get_health, reset_health
-    from pathway_tpu.tiering.index import _tier_provider
+    from pathway_tpu.internals.monitoring import register_metrics_provider_once
+    from pathway_tpu.tiering.index import _TierMetricsProvider
+
+    _tier_provider = register_metrics_provider_once("tiering", _TierMetricsProvider)
 
     t = TieredKnnIndex(
         dim=16, hot_rows=8, capacity=64, n_partitions=4,
