@@ -372,6 +372,22 @@ def fire_decode_cb() -> bool:
     )
 
 
+def fire_spec() -> bool:
+    """Speculative multi-token decode + COW prefix sharing on the real
+    chip (ISSUE 16): decode_bench.py's shared-template phase A/Bs the
+    PR 14 baseline against prefix sharing and --spec-k drafting at
+    batch 8 (gpt2 geometry on TPU) — aggregate tokens/s, inter-token
+    p50/p99, prefix-hit + draft-acceptance rates.  Success requires a
+    platform=="tpu" decode_speculative record; it additionally lands in
+    chip_results.jsonl."""
+    return _fire_tpu_jsonl(
+        os.path.join(HERE, "decode_bench.py"),
+        840.0,
+        {"DECODE_BENCH_BUDGET_S": "780", "DECODE_BENCH_PHASE": "spec"},
+        bank_metric="decode_speculative",
+    )
+
+
 def fire_profile() -> bool:
     """On-demand device profiling on the real chip (ISSUE 15):
     benchmarks/obs_overhead.py --profile-probe starts a live webserver
@@ -571,6 +587,7 @@ def main() -> int:
         "tiered": False,
         "cache": False,
         "decode": False,
+        "spec": False,
         "profile": False,
     }
     fire = {
@@ -586,6 +603,7 @@ def main() -> int:
         "tiered": fire_tiered,
         "cache": fire_cache,
         "decode": fire_decode_cb,
+        "spec": fire_spec,
         "profile": fire_profile,
     }
     last_bank = None  # monotonic() of the last banked record

@@ -1,4 +1,5 @@
-"""Continuous-batching paged decode vs sequential dense-scan decode.
+"""Continuous-batching paged decode vs sequential dense-scan decode,
+plus the ISSUE 16 shared-template / speculative phase.
 
 The serving A/B for ISSUE 14: the pre-PR decode shape is ONE request at
 a time through ``CausalLM.generate_ids`` (a private dense KV cache per
@@ -13,12 +14,22 @@ sequence one token per launch.  Measures:
   vs the dense path's effective per-token time (a client staring at a
   sequential queue waits for every request ahead of it).
 
-Prints one JSON line per batch size and a consolidated
-``decode_continuous_batching`` record; both append to
-``benchmarks/bench_results.jsonl``.
+The SPECULATIVE phase (ISSUE 16) replays the RAG serving shape — every
+request carries the same template preamble with a short unique tail —
+through three sessions over identical requests: the PR 14 baseline
+(sharing off, spec off), prefix sharing on, and sharing + ``--spec-k``
+drafting.  Banked as ``metric=decode_speculative`` with aggregate
+tokens/s, inter-token p50/p99, prefix-hit rate and draft acceptance
+rate (acceptance: ≥2x tokens/s at batch 8 over the PR 14 baseline).
 
-Run: ``JAX_PLATFORMS=cpu python benchmarks/decode_bench.py [geometry]``
-(geometry: "tiny" | "small" (default off-TPU) | "gpt2" (default on TPU))
+Prints one JSON line per batch size and consolidated
+``decode_continuous_batching`` + ``decode_speculative`` records; all
+append to ``benchmarks/bench_results.jsonl``.
+
+Run: ``JAX_PLATFORMS=cpu python benchmarks/decode_bench.py [geometry]
+[--spec-k K]`` (geometry: "tiny" | "small" (default off-TPU) | "gpt2"
+(default on TPU)).  ``DECODE_BENCH_PHASE`` = ``all`` (default) | ``cb``
+| ``spec`` selects the phases.
 """
 
 from __future__ import annotations
@@ -190,10 +201,177 @@ def run(geometry: str | None = None) -> dict:
     return consolidated
 
 
+def run_speculative(
+    geometry: str | None = None, spec_k: int = 4, batch: int = 8
+) -> dict:
+    """Shared-template phase + --spec-k A/B (ISSUE 16)."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from pathway_tpu.generation import DecodeSession
+    from pathway_tpu.generation.engine import generation_status
+    from pathway_tpu.models.decoder import CausalLM, DecoderConfig
+    from pathway_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    platform = jax.devices()[0].platform
+    if geometry is None:
+        geometry = "gpt2" if platform == "tpu" else "small"
+    if geometry == "tiny":
+        cfg = DecoderConfig(
+            vocab_size=512, hidden_dim=128, num_layers=4, num_heads=4,
+            mlp_dim=512, max_len=512,
+            dtype=jnp.float32 if platform == "cpu" else jnp.bfloat16,
+        )
+    elif geometry == "small":
+        cfg = DecoderConfig(
+            vocab_size=4096, hidden_dim=512, num_layers=8, num_heads=8,
+            mlp_dim=2048, max_len=128,
+            dtype=jnp.float32 if platform == "cpu" else jnp.bfloat16,
+        )
+    else:
+        cfg = DecoderConfig(
+            dtype=jnp.float32 if platform == "cpu" else jnp.bfloat16
+        )
+    lm = CausalLM(cfg=cfg)
+    rng = np.random.default_rng(7)
+    max_new = min(16, int(os.environ.get("DECODE_BENCH_MAX_NEW", "16")))
+    # the RAG serving shape: one long template preamble shared verbatim
+    # by every request, plus a short unique per-request tail.  384
+    # tokens ≈ a system prompt + one retrieved passage; geometries with
+    # a short max_len (small: 128) get what fits
+    tail_len = 8
+    template_len = min(
+        cfg.max_len - max_new - tail_len - 1, 24 * 16
+    )
+    template = rng.integers(1, cfg.vocab_size, size=template_len).tolist()
+    reqs = [
+        template + rng.integers(1, cfg.vocab_size, size=tail_len).tolist()
+        for _ in range(batch)
+    ]
+    need = sum(-(-(len(p) + max_new) // 16) for p in reqs)
+    pool_tokens = 16 * (need + max(2, need // 4))
+
+    def one_run(share: bool, k: int, measure: bool):
+        sess = DecodeSession(
+            cfg, lm.params, auto=False, use_runtime=False,
+            pool_tokens=pool_tokens, block_size=16,
+            prefix_share=share, spec_k=k,
+        )
+        stamps: dict[int, list[float]] = {i: [] for i in range(batch)}
+        t0 = time.perf_counter()
+        handles = [
+            sess.submit(
+                reqs[0], max_new_tokens=max_new,
+                stream_cb=(
+                    (lambda tok: stamps[0].append(time.perf_counter()))
+                    if measure else None
+                ),
+            )
+        ]
+        # template carrier prefills (and content-registers) first; the
+        # followers then admit against a warm prefix index — the
+        # sequential-then-concurrent shape real template traffic has
+        sess.tick()
+        for i in range(1, batch):
+            handles.append(
+                sess.submit(
+                    reqs[i], max_new_tokens=max_new,
+                    stream_cb=(
+                        (lambda tok, i=i: stamps[i].append(
+                            time.perf_counter()
+                        )) if measure else None
+                    ),
+                )
+            )
+        sess.drain(timeout=600)
+        elapsed = time.perf_counter() - t0
+        for h in handles:
+            assert len(h.result()) == max_new
+        sess.close()
+        gaps = []
+        for ts in stamps.values():
+            gaps.extend(b - a for a, b in zip(ts, ts[1:]))
+        return elapsed, gaps
+
+    out = {
+        "metric": "decode_speculative",
+        "geometry": geometry,
+        "platform": platform,
+        "batch": batch,
+        "max_new_tokens": max_new,
+        "template_tokens": template_len,
+        "tail_tokens": tail_len,
+        "spec_k": spec_k,
+    }
+    variants = {
+        "baseline": (False, 0),       # PR 14 semantics: no share, no spec
+        "shared": (True, 0),          # prefix sharing only
+        "shared_spec": (True, spec_k),  # sharing + drafting
+    }
+    for name, (share, k) in variants.items():
+        one_run(share, k, measure=False)  # warm every launch shape
+        before = dict(generation_status())
+        elapsed, gaps = one_run(share, k, measure=True)
+        after = dict(generation_status())
+        tps = batch * max_new / elapsed
+        out[f"{name}_tokens_per_sec"] = round(tps, 1)
+        out[f"{name}_inter_token_p50_ms"] = round(
+            _pctl(gaps, 0.50) * 1e3, 2
+        )
+        out[f"{name}_inter_token_p99_ms"] = round(
+            _pctl(gaps, 0.99) * 1e3, 2
+        )
+        if share:
+            hit = after["prefix_hit_blocks_total"] - before[
+                "prefix_hit_blocks_total"
+            ]
+            cand = after["prefix_candidate_blocks_total"] - before[
+                "prefix_candidate_blocks_total"
+            ]
+            out[f"{name}_prefix_hit_rate"] = round(
+                hit / cand if cand else 0.0, 3
+            )
+        if k > 0:
+            prop = after["draft_proposed_total"] - before[
+                "draft_proposed_total"
+            ]
+            acc = after["draft_accepted_total"] - before[
+                "draft_accepted_total"
+            ]
+            out["draft_acceptance_rate"] = round(
+                acc / prop if prop else 0.0, 3
+            )
+    base = out["baseline_tokens_per_sec"]
+    out["speedup_shared"] = round(out["shared_tokens_per_sec"] / base, 3)
+    out["speedup_shared_spec"] = round(
+        out["shared_spec_tokens_per_sec"] / base, 3
+    )
+    out["meets_acceptance"] = (
+        max(out["speedup_shared"], out["speedup_shared_spec"]) >= 2.0
+    )
+    print(json.dumps(out), flush=True)
+    return out
+
+
 if __name__ == "__main__":
-    out = run(sys.argv[1] if len(sys.argv) > 1 else None)
-    out["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-    line = json.dumps(out)
-    print(line)
+    argv = [a for a in sys.argv[1:]]
+    spec_k = 4
+    if "--spec-k" in argv:
+        i = argv.index("--spec-k")
+        spec_k = int(argv[i + 1])
+        del argv[i:i + 2]
+    geometry = argv[0] if argv else None
+    phase = os.environ.get("DECODE_BENCH_PHASE", "all")
+    outs = []
+    if phase in ("all", "cb"):
+        outs.append(run(geometry))
+    if phase in ("all", "spec"):
+        outs.append(run_speculative(geometry, spec_k=spec_k))
     with open(RESULTS, "a") as f:
-        f.write(line + "\n")
+        for out in outs:
+            out["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            line = json.dumps(out)
+            print(line)
+            f.write(line + "\n")

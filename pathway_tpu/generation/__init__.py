@@ -18,19 +18,29 @@ __all__ = [
     "GenerationHandle",
     "PagedDecoder",
     "PagedKVPool",
+    "PrefixIndex",
     "decode_kernel_mode",
+    "decode_prefix_share",
+    "decode_spec_k",
     "generation_status",
     "iter_text_pieces",
     "paged_decode_attention",
+    "paged_verify_attention",
+    "propose_draft",
     "validate_decoder_geometry",
 ]
 
 _EXPORTS = {
     "BlockAllocator": ".paged_kv",
     "PagedKVPool": ".paged_kv",
+    "PrefixIndex": ".paged_kv",
+    "decode_spec_k": ".paged_kv",
+    "decode_prefix_share": ".paged_kv",
     "decode_kernel_mode": ".decode_kernel",
     "paged_decode_attention": ".decode_kernel",
+    "paged_verify_attention": ".decode_kernel",
     "validate_decoder_geometry": ".decode_kernel",
+    "propose_draft": ".drafting",
     "DecodeSession": ".engine",
     "GenerationHandle": ".engine",
     "PagedDecoder": ".engine",

@@ -43,6 +43,7 @@ __all__ = [
     "resolve_decode_mode",
     "validate_decoder_geometry",
     "paged_decode_attention",
+    "paged_verify_attention",
 ]
 
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
@@ -197,6 +198,203 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, lengths, layer,
         interpret=interpret,
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
       q, k_pool, v_pool)
+
+
+# ---------------------------------------------------------------------------
+# verify mode: a ragged bundle of K drafted positions per row, one launch
+# ---------------------------------------------------------------------------
+
+
+def _paged_verify_kernel(
+    bt_ref,    # scalar-prefetch [R, table_width] physical block ids (SMEM)
+    base_ref,  # scalar-prefetch [R] accepted tokens resident BEFORE this launch
+    new_ref,   # scalar-prefetch [R] live positions this launch (<= K)
+    q_ref,     # [1, K, H, Dh] — the row's K new-token queries
+    k_ref,     # [1, 1, block_size, H, Dh] — this program's gathered block
+    v_ref,     # [1, 1, block_size, H, Dh]
+    o_ref,     # [1, K, H, Dh]
+    m_sc,      # VMEM [K, H] f32 running max
+    l_sc,      # VMEM [K, H] f32 running denominator
+    acc_sc,    # VMEM [K, H, Dh] f32 running numerator
+    *,
+    block_size: int,
+    sm_scale: float,
+):
+    """The speculative-verify half of the decode kernel: the same
+    scalar-prefetch block-table gather as :func:`_paged_decode_kernel`,
+    but each row carries K query positions (drafted tokens + forced
+    prefix-tail tokens) scored in ONE launch.  Query ``i`` of a row with
+    ``base`` resident tokens attends positions ``< base + i + 1`` —
+    causal among the bundle (whose K/V were written at ``base..base+K-1``
+    before the call) and masked to the row's live length, so rejected
+    drafts beyond the accepted point are structurally unreachable next
+    launch exactly like a freed block's stale tail."""
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    base = base_ref[r]
+    n_new = new_ref[r]
+    K = q_ref.shape[1]
+
+    # any query in the bundle may attend this block?
+    @pl.when(j * block_size < base + n_new)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale        # [K, H, Dh]
+        kb = k_ref[0, 0].astype(jnp.float32)               # [bs, H, Dh]
+        vb = v_ref[0, 0].astype(jnp.float32)
+        # scores per (block slot, query, head)
+        s = jnp.sum(q[None, :, :, :] * kb[:, None, :, :], axis=-1)  # [bs,K,H]
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (block_size, 1, 1), 0
+        )
+        qi = jax.lax.broadcasted_iota(jnp.int32, (1, K, 1), 1)
+        valid = pos < base + qi + 1                        # [bs, K, 1]
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_sc[...]                                  # [K, H]
+        l_prev = l_sc[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0))
+        # masked lanes must contribute 0 even while m_new is still
+        # _NEG_INF (exp(s - m_new) == 1 there)
+        p = jnp.exp(s - m_new[None]) * valid.astype(jnp.float32)
+        alpha = jnp.exp(m_prev - m_new)                     # [K, H]
+        l_new = l_prev * alpha + jnp.sum(p, axis=0)
+        acc_new = acc_sc[...] * alpha[:, :, None] + jnp.sum(
+            p[:, :, :, None] * vb[:, None, :, :], axis=0
+        )                                                   # [K, H, Dh]
+        m_sc[...] = m_new
+        l_sc[...] = l_new
+        acc_sc[...] = acc_new
+
+    # write the running answer every visit (the final visit wins; query
+    # slots past n_new keep l == 0 and emit exact zeros)
+    o_ref[0] = (
+        acc_sc[...] / jnp.maximum(l_sc[...][:, :, None], 1e-30)
+    ).astype(o_ref.dtype)
+
+
+def _paged_verify_pallas(q, k_pool, v_pool, block_tables, base_lengths,
+                         n_new, layer, block_size, sm_scale, interpret):
+    rows, K, heads, dh = q.shape
+    table_w = block_tables.shape[1]
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(rows, table_w),
+        in_specs=[
+            pl.BlockSpec(
+                (1, K, heads, dh), lambda r, j, bt, bl, nn: (r, 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_size, heads, dh),
+                lambda r, j, bt, bl, nn: (layer, bt[r, j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_size, heads, dh),
+                lambda r, j, bt, bl, nn: (layer, bt[r, j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, K, heads, dh), lambda r, j, bt, bl, nn: (r, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((K, heads), jnp.float32),
+            pltpu.VMEM((K, heads), jnp.float32),
+            pltpu.VMEM((K, heads, dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _paged_verify_kernel, block_size=block_size, sm_scale=sm_scale
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, K, heads, dh), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * rows * K * table_w * block_size * heads * dh,
+            bytes_accessed=(
+                2 * rows * table_w * block_size * heads * dh
+                * q.dtype.itemsize
+                + 2 * rows * K * heads * dh * q.dtype.itemsize
+            ),
+            transcendentals=rows * K * table_w * block_size * heads,
+        ),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), base_lengths.astype(jnp.int32),
+      n_new.astype(jnp.int32), q, k_pool, v_pool)
+
+
+def _paged_verify_reference(q, k_pool, v_pool, block_tables, base_lengths,
+                            n_new, layer, block_size, sm_scale):
+    rows, K, heads, dh = q.shape
+    table_w = block_tables.shape[1]
+    seq_cap = table_w * block_size
+    kc = k_pool[layer][block_tables].reshape(rows, seq_cap, heads, dh)
+    vc = v_pool[layer][block_tables].reshape(rows, seq_cap, heads, dh)
+    # the same masked-softmax formulation as _paged_reference with an
+    # extra query axis: contraction stays per-(row, query, head) row-
+    # independent, so a K=1 bundle is bit-identical to the single-token
+    # step (the greedy-parity pin rides this)
+    s = jnp.einsum(
+        "rkhd,rthd->rkht", q, kc, preferred_element_type=jnp.float32,
+    )
+    if sm_scale is None:
+        s = s / np.sqrt(dh)
+    else:
+        s = s * sm_scale
+    t_iota = jnp.arange(seq_cap)
+    limit = base_lengths[:, None] + jnp.arange(K)[None, :] + 1  # [R, K]
+    mask = t_iota[None, None, :] < limit[:, :, None]            # [R, K, S]
+    s = jnp.where(mask[:, :, None, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("rkht,rthd->rkhd", probs, vc)
+
+
+def paged_verify_attention(
+    q,
+    k_pool,
+    v_pool,
+    block_tables,
+    base_lengths,
+    n_new,
+    layer: int,
+    *,
+    block_size: int,
+    sm_scale: float | None = None,
+    mode: str,
+):
+    """Attention for a ragged bundle of K new positions per row in ONE
+    launch — the verify half of speculative decode, and the ingest path
+    for prefix-matched prompt tails.
+
+    ``q``: ``[rows, K, heads, head_dim]`` — each row's K new-token
+    queries (slot ``i`` sits at sequence position ``base_lengths[r] +
+    i``; its K/V must already be written to the pool).
+    ``base_lengths``: accepted tokens resident per row BEFORE this
+    launch.  ``n_new``: live query slots per row (``<= K``; dead rows
+    pass 0 — their outputs are garbage-but-finite and ignored by the
+    host).  Query ``i`` attends positions ``< base + i + 1``: causal
+    over the bundle, masked to the row's live length.  ``mode`` must
+    already be resolved (:func:`resolve_decode_mode`); ``sm_scale=None``
+    means "divide scores by sqrt(head_dim)" — the dense ``lax.scan``
+    formulation the parity oracle pins."""
+    if mode == "reference":
+        return _paged_verify_reference(
+            q, k_pool, v_pool, block_tables, base_lengths, n_new, layer,
+            block_size, None if sm_scale is None else float(sm_scale),
+        )
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    interpret = jax.default_backend() != "tpu"
+    return _paged_verify_pallas(
+        q, k_pool, v_pool, block_tables, base_lengths, n_new, layer,
+        block_size, float(sm_scale), interpret,
+    )
 
 
 # ---------------------------------------------------------------------------

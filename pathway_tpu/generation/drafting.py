@@ -1,0 +1,52 @@
+"""Host-side draft proposal for speculative multi-token decode.
+
+Prompt-lookup / n-gram drafting (the strongest cheap drafter for RAG:
+the answer text is usually sitting verbatim in the retrieved passages
+that make up the prompt): find the most recent earlier occurrence of the
+sequence's current suffix n-gram anywhere in its own prompt + generated
+context and propose the tokens that followed it.  Zero model cost, zero
+device work — the drafts are verified (and mostly amortized away when
+wrong) by the decode kernel's multi-position verify launch, so a bad
+draft costs one rejected lane, not a wrong token: greedy output is
+token-for-token identical with drafting on or off (pinned in
+tests/test_spec_prefix_decode.py).
+
+Stateless and allocation-light on purpose — this runs per live row per
+decode tick under the session lock.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+__all__ = ["propose_draft"]
+
+#: longest suffix n-gram tried first; 1-gram fallback still pays off on
+#: repetitive generation (loops) where any recurrence predicts the next
+#: token
+_MAX_NGRAM = 3
+
+
+def propose_draft(
+    tokens: Sequence[int],
+    k: int,
+    *,
+    max_ngram: int = _MAX_NGRAM,
+) -> list[int]:
+    """Up to ``k`` draft tokens continuing ``tokens``, or ``[]``.
+
+    Tries the longest suffix n-gram first (``max_ngram`` down to 1) and
+    takes the MOST RECENT earlier occurrence — recency beats frequency
+    for decode loops and for answers being copied out of a retrieved
+    passage mid-generation.
+    """
+    n_tokens = len(tokens)
+    if k <= 0 or n_tokens < 2:
+        return []
+    for n in range(min(max_ngram, n_tokens - 1), 0, -1):
+        suffix = tokens[-n:]
+        # rightmost occurrence strictly before the suffix itself
+        for start in range(n_tokens - n - 1, -1, -1):
+            if tokens[start:start + n] == suffix:
+                return list(tokens[start + n:start + n + k])
+    return []

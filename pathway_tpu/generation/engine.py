@@ -54,10 +54,17 @@ from ..ops.ragged_attention import (
 from .decode_kernel import (
     decode_kernel_mode,
     paged_decode_attention,
+    paged_verify_attention,
     resolve_decode_mode,
     validate_decoder_geometry,
 )
-from .paged_kv import PagedKVPool
+from .drafting import propose_draft
+from .paged_kv import (
+    PagedKVPool,
+    PrefixIndex,
+    decode_prefix_share,
+    decode_spec_k,
+)
 
 __all__ = [
     "PagedDecoder",
@@ -78,6 +85,12 @@ _PREFILL_TOKEN_BUCKETS: tuple[int, ...] = (32, 64) + tuple(
 )
 #: dense_s grid for the XLA reference's per-row unpack
 _DENSE_BUCKETS: tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
+
+#: max tokens one row consumes per multi-token launch while ingesting a
+#: forced tail (extension context / prefix-match remainder): one block's
+#: worth keeps the verify launch's K bucket small and the per-tick lock
+#: hold bounded
+_INGEST_K = 16
 
 
 def _bucket_of(n: int, grid: Sequence[int]) -> int:
@@ -206,9 +219,75 @@ def _paged_step_impl(
     return k_pool, v_pool, toks_next
 
 
+def _paged_multi_step_impl(
+    params, k_pool, v_pool, bt, base, n_new, toks, active, seeds, counts,
+    temps, *, cfg: DecoderConfig, block_size: int, mode: str,
+):
+    """One speculative/ingest tick: each live row consumes up to K new
+    tokens (``toks[r, :n_new[r]]``) in a SINGLE launch — drafted tokens
+    plus their verification logits, or an extension's forced tail being
+    ingested against resident pool KV (which the packed ragged prefill
+    cannot attend).  K/V for all K positions land in the row's reserved
+    blocks; lanes at or past ``n_new[r]`` (and dead rows) write nowhere.
+    Sampling uses per-lane counts ``counts[r] + k`` so the emitted
+    stream is exactly the sequential single-step stream — rejected lanes
+    are simply never committed by the host (their KV entries sit beyond
+    the accepted length, structurally unreachable until overwritten)."""
+    R, K = toks.shape
+    D = cfg.hidden_dim
+    H = cfg.num_heads
+    Dh = D // H
+    NB = k_pool.shape[1]
+    W = bt.shape[1]
+    k_iota = jnp.arange(K, dtype=jnp.int32)[None, :]
+    pos = base[:, None] + k_iota                      # [R, K] write positions
+    x = (
+        params["wte"]["embedding"][toks]
+        + params["wpe"]["embedding"][jnp.minimum(pos, cfg.max_len - 1)]
+    ).astype(cfg.dtype)                               # [R, K, D]
+    writing = active[:, None] & (k_iota < n_new[:, None])
+    blk = jnp.minimum(pos // block_size, W - 1)
+    slot = pos % block_size
+    bidx = jnp.take_along_axis(bt, blk, axis=1)       # [R, K]
+    bidx = jnp.where(writing, bidx, NB)               # pad lanes: dropped write
+    for li in range(cfg.num_layers):
+        p = params[f"h_{li}"]
+        h = _ln(x, p["ln_1"], cfg.ln_eps).astype(cfg.dtype)
+        qkv = h @ p["c_attn"]["kernel"] + p["c_attn"]["bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(R, K, H, Dh)
+        k_pool = k_pool.at[li, bidx, slot].set(
+            k.reshape(R, K, H, Dh).astype(k_pool.dtype), mode="drop"
+        )
+        v_pool = v_pool.at[li, bidx, slot].set(
+            v.reshape(R, K, H, Dh).astype(v_pool.dtype), mode="drop"
+        )
+        ctx = paged_verify_attention(
+            q, k_pool, v_pool, bt,
+            jnp.where(active, base, 0), jnp.where(active, n_new, 0), li,
+            block_size=block_size, mode=mode,
+        )
+        x = x + ctx.reshape(R, K, D) @ p["attn_proj"]["kernel"] + p["attn_proj"]["bias"]
+        h2 = _ln(x, p["ln_2"], cfg.ln_eps).astype(cfg.dtype)
+        m = jax.nn.gelu(
+            h2 @ p["c_fc"]["kernel"] + p["c_fc"]["bias"], approximate=True
+        )
+        x = x + m @ p["mlp_proj"]["kernel"] + p["mlp_proj"]["bias"]
+    x = _ln(x, params["ln_f"], cfg.ln_eps)
+    logits = _logits_of(x, params)                    # [R, K, V]
+    counts_grid = counts[:, None] + k_iota
+    seeds_grid = jnp.broadcast_to(seeds[:, None], (R, K))
+    temps_grid = jnp.broadcast_to(temps[:, None], (R, K))
+    toks_out = jax.vmap(jax.vmap(_pick_token))(
+        logits, seeds_grid, counts_grid, temps_grid
+    )
+    return k_pool, v_pool, toks_out
+
+
 _JIT_LOCK = threading.Lock()
 _PREFILL_JIT: Any = None
 _STEP_JIT: Any = None
+_MULTI_JIT: Any = None
 
 
 def _donate() -> tuple[int, ...]:
@@ -247,6 +326,21 @@ def _step_jit():
         return _STEP_JIT
 
 
+def _multi_jit():
+    global _MULTI_JIT
+    with _JIT_LOCK:
+        if _MULTI_JIT is None:
+            from ..internals.flight_recorder import instrument_jit
+
+            fn = jax.jit(
+                _paged_multi_step_impl,
+                static_argnames=("cfg", "block_size", "mode"),
+                donate_argnums=_donate(),
+            )
+            _MULTI_JIT = instrument_jit(fn, "decoder.paged_verify_step")
+        return _MULTI_JIT
+
+
 # ---------------------------------------------------------------------------
 # process-wide observability (metrics provider + health block)
 # ---------------------------------------------------------------------------
@@ -257,6 +351,13 @@ _COUNTERS = {
     "prefill_tokens_total": 0,
     "shed_total": 0,
     "retired_total": 0,
+    # prefix sharing + speculative decode (ISSUE 16)
+    "prefix_hit_blocks_total": 0,
+    "prefix_hit_tokens_total": 0,
+    "prefix_candidate_blocks_total": 0,
+    "cow_copies_total": 0,
+    "draft_proposed_total": 0,
+    "draft_accepted_total": 0,
 }
 _SESSIONS: "weakref.WeakSet[DecodeSession]" = weakref.WeakSet()
 
@@ -301,6 +402,19 @@ class _GenerationMetricsProvider:
             f"pathway_decode_shed_total {counters['shed_total']}",
             "# TYPE pathway_decode_retired_total counter",
             f"pathway_decode_retired_total {counters['retired_total']}",
+            "# TYPE pathway_decode_prefix_hit_blocks_total counter",
+            f"pathway_decode_prefix_hit_blocks_total "
+            f"{counters['prefix_hit_blocks_total']}",
+            "# TYPE pathway_decode_shared_blocks gauge",
+            f"pathway_decode_shared_blocks {s.get('shared_blocks', 0)}",
+            "# TYPE pathway_decode_cow_copies_total counter",
+            f"pathway_decode_cow_copies_total {counters['cow_copies_total']}",
+            "# TYPE pathway_decode_draft_proposed_total counter",
+            f"pathway_decode_draft_proposed_total "
+            f"{counters['draft_proposed_total']}",
+            "# TYPE pathway_decode_draft_accepted_total counter",
+            f"pathway_decode_draft_accepted_total "
+            f"{counters['draft_accepted_total']}",
         ]
         return lines
 
@@ -319,7 +433,7 @@ def generation_status() -> dict[str, Any]:
         "kernel_mode": decode_kernel_mode(),
         **counters,
     }
-    live = pending = used = free = 0
+    live = pending = used = free = shared = 0
     block_size = None
     for s in sessions:
         st = s.stats()
@@ -327,12 +441,22 @@ def generation_status() -> dict[str, Any]:
         pending += st["pending"]
         used += st["kv_blocks_used"]
         free += st["kv_blocks_free"]
+        shared += st["shared_blocks"]
         block_size = st["block_size"]
     status.update(
         live_sequences=live,
         pending=pending,
         kv_blocks_used=used,
         kv_blocks_free=free,
+        shared_blocks=shared,
+    )
+    cand = counters["prefix_candidate_blocks_total"]
+    status["prefix_hit_rate"] = (
+        counters["prefix_hit_blocks_total"] / cand if cand else 0.0
+    )
+    prop = counters["draft_proposed_total"]
+    status["draft_acceptance_rate"] = (
+        counters["draft_accepted_total"] / prop if prop else 0.0
     )
     if block_size is not None:
         status["block_size"] = block_size
@@ -349,6 +473,7 @@ class _Seq:
         "ids", "max_new", "eos_id", "temperature", "seed", "blocks",
         "length", "next_input", "generated", "count", "handle",
         "deadline_at", "retain", "forced", "submitted_at",
+        "all_tokens", "chain", "registered_upto", "cow_spare",
     )
 
     def __init__(self, ids, max_new, eos_id, temperature, seed,
@@ -368,6 +493,13 @@ class _Seq:
         self.retain = bool(retain)
         self.forced: deque[int] = deque()
         self.submitted_at = time.monotonic()
+        #: full known token stream; ``all_tokens[:length]`` is exactly
+        #: the KV-resident tokens (drafting context + prefix registration)
+        self.all_tokens: list[int] = list(ids)
+        self.chain = 0           # prefix-index chain key after registered blocks
+        self.registered_upto = 0  # full blocks content-registered so far
+        #: pre-reserved COW destination for a partially-shared tail block
+        self.cow_spare: int | None = None
 
 
 class GenerationHandle:
@@ -466,11 +598,17 @@ class DecodeSession:
         use_runtime: bool | None = None,
         auto: bool = True,
         name: str = "decode",
+        spec_k: int | None = None,
+        prefix_share: bool | None = None,
     ):
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
         self.mode = resolve_decode_mode(mode)
+        self.spec_k = decode_spec_k() if spec_k is None else max(0, int(spec_k))
+        self.prefix_share = (
+            decode_prefix_share() if prefix_share is None else bool(prefix_share)
+        )
         head_dim = cfg.hidden_dim // cfg.num_heads
         if self.mode == "pallas":
             validate_decoder_geometry(
@@ -582,6 +720,7 @@ class DecodeSession:
             else time.monotonic() + float(deadline_s),
             retain,
         )
+        seq.chain = PrefixIndex.root_key(self.params)
         handle = GenerationHandle(self)
         if stream_cb is not None:
             orig = handle._on_token
@@ -653,18 +792,29 @@ class DecodeSession:
             seq.max_new = int(max_new_tokens)
             seq.generated = []
             seq.forced = deque(extra_ids)
+            seq.all_tokens.extend(extra_ids)
             seq.count += 1  # fresh sampling stream for the continuation
             self._live.append(seq)
             self._work.notify_all()
         return new_handle
 
+    def _free_seq_blocks_locked(self, seq: _Seq) -> None:
+        """Drop every block reference a sequence holds — its table AND
+        its reserved COW spare (refcount decrement; shared blocks stay
+        resident for their remaining readers)."""
+        if seq.blocks:
+            self.pool.allocator.free(seq.blocks)
+            seq.blocks = []
+        if seq.cow_spare is not None:
+            self.pool.allocator.free([seq.cow_spare])
+            seq.cow_spare = None
+
     def release(self, handle: GenerationHandle) -> None:
         """Free a retained sequence's blocks."""
         with self._lock:
             seq = self._retained.pop(id(handle), None)
-            if seq is not None and seq.blocks:
-                self.pool.allocator.free(seq.blocks)
-                seq.blocks = []
+            if seq is not None:
+                self._free_seq_blocks_locked(seq)
             self._work.notify_all()  # freed blocks may unblock admission
 
     def cancel(self, handle: GenerationHandle) -> None:
@@ -689,9 +839,7 @@ class DecodeSession:
             if seq is None:
                 return
             seq.retain = False
-            if seq.blocks:
-                self.pool.allocator.free(seq.blocks)
-                seq.blocks = []
+            self._free_seq_blocks_locked(seq)
             if seq.handle is not None and not seq.handle.done:
                 seq.handle._finish()
             self._work.notify_all()
@@ -718,8 +866,7 @@ class DecodeSession:
         self.ticks_total += 1
         progressed = self._admit_and_prefill_locked()
         if self._live:
-            self._decode_step_locked()
-            progressed = True
+            progressed = self._decode_step_locked() or progressed
         return progressed
 
     def _admit_and_prefill_locked(self) -> bool:
@@ -741,21 +888,87 @@ class DecodeSession:
                 kept.append(seq)
         self._pending = kept
         admitted: list[_Seq] = []
+        matched_any = False
         while self._pending and len(self._live) + len(admitted) < self.max_live:
             seq = self._pending[0]
             need = self.pool.blocks_for(len(seq.ids) + seq.max_new - 1)
+            alloc = self.pool.allocator
+            full: list[int] = []
+            chain = seq.chain
+            partial: tuple[int, int] | None = None
+            if self.prefix_share:
+                full, chain, partial = self.pool.prefix.match(
+                    self.params, seq.ids
+                )
+                _bump(
+                    "prefix_candidate_blocks_total",
+                    self.pool.blocks_for(len(seq.ids) - 1)
+                    if len(seq.ids) > 1 else 0,
+                )
+            # pin the matched blocks FIRST: acquire pulls lingering
+            # (refcount-0, still content-addressed) blocks out of the
+            # free list before alloc could hand them to this very
+            # sequence as fresh blocks and evict their registrations
+            for b in full:
+                alloc.acquire(b)
+            if partial is not None:
+                alloc.acquire(partial[0])
+            # worst-case reservation discounts fully-matched blocks; a
+            # partial match still reserves its block slot PLUS one COW
+            # spare (net: no discount) so the first divergent write can
+            # always copy without allocating under pressure
+            fresh_need = need - len(full)
             t0 = time.monotonic()
-            blocks = self.pool.allocator.alloc(need)
+            fresh = alloc.alloc(fresh_need)
             self._record_span(
-                "kv:alloc", t0, {"blocks": need, "ok": blocks is not None}
+                "kv:alloc", t0,
+                {"blocks": fresh_need, "matched": len(full),
+                 "ok": fresh is not None},
             )
-            if blocks is None:
-                break  # pool full: stays queued until retirements free blocks
-            seq.blocks = blocks
+            if fresh is None:
+                # roll the shares back; pool full — stays queued until
+                # retirements free blocks
+                rollback = list(full) + (
+                    [partial[0]] if partial is not None else []
+                )
+                if rollback:
+                    alloc.free(rollback)
+                break
             self._pending.popleft()
-            admitted.append(seq)
+            if not full and partial is None:
+                seq.blocks = fresh
+                admitted.append(seq)
+                continue
+            # prefix hit: adopt the resident blocks and skip their
+            # prefill entirely — the unmatched tail rides the decode
+            # ticks as forced input (the multi-token verify launch can
+            # attend resident pool KV; the packed ragged prefill cannot)
+            bs = self.pool.block_size
+            matched_len = len(full) * bs + (partial[1] if partial else 0)
+            if partial is not None:
+                seq.blocks = full + [partial[0]] + fresh[1:]
+                seq.cow_spare = fresh[0]
+            else:
+                seq.blocks = full + fresh
+            seq.length = matched_len
+            seq.chain = chain
+            seq.registered_upto = len(full)
+            tail = seq.ids[matched_len:]
+            seq.next_input = tail[0]
+            seq.forced = deque(tail[1:])
+            seq.count = 0
+            hit_blocks = len(full) + (1 if partial is not None else 0)
+            _bump("prefix_hit_blocks_total", hit_blocks)
+            _bump("prefix_hit_tokens_total", matched_len)
+            self._record_span(
+                "kv:prefix_match", t0,
+                {"blocks": hit_blocks, "tokens": matched_len,
+                 "partial": partial is not None},
+            )
+            self._live.append(seq)
+            matched_any = True
         if not admitted:
-            return False
+            return matched_any
         # pack admitted prompts into bounded ragged launches
         start = 0
         try:
@@ -782,13 +995,38 @@ class DecodeSession:
                     continue  # retired during its batch (e.g. instant EOS)
                 if any(s is seq for s in self._live):
                     continue  # made it live: _fail_all covers it
-                if seq.blocks:
-                    self.pool.allocator.free(seq.blocks)
-                    seq.blocks = []
+                self._free_seq_blocks_locked(seq)
                 if seq.handle is not None:
                     seq.handle._finish(exc)
             raise
         return True
+
+    # -- prefix-index registration ---------------------------------------
+    def _register_progress_locked(self, seq: _Seq) -> None:
+        """Content-register every block newly covered by the ACCEPTED
+        length (never blocks holding rejected draft KV) so later prompts
+        can adopt it."""
+        if not self.prefix_share:
+            return
+        bs = self.pool.block_size
+        while (seq.registered_upto + 1) * bs <= seq.length:
+            u = seq.registered_upto
+            seq.chain = self.pool.prefix.register_full(
+                seq.chain, seq.all_tokens[u * bs:(u + 1) * bs], seq.blocks[u]
+            )
+            seq.registered_upto += 1
+
+    def _register_partial_locked(self, seq: _Seq) -> None:
+        """Register the partial tail block (prompt tail at prefill,
+        accepted tail at retirement) — entries below the write cursor
+        stay valid even as the owner keeps appending."""
+        if not self.prefix_share:
+            return
+        bs = self.pool.block_size
+        u = seq.registered_upto
+        tail = seq.all_tokens[u * bs:seq.length]
+        if tail and u < len(seq.blocks):
+            self.pool.prefix.register_partial(seq.chain, tail, seq.blocks[u])
 
     def _prefill_batch_locked(self, batch: list[_Seq]) -> None:
         bs = self.pool.block_size
@@ -855,6 +1093,8 @@ class DecodeSession:
         for j, seq in enumerate(batch):
             seq.length = lens[j]
             seq.count = 1
+            self._register_progress_locked(seq)
+            self._register_partial_locked(seq)
             tok = int(first[j])
             self._consume_token_locked(seq, tok)
             if seq.handle is not None and not seq.handle.done:
@@ -867,6 +1107,7 @@ class DecodeSession:
             seq.next_input = seq.forced.popleft()
             return
         seq.generated.append(tok)
+        seq.all_tokens.append(tok)
         seq.next_input = tok
         _bump("tokens_generated_total")
         seq.handle._on_token(tok)
@@ -879,16 +1120,94 @@ class DecodeSession:
         _bump("retired_total")
         if seq in self._live:
             self._live.remove(seq)
+        # content-register what this sequence produced BEFORE the blocks
+        # go anywhere: retained blocks serve matches while parked, and
+        # non-retained blocks linger in the free list still addressed —
+        # a sequential re-ask of the same prompt revives them for free
+        self._register_progress_locked(seq)
+        self._register_partial_locked(seq)
         if seq.retain:
             self._retained[id(seq.handle)] = seq
-        elif seq.blocks:
-            self.pool.allocator.free(seq.blocks)
-            seq.blocks = []
+        else:
+            self._free_seq_blocks_locked(seq)
         seq.handle._finish()
 
-    def _decode_step_locked(self) -> None:
+    def _prepare_write_locked(self, seq: _Seq, n: int) -> bool:
+        """COW / registration maintenance for the blocks positions
+        ``[seq.length, seq.length + n)`` are about to write.  A shared
+        block (refcount > 1) is copied into the sequence's reserved
+        spare (or a fresh block) first; a sole-owned block's partial
+        registration is truncated at the write cursor.  Returns False to
+        STALL the row this tick when a copy destination cannot be
+        allocated right now — sound, because every other live sequence
+        holds its worst-case reservation and will retire."""
+        bs = self.pool.block_size
+        alloc = self.pool.allocator
+        first = seq.length
+        for bi in range(first // bs, (first + n - 1) // bs + 1):
+            b = seq.blocks[bi]
+            if alloc.refcount(b) > 1:
+                dst = seq.cow_spare
+                if dst is not None:
+                    seq.cow_spare = None
+                else:
+                    got = alloc.alloc(1)
+                    if got is None:
+                        return False
+                    dst = got[0]
+                self.pool.copy_block(b, dst)
+                alloc.free([b])  # drop our read ref; others keep it
+                seq.blocks[bi] = dst
+                _bump("cow_copies_total")
+            else:
+                # sole owner appending into its own registered tail:
+                # entries from the write slot on are clobbered
+                slot = first % bs if bi == first // bs else 0
+                self.pool.prefix.truncate_partial(b, slot)
+        return True
+
+    def _decode_step_locked(self) -> bool:
+        """Advance the live set: plan each row's input bundle (next
+        token + forced-extension tail + prompt-lookup drafts), COW any
+        shared block in the write span, launch, then commit outputs
+        with EXACT sequential semantics — a draft lane is accepted only
+        while it matches what the sequential step stream would have
+        consumed.  Returns whether any row advanced."""
         rows = list(self._live)
-        R = _pow2_bucket(len(rows))
+        bs = self.pool.block_size
+        plans: list[tuple[_Seq, list[int], int, int]] = []
+        k_max = 1
+        for seq in rows:
+            cap = len(seq.blocks) * bs - seq.length
+            inputs = [seq.next_input]
+            n_forced = 0
+            n_draft = 0
+            if seq.forced:
+                take = min(len(seq.forced), _INGEST_K - 1, max(0, cap - 1))
+                for i, t in enumerate(seq.forced):
+                    if i >= take:
+                        break
+                    inputs.append(t)
+                n_forced = take
+            elif self.spec_k > 0:
+                remaining = seq.max_new - len(seq.generated)
+                m = min(self.spec_k, remaining - 1, cap - 1)
+                if m > 0:
+                    draft = propose_draft(seq.all_tokens, m)
+                    if draft:
+                        inputs.extend(draft)
+                        n_draft = len(draft)
+                        _bump("draft_proposed_total", n_draft)
+            plans.append((seq, inputs, n_forced, n_draft))
+            k_max = max(k_max, len(inputs))
+        if k_max <= 1:
+            return self._single_step_locked(plans)
+        return self._multi_step_locked(plans, k_max)
+
+    def _single_step_locked(
+        self, plans: list[tuple[_Seq, list[int], int, int]]
+    ) -> bool:
+        R = _pow2_bucket(len(plans))
         W = self.pool.blocks_per_seq
         bt = np.zeros((R, W), np.int32)
         lengths = np.zeros(R, np.int32)
@@ -897,7 +1216,9 @@ class DecodeSession:
         seeds = np.zeros(R, np.int32)
         counts = np.zeros(R, np.int32)
         temps = np.zeros(R, np.float32)
-        for r, seq in enumerate(rows):
+        for r, (seq, _inputs, _nf, _nd) in enumerate(plans):
+            if not self._prepare_write_locked(seq, 1):
+                continue  # stalled: dead row this tick
             blocks = seq.blocks
             bt[r, : len(blocks)] = blocks
             lengths[r] = seq.length
@@ -906,6 +1227,8 @@ class DecodeSession:
             seeds[r] = seq.seed
             counts[r] = seq.count
             temps[r] = seq.temperature
+        if not active.any():
+            return False
         t0 = time.monotonic()
         k_pool, v_pool, toks_next = _step_jit()(
             self.params, self.pool.k_pool, self.pool.v_pool,
@@ -917,12 +1240,81 @@ class DecodeSession:
         self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
         out = np.asarray(toks_next)  # host read = device sync (handler contract)
         self._record_span(
-            "decode:step", t0, {"rows": len(rows), "bucket": R}
+            "decode:step", t0, {"rows": len(plans), "bucket": R}
         )
-        for r, seq in enumerate(rows):
+        for r, (seq, _inputs, _nf, _nd) in enumerate(plans):
+            if not active[r]:
+                continue
             seq.length += 1
             seq.count += 1
             self._consume_token_locked(seq, int(out[r]))
+            if seq.blocks:
+                self._register_progress_locked(seq)
+        return True
+
+    def _multi_step_locked(
+        self, plans: list[tuple[_Seq, list[int], int, int]], k_max: int
+    ) -> bool:
+        K = max(2, _pow2_bucket(k_max))
+        R = _pow2_bucket(len(plans))
+        W = self.pool.blocks_per_seq
+        bt = np.zeros((R, W), np.int32)
+        base = np.zeros(R, np.int32)
+        n_new = np.zeros(R, np.int32)
+        toks = np.zeros((R, K), np.int32)
+        active = np.zeros(R, bool)
+        seeds = np.zeros(R, np.int32)
+        counts = np.zeros(R, np.int32)
+        temps = np.zeros(R, np.float32)
+        for r, (seq, inputs, _nf, _nd) in enumerate(plans):
+            n = len(inputs)
+            if not self._prepare_write_locked(seq, n):
+                continue  # stalled: dead row this tick
+            blocks = seq.blocks
+            bt[r, : len(blocks)] = blocks
+            base[r] = seq.length
+            n_new[r] = n
+            toks[r, :n] = inputs
+            active[r] = True
+            seeds[r] = seq.seed
+            counts[r] = seq.count
+            temps[r] = seq.temperature
+        if not active.any():
+            return False
+        t0 = time.monotonic()
+        k_pool, v_pool, toks_out = _multi_jit()(
+            self.params, self.pool.k_pool, self.pool.v_pool,
+            jnp.asarray(bt), jnp.asarray(base), jnp.asarray(n_new),
+            jnp.asarray(toks), jnp.asarray(active), jnp.asarray(seeds),
+            jnp.asarray(counts), jnp.asarray(temps),
+            cfg=self.cfg, block_size=self.pool.block_size, mode=self.mode,
+        )
+        self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
+        out = np.asarray(toks_out)  # host read = device sync
+        self._record_span(
+            "decode:verify", t0,
+            {"rows": len(plans), "bucket": R, "k": K},
+        )
+        for r, (seq, inputs, nf, nd) in enumerate(plans):
+            if not active[r]:
+                continue
+            n = int(n_new[r])
+            accepted = 0
+            for j in range(n):
+                if nd and j >= 1 + nf:
+                    accepted += 1  # the draft at inputs[j] got consumed
+                seq.length += 1
+                seq.count += 1
+                self._consume_token_locked(seq, int(out[r, j]))
+                if seq.handle is not None and seq.handle.done:
+                    break  # retired mid-bundle (EOS / max_new)
+                if j + 1 < n and seq.next_input != inputs[j + 1]:
+                    break  # draft diverged: later lanes are rolled back
+            if accepted:
+                _bump("draft_accepted_total", accepted)
+            if seq.blocks:
+                self._register_progress_locked(seq)
+        return True
 
     # -- pump / runtime integration -------------------------------------
     def _ensure_pump_locked(self) -> None:
@@ -988,9 +1380,7 @@ class DecodeSession:
             self._live.clear()
             self._pending.clear()
             for seq in seqs:
-                if seq.blocks:
-                    self.pool.allocator.free(seq.blocks)
-                    seq.blocks = []
+                self._free_seq_blocks_locked(seq)
                 if seq.handle is not None and not seq.handle.done:
                     seq.handle._finish(exc)
         from ..internals.errors import register_error
@@ -1030,6 +1420,10 @@ class DecodeSession:
             "retained": len(self._retained),
             "kv_blocks_used": alloc.used_count,
             "kv_blocks_free": alloc.free_count,
+            "shared_blocks": alloc.shared_count,
+            "prefix_index_entries": len(self.pool.prefix),
+            "spec_k": self.spec_k,
+            "prefix_share": self.prefix_share,
             "block_size": self.pool.block_size,
             "pool_blocks": self.pool.num_blocks,
             "ticks_total": self.ticks_total,

@@ -1,4 +1,5 @@
-"""Paged KV cache: a preallocated HBM block pool + host-side allocator.
+"""Paged KV cache: a preallocated HBM block pool + host-side allocator
+with copy-on-write prefix sharing.
 
 The dense decode path (models/decoder.py) preallocates one contiguous
 ``[B, Tmax, H, Dh]`` cache per launch — every sequence pays ``Tmax``
@@ -12,13 +13,28 @@ fixed-size blocks:
   addresses a contiguous major-axis slice; the per-block gather rides a
   scalar-prefetch block-table array exactly like the ragged kernel's
   ``ragged_bounds``);
-* the HOST side is this module: a free-list :class:`BlockAllocator` and
-  per-sequence block tables.  Admission allocates a sequence's worst-case
-  block count up front (prompt + ``max_new_tokens``), retirement frees
-  them — so "can this request run now" is a pure host-side free-list
-  check, the token-budget admission signal the serving plane sheds on.
+* the HOST side is this module: a REF-COUNTED free-list
+  :class:`BlockAllocator` and per-sequence block tables.  Admission
+  allocates a sequence's worst-case block count up front (prompt +
+  ``max_new_tokens``, DISCOUNTED by prefix-matched blocks), retirement
+  decrements refcounts — so "can this request run now" is a pure
+  host-side free-list check, the token-budget admission signal the
+  serving plane sheds on.
 
-A freed block is reused verbatim (no zeroing): a new tenant overwrites
+Prefix sharing (ISSUE 16): RAG traffic is pathologically shareable —
+every request carries the same template preamble and popular documents
+recur across contexts.  :class:`PrefixIndex` hash-conses FULL blocks on
+``(params identity, token-id chunk)`` chain keys so a later request
+whose prompt starts with an already-resident prefix acquires those
+blocks (refcount + 1) instead of re-prefilling them; the final PARTIAL
+block of a prompt is registered with its token ids and can be shared up
+to the longest common prefix, with the writer copy-on-writing the block
+before its first mutation.  Freed blocks LINGER in the free list still
+content-addressed (refcount 0): a sequential re-ask of the same prompt
+revives them at zero prefill cost; handing a lingering block to a fresh
+allocation forgets its registration first (``on_reuse``).
+
+A reused block is filled verbatim (no zeroing): a new tenant overwrites
 it from position 0 and every attention read is masked to the OWNING
 sequence's live length, so stale tail data is structurally unreachable
 (pinned by the block-reuse test in tests/test_paged_decode.py).
@@ -27,16 +43,19 @@ sequence's live length, so stale tail data is structurally unreachable
 from __future__ import annotations
 
 import math
-import os
 from collections import deque
+from typing import Callable, Sequence
 
 from ..internals.config import env_int as _env_int
 
 __all__ = [
     "BlockAllocator",
     "PagedKVPool",
+    "PrefixIndex",
     "decode_block_size",
     "decode_pool_tokens",
+    "decode_spec_k",
+    "decode_prefix_share",
 ]
 
 
@@ -56,19 +75,53 @@ def decode_pool_tokens() -> int:
     return max(1, v)
 
 
+def decode_spec_k() -> int:
+    """``PATHWAY_DECODE_SPEC_K``: draft tokens proposed per live row per
+    decode launch (default 0 = speculative decode off).  Drafts come
+    from host-side prompt-lookup over the sequence's own prompt+context
+    and are verified in ONE multi-position paged-attention launch."""
+    v = _env_int("PATHWAY_DECODE_SPEC_K", 0)
+    return max(0, v)
+
+
+def decode_prefix_share() -> bool:
+    """``PATHWAY_DECODE_PREFIX_SHARE``: hash-consed copy-on-write KV
+    prefix sharing across requests (default 1 = on; 0 disables both
+    matching and registration)."""
+    return _env_int("PATHWAY_DECODE_PREFIX_SHARE", 1) != 0
+
+
 class BlockAllocator:
-    """Free-list allocator over ``num_blocks`` KV blocks.
+    """Ref-counted free-list allocator over ``num_blocks`` KV blocks.
 
     NOT internally locked — the owning :class:`DecodeSession` serializes
     alloc/free under its session lock.  FIFO reuse (a deque) keeps the
     reuse order deterministic, which the block-reuse parity test relies
-    on to actually exercise reuse."""
+    on to actually exercise reuse.
 
-    __slots__ = ("num_blocks", "_free")
+    Refcounts make sharing safe: :meth:`alloc` hands out blocks at
+    refcount 1, :meth:`acquire` adds a reader (reviving a lingering
+    refcount-0 block out of the free list if needed), and :meth:`free`
+    DECREMENTS — a block only rejoins the free list at refcount zero, so
+    a shared prefix block survives until its last reader retires.
+    ``free`` raises on duplicate or foreign ids: a double-free would
+    hand the same block to two sequences later (ghost attention), and
+    with refcounts an unbalanced decrement silently starves the pool.
+    """
+
+    __slots__ = ("num_blocks", "_free", "_refs", "on_reuse")
 
     def __init__(self, num_blocks: int):
         self.num_blocks = int(num_blocks)
         self._free: deque[int] = deque(range(self.num_blocks))
+        self._refs: list[int] = [0] * self.num_blocks
+        #: called with a block id when a LINGERING block is handed to a
+        #: fresh allocation (the pool forgets its content registration)
+        self.on_reuse: Callable[[int], None] | None = None
+
+    def _check(self, b: int) -> None:
+        if not 0 <= b < self.num_blocks:
+            raise ValueError(f"free/acquire of out-of-range block {b}")
 
     @property
     def free_count(self) -> int:
@@ -78,24 +131,224 @@ class BlockAllocator:
     def used_count(self) -> int:
         return self.num_blocks - len(self._free)
 
+    @property
+    def shared_count(self) -> int:
+        """Blocks referenced by two or more sequences right now."""
+        return sum(1 for r in self._refs if r >= 2)
+
+    def refcount(self, b: int) -> int:
+        self._check(b)
+        return self._refs[b]
+
     def alloc(self, n: int) -> list[int] | None:
-        """``n`` blocks, or ``None`` when the pool cannot satisfy the
-        request right now (the caller keeps the work queued)."""
+        """``n`` fresh blocks at refcount 1, or ``None`` when the pool
+        cannot satisfy the request right now (the caller keeps the work
+        queued).  A lingering registration on a reused block is evicted
+        via ``on_reuse`` before the block is handed out."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
-        return [self._free.popleft() for _ in range(n)]
+        out: list[int] = []
+        for _ in range(n):
+            b = self._free.popleft()
+            self._refs[b] = 1
+            if self.on_reuse is not None:
+                self.on_reuse(b)
+            out.append(b)
+        return out
+
+    def acquire(self, b: int) -> int:
+        """Add a reader to ``b``: refcount + 1 for a live block, or
+        revive a lingering (refcount 0, still content-addressed) block
+        out of the free list.  Returns the new refcount."""
+        self._check(b)
+        if self._refs[b] == 0:
+            try:
+                self._free.remove(b)
+            except ValueError:
+                raise ValueError(
+                    f"acquire of block {b}: refcount 0 but not in the "
+                    "free list (allocator state corrupted)"
+                ) from None
+            self._refs[b] = 1
+        else:
+            self._refs[b] += 1
+        return self._refs[b]
 
     def free(self, blocks: list[int]) -> None:
+        """Decrement each block's refcount; a block rejoins the FIFO
+        free list only at zero.  Raises ``ValueError`` on out-of-range,
+        duplicate-in-call, or already-free ids — a silent double-free
+        hands the same block to two sequences later (ghost attention),
+        and refcounting makes the balance load-bearing."""
+        if len(set(blocks)) != len(blocks):
+            raise ValueError(
+                f"free of duplicate block ids in one call: {sorted(blocks)}"
+            )
         for b in blocks:
-            if not 0 <= b < self.num_blocks:
-                raise ValueError(f"free of out-of-range block {b}")
-            self._free.append(b)
+            self._check(b)
+            if self._refs[b] <= 0:
+                raise ValueError(
+                    f"double free of KV block {b} (refcount already 0)"
+                )
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._free.append(b)
+
+
+class PrefixIndex:
+    """Content-hash table over resident KV blocks.
+
+    FULL blocks key on a CHAIN hash: ``key_j = hash((key_{j-1},
+    chunk_j))`` rooted at the params identity — K/V content at position
+    ``i`` depends on the ENTIRE token prefix, so a block is only
+    reusable when every preceding chunk matches too, which the chain
+    encodes for free.  Stored chunks are verified verbatim on match
+    (Python hashes can collide).  The final PARTIAL chunk of a prompt or
+    retired sequence registers under its prefix key with its literal
+    token ids; a later prompt sharing all full chunks can adopt the
+    block up to the longest common prefix and copy-on-writes before its
+    first divergent write.
+
+    All mutation happens under the owning session's lock.
+    """
+
+    __slots__ = ("block_size", "_by_key", "_block_full", "_partials",
+                 "_block_partial")
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._by_key: dict[int, int] = {}
+        #: block -> (key, prev_key, chunk) for eviction + verification
+        self._block_full: dict[int, tuple[int, int, tuple[int, ...]]] = {}
+        #: prev_key -> {block: partial token tuple}
+        self._partials: dict[int, dict[int, tuple[int, ...]]] = {}
+        self._block_partial: dict[int, int] = {}
+
+    @staticmethod
+    def root_key(params: object) -> int:
+        """Chain root: the params identity — two sessions over different
+        weights must never share KV content."""
+        return hash(("pathway-kv-chain-root", id(params)))
+
+    @staticmethod
+    def chain_key(prev_key: int, chunk: Sequence[int]) -> int:
+        return hash((prev_key, tuple(chunk)))
+
+    def __len__(self) -> int:
+        return len(self._block_full) + len(self._block_partial)
+
+    # -- registration ----------------------------------------------------
+    def register_full(self, prev_key: int, chunk: Sequence[int],
+                      block: int) -> int:
+        """Register a FULL block's content; first registration of a key
+        wins (duplicate content in two blocks keeps one address).
+        Returns the chain key for the NEXT chunk regardless."""
+        chunk = tuple(chunk)
+        key = self.chain_key(prev_key, chunk)
+        if key not in self._by_key and block not in self._block_full:
+            # a stale partial registration on the same block is
+            # superseded by the full content
+            self.forget_partial(block)
+            self._by_key[key] = block
+            self._block_full[block] = (key, prev_key, chunk)
+        return key
+
+    def register_partial(self, prev_key: int, tokens: Sequence[int],
+                         block: int) -> None:
+        tokens = tuple(tokens)
+        if not tokens or block in self._block_full:
+            return
+        if block in self._block_partial:
+            return  # first registration wins (content identical anyway)
+        self._partials.setdefault(prev_key, {})[block] = tokens
+        self._block_partial[block] = prev_key
+
+    # -- invalidation ----------------------------------------------------
+    def forget(self, block: int) -> None:
+        """Drop every registration for ``block`` (reused for a fresh
+        allocation, or its owner is about to overwrite it)."""
+        meta = self._block_full.pop(block, None)
+        if meta is not None and self._by_key.get(meta[0]) == block:
+            del self._by_key[meta[0]]
+        self.forget_partial(block)
+
+    def forget_partial(self, block: int) -> None:
+        prev = self._block_partial.pop(block, None)
+        if prev is not None:
+            entries = self._partials.get(prev)
+            if entries is not None:
+                entries.pop(block, None)
+                if not entries:
+                    del self._partials[prev]
+
+    def truncate_partial(self, block: int, keep: int) -> None:
+        """The sole owner is about to write slot ``keep``: entries
+        before it stay valid, the rest are clobbered — shrink the
+        registration instead of dropping the shareable head."""
+        prev = self._block_partial.get(block)
+        if prev is None:
+            return
+        tokens = self._partials[prev][block]
+        if keep <= 0:
+            self.forget_partial(block)
+        elif keep < len(tokens):
+            self._partials[prev][block] = tokens[:keep]
+
+    # -- matching --------------------------------------------------------
+    def match(
+        self, params: object, tokens: Sequence[int]
+    ) -> tuple[list[int], int, tuple[int, int] | None]:
+        """Longest resident prefix of ``tokens`` at block granularity.
+
+        Returns ``(full_blocks, chain_key, partial)`` where
+        ``full_blocks`` are the matched FULL blocks in order,
+        ``chain_key`` is the key after the matched chain (the root key
+        when nothing matched), and ``partial`` is ``(block, lcp)`` for
+        an adoptable partial tail block or ``None``.  The match is
+        capped at ``len(tokens) - 1``: at least one prompt token must
+        still run so the sequence has logits to sample its first token
+        from."""
+        bs = self.block_size
+        usable = len(tokens) - 1
+        prev = self.root_key(params)
+        full: list[int] = []
+        j = 0
+        while (j + 1) * bs <= usable:
+            chunk = tuple(tokens[j * bs:(j + 1) * bs])
+            key = self.chain_key(prev, chunk)
+            block = self._by_key.get(key)
+            if block is None:
+                break
+            stored = self._block_full[block]
+            if stored[1] != prev or stored[2] != chunk:
+                break  # hash collision: verify failed, stop matching
+            full.append(block)
+            prev = key
+            j += 1
+        partial: tuple[int, int] | None = None
+        entries = self._partials.get(prev)
+        if entries:
+            remainder = tuple(tokens[j * bs:usable])
+            best_block, best_lcp = -1, 0
+            for block, reg in entries.items():
+                lcp = 0
+                for a, b in zip(reg, remainder):
+                    if a != b:
+                        break
+                    lcp += 1
+                if lcp > best_lcp:
+                    best_block, best_lcp = block, lcp
+            if best_lcp > 0:
+                partial = (best_block, best_lcp)
+        return full, prev, partial
 
 
 class PagedKVPool:
-    """The device half: K and V block pools plus the allocator.
+    """The device half: K and V block pools plus the allocator and the
+    content-addressed prefix index.
 
     Pools are ordinary jax arrays carried FUNCTIONALLY — each jitted
     prefill/step returns updated pools and the session swaps its
@@ -127,10 +380,21 @@ class PagedKVPool:
         self.k_pool = jnp.zeros(shape, cfg.dtype)
         self.v_pool = jnp.zeros(shape, cfg.dtype)
         self.allocator = BlockAllocator(self.num_blocks)
+        self.prefix = PrefixIndex(self.block_size)
+        # a lingering (freed-but-registered) block handed to a fresh
+        # allocation stops being content-addressable first
+        self.allocator.on_reuse = self.prefix.forget
 
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens`` KV entries."""
         return max(1, -(-int(n_tokens) // self.block_size))
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """Copy-on-write device copy: duplicate ``src``'s K/V content
+        across every layer into ``dst`` (the writer's private copy; the
+        remaining readers keep ``src``)."""
+        self.k_pool = self.k_pool.at[:, dst].set(self.k_pool[:, src])
+        self.v_pool = self.v_pool.at[:, dst].set(self.v_pool[:, src])
 
     def hbm_bytes(self) -> int:
         import numpy as np

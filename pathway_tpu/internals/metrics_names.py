@@ -262,6 +262,26 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "sequences retired (EOS or max_new_tokens reached; blocks freed unless retained)",
     ),
+    "pathway_decode_prefix_hit_blocks_total": (
+        "counter",
+        "KV blocks adopted from the content-addressed prefix index instead of prefilled",
+    ),
+    "pathway_decode_shared_blocks": (
+        "gauge",
+        "KV blocks currently referenced by two or more sequences (refcount >= 2)",
+    ),
+    "pathway_decode_cow_copies_total": (
+        "counter",
+        "copy-on-write block duplications before a write into a shared KV block",
+    ),
+    "pathway_decode_draft_proposed_total": (
+        "counter",
+        "speculative draft tokens proposed by host-side prompt-lookup drafting",
+    ),
+    "pathway_decode_draft_accepted_total": (
+        "counter",
+        "speculative draft tokens accepted by the multi-position verify launch",
+    ),
 }
 
 
