@@ -388,6 +388,21 @@ def fire_spec() -> bool:
     )
 
 
+def fire_fleet() -> bool:
+    """Replicated serving fleet on the real chip (ISSUE 17):
+    serving_bench.py --replicas 2 runs the SLO-aware router over two
+    replica subprocesses sharing the host — aggregate QPS ratio vs N=1,
+    kill-window p99 and zero-failure failover.  Success requires a
+    platform=="tpu" rag_serving_fleet record; it additionally lands in
+    chip_results.jsonl."""
+    return _fire_tpu_jsonl(
+        [os.path.join(HERE, "serving_bench.py"), "48", "--replicas", "2"],
+        960.0,
+        {"SERVING_BENCH_BUDGET_S": "900"},
+        bank_metric="rag_serving_fleet",
+    )
+
+
 def fire_profile() -> bool:
     """On-demand device profiling on the real chip (ISSUE 15):
     benchmarks/obs_overhead.py --profile-probe starts a live webserver
@@ -588,6 +603,7 @@ def main() -> int:
         "cache": False,
         "decode": False,
         "spec": False,
+        "fleet": False,
         "profile": False,
     }
     fire = {
@@ -604,6 +620,7 @@ def main() -> int:
         "cache": fire_cache,
         "decode": fire_decode_cb,
         "spec": fire_spec,
+        "fleet": fire_fleet,
         "profile": fire_profile,
     }
     last_bank = None  # monotonic() of the last banked record

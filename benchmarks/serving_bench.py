@@ -55,6 +55,20 @@ swaps MiniLM for a small random-init REAL encoder (the token-hash cache
 key needs a real tokenizer, so this mode never uses the hash-only fake).
 
 Run: ``JAX_PLATFORMS=cpu python benchmarks/serving_bench.py 120 --zipf 1.1 --clients 8 --mock``
+
+Fleet mode (``--replicas N``): the replicated serving fleet (ISSUE 17)
+measured — an in-process :class:`FleetRouter` fronts N replica
+subprocesses (``pathway_tpu.fleet.launcher``), each with an emulated
+per-replica accelerator (``FLEET_BENCH_DEVICE_MS`` per-row device
+sleep).  Sweeps N=1/2/4 clipped to the requested max: router fan-out
+ingest + convergence probe, then 6×N closed-loop clients per point,
+with one replica SIGKILLed mid-run at the largest N.  Reports aggregate
+QPS + p50/p99 per point, QPS ratios vs N=1 (acceptance: ≥1.7× at N=2,
+≥3× at N=4), the kill-window p99, and the router's failover/breaker
+counters; banks a ``metric=rag_serving_fleet`` row to
+``benchmarks/bench_results.jsonl``.
+
+Run: ``JAX_PLATFORMS=cpu python benchmarks/serving_bench.py 48 --replicas 4``
 """
 
 from __future__ import annotations
@@ -1276,7 +1290,231 @@ def run_contention_phase(phase: str, n_docs: int, clients: int,
     return res
 
 
+# ---------------------------------------------------------------------------
+# fleet mode (--replicas N): SLO-aware router over N replica processes
+# (ISSUE 17).  Each replica is its own process with its own engine and an
+# EMULATED accelerator — a per-process device lock + fixed per-item sleep
+# (FLEET_BENCH_DEVICE_MS), the same device-emulation idiom the contention
+# mode uses for ONE device, except each replica owns its own.  On this
+# one-core box that models "N hosts, one accelerator each": the sleeps
+# overlap across processes (off-CPU, like real device time), the CPU work
+# does not, so aggregate QPS measures the ROUTER layer's scaling, which
+# is the thing under test.  The kill phase SIGKILLs a replica mid-run:
+# the router's breaker + retry-on-next-replica must keep client failures
+# at zero with bounded p99.
+# ---------------------------------------------------------------------------
+
+
+def _run_fleet_loadgen(url: str, n_docs: int, clients: int,
+                       queries_per_client: int) -> None:
+    """Child-process load generator against the ROUTER url.  Queries get
+    a per-request nonce so no replica-side result/embedding cache can
+    short-circuit the emulated device — the scaling measurement must pay
+    full service time on every request.  Prints wall-stamped samples so
+    the parent can cut a replica-kill tail-latency window."""
+    import threading
+    import urllib.request
+
+    docs = _corpus(n_docs)
+    samples: list = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients)
+
+    def worker(wid: int) -> None:
+        barrier.wait()
+        for i in range(queries_per_client):
+            base = docs[(wid * 31 + i * 7) % len(docs)]
+            q = f"{base[:96]} nonce{wid}x{i}"
+            body = json.dumps({"query": q, "k": 1}).encode()
+            t0 = time.perf_counter()
+            ok = 1
+            try:
+                req = urllib.request.Request(
+                    url + "/v1/retrieve", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    resp.read()
+                    if resp.status != 200:
+                        ok = 0
+            except Exception:
+                ok = 0
+            ms = (time.perf_counter() - t0) * 1000.0
+            with lock:
+                samples.append((round(time.time(), 3), round(ms, 3), ok))
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    print(json.dumps({"samples": samples}))
+
+
+def _fleet_phase(n_replicas: int, n_docs: int, queries_per_client: int,
+                 emu_ms: float, kill: bool) -> dict:
+    """One sweep point: router (in-process) + N replica subprocesses,
+    ingest via fan-out + convergence probe, measured load from a child
+    process, optional mid-run SIGKILL of one replica."""
+    import subprocess
+    import urllib.request
+
+    from pathway_tpu.fleet import launcher
+    from pathway_tpu.fleet.router import FleetRouter
+
+    router = FleetRouter(poll_interval_s=0.5)
+    rport = router.start(port=_free_port())
+    router_url = f"http://127.0.0.1:{rport}"
+    procs: list = []
+    rec: dict = {"replicas": n_replicas}
+    try:
+        for i in range(n_replicas):
+            procs.append(launcher.spawn_replica(
+                port=_free_port(), router_url=router_url,
+                name=f"r{i}",
+                env={"PATHWAY_FLEET_EMU_DEVICE_MS": f"{emu_ms:g}"},
+            ))
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if router.live_count() >= n_replicas:
+                break
+            if any(p.poll() is not None for p in procs):
+                raise RuntimeError("a replica died during bring-up")
+            time.sleep(0.5)
+        else:
+            raise TimeoutError(
+                f"only {router.live_count()}/{n_replicas} replicas registered"
+            )
+
+        docs = _corpus(n_docs)
+        body = json.dumps({
+            "docs": [
+                {"doc_id": f"d{i:04d}", "text": t}
+                for i, t in enumerate(docs)
+            ]
+        }).encode()
+        t0 = time.monotonic()
+        req = urllib.request.Request(
+            router_url + "/v1/fleet/ingest", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            watermark = json.loads(resp.read().decode())["watermark"]
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                router_url + f"/v1/fleet/converged?watermark={watermark}",
+                timeout=10,
+            ) as resp:
+                if json.loads(resp.read().decode())["converged"]:
+                    break
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("fleet never converged on the ingest watermark")
+        rec["convergence_s"] = round(time.monotonic() - t0, 3)
+
+        clients = 6 * n_replicas
+        rec["clients"] = clients
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--fleet-loadgen",
+             router_url, str(n_docs), str(clients),
+             str(queries_per_client)],
+            stdout=subprocess.PIPE, text=True,
+        )
+        kill_at = None
+        if kill and n_replicas > 1:
+            expected_s = (
+                clients * queries_per_client * (emu_ms / 1000.0) / n_replicas
+            )
+            time.sleep(max(3.0, 0.35 * expected_s))
+            kill_at = time.time()
+            procs[-1].kill()
+        out, _ = proc.communicate(timeout=1200)
+        samples = json.loads(out.strip().splitlines()[-1])["samples"]
+        lat_ok = [ms for (_t, ms, ok) in samples if ok]
+        failures = sum(1 for (_t, _ms, ok) in samples if not ok)
+        span = max(t for (t, _ms, _ok) in samples) - min(
+            t for (t, _ms, _ok) in samples
+        )
+        rec.update(
+            qps=round(len(lat_ok) / max(span, 1e-6), 2),
+            p50_ms=round(_pctl(lat_ok, 0.50), 2),
+            p99_ms=round(_pctl(lat_ok, 0.99), 2),
+            queries=len(samples),
+            failures=failures,
+        )
+        if kill_at is not None:
+            window = [
+                ms for (t, ms, ok) in samples
+                if ok and kill_at <= t <= kill_at + 6.0
+            ]
+            rec["kill"] = {
+                "window_s": 6.0,
+                "queries": len(window),
+                "p99_ms": round(_pctl(window, 0.99), 2) if window else None,
+            }
+        rec["router"] = router.stats()["counters"]
+        return rec
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        router.stop()
+
+
+def run_fleet(n_docs: int, max_replicas: int,
+              queries_per_client: int) -> dict:
+    """``--replicas N``: aggregate QPS + p99 at N=1/2/4 (clipped to the
+    requested max) with a replica-kill window at the largest N; banks a
+    ``metric=rag_serving_fleet`` row to benchmarks/bench_results.jsonl."""
+    import jax
+
+    emu_ms = float(os.environ.get("FLEET_BENCH_DEVICE_MS", "40"))
+    sweep = [n for n in (1, 2, 4) if n <= max_replicas]
+    if max_replicas not in sweep:
+        sweep.append(max_replicas)
+    phases: dict = {}
+    for n in sweep:
+        phases[str(n)] = _fleet_phase(
+            n, n_docs, queries_per_client, emu_ms,
+            kill=(n == sweep[-1] and n > 1),
+        )
+    rec = {
+        "metric": "rag_serving_fleet",
+        "platform": jax.devices()[0].platform,
+        "n_docs": n_docs,
+        "emu_device_ms": emu_ms,
+        "queries_per_client": queries_per_client,
+        "fleet": phases,
+    }
+    base_qps = phases[str(sweep[0])]["qps"]
+    checks = []
+    for n, floor in ((2, 1.7), (4, 3.0)):
+        if str(n) in phases and base_qps > 0:
+            ratio = round(phases[str(n)]["qps"] / base_qps, 2)
+            rec[f"qps_ratio_n{n}"] = ratio
+            checks.append(ratio >= floor)
+    total_failures = sum(p["failures"] for p in phases.values())
+    rec["failures"] = total_failures
+    rec["ok"] = bool(checks) and all(checks) and total_failures == 0
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(os.path.join(HERE, "bench_results.jsonl"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--fleet-loadgen":
+        url, n_s, clients_s, qpc_s = sys.argv[2:6]
+        _run_fleet_loadgen(url, int(n_s), int(clients_s), int(qpc_s))
+        sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "--loadgen":
         url, n_docs_s, clients_s, qpc_s, pace_s = sys.argv[2:7]
         _run_loadgen(url, int(n_docs_s), int(clients_s), int(qpc_s),
@@ -1345,8 +1583,17 @@ if __name__ == "__main__":
         i = args.index("--zipf")
         zipf_s = float(args[i + 1])
         del args[i : i + 2]
+    replicas = 0
+    if "--replicas" in args:
+        i = args.index("--replicas")
+        replicas = int(args[i + 1])
+        del args[i : i + 2]
+        if "--queries-per-client" not in sys.argv:
+            qpc = 60  # longer phases so the kill window holds samples
     n = int(args[0]) if args else 120
-    if zipf_s > 0:
+    if replicas > 0:
+        out = run_fleet(n, replicas, qpc)
+    elif zipf_s > 0:
         if clients <= 0:
             clients = 8
         out = run_zipf(n, zipf_s, clients, qpc, mock)

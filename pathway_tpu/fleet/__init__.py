@@ -1,0 +1,58 @@
+"""Inter-host serving fleet (ROADMAP item 1): SLO-aware router,
+replica lifecycle, ingest fan-out convergence, closed-loop autoscaling.
+
+The layer ABOVE one host's mesh: N single-host replicas (each its own
+process with its own engine, index shards, and health registry) behind
+a thin asyncio router that speaks the same ``/v1/*`` surface.
+
+* :mod:`.balancer` — pure selection: consistent-hash affinity on the
+  normalized query hash + least-loaded spill from polled ``"slo"`` /
+  ``"capacity"`` health blocks.
+* :mod:`.router` — the proxy process: per-replica circuit breaking,
+  retry-on-next-replica under one traceparent, ingest fan-out with
+  watermark convergence, ``pathway_fleet_*`` metrics on ``/status``.
+* :mod:`.member` — replica-side: registration + heartbeats, graceful
+  drain (503 + Retry-After on serving routes, control routes stay up),
+  freshness-watermark tracking wired into the PR 15 indexed listener.
+* :mod:`.autoscale` — injectable-clock controller: spawn on ``warn``
+  burn verdicts, drain after sustained ``ok``.
+* :mod:`.launcher` — one-process-per-replica bring-up, snapshot-seeded
+  from the fleet's chunked snapshot store (zero re-embeds).
+
+Import discipline: nothing here imports jax; the router process stays
+engine-free and ``/v1/health``'s ``fleet`` block is gated on this
+package already being imported (``_attach_module_block``).
+"""
+
+from __future__ import annotations
+
+from .autoscale import AutoscaleController
+from .balancer import HashRing, Plan, ReplicaView, normalize_query, plan, query_hash
+from .member import (
+    FleetMember,
+    activate_member,
+    deactivate_member,
+    fleet_status,
+    get_member,
+    is_draining,
+)
+from .router import DEFAULT_SERVING_ROUTES, FleetRouter, ReplicaState
+
+__all__ = [
+    "AutoscaleController",
+    "DEFAULT_SERVING_ROUTES",
+    "FleetMember",
+    "FleetRouter",
+    "HashRing",
+    "Plan",
+    "ReplicaState",
+    "ReplicaView",
+    "activate_member",
+    "deactivate_member",
+    "fleet_status",
+    "get_member",
+    "is_draining",
+    "normalize_query",
+    "plan",
+    "query_hash",
+]

@@ -1,0 +1,176 @@
+"""Closed-loop fleet autoscaling on burn-rate verdicts.
+
+The SLO engine (observability/slo.py) already grades every endpoint
+``ok``/``warn``/``burning`` from multi-window burn rates; the router
+aggregates the fleet-wide worst verdict.  This controller closes the
+loop: add capacity the moment ANY endpoint flips to ``warn`` (before
+``burning`` — by the time the hot window confirms a burn, a cold
+replica spawned at ``warn`` has finished its snapshot-seeded bring-up),
+and drain one replica after a sustained-``ok`` cooldown.
+
+Everything is injected — verdict source, replica count, spawn/drain
+actions, and the **clock** — so the loop is a pure unit-testable state
+machine (the acceptance test drives it with explicit clocks, no
+sleeps).  ``run()`` wraps ``tick()`` in a daemon thread for production
+use.
+
+Spawned replicas are snapshot-seeded by construction: ``spawn()``
+implementations (``fleet/launcher.py``) start the new process over the
+fleet's shared chunked-snapshot store (PR 6), so bring-up bulk-restores
+with ZERO re-embeds and the member only registers with the router once
+``/v1/health`` reports ready.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from ..internals.monitoring import register_metrics_provider_once
+from .balancer import worst_verdict
+
+__all__ = ["AutoscaleController"]
+
+_SCALE_VERDICTS = ("warn", "burning")
+
+
+class _AutoscaleMetrics:
+    """Process-wide ``pathway_fleet_autoscale_total`` counters (one
+    controller per router in practice, but the provider registry wants
+    a stable owner)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.actions: dict[str, int] = {"spawn": 0, "drain": 0}
+
+    def bump(self, action: str) -> None:
+        with self._lock:
+            self.actions[action] = self.actions.get(action, 0) + 1
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {"autoscale": dict(self.actions)}
+
+    def openmetrics_lines(self) -> list[str]:
+        # TYPE leads: these lines render inside arbitrary StatsMonitor
+        # expositions (process-global provider) and must parse standalone
+        with self._lock:
+            return [
+                "# TYPE pathway_fleet_autoscale_total counter",
+                *(
+                    f'pathway_fleet_autoscale_total{{action="{a}"}} {n}'
+                    for a, n in sorted(self.actions.items())
+                ),
+            ]
+
+
+def _metrics() -> _AutoscaleMetrics:
+    return register_metrics_provider_once(
+        "fleet_autoscale", _AutoscaleMetrics
+    )
+
+
+class AutoscaleController:
+    """``tick()``-driven spawn/drain state machine (module docstring).
+
+    Parameters
+    ----------
+    verdicts:
+        ``() -> dict[replica, verdict]`` — per-replica worst endpoint
+        verdicts (``FleetRouter.slo_verdicts``).
+    count:
+        ``() -> int`` — current live replica count.
+    spawn / drain:
+        capacity actions; ``spawn()`` must block-or-queue the
+        snapshot-seeded bring-up, ``drain()`` a graceful drain.
+    """
+
+    def __init__(
+        self,
+        verdicts: Callable[[], "dict[str, str]"],
+        count: Callable[[], int],
+        spawn: Callable[[], Any],
+        drain: Callable[[], Any],
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        ok_cooldown_s: float = 60.0,
+        spawn_cooldown_s: float = 30.0,
+    ):
+        self.verdicts = verdicts
+        self.count = count
+        self.spawn = spawn
+        self.drain = drain
+        self.clock = clock
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.ok_cooldown_s = ok_cooldown_s
+        self.spawn_cooldown_s = spawn_cooldown_s
+        self._last_spawn_at: float | None = None
+        self._ok_since: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.events: list[dict[str, Any]] = []
+
+    def tick(self) -> str | None:
+        """Evaluate once; returns the action taken ("spawn"/"drain") or
+        None."""
+        now = self.clock()
+        verdict = worst_verdict(list(self.verdicts().values()))
+        n = self.count()
+        if verdict in _SCALE_VERDICTS:
+            # burn in progress: reset the drain cooldown unconditionally
+            self._ok_since = None
+            if n >= self.max_replicas:
+                return None
+            if (
+                self._last_spawn_at is not None
+                and now - self._last_spawn_at < self.spawn_cooldown_s
+            ):
+                # one spawn per cooldown: the new replica needs time to
+                # restore and absorb load before the verdict re-reads
+                return None
+            self._last_spawn_at = now
+            self._record("spawn", verdict, n, now)
+            self.spawn()
+            return "spawn"
+        if verdict == "ok" and n > 0:
+            if self._ok_since is None:
+                self._ok_since = now
+                return None
+            if now - self._ok_since >= self.ok_cooldown_s:
+                if n <= self.min_replicas:
+                    return None
+                self._ok_since = now  # one drain per sustained-ok window
+                self._record("drain", verdict, n, now)
+                self.drain()
+                return "drain"
+        return None
+
+    def _record(self, action: str, verdict: str, n: int, now: float) -> None:
+        self.events.append(
+            {"action": action, "verdict": verdict, "replicas": n, "at": now}
+        )
+        _metrics().bump(action)
+
+    # -- production loop --------------------------------------------------
+    def run(self, interval_s: float = 2.0) -> None:
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — the loop must survive
+                    pass
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="fleet-autoscale"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

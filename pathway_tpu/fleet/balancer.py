@@ -1,0 +1,217 @@
+"""Replica selection for the fleet router — pure logic, no I/O, no jax.
+
+Two placement signals compose (ROADMAP item 1, the layer above the
+intra-replica mesh):
+
+* **consistent hashing** on a normalized query hash keeps repeat and
+  near-duplicate queries on ONE replica, so that replica's embedding /
+  result caches (PR 13) keep their hit rate instead of being diluted
+  N ways — the same token-hash normalization idea the query cache keys
+  on (casing/whitespace variants of a query land on the same replica);
+* **least-loaded fallback** driven by each replica's polled
+  ``/v1/health`` ``"slo"`` / ``"capacity"`` blocks (PR 15): when the
+  affinity owner is hot (burn verdict ``warn``/``burning``, runtime
+  queues deep, or simply carrying the most in-flight requests) the
+  query spills to the coldest routable replica instead of piling on.
+
+``plan()`` returns the full failover ORDER, not a single pick: the
+router walks it on 503 / connection errors so an idempotent read
+survives a replica kill with zero client-visible failures.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any
+
+__all__ = [
+    "HashRing",
+    "ReplicaView",
+    "load_score",
+    "normalize_query",
+    "plan",
+    "query_hash",
+    "worst_verdict",
+]
+
+#: burn-rate verdict severity order (observability/slo.py emits these)
+_VERDICT_RANK = {"ok": 0, "warn": 1, "burning": 2}
+
+
+def normalize_query(text: str) -> str:
+    """Casing/whitespace variants of a query hash identically — the same
+    equivalence the query cache's token-hash key gives (PR 13), so cache
+    affinity survives sloppy clients."""
+    return " ".join(str(text).casefold().split())
+
+
+def query_hash(text: str) -> int:
+    digest = hashlib.blake2b(
+        normalize_query(text).encode("utf-8"), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big")
+
+
+def _point(name: str, vnode: int) -> int:
+    digest = hashlib.blake2b(
+        f"{name}#{vnode}".encode("utf-8"), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes: adding/removing one
+    replica moves ~1/N of the keyspace instead of reshuffling all
+    affinity (and therefore all warmed caches)."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = vnodes
+        self._points: list[int] = []
+        self._owners: dict[int, str] = {}
+        self._nodes: set[str] = set()
+
+    def add(self, name: str) -> None:
+        if name in self._nodes:
+            return
+        self._nodes.add(name)
+        for v in range(self.vnodes):
+            p = _point(name, v)
+            if p in self._owners:  # vanishing-probability collision
+                continue
+            self._owners[p] = name
+            bisect.insort(self._points, p)
+
+    def remove(self, name: str) -> None:
+        if name not in self._nodes:
+            return
+        self._nodes.discard(name)
+        for v in range(self.vnodes):
+            p = _point(name, v)
+            if self._owners.get(p) == name:
+                del self._owners[p]
+                i = bisect.bisect_left(self._points, p)
+                if i < len(self._points) and self._points[i] == p:
+                    del self._points[i]
+
+    def nodes(self) -> set[str]:
+        return set(self._nodes)
+
+    def preference(self, key_hash: int, k: int | None = None) -> list[str]:
+        """Distinct owners walking clockwise from ``key_hash`` — element
+        0 is the affinity owner, the rest the consistent failover order."""
+        if not self._points:
+            return []
+        want = len(self._nodes) if k is None else min(k, len(self._nodes))
+        out: list[str] = []
+        start = bisect.bisect_left(self._points, key_hash)
+        n = len(self._points)
+        for off in range(n):
+            owner = self._owners[self._points[(start + off) % n]]
+            if owner not in out:
+                out.append(owner)
+                if len(out) >= want:
+                    break
+        return out
+
+
+@dataclass
+class ReplicaView:
+    """One replica's routing-relevant state, distilled from its polled
+    health payload by the router (or synthesized directly in tests)."""
+
+    name: str
+    healthy: bool = True
+    draining: bool = False
+    breaker_open: bool = False
+    verdict: str = "ok"
+    load: float = 0.0
+    inflight: int = 0
+    epoch: str = ""
+
+    @property
+    def routable(self) -> bool:
+        return self.healthy and not self.draining and not self.breaker_open
+
+    @property
+    def hot(self) -> bool:
+        """Affinity is overridden for a hot owner: burning/warn burn
+        verdict or a saturated capacity score — spilling one query beats
+        feeding a replica that is already missing its SLO."""
+        return (
+            _VERDICT_RANK.get(self.verdict, 0) >= _VERDICT_RANK["warn"]
+            or self.load >= 1.0
+        )
+
+
+def worst_verdict(verdicts: "list[str] | tuple[str, ...]") -> str:
+    worst = "ok"
+    for v in verdicts:
+        if _VERDICT_RANK.get(v, 0) > _VERDICT_RANK[worst]:
+            worst = v
+    return worst
+
+
+def load_score(payload: dict[str, Any], inflight: int = 0) -> float:
+    """Scalar routing load from a ``/v1/health`` payload: runtime queue
+    occupancy (capacity block) + burn-verdict penalty + in-flight count.
+    0 ≈ idle; ≥1 ≈ saturated.  Tolerates partial payloads (a replica
+    without the capacity block still routes, just on verdict+inflight)."""
+    score = float(inflight) / 8.0
+    capacity = payload.get("capacity") or {}
+    runtime = capacity.get("runtime") or {}
+    try:
+        depth = float(runtime.get("queue_depth", 0) or 0)
+        limit = float(runtime.get("queue_limit", 0) or 0)
+        if limit > 0:
+            score += depth / limit
+        else:
+            score += depth / 64.0
+    except (TypeError, ValueError):
+        pass
+    slo = payload.get("slo") or {}
+    endpoints = slo.get("endpoints") or {}
+    verdict = worst_verdict(
+        [str((e or {}).get("verdict", "ok")) for e in endpoints.values()]
+        or [str(slo.get("verdict", "ok"))]
+    )
+    score += _VERDICT_RANK.get(verdict, 0) * 0.75
+    return score
+
+
+@dataclass
+class Plan:
+    """Ordered dispatch attempt list plus why it was ordered that way."""
+
+    order: list[str] = field(default_factory=list)
+    affinity: str | None = None
+    spilled: bool = False
+
+
+def plan(
+    views: "dict[str, ReplicaView]", query_text: str, ring: HashRing
+) -> Plan:
+    """Failover-ordered replica names for one query.
+
+    The consistent-hash owner leads unless it is hot or unroutable, in
+    which case the coldest routable replica leads (cache affinity is a
+    throughput optimization, never worth a missed SLO).  Remaining
+    routable replicas follow coldest-first so retry-on-next-replica
+    always walks toward spare capacity."""
+    routable = {n: v for n, v in views.items() if v.routable}
+    if not routable:
+        return Plan()
+    pref = [n for n in ring.preference(query_hash(query_text)) if n in routable]
+    affinity = pref[0] if pref else None
+    by_load = sorted(
+        routable.values(), key=lambda v: (v.load, v.inflight, v.name)
+    )
+    if affinity is not None and not routable[affinity].hot:
+        order = [affinity] + [v.name for v in by_load if v.name != affinity]
+        return Plan(order=order, affinity=affinity, spilled=False)
+    return Plan(
+        order=[v.name for v in by_load],
+        affinity=affinity,
+        spilled=affinity is not None,
+    )

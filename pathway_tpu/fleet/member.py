@@ -1,0 +1,354 @@
+"""Replica-side fleet state: registration, heartbeats, ingest apply,
+freshness watermarks, graceful drain.
+
+One process-global :class:`FleetMember` per replica (the deployment
+shape mirrors ``internals/health.py``: one live engine per process).
+The module stays stdlib-importable — ``/v1/health`` attaches the
+``fleet`` block via the same ``sys.modules`` gate as the other
+subsystem blocks, so a bare health probe never pulls in engine state —
+and every pathway import happens lazily inside the functions that
+need it.
+
+Watermark mechanics (ingest fan-out convergence, ROADMAP item 1):
+
+1. the router fans a write out with a monotonically increasing
+   ``watermark`` W; :meth:`FleetMember.apply_ingest` pushes the rows
+   into the replica's fleet ingest connector and records W as
+   *ingested*;
+2. when the streaming driver drains that connector it calls the
+   subject's ``_on_drained(t, scope)`` hook with the engine timestamp
+   ``t`` the rows entered under — the member remembers (t, W);
+3. when the index applies timestamp ``t`` the freshness tracker's
+   indexed listener fires and W becomes *queryable* — exactly the
+   read→queryable closure PR 15 built, reused as the fleet's
+   convergence signal.  A query is answerable fleet-wide once every
+   live replica's queryable watermark ≥ W.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Any
+
+__all__ = [
+    "FleetMember",
+    "activate_member",
+    "deactivate_member",
+    "drain_retry_after_s",
+    "fleet_status",
+    "get_member",
+    "is_draining",
+]
+
+
+def drain_retry_after_s() -> float:
+    """Retry-After a draining replica sends with its 503s: long enough
+    for the router to poll the drain state, short enough that a direct
+    client retries onto a live replica promptly."""
+    try:
+        return float(os.environ.get("PATHWAY_FLEET_DRAIN_RETRY_AFTER_S", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+class FleetMember:
+    """Process-global replica identity + watermark + drain state."""
+
+    def __init__(
+        self,
+        name: str | None = None,
+        advertise_url: str | None = None,
+        router_url: str | None = None,
+    ):
+        self.name = name or f"replica-{uuid.uuid4().hex[:8]}"
+        self.advertise_url = advertise_url
+        self.router_url = router_url
+        self._lock = threading.Lock()
+        self._draining = False
+        self._drained_at: float | None = None
+        self._ingested_w = 0
+        self._queryable_w = 0
+        self._ingested_docs = 0
+        #: (engine_time, watermark) batches drained but not yet indexed,
+        #: keyed by engine scope (timestamps restart per engine)
+        self._pending: dict[int, list[tuple[int, int]]] = {}
+        self._subject: Any = None
+        self._hb_thread: threading.Thread | None = None
+        self._hb_stop = threading.Event()
+        self.heartbeat_interval_s = float(
+            os.environ.get("PATHWAY_FLEET_HEARTBEAT_S", "2.0")
+        )
+
+    # -- ingest fan-in ---------------------------------------------------
+    def build_ingest_table(self):
+        """Docs table fed by the router's ingest fan-out — pass it to
+        ``VectorStoreServer(*docs)`` alongside (or instead of) file
+        sources.  Shape matches ``pw.io.fs.read(format="binary",
+        with_metadata=True)``: ``data`` bytes + ``_metadata`` Json."""
+        from ..internals.schema import schema_from_types
+        from ..internals.value import Json
+        from ..io.python import read
+        from ..io.streaming import ConnectorSubject
+
+        member = self
+
+        class _FleetIngestSubject(ConnectorSubject):
+            # rides the ephemeral-source exemption under
+            # OPERATOR_PERSISTING (the push source itself cannot seek):
+            # durability comes from the INDEX operator's chunked
+            # snapshots — restored rows include fan-out docs — while a
+            # restarted replica restarts at watermark 0 so the router
+            # re-verifies instead of assuming it saw recent fan-outs
+            _ephemeral = True
+            _session_type = "upsert"
+
+            def __init__(self):
+                super().__init__(datasource_name="fleet_ingest")
+
+            def run(self) -> None:
+                self._closed.wait()
+
+            def _on_drained(self, t: int, scope: int) -> None:
+                member.note_drained(t, scope)
+
+        subject = _FleetIngestSubject()
+        self._subject = subject
+        schema = schema_from_types(data=bytes, _metadata=Json)
+        self._watch_indexed()
+        return read(subject, schema=schema, autocommit_duration_ms=None)
+
+    def _watch_indexed(self) -> None:
+        from ..internals.monitoring import get_freshness
+
+        get_freshness().add_indexed_listener(self._on_indexed)
+
+    def apply_ingest(self, docs: list[dict], watermark: int) -> dict:
+        """Apply one fan-out batch: each doc is ``{"text": str,
+        "metadata": {...}}`` keyed by ``doc_id`` (upsert semantics, so a
+        re-sent batch after a router retry is idempotent)."""
+        from ..internals.keys import ref_scalar
+        from ..internals.value import Json
+
+        subject = self._subject
+        if subject is None:
+            raise RuntimeError("fleet ingest table is not wired")
+        for doc in docs:
+            doc_id = str(doc.get("doc_id") or doc.get("id") or uuid.uuid4().hex)
+            meta = dict(doc.get("metadata") or {})
+            meta.setdefault("path", f"fleet://{doc_id}")
+            subject._add_inner(
+                ref_scalar("fleet_ingest", doc_id),
+                (str(doc.get("text", "")).encode(), Json(meta)),
+            )
+        subject.commit()
+        with self._lock:
+            self._ingested_w = max(self._ingested_w, int(watermark))
+            self._ingested_docs += len(docs)
+            return {"watermark": self._ingested_w, "replica": self.name}
+
+    def note_drained(self, t: int, scope: int) -> None:
+        with self._lock:
+            self._pending.setdefault(scope, []).append((t, self._ingested_w))
+
+    def _on_indexed(self, _index: str, engine_time: int, scope: int) -> None:
+        with self._lock:
+            pending = self._pending.get(scope)
+            if not pending:
+                return
+            ready = [w for (t, w) in pending if t <= engine_time]
+            if ready:
+                self._queryable_w = max(self._queryable_w, max(ready))
+                self._pending[scope] = [
+                    (t, w) for (t, w) in pending if t > engine_time
+                ]
+
+    def watermarks(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "ingested": self._ingested_w,
+                "queryable": self._queryable_w,
+            }
+
+    # -- drain -----------------------------------------------------------
+    def begin_drain(self) -> dict:
+        """Stop accepting serving traffic (the webserver's drain guard
+        503s with Retry-After), finish in-flight, report the final
+        watermark so the router can hand affinity elsewhere."""
+        with self._lock:
+            already = self._draining
+            self._draining = True
+            if not already:
+                self._drained_at = time.time()
+        try:
+            from ..internals.health import get_health
+
+            get_health().set_component(
+                "fleet:drain",
+                "draining",
+                ready=True,
+                degraded=True,
+                critical=False,
+                detail="drain requested; serving routes answer 503",
+                scope="process",
+            )
+        except Exception:  # noqa: BLE001 — drain must never fail
+            pass
+        return {"replica": self.name, "draining": True, **self.watermarks()}
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def wire_routes(self, webserver: Any) -> None:
+        """Register the member control surface on the replica's
+        webserver: ingest fan-in, drain, and the watermark probe.  These
+        are CONTROL routes — the drain guard in the webserver exempts
+        ``/v1/fleet/*`` so a draining replica still answers them."""
+        member = self
+
+        async def ingest_handler(request):
+            from aiohttp import web
+
+            body = await request.json()
+            ack = member.apply_ingest(
+                list(body.get("docs") or []), int(body.get("watermark", 0))
+            )
+            return web.json_response(ack)
+
+        async def drain_handler(_request):
+            from aiohttp import web
+
+            return web.json_response(member.begin_drain())
+
+        async def watermark_handler(_request):
+            from aiohttp import web
+
+            return web.json_response(
+                {"replica": member.name, "watermark": member.watermarks()}
+            )
+
+        webserver.add_raw_route("/v1/fleet/ingest", ("POST",), ingest_handler)
+        webserver.add_raw_route("/v1/fleet/drain", ("POST",), drain_handler)
+        webserver.add_raw_route(
+            "/v1/fleet/watermark", ("GET",), watermark_handler
+        )
+
+    # -- registration / heartbeats ---------------------------------------
+    def epoch(self) -> dict:
+        from ..internals.health import get_health
+
+        return get_health().epoch()
+
+    def _announce(self, route: str) -> bool:
+        if not (self.router_url and self.advertise_url):
+            return False
+        body = {
+            "name": self.name,
+            "url": self.advertise_url,
+            "epoch": self.epoch(),
+            "draining": self.draining,
+            "watermark": self.watermarks(),
+        }
+        req = urllib.request.Request(
+            self.router_url.rstrip("/") + route,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5.0):
+                return True
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def start_heartbeats(self) -> None:
+        """Register with the router once the replica is READY (the
+        snapshot-seeded bring-up gate: a joining replica bulk-restores
+        first and only then advertises), then heartbeat until drained or
+        stopped.  Safe without a router_url — no-op."""
+        if self.router_url is None or self._hb_thread is not None:
+            return
+
+        def loop() -> None:
+            from ..internals.health import get_health
+
+            while not self._hb_stop.is_set():
+                if get_health().snapshot().get("ready"):
+                    if self._announce("/v1/fleet/register"):
+                        break
+                self._hb_stop.wait(0.25)
+            while not self._hb_stop.is_set():
+                self._announce("/v1/fleet/heartbeat")
+                self._hb_stop.wait(self.heartbeat_interval_s)
+
+        self._hb_thread = threading.Thread(
+            target=loop, daemon=True, name="fleet-heartbeat"
+        )
+        self._hb_thread.start()
+
+    def stop(self) -> None:
+        self._hb_stop.set()
+
+    # -- health block ----------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "replica": self.name,
+                "advertise_url": self.advertise_url,
+                "router": self.router_url,
+                "draining": self._draining,
+                "watermark": {
+                    "ingested": self._ingested_w,
+                    "queryable": self._queryable_w,
+                },
+                "ingested_docs": self._ingested_docs,
+            }
+
+
+_member_lock = threading.Lock()
+_member: FleetMember | None = None
+
+
+def activate_member(
+    name: str | None = None,
+    advertise_url: str | None = None,
+    router_url: str | None = None,
+) -> FleetMember:
+    global _member
+    with _member_lock:
+        if _member is None:
+            _member = FleetMember(name, advertise_url, router_url)
+        return _member
+
+
+def get_member(create: bool = False) -> FleetMember | None:
+    if create:
+        return activate_member()
+    return _member
+
+
+def deactivate_member() -> None:
+    """Test isolation hook."""
+    global _member
+    with _member_lock:
+        if _member is not None:
+            _member.stop()
+        _member = None
+
+
+def is_draining() -> bool:
+    m = _member
+    return m is not None and m.draining
+
+
+def fleet_status() -> dict | None:
+    """Module-gated ``/v1/health`` block (``_attach_module_block``)."""
+    m = _member
+    return m.status() if m is not None else None

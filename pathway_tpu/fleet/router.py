@@ -1,0 +1,751 @@
+"""SLO-aware fleet router: one ``/v1/*`` surface over N replicas.
+
+A thin asyncio process (no engine, no jax — it must start in
+milliseconds and survive replica churn) that:
+
+* polls each replica's ``/v1/health`` and routes on the ``"slo"`` /
+  ``"capacity"`` blocks PR 15 put there (least-loaded), with
+  consistent-hash affinity on the normalized query hash for
+  result/embedding-cache locality (:mod:`.balancer`);
+* circuit-breaks per replica (``xpacks/llm/_breaker.CircuitBreaker`` —
+  the same breaker serving planes use, so a black-holed replica stops
+  eating connect timeouts after ``PATHWAY_BREAKER_FAILURES`` misses);
+* retries idempotent reads on the next replica in the plan under ONE
+  W3C ``traceparent`` per logical request — the failed attempt and the
+  winning one stitch into a single trace on whichever replicas saw
+  them (the PR 15 client idiom, applied server-side);
+* fans ingest out to every live replica under a monotonically
+  increasing watermark and answers the convergence probe
+  (``/v1/fleet/converged?watermark=W``) from the per-replica queryable
+  watermarks the members report back;
+* distinguishes a RESTARTED replica from a long-lived one by the
+  health payload's ``epoch`` block (monotonic ``start_seq``): on an
+  epoch change the router drops the replica's capacity/latency history
+  and re-verifies its snapshot watermark from the fresh payload
+  instead of trusting state from the previous process.
+
+Metric families (``pathway_fleet_*``, declared in
+``internals/metrics_names.py``) ride the router's ``/status``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable
+
+from ..internals.metrics_names import escape_label_value
+from . import balancer
+
+__all__ = ["FleetRouter", "ReplicaState", "DEFAULT_SERVING_ROUTES"]
+
+#: idempotent read surface proxied 1:1 (retry-on-next-replica is safe);
+#: ``/v1/pw_ai_answer`` is deterministic for the mock/greedy paths this
+#: repo serves and is treated as idempotent like the reference RAG API
+DEFAULT_SERVING_ROUTES = (
+    "/v1/retrieve",
+    "/v1/statistics",
+    "/v1/inputs",
+    "/v1/pw_list_documents",
+    "/v1/pw_ai_answer",
+)
+
+
+class ReplicaState:
+    """Router-side book-keeping for one replica."""
+
+    def __init__(self, name: str, url: str, clock: Callable[[], float]):
+        from ..xpacks.llm._breaker import CircuitBreaker
+
+        self.name = name
+        self.url = url.rstrip("/")
+        self.clock = clock
+        self.epoch_id: str | None = None
+        self.start_seq: int | None = None
+        self.registered_at = clock()
+        self.last_seen = clock()
+        self.payload: dict[str, Any] = {}
+        self.inflight = 0
+        self.draining = False
+        self.detached = False
+        self.watermark = {"ingested": 0, "queryable": 0}
+        self.epoch_restarts = 0
+        #: rolling capacity/load history — RESET on epoch change (a
+        #: restarted process's old queue depths are another process's)
+        self.load_history: list[float] = []
+        self.breaker = CircuitBreaker(f"fleet:{name}")
+
+    def note_payload(self, payload: dict[str, Any]) -> bool:
+        """Fold a health payload in; returns True when an epoch change
+        was detected (restart: history dropped, watermark re-verified)."""
+        self.last_seen = self.clock()
+        restarted = False
+        epoch = payload.get("epoch") or {}
+        eid = epoch.get("id")
+        seq = epoch.get("start_seq")
+        if eid is not None and self.epoch_id is not None and eid != self.epoch_id:
+            restarted = True
+        elif (
+            seq is not None
+            and self.start_seq is not None
+            and seq > self.start_seq
+        ):
+            restarted = True
+        if restarted:
+            self.load_history.clear()
+            self.epoch_restarts += 1
+            self.breaker.record_success()  # fresh process: give it a shot
+            # the previous process's watermark history is void — trust
+            # only what the NEW process reports (re-verification)
+            self.watermark = {"ingested": 0, "queryable": 0}
+        if eid is not None:
+            self.epoch_id = eid
+        if seq is not None:
+            self.start_seq = seq
+        self.payload = payload
+        fleet_block = payload.get("fleet") or {}
+        wm = fleet_block.get("watermark")
+        if isinstance(wm, dict):
+            self.watermark = {
+                "ingested": int(wm.get("ingested", 0) or 0),
+                "queryable": int(wm.get("queryable", 0) or 0),
+            }
+        if fleet_block.get("draining"):
+            self.draining = True
+        load = balancer.load_score(payload, self.inflight)
+        self.load_history.append(load)
+        del self.load_history[:-32]
+        return restarted
+
+    def view(self, liveness_timeout_s: float) -> balancer.ReplicaView:
+        fresh = (self.clock() - self.last_seen) <= liveness_timeout_s
+        ready = bool(self.payload.get("ready", True))
+        return balancer.ReplicaView(
+            name=self.name,
+            healthy=fresh and ready and not self.detached,
+            draining=self.draining,
+            breaker_open=self.breaker.state == "open",
+            verdict=self.worst_verdict(),
+            load=balancer.load_score(self.payload, self.inflight),
+            inflight=self.inflight,
+            epoch=self.epoch_id or "",
+        )
+
+    def worst_verdict(self) -> str:
+        slo = self.payload.get("slo") or {}
+        endpoints = slo.get("endpoints") or {}
+        verdicts = [
+            str((e or {}).get("verdict", "ok")) for e in endpoints.values()
+        ]
+        if not verdicts:
+            verdicts = [str(slo.get("verdict", "ok"))]
+        return balancer.worst_verdict(verdicts)
+
+
+class FleetRouter:
+    """See module docstring.  Thread-safe: handlers run on the aiohttp
+    loop, the health poller and tests call ``note_health`` from other
+    threads."""
+
+    def __init__(
+        self,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        poll_interval_s: float | None = None,
+        liveness_timeout_s: float | None = None,
+        attempt_timeout_s: float | None = None,
+        serving_routes: tuple[str, ...] = DEFAULT_SERVING_ROUTES,
+        vnodes: int = 64,
+    ):
+        import os
+
+        self.clock = clock
+        self.poll_interval_s = (
+            poll_interval_s
+            if poll_interval_s is not None
+            else float(os.environ.get("PATHWAY_FLEET_POLL_S", "1.0"))
+        )
+        self.liveness_timeout_s = (
+            liveness_timeout_s
+            if liveness_timeout_s is not None
+            else float(os.environ.get("PATHWAY_FLEET_LIVENESS_S", "10.0"))
+        )
+        self.attempt_timeout_s = (
+            attempt_timeout_s
+            if attempt_timeout_s is not None
+            else float(os.environ.get("PATHWAY_FLEET_ATTEMPT_TIMEOUT_S", "30.0"))
+        )
+        self.serving_routes = serving_routes
+        self._lock = threading.Lock()
+        self._replicas: dict[str, ReplicaState] = {}
+        self._ring = balancer.HashRing(vnodes=vnodes)
+        self._watermark = 0
+        self._counters: dict[str, int] = {
+            "requests_ok": 0,
+            "requests_failed": 0,
+            "failovers": 0,
+            "spills": 0,
+            "epoch_restarts": 0,
+            "ingest_batches": 0,
+        }
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._stop = threading.Event()
+        self._poller: threading.Thread | None = None
+        self.port: int | None = None
+        from ..internals.monitoring import register_metrics_provider
+
+        register_metrics_provider("fleet_router", self)
+
+    # -- membership ------------------------------------------------------
+    def register_replica(
+        self, name: str, url: str, payload: dict[str, Any] | None = None
+    ) -> ReplicaState:
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None or rep.url != url.rstrip("/"):
+                rep = ReplicaState(name, url, self.clock)
+                self._replicas[name] = rep
+                self._ring.add(name)
+            rep.detached = False
+        if payload:
+            self.note_health(name, payload)
+        return rep
+
+    def note_heartbeat(self, name: str, body: dict[str, Any]) -> None:
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None and body.get("url"):
+            rep = self.register_replica(name, body["url"])
+        if rep is None:
+            return
+        payload: dict[str, Any] = {"ready": True}
+        if "epoch" in body:
+            payload["epoch"] = body["epoch"]
+        payload["fleet"] = {
+            "draining": bool(body.get("draining")),
+            "watermark": body.get("watermark") or {},
+        }
+        self.note_health(name, payload)
+
+    def note_health(self, name: str, payload: dict[str, Any]) -> None:
+        """Fold one health payload (poller result, heartbeat, or a
+        synthetic payload in tests) into the routing state."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                return
+            if rep.note_payload(payload):
+                self._counters["epoch_restarts"] += 1
+            self._maybe_detach(rep)
+
+    def _maybe_detach(self, rep: ReplicaState) -> None:
+        # caller holds the lock: a draining replica with nothing in
+        # flight leaves the ring — drain is complete, detach
+        if rep.draining and rep.inflight <= 0 and not rep.detached:
+            rep.detached = True
+            self._ring.remove(rep.name)
+
+    def drop_replica(self, name: str) -> None:
+        with self._lock:
+            rep = self._replicas.pop(name, None)
+            if rep is not None:
+                self._ring.remove(name)
+
+    def replica_names(self, *, live_only: bool = False) -> list[str]:
+        with self._lock:
+            if not live_only:
+                return sorted(self._replicas)
+            return sorted(
+                n
+                for n, r in self._replicas.items()
+                if r.view(self.liveness_timeout_s).routable
+            )
+
+    def views(self) -> dict[str, balancer.ReplicaView]:
+        with self._lock:
+            return {
+                n: r.view(self.liveness_timeout_s)
+                for n, r in self._replicas.items()
+            }
+
+    def plan_for(self, query_text: str) -> balancer.Plan:
+        with self._lock:
+            views = {
+                n: r.view(self.liveness_timeout_s)
+                for n, r in self._replicas.items()
+            }
+            p = balancer.plan(views, query_text, self._ring)
+            if p.spilled:
+                self._counters["spills"] += 1
+            return p
+
+    # -- autoscale signals ----------------------------------------------
+    def slo_verdicts(self) -> dict[str, str]:
+        with self._lock:
+            return {
+                n: r.worst_verdict()
+                for n, r in self._replicas.items()
+                if not r.detached
+            }
+
+    def fleet_verdict(self) -> str:
+        return balancer.worst_verdict(list(self.slo_verdicts().values()))
+
+    def live_count(self) -> int:
+        return len(self.replica_names(live_only=True))
+
+    # -- drain (router side) ---------------------------------------------
+    def pick_drain_candidate(self) -> str | None:
+        """Coldest routable replica — draining the least-loaded one
+        perturbs the fewest in-flight requests and warmed caches."""
+        views = [v for v in self.views().values() if v.routable]
+        if len(views) <= 1:
+            return None
+        views.sort(key=lambda v: (v.load, v.inflight, v.name))
+        return views[0].name
+
+    def request_drain(self, name: str) -> bool:
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                return False
+            rep.draining = True
+            url = rep.url
+        try:
+            req = urllib.request.Request(
+                url + "/v1/fleet/drain", data=b"{}",
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5.0):
+                pass
+        except (urllib.error.URLError, OSError):
+            pass  # unreachable replica: liveness timeout will detach it
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is not None:
+                self._maybe_detach(rep)
+        return True
+
+    # -- ingest fan-out ---------------------------------------------------
+    def next_watermark(self) -> int:
+        with self._lock:
+            self._watermark += 1
+            return self._watermark
+
+    def fan_out_ingest(self, docs: list[dict]) -> dict[str, Any]:
+        """Synchronous fan-out (tests / programmatic callers); the HTTP
+        handler wraps it in a thread so the loop stays free."""
+        watermark = self.next_watermark()
+        with self._lock:
+            targets = [
+                (r.name, r.url)
+                for r in self._replicas.values()
+                if not r.detached and not r.draining
+            ]
+            self._counters["ingest_batches"] += 1
+        body = json.dumps({"docs": docs, "watermark": watermark}).encode()
+        acks: dict[str, Any] = {}
+        for name, url in targets:
+            try:
+                req = urllib.request.Request(
+                    url + "/v1/fleet/ingest", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self.attempt_timeout_s
+                ) as resp:
+                    acks[name] = json.loads(resp.read().decode())
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                acks[name] = {"error": str(exc)}
+        return {"watermark": watermark, "replicas": acks}
+
+    def converged(self, watermark: int) -> dict[str, Any]:
+        """Fleet-wide answerability: every LIVE replica's queryable
+        watermark has passed ``watermark``."""
+        with self._lock:
+            live = {
+                n: dict(r.watermark)
+                for n, r in self._replicas.items()
+                if not r.detached
+                and (self.clock() - r.last_seen) <= self.liveness_timeout_s
+            }
+        ok = bool(live) and all(
+            w["queryable"] >= watermark for w in live.values()
+        )
+        return {"watermark": watermark, "converged": ok, "replicas": live}
+
+    # -- health polling ---------------------------------------------------
+    def poll_once(
+        self, fetch: Callable[[str], dict | None] | None = None
+    ) -> None:
+        """One poll sweep.  ``fetch(url) -> payload|None`` is injectable
+        for tests; the default GETs ``/v1/health`` (a 503 body still
+        carries the payload — unready is a payload, not an error)."""
+        fetch = fetch or self._fetch_health
+        with self._lock:
+            targets = [
+                (r.name, r.url)
+                for r in self._replicas.values()
+                if not r.detached
+            ]
+        for name, url in targets:
+            payload = fetch(url)
+            if payload is None:
+                with self._lock:
+                    rep = self._replicas.get(name)
+                    if rep is not None:
+                        rep.breaker.record_failure(
+                            ConnectionError(f"health poll failed: {url}")
+                        )
+                continue
+            self.note_health(name, payload)
+
+    def _fetch_health(self, url: str) -> dict | None:
+        try:
+            with urllib.request.urlopen(url + "/v1/health", timeout=5.0) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as exc:
+            try:
+                return json.loads(exc.read().decode())
+            except (ValueError, OSError):
+                return None
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def start_poller(self) -> None:
+        if self._poller is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(self.poll_interval_s):
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — the poller must survive
+                    pass
+
+        self._poller = threading.Thread(
+            target=loop, daemon=True, name="fleet-poller"
+        )
+        self._poller.start()
+
+    # -- metrics ----------------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            counters = dict(self._counters)
+            replicas = {
+                n: {
+                    "url": r.url,
+                    "draining": r.draining,
+                    "detached": r.detached,
+                    "inflight": r.inflight,
+                    "breaker": r.breaker.state,
+                    "verdict": r.worst_verdict(),
+                    "epoch": r.epoch_id,
+                    "epoch_restarts": r.epoch_restarts,
+                    "watermark": dict(r.watermark),
+                }
+                for n, r in self._replicas.items()
+            }
+            watermark = self._watermark
+        return {
+            "replicas": replicas,
+            "counters": counters,
+            "watermark": watermark,
+        }
+
+    def openmetrics_lines(self) -> list[str]:
+        s = self.stats()
+        by_state: dict[str, int] = {"ready": 0, "draining": 0, "detached": 0}
+        for r in s["replicas"].values():
+            if r["detached"]:
+                by_state["detached"] += 1
+            elif r["draining"]:
+                by_state["draining"] += 1
+            else:
+                by_state["ready"] += 1
+        c = s["counters"]
+        # each family leads with its TYPE declaration: the router doubles
+        # as a process-global metrics provider, so these lines land inside
+        # an arbitrary StatsMonitor exposition and must parse standalone
+        lines = [
+            "# TYPE pathway_fleet_replicas gauge",
+            *(
+                f'pathway_fleet_replicas{{state="{st}"}} {n}'
+                for st, n in sorted(by_state.items())
+            ),
+            "# TYPE pathway_fleet_requests_total counter",
+            "pathway_fleet_requests_total"
+            f'{{outcome="ok"}} {c["requests_ok"]}',
+            "pathway_fleet_requests_total"
+            f'{{outcome="failed"}} {c["requests_failed"]}',
+            "# TYPE pathway_fleet_failovers_total counter",
+            f'pathway_fleet_failovers_total {c["failovers"]}',
+            "# TYPE pathway_fleet_affinity_spills_total counter",
+            f'pathway_fleet_affinity_spills_total {c["spills"]}',
+            "# TYPE pathway_fleet_epoch_restarts_total counter",
+            f'pathway_fleet_epoch_restarts_total {c["epoch_restarts"]}',
+            "# TYPE pathway_fleet_ingest_batches_total counter",
+            f'pathway_fleet_ingest_batches_total {c["ingest_batches"]}',
+            "# TYPE pathway_fleet_ingest_watermark gauge",
+        ]
+        for name, r in sorted(s["replicas"].items()):
+            label = escape_label_value(name)
+            for kind in ("ingested", "queryable"):
+                lines.append(
+                    "pathway_fleet_ingest_watermark"
+                    f'{{replica="{label}",kind="{kind}"}} '
+                    f'{r["watermark"].get(kind, 0)}'
+                )
+        return lines
+
+    # -- dispatch ---------------------------------------------------------
+    def _mint_traceparent(self) -> str:
+        from ..internals.flight_recorder import (
+            format_traceparent,
+            new_span_id,
+            new_trace_id,
+        )
+
+        return format_traceparent(new_trace_id(), new_span_id())
+
+    async def _dispatch(self, request):
+        """Proxy one serving request: walk the balancer plan, failover on
+        503/transport errors, ONE traceparent across every attempt."""
+        import aiohttp
+        from aiohttp import web
+
+        try:
+            payload = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return web.json_response(
+                {"detail": "request body is not valid JSON"}, status=400
+            )
+        key_text = str(
+            payload.get("query") or payload.get("prompt") or request.path
+        )
+        traceparent = request.headers.get("traceparent")
+        if traceparent is None:
+            traceparent = self._mint_traceparent()
+        p = self.plan_for(key_text)
+        attempts = 0
+        for name in p.order:
+            with self._lock:
+                rep = self._replicas.get(name)
+                if rep is None:
+                    continue
+                if not rep.breaker.allow():
+                    continue
+                rep.inflight += 1
+                url = rep.url
+            attempts += 1
+            try:
+                timeout = aiohttp.ClientTimeout(total=self.attempt_timeout_s)
+                async with self._session.post(
+                    url + request.path,
+                    json=payload,
+                    headers={"traceparent": traceparent},
+                    timeout=timeout,
+                ) as resp:
+                    body = await resp.read()
+                    status = resp.status
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
+                rep.breaker.record_failure(exc)
+                with self._lock:
+                    rep.inflight -= 1
+                    self._counters["failovers"] += 1
+                    self._maybe_detach(rep)
+                continue
+            with self._lock:
+                rep.inflight -= 1
+                self._maybe_detach(rep)
+            if status == 503:
+                # shed or draining — a normal backpressure answer, not a
+                # breaker-worthy fault; move to the next replica
+                with self._lock:
+                    self._counters["failovers"] += 1
+                continue
+            rep.breaker.record_success()
+            with self._lock:
+                self._counters["requests_ok"] += 1
+            return web.Response(
+                body=body,
+                status=status,
+                content_type="application/json",
+                headers={
+                    "x-pathway-fleet-replica": name,
+                    "x-pathway-fleet-attempts": str(attempts),
+                },
+            )
+        with self._lock:
+            self._counters["requests_failed"] += 1
+        return web.json_response(
+            {"detail": "no replica available", "attempts": attempts},
+            status=503,
+            headers={"Retry-After": "1.0"},
+        )
+
+    # -- aiohttp app ------------------------------------------------------
+    def _build_app(self):
+        from aiohttp import web
+
+        app = web.Application()
+
+        async def register_handler(request):
+            body = await request.json()
+            self.register_replica(
+                str(body["name"]), str(body["url"]),
+                payload={
+                    "ready": True,
+                    "epoch": body.get("epoch") or {},
+                    "fleet": {
+                        "draining": bool(body.get("draining")),
+                        "watermark": body.get("watermark") or {},
+                    },
+                },
+            )
+            return web.json_response(
+                {"ok": True, "replicas": self.replica_names()}
+            )
+
+        async def heartbeat_handler(request):
+            body = await request.json()
+            self.note_heartbeat(str(body.get("name", "")), body)
+            return web.json_response({"ok": True})
+
+        async def drain_handler(request):
+            try:
+                body = await request.json()
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                body = {}
+            name = body.get("name") or self.pick_drain_candidate()
+            if name is None:
+                return web.json_response(
+                    {"detail": "no drainable replica"}, status=409
+                )
+            ok = await asyncio.to_thread(self.request_drain, str(name))
+            return web.json_response({"ok": ok, "replica": name})
+
+        async def ingest_handler(request):
+            try:
+                body = await request.json()
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                return web.json_response(
+                    {"detail": "body must be JSON"}, status=400
+                )
+            # canonical shape is {"docs": [...]}; a bare list also works
+            docs = body if isinstance(body, list) else (
+                body.get("docs") if isinstance(body, dict) else None
+            ) or []
+            if not isinstance(docs, list):
+                return web.json_response(
+                    {"detail": '"docs" must be a list'}, status=400
+                )
+            out = await asyncio.to_thread(self.fan_out_ingest, docs)
+            return web.json_response(out)
+
+        async def converged_handler(request):
+            try:
+                watermark = int(request.query.get("watermark", "0"))
+            except ValueError:
+                return web.json_response(
+                    {"detail": "watermark must be an integer"}, status=400
+                )
+            return web.json_response(self.converged(watermark))
+
+        async def health_handler(_request):
+            views = self.views()
+            routable = [n for n, v in views.items() if v.routable]
+            snap = {
+                "status": "ready" if routable else "unready",
+                "ready": bool(routable),
+                "role": "fleet-router",
+                "fleet": self.stats(),
+            }
+            return web.json_response(
+                snap, status=200 if routable else 503,
+                headers={} if routable else {"Retry-After": "1.0"},
+            )
+
+        async def status_handler(_request):
+            # OpenMetrics expositions terminate with # EOF, like the main
+            # StatsMonitor /status
+            lines = self.openmetrics_lines() + ["# EOF"]
+            return web.Response(
+                text="\n".join(lines) + "\n",
+                content_type="text/plain",
+            )
+
+        app.router.add_post("/v1/fleet/register", register_handler)
+        app.router.add_post("/v1/fleet/heartbeat", heartbeat_handler)
+        app.router.add_post("/v1/fleet/drain", drain_handler)
+        app.router.add_post("/v1/fleet/ingest", ingest_handler)
+        app.router.add_get("/v1/fleet/converged", converged_handler)
+        app.router.add_get("/v1/health", health_handler)
+        app.router.add_get("/status", status_handler)
+        for route in self.serving_routes:
+            app.router.add_post(route, self._dispatch)
+        return app
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Serve on a daemon thread (the PathwayWebserver idiom); returns
+        the bound port."""
+        if self._thread is not None:
+            if self.port is None:
+                raise RuntimeError("router failed to start")
+            return self.port
+
+        def serve() -> None:
+            import aiohttp
+            from aiohttp import web
+
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def boot() -> None:
+                app = self._build_app()
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, host, port)
+                await site.start()
+                # the proxy ClientSession must be born on the running loop
+                self._session = aiohttp.ClientSession()
+                self.port = site._server.sockets[0].getsockname()[1]
+                self._started.set()
+
+            self._loop.run_until_complete(boot())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(
+            target=serve, daemon=True, name="fleet-router"
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30.0):
+            raise RuntimeError("fleet router did not start within 30s")
+        self.start_poller()
+        assert self.port is not None
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        loop = self._loop
+        if loop is not None:
+            def _shutdown() -> None:
+                async def close_and_stop() -> None:
+                    session = getattr(self, "_session", None)
+                    if session is not None:
+                        await session.close()
+                    loop.stop()
+
+                asyncio.ensure_future(close_and_stop())
+
+            try:
+                loop.call_soon_threadsafe(_shutdown)
+            except RuntimeError:
+                pass

@@ -35,6 +35,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import uuid
 from typing import Any
 
 __all__ = ["HealthRegistry", "get_health", "reset_health"]
@@ -68,6 +69,16 @@ class HealthRegistry:
         self._components: dict[str, dict] = {}
         self._beats: dict[str, float] = {}
         self.started_at = time.time()
+        #: process epoch: a fresh registry = a fresh process (or a test
+        #: reset — the same trust boundary).  ``id`` is the identity, and
+        #: ``start_seq`` (ns wall clock at creation) orders epochs, so a
+        #: fleet router / external LB can tell a RESTARTED replica from a
+        #: long-lived one and re-verify its snapshot watermark instead of
+        #: trusting capacity history from the previous process.
+        self._epoch = {
+            "id": uuid.uuid4().hex[:12],
+            "start_seq": time.time_ns(),
+        }
         self.engine_stall_s = float(
             os.environ.get("PATHWAY_HEALTH_STALL_S", "10")
         )
@@ -146,6 +157,14 @@ class HealthRegistry:
             # inherit the previous run's commit freshness
             self._last_commit_at = None
 
+    def epoch(self) -> dict[str, Any]:
+        """Monotonic process-epoch block (see ``_epoch``)."""
+        return {
+            **self._epoch,
+            "started_at": round(self.started_at, 3),
+            "uptime_s": round(time.time() - self.started_at, 3),
+        }
+
     # -- snapshot / readiness ------------------------------------------
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
@@ -183,6 +202,7 @@ class HealthRegistry:
         snap: dict[str, Any] = {
             "status": status,
             "ready": ready,
+            "epoch": self.epoch(),
             "components": components,
         }
         if engine_age is not None:
@@ -261,6 +281,12 @@ class HealthRegistry:
             "generation",
             "pathway_tpu.generation.engine",
             "generation_status",
+        )
+        # fleet membership: replica identity, drain state, and the
+        # ingest/queryable watermarks the router's convergence probe and
+        # epoch re-verification read
+        _attach_module_block(
+            snap, "fleet", "pathway_tpu.fleet.member", "fleet_status"
         )
         try:
             from ..testing import faults

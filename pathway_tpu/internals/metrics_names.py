@@ -282,6 +282,39 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "speculative draft tokens accepted by the multi-position verify launch",
     ),
+    # -- replicated serving fleet (fleet/router.py /status) --
+    "pathway_fleet_replicas": (
+        "gauge",
+        "replicas known to the fleet router by state (ready/draining/detached)",
+    ),
+    "pathway_fleet_requests_total": (
+        "counter",
+        "proxied serving requests by outcome (ok = some replica answered)",
+    ),
+    "pathway_fleet_failovers_total": (
+        "counter",
+        "dispatch attempts that moved to the next replica (503 or transport error)",
+    ),
+    "pathway_fleet_affinity_spills_total": (
+        "counter",
+        "queries routed off their consistent-hash owner because it was hot",
+    ),
+    "pathway_fleet_epoch_restarts_total": (
+        "counter",
+        "replica process-epoch changes observed (restart detected; history re-verified)",
+    ),
+    "pathway_fleet_ingest_batches_total": (
+        "counter",
+        "ingest batches fanned out to the fleet under a fresh watermark",
+    ),
+    "pathway_fleet_ingest_watermark": (
+        "gauge",
+        "per-replica ingest/queryable freshness watermark (convergence probe input)",
+    ),
+    "pathway_fleet_autoscale_total": (
+        "counter",
+        "autoscale actions taken by the burn-verdict controller (spawn/drain)",
+    ),
 }
 
 

@@ -322,6 +322,18 @@ class FreshnessTracker:
         self._source_order: deque[tuple[int, int]] = deque()
         #: connector label -> (end-to-end lag seconds, observed wall)
         self._source_lag: dict[str, tuple[float, float]] = {}
+        #: ``fn(index_name, engine_time, scope)`` callbacks fired on
+        #: every index apply — the fleet member advances its queryable
+        #: watermark here (a router-fanned write is answerable on this
+        #: replica exactly when the timestamp that carried it indexes)
+        self._indexed_listeners: list = []
+
+    def add_indexed_listener(self, fn) -> None:
+        """Register an index-apply callback (idempotent by identity);
+        called OUTSIDE the tracker lock, exceptions swallowed."""
+        with self._lock:
+            if fn not in self._indexed_listeners:
+                self._indexed_listeners.append(fn)
 
     def note_ingest(
         self, engine_time: int, wall_time: float | None = None, scope: int = 0
@@ -370,22 +382,39 @@ class FreshnessTracker:
         timestamp become ``pathway_freshness_seconds{connector=}``
         observations and feed the freshness SLO burn windows."""
         now = time.time()
+        lag: float | None = None
+        sources: dict[str, float] = {}
         with self._lock:
             wall = self._ingest_wall.get((scope, engine_time))
-            if wall is None:
-                return None
-            lag = max(0.0, now - wall)
-            self._lag[index_name] = (lag, now)
-            # CONSUME the read stamps: the end-to-end lag closes when the
-            # timestamp FIRST becomes queryable — without the pop, a
-            # pipeline with k index nodes would feed the freshness burn
-            # ring k times per ingest batch (k−1 of them fresh), diluting
-            # a stale connector's bad fraction k-fold and flapping the
-            # gauge to whichever index flushed last.  Per-index staleness
-            # stays on pathway_index_freshness_seconds{index=}.
-            sources = self._source_read.pop((scope, engine_time), None) or {}
-            for connector, read_wall in sources.items():
-                self._source_lag[connector] = (max(0.0, now - read_wall), now)
+            if wall is not None:
+                lag = max(0.0, now - wall)
+                self._lag[index_name] = (lag, now)
+                # CONSUME the read stamps: the end-to-end lag closes when
+                # the timestamp FIRST becomes queryable — without the pop,
+                # a pipeline with k index nodes would feed the freshness
+                # burn ring k times per ingest batch (k−1 of them fresh),
+                # diluting a stale connector's bad fraction k-fold and
+                # flapping the gauge to whichever index flushed last.
+                # Per-index staleness stays on
+                # pathway_index_freshness_seconds{index=}.
+                sources = (
+                    self._source_read.pop((scope, engine_time), None) or {}
+                )
+                for connector, read_wall in sources.items():
+                    self._source_lag[connector] = (
+                        max(0.0, now - read_wall), now,
+                    )
+            listeners = tuple(self._indexed_listeners)
+        # listeners fire even for timestamps without an ingest stamp
+        # (static/replayed data): an index APPLY is the queryability
+        # event the fleet watermark keys on, stamped or not
+        for fn in listeners:
+            try:
+                fn(index_name, engine_time, scope)
+            except Exception:  # noqa: BLE001 — listeners must not break flush
+                pass
+        if lag is None:
+            return None
         # burn-rate treatment (observability/slo.py) — lazy and fail-open:
         # freshness accounting must never take down an index flush
         if sources:

@@ -191,6 +191,38 @@ class PathwayWebserver:
             observe_slo(resp.status)
             return resp
 
+        #: routes a DRAINING replica keeps answering: health/metrics
+        #: probes, debug surfaces, and the fleet control plane (the
+        #: router needs /v1/fleet/drain acks and watermark reads from a
+        #: draining member — that is how the drain completes)
+        _drain_exempt = ("/v1/health", "/v1/debug/", "/_schema",
+                         "/v1/fleet/", "/status")
+
+        @web.middleware
+        async def drain_guard_mw(request, handler):
+            """Graceful drain: once the fleet member starts draining,
+            serving endpoints answer 503 with a REAL ``Retry-After`` so
+            clients back off with jitter instead of hammering, while
+            requests already in flight run to completion (this guard
+            only rejects NEW arrivals).  Gated on the fleet module
+            already being imported — a fleet-less server never pays the
+            check beyond one dict lookup."""
+            import sys as _sys
+
+            member_mod = _sys.modules.get("pathway_tpu.fleet.member")
+            if (
+                member_mod is not None
+                and member_mod.is_draining()
+                and not any(request.path.startswith(p) for p in _drain_exempt)
+            ):
+                retry_after = member_mod.drain_retry_after_s()
+                return web.json_response(
+                    {"detail": "replica is draining", "draining": True},
+                    status=503,
+                    headers={"Retry-After": f"{retry_after:g}"},
+                )
+            return await handler(request)
+
         @web.middleware
         async def sanitize_errors_mw(request, handler):
             """An unhandled handler exception must not leak a traceback
@@ -226,7 +258,9 @@ class PathwayWebserver:
                     body["trace_id"] = trace.trace_id
                 return web.json_response(body, status=500)
 
-        app = web.Application(middlewares=[tracing_mw, sanitize_errors_mw])
+        app = web.Application(
+            middlewares=[tracing_mw, drain_guard_mw, sanitize_errors_mw]
+        )
         for route, methods, handler in self._routes:
             for m in methods:
                 app.router.add_route(m, route, handler)
@@ -246,8 +280,13 @@ class PathwayWebserver:
             from ...internals.health import get_health
 
             snap = get_health().snapshot()
+            if snap["ready"]:
+                return web.json_response(snap)
+            # a real Retry-After on the unready 503: restore progress is
+            # measured in seconds, and RestClientBase turns the hint into
+            # jittered backoff instead of a fixed-cadence hammer
             return web.json_response(
-                snap, status=200 if snap["ready"] else 503
+                snap, status=503, headers={"Retry-After": "1.0"}
             )
 
         async def debug_traces_handler(request):

@@ -1111,6 +1111,16 @@ class StreamingDriver:
                 get_freshness().note_source(
                     label, t, read_wall, scope=id(self.engine)
                 )
+            # fleet watermark hook: the subject learns the engine
+            # timestamp its drained rows ride under, so the member can
+            # flip the matching ingest watermark to QUERYABLE when an
+            # index applies t (fleet/member.py)
+            on_drained = getattr(subject, "_on_drained", None)
+            if on_drained is not None:
+                try:
+                    on_drained(t, id(self.engine))
+                except Exception:  # noqa: BLE001 — hooks must not stall the drain
+                    pass
 
     def _record_finished_connectors(self) -> None:
         monitor = getattr(self.engine, "monitor", None)

@@ -194,7 +194,14 @@ class RestClientBase:
                     else self.backoff_initial_s
                     * (self.backoff_factor ** attempt)
                 )
-                delay += random.uniform(0.0, self.backoff_jitter_s)
+                # jitter scales with the delay (≥ the configured floor):
+                # a draining/overloaded replica hands every client the
+                # SAME Retry-After, and a fixed sleep would march them
+                # all back in lockstep — proportional jitter decorrelates
+                # the herd
+                delay += random.uniform(
+                    0.0, max(self.backoff_jitter_s, 0.25 * delay)
+                )
                 delay = max(0.0, min(delay, self.max_retry_after_s))
                 if time.monotonic() + delay > deadline:
                     # total-deadline cap: fail fast instead of sleeping

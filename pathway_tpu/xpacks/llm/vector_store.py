@@ -346,6 +346,17 @@ class VectorStoreServer:
         webserver = PathwayWebserver(host=host, port=port)
         self._webserver = webserver
 
+        # fleet membership control surface (/v1/fleet/ingest|drain|
+        # watermark): wired only when this process activated a member —
+        # a standalone server never registers the routes
+        import sys as _sys
+
+        _member_mod = _sys.modules.get("pathway_tpu.fleet.member")
+        if _member_mod is not None:
+            _member = _member_mod.get_member()
+            if _member is not None:
+                _member.wire_routes(webserver)
+
         embedder = self.embedder or getattr(self.index_factory, "embedder", None)
         if with_scheduler is None:
             from ._scheduler import scheduler_enabled

@@ -1,0 +1,803 @@
+"""Replicated serving fleet (ISSUE 17): balancer placement, router
+failover/breaker/epoch behavior, drain guard 503s, watermark
+convergence, closed-loop autoscaling, and the snapshot-seeded replica
+bring-up with zero re-embeds.
+
+The router tests drive :meth:`FleetRouter.note_health` with SYNTHETIC
+health payloads (the same ``slo``/``capacity`` shapes ``/v1/health``
+serves) so placement decisions are pinned without any engine; the
+integration tests stand up real HTTP stubs — and, for the bring-up
+test, a real replica subprocess via ``pathway_tpu.fleet.launcher``.
+"""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from pathway_tpu.fleet.balancer import (  # noqa: E402
+    HashRing,
+    ReplicaView,
+    load_score,
+    normalize_query,
+    plan,
+    query_hash,
+    worst_verdict,
+)
+from pathway_tpu.fleet.member import (  # noqa: E402
+    FleetMember,
+    activate_member,
+    deactivate_member,
+)
+from pathway_tpu.fleet.router import FleetRouter  # noqa: E402
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _payload(verdict="ok", queue_depth=0, queue_limit=64, ready=True,
+             epoch=None, fleet=None):
+    """Synthetic /v1/health payload in the served shape."""
+    p = {
+        "ready": ready,
+        "status": "ready" if ready else "starting",
+        "slo": {"endpoints": {"/v1/retrieve": {"verdict": verdict}}},
+        "capacity": {
+            "runtime": {"queue_depth": queue_depth, "queue_limit": queue_limit}
+        },
+    }
+    if epoch is not None:
+        p["epoch"] = epoch
+    if fleet is not None:
+        p["fleet"] = fleet
+    return p
+
+
+# ---------------------------------------------------------------------------
+# balancer: normalization, ring, least-loaded plan
+# ---------------------------------------------------------------------------
+
+
+def test_normalized_query_hash_collapses_case_and_whitespace():
+    assert normalize_query("  What IS\tPathway? ") == "what is pathway?"
+    assert query_hash("What is Pathway?") == query_hash("  what IS pathway? ")
+    assert query_hash("what is pathway?") != query_hash("something else")
+
+
+def test_hash_ring_affinity_stable_and_minimal_movement():
+    ring = HashRing()
+    for n in ("a", "b", "c", "d"):
+        ring.add(n)
+    keys = [query_hash(f"query number {i}") for i in range(400)]
+    before = {k: ring.preference(k)[0] for k in keys}
+    ring.remove("d")
+    after = {k: ring.preference(k)[0] for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    # only keys owned by the removed node move (~1/4), not a reshuffle
+    assert moved == sum(1 for k in keys if before[k] == "d")
+    assert moved < len(keys) // 2
+    # survivors keep their owner exactly
+    for k in keys:
+        if before[k] != "d":
+            assert after[k] == before[k]
+
+
+def test_load_score_from_synthetic_health_payloads():
+    cold = load_score(_payload(queue_depth=0), inflight=0)
+    deep = load_score(_payload(queue_depth=48, queue_limit=64), inflight=0)
+    warn = load_score(_payload(verdict="warn"), inflight=0)
+    assert cold == 0.0
+    assert deep > cold
+    assert warn > cold
+    assert worst_verdict(["ok", "warn"]) == "warn"
+    assert worst_verdict(["burning", "warn"]) == "burning"
+
+
+def test_plan_least_loaded_picks_cold_replica():
+    """A hot affinity owner spills to the coldest routable replica."""
+    views = {
+        "hot": ReplicaView(
+            "hot", load=load_score(_payload(verdict="warn", queue_depth=60))
+        ),
+        "warm": ReplicaView(
+            "warm", load=load_score(_payload(queue_depth=20)), inflight=2
+        ),
+        "cold": ReplicaView("cold", load=load_score(_payload())),
+    }
+    views["hot"].verdict = "warn"
+    ring = HashRing()
+    for n in views:
+        ring.add(n)
+    # find a query whose consistent-hash owner is the hot replica
+    q = next(
+        f"query {i}" for i in range(500)
+        if ring.preference(query_hash(f"query {i}"))[0] == "hot"
+    )
+    p = plan(views, q, ring)
+    assert p.affinity == "hot"
+    assert p.spilled
+    assert p.order[0] == "cold"  # coldest-first, not the hot owner
+    assert set(p.order) == {"hot", "warm", "cold"}
+
+
+def test_plan_affinity_owner_leads_when_cold():
+    views = {n: ReplicaView(n) for n in ("a", "b", "c")}
+    ring = HashRing()
+    for n in views:
+        ring.add(n)
+    p1 = plan(views, "  What IS pathway? ", ring)
+    p2 = plan(views, "what is pathway?", ring)
+    assert p1.affinity == p2.affinity == p1.order[0] == p2.order[0]
+    assert not p1.spilled
+    # unroutable owner: excluded from the order entirely
+    views[p1.affinity].healthy = False
+    p3 = plan(views, "what is pathway?", ring)
+    assert p1.affinity not in p3.order and len(p3.order) == 2
+
+
+# ---------------------------------------------------------------------------
+# router state: epoch re-verification, breaker isolation, convergence
+# ---------------------------------------------------------------------------
+
+
+def test_router_epoch_change_reverifies_restarted_replica():
+    """Satellite (a): a restarted replica (new health epoch) must not be
+    trusted on its previous history — watermark resets until the NEW
+    process reports one."""
+    r = FleetRouter(clock=time.monotonic)
+    r.register_replica("a", "http://127.0.0.1:1")
+    r.note_health("a", _payload(
+        epoch={"id": "e1", "start_seq": 100},
+        fleet={"watermark": {"ingested": 7, "queryable": 7}},
+    ))
+    assert r.stats()["replicas"]["a"]["watermark"]["queryable"] == 7
+    assert r.converged(7)["converged"]
+
+    # same replica name+url, NEW process epoch, no watermark yet
+    r.note_health("a", _payload(epoch={"id": "e2", "start_seq": 200}))
+    st = r.stats()["replicas"]["a"]
+    assert st["epoch"] == "e2"
+    assert st["epoch_restarts"] == 1
+    assert st["watermark"] == {"ingested": 0, "queryable": 0}
+    assert not r.converged(7)["converged"]  # re-verify, don't trust history
+    assert r.stats()["counters"]["epoch_restarts"] == 1
+
+    # a larger start_seq alone (same id field absent) also counts
+    r.note_health("a", _payload(epoch={"id": "e2", "start_seq": 300}))
+    assert r.stats()["replicas"]["a"]["epoch_restarts"] == 2
+
+
+def test_router_breaker_isolates_blackholed_replica(monkeypatch):
+    monkeypatch.setenv("PATHWAY_BREAKER_FAILURES", "3")
+    r = FleetRouter(clock=time.monotonic)
+    r.register_replica("good", "http://127.0.0.1:1")
+    r.register_replica("dead", "http://127.0.0.1:2")
+    r.note_health("good", _payload())
+    r.note_health("dead", _payload())
+
+    def fetch(url):
+        return _payload() if url.endswith(":1") else None
+
+    try:
+        for _ in range(3):
+            r.poll_once(fetch=fetch)
+        views = r.views()
+        assert views["dead"].breaker_open and not views["good"].breaker_open
+        p = r.plan_for("any query at all")
+        assert p.order and "dead" not in p.order
+        assert r.stats()["replicas"]["dead"]["breaker"] == "open"
+    finally:
+        from pathway_tpu.internals.health import reset_health
+
+        reset_health()
+
+
+def test_router_convergence_requires_every_live_replica():
+    r = FleetRouter(clock=time.monotonic)
+    r.register_replica("a", "http://127.0.0.1:1")
+    r.register_replica("b", "http://127.0.0.1:2")
+    w = r.next_watermark()
+    r.note_health("a", _payload(fleet={"watermark": {"ingested": w, "queryable": w}}))
+    r.note_health("b", _payload(fleet={"watermark": {"ingested": w, "queryable": 0}}))
+    assert not r.converged(w)["converged"]  # b still behind
+    r.note_health("b", _payload(fleet={"watermark": {"ingested": w, "queryable": w}}))
+    out = r.converged(w)
+    assert out["converged"] and set(out["replicas"]) == {"a", "b"}
+
+
+def test_router_openmetrics_families_are_declared():
+    from pathway_tpu.internals.metrics_names import METRICS
+
+    r = FleetRouter(clock=time.monotonic)
+    r.register_replica("a", "http://127.0.0.1:1")
+    r.note_health("a", _payload())
+    declared_types: set[str] = set()
+    for line in r.openmetrics_lines():
+        if line.startswith("# TYPE "):
+            _, _, family, kind = line.split(" ")
+            assert family in METRICS, line
+            assert METRICS[family][0] == kind, line
+            declared_types.add(family)
+            continue
+        family = line.split("{")[0].split(" ")[0]
+        assert family in METRICS, line
+        # the router is a process-global provider: every sample must land
+        # after its own TYPE declaration so /status stays strictly parseable
+        assert family in declared_types, f"sample before TYPE: {line}"
+
+
+# ---------------------------------------------------------------------------
+# router HTTP: failover with ONE traceparent, replica kill, shed path
+# ---------------------------------------------------------------------------
+
+
+class _StubReplica:
+    """Minimal replica: /v1/health + /v1/retrieve, recording the
+    traceparent of every serving request.  ``mode`` switches behavior:
+    "ok" answers, "shed" answers 503+Retry-After."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.mode = "ok"
+        self.traceparents: list = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def do_GET(self):  # noqa: N802
+                body = json.dumps(_payload(
+                    epoch={"id": stub.name, "start_seq": 1}
+                )).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(n)
+                stub.traceparents.append(self.headers.get("traceparent"))
+                if stub.mode == "shed":
+                    body = b'{"detail": "overloaded"}'
+                    self.send_response(503)
+                    self.send_header("Retry-After", "0.5")
+                else:
+                    body = json.dumps(
+                        [{"text": f"answer from {stub.name}", "dist": 0.0}]
+                    ).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def kill(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _post_router(port, route, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{route}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read().decode())
+
+
+@pytest.fixture
+def two_stub_fleet():
+    stubs = [_StubReplica("r0"), _StubReplica("r1")]
+    router = FleetRouter(
+        poll_interval_s=0.2, liveness_timeout_s=5.0, attempt_timeout_s=5.0
+    )
+    port = router.start(port=_free_port())
+    for s in stubs:
+        router.register_replica(
+            s.name, s.url, payload=_payload(epoch={"id": s.name, "start_seq": 1})
+        )
+    yield router, port, stubs
+    router.stop()
+    for s in stubs:
+        try:
+            s.kill()
+        except Exception:
+            pass
+    # open fleet breakers register health components; don't leak them
+    # into unrelated tests' global health snapshots
+    from pathway_tpu.internals.health import reset_health
+
+    reset_health()
+
+
+def test_router_failover_keeps_one_traceparent(two_stub_fleet):
+    """A shedding replica fails over to the next one in the plan under
+    the SAME W3C traceparent — one logical request, one trace."""
+    router, port, (s0, s1) = two_stub_fleet
+    # craft a query whose affinity owner is s0, then shed on s0
+    q = next(
+        f"find me {i}" for i in range(500)
+        if router.plan_for(f"find me {i}").order[0] == s0.name
+    )
+    s0.mode = "shed"
+    status, headers, body = _post_router(port, "/v1/retrieve", {"query": q})
+    assert status == 200
+    assert body[0]["text"] == "answer from r1"
+    assert headers["x-pathway-fleet-replica"] == "r1"
+    assert int(headers["x-pathway-fleet-attempts"]) == 2
+    assert len(s0.traceparents) == 1 and len(s1.traceparents) == 1
+    assert s0.traceparents[0] == s1.traceparents[0]  # ONE traceparent
+    assert s0.traceparents[0].startswith("00-")
+    assert router.stats()["counters"]["failovers"] >= 1
+
+
+def test_router_affinity_repeat_queries_hit_one_replica(two_stub_fleet):
+    router, port, (s0, s1) = two_stub_fleet
+    for variant in ("what is pathway?", "  What IS pathway?  "):
+        status, headers, _ = _post_router(
+            port, "/v1/retrieve", {"query": variant}
+        )
+        assert status == 200
+    served_by = {len(s0.traceparents), len(s1.traceparents)}
+    assert served_by == {0, 2}  # both variants landed on the SAME replica
+
+
+def test_router_all_replicas_down_returns_503_retry_after(two_stub_fleet):
+    router, port, (s0, s1) = two_stub_fleet
+    s0.mode = s1.mode = "shed"
+    try:
+        _post_router(port, "/v1/retrieve", {"query": "anything"})
+        raise AssertionError("expected HTTP 503")
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 503
+        assert exc.headers.get("Retry-After") is not None
+
+
+def test_replica_kill_midrun_zero_failed_requests(two_stub_fleet):
+    """Acceptance: SIGKILL-equivalent (socket gone) on one replica while
+    clients hammer the router — every client request still succeeds via
+    failover; the kill is absorbed, not surfaced."""
+    router, port, (s0, s1) = two_stub_fleet
+    results: list = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(4 + 1)
+
+    def client(wid):
+        barrier.wait()
+        for i in range(12):
+            try:
+                status, headers, _ = _post_router(
+                    port, "/v1/retrieve",
+                    {"query": f"live question {wid}-{i}"}, timeout=30,
+                )
+                ok = status == 200
+            except Exception:
+                ok = False
+            with lock:
+                results.append((time.monotonic(), ok))
+            time.sleep(0.03)  # pace: the run must straddle the kill
+
+    threads = [threading.Thread(target=client, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    time.sleep(0.12)
+    killed_at = time.monotonic()
+    s0.kill()  # mid-run: connections to s0 now fail at transport level
+    for t in threads:
+        t.join()
+    assert len(results) == 48
+    after_kill = [ok for (t, ok) in results if t > killed_at]
+    assert after_kill, "no requests landed after the kill — pace the run"
+    failed = sum(1 for (_t, ok) in results if not ok)
+    assert failed == 0, f"{failed} client requests failed across the kill"
+    assert len(s1.traceparents) > 0
+    # the poller's next sweeps trip the dead replica's breaker: it stops
+    # being routed at all instead of eating a connect error per request
+    for _ in range(3):
+        router.poll_once()
+    assert router.stats()["replicas"]["r0"]["breaker"] == "open"
+    assert "r0" not in router.plan_for("post-kill question").order
+
+
+# ---------------------------------------------------------------------------
+# health epoch block (satellite a, replica side)
+# ---------------------------------------------------------------------------
+
+
+def test_health_epoch_block_changes_across_reset():
+    from pathway_tpu.internals.health import get_health, reset_health
+
+    reset_health()
+    first = get_health().snapshot()["epoch"]
+    assert first["id"] and first["start_seq"] > 0
+    assert get_health().snapshot()["epoch"]["id"] == first["id"]  # stable
+    reset_health()
+    second = get_health().snapshot()["epoch"]
+    assert second["id"] != first["id"]
+    assert second["start_seq"] > first["start_seq"]  # monotonic
+    reset_health()
+
+
+# ---------------------------------------------------------------------------
+# drain guard: 503 + Retry-After on serving routes, control stays up
+# ---------------------------------------------------------------------------
+
+
+def test_draining_replica_503s_serving_routes_with_retry_after():
+    from pathway_tpu.internals.health import get_health, reset_health
+    from pathway_tpu.io.http import PathwayWebserver
+
+    reset_health()
+    deactivate_member()
+    member = activate_member(name="drain-test")
+    port = _free_port()
+    ws = PathwayWebserver(host="127.0.0.1", port=port)
+
+    async def retrieve(request):
+        from aiohttp import web
+
+        return web.json_response([{"text": "ok"}])
+
+    ws.add_raw_route("/v1/retrieve", ("POST",), retrieve)
+    member.wire_routes(ws)
+    ws._ensure_started()
+    get_health().set_component("engine", "running", ready=True)
+    get_health().beat("engine")
+    try:
+        status, _, _ = _post_router(port, "/v1/retrieve", {"query": "q"})
+        assert status == 200  # serving normally before the drain
+
+        status, _, body = _post_router(port, "/v1/fleet/drain", {})
+        assert status == 200 and body["draining"]
+
+        # serving routes: 503 with a REAL Retry-After
+        try:
+            _post_router(port, "/v1/retrieve", {"query": "q"})
+            raise AssertionError("expected HTTP 503 while draining")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+            assert float(exc.headers["Retry-After"]) > 0
+            assert json.loads(exc.read().decode())["draining"]
+
+        # control surface stays up: health + fleet routes answer
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/health", timeout=5
+        ) as resp:
+            snap = json.loads(resp.read().decode())
+            assert resp.status == 200
+            assert snap["fleet"]["draining"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/fleet/watermark", timeout=5
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        deactivate_member()
+        reset_health()
+
+
+def test_client_backoff_jitter_scales_with_retry_after(monkeypatch):
+    """Satellite (b): the client's backoff jitter is PROPORTIONAL to the
+    server's Retry-After hint, so a fleet of clients handed the same
+    hint does not march back in lockstep."""
+    from pathway_tpu.xpacks.llm._utils import RestClientBase
+
+    client = RestClientBase(
+        url="http://127.0.0.1:1", retry_on_unavailable=True,
+        backoff_jitter_s=0.01, max_retries=1, retry_deadline_s=30.0,
+    )
+    seen: dict = {}
+
+    def fake_uniform(lo, hi):
+        seen["range"] = (lo, hi)
+        return 0.0
+
+    sleeps: list = []
+    monkeypatch.setattr("random.uniform", fake_uniform)
+    monkeypatch.setattr("time.sleep", lambda s: sleeps.append(s))
+
+    calls = {"n": 0}
+
+    def fake_post_once(route, payload, traceparent=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise urllib.error.HTTPError(
+                "http://127.0.0.1:1/x", 503, "busy",
+                {"Retry-After": "2.0"}, None,
+            )
+        return {"ok": True}
+
+    monkeypatch.setattr(client, "_post_once", fake_post_once)
+    assert client._post("/x", {}) == {"ok": True}
+    # jitter window scaled to 25% of the 2s hint, not the 10ms floor
+    assert seen["range"] == (0.0, 0.5)
+    assert sleeps and sleeps[0] >= 2.0
+
+
+# ---------------------------------------------------------------------------
+# member watermarks: ingest → drained → indexed closes queryable
+# ---------------------------------------------------------------------------
+
+
+class _FakeSubject:
+    def __init__(self):
+        self.rows: list = []
+        self.commits = 0
+
+    def _add_inner(self, key, values):
+        self.rows.append((key, values))
+
+    def commit(self):
+        self.commits += 1
+
+
+def test_member_watermark_advances_only_after_indexing():
+    m = FleetMember(name="wm-test")
+    m._subject = _FakeSubject()
+    ack = m.apply_ingest(
+        [{"doc_id": "d1", "text": "hello"}, {"doc_id": "d2", "text": "world"}],
+        watermark=3,
+    )
+    assert ack["watermark"] == 3
+    assert m.watermarks() == {"ingested": 3, "queryable": 0}
+    assert m._subject.commits == 1 and len(m._subject.rows) == 2
+
+    scope = 1234
+    m.note_drained(t=100, scope=scope)
+    m._on_indexed("idx", 99, scope)  # earlier engine time: not yet queryable
+    assert m.watermarks()["queryable"] == 0
+    m._on_indexed("idx", 100, scope)  # index applied the drain timestamp
+    assert m.watermarks()["queryable"] == 3
+
+    # idempotent re-delivery (router retry) keys by doc_id → same keys
+    m.apply_ingest([{"doc_id": "d1", "text": "hello"}], watermark=3)
+    keys = [k for (k, _v) in m._subject.rows]
+    assert keys[0] == keys[2]  # upsert replaces, not duplicates
+
+
+def test_freshness_indexed_listener_fires_outside_lock():
+    from pathway_tpu.internals.monitoring import FreshnessTracker
+
+    tracker = FreshnessTracker()
+    fired: list = []
+    tracker.add_indexed_listener(lambda *a: fired.append(a))
+    tracker.add_indexed_listener(lambda *a: fired.append(a))  # dedup by id? no — distinct fns both fire
+    tracker.note_indexed("idx", 42, scope=7)
+    assert len(fired) == 2
+    assert fired[0] == ("idx", 42, 7)
+    # same listener re-registered is NOT duplicated
+    fn = lambda *a: fired.append(("again", *a))  # noqa: E731
+    tracker.add_indexed_listener(fn)
+    tracker.add_indexed_listener(fn)
+    fired.clear()
+    tracker.note_indexed("idx", 43, scope=7)
+    assert sum(1 for f in fired if f[0] == "again") == 1
+
+
+# ---------------------------------------------------------------------------
+# closed-loop autoscaling — explicit clocks, no sleeps
+# ---------------------------------------------------------------------------
+
+
+def _make_controller(**kwargs):
+    from pathway_tpu.fleet.autoscale import AutoscaleController
+
+    state = {
+        "now": 0.0,
+        "verdicts": {"a": "ok"},
+        "count": 1,
+        "spawned": 0,
+        "drained": 0,
+    }
+
+    def spawn():
+        state["spawned"] += 1
+        state["count"] += 1
+
+    def drain():
+        state["drained"] += 1
+        state["count"] -= 1
+
+    ctl = AutoscaleController(
+        verdicts=lambda: dict(state["verdicts"]),
+        count=lambda: state["count"],
+        spawn=spawn,
+        drain=drain,
+        clock=lambda: state["now"],
+        **kwargs,
+    )
+    return ctl, state
+
+
+def test_autoscale_spawns_on_warn_burn_verdict():
+    ctl, state = _make_controller(
+        min_replicas=1, max_replicas=4, ok_cooldown_s=60.0,
+        spawn_cooldown_s=30.0,
+    )
+    assert ctl.tick() is None  # all ok: nothing to do
+    state["verdicts"] = {"a": "warn"}
+    state["now"] = 10.0
+    assert ctl.tick() == "spawn"
+    assert state["spawned"] == 1 and state["count"] == 2
+    # still warn, but inside the spawn cooldown: no thundering spawn
+    state["now"] = 20.0
+    assert ctl.tick() is None
+    # past the cooldown and still burning: add another
+    state["now"] = 45.0
+    state["verdicts"] = {"a": "burning", "b": "ok"}
+    assert ctl.tick() == "spawn"
+    assert state["spawned"] == 2
+    assert [e["action"] for e in ctl.events] == ["spawn", "spawn"]
+
+
+def test_autoscale_drains_after_sustained_ok_cooldown():
+    ctl, state = _make_controller(
+        min_replicas=1, max_replicas=4, ok_cooldown_s=60.0,
+        spawn_cooldown_s=5.0,
+    )
+    state["count"] = 3
+    state["verdicts"] = {"a": "ok", "b": "ok", "c": "ok"}
+    state["now"] = 100.0
+    assert ctl.tick() is None  # starts the ok window
+    state["now"] = 130.0
+    assert ctl.tick() is None  # sustained, but not for long enough
+    state["now"] = 161.0
+    assert ctl.tick() == "drain"
+    assert state["drained"] == 1 and state["count"] == 2
+    # ONE drain per sustained-ok window — the clock must run again
+    state["now"] = 162.0
+    assert ctl.tick() is None
+    state["now"] = 222.0
+    assert ctl.tick() == "drain"
+    assert state["count"] == 1
+    # min_replicas floor: never drains the last one
+    state["now"] = 400.0
+    assert ctl.tick() is None
+    assert state["count"] == 1
+
+
+def test_autoscale_warn_blip_resets_drain_cooldown_and_max_caps_spawn():
+    ctl, state = _make_controller(
+        min_replicas=1, max_replicas=2, ok_cooldown_s=60.0,
+        spawn_cooldown_s=1.0,
+    )
+    state["count"] = 2
+    state["verdicts"] = {"a": "ok", "b": "ok"}
+    state["now"] = 0.0
+    ctl.tick()
+    state["now"] = 59.0
+    state["verdicts"] = {"a": "warn", "b": "ok"}
+    assert ctl.tick() is None  # at max_replicas: warn cannot spawn
+    state["verdicts"] = {"a": "ok", "b": "ok"}
+    state["now"] = 61.0
+    # the warn blip reset the ok window — no drain at t=61
+    assert ctl.tick() is None
+    state["now"] = 122.0
+    assert ctl.tick() == "drain"
+
+
+def test_router_feeds_autoscaler_verdicts():
+    r = FleetRouter(clock=time.monotonic)
+    r.register_replica("a", "http://127.0.0.1:1")
+    r.register_replica("b", "http://127.0.0.1:2")
+    r.note_health("a", _payload(verdict="ok"))
+    r.note_health("b", _payload(verdict="warn"))
+    assert r.slo_verdicts() == {"a": "ok", "b": "warn"}
+    assert r.fleet_verdict() == "warn"
+    # the drain candidate is the coldest routable replica
+    r.note_health("a", _payload(verdict="ok", queue_depth=50))
+    assert r.pick_drain_candidate() is not None
+
+
+# ---------------------------------------------------------------------------
+# snapshot-seeded bring-up: zero re-embeds, pinned by the embed counter
+# ---------------------------------------------------------------------------
+
+
+def _wait_http(url, timeout_s=120.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError:
+            time.sleep(0.25)
+        except (urllib.error.URLError, OSError, ValueError):
+            time.sleep(0.25)
+    raise TimeoutError(f"no answer from {url}")
+
+
+def _retrieve_until_results(port, query, timeout_s=120.0):
+    """Poll /v1/retrieve; returns (results, successful_posts) — every
+    200 response embeds the query exactly once, so the caller can
+    subtract query embeds from the replica's embed counter."""
+    deadline = time.monotonic() + timeout_s
+    posts = 0
+    while time.monotonic() < deadline:
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/retrieve",
+                data=json.dumps({"query": query, "k": 2}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                posts += 1
+                body = json.loads(resp.read().decode())
+                if body:
+                    return body, posts
+        except (urllib.error.URLError, OSError, ValueError):
+            pass
+        time.sleep(0.5)
+    raise TimeoutError("index never answered with results")
+
+
+def test_snapshot_seeded_replica_spawn_zero_reembeds(tmp_path):
+    """Acceptance: a replica spawned over a warm snapshot store (what the
+    autoscaler's ``spawn()`` does) bulk-restores and serves WITHOUT
+    re-embedding the corpus — pinned by the launcher's embed-counter
+    file, with query embeds accounted exactly."""
+    from pathway_tpu.fleet.launcher import spawn_replica
+
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    for i in range(4):
+        (corpus / f"d{i}.txt").write_text(f"fleet document {i} token{i}")
+    pstore = tmp_path / "pstore"
+
+    def bring_up(counter_name):
+        counter = tmp_path / counter_name
+        port = _free_port()
+        proc = spawn_replica(
+            port=port, snapshot_dir=str(pstore), corpus_dir=str(corpus),
+            name=f"seed-{counter_name}",
+            env={"PATHWAY_FLEET_EMBED_COUNTER_FILE": str(counter)},
+        )
+        try:
+            snap = _wait_http(f"http://127.0.0.1:{port}/v1/health")
+            assert "epoch" in snap  # satellite (a): replicas expose epoch
+            results, posts = _retrieve_until_results(
+                port, "fleet document 2 token2"
+            )
+            embeds = int(counter.read_text()) if counter.exists() else 0
+            return results, embeds - posts  # corpus embeds only
+        finally:
+            proc.kill()
+            proc.wait(timeout=15)
+
+    cold_results, cold_corpus_embeds = bring_up("cold.count")
+    assert cold_corpus_embeds >= 4  # every doc embedded once on first boot
+
+    warm_results, warm_corpus_embeds = bring_up("warm.count")
+    assert warm_corpus_embeds == 0  # restored from chunks: ZERO re-embeds
+    assert [r["text"] for r in warm_results] == [
+        r["text"] for r in cold_results
+    ]
