@@ -39,9 +39,15 @@ import urllib.request
 from typing import Any, Callable
 
 from ..internals.metrics_names import escape_label_value
+from ..testing import faults as _faults
 from . import balancer
 
-__all__ = ["FleetRouter", "ReplicaState", "DEFAULT_SERVING_ROUTES"]
+__all__ = [
+    "FleetRouter",
+    "ReplicaState",
+    "DEFAULT_SERVING_ROUTES",
+    "STREAMING_SERVING_ROUTES",
+]
 
 #: idempotent read surface proxied 1:1 (retry-on-next-replica is safe);
 #: ``/v1/pw_ai_answer`` is deterministic for the mock/greedy paths this
@@ -53,6 +59,12 @@ DEFAULT_SERVING_ROUTES = (
     "/v1/pw_list_documents",
     "/v1/pw_ai_answer",
 )
+
+#: streamed NDJSON surface: retry-on-next-replica is safe ONLY until the
+#: first upstream body byte has been forwarded — after that the response
+#: is committed to one replica and a mid-stream death truncates rather
+#: than retries (a retry would re-send already-delivered tokens)
+STREAMING_SERVING_ROUTES = ("/v1/pw_ai_answer_stream",)
 
 
 class ReplicaState:
@@ -159,6 +171,7 @@ class FleetRouter:
         liveness_timeout_s: float | None = None,
         attempt_timeout_s: float | None = None,
         serving_routes: tuple[str, ...] = DEFAULT_SERVING_ROUTES,
+        streaming_routes: tuple[str, ...] = STREAMING_SERVING_ROUTES,
         vnodes: int = 64,
     ):
         import os
@@ -180,6 +193,7 @@ class FleetRouter:
             else float(os.environ.get("PATHWAY_FLEET_ATTEMPT_TIMEOUT_S", "30.0"))
         )
         self.serving_routes = serving_routes
+        self.streaming_routes = streaming_routes
         self._lock = threading.Lock()
         self._replicas: dict[str, ReplicaState] = {}
         self._ring = balancer.HashRing(vnodes=vnodes)
@@ -546,6 +560,13 @@ class FleetRouter:
                 url = rep.url
             attempts += 1
             try:
+                # chaos site fleet.rpc: one proxy attempt — fail/drop are
+                # both transport-shaped, so the failover path below is
+                # exactly what a flaky replica link would exercise
+                if _faults.enabled and _faults.perturb("fleet.rpc") == "drop":
+                    raise aiohttp.ClientConnectionError(
+                        "fault injection dropped the proxy attempt"
+                    )
                 timeout = aiohttp.ClientTimeout(total=self.attempt_timeout_s)
                 async with self._session.post(
                     url + request.path,
@@ -555,7 +576,12 @@ class FleetRouter:
                 ) as resp:
                     body = await resp.read()
                     status = resp.status
-            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as exc:
+            except (
+                _faults.FaultInjected,
+                aiohttp.ClientError,
+                asyncio.TimeoutError,
+                OSError,
+            ) as exc:
                 rep.breaker.record_failure(exc)
                 with self._lock:
                     rep.inflight -= 1
@@ -583,6 +609,162 @@ class FleetRouter:
                     "x-pathway-fleet-attempts": str(attempts),
                 },
             )
+        with self._lock:
+            self._counters["requests_failed"] += 1
+        return web.json_response(
+            {"detail": "no replica available", "attempts": attempts},
+            status=503,
+            headers={"Retry-After": "1.0"},
+        )
+
+    async def _dispatch_stream(self, request):
+        """Proxy one STREAMING serving request (NDJSON).
+
+        Failover walks the same balancer plan as :meth:`_dispatch`, but
+        ONLY until the first upstream body byte has been read — that
+        byte commits the response to one replica (our 200 + headers go
+        out with it), and from then on a replica death truncates the
+        stream instead of retrying: a retry would re-send tokens the
+        client already consumed.  The truncation is detectable
+        client-side because a healthy stream always ends with a terminal
+        ``done``/``error`` NDJSON line.  ``x-pathway-fleet-attempts``
+        counts every attempt including the committed one."""
+        import aiohttp
+        from aiohttp import web
+
+        try:
+            payload = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return web.json_response(
+                {"detail": "request body is not valid JSON"}, status=400
+            )
+        key_text = str(
+            payload.get("query") or payload.get("prompt") or request.path
+        )
+        traceparent = request.headers.get("traceparent")
+        if traceparent is None:
+            traceparent = self._mint_traceparent()
+        p = self.plan_for(key_text)
+        attempts = 0
+        for name in p.order:
+            with self._lock:
+                rep = self._replicas.get(name)
+                if rep is None:
+                    continue
+                if not rep.breaker.allow():
+                    continue
+                rep.inflight += 1
+                url = rep.url
+            attempts += 1
+            resp = None
+            try:
+                if _faults.enabled and _faults.perturb("fleet.rpc") == "drop":
+                    raise aiohttp.ClientConnectionError(
+                        "fault injection dropped the proxy attempt"
+                    )
+                # sock_read, not total: a healthy decode stream may run
+                # far longer than one buffered attempt would, but the
+                # gap BETWEEN chunks stays bounded
+                timeout = aiohttp.ClientTimeout(
+                    total=None, sock_read=self.attempt_timeout_s
+                )
+                resp = await self._session.post(
+                    url + request.path,
+                    json=payload,
+                    headers={"traceparent": traceparent},
+                    timeout=timeout,
+                )
+                if resp.status == 503:
+                    # shed — backpressure, not a fault; next replica
+                    resp.close()
+                    with self._lock:
+                        rep.inflight -= 1
+                        self._counters["failovers"] += 1
+                        self._maybe_detach(rep)
+                    continue
+                if resp.status != 200:
+                    # non-streamable answer (4xx/5xx): forward buffered
+                    body = await resp.read()
+                    status = resp.status
+                    resp.close()
+                    rep.breaker.record_success()
+                    with self._lock:
+                        rep.inflight -= 1
+                        self._counters["requests_ok"] += 1
+                        self._maybe_detach(rep)
+                    return web.Response(
+                        body=body,
+                        status=status,
+                        content_type="application/json",
+                        headers={
+                            "x-pathway-fleet-replica": name,
+                            "x-pathway-fleet-attempts": str(attempts),
+                        },
+                    )
+                # the point of no return: once this read yields a byte,
+                # the response is committed to THIS replica
+                first = await resp.content.readany()
+            except (
+                _faults.FaultInjected,
+                aiohttp.ClientError,
+                asyncio.TimeoutError,
+                OSError,
+            ) as exc:
+                if resp is not None:
+                    resp.close()
+                rep.breaker.record_failure(exc)
+                with self._lock:
+                    rep.inflight -= 1
+                    self._counters["failovers"] += 1
+                    self._maybe_detach(rep)
+                continue
+            out = web.StreamResponse(
+                status=200,
+                headers={
+                    "Content-Type": resp.headers.get(
+                        "Content-Type", "application/x-ndjson"
+                    ),
+                    "Cache-Control": "no-cache",
+                    "x-pathway-fleet-replica": name,
+                    "x-pathway-fleet-attempts": str(attempts),
+                },
+            )
+            ok = True
+            try:
+                await out.prepare(request)
+                await out.write(first)
+                while True:
+                    try:
+                        chunk = await resp.content.readany()
+                    except (
+                        aiohttp.ClientError,
+                        asyncio.TimeoutError,
+                        OSError,
+                    ) as exc:
+                        # replica died AFTER the first forwarded byte:
+                        # truncate (never retry) and charge its breaker
+                        rep.breaker.record_failure(exc)
+                        ok = False
+                        break
+                    if not chunk:
+                        break
+                    await out.write(chunk)
+                if ok:
+                    await out.write_eof()
+                    rep.breaker.record_success()
+            except OSError:
+                # the CLIENT went away mid-stream — not the replica's
+                # fault, so no breaker charge
+                ok = False
+            finally:
+                resp.close()
+                with self._lock:
+                    rep.inflight -= 1
+                    self._counters[
+                        "requests_ok" if ok else "requests_failed"
+                    ] += 1
+                    self._maybe_detach(rep)
+            return out
         with self._lock:
             self._counters["requests_failed"] += 1
         return web.json_response(
@@ -691,6 +873,8 @@ class FleetRouter:
         app.router.add_get("/status", status_handler)
         for route in self.serving_routes:
             app.router.add_post(route, self._dispatch)
+        for route in self.streaming_routes:
+            app.router.add_post(route, self._dispatch_stream)
         return app
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:

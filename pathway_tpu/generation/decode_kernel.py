@@ -25,6 +25,16 @@ tier-1 exercises the kernel body on CPU), ``reference`` (XLA
 everywhere).  The reference gathers ``pool[table]`` into the dense
 per-row layout and runs the same masked softmax the dense ``lax.scan``
 decoder uses — the bit-parity oracle path.
+
+Fault containment (ISSUE 18): these functions are PURE — they hold no
+session state, so a launch that dies (XLA runtime error, chaos
+injection) leaves nothing to clean up here.  The engine wraps every
+prefill/decode-step/verify launch in its guarded-launch path
+(``engine._launch_guarded_locked``): TRANSIENT failures retry once and
+then contain to the launched batch, FATAL classifications quarantine
+the KV pool and resurrect sequences by replay re-prefill.  Keeping the
+kernel layer stateless is what makes that replay sound — re-running a
+launch with the same inputs is always safe.
 """
 
 from __future__ import annotations

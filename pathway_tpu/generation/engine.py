@@ -43,8 +43,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..internals.config import env_int as _env_int
+from ..internals.config import env_float as _env_float, env_int as _env_int
 from ..models.decoder import DecoderConfig, _ln, _logits_of
+from ..ops.device_faults import FATAL, TRANSIENT, classify_device_error
+from ..testing import faults as _faults
 from ..ops.ragged_attention import (
     MAX_PACKED_TOKENS,
     ragged_attention,
@@ -358,6 +360,11 @@ _COUNTERS = {
     "cow_copies_total": 0,
     "draft_proposed_total": 0,
     "draft_accepted_total": 0,
+    # generation-plane fault containment (ISSUE 18)
+    "fault_retries_total": 0,
+    "fault_contained_total": 0,
+    "fault_replays_total": 0,
+    "kv_pool_rebuilds_total": 0,
 }
 _SESSIONS: "weakref.WeakSet[DecodeSession]" = weakref.WeakSet()
 
@@ -415,6 +422,18 @@ class _GenerationMetricsProvider:
             "# TYPE pathway_decode_draft_accepted_total counter",
             f"pathway_decode_draft_accepted_total "
             f"{counters['draft_accepted_total']}",
+            "# TYPE pathway_decode_fault_retries_total counter",
+            f"pathway_decode_fault_retries_total "
+            f"{counters['fault_retries_total']}",
+            "# TYPE pathway_decode_fault_contained_total counter",
+            f"pathway_decode_fault_contained_total "
+            f"{counters['fault_contained_total']}",
+            "# TYPE pathway_decode_fault_replays_total counter",
+            f"pathway_decode_fault_replays_total "
+            f"{counters['fault_replays_total']}",
+            "# TYPE pathway_kv_pool_rebuilds_total counter",
+            f"pathway_kv_pool_rebuilds_total "
+            f"{counters['kv_pool_rebuilds_total']}",
         ]
         return lines
 
@@ -435,6 +454,8 @@ def generation_status() -> dict[str, Any]:
     }
     live = pending = used = free = shared = 0
     block_size = None
+    recovering = False
+    breakers: dict[str, str] = {}
     for s in sessions:
         st = s.stats()
         live += st["live_sequences"]
@@ -443,6 +464,20 @@ def generation_status() -> dict[str, Any]:
         free += st["kv_blocks_free"]
         shared += st["shared_blocks"]
         block_size = st["block_size"]
+        recovering = recovering or bool(st.get("recovering"))
+        if st.get("breaker") is not None:
+            breakers[s.name] = st["breaker"]
+    # the faults sub-block rides the health "generation" block so the
+    # fleet router's health poller sees a replica mid-recovery (and an
+    # open generation breaker) without a dedicated probe
+    status["faults"] = {
+        "retries_total": counters["fault_retries_total"],
+        "contained_total": counters["fault_contained_total"],
+        "replays_total": counters["fault_replays_total"],
+        "kv_pool_rebuilds_total": counters["kv_pool_rebuilds_total"],
+        "recovering": recovering,
+        "breakers": breakers,
+    }
     status.update(
         live_sequences=live,
         pending=pending,
@@ -474,6 +509,7 @@ class _Seq:
         "length", "next_input", "generated", "count", "handle",
         "deadline_at", "retain", "forced", "submitted_at",
         "all_tokens", "chain", "registered_upto", "cow_spare",
+        "replayed",
     )
 
     def __init__(self, ids, max_new, eos_id, temperature, seed,
@@ -500,6 +536,9 @@ class _Seq:
         self.registered_upto = 0  # full blocks content-registered so far
         #: pre-reserved COW destination for a partially-shared tail block
         self.cow_spare: int | None = None
+        #: times this sequence was resurrected by replay re-prefill
+        #: after a fatal pool quarantine
+        self.replayed = 0
 
 
 class GenerationHandle:
@@ -637,6 +676,24 @@ class DecodeSession:
         self._pump: threading.Thread | None = None
         self._group = None
         self.ticks_total = 0
+        #: per-launch transient retry budget (PR 6 containment contract
+        #: extended to the generation plane)
+        self.fault_retries = _env_int("PATHWAY_DECODE_FAULT_RETRIES", 1, lo=0)
+        self._recovering = False
+        # generation breaker: contained launch failures trip it; while
+        # OPEN, submit() sheds NEW admissions (503 + Retry-After through
+        # the HTTP planes) but live rows keep decoding
+        from ..xpacks.llm._breaker import CircuitBreaker
+
+        self.breaker = CircuitBreaker(
+            f"generation:{name}",
+            failure_threshold=_env_int(
+                "PATHWAY_GENERATION_BREAKER_FAILURES", 3, lo=1
+            ),
+            cooldown_s=_env_float(
+                "PATHWAY_GENERATION_BREAKER_COOLDOWN_S", 5.0, lo=0.0
+            ),
+        )
         from ..internals.monitoring import register_metrics_provider
         from ..observability.hbm_ledger import get_ledger
 
@@ -673,6 +730,18 @@ class DecodeSession:
 
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.breaker is not None and self.breaker.state == "open":
+            # decode launches are failing: shed NEW admissions while the
+            # breaker cools down — live rows keep decoding, and the next
+            # successful launch closes it.  (state == "open" on purpose,
+            # not allow(): admissions must not consume the half-open
+            # probe slot — the launches themselves are the probe.)
+            _bump("shed_total")
+            raise AdmissionRefused(
+                f"generation breaker open for session {self.name!r}: "
+                "decode launches are failing; new admissions shed",
+                retry_after_s=max(0.1, self.breaker.cooldown_s),
+            )
         if int(max_new_tokens) > self.cfg.max_len:
             # past max_len the per-sequence block table (blocks_per_seq =
             # ceil(max_len/block_size) entries) can NEVER hold the
@@ -775,7 +844,16 @@ class DecodeSession:
             need = self.pool.blocks_for(total) - len(seq.blocks)
             if need > 0:
                 t0 = time.monotonic()
-                more = self.pool.allocator.alloc(need)
+                more = None
+                try:
+                    if _faults.enabled:
+                        _faults.perturb("kv.alloc")
+                    more = self.pool.allocator.alloc(need)
+                except _faults.FaultInjected:
+                    # injected alloc fault (any severity): refuse the
+                    # extension — the retained sequence stays parked and
+                    # extendable, nothing was allocated
+                    more = None
                 self._record_span(
                     "kv:alloc", t0,
                     {"blocks": need, "ok": more is not None},
@@ -864,9 +942,19 @@ class DecodeSession:
 
     def _tick_locked(self) -> bool:
         self.ticks_total += 1
-        progressed = self._admit_and_prefill_locked()
-        if self._live:
-            progressed = self._decode_step_locked() or progressed
+        try:
+            progressed = self._admit_and_prefill_locked()
+            if self._live:
+                progressed = self._decode_step_locked() or progressed
+        except BaseException as exc:
+            if classify_device_error(exc) == FATAL and not self._recovering:
+                # the device arrays are suspect: quarantine the pool and
+                # resurrect every live/retained sequence by replay
+                # re-prefill from its recorded tokens — the session
+                # survives, streams resume token-for-token
+                self._recover_locked(exc)
+                return True
+            raise  # host-side bug: the pump's _fail_all keeps its role
         return progressed
 
     def _admit_and_prefill_locked(self) -> bool:
@@ -919,7 +1007,17 @@ class DecodeSession:
             # always copy without allocating under pressure
             fresh_need = need - len(full)
             t0 = time.monotonic()
-            fresh = alloc.alloc(fresh_need)
+            fresh = None
+            fatal_exc: BaseException | None = None
+            try:
+                if _faults.enabled:
+                    _faults.perturb("kv.alloc")
+                fresh = alloc.alloc(fresh_need)
+            except _faults.FaultInjected as exc:
+                # transient alloc fault: the request simply stays queued
+                # for the next tick; a fatal one escalates to recovery
+                if classify_device_error(exc) == FATAL:
+                    fatal_exc = exc
             self._record_span(
                 "kv:alloc", t0,
                 {"blocks": fresh_need, "matched": len(full),
@@ -933,6 +1031,8 @@ class DecodeSession:
                 )
                 if rollback:
                     alloc.free(rollback)
+                if fatal_exc is not None:
+                    raise fatal_exc
                 break
             self._pending.popleft()
             if not full and partial is None:
@@ -969,36 +1069,45 @@ class DecodeSession:
             matched_any = True
         if not admitted:
             return matched_any
-        # pack admitted prompts into bounded ragged launches
+        # pack admitted prompts into bounded ragged launches; a failed
+        # launch is contained to ITS batch — remaining batches (and the
+        # live set) carry on
         start = 0
-        try:
+        while start < len(admitted):
+            batch: list[_Seq] = []
+            total = 0
             while start < len(admitted):
-                batch: list[_Seq] = []
-                total = 0
-                while start < len(admitted):
-                    ln = len(admitted[start].ids)
-                    if batch and total + ln > MAX_PACKED_TOKENS:
-                        break
-                    batch.append(admitted[start])
-                    total += ln
-                    start += 1
+                ln = len(admitted[start].ids)
+                if batch and total + ln > MAX_PACKED_TOKENS:
+                    break
+                batch.append(admitted[start])
+                total += ln
+                start += 1
+            try:
                 self._prefill_batch_locked(batch)
-        except BaseException as exc:
-            # a failed prefill launch must not orphan the admitted batch:
-            # these sequences are in neither _live nor _pending, so the
-            # pump's _fail_all would miss them — their blocks would leak
-            # (the pool permanently shrinks) and their handles' waiters
-            # would block forever.  Free + fail them here, then re-raise
-            # so the pump fails the rest consistently.
-            for seq in admitted:
-                if seq.handle is not None and seq.handle.done:
-                    continue  # retired during its batch (e.g. instant EOS)
-                if any(s is seq for s in self._live):
-                    continue  # made it live: _fail_all covers it
-                self._free_seq_blocks_locked(seq)
-                if seq.handle is not None:
-                    seq.handle._finish(exc)
-            raise
+            except BaseException as exc:
+                if classify_device_error(exc) == FATAL:
+                    # the pool is suspect: nothing this batch wrote can
+                    # be trusted.  Requeue the whole un-prefilled
+                    # remainder at the queue head (their old-pool block
+                    # refs are void wholesale once the pool is
+                    # quarantined) and let the tick-level handler
+                    # rebuild + replay.
+                    for seq in reversed(batch + admitted[start:]):
+                        if seq.handle is not None and seq.handle.done:
+                            continue
+                        if any(s is seq for s in self._live):
+                            continue
+                        seq.blocks = []
+                        seq.cow_spare = None
+                        seq.length = 0
+                        self._pending.appendleft(seq)
+                    raise
+                # per-launch blast radius: only this packed launch's
+                # sequences fail — free + finish them (they are in
+                # neither _live nor _pending, so nothing else covers
+                # them) and move on to the next batch
+                self._contain_launch_failure_locked(batch, exc, "prefill")
         return True
 
     # -- prefix-index registration ---------------------------------------
@@ -1028,10 +1137,248 @@ class DecodeSession:
         if tail and u < len(seq.blocks):
             self.pool.prefix.register_partial(seq.chain, tail, seq.blocks[u])
 
-    def _prefill_batch_locked(self, batch: list[_Seq]) -> None:
+    # -- fault containment (ISSUE 18) ------------------------------------
+    def _launch_guarded_locked(self, site: str, fn: Callable[[], Any]) -> Any:
+        """Run one device launch under the containment contract: the
+        chaos site perturbs first, and a TRANSIENT classification retries
+        the launch up to ``PATHWAY_DECODE_FAULT_RETRIES`` times (safe: a
+        failed dispatch leaves the pools untouched — donation is
+        TPU-only, and a donated-buffer loss classifies FATAL).  On
+        exhaustion the error propagates for the caller to contain to
+        this launch's sequences; a clean launch records breaker
+        success."""
+        attempt = 0
+        while True:
+            try:
+                if _faults.enabled:
+                    _faults.perturb(site)
+                out = fn()
+            except BaseException as exc:
+                if (
+                    classify_device_error(exc) == TRANSIENT
+                    and attempt < self.fault_retries
+                ):
+                    attempt += 1
+                    _bump("fault_retries_total")
+                    continue
+                raise
+            if self.breaker is not None:
+                self.breaker.record_success()
+            return out
+
+    def _contain_launch_failure_locked(
+        self, seqs: list[_Seq], exc: BaseException, what: str
+    ) -> None:
+        """Blast-radius isolation: fail ONLY the given launch's
+        sequences (free blocks, finish handles with the error), charge
+        the generation breaker, and keep the session serving."""
+        _bump("fault_contained_total")
+        failed = 0
+        for seq in seqs:
+            if seq in self._live:
+                self._live.remove(seq)
+            if seq.handle is not None and seq.handle.done:
+                # a parked retained sequence can no longer be resumed —
+                # unpark it (its blocks go back) rather than keep a
+                # stale table; an already-retired row is left alone
+                if self._retained.pop(id(seq.handle), None) is not None:
+                    self._free_seq_blocks_locked(seq)
+                continue
+            self._free_seq_blocks_locked(seq)
+            if seq.handle is not None:
+                seq.handle._finish(exc)
+            failed += 1
+        if self.breaker is not None:
+            self.breaker.record_failure(exc)
+        from ..internals.errors import register_error
+
+        register_error(
+            f"decode {what} launch contained: {type(exc).__name__}: {exc} "
+            f"({failed} sequence(s) failed; session keeps serving)",
+            kind="serving",
+            operator=self.name,
+        )
+
+    def recover(self, exc: BaseException | None = None) -> int:
+        """Quarantine the paged-KV pool and resurrect every live and
+        retained sequence by replay re-prefill from its recorded token
+        ids (prompt + accepted tokens).  The tick loop calls this
+        automatically on a FATAL classification; it is public for
+        operators and tests.  Returns the number of sequences
+        replayed."""
+        with self._lock:
+            return self._recover_locked(
+                exc if exc is not None
+                else RuntimeError("manual DecodeSession.recover()")
+            )
+
+    def _recover_locked(self, exc: BaseException) -> int:
+        from ..internals.errors import register_error
+
+        self._recovering = True
+        t0 = time.monotonic()
+        try:
+            old = self.pool
+            # quarantine: never touch the suspect arrays again — a fresh
+            # pool (arrays + allocator + prefix index) replaces them
+            # atomically, and the HBM ledger's bytes_fn reads self.pool
+            # through the session so the ledger follows the swap
+            self.pool = PagedKVPool(
+                self.cfg,
+                block_size=old.block_size,
+                pool_tokens=old.num_blocks * old.block_size,
+            )
+            old.quarantine()
+            _bump("kv_pool_rebuilds_total")
+            victims = list(self._live) + list(self._retained.values())
+            self._live = []
+            replayed = 0
+            # one victim at a time, ON PURPOSE: each replay prefill
+            # content-registers its blocks before the next victim's
+            # prefix match runs, so identical prefixes (the shared RAG
+            # template case) re-prefill once and are adopted by every
+            # later victim — the PrefixIndex makes replay cheap
+            for seq in victims:
+                # old-pool block refs are void wholesale (the allocator
+                # was quarantined with the arrays)
+                seq.blocks = []
+                seq.cow_spare = None
+                plan = self._resurrect_locked(seq, exc)
+                if plan is None:
+                    continue
+                replayed += 1
+                tag, head = plan
+                if tag == "prefill":
+                    try:
+                        self._prefill_batch_locked(
+                            [seq], tokens=[head], replay=True
+                        )
+                    except BaseException as exc2:  # noqa: BLE001
+                        # a replay prefill failing (even fatally) is
+                        # contained to its sequence — recovery NEVER
+                        # recurses into another recovery
+                        self._contain_launch_failure_locked(
+                            [seq], exc2, "replay_prefill"
+                        )
+                elif seq.handle is not None and not seq.handle.done:
+                    self._live.append(seq)
+            register_error(
+                f"decode pool quarantined after fatal device error "
+                f"({type(exc).__name__}: {exc}); rebuilt fresh and "
+                f"replayed {replayed} sequence(s)",
+                kind="serving",
+                operator=self.name,
+            )
+            self._record_span(
+                "kv:rebuild", t0,
+                {"replayed": replayed, "pending": len(self._pending)},
+            )
+            # queued admissions were never lost — wake the pump so they
+            # drain against the fresh pool
+            self._work.notify_all()
+            return replayed
+        finally:
+            self._recovering = False
+
+    def _resurrect_locked(
+        self, seq: _Seq, exc: BaseException
+    ) -> tuple[str, list[int]] | None:
+        """Re-seat one sequence in the fresh pool and restore its stream
+        state so decode resumes token-for-token.  Returns
+        ``("prefill", head)`` when a replay prefill launch is still
+        needed, ``("live", [])`` when a prefix match covered the replay
+        (the remainder rides forced ingestion), or ``None`` when the
+        sequence could not be resurrected (requeued or failed)."""
+        resident = seq.length
+        if resident <= 0:
+            # nothing device-resident yet: back to the queue head for a
+            # fresh admission
+            self._pending.appendleft(seq)
+            return None
+        replay = seq.all_tokens[:resident]
+        # worst-case reservation mirrors admission: cover the resident
+        # replay plus every token the stream may still consume (equal to
+        # the sequence's original reservation, so it always fits)
+        rest = 1 + len(seq.forced) + max(0, seq.max_new - len(seq.generated))
+        need = self.pool.blocks_for(
+            min(resident + rest - 1, self.cfg.max_len)
+        )
+        alloc = self.pool.allocator
+        full: list[int] = []
+        chain = PrefixIndex.root_key(self.params)
+        partial: tuple[int, int] | None = None
+        if self.prefix_share:
+            full, chain, partial = self.pool.prefix.match(self.params, replay)
+        for b in full:
+            alloc.acquire(b)
+        if partial is not None:
+            alloc.acquire(partial[0])
+        fresh = alloc.alloc(need - len(full))
+        if fresh is None:
+            rollback = list(full) + (
+                [partial[0]] if partial is not None else []
+            )
+            if rollback:
+                alloc.free(rollback)
+            self._retained.pop(id(seq.handle), None)
+            if seq.handle is not None and not seq.handle.done:
+                seq.handle._finish(exc)
+            return None
+        bs = self.pool.block_size
+        matched_len = len(full) * bs + (partial[1] if partial else 0)
+        if partial is not None:
+            seq.blocks = full + [partial[0]] + fresh[1:]
+            seq.cow_spare = fresh[0]
+        else:
+            seq.blocks = full + fresh
+        if matched_len:
+            _bump(
+                "prefix_hit_blocks_total",
+                len(full) + (1 if partial is not None else 0),
+            )
+            _bump("prefix_hit_tokens_total", matched_len)
+        seq.chain = chain
+        seq.registered_upto = len(full)
+        seq.replayed += 1
+        _bump("fault_replays_total")
+        # restore the stream state so decode resumes EXACTLY where it
+        # left off: the not-yet-consumed input chain (next_input +
+        # forced) is prepended with whatever part of the replay is not
+        # covered by prefill/prefix blocks, and the sampling counter is
+        # rewound so it returns to its fault-time value exactly when the
+        # length does (every replay lane's sampled output is discarded
+        # by _consume_token_locked while forced input remains, so the
+        # interim counter values never reach a committed token)
+        pend = [seq.next_input] + list(seq.forced)
+        if matched_len == 0:
+            head = replay[:MAX_PACKED_TOKENS]
+            seq.length = 0
+            seq.forced = deque(replay[len(head):] + pend)
+            seq.count -= resident - len(head)
+            return ("prefill", head)
+        seq.length = matched_len
+        tail = replay[matched_len:] + pend
+        seq.next_input = tail[0]
+        seq.forced = deque(tail[1:])
+        seq.count -= resident - matched_len
+        return ("live", [])
+
+    def _prefill_batch_locked(
+        self,
+        batch: list[_Seq],
+        tokens: list[list[int]] | None = None,
+        replay: bool = False,
+    ) -> None:
+        """Packed prefill of one batch.  ``tokens`` overrides the rows'
+        token lists (replay re-prefill feeds the recorded stream head,
+        not ``seq.ids``); ``replay=True`` keeps each row's restored
+        sampling counter instead of resetting it — the launch's sampled
+        tokens are discarded either way (the true continuation sits in
+        ``seq.forced``)."""
         bs = self.pool.block_size
         NB = self.pool.num_blocks
-        lens = [len(s.ids) for s in batch]
+        row_tokens = tokens if tokens is not None else [s.ids for s in batch]
+        lens = [len(t) for t in row_tokens]
         t_real = sum(lens)
         T = _bucket_of(t_real, _PREFILL_TOKEN_BUCKETS)
         R = _pow2_bucket(len(batch))
@@ -1051,7 +1398,7 @@ class DecodeSession:
         off = 0
         for j, seq in enumerate(batch):
             ln = lens[j]
-            ids[off : off + ln] = seq.ids
+            ids[off : off + ln] = row_tokens[j]
             p = np.arange(ln, dtype=np.int32)
             pos[off : off + ln] = p
             seg[off : off + ln] = j
@@ -1064,13 +1411,16 @@ class DecodeSession:
             cu[j + 1] = off
         bounds = ragged_bounds(cu, T, ragged_block(T))
         t0 = time.monotonic()
-        k_pool, v_pool, logits = _prefill_jit()(
-            self.params, self.pool.k_pool, self.pool.v_pool,
-            jnp.asarray(ids), jnp.asarray(pos), jnp.asarray(seg),
-            jnp.asarray(starts), jnp.asarray(bounds),
-            jnp.asarray(dest_block), jnp.asarray(dest_slot),
-            jnp.asarray(last_idx),
-            cfg=self.cfg, num_rows=R, dense_s=dense_s, mode=self.mode,
+        k_pool, v_pool, logits = self._launch_guarded_locked(
+            "device.prefill",
+            lambda: _prefill_jit()(
+                self.params, self.pool.k_pool, self.pool.v_pool,
+                jnp.asarray(ids), jnp.asarray(pos), jnp.asarray(seg),
+                jnp.asarray(starts), jnp.asarray(bounds),
+                jnp.asarray(dest_block), jnp.asarray(dest_slot),
+                jnp.asarray(last_idx),
+                cfg=self.cfg, num_rows=R, dense_s=dense_s, mode=self.mode,
+            ),
         )
         self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
         seeds = np.zeros(R, np.int32)
@@ -1092,7 +1442,8 @@ class DecodeSession:
         _bump("prefill_tokens_total", t_real)
         for j, seq in enumerate(batch):
             seq.length = lens[j]
-            seq.count = 1
+            if not replay:
+                seq.count = 1
             self._register_progress_locked(seq)
             self._register_partial_locked(seq)
             tok = int(first[j])
@@ -1230,13 +1581,26 @@ class DecodeSession:
         if not active.any():
             return False
         t0 = time.monotonic()
-        k_pool, v_pool, toks_next = _step_jit()(
-            self.params, self.pool.k_pool, self.pool.v_pool,
-            jnp.asarray(bt), jnp.asarray(lengths), jnp.asarray(toks),
-            jnp.asarray(active), jnp.asarray(seeds), jnp.asarray(counts),
-            jnp.asarray(temps),
-            cfg=self.cfg, block_size=self.pool.block_size, mode=self.mode,
-        )
+        try:
+            k_pool, v_pool, toks_next = self._launch_guarded_locked(
+                "device.decode_step",
+                lambda: _step_jit()(
+                    self.params, self.pool.k_pool, self.pool.v_pool,
+                    jnp.asarray(bt), jnp.asarray(lengths), jnp.asarray(toks),
+                    jnp.asarray(active), jnp.asarray(seeds),
+                    jnp.asarray(counts), jnp.asarray(temps),
+                    cfg=self.cfg, block_size=self.pool.block_size,
+                    mode=self.mode,
+                ),
+            )
+        except BaseException as exc:
+            if classify_device_error(exc) == FATAL:
+                raise  # tick-level handler quarantines + replays
+            self._contain_launch_failure_locked(
+                [p[0] for r, p in enumerate(plans) if active[r]],
+                exc, "decode_step",
+            )
+            return True
         self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
         out = np.asarray(toks_next)  # host read = device sync (handler contract)
         self._record_span(
@@ -1282,13 +1646,27 @@ class DecodeSession:
         if not active.any():
             return False
         t0 = time.monotonic()
-        k_pool, v_pool, toks_out = _multi_jit()(
-            self.params, self.pool.k_pool, self.pool.v_pool,
-            jnp.asarray(bt), jnp.asarray(base), jnp.asarray(n_new),
-            jnp.asarray(toks), jnp.asarray(active), jnp.asarray(seeds),
-            jnp.asarray(counts), jnp.asarray(temps),
-            cfg=self.cfg, block_size=self.pool.block_size, mode=self.mode,
-        )
+        try:
+            k_pool, v_pool, toks_out = self._launch_guarded_locked(
+                "device.verify",
+                lambda: _multi_jit()(
+                    self.params, self.pool.k_pool, self.pool.v_pool,
+                    jnp.asarray(bt), jnp.asarray(base), jnp.asarray(n_new),
+                    jnp.asarray(toks), jnp.asarray(active),
+                    jnp.asarray(seeds), jnp.asarray(counts),
+                    jnp.asarray(temps),
+                    cfg=self.cfg, block_size=self.pool.block_size,
+                    mode=self.mode,
+                ),
+            )
+        except BaseException as exc:
+            if classify_device_error(exc) == FATAL:
+                raise  # tick-level handler quarantines + replays
+            self._contain_launch_failure_locked(
+                [p[0] for r, p in enumerate(plans) if active[r]],
+                exc, "verify",
+            )
+            return True
         self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
         out = np.asarray(toks_out)  # host read = device sync
         self._record_span(
@@ -1429,6 +1807,13 @@ class DecodeSession:
             "ticks_total": self.ticks_total,
             "mode": self.mode,
             "hbm_bytes": self.pool.hbm_bytes(),
+            "recovering": self._recovering,
+            "breaker": None if self.breaker is None else self.breaker.state,
+            "fault_retries": self.fault_retries,
+            "replayed_sequences": sum(
+                1 for s in list(self._live) + list(self._retained.values())
+                if s.replayed
+            ),
         }
 
 

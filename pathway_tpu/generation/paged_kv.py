@@ -384,6 +384,26 @@ class PagedKVPool:
         # a lingering (freed-but-registered) block handed to a fresh
         # allocation stops being content-addressable first
         self.allocator.on_reuse = self.prefix.forget
+        #: set by :meth:`quarantine` after a FATAL device fault
+        self.quarantined = False
+
+    def quarantine(self) -> None:
+        """Poison this pool after a FATAL device fault.
+
+        The owning session swaps in a FRESH pool and resurrects its
+        sequences by replay re-prefill; the old pool's K/V content is
+        suspect and must never be read or handed out again.  Dropping
+        the device arrays lets jax reclaim the HBM the moment the last
+        in-flight launch referencing them retires, emptying the free
+        list makes any stray ``alloc`` return ``None`` (queue, don't
+        serve poison), and resetting the prefix index guarantees no
+        content-address ever resolves back into this pool."""
+        self.quarantined = True
+        self.allocator._free.clear()
+        self.prefix = PrefixIndex(self.block_size)
+        self.allocator.on_reuse = self.prefix.forget
+        self.k_pool = None
+        self.v_pool = None
 
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens`` KV entries."""

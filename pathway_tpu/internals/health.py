@@ -251,7 +251,10 @@ class HealthRegistry:
         # bare probe never mints empty series), the capacity payload a
         # least-loaded fleet router places load on (HBM ledger totals +
         # free HBM + runtime occupancy, ROADMAP item 4), and paged-KV
-        # generation counters
+        # generation counters — whose "faults" sub-block (launch-retry /
+        # containment / replay counters, per-session breaker states and
+        # recovering flags) is what an operator reads first during a
+        # generation-plane incident
         _attach_module_block(
             snap, "mesh", "pathway_tpu.parallel.index", "mesh_status"
         )

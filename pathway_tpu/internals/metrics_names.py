@@ -282,6 +282,23 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "speculative draft tokens accepted by the multi-position verify launch",
     ),
+    # -- generation-plane fault containment (ISSUE 18) --
+    "pathway_decode_fault_retries_total": (
+        "counter",
+        "transient device-launch failures retried in place (PATHWAY_DECODE_FAULT_RETRIES)",
+    ),
+    "pathway_decode_fault_contained_total": (
+        "counter",
+        "launch failures contained to their own sequences (blast-radius isolation)",
+    ),
+    "pathway_decode_fault_replays_total": (
+        "counter",
+        "sequences resurrected by replay re-prefill after a fatal pool quarantine",
+    ),
+    "pathway_kv_pool_rebuilds_total": (
+        "counter",
+        "paged-KV pools quarantined and reallocated fresh after a FATAL device error",
+    ),
     # -- replicated serving fleet (fleet/router.py /status) --
     "pathway_fleet_replicas": (
         "gauge",

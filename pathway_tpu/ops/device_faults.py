@@ -57,9 +57,13 @@ def classify_device_error(exc: BaseException) -> str | None:
     from ..testing.faults import FaultInjected
 
     if isinstance(exc, FaultInjected):
-        # chaos-injected faults model a flaky dispatch, not corrupted
-        # HBM — breaker-and-degrade territory
-        return TRANSIENT if exc.site.startswith("device.") else None
+        # chaos-injected faults on device-plane sites model a flaky
+        # dispatch (breaker-and-degrade territory) unless flagged fatal,
+        # which models corrupted device state (quarantine/replay
+        # territory); non-device sites keep their local containment
+        if exc.site.startswith("device.") or exc.site == "kv.alloc":
+            return FATAL if getattr(exc, "fatal", False) else TRANSIENT
+        return None
     msg = str(exc).lower()
     for t in type(exc).__mro__:
         if t.__name__ in _FATAL_TYPE_NAMES:

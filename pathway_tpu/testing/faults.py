@@ -38,6 +38,28 @@ Sites wired into the codebase:
                           snapshot (streaming driver) — retried with the
                           same bound; the chaos suite pins that seeded
                           failures retry cleanly
+``device.prefill``        the packed ragged prefill launch admitting new
+                          decode sequences (``generation/engine.py``) —
+                          ``fail`` is retried once then contained to the
+                          hit batch, ``fatal`` quarantines the KV pool
+``device.decode_step``    the single-token decode launch — same
+                          retry/containment contract as prefill
+``device.verify``         the speculative multi-token verify/ingest
+                          launch — same contract
+``kv.alloc``              paged-KV block allocation at admission/extend —
+                          ``fail`` keeps the request queued (admission)
+                          or refuses the extension, ``fatal`` quarantines
+``tier.migrate``          a tiered-index migration pass
+                          (``tiering/index.py``) — ``fail`` is absorbed
+                          as ``migrate_errors``; serving never notices
+``cache.refresh``         a stale-while-revalidate result-cache refresh
+                          (``xpacks/llm/_query_cache.py``) — contained
+                          by the refresh batch's error handling
+``fleet.rpc``             one router→replica proxy attempt
+                          (``fleet/router.py``) — ``fail``/``drop`` is
+                          treated like a transport error: failover to
+                          the next replica (streams: only before the
+                          first forwarded body byte)
 ========================  ====================================================
 
 Activation:
@@ -47,10 +69,13 @@ Activation:
 * environment — ``PATHWAY_FAULTS="connector.read:fail=0.05;udf:fail=0.1"``
   plus ``PATHWAY_FAULT_SEED=7``, parsed at import.
 
-Rules per site: ``fail`` / ``drop`` / ``delay`` probabilities in [0, 1]
-(at most one action fires per call, tried in that order) and ``delay_ms``
-for the delay action.  All injections are counted; :func:`stats` feeds
-``/v1/health`` and ``benchmarks/soak.py --chaos`` reports.
+Rules per site: ``fail`` / ``fatal`` / ``drop`` / ``delay`` probabilities
+in [0, 1] (at most one action fires per call, tried in that order) and
+``delay_ms`` for the delay action.  ``fatal`` raises a
+:class:`FaultInjected` flagged so ``ops/device_faults.py`` classifies it
+FATAL — the chaos lever for the quarantine/replay recovery path.  All
+injections are counted; :func:`stats` feeds ``/v1/health`` and
+``benchmarks/soak.py --chaos`` reports.
 """
 
 from __future__ import annotations
@@ -66,6 +91,7 @@ from typing import Any
 
 __all__ = [
     "FaultInjected",
+    "SITES",
     "configure",
     "configure_from_env",
     "reset",
@@ -76,18 +102,43 @@ __all__ = [
     "current_seed",
 ]
 
+#: the single source of truth for chaos-site names: every site string a
+#: call site passes to :func:`perturb` must be declared here and vice
+#: versa (both directions linted in tests/test_generation_faults.py), so
+#: a renamed site can never silently turn chaos coverage off
+SITES: dict[str, str] = {
+    "connector.read": "each row a ConnectorSubject pushes (io/streaming.py)",
+    "udf": "each UDF/apply invocation (internals/{evaluator,runtime}.py)",
+    "embedder": "fused serving-plane embed stage (xpacks/llm/_scheduler.py)",
+    "scheduler.step": "each device-step batch the serving scheduler runs",
+    "device.upsert": "staged device scatter applying index upserts (ops/knn.py)",
+    "index.snapshot": "each index snapshot-delta write (lowering.py)",
+    "index.restore": "each warm-restart index snapshot restore attempt",
+    "device.prefill": "packed ragged prefill launch (generation/engine.py)",
+    "device.decode_step": "single-token decode launch (generation/engine.py)",
+    "device.verify": "speculative verify/ingest launch (generation/engine.py)",
+    "kv.alloc": "paged-KV block allocation at admission/extend",
+    "tier.migrate": "tiered-index migration pass (tiering/index.py)",
+    "cache.refresh": "result-cache refresh recompute (xpacks/llm/_query_cache.py)",
+    "fleet.rpc": "one router-to-replica proxy attempt (fleet/router.py)",
+}
+
 #: hot-path guard — sites check this module global before calling
 #: :func:`perturb`, so an unconfigured process pays one attribute load
 enabled: bool = False
 
 
 class FaultInjected(RuntimeError):
-    """Raised by a ``fail`` injection; carries the site for assertions."""
+    """Raised by a ``fail``/``fatal`` injection; carries the site for
+    assertions and a ``fatal`` flag that ``classify_device_error`` maps
+    to FATAL (modeling corrupted device state, not a flaky dispatch)."""
 
-    def __init__(self, site: str, n: int):
-        super().__init__(f"injected fault at {site!r} (call #{n})")
+    def __init__(self, site: str, n: int, *, fatal: bool = False):
+        kind = "fatal fault" if fatal else "fault"
+        super().__init__(f"injected {kind} at {site!r} (call #{n})")
         self.site = site
         self.call_number = n
+        self.fatal = bool(fatal)
 
 
 class _Plan:
@@ -97,11 +148,12 @@ class _Plan:
         for site, rule in rules.items():
             r = {
                 "fail": float(rule.get("fail", 0.0)),
+                "fatal": float(rule.get("fatal", 0.0)),
                 "drop": float(rule.get("drop", 0.0)),
                 "delay": float(rule.get("delay", 0.0)),
                 "delay_ms": float(rule.get("delay_ms", 5.0)),
             }
-            if r["fail"] + r["drop"] + r["delay"] > 1.0:
+            if r["fail"] + r["fatal"] + r["drop"] + r["delay"] > 1.0:
                 raise ValueError(
                     f"fault probabilities for site {site!r} sum over 1.0"
                 )
@@ -126,11 +178,14 @@ class _Plan:
             return "ok"
         n = next(self._counters[site])
         u = self._uniform(site, n)
-        if u < rule["fail"]:
+        edge = rule["fail"]
+        if u < edge:
             action = "fail"
-        elif u < rule["fail"] + rule["drop"]:
+        elif u < (edge := edge + rule["fatal"]):
+            action = "fatal"
+        elif u < (edge := edge + rule["drop"]):
             action = "drop"
-        elif u < rule["fail"] + rule["drop"] + rule["delay"]:
+        elif u < edge + rule["delay"]:
             action = "delay"
         else:
             return "ok"
@@ -149,6 +204,8 @@ class _Plan:
             return "ok"
         if action == "fail":
             raise FaultInjected(site, n)
+        if action == "fatal":
+            raise FaultInjected(site, n, fatal=True)
         return "drop"
 
 

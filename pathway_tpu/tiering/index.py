@@ -485,6 +485,21 @@ class TieredKnnIndex:
         searches see either the old or the new placement, never half."""
         t0 = time.monotonic()
         wall = time.time()
+        from ..testing import faults as _faults
+
+        if _faults.enabled:
+            try:
+                _faults.perturb("tier.migrate")
+            except _faults.FaultInjected:
+                # chaos containment: a failed migration pass is absorbed
+                # right here — placements stay exactly as they were (the
+                # batch is all-or-nothing under the lock anyway), serving
+                # never notices, and the next search window re-arms the
+                # check via the cleared pending flag
+                with self._lock:
+                    self._migration_pending = False
+                    self.migrate_errors += 1
+                return {"promoted": 0, "demoted": 0}
         with self._lock:
             self._migration_pending = False
             self._hits_dirty = 0

@@ -859,6 +859,14 @@ class QueryCacheStack:
         result cache (a read would hit the same stale entry and loop)
         and write the fresh rows back under the keys the stale serve
         recorded.  The caller releases the in-flight markers."""
+        from ...testing import faults as _faults
+
+        if _faults.enabled:
+            # chaos site cache.refresh: a raise here is contained by the
+            # scheduler's refresh-batch guard (which logs and ALWAYS
+            # releases the in-flight markers), so a failed recompute just
+            # leaves the stale entry serving out its window
+            _faults.perturb("cache.refresh")
         texts = [q for q, _, _ in items]
         specs = [(k, flt) for _, k, flt in items]
         tkeys, ids_all, mask_all, lens = self._tokenize_keys(texts)

@@ -567,6 +567,31 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
                     "context_docs": docs,
                 }
 
+            def _contained_fault(exc: BaseException) -> dict:
+                """Terminal error line for a generation-plane fault the
+                engine contained (blast-radius isolation / pool
+                recovery): a RETRYABLE server fault, not LLM sickness —
+                never charged to the LLM breaker, and distinguishable
+                from a network cut because the line still arrives."""
+                from ...internals.errors import register_error
+
+                register_error(
+                    f"streamed generation hit a contained device fault: "
+                    f"{type(exc).__name__}: {exc}",
+                    kind="serving",
+                    operator="pw_ai_answer_stream",
+                )
+                dur_ms = (_time_mod.monotonic() - t0) * 1000.0
+                record_span("llm", "llm", wall0, dur_ms, attrs={"ok": False})
+                observe_stage("llm", dur_ms)
+                return {
+                    "event": "error",
+                    "kind": "error",
+                    "retryable": True,
+                    "detail": f"{type(exc).__name__}: {exc}",
+                    "context_docs": docs,
+                }
+
             # the FIRST pull runs decode admission: queue backpressure /
             # deadline sheds surface as real 503 + Retry-After (the
             # retrieval stage's contract) BEFORE headers go out, and are
@@ -582,6 +607,17 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
                     },
                 )
             except Exception as exc:  # noqa: BLE001 — degrade, don't 5xx
+                from ...ops.device_faults import classify_device_error
+
+                if classify_device_error(exc) is not None:
+                    # contained device fault before headers: a retry hits
+                    # a recovered engine — shed-shaped 503, no breaker
+                    # charge
+                    return web.json_response(
+                        {"detail": str(exc), "retryable": True},
+                        status=503,
+                        headers={"Retry-After": "1.0"},
+                    )
                 return web.json_response(_gen_failed(exc))
             resp = web.StreamResponse(
                 status=200,
@@ -646,7 +682,12 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
                     await resp.write_eof()
                     return resp
                 except Exception as exc:  # noqa: BLE001 — degrade, don't 5xx
-                    await emit(_gen_failed(exc))
+                    from ...ops.device_faults import classify_device_error
+
+                    if classify_device_error(exc) is not None:
+                        await emit(_contained_fault(exc))
+                    else:
+                        await emit(_gen_failed(exc))
                     await resp.write_eof()
                     return resp
             self.llm_breaker.record_success()
@@ -1032,7 +1073,16 @@ class RAGClient(RestClientBase):
         deadline_ms: float | None = None,
     ):
         """Stream ``/v1/pw_ai_answer_stream`` NDJSON events as dicts
-        (``context`` / ``token`` / ``done``) as the server emits them."""
+        (``context`` / ``token`` / ``done`` / ``error``) as the server
+        emits them.
+
+        A terminal ``{"kind": "error", "retryable": true}`` line means
+        the server hit a *contained* generation-plane fault mid-stream
+        (blast-radius isolation or KV-pool recovery): the stream ended
+        early but the server is healthy and a retried request will hit a
+        recovered engine.  A connection that dies with NO terminal
+        ``done``/``error`` line is a network cut — the two are
+        deliberately distinguishable."""
         import json as _json
         import urllib.request
 

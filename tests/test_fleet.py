@@ -801,3 +801,157 @@ def test_snapshot_seeded_replica_spawn_zero_reembeds(tmp_path):
     assert [r["text"] for r in warm_results] == [
         r["text"] for r in cold_results
     ]
+
+
+# ---------------------------------------------------------------------------
+# streamed proxying (ISSUE 18 satellite): failover only before first byte
+# ---------------------------------------------------------------------------
+
+
+class _StreamStubReplica:
+    """Replica stub for ``/v1/pw_ai_answer_stream``: NDJSON body with
+    modes "ok" (token line + terminal done line), "shed" (503 before any
+    body byte) and "die_mid" (one token line, then the socket drops with
+    NO terminal line)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.mode = "ok"
+        self.stream_hits = 0
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def do_GET(self):  # noqa: N802
+                body = json.dumps(_payload(
+                    epoch={"id": stub.name, "start_seq": 1}
+                )).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", "0"))
+                self.rfile.read(n)
+                stub.stream_hits += 1
+                if stub.mode == "shed":
+                    body = b'{"detail": "overloaded"}'
+                    self.send_response(503)
+                    self.send_header("Retry-After", "0.5")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()  # HTTP/1.0: EOF delimits the body
+                self.wfile.write(json.dumps(
+                    {"event": "token", "round": 0, "text": stub.name}
+                ).encode() + b"\n")
+                self.wfile.flush()
+                if stub.mode == "die_mid":
+                    # the replica dies AFTER the first body byte: close
+                    # without the terminal line — the router must
+                    # truncate, never re-dispatch to another replica
+                    self.close_connection = True
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                    return
+                time.sleep(0.05)
+                self.wfile.write(json.dumps(
+                    {"event": "done", "response": stub.name,
+                     "degraded": False}
+                ).encode() + b"\n")
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def kill(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def stream_fleet():
+    stubs = [_StreamStubReplica("s0"), _StreamStubReplica("s1")]
+    router = FleetRouter(
+        poll_interval_s=0.2, liveness_timeout_s=5.0, attempt_timeout_s=5.0
+    )
+    port = router.start(port=_free_port())
+    for s in stubs:
+        router.register_replica(
+            s.name, s.url,
+            payload=_payload(epoch={"id": s.name, "start_seq": 1}),
+        )
+    yield router, port, stubs
+    router.stop()
+    for s in stubs:
+        try:
+            s.kill()
+        except Exception:
+            pass
+    from pathway_tpu.internals.health import reset_health
+
+    reset_health()
+
+
+def _stream_via_router(port, prompt, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/pw_ai_answer_stream",
+        data=json.dumps({"prompt": prompt}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        headers = dict(resp.headers)
+        lines = [json.loads(ln) for ln in resp if ln.strip()]
+    return headers, lines
+
+
+def _query_owned_by(router, owner: str) -> str:
+    return next(
+        f"stream me {i}" for i in range(500)
+        if router.plan_for(f"stream me {i}").order[0] == owner
+    )
+
+
+def test_router_stream_failover_before_first_byte(stream_fleet):
+    """A replica that sheds BEFORE any body byte fails over exactly like
+    the buffered routes — the stream arrives intact from the next
+    replica and ``x-pathway-fleet-attempts`` counts both attempts."""
+    router, port, (s0, s1) = stream_fleet
+    q = _query_owned_by(router, s0.name)
+    s0.mode = "shed"
+    headers, lines = _stream_via_router(port, q)
+    assert headers["x-pathway-fleet-replica"] == "s1"
+    assert int(headers["x-pathway-fleet-attempts"]) == 2
+    assert lines[0]["event"] == "token"
+    assert lines[-1]["event"] == "done" and lines[-1]["response"] == "s1"
+    assert router.stats()["counters"]["failovers"] >= 1
+
+
+def test_router_stream_never_retries_after_first_byte(stream_fleet):
+    """Once the first body byte has been forwarded the response is
+    committed to that replica: a mid-stream death truncates the stream
+    (no terminal line — detectable client-side per the error-line
+    contract) and the other replica never sees a re-dispatch that would
+    re-send already-delivered tokens."""
+    router, port, (s0, s1) = stream_fleet
+    q = _query_owned_by(router, s0.name)
+    s0.mode = "die_mid"
+    other_before = s1.stream_hits
+    headers, lines = _stream_via_router(port, q)
+    assert headers["x-pathway-fleet-replica"] == "s0"
+    assert int(headers["x-pathway-fleet-attempts"]) == 1
+    # the first byte arrived, the terminal line did NOT
+    assert lines and lines[0]["event"] == "token"
+    assert lines[-1]["event"] != "done"
+    assert s1.stream_hits == other_before  # no re-dispatch

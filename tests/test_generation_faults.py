@@ -1,0 +1,469 @@
+"""Generation-plane fault containment (ISSUE 18).
+
+The containment contract, pinned here end to end:
+
+* a TRANSIENT launch fault (chaos ``fail`` on a ``device.*`` site, a
+  flaky dispatch) is retried once per launch and then CONTAINED to the
+  launched sequences — the session survives, live rows elsewhere never
+  notice, and repeated containments trip a per-session generation
+  breaker that sheds NEW admissions as 503 + Retry-After;
+* a FATAL classification (chaos ``fatal``, XLA runtime error, OOM)
+  quarantines the paged-KV pool and resurrects every live and retained
+  sequence by replay re-prefill from its recorded token ids — streams
+  resume token-for-token against the dense oracle, in both kernel modes
+  and with prefix sharing on or off;
+* the chaos-site registry (``testing/faults.SITES``) is linted in BOTH
+  directions against the source tree, so a renamed site can never
+  silently turn chaos coverage off.
+"""
+
+import re
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from pathway_tpu.generation import DecodeSession
+from pathway_tpu.generation.engine import generation_status
+from pathway_tpu.models.decoder import CausalLM, DecoderConfig
+from pathway_tpu.testing import faults
+from pathway_tpu.testing.faults import SITES, FaultInjected
+
+TINY = DecoderConfig(
+    vocab_size=211, hidden_dim=64, num_layers=2, num_heads=4, mlp_dim=128,
+    max_len=128, dtype=jnp.float32,
+)
+
+_LMS: dict = {}
+
+
+def _lm(cfg=TINY) -> CausalLM:
+    key = (cfg.dtype.__name__, cfg.hidden_dim)
+    if key not in _LMS:
+        _LMS[key] = CausalLM(cfg=cfg, seed=3)
+    return _LMS[key]
+
+
+def _session(cfg=TINY, **kw) -> DecodeSession:
+    kw.setdefault("auto", False)
+    kw.setdefault("pool_tokens", 2048)
+    kw.setdefault("block_size", 16)
+    return DecodeSession(cfg, _lm(cfg).params, **kw)
+
+
+# ---------------------------------------------------------------------------
+# fault-site registry lint (bidirectional, the metrics-names idiom)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_site_registry_is_bidirectionally_complete():
+    """Every site literal passed to ``faults.perturb`` is declared in
+    ``SITES``, and every declared site appears as a quoted literal
+    somewhere in the package — a renamed or forgotten site fails here
+    instead of silently running with no chaos coverage."""
+    import pathlib
+
+    import pathway_tpu
+
+    root = pathlib.Path(pathway_tpu.__file__).parent
+    perturb_re = re.compile(r"""perturb\(\s*['"]([a-z_.]+)['"]""")
+    used: set[str] = set()
+    corpus = ""
+    for p in sorted(root.rglob("*.py")):
+        text = p.read_text()
+        corpus += text
+        used.update(perturb_re.findall(text))
+    unknown = used - set(SITES)
+    assert not unknown, f"perturb() sites missing from SITES: {unknown}"
+    # reverse: declared sites must be referenced as literals somewhere
+    # (sites routed through a variable — io/streaming's _fault_site —
+    # still quote the literal at the assignment)
+    for site in SITES:
+        assert f'"{site}"' in corpus or f"'{site}'" in corpus, (
+            f"SITES entry {site!r} has no quoted literal in the package: "
+            "dead registry entry or chaos coverage silently lost"
+        )
+    # the new generation-plane sites exist (the ISSUE 18 floor)
+    for site in (
+        "device.prefill", "device.decode_step", "device.verify", "kv.alloc",
+        "tier.migrate", "cache.refresh", "fleet.rpc",
+    ):
+        assert site in SITES, site
+
+
+def test_fatal_rule_classifies_fatal_and_fail_transient():
+    from pathway_tpu.ops.device_faults import (
+        FATAL,
+        TRANSIENT,
+        classify_device_error,
+    )
+
+    assert classify_device_error(FaultInjected("device.decode_step", 0)) \
+        == TRANSIENT
+    assert classify_device_error(
+        FaultInjected("device.prefill", 0, fatal=True)
+    ) == FATAL
+    assert classify_device_error(FaultInjected("kv.alloc", 0)) == TRANSIENT
+    # non-device sites keep their local containment paths
+    assert classify_device_error(FaultInjected("udf", 0)) is None
+    with faults.scoped(0, {"x": {"fatal": 1.0}}):
+        with pytest.raises(FaultInjected) as ei:
+            faults.perturb("x")
+        assert ei.value.fatal
+    with pytest.raises(ValueError, match="sum over 1.0"):
+        faults.scoped(0, {"x": {"fail": 0.6, "fatal": 0.6}}).__enter__()
+
+
+# ---------------------------------------------------------------------------
+# transient: retry once, contain on exhaustion, breaker sheds
+# ---------------------------------------------------------------------------
+
+
+def test_transient_launch_fault_retries_once_to_parity():
+    """Chaos seed 4 makes the scoped tick's decode launch fail once then
+    succeed: exactly one retry, no containment, breaker stays closed,
+    and the stream is token-for-token the dense oracle's."""
+    lm = _lm()
+    before = dict(generation_status()["faults"])
+    s = _session()
+    h = s.submit([5, 9, 17, 4], max_new_tokens=6)
+    s.tick()  # clean prefill + first step
+    with faults.scoped(4, {"device.decode_step": {"fail": 0.5}}):
+        s.tick()  # decision 0 = fail → one retry → decision 1 = ok
+    s.drain()
+    assert h.result() == lm.generate_ids([[5, 9, 17, 4]], 6)[0].tolist()
+    after = generation_status()["faults"]
+    assert after["retries_total"] == before["retries_total"] + 1
+    assert after["contained_total"] == before["contained_total"]
+    assert s.stats()["breaker"] == "closed"
+    assert s.stats()["kv_blocks_used"] == 0
+
+
+def test_exhausted_retries_contain_launch_and_breaker_sheds(monkeypatch):
+    """fail=1.0 exhausts the retry budget: the launch's sequences fail
+    (containment), the session survives, and with a threshold of 1 the
+    generation breaker opens — new admissions shed as AdmissionRefused
+    with a Retry-After hint while the session itself keeps serving once
+    the breaker closes."""
+    from pathway_tpu.runtime import AdmissionRefused
+
+    monkeypatch.setenv("PATHWAY_GENERATION_BREAKER_FAILURES", "1")
+    lm = _lm()
+    s = _session(name="breaker-shed-test")
+    assert s.stats()["fault_retries"] == 1  # PATHWAY_DECODE_FAULT_RETRIES
+    h = s.submit([1, 2, 3], max_new_tokens=6)
+    s.tick()  # clean admission
+    before = dict(generation_status()["faults"])
+    with faults.scoped(0, {"device.decode_step": {"fail": 1.0}}):
+        s.tick()  # fail, retry, fail → contained
+    assert h.done
+    with pytest.raises(FaultInjected):
+        h.result(timeout=5)
+    after = generation_status()["faults"]
+    assert after["retries_total"] == before["retries_total"] + 1
+    assert after["contained_total"] == before["contained_total"] + 1
+    assert s.stats()["kv_blocks_used"] == 0  # contained rows freed
+    assert s.stats()["breaker"] == "open"
+    with pytest.raises(AdmissionRefused, match="generation breaker open") as ei:
+        s.submit([4, 5, 6], max_new_tokens=4)
+    assert getattr(ei.value, "retry_after_s", 0) > 0
+    # blast radius: the SESSION is healthy — close the breaker and serve
+    s.breaker.record_success()
+    h2 = s.submit([4, 5, 6], max_new_tokens=4)
+    s.drain()
+    assert h2.result() == lm.generate_ids([[4, 5, 6]], 4)[0].tolist()
+
+
+def test_prefill_launch_failure_spares_live_rows():
+    """Per-launch blast radius: a failed packed-prefill launch fails
+    ONLY the sequences in that launch — the already-live row keeps
+    decoding to oracle parity in the same session."""
+    lm = _lm()
+    s = _session()
+    ha = s.submit([11, 12, 13, 14, 15, 16, 17], max_new_tokens=10)
+    s.tick()  # A is live
+    assert not ha.done
+    hb = s.submit([8, 3], max_new_tokens=4)
+    before = generation_status()["faults"]["contained_total"]
+    with faults.scoped(0, {"device.prefill": {"fail": 1.0}}):
+        s.tick()  # B's prefill fails both attempts → contained to B
+    assert hb.done
+    with pytest.raises(FaultInjected):
+        hb.result(timeout=5)
+    assert not ha.done  # the live row never noticed
+    assert generation_status()["faults"]["contained_total"] == before + 1
+    s.drain()
+    assert ha.result() == lm.generate_ids(
+        [[11, 12, 13, 14, 15, 16, 17]], 10
+    )[0].tolist()
+    assert s.stats()["kv_blocks_used"] == 0
+
+
+def test_kv_alloc_fault_keeps_admission_queued():
+    """A transient kv.alloc fault during admission is backpressure, not
+    an error: the request stays queued and admits cleanly on the next
+    (fault-free) tick."""
+    lm = _lm()
+    s = _session()
+    h = s.submit([2, 4, 6], max_new_tokens=4)
+    with faults.scoped(0, {"kv.alloc": {"fail": 1.0}}):
+        s.tick()
+    assert not h.done
+    assert s.stats()["pending"] == 1  # queued, NOT failed
+    s.drain()
+    assert h.result() == lm.generate_ids([[2, 4, 6]], 4)[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# fatal: quarantine + replay re-prefill, token-for-token
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["reference", "pallas"])
+@pytest.mark.parametrize("prefix_share", [False, True])
+def test_fatal_quarantine_replays_to_token_parity(mode, prefix_share):
+    """A fatal mid-decode fault rebuilds the pool and resurrects every
+    live sequence by replay re-prefill: greedy AND seeded-sampled
+    streams resume token-for-token against a fault-free oracle, in both
+    kernel modes, with prefix sharing on and off."""
+    lm = _lm()
+    before = dict(generation_status()["faults"])
+    s = _session(mode=mode, prefix_share=prefix_share)
+    greedy_prompts = [[5, 9, 17, 4], [8, 3], list(range(40, 56))]
+    handles = [s.submit(p, max_new_tokens=6) for p in greedy_prompts]
+    hs = s.submit([7, 7, 9], max_new_tokens=6, temperature=0.7, seed=5)
+    for _ in range(3):
+        s.tick()
+    with faults.scoped(0, {"device.decode_step": {"fatal": 1.0}}):
+        s.tick()  # FATAL → quarantine, rebuild, replay
+    st = s.stats()
+    assert st["recovering"] is False
+    assert st["replayed_sequences"] >= 1
+    s.drain()
+    for h, p in zip(handles, greedy_prompts):
+        assert h.result() == lm.generate_ids([p], 6)[0].tolist(), p
+    # sampled parity: keys fold (seq seed, step count) and recovery
+    # rewinds the counter, so the replayed stream equals a clean run
+    clean = _session(mode=mode, prefix_share=prefix_share)
+    hc = clean.submit([7, 7, 9], max_new_tokens=6, temperature=0.7, seed=5)
+    clean.drain()
+    assert hs.result() == hc.result()
+    after = generation_status()["faults"]
+    assert after["kv_pool_rebuilds_total"] \
+        == before["kv_pool_rebuilds_total"] + 1
+    assert after["replays_total"] >= before["replays_total"] + 1
+    assert s.stats()["kv_blocks_used"] == 0
+    assert s.stats()["breaker"] == "closed"  # recovery is not sickness
+
+
+def test_queued_admissions_drain_after_recovery():
+    """Pending work survives a pool rebuild: a request still queued when
+    the fatal hit admits against the fresh pool and completes to
+    parity — nothing is lost, nothing double-runs."""
+    lm = _lm()
+    s = _session(pool_tokens=128, block_size=16)  # 8 blocks
+    big_p = list(range(40))
+    second_p = list(range(50))
+    big = s.submit(big_p, max_new_tokens=24)     # 4 blocks
+    second = s.submit(second_p, max_new_tokens=40)  # 6 blocks: must wait
+    s.tick()
+    assert s.stats()["pending"] == 1
+    with faults.scoped(0, {"device.decode_step": {"fatal": 1.0}}):
+        s.tick()
+    s.drain(timeout=240)
+    assert big.result() == lm.generate_ids([big_p], 24)[0].tolist()
+    assert second.result() == lm.generate_ids([second_p], 40)[0].tolist()
+    assert s.stats()["kv_blocks_used"] == 0
+
+
+def test_quarantined_pool_never_serves_blocks_again():
+    from pathway_tpu.generation import PagedKVPool
+
+    pool = PagedKVPool(TINY, block_size=16, pool_tokens=256)
+    got = pool.allocator.alloc(2)
+    assert got is not None
+    pool.quarantine()
+    assert pool.quarantined
+    assert pool.k_pool is None and pool.v_pool is None
+    assert pool.allocator.alloc(1) is None  # queue, don't serve poison
+    assert len(pool.prefix) == 0
+
+
+def test_manual_recover_replays_retained_sequence_for_extend():
+    """Operator-triggered recovery: a retained (adaptive-RAG) sequence
+    is re-seated by replay, and a later extend() continues from the
+    replayed KV to the same tokens the dense oracle produces over the
+    full concatenated stream."""
+    lm = _lm()
+    s = _session()
+    prompt = [11, 12, 13]
+    h = s.submit(prompt, max_new_tokens=6, retain=True)
+    s.drain()
+    g1 = h.result()
+    assert s.stats()["retained"] == 1
+    replayed = s.recover()  # quarantine + replay, no fault needed
+    assert replayed == 1
+    h2 = s.extend(h, [20, 21], max_new_tokens=5)
+    s.drain()
+    oracle = lm.generate_ids([prompt + g1 + [20, 21]], 5)[0].tolist()
+    assert h2.result() == oracle
+    s.release(h2)
+    assert s.stats()["kv_blocks_used"] == 0
+
+
+def test_mid_stream_tokens_are_not_resent_after_recovery():
+    """The stream contract: a consumer that saw k tokens before the
+    fault sees ONLY the continuation afterwards — replay re-prefill
+    restores engine state without re-emitting delivered tokens."""
+    lm = _lm()
+    s = _session()
+    seen: list[int] = []
+    h = s.submit([5, 9, 17, 4], max_new_tokens=8, stream_cb=seen.append)
+    for _ in range(3):
+        s.tick()
+    k = len(seen)
+    assert k >= 1
+    with faults.scoped(0, {"device.decode_step": {"fatal": 1.0}}):
+        s.tick()
+    s.drain()
+    oracle = lm.generate_ids([[5, 9, 17, 4]], 8)[0].tolist()
+    assert seen == oracle  # in order, each token exactly once
+    assert h.result() == oracle
+
+
+# ---------------------------------------------------------------------------
+# serving plane: stream error line + breaker shed over live HTTP
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    sk = socket.socket()
+    sk.bind(("127.0.0.1", 0))
+    port = sk.getsockname()[1]
+    sk.close()
+    return port
+
+
+def _wait_http(call, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return call()
+        except Exception as exc:  # noqa: BLE001 — server still starting
+            last = exc
+            time.sleep(0.3)
+    raise TimeoutError(f"server did not come up: {last}")
+
+
+def test_stream_error_line_and_breaker_shed_over_live_http(tmp_path):
+    """(1) a device-classified fault mid-stream ends the stream with a
+    terminal ``{"kind": "error", "retryable": true}`` NDJSON line — the
+    client can tell a recoverable server fault from a network cut — and
+    NEVER charges the LLM breaker; (2) an open generation breaker sheds
+    the first pull as 503 + Retry-After, not a 5xx, and service resumes
+    once the breaker closes."""
+    import urllib.error
+
+    import pathway_tpu as pw
+    from pathway_tpu.xpacks.llm import mocks
+    from pathway_tpu.xpacks.llm.llms import JaxPipelineChat
+    from pathway_tpu.xpacks.llm.question_answering import (
+        BaseRAGQuestionAnswerer,
+        RAGClient,
+    )
+    from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+    (tmp_path / "doc1.txt").write_text("Tokyo is the capital of Japan.")
+    docs = pw.io.fs.read(
+        tmp_path, format="binary", mode="streaming", with_metadata=True,
+        refresh_interval=0.2,
+    )
+    vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=8))
+    chat = JaxPipelineChat(model=None, causal_lm=_lm(), max_new_tokens=6)
+    qa = BaseRAGQuestionAnswerer(llm=chat, indexer=vs)
+    port = _free_port()
+    qa.build_server(host="127.0.0.1", port=port)
+    qa.server.run(threaded=True, with_cache=False)
+    client = RAGClient(host="127.0.0.1", port=port)
+
+    def ask():
+        evs = list(client.pw_ai_answer_stream("What is the capital of Japan?"))
+        assert evs and evs[-1].get("event") == "done", evs
+        return evs
+
+    _wait_http(ask)
+
+    # (1) mid-stream device fault → terminal retryable error line
+    failures_before = qa.llm_breaker._counters["failures_total"]
+    orig_rounds = qa._stream_rounds
+
+    def faulty_rounds(*a, **k):
+        def gen():
+            yield ("token", 0, "hello")
+            raise FaultInjected("device.decode_step", 0)
+
+        return gen()
+
+    qa._stream_rounds = faulty_rounds
+    try:
+        evs = list(client.pw_ai_answer_stream("mid-stream fault?"))
+    finally:
+        qa._stream_rounds = orig_rounds
+    assert any(e.get("event") == "token" for e in evs)  # bytes were out
+    term = evs[-1]
+    assert term.get("kind") == "error" and term.get("retryable") is True
+    # a contained device fault is NOT LLM sickness
+    assert qa.llm_breaker.state == "closed"
+    assert qa.llm_breaker._counters["failures_total"] == failures_before
+
+    # (2) generation breaker open → first pull sheds 503 + Retry-After
+    sess = _lm().paged_session()
+    for _ in range(sess.breaker.failure_threshold):
+        sess.breaker.record_failure(RuntimeError("synthetic launch failure"))
+    assert sess.breaker.state == "open"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            list(client.pw_ai_answer_stream("shed me?"))
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+    finally:
+        sess.breaker.record_success()
+    assert qa.llm_breaker.state == "closed"  # shed ≠ sick, still
+    # healthy again after the breaker closes
+    evs3 = _wait_http(ask)
+    assert evs3[-1]["response"] is not None
+
+
+# ---------------------------------------------------------------------------
+# health / metrics surface
+# ---------------------------------------------------------------------------
+
+
+def test_generation_status_faults_block_and_metric_families():
+    from pathway_tpu.generation.engine import _PROVIDER
+    from pathway_tpu.internals.metrics_names import declared_metric_names
+
+    s = _session()
+    h = s.submit([9, 8, 7], max_new_tokens=3)
+    s.drain()
+    h.result()
+    fb = generation_status()["faults"]
+    for key in (
+        "retries_total", "contained_total", "replays_total",
+        "kv_pool_rebuilds_total", "recovering", "breakers",
+    ):
+        assert key in fb, key
+    assert fb["breakers"].get(s.name) in ("closed", "open", "half_open")
+    text = "\n".join(_PROVIDER.openmetrics_lines())
+    allowed = declared_metric_names()
+    for family in (
+        "pathway_decode_fault_retries_total",
+        "pathway_decode_fault_contained_total",
+        "pathway_decode_fault_replays_total",
+        "pathway_kv_pool_rebuilds_total",
+    ):
+        assert family in text, family
+        assert family in allowed, family
