@@ -28,6 +28,12 @@ starts a webserver next to live device work and captures one REAL
 ``/v1/debug/profile`` window, banking the artifact's existence + size
 (metric ``device_profile``; platform-gated by the watcher).
 
+``--fleet`` runs the same A/B THROUGH a fleet router (replica + router
+per phase child): the ON side adds the federation scrape plane and the
+dispatch spans, the OFF side kills them with
+``PATHWAY_FLEET_FEDERATION=0`` + the tracing switches (metric
+``fleet_obs_overhead``, same ≤2% p50 acceptance).
+
 Run: ``JAX_PLATFORMS=cpu python benchmarks/obs_overhead.py [n_docs]``
 """
 
@@ -123,6 +129,66 @@ def _phase(n_docs: int) -> dict:
     }
 
 
+def _fleet_phase(n_docs: int) -> dict:
+    """One FLEET serving phase in THIS process: replica + router, the
+    query stream goes through the router's proxy surface — so the
+    measured p50 includes the dispatch span, the forwarded traceparent,
+    and (phase ``on``) the federation scrape plane riding the poller."""
+    import pathway_tpu as pw
+    from pathway_tpu.fleet.router import FleetRouter
+    from pathway_tpu.xpacks.llm import mocks
+    from pathway_tpu.xpacks.llm.vector_store import (
+        VectorStoreClient,
+        VectorStoreServer,
+    )
+
+    tmpdir = tempfile.mkdtemp(prefix="obs_bench_fleet_")
+    texts = _corpus(tmpdir, n_docs)
+    docs = pw.io.fs.read(
+        tmpdir, format="binary", mode="streaming", with_metadata=True,
+        refresh_interval=1.0,
+    )
+    vs = VectorStoreServer(docs, embedder=mocks.FakeEmbedder(dim=64))
+    port = _free_port()
+    vs.run_server(
+        host="127.0.0.1", port=port, threaded=True, with_cache=False,
+        with_scheduler=True,
+    )
+    router = FleetRouter(poll_interval_s=0.5)
+    rport = router.start()
+    router.register_replica("r0", f"http://127.0.0.1:{port}")
+    client = VectorStoreClient(host="127.0.0.1", port=rport)
+    probe = texts[0]
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        try:
+            if client.query(probe, k=1):
+                break
+        except Exception:
+            pass
+        time.sleep(0.25)
+    else:
+        raise TimeoutError("fleet never became queryable")
+    for i in range(WARM_QUERIES):
+        client.query(texts[i % len(texts)], k=3)
+    lat_ms = []
+    t_start = time.monotonic()
+    for i in range(MEASURED_QUERIES):
+        t0 = time.monotonic()
+        client.query(texts[(i * 13) % len(texts)], k=3)
+        lat_ms.append((time.monotonic() - t0) * 1000.0)
+    wall = time.monotonic() - t_start
+    lat_ms.sort()
+    import jax
+
+    return {
+        "p50_ms": round(lat_ms[len(lat_ms) // 2], 3),
+        "p99_ms": round(lat_ms[int(len(lat_ms) * 0.99) - 1], 3),
+        "qps": round(MEASURED_QUERIES / wall, 1),
+        "platform": jax.default_backend(),
+    }
+
+
 def _child(argv: list[str], env: dict, timeout: float = 600.0) -> dict:
     import subprocess
 
@@ -158,6 +224,14 @@ PHASE_ENV = {
         "PATHWAY_SLO_RETRIEVE_P99_MS": "",
         "PATHWAY_SLO_RETRIEVE_AVAIL": "",
     },
+}
+
+#: --fleet A/B: the ON side adds the federation scrape plane on top of
+#: the full observability stack; the OFF side kills tracing AND the
+#: federation (PATHWAY_FLEET_FEDERATION is its documented kill switch)
+FLEET_PHASE_ENV = {
+    "on": {**PHASE_ENV["on"], "PATHWAY_FLEET_FEDERATION": "1"},
+    "off": {**PHASE_ENV["off"], "PATHWAY_FLEET_FEDERATION": "0"},
 }
 
 
@@ -219,13 +293,19 @@ def main() -> int:
     if "--phase" in args:
         print(json.dumps(_phase(n_docs)))
         return 0
+    if "--fleet-phase" in args:
+        print(json.dumps(_fleet_phase(n_docs)))
+        return 0
+    fleet = "--fleet" in args
+    phase_flag = "--fleet-phase" if fleet else "--phase"
+    phase_env = FLEET_PHASE_ENV if fleet else PHASE_ENV
     reps = int(os.environ.get("OBS_BENCH_REPS", "3"))
     phases: dict[str, list[dict]] = {"on": [], "off": []}
     # interleave reps so slow machine drift hits both phases evenly
     for _rep in range(reps):
         for name in ("on", "off"):
             phases[name].append(
-                _child([str(n_docs), "--phase"], PHASE_ENV[name])
+                _child([str(n_docs), phase_flag], phase_env[name])
             )
     med = {
         name: statistics.median(r["p50_ms"] for r in runs)
@@ -237,7 +317,7 @@ def main() -> int:
     }
     overhead = med["on"] / med["off"] - 1.0
     rec = {
-        "metric": "obs_overhead",
+        "metric": "fleet_obs_overhead" if fleet else "obs_overhead",
         "platform": phases["on"][0]["platform"],
         "n_docs": n_docs,
         "queries": MEASURED_QUERIES,
@@ -250,7 +330,12 @@ def main() -> int:
         "p50_per_rep_on": [r["p50_ms"] for r in phases["on"]],
         "p50_per_rep_off": [r["p50_ms"] for r in phases["off"]],
         "meets_acceptance": overhead <= 0.02,
-        "acceptance": "p50 overhead <= 2% with tracing+SLO+ledger fully on",
+        "acceptance": (
+            "p50 overhead <= 2% with tracing+SLO+federation fully on "
+            "(routed through the fleet router)"
+            if fleet
+            else "p50 overhead <= 2% with tracing+SLO+ledger fully on"
+        ),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     print(json.dumps(rec))

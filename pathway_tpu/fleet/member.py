@@ -210,7 +210,14 @@ class FleetMember:
         """Register the member control surface on the replica's
         webserver: ingest fan-in, drain, and the watermark probe.  These
         are CONTROL routes — the drain guard in the webserver exempts
-        ``/v1/fleet/*`` so a draining replica still answers them."""
+        ``/v1/fleet/*`` so a draining replica still answers them.
+
+        The ``/status`` OpenMetrics exposition (the router's federation
+        scrape surface) is guaranteed here too: the webserver's own
+        fallback provides it, but a member must keep the surface even
+        on a webserver whose user registered every fallback away — a
+        replica that cannot be scraped vanishes from the federated
+        exposition."""
         member = self
 
         async def ingest_handler(request):
@@ -234,11 +241,24 @@ class FleetMember:
                 {"replica": member.name, "watermark": member.watermarks()}
             )
 
+        async def status_handler(_request):
+            import asyncio
+
+            from aiohttp import web
+
+            from ..internals.monitoring import exposition
+
+            text = await asyncio.to_thread(exposition)
+            return web.Response(text=text, content_type="text/plain")
+
         webserver.add_raw_route("/v1/fleet/ingest", ("POST",), ingest_handler)
         webserver.add_raw_route("/v1/fleet/drain", ("POST",), drain_handler)
         webserver.add_raw_route(
             "/v1/fleet/watermark", ("GET",), watermark_handler
         )
+        routes = getattr(webserver, "_routes", ())
+        if not any(r[0] == "/status" for r in routes):
+            webserver.add_raw_route("/status", ("GET",), status_handler)
 
     # -- registration / heartbeats ---------------------------------------
     def epoch(self) -> dict:

@@ -60,6 +60,19 @@ DEFAULT_SERVING_ROUTES = (
     "/v1/pw_ai_answer",
 )
 
+#: families the router renders itself in :meth:`FleetRouter.
+#: openmetrics_lines` — the federation plane must not re-expose a
+#: replica-side family under the same name in the same exposition
+_ROUTER_FAMILIES = frozenset({
+    "pathway_fleet_replicas",
+    "pathway_fleet_requests_total",
+    "pathway_fleet_failovers_total",
+    "pathway_fleet_affinity_spills_total",
+    "pathway_fleet_epoch_restarts_total",
+    "pathway_fleet_ingest_batches_total",
+    "pathway_fleet_ingest_watermark",
+})
+
 #: streamed NDJSON surface: retry-on-next-replica is safe ONLY until the
 #: first upstream body byte has been forwarded — after that the response
 #: is committed to one replica and a mid-stream death truncates rather
@@ -213,6 +226,17 @@ class FleetRouter:
         self._poller: threading.Thread | None = None
         self.port: int | None = None
         from ..internals.monitoring import register_metrics_provider
+        from ..observability.federation import (
+            FederationState,
+            federation_enabled,
+        )
+
+        #: telemetry federation (PATHWAY_FLEET_FEDERATION=0 disables):
+        #: per-replica /status scrapes, restart-safe aggregates, fleet
+        #: SLO burn verdicts — all served off the router's own /status
+        self.federation: FederationState | None = (
+            FederationState(clock=clock) if federation_enabled() else None
+        )
 
         register_metrics_provider("fleet_router", self)
 
@@ -250,13 +274,19 @@ class FleetRouter:
     def note_health(self, name: str, payload: dict[str, Any]) -> None:
         """Fold one health payload (poller result, heartbeat, or a
         synthetic payload in tests) into the routing state."""
+        restarted = False
         with self._lock:
             rep = self._replicas.get(name)
             if rep is None:
                 return
             if rep.note_payload(payload):
                 self._counters["epoch_restarts"] += 1
+                restarted = True
             self._maybe_detach(rep)
+        if restarted and self.federation is not None:
+            # a restarted process's counters restart from zero: fold the
+            # old values into the monotonic base BEFORE the next scrape
+            self.federation.reset_replica(name)
 
     def _maybe_detach(self, rep: ReplicaState) -> None:
         # caller holds the lock: a draining replica with nothing in
@@ -270,6 +300,8 @@ class FleetRouter:
             rep = self._replicas.pop(name, None)
             if rep is not None:
                 self._ring.remove(name)
+        if rep is not None and self.federation is not None:
+            self.federation.drop_replica(name)
 
     def replica_names(self, *, live_only: bool = False) -> list[str]:
         with self._lock:
@@ -397,12 +429,22 @@ class FleetRouter:
 
     # -- health polling ---------------------------------------------------
     def poll_once(
-        self, fetch: Callable[[str], dict | None] | None = None
+        self,
+        fetch: Callable[[str], dict | None] | None = None,
+        scrape: Callable[[str], str | None] | None = None,
     ) -> None:
         """One poll sweep.  ``fetch(url) -> payload|None`` is injectable
         for tests; the default GETs ``/v1/health`` (a 503 body still
-        carries the payload — unready is a payload, not an error)."""
+        carries the payload — unready is a payload, not an error).
+
+        The federation scrape (``scrape(url) -> /status text|None``)
+        rides the same cadence.  When ``fetch`` is injected without a
+        ``scrape``, scraping is skipped — synthetic-health tests must
+        not grow a surprise network dependency."""
+        injected = fetch is not None
         fetch = fetch or self._fetch_health
+        if scrape is None and not injected:
+            scrape = self._fetch_status
         with self._lock:
             targets = [
                 (r.name, r.url)
@@ -420,6 +462,24 @@ class FleetRouter:
                         )
                 continue
             self.note_health(name, payload)
+        if self.federation is None or scrape is None:
+            return
+        for name, url in targets:
+            text = scrape(url)
+            if text is None:
+                self.federation.note_scrape_error(name)
+                continue
+            try:
+                self.federation.note_scrape(name, text)
+            except Exception:  # noqa: BLE001 — a bad exposition must not kill the poller
+                self.federation.note_scrape_error(name)
+
+    def _fetch_status(self, url: str) -> str | None:
+        try:
+            with urllib.request.urlopen(url + "/status", timeout=5.0) as r:
+                return r.read().decode()
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
 
     def _fetch_health(self, url: str) -> dict | None:
         try:
@@ -517,6 +577,15 @@ class FleetRouter:
                     f'{{replica="{label}",kind="{kind}"}} '
                     f'{r["watermark"].get(kind, 0)}'
                 )
+        if self.federation is not None:
+            # federated: per-replica re-exposition + monotonic aggregates
+            # + fleet SLO gauges (skip the families the router itself
+            # just emitted — one TYPE line per family per exposition)
+            lines.extend(
+                self.federation.openmetrics_lines(
+                    skip_families=_ROUTER_FAMILIES
+                )
+            )
         return lines
 
     # -- dispatch ---------------------------------------------------------
@@ -528,6 +597,56 @@ class FleetRouter:
         )
 
         return format_traceparent(new_trace_id(), new_span_id())
+
+    def _trace_setup(
+        self, request
+    ) -> tuple[str, str | None, str, str, bool]:
+        """Dispatch-span lineage for one proxied request: ``(trace_id,
+        remote_parent, dispatch_span_id, traceparent, tracing)``.
+
+        The forwarded ``traceparent`` carries the router's DISPATCH span
+        id, so every replica-side request span parents onto it — and the
+        header value stays identical across failover attempts (the
+        stitched tree shows the failed and winning attempts as
+        siblings under one dispatch span)."""
+        from ..internals.flight_recorder import (
+            format_traceparent,
+            get_recorder,
+            new_span_id,
+            new_trace_id,
+            parse_traceparent,
+        )
+
+        parsed = parse_traceparent(request.headers.get("traceparent"))
+        if parsed is not None:
+            trace_id, remote_parent = parsed
+        else:
+            trace_id, remote_parent = new_trace_id(), None
+        dispatch_id = new_span_id()
+        traceparent = format_traceparent(trace_id, dispatch_id)
+        return (
+            trace_id, remote_parent, dispatch_id, traceparent,
+            get_recorder().enabled,
+        )
+
+    def _record_fleet_span(
+        self,
+        name: str,
+        wall: float,
+        t0: float,
+        *,
+        trace_id: str,
+        span_id: str,
+        parent_id: str | None,
+        attrs: dict[str, Any],
+    ) -> None:
+        from ..internals.flight_recorder import record_span
+
+        record_span(
+            name, "fleet", wall, (time.monotonic() - t0) * 1000.0,
+            trace_id=trace_id, span_id=span_id, parent_id=parent_id,
+            attrs=attrs,
+        )
 
     async def _dispatch(self, request):
         """Proxy one serving request: walk the balancer plan, failover on
@@ -544,9 +663,12 @@ class FleetRouter:
         key_text = str(
             payload.get("query") or payload.get("prompt") or request.path
         )
-        traceparent = request.headers.get("traceparent")
-        if traceparent is None:
-            traceparent = self._mint_traceparent()
+        from ..internals.flight_recorder import new_span_id
+
+        (trace_id, remote_parent, dispatch_id, traceparent, tracing) = (
+            self._trace_setup(request)
+        )
+        disp_wall, disp_t0 = time.time(), time.monotonic()
         p = self.plan_for(key_text)
         attempts = 0
         for name in p.order:
@@ -559,6 +681,7 @@ class FleetRouter:
                 rep.inflight += 1
                 url = rep.url
             attempts += 1
+            att_wall, att_t0 = time.time(), time.monotonic()
             try:
                 # chaos site fleet.rpc: one proxy attempt — fail/drop are
                 # both transport-shaped, so the failover path below is
@@ -587,6 +710,14 @@ class FleetRouter:
                     rep.inflight -= 1
                     self._counters["failovers"] += 1
                     self._maybe_detach(rep)
+                if tracing:
+                    self._record_fleet_span(
+                        "fleet:attempt", att_wall, att_t0,
+                        trace_id=trace_id, span_id=new_span_id(),
+                        parent_id=dispatch_id,
+                        attrs={"replica": name, "outcome": "error",
+                               "error": type(exc).__name__},
+                    )
                 continue
             with self._lock:
                 rep.inflight -= 1
@@ -596,10 +727,34 @@ class FleetRouter:
                 # breaker-worthy fault; move to the next replica
                 with self._lock:
                     self._counters["failovers"] += 1
+                if tracing:
+                    self._record_fleet_span(
+                        "fleet:attempt", att_wall, att_t0,
+                        trace_id=trace_id, span_id=new_span_id(),
+                        parent_id=dispatch_id,
+                        attrs={"replica": name, "outcome": "shed",
+                               "status": status},
+                    )
                 continue
             rep.breaker.record_success()
             with self._lock:
                 self._counters["requests_ok"] += 1
+            if tracing:
+                self._record_fleet_span(
+                    "fleet:attempt", att_wall, att_t0,
+                    trace_id=trace_id, span_id=new_span_id(),
+                    parent_id=dispatch_id,
+                    attrs={"replica": name, "outcome": "ok",
+                           "status": status},
+                )
+                self._record_fleet_span(
+                    "fleet:dispatch", disp_wall, disp_t0,
+                    trace_id=trace_id, span_id=dispatch_id,
+                    parent_id=remote_parent,
+                    attrs={"route": request.path, "replica": name,
+                           "attempts": attempts,
+                           "failovers": attempts - 1, "outcome": "ok"},
+                )
             return web.Response(
                 body=body,
                 status=status,
@@ -611,6 +766,15 @@ class FleetRouter:
             )
         with self._lock:
             self._counters["requests_failed"] += 1
+        if tracing:
+            self._record_fleet_span(
+                "fleet:dispatch", disp_wall, disp_t0,
+                trace_id=trace_id, span_id=dispatch_id,
+                parent_id=remote_parent,
+                attrs={"route": request.path, "replica": "",
+                       "attempts": attempts, "failovers": attempts,
+                       "outcome": "failed"},
+            )
         return web.json_response(
             {"detail": "no replica available", "attempts": attempts},
             status=503,
@@ -641,9 +805,12 @@ class FleetRouter:
         key_text = str(
             payload.get("query") or payload.get("prompt") or request.path
         )
-        traceparent = request.headers.get("traceparent")
-        if traceparent is None:
-            traceparent = self._mint_traceparent()
+        from ..internals.flight_recorder import new_span_id
+
+        (trace_id, remote_parent, dispatch_id, traceparent, tracing) = (
+            self._trace_setup(request)
+        )
+        disp_wall, disp_t0 = time.time(), time.monotonic()
         p = self.plan_for(key_text)
         attempts = 0
         for name in p.order:
@@ -656,6 +823,7 @@ class FleetRouter:
                 rep.inflight += 1
                 url = rep.url
             attempts += 1
+            att_wall, att_t0 = time.time(), time.monotonic()
             resp = None
             try:
                 if _faults.enabled and _faults.perturb("fleet.rpc") == "drop":
@@ -681,6 +849,14 @@ class FleetRouter:
                         rep.inflight -= 1
                         self._counters["failovers"] += 1
                         self._maybe_detach(rep)
+                    if tracing:
+                        self._record_fleet_span(
+                            "fleet:attempt", att_wall, att_t0,
+                            trace_id=trace_id, span_id=new_span_id(),
+                            parent_id=dispatch_id,
+                            attrs={"replica": name, "outcome": "shed",
+                                   "status": 503},
+                        )
                     continue
                 if resp.status != 200:
                     # non-streamable answer (4xx/5xx): forward buffered
@@ -692,6 +868,24 @@ class FleetRouter:
                         rep.inflight -= 1
                         self._counters["requests_ok"] += 1
                         self._maybe_detach(rep)
+                    if tracing:
+                        self._record_fleet_span(
+                            "fleet:attempt", att_wall, att_t0,
+                            trace_id=trace_id, span_id=new_span_id(),
+                            parent_id=dispatch_id,
+                            attrs={"replica": name, "outcome": "ok",
+                                   "status": status},
+                        )
+                        self._record_fleet_span(
+                            "fleet:dispatch", disp_wall, disp_t0,
+                            trace_id=trace_id, span_id=dispatch_id,
+                            parent_id=remote_parent,
+                            attrs={"route": request.path, "replica": name,
+                                   "attempts": attempts,
+                                   "failovers": attempts - 1,
+                                   "streaming": True, "committed": False,
+                                   "outcome": "ok"},
+                        )
                     return web.Response(
                         body=body,
                         status=status,
@@ -717,7 +911,27 @@ class FleetRouter:
                     rep.inflight -= 1
                     self._counters["failovers"] += 1
                     self._maybe_detach(rep)
+                if tracing:
+                    self._record_fleet_span(
+                        "fleet:attempt", att_wall, att_t0,
+                        trace_id=trace_id, span_id=new_span_id(),
+                        parent_id=dispatch_id,
+                        attrs={"replica": name, "outcome": "error",
+                               "error": type(exc).__name__},
+                    )
                 continue
+            # commit point reached: the first-byte latency is THE
+            # datum a failover post-mortem needs (everything before it
+            # was still retryable)
+            first_byte_ms = (time.monotonic() - disp_t0) * 1000.0
+            if tracing:
+                self._record_fleet_span(
+                    "fleet:attempt", att_wall, att_t0,
+                    trace_id=trace_id, span_id=new_span_id(),
+                    parent_id=dispatch_id,
+                    attrs={"replica": name, "outcome": "committed",
+                           "status": 200},
+                )
             out = web.StreamResponse(
                 status=200,
                 headers={
@@ -764,9 +978,32 @@ class FleetRouter:
                         "requests_ok" if ok else "requests_failed"
                     ] += 1
                     self._maybe_detach(rep)
+                if tracing:
+                    self._record_fleet_span(
+                        "fleet:dispatch", disp_wall, disp_t0,
+                        trace_id=trace_id, span_id=dispatch_id,
+                        parent_id=remote_parent,
+                        attrs={"route": request.path, "replica": name,
+                               "attempts": attempts,
+                               "failovers": attempts - 1,
+                               "streaming": True, "committed": True,
+                               "first_byte_ms": round(first_byte_ms, 3),
+                               "truncated": not ok,
+                               "outcome": "ok" if ok else "truncated"},
+                    )
             return out
         with self._lock:
             self._counters["requests_failed"] += 1
+        if tracing:
+            self._record_fleet_span(
+                "fleet:dispatch", disp_wall, disp_t0,
+                trace_id=trace_id, span_id=dispatch_id,
+                parent_id=remote_parent,
+                attrs={"route": request.path, "replica": "",
+                       "attempts": attempts, "failovers": attempts,
+                       "streaming": True, "committed": False,
+                       "outcome": "failed"},
+            )
         return web.json_response(
             {"detail": "no replica available", "attempts": attempts},
             status=503,
@@ -850,10 +1087,77 @@ class FleetRouter:
                 "role": "fleet-router",
                 "fleet": self.stats(),
             }
+            if self.federation is not None:
+                snap["fleet_slo"] = self.federation.status()
             return web.json_response(
                 snap, status=200 if routable else 503,
                 headers={} if routable else {"Retry-After": "1.0"},
             )
+
+        async def debug_trace_handler(request):
+            """One stitched trace tree for ``?trace_id=``: the router's
+            own dispatch/attempt spans merged with every replica's
+            ``/v1/debug/traces`` fragment.  A replica that cannot answer
+            marks the result ``incomplete`` — partial evidence, not a
+            500.  ``?format=perfetto`` exports Chrome-tracing JSON via
+            the profiler's span-export path; ``?format=tree`` renders
+            ASCII."""
+            import aiohttp
+
+            from ..internals.flight_recorder import get_recorder
+            from ..observability import federation as fed
+
+            trace_id = request.query.get("trace_id")
+            if not trace_id:
+                return web.json_response(
+                    {"detail": "trace_id is required"}, status=400
+                )
+            router_spans = [
+                s.to_dict()
+                for s in get_recorder().spans(
+                    trace_id=trace_id, mark_read=False
+                )
+            ]
+            with self._lock:
+                targets = [
+                    (r.name, r.url)
+                    for r in self._replicas.values()
+                    if not r.detached
+                ]
+
+            async def fetch(name, url):
+                try:
+                    timeout = aiohttp.ClientTimeout(total=5.0)
+                    async with self._session.get(
+                        url + "/v1/debug/traces",
+                        params={"trace_id": trace_id},
+                        timeout=timeout,
+                    ) as resp:
+                        if resp.status != 200:
+                            return name, None
+                        return name, await resp.json()
+                except (
+                    aiohttp.ClientError,
+                    asyncio.TimeoutError,
+                    OSError,
+                    ValueError,
+                ):
+                    return name, None
+
+            results = await asyncio.gather(
+                *(fetch(n, u) for n, u in targets)
+            )
+            stitched = fed.stitch_trace(
+                trace_id, router_spans, dict(results)
+            )
+            if request.query.get("format") == "perfetto":
+                return web.json_response(fed.stitched_perfetto(stitched))
+            if request.query.get("format") == "tree":
+                return web.Response(
+                    text=fed.render_tree(stitched) + "\n",
+                    content_type="text/plain",
+                )
+            return web.json_response(stitched)
 
         async def status_handler(_request):
             # OpenMetrics expositions terminate with # EOF, like the main
@@ -870,6 +1174,7 @@ class FleetRouter:
         app.router.add_post("/v1/fleet/ingest", ingest_handler)
         app.router.add_get("/v1/fleet/converged", converged_handler)
         app.router.add_get("/v1/health", health_handler)
+        app.router.add_get("/v1/debug/trace", debug_trace_handler)
         app.router.add_get("/status", status_handler)
         for route in self.serving_routes:
             app.router.add_post(route, self._dispatch)

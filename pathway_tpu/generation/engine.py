@@ -380,6 +380,100 @@ def _bump(name: str, n: int = 1) -> None:
         _COUNTERS[name] += n
 
 
+# -- per-launch decode telemetry (ISSUE 19) ---------------------------------
+# Timed launch guards feed these: one histogram pair per launch kind
+# (prefill / decode_step / verify) — the direct input for the MFU hunt
+# (ROADMAP item 3: launch wall time × rows ≈ where the chip time goes).
+_LAUNCH_MS_BUCKETS = (
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 1000.0,
+)
+_LAUNCH_ROW_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+_launch_ms: dict[str, Any] = {}
+_launch_rows: dict[str, Any] = {}
+
+
+def _observe_launch(kind: str, duration_ms: float, rows: int) -> None:
+    from ..internals.metrics_names import Histogram
+
+    with _MX:
+        ms = _launch_ms.get(kind)
+        if ms is None:
+            ms = _launch_ms[kind] = Histogram(_LAUNCH_MS_BUCKETS)
+            _launch_rows[kind] = Histogram(_LAUNCH_ROW_BUCKETS)
+        ms.observe(duration_ms)
+        _launch_rows[kind].observe(float(rows))
+
+
+class _RateWindow:
+    """Per-second event buckets → rolling tokens/s and draft-acceptance
+    series for one DecodeSession (the ``/v1/health`` generation block's
+    time series).  NOT internally locked — every caller already holds
+    the session lock."""
+
+    __slots__ = ("window_s", "_cells")
+
+    def __init__(self, window_s: int = 60):
+        self.window_s = int(window_s)
+        #: sec -> [tokens, draft_proposed, draft_accepted]
+        self._cells: deque[tuple[int, list[int]]] = deque()
+
+    def _cell(self, now: float) -> list[int]:
+        sec = int(now)
+        if self._cells and self._cells[-1][0] == sec:
+            cell = self._cells[-1][1]
+        else:
+            cell = [0, 0, 0]
+            self._cells.append((sec, cell))
+        while self._cells and self._cells[0][0] <= sec - self.window_s:
+            self._cells.popleft()
+        return cell
+
+    def note_tokens(self, n: int, now: float | None = None) -> None:
+        self._cell(time.time() if now is None else now)[0] += n
+
+    def note_draft(
+        self, proposed: int, accepted: int, now: float | None = None
+    ) -> None:
+        cell = self._cell(time.time() if now is None else now)
+        cell[1] += proposed
+        cell[2] += accepted
+
+    def snapshot(self, now: float | None = None) -> dict[str, Any]:
+        now = time.time() if now is None else now
+        sec = int(now)
+        # the health thread snapshots while the decode pump appends —
+        # deque iteration during a mutation raises, so retry the copy
+        for _ in range(3):
+            try:
+                cells = [(s, list(c)) for s, c in self._cells]
+                break
+            except RuntimeError:
+                continue
+        else:
+            cells = []
+        live = [(s, c) for s, c in cells if s > sec - self.window_s]
+        tokens = sum(c[0] for _s, c in live)
+        proposed = sum(c[1] for _s, c in live)
+        accepted = sum(c[2] for _s, c in live)
+        span = (
+            min(self.window_s, max(1, sec - live[0][0] + 1)) if live else 1
+        )
+        return {
+            "window_s": self.window_s,
+            "tokens_per_s": tokens / span,
+            "draft_acceptance_rate": accepted / proposed if proposed else 0.0,
+            "series": [
+                {
+                    "t": s,
+                    "tokens": c[0],
+                    "draft_proposed": c[1],
+                    "draft_accepted": c[2],
+                }
+                for s, c in live
+            ],
+        }
+
+
 class _GenerationMetricsProvider:
     """``pathway_decode_*`` series for /status; also the ``generation``
     block on ``/v1/health`` (internals/health.py gates on this module
@@ -435,6 +529,27 @@ class _GenerationMetricsProvider:
             f"pathway_kv_pool_rebuilds_total "
             f"{counters['kv_pool_rebuilds_total']}",
         ]
+        from ..internals.metrics_names import escape_label_value
+
+        with _MX:
+            if _launch_ms:
+                lines.append("# TYPE pathway_decode_launch_ms histogram")
+                for kind, hist in sorted(_launch_ms.items()):
+                    lines.extend(
+                        hist.openmetrics_lines(
+                            "pathway_decode_launch_ms",
+                            f'kind="{escape_label_value(kind)}"',
+                        )
+                    )
+            if _launch_rows:
+                lines.append("# TYPE pathway_decode_batch_rows histogram")
+                for kind, hist in sorted(_launch_rows.items()):
+                    lines.extend(
+                        hist.openmetrics_lines(
+                            "pathway_decode_batch_rows",
+                            f'kind="{escape_label_value(kind)}"',
+                        )
+                    )
         return lines
 
 
@@ -456,6 +571,7 @@ def generation_status() -> dict[str, Any]:
     block_size = None
     recovering = False
     breakers: dict[str, str] = {}
+    throughput: dict[str, Any] = {}
     for s in sessions:
         st = s.stats()
         live += st["live_sequences"]
@@ -467,6 +583,12 @@ def generation_status() -> dict[str, Any]:
         recovering = recovering or bool(st.get("recovering"))
         if st.get("breaker") is not None:
             breakers[s.name] = st["breaker"]
+        if st.get("rates") is not None:
+            throughput[s.name] = st["rates"]
+    if throughput:
+        # rolling per-session tokens/s + draft-acceptance time series —
+        # the /v1/health generation block's MFU-hunt input (ROADMAP 3)
+        status["throughput"] = throughput
     # the faults sub-block rides the health "generation" block so the
     # fleet router's health poller sees a replica mid-recovery (and an
     # open generation breaker) without a dedicated probe
@@ -509,11 +631,11 @@ class _Seq:
         "length", "next_input", "generated", "count", "handle",
         "deadline_at", "retain", "forced", "submitted_at",
         "all_tokens", "chain", "registered_upto", "cow_spare",
-        "replayed",
+        "replayed", "trace_link",
     )
 
     def __init__(self, ids, max_new, eos_id, temperature, seed,
-                 deadline_at, retain):
+                 deadline_at, retain, trace_link=None):
         self.ids = list(ids)
         self.max_new = int(max_new)
         self.eos_id = eos_id
@@ -539,6 +661,9 @@ class _Seq:
         #: times this sequence was resurrected by replay re-prefill
         #: after a fatal pool quarantine
         self.replayed = 0
+        #: (trace_id, parent_span_id) of the request that submitted this
+        #: sequence — the launch spans it rides link back to it
+        self.trace_link = trace_link
 
 
 class GenerationHandle:
@@ -676,6 +801,9 @@ class DecodeSession:
         self._pump: threading.Thread | None = None
         self._group = None
         self.ticks_total = 0
+        #: rolling tokens/s + draft-acceptance window (mutated under
+        #: self._lock; snapshotted by stats())
+        self._rates = _RateWindow()
         #: per-launch transient retry budget (PR 6 containment contract
         #: extended to the generation plane)
         self.fault_retries = _env_int("PATHWAY_DECODE_FAULT_RETRIES", 1, lo=0)
@@ -719,6 +847,7 @@ class DecodeSession:
         deadline_s: float | None = None,
         stream_cb: Callable[[int], None] | None = None,
         retain: bool = False,
+        trace_link: tuple[str, str] | None = None,
     ) -> GenerationHandle:
         """Queue one sequence; admission happens at the next tick once
         the free list covers its worst case.  Raises
@@ -788,6 +917,7 @@ class DecodeSession:
             None if deadline_s is None
             else time.monotonic() + float(deadline_s),
             retain,
+            trace_link,
         )
         seq.chain = PrefixIndex.root_key(self.params)
         handle = GenerationHandle(self)
@@ -857,6 +987,7 @@ class DecodeSession:
                 self._record_span(
                     "kv:alloc", t0,
                     {"blocks": need, "ok": more is not None},
+                    seqs=(seq,),
                 )
                 if more is None:
                     self._retained[id(handle)] = seq
@@ -923,13 +1054,36 @@ class DecodeSession:
             self._work.notify_all()
 
     # -- tick engine -----------------------------------------------------
-    def _record_span(self, name: str, t0: float, attrs: dict) -> None:
-        from ..internals.flight_recorder import record_span
+    def _record_span(
+        self,
+        name: str,
+        t0: float,
+        attrs: dict,
+        seqs: "Sequence[_Seq]" = (),
+        launch_kind: str | None = None,
+    ) -> None:
+        from ..internals.flight_recorder import new_span_id, record_span
 
-        record_span(
-            name, "generate", time.time(),
-            (time.monotonic() - t0) * 1000.0, attrs=attrs,
-        )
+        dur_ms = (time.monotonic() - t0) * 1000.0
+        if launch_kind is not None:
+            _observe_launch(launch_kind, dur_ms, int(attrs.get("rows", 1)))
+        # sequences carry the (trace_id, span_id) of the request that
+        # submitted them: a launch serving traced sequences is recorded
+        # once per distinct triggering trace so the stitched fleet tree
+        # reaches all the way down to the device launches
+        links: list[tuple[str, str]] = []
+        for seq in seqs:
+            if seq.trace_link is not None and seq.trace_link not in links:
+                links.append(seq.trace_link)
+        if links:
+            for tid, parent in links:
+                record_span(
+                    name, "generate", time.time(), dur_ms,
+                    trace_id=tid, span_id=new_span_id(), parent_id=parent,
+                    attrs=attrs,
+                )
+        else:
+            record_span(name, "generate", time.time(), dur_ms, attrs=attrs)
 
     def _has_work_locked(self) -> bool:
         return bool(self._pending) or bool(self._live)
@@ -1022,6 +1176,7 @@ class DecodeSession:
                 "kv:alloc", t0,
                 {"blocks": fresh_need, "matched": len(full),
                  "ok": fresh is not None},
+                seqs=(seq,),
             )
             if fresh is None:
                 # roll the shares back; pool full — stays queued until
@@ -1064,6 +1219,7 @@ class DecodeSession:
                 "kv:prefix_match", t0,
                 {"blocks": hit_blocks, "tokens": matched_len,
                  "partial": partial is not None},
+                seqs=(seq,),
             )
             self._live.append(seq)
             matched_any = True
@@ -1438,6 +1594,7 @@ class DecodeSession:
         self._record_span(
             "prefill", t0,
             {"rows": len(batch), "tokens": t_real, "bucket": T},
+            seqs=batch, launch_kind="prefill",
         )
         _bump("prefill_tokens_total", t_real)
         for j, seq in enumerate(batch):
@@ -1461,6 +1618,7 @@ class DecodeSession:
         seq.all_tokens.append(tok)
         seq.next_input = tok
         _bump("tokens_generated_total")
+        self._rates.note_tokens(1)
         seq.handle._on_token(tok)
         if len(seq.generated) >= seq.max_new or (
             seq.eos_id is not None and tok == seq.eos_id
@@ -1549,6 +1707,7 @@ class DecodeSession:
                         inputs.extend(draft)
                         n_draft = len(draft)
                         _bump("draft_proposed_total", n_draft)
+                        self._rates.note_draft(n_draft, 0)
             plans.append((seq, inputs, n_forced, n_draft))
             k_max = max(k_max, len(inputs))
         if k_max <= 1:
@@ -1604,7 +1763,8 @@ class DecodeSession:
         self.pool.k_pool, self.pool.v_pool = k_pool, v_pool
         out = np.asarray(toks_next)  # host read = device sync (handler contract)
         self._record_span(
-            "decode:step", t0, {"rows": len(plans), "bucket": R}
+            "decode:step", t0, {"rows": len(plans), "bucket": R},
+            seqs=[p[0] for p in plans], launch_kind="decode_step",
         )
         for r, (seq, _inputs, _nf, _nd) in enumerate(plans):
             if not active[r]:
@@ -1672,6 +1832,7 @@ class DecodeSession:
         self._record_span(
             "decode:verify", t0,
             {"rows": len(plans), "bucket": R, "k": K},
+            seqs=[p[0] for p in plans], launch_kind="verify",
         )
         for r, (seq, inputs, nf, nd) in enumerate(plans):
             if not active[r]:
@@ -1690,6 +1851,7 @@ class DecodeSession:
                     break  # draft diverged: later lanes are rolled back
             if accepted:
                 _bump("draft_accepted_total", accepted)
+                self._rates.note_draft(0, accepted)
             if seq.blocks:
                 self._register_progress_locked(seq)
         return True
@@ -1814,6 +1976,7 @@ class DecodeSession:
                 1 for s in list(self._live) + list(self._retained.values())
                 if s.replayed
             ),
+            "rates": self._rates.snapshot(),
         }
 
 

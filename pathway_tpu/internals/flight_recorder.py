@@ -65,6 +65,7 @@ __all__ = [
     "trace_stage",
     "batch_traces",
     "batch_stage",
+    "current_trace_link",
     "new_trace_id",
     "new_span_id",
     "parse_traceparent",
@@ -597,6 +598,27 @@ def batch_traces(traces: list[RequestTrace]) -> Iterator[None]:
         yield
     finally:
         _tls.traces = prev
+
+
+def current_trace_link() -> tuple[str, str] | None:
+    """``(trace_id, span_id)`` of the request whose work is executing on
+    this thread, or None outside any trace scope.
+
+    Deferred runtime work (query-cache refresh, tier migration) is
+    SUBMITTED from inside a request's batch scope but EXECUTES on a later
+    tick, after the scope is gone — the submitter captures this link at
+    submit time and threads it through the WorkItem so the deferred
+    tick's spans carry ``parent_id`` = the triggering request's span
+    instead of starting trace-orphaned.  First sampled trace wins: a
+    multi-request batch that triggers one refresh attributes it to one
+    requester, which beats attributing it to nobody."""
+    traces = getattr(_tls, "traces", None)
+    if not traces:
+        return None
+    for tr in traces:
+        if tr.sampled:
+            return tr.trace_id, tr.span_id
+    return None
 
 
 @contextlib.contextmanager

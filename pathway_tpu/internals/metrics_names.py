@@ -332,6 +332,41 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "autoscale actions taken by the burn-verdict controller (spawn/drain)",
     ),
+    # -- per-launch decode telemetry (generation/engine.py launch guards) —
+    # every series carries a kind label (prefill / decode_step / verify)
+    "pathway_decode_launch_ms": (
+        "histogram",
+        "device-launch wall time per guarded generation launch (kind=)",
+    ),
+    "pathway_decode_batch_rows": (
+        "histogram",
+        "sequences riding each guarded generation launch (kind=)",
+    ),
+    # -- telemetry federation (observability/federation.py via the fleet
+    # router's /status) — replica-labeled re-exposition plus aggregates
+    "pathway_fleet_aggregate_total": (
+        "counter",
+        "fleet-wide sum of a counter family across live replicas "
+        "(family= names the source family; restart-safe, never decreases)",
+    ),
+    "pathway_fleet_scrapes_total": (
+        "counter",
+        "replica /status scrapes completed by the federation plane",
+    ),
+    "pathway_fleet_scrape_errors_total": (
+        "counter",
+        "replica /status scrapes that failed (replica unreachable or "
+        "exposition unparsable)",
+    ),
+    "pathway_fleet_slo_burn_rate": (
+        "gauge",
+        "fleet-level error-budget burn rate per endpoint/window, computed "
+        "from the federated per-endpoint latency histograms",
+    ),
+    "pathway_fleet_slo_verdict": (
+        "gauge",
+        "fleet-level burn verdict per endpoint (0=ok 1=warn 2=burning)",
+    ),
 }
 
 

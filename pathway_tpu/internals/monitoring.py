@@ -33,6 +33,7 @@ __all__ = [
     "MonitoringLevel",
     "register_metrics_provider",
     "register_metrics_provider_once",
+    "exposition",
     "FreshnessTracker",
     "get_freshness",
 ]
@@ -84,6 +85,29 @@ def register_metrics_provider_once(name: str, factory: Any) -> Any:
             provider = _strong_providers[name] = factory()
             register_metrics_provider(name, provider)
         return provider
+
+
+#: process-wide monitor backing :func:`exposition` — serving processes
+#: that never built an engine-owned StatsMonitor (fleet replicas behind a
+#: PathwayWebserver) still need a /status exposition surface for the
+#: router's federation scrape.
+_exposition_monitor: "StatsMonitor | None" = None
+_exposition_monitor_lock = threading.Lock()
+
+
+def exposition() -> str:
+    """Render the process's OpenMetrics exposition.
+
+    Every interesting series (registered providers, freshness, tracing)
+    lives in module-global registries, not on a particular
+    :class:`StatsMonitor` — so a lazily-created module monitor renders
+    the full picture even when no engine run owns one."""
+    global _exposition_monitor
+    with _exposition_monitor_lock:
+        if _exposition_monitor is None:
+            _exposition_monitor = StatsMonitor()
+        monitor = _exposition_monitor
+    return monitor.openmetrics()
 
 
 #: flush-latency histogram bucket upper bounds (milliseconds)

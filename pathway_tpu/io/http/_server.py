@@ -369,8 +369,20 @@ class PathwayWebserver:
                 },
             )
 
+        async def status_handler(_request):
+            """OpenMetrics exposition for this process.  Fleet routers
+            scrape it on the health-poll cadence (telemetry federation);
+            rendering walks every provider under locks, so it runs off
+            the event loop."""
+            from ...internals.monitoring import exposition
+
+            text = await asyncio.to_thread(exposition)
+            return web.Response(text=text, content_type="text/plain")
+
         if not any(route == "/v1/health" for route, _, _ in self._routes):
             app.router.add_get("/v1/health", health_handler)
+        if not any(route == "/status" for route, _, _ in self._routes):
+            app.router.add_get("/status", status_handler)
         if not any(route == "/v1/debug/traces" for route, _, _ in self._routes):
             app.router.add_get("/v1/debug/traces", debug_traces_handler)
         if not any(route == "/v1/debug/profile" for route, _, _ in self._routes):

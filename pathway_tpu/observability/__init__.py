@@ -27,6 +27,13 @@ health probes never import jax):
   profiling (``GET/POST /v1/debug/profile?ms=``): a bounded-spool
   ``jax.profiler`` trace window on TPU, a pure flight-recorder Perfetto
   export everywhere else; single-flight, capped duration.
+* :mod:`~pathway_tpu.observability.federation` — fleet-wide telemetry:
+  the router scrapes every replica's ``/status``, re-exposes each
+  ``pathway_*`` family with a ``replica=`` label plus restart-safe
+  fleet aggregates, computes fleet-level SLO burn verdicts from the
+  federated latency histograms, and stitches one cross-process trace
+  tree (router dispatch → replica request → device launch) on
+  ``GET /v1/debug/trace?trace_id=``.
 
 Import discipline: every module here is stdlib-only at import time
 (plus the :mod:`internals.metrics_names` leaf) — jax is touched only
@@ -36,4 +43,4 @@ initialize a device runtime.
 
 from __future__ import annotations
 
-__all__ = ["hbm_ledger", "slo", "profiler"]
+__all__ = ["hbm_ledger", "slo", "profiler", "federation"]

@@ -57,6 +57,11 @@ __all__ = [
     "slo_metrics_lines",
     "endpoint_env_key",
     "reset_slo",
+    "burn_settings",
+    "burn_verdict",
+    "worse_verdict",
+    "latency_target_ms",
+    "LATENCY_BUDGET",
 ]
 
 #: latency histogram bucket upper bounds (ms) — wider than the stage
@@ -293,6 +298,43 @@ def _verdict(fast: float, slow: float, cfg: dict[str, float]) -> str:
     ) >= cfg["burn_hot"]:
         return "warn"
     return "ok"
+
+
+# -- public burn math (the federation plane reuses the SAME semantics) ------
+# One verdict implementation for the whole system: a fleet-level burn
+# computed by the router (observability/federation.py) must agree with a
+# replica's own verdict on identical inputs, or operators see the router
+# and the replica disagree about the same incident.
+
+#: fixed latency-objective budget (p99 target ⇒ 1% of requests may exceed)
+LATENCY_BUDGET = _LATENCY_BUDGET
+
+
+def burn_settings() -> dict[str, float]:
+    """The live window/threshold knobs (PATHWAY_SLO_* env)."""
+    return _settings()
+
+
+def burn_verdict(
+    fast: float, slow: float, cfg: dict[str, float] | None = None
+) -> str:
+    """Multi-window verdict from two burn rates (``ok``/``warn``/
+    ``burning``) — exactly the per-replica rule."""
+    return _verdict(fast, slow, cfg if cfg is not None else _settings())
+
+
+def worse_verdict(a: str, b: str) -> str:
+    """The more severe of two verdicts."""
+    return _worse(a, b)
+
+
+def latency_target_ms(path: str) -> float:
+    """The configured p99 target for an endpoint path (0.0 = no target),
+    read from ``PATHWAY_SLO_<ENDPOINT>_P99_MS`` exactly as a replica
+    series would read it."""
+    return _env_float(
+        f"PATHWAY_SLO_{endpoint_env_key(path)}_P99_MS", 0.0
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -229,7 +229,7 @@ class WorkItem:
     __slots__ = (
         "group", "payload", "qos", "tokens", "future",
         "enqueued_at", "deadline_at", "coalesce_s", "trace", "observer",
-        "retry_after_s",
+        "retry_after_s", "trace_link",
     )
 
     def __init__(
@@ -245,6 +245,7 @@ class WorkItem:
         trace=None,
         observer=None,
         retry_after_s: float | None = None,
+        trace_link: tuple[str, str] | None = None,
     ):
         self.group = group
         self.payload = payload
@@ -265,6 +266,11 @@ class WorkItem:
         #: per-item Retry-After override (the submitting plane's hint);
         #: None uses the runtime default
         self.retry_after_s = retry_after_s
+        #: ``(trace_id, parent_span_id)`` of the request that CAUSED this
+        #: item — deferred work executes after the request's batch scope
+        #: is gone, so the link captured at submit time is the only way
+        #: its tick spans stay attributable to the trigger
+        self.trace_link = trace_link
 
 
 #: wait-time histogram bucket upper bounds (milliseconds)
@@ -394,6 +400,7 @@ class DeviceTickRuntime:
         observer: Any = None,
         retry_after_s: float | None = None,
         defer: bool = False,
+        trace_link: tuple[str, str] | None = None,
     ) -> Future:
         """Enqueue one payload under a QoS class; the future resolves
         when its batch ran.
@@ -430,6 +437,14 @@ class DeviceTickRuntime:
             sheddable = deadline_s is not None
         if trace is not None and not trace.sampled:
             trace = None
+        if defer and trace_link is None:
+            # deferred work submitted from inside a request's batch scope
+            # (query-cache refresh, tier migration) would otherwise start
+            # trace-orphaned — capture the triggering request's span now,
+            # while the scope still exists
+            from ..internals.flight_recorder import current_trace_link
+
+            trace_link = current_trace_link()
         if tokens is None:
             estimate = getattr(group, "token_estimate", None)
             tokens = (estimate or estimate_tokens)(payload)
@@ -449,6 +464,7 @@ class DeviceTickRuntime:
             item = WorkItem(
                 group, payload, tick_qos, tokens, fut,
                 time.monotonic(), None, 0.0, trace, observer, retry_after_s,
+                trace_link,
             )
             self._execute(group, [item], tick_qos, inline=True)
             return fut
@@ -465,6 +481,7 @@ class DeviceTickRuntime:
             trace,
             observer,
             retry_after_s,
+            trace_link,
         )
         refused = False
         with self._cv:
@@ -779,13 +796,37 @@ class DeviceTickRuntime:
             }
             if inline:
                 attrs["inline"] = True
-            record_span(
-                f"tick:{group.label}",
-                "scheduler",
-                tick_wall,
-                (time.monotonic() - tick_t0) * 1000.0,
-                attrs=attrs,
-            )
+            dur_ms = (time.monotonic() - tick_t0) * 1000.0
+            # deferred items carry the (trace_id, span_id) of the request
+            # that caused them: record the tick span once per distinct
+            # triggering trace so the stitched tree shows the background
+            # work under its requester, and once unlinked otherwise
+            links: list[tuple[str, str]] = []
+            for it in chunk:
+                if it.trace_link is not None and it.trace_link not in links:
+                    links.append(it.trace_link)
+            if links:
+                from ..internals.flight_recorder import new_span_id
+
+                for tid, parent in links:
+                    record_span(
+                        f"tick:{group.label}",
+                        "scheduler",
+                        tick_wall,
+                        dur_ms,
+                        trace_id=tid,
+                        span_id=new_span_id(),
+                        parent_id=parent,
+                        attrs={**attrs, "deferred": True},
+                    )
+            else:
+                record_span(
+                    f"tick:{group.label}",
+                    "scheduler",
+                    tick_wall,
+                    dur_ms,
+                    attrs=attrs,
+                )
         with self._mx:
             self._class_counters[qos]["completed_total"] += len(chunk)
         if obs is not None:

@@ -203,6 +203,9 @@ class TieredKnnIndex:
         self.rebuilds = 0
         self.tier_label = f"tiered{next(_tier_label_seq)}"
         self._migrate_group = None  # built lazily (runtime import)
+        #: (trace_id, span_id) of the search that scheduled the pending
+        #: migration — the migrate span links back to it
+        self._migrate_trace_link: tuple[str, str] | None = None
         _LIVE_TIERED.add(self)
         _ensure_tier_provider()
         # unified HBM ledger: the hot tier registers itself through the
@@ -503,6 +506,7 @@ class TieredKnnIndex:
         with self._lock:
             self._migration_pending = False
             self._hits_dirty = 0
+            trace_link, self._migrate_trace_link = self._migrate_trace_link, None
             promos, demos = plan if plan is not None else self._plan_locked(limit)
             n_promoted = n_demoted = 0
             for key in demos:
@@ -534,8 +538,17 @@ class TieredKnnIndex:
                 self._placement_dirty = True
                 self._placement_rev += 1
         try:
-            from ..internals.flight_recorder import record_span
+            from ..internals.flight_recorder import new_span_id, record_span
 
+            lineage = {}
+            if trace_link is not None:
+                # link the background migration to the search that
+                # triggered it — it shows up in that request's trace
+                lineage = {
+                    "trace_id": trace_link[0],
+                    "span_id": new_span_id(),
+                    "parent_id": trace_link[1],
+                }
             record_span(
                 f"tier:migrate:{self.tier_label}", "runtime", wall,
                 (time.monotonic() - t0) * 1000.0,
@@ -544,6 +557,7 @@ class TieredKnnIndex:
                     "demoted": n_demoted,
                     "hot_rows": len(self._hot_keys),
                 },
+                **lineage,
             )
         except Exception:  # noqa: BLE001 — observability must never raise
             pass
@@ -578,6 +592,9 @@ class TieredKnnIndex:
                     lambda payloads: [self.migrate() for _ in payloads],
                     max_batch=1,
                 )
+            from ..internals.flight_recorder import current_trace_link
+
+            self._migrate_trace_link = current_trace_link()
             # defer=True: a search executing INSIDE a runtime tick must
             # enqueue the migration for a LATER BULK_INGEST tick, never
             # run it inline on the interactive tick's latency budget

@@ -395,7 +395,7 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
     def _stream_rounds(
         self, lm, question: str, docs: list[str], *,
         max_new_tokens: int, temperature: float, seed: int,
-        deadline_s: float | None,
+        deadline_s: float | None, trace_link=None,
     ):
         """Yield ``("token", round, piece)`` events then one
         ``("final", round, answer)``.  Base: a single round over the
@@ -409,7 +409,7 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
         handle = session.submit(
             lm.encode_prompt(prompt), max_new_tokens=max_new_tokens,
             temperature=temperature, seed=seed, eos_id=eos,
-            deadline_s=deadline_s,
+            deadline_s=deadline_s, trace_link=trace_link,
         )
         try:
             from ...generation.engine import iter_text_pieces
@@ -538,10 +538,19 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
 
             wall0 = _time_mod.time()
             t0 = _time_mod.monotonic()
+            # thread the request's trace through to the decode launches:
+            # the spans the device emits for this stream link back to it
+            pw_trace = request.get("pw_trace")
+            trace_link = (
+                (pw_trace.trace_id, pw_trace.span_id)
+                if pw_trace is not None and pw_trace.sampled
+                else None
+            )
             rounds_it = iter(
                 self._stream_rounds(
                     lm, prompt, docs, max_new_tokens=max_new,
                     temperature=temperature, seed=seed, deadline_s=deadline_s,
+                    trace_link=trace_link,
                 )
             )
 
@@ -909,7 +918,7 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
     def _stream_rounds(
         self, lm, question: str, docs: list[str], *,
         max_new_tokens: int, temperature: float, seed: int,
-        deadline_s: float | None,
+        deadline_s: float | None, trace_link=None,
     ):
         """Geometric escalation over LIVE KV blocks: round 1 prefills
         the n_starting-docs prompt with ``retain=True``; an unanswered
@@ -935,6 +944,7 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
                         max_new_tokens=max_new_tokens,
                         temperature=temperature, seed=seed, eos_id=eos,
                         deadline_s=deadline_s, retain=True,
+                        trace_link=trace_link,
                     )
                 else:
                     extra = docs[consumed:n]

@@ -1,0 +1,691 @@
+"""Fleet-wide distributed tracing & telemetry federation (ISSUE 19).
+
+Pure-logic pins on :mod:`pathway_tpu.observability.federation` (stitch
+trees, exposition parsing, restart-safe aggregates, fleet SLO burn),
+the trace-schema lint (every launch-guard span kind appears in the
+renderer's known-kinds table, BOTH directions — the fault-site registry
+idiom), the deferred-runtime trace-link bugfix, and the cross-process
+integration pins: a failed-over request yields ONE stitched trace tree
+via the router's ``/v1/debug/trace``, and the router federates real
+replica ``/status`` expositions with ``replica=`` labels.
+"""
+
+import json
+import os
+import re
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from pathway_tpu.observability import federation as fed  # noqa: E402
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _span(name, span_id, parent_id=None, trace_id="t" * 32, start_s=1.0,
+          **attrs):
+    d = {
+        "name": name, "category": "fleet", "start_s": start_s,
+        "duration_ms": 1.0, "trace_id": trace_id, "span_id": span_id,
+    }
+    if parent_id is not None:
+        d["parent_id"] = parent_id
+    if attrs:
+        d["attrs"] = attrs
+    return d
+
+
+# ---------------------------------------------------------------------------
+# stitch_trace: tree shape, incomplete, orphans, dedup
+# ---------------------------------------------------------------------------
+
+
+def test_stitch_builds_parent_linked_tree_with_sibling_attempts():
+    tid = "a" * 32
+    router_spans = [
+        _span("fleet:dispatch", "d" * 16, trace_id=tid, start_s=1.0),
+        _span("fleet:attempt", "1" * 16, "d" * 16, tid, 1.1,
+              outcome="error"),
+        _span("fleet:attempt", "2" * 16, "d" * 16, tid, 1.2, outcome="ok"),
+    ]
+    replica = {"spans": [
+        _span("POST /v1/retrieve", "3" * 16, "d" * 16, tid, 1.25),
+        _span("prefill", "4" * 16, "3" * 16, tid, 1.3),
+    ]}
+    out = fed.stitch_trace(tid, router_spans, {"r1": replica})
+    assert out["trace_id"] == tid
+    assert not out["incomplete"]
+    assert out["replicas"] == {"r1": "ok"}
+    assert out["span_count"] == 5
+    assert len(out["tree"]) == 1
+    root = out["tree"][0]
+    assert root["name"] == "fleet:dispatch"
+    kids = [k["name"] for k in root["children"]]
+    # failed attempt, winning attempt, and the replica request span are
+    # SIBLINGS under the one dispatch span
+    assert kids == ["fleet:attempt", "fleet:attempt", "POST /v1/retrieve"]
+    req = root["children"][2]
+    assert [k["name"] for k in req["children"]] == ["prefill"]
+    # known-kind annotation from the renderer schema
+    assert req["children"][0]["kind_info"]["plane"] == "generate"
+    assert root["kind_info"]["plane"] == "fleet"
+
+
+def test_stitch_unreachable_replica_marks_incomplete_not_dropped():
+    tid = "b" * 32
+    out = fed.stitch_trace(
+        tid,
+        [_span("fleet:dispatch", "d" * 16, trace_id=tid)],
+        {"up": {"spans": []}, "down": None},
+    )
+    assert out["incomplete"] is True
+    assert out["replicas"] == {"down": "unreachable", "up": "ok"}
+    assert out["span_count"] == 1  # partial evidence survives
+
+
+def test_stitch_orphan_span_becomes_marked_root():
+    tid = "c" * 32
+    out = fed.stitch_trace(
+        tid, [],
+        {"r": {"spans": [_span("decode:step", "5" * 16, "f" * 16, tid)]}},
+    )
+    assert len(out["tree"]) == 1
+    assert out["tree"][0]["orphan"] is True  # parent missing, not hidden
+
+
+def test_stitch_dedups_spans_seen_by_router_and_replica():
+    tid = "d" * 32
+    sp = _span("fleet:dispatch", "d" * 16, trace_id=tid)
+    out = fed.stitch_trace(tid, [sp], {"r": {"spans": [dict(sp)]}})
+    assert out["span_count"] == 1
+    assert out["spans"][0]["replica"] == "router"  # first writer wins
+
+
+def test_render_tree_and_perfetto_export():
+    tid = "e" * 32
+    out = fed.stitch_trace(
+        tid,
+        [_span("fleet:dispatch", "d" * 16, trace_id=tid)],
+        {"r": {"spans": [_span("prefill", "6" * 16, "d" * 16, tid)]},
+         "ghost": None},
+    )
+    text = fed.render_tree(out)
+    assert "(incomplete)" in text
+    assert "fleet:dispatch" in text and "prefill" in text
+    assert "@router" in text and "@r" in text
+    perf = fed.stitched_perfetto(out)
+    events = [e for e in perf["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in events} >= {"fleet:dispatch", "prefill"}
+    assert all(e["args"]["trace_id"] == tid for e in events)
+
+
+# ---------------------------------------------------------------------------
+# exposition parsing
+# ---------------------------------------------------------------------------
+
+_EXPO = """\
+# TYPE pathway_uptime_seconds gauge
+pathway_uptime_seconds 12.5
+# TYPE pathway_connector_messages_total counter
+pathway_connector_messages_total{connector="serve"} 42
+# TYPE pathway_endpoint_latency_ms histogram
+pathway_endpoint_latency_ms_bucket{endpoint="/v1/retrieve",le="5"} 10 # {trace_id="abc"} 4.2 1700000000.0
+pathway_endpoint_latency_ms_bucket{endpoint="/v1/retrieve",le="+Inf"} 12
+pathway_endpoint_latency_ms_sum{endpoint="/v1/retrieve"} 60.0
+pathway_endpoint_latency_ms_count{endpoint="/v1/retrieve"} 12
+not_ours_total 7
+# EOF
+"""
+
+
+def test_parse_exposition_resolves_types_and_strips_exemplars():
+    fams = fed.parse_exposition(_EXPO)
+    assert fams["pathway_uptime_seconds"]["type"] == "gauge"
+    assert fams["pathway_connector_messages_total"]["type"] == "counter"
+    hist = fams["pathway_endpoint_latency_ms"]
+    assert hist["type"] == "histogram"
+    names = {s[0] for s in hist["samples"]}
+    assert names == {
+        "pathway_endpoint_latency_ms_bucket",
+        "pathway_endpoint_latency_ms_sum",
+        "pathway_endpoint_latency_ms_count",
+    }
+    # the exemplar suffix was stripped, not parsed into the value
+    bucket5 = next(
+        s for s in hist["samples"]
+        if s[0].endswith("_bucket") and 'le="5"' in s[1]
+    )
+    assert bucket5[2] == 10.0
+    assert "not_ours_total" not in fams  # non-pathway families skipped
+
+
+def test_parse_labels_unescapes():
+    labels = fed.parse_labels(r'a="x\"y",le="+Inf"')
+    assert labels == {"a": 'x"y', "le": "+Inf"}
+
+
+# ---------------------------------------------------------------------------
+# federation restart-safety (satellite test)
+# ---------------------------------------------------------------------------
+
+
+def _counter_expo(value, family="pathway_connector_messages_total"):
+    return (
+        f"# TYPE {family} counter\n{family} {value}\n# EOF\n"
+    )
+
+
+def _aggregate(state, family="pathway_connector_messages_total"):
+    lines = state.openmetrics_lines()
+    for line in lines:
+        if line.startswith("pathway_fleet_aggregate_total") and (
+            f'family="{family}"' in line
+        ):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_epoch_restart_never_produces_negative_aggregate():
+    clock = _FakeClock()
+    st = fed.FederationState(clock=clock, stale_after_s=30.0)
+    st.note_scrape("r0", _counter_expo(100))
+    assert _aggregate(st) == 100.0
+    # replica restarts (router signals the epoch change BEFORE the next
+    # scrape): the restarted process's counter restarts near zero
+    st.reset_replica("r0")
+    st.note_scrape("r0", _counter_expo(5))
+    assert _aggregate(st) == 105.0  # folded, monotonic — never 5, never -95
+    st.note_scrape("r0", _counter_expo(7))
+    assert _aggregate(st) == 107.0
+
+
+def test_inplace_counter_regression_folds_without_epoch_signal():
+    clock = _FakeClock()
+    st = fed.FederationState(clock=clock, stale_after_s=30.0)
+    st.note_scrape("r0", _counter_expo(50))
+    # restart raced the health poll: the scrape sees the regression first
+    st.note_scrape("r0", _counter_expo(3))
+    assert _aggregate(st) == 53.0
+
+
+def test_stale_replica_series_dropped_not_frozen():
+    clock = _FakeClock()
+    st = fed.FederationState(clock=clock, stale_after_s=10.0)
+    st.note_scrape("r0", _counter_expo(5))
+    lines = st.openmetrics_lines()
+    assert any('replica="r0"' in li for li in lines)
+    clock.now += 60.0  # no scrape for a minute: the series must vanish
+    lines = st.openmetrics_lines()
+    assert not any('replica="r0"' in li for li in lines)
+    assert _aggregate(st) == 5.0  # ...but the aggregate keeps its history
+
+
+def test_dropped_replica_retires_contribution():
+    clock = _FakeClock()
+    st = fed.FederationState(clock=clock, stale_after_s=30.0)
+    st.note_scrape("r0", _counter_expo(9))
+    st.note_scrape("r1", _counter_expo(4))
+    assert _aggregate(st) == 13.0
+    st.drop_replica("r0")
+    assert not any(
+        'replica="r0"' in li for li in st.openmetrics_lines()
+    )
+    assert _aggregate(st) == 13.0  # monotonic across membership churn
+    st.note_scrape("r1", _counter_expo(6))
+    assert _aggregate(st) == 15.0
+
+
+def test_scrape_error_counted():
+    st = fed.FederationState(clock=_FakeClock())
+    st.note_scrape_error("r0")
+    lines = st.openmetrics_lines()
+    assert "pathway_fleet_scrape_errors_total 1" in lines
+
+
+# ---------------------------------------------------------------------------
+# fleet SLO burn from federated histograms
+# ---------------------------------------------------------------------------
+
+
+def _latency_expo(count, good):
+    return f"""\
+# TYPE pathway_endpoint_latency_ms histogram
+pathway_endpoint_latency_ms_bucket{{endpoint="/v1/retrieve",le="50.0"}} {good}
+pathway_endpoint_latency_ms_bucket{{endpoint="/v1/retrieve",le="+Inf"}} {count}
+pathway_endpoint_latency_ms_count{{endpoint="/v1/retrieve"}} {count}
+# EOF
+"""
+
+
+def test_fleet_slo_burn_verdict_from_federated_histograms(monkeypatch):
+    monkeypatch.setenv("PATHWAY_SLO_RETRIEVE_P99_MS", "50")
+    clock = _FakeClock()
+    st = fed.FederationState(clock=clock, stale_after_s=300.0)
+    st.note_scrape("r0", _latency_expo(100, 100))  # baseline only
+    clock.now += 1.0
+    # next delta: 100 new requests, 60 over target — way past any budget
+    st.note_scrape("r0", _latency_expo(200, 140))
+    out = st.verdicts()
+    obj = out["endpoints"]["/v1/retrieve"]
+    assert obj["p99_ms"] == 50.0
+    assert obj["burn_fast"] > 14.4 and obj["burn_slow"] > 14.4
+    assert obj["verdict"] == "burning" and out["verdict"] == "burning"
+    lines = st.openmetrics_lines()
+    assert any(
+        li.startswith("pathway_fleet_slo_burn_rate{") for li in lines
+    )
+    assert any(
+        li.startswith("pathway_fleet_slo_verdict{")
+        and li.endswith(" 2")
+        for li in lines
+    )
+
+
+def test_fleet_slo_restart_rebaselines_instead_of_negative_delta(
+    monkeypatch,
+):
+    monkeypatch.setenv("PATHWAY_SLO_RETRIEVE_P99_MS", "50")
+    clock = _FakeClock()
+    st = fed.FederationState(clock=clock, stale_after_s=300.0)
+    st.note_scrape("r0", _latency_expo(1000, 400))
+    clock.now += 1.0
+    st.reset_replica("r0")  # epoch change
+    st.note_scrape("r0", _latency_expo(10, 10))  # restarted counters
+    clock.now += 1.0
+    st.note_scrape("r0", _latency_expo(20, 20))  # all-good deltas
+    out = st.verdicts()
+    obj = out["endpoints"]["/v1/retrieve"]
+    # the pre-restart 60%-bad history never leaks into the ring as a
+    # bogus giant (or negative) delta
+    assert obj["verdict"] == "ok"
+    assert obj["burn_fast"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# federated families are declared (metrics-names lint, runtime side)
+# ---------------------------------------------------------------------------
+
+
+def test_federated_exposition_families_declared_and_type_led(monkeypatch):
+    from pathway_tpu.internals.metrics_names import declared_metric_names
+
+    monkeypatch.setenv("PATHWAY_SLO_RETRIEVE_P99_MS", "50")
+    clock = _FakeClock()
+    st = fed.FederationState(clock=clock, stale_after_s=300.0)
+    st.note_scrape("r0", _EXPO)
+    clock.now += 1.0
+    st.note_scrape("r0", _latency_expo(200, 100))
+    declared = declared_metric_names()
+    declared_types = set()
+    suffix = re.compile(r"(_bucket|_sum|_count)$")
+    for line in st.openmetrics_lines():
+        if line.startswith("# TYPE "):
+            declared_types.add(line.split()[2])
+            continue
+        name = re.match(r"[a-z_]+", line).group(0)
+        family = suffix.sub("", name)
+        assert family in declared, f"undeclared federated family: {family}"
+        assert family in declared_types, f"sample before TYPE: {line}"
+
+
+def test_new_metric_families_declared():
+    from pathway_tpu.internals.metrics_names import METRICS
+
+    for family, kind in [
+        ("pathway_decode_launch_ms", "histogram"),
+        ("pathway_decode_batch_rows", "histogram"),
+        ("pathway_fleet_aggregate_total", "counter"),
+        ("pathway_fleet_scrapes_total", "counter"),
+        ("pathway_fleet_scrape_errors_total", "counter"),
+        ("pathway_fleet_slo_burn_rate", "gauge"),
+        ("pathway_fleet_slo_verdict", "gauge"),
+    ]:
+        assert METRICS[family][0] == kind
+
+
+# ---------------------------------------------------------------------------
+# trace-schema lint: launch-guard span kinds <-> known-kinds table,
+# both directions (the fault-site registry idiom)
+# ---------------------------------------------------------------------------
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _span_names(path, call_re):
+    src = open(os.path.join(_REPO, path)).read()
+    return set(re.findall(call_re, src))
+
+
+def test_engine_launch_guard_spans_match_known_kinds_table():
+    emitted = _span_names(
+        "pathway_tpu/generation/engine.py",
+        r'self\._record_span\(\s*\n?\s*"([^"]+)"',
+    )
+    table = {
+        name for name, (plane, _) in fed.KNOWN_SPAN_KINDS.items()
+        if plane == "generate"
+    }
+    assert emitted, "no launch-guard span sites found — regex rot?"
+    missing = emitted - table
+    stale = table - emitted
+    assert not missing, (
+        f"launch-guard span kinds missing from KNOWN_SPAN_KINDS: {missing}"
+    )
+    assert not stale, (
+        f"KNOWN_SPAN_KINDS entries with no engine launch guard: {stale}"
+    )
+
+
+def test_router_fleet_spans_match_known_kinds_table():
+    emitted = _span_names(
+        "pathway_tpu/fleet/router.py",
+        r'self\._record_fleet_span\(\s*\n?\s*"([^"]+)"',
+    )
+    table = {
+        name for name, (plane, _) in fed.KNOWN_SPAN_KINDS.items()
+        if plane == "fleet"
+    }
+    assert emitted == table, (
+        f"router span kinds and KNOWN_SPAN_KINDS disagree: "
+        f"emitted={emitted}, table={table}"
+    )
+
+
+def test_every_known_kind_has_description_and_prefixes_resolve():
+    for name, (plane, desc) in fed.KNOWN_SPAN_KINDS.items():
+        assert plane and desc, name
+        assert fed.span_kind_info(name) == (plane, desc)
+    assert fed.span_kind_info("tick:embed") is not None
+    assert fed.span_kind_info("tier:migrate:warm") is not None
+    assert fed.span_kind_info("no-such-kind") is None
+
+
+# ---------------------------------------------------------------------------
+# deferred runtime work inherits the request trace (bugfix pin)
+# ---------------------------------------------------------------------------
+
+
+def test_deferred_submit_carries_request_trace_link(monkeypatch):
+    monkeypatch.delenv("PATHWAY_FLIGHT_RECORDER_CAPACITY", raising=False)
+    monkeypatch.delenv("PATHWAY_TRACE_SAMPLE", raising=False)
+    from pathway_tpu.internals.flight_recorder import (
+        batch_traces,
+        get_recorder,
+        reset_recorder,
+        start_request,
+    )
+    from pathway_tpu.runtime import DeviceTickRuntime, QoS, WorkGroup
+
+    reset_recorder()
+    rt = DeviceTickRuntime(tick_tokens=100, max_wait_ms=1, name="t-tracelink")
+    try:
+        group = WorkGroup("deferred-probe", lambda xs: xs, max_batch=8)
+        trace = start_request("POST /v1/test", None)
+        assert trace.sampled
+        with batch_traces([trace]):
+            fut = rt.submit(
+                group, ("work",), qos=QoS.BULK_INGEST, defer=True,
+                sheddable=False,
+            )
+        fut.result(timeout=30)
+        spans = get_recorder().spans(
+            trace_id=trace.trace_id, mark_read=False
+        )
+        tick = [s for s in spans if s.name == "tick:deferred-probe"]
+        assert tick, "deferred tick span is trace-orphaned"
+        assert tick[0].parent_id == trace.span_id
+        assert tick[0].attrs.get("deferred") is True
+    finally:
+        # the runtime's scheduler thread keeps the instance (and thus
+        # its weakly-registered metrics provider) alive forever — drop
+        # the registration so this test's qos histograms don't merge
+        # into later tests' /status expositions
+        from pathway_tpu.internals.monitoring import _metrics_providers
+
+        _metrics_providers.pop("t-tracelink", None)
+        reset_recorder()
+
+
+# ---------------------------------------------------------------------------
+# HTTP integration: one stitched tree across router + replicas, with a
+# fleet.rpc fault forcing a failover mid-trace; federation over real
+# /status scrapes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def live_fleet(monkeypatch):
+    """Two REAL PathwayWebserver replicas behind a real FleetRouter."""
+    monkeypatch.delenv("PATHWAY_FLIGHT_RECORDER_CAPACITY", raising=False)
+    monkeypatch.delenv("PATHWAY_TRACE_SAMPLE", raising=False)
+    monkeypatch.setenv("PATHWAY_FLEET_FEDERATION", "1")
+    from pathway_tpu.fleet.router import FleetRouter
+    from pathway_tpu.internals.flight_recorder import reset_recorder
+    from pathway_tpu.internals.health import get_health, reset_health
+    from pathway_tpu.io.http import PathwayWebserver
+
+    reset_recorder()
+    reset_health()
+    get_health().set_component("engine", "running", ready=True)
+    get_health().beat("engine")
+
+    async def retrieve(request):
+        from aiohttp import web
+
+        await request.json()
+        return web.json_response([{"text": "ok", "dist": 0.0}])
+
+    servers = []
+    for _ in range(2):
+        ws = PathwayWebserver(host="127.0.0.1", port=_free_port())
+        ws.add_raw_route("/v1/retrieve", ("POST",), retrieve)
+        ws._ensure_started()
+        servers.append(ws)
+    router = FleetRouter(
+        poll_interval_s=0.2, liveness_timeout_s=10.0, attempt_timeout_s=10.0
+    )
+    port = router.start(port=_free_port())
+    for i, ws in enumerate(servers):
+        router.register_replica(f"r{i}", f"http://127.0.0.1:{ws.port}")
+    router.poll_once()  # real health + real /status scrape
+    yield router, port, servers
+    router.stop()
+    reset_health()
+    reset_recorder()
+
+
+def _get_json(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def test_failed_over_request_yields_one_stitched_trace_tree(live_fleet):
+    """THE acceptance pin: inject a fleet.rpc fault so the first proxy
+    attempt drops, let the retry win on the other replica, then fetch
+    ``/v1/debug/trace?trace_id=`` and find ONE tree: the router dispatch
+    span with the failed attempt, the winning attempt, and the winning
+    replica's request span under one trace id."""
+    from pathway_tpu.testing import faults
+
+    router, port, servers = live_fleet
+    rules = {"fleet.rpc": {"drop": 0.5}}
+
+    def _sequence(s):
+        plan = faults._Plan(s, rules)
+        return [plan.decide("fleet.rpc") for _ in range(2)]
+
+    seed = next(s for s in range(500) if _sequence(s) == ["drop", "ok"])
+    trace_id = "ab" * 16
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/retrieve",
+        data=json.dumps({"query": "stitch me"}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "traceparent": f"00-{trace_id}-{'cd' * 8}-01",
+        },
+        method="POST",
+    )
+    with faults.scoped(seed, rules):
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            assert resp.status == 200
+            assert int(resp.headers["x-pathway-fleet-attempts"]) == 2
+            winner = resp.headers["x-pathway-fleet-replica"]
+
+    status, out = _get_json(
+        f"http://127.0.0.1:{port}/v1/debug/trace?trace_id={trace_id}"
+    )
+    assert status == 200
+    assert out["trace_id"] == trace_id
+    assert out["incomplete"] is False
+    assert set(out["replicas"]) == {"r0", "r1"}
+    # every merged span rides the ONE trace id
+    assert all(s.get("trace_id") == trace_id for s in out["spans"])
+    dispatch = [n for n in out["tree"] if n["name"] == "fleet:dispatch"]
+    assert len(dispatch) == 1, f"tree roots: {[n['name'] for n in out['tree']]}"
+    root = dispatch[0]
+    # the client's span id is the dispatch span's remote parent
+    assert root["parent_id"] == "cd" * 8 and root.get("orphan")
+    attempts = [
+        k for k in root["children"] if k["name"] == "fleet:attempt"
+    ]
+    outcomes = sorted(k["attrs"]["outcome"] for k in attempts)
+    assert outcomes == ["error", "ok"], outcomes
+    winning = [
+        k for k in attempts if k["attrs"]["outcome"] == "ok"
+    ][0]
+    assert winning["attrs"]["replica"] == winner
+    # the replica-side request span is a SIBLING of the attempts, under
+    # the same dispatch span (the forwarded traceparent carried its id)
+    requests = [
+        k for k in root["children"] if k["name"] == "POST /v1/retrieve"
+    ]
+    assert requests, (
+        f"replica request span not under dispatch: "
+        f"{[k['name'] for k in root['children']]}"
+    )
+
+
+def test_partial_trace_fetch_yields_incomplete_not_500(live_fleet):
+    router, port, servers = live_fleet
+    # a registered replica whose socket is dead: the stitch must degrade
+    dead = _free_port()
+    router.register_replica("ghost", f"http://127.0.0.1:{dead}")
+    trace_id = "ef" * 16
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/retrieve",
+        data=json.dumps({"query": "partial"}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "traceparent": f"00-{trace_id}-{'ab' * 8}-01",
+        },
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        assert resp.status == 200
+    status, out = _get_json(
+        f"http://127.0.0.1:{port}/v1/debug/trace?trace_id={trace_id}"
+    )
+    assert status == 200  # partial evidence, NOT a 500
+    assert out["incomplete"] is True
+    assert out["replicas"]["ghost"] == "unreachable"
+    assert any(s["name"] == "fleet:dispatch" for s in out["spans"])
+
+
+def test_router_federates_real_replica_status_expositions(live_fleet):
+    router, port, servers = live_fleet
+    router.poll_once()  # second sweep: scrape counters move
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/status", timeout=15
+    ) as resp:
+        text = resp.read().decode()
+    assert text.rstrip().endswith("# EOF")
+    # re-exposed replica series carry the replica label
+    assert 'pathway_uptime_seconds{replica="r0"}' in text
+    assert 'pathway_uptime_seconds{replica="r1"}' in text
+    assert "pathway_fleet_scrapes_total" in text
+    assert "pathway_fleet_aggregate_total{" in text
+    # the router's own families still lead with their TYPE line exactly
+    # once (no duplicate family declarations after federation)
+    type_lines = [
+        li for li in text.splitlines() if li.startswith("# TYPE ")
+    ]
+    assert len(type_lines) == len(set(type_lines))
+    # health carries the fleet_slo block
+    status, snap = _get_json(f"http://127.0.0.1:{port}/v1/health")
+    assert "fleet_slo" in snap
+    assert set(snap["fleet_slo"]["replicas"]) == {"r0", "r1"}
+
+
+def test_federation_kill_switch_disables_scrape_plane(monkeypatch):
+    monkeypatch.setenv("PATHWAY_FLEET_FEDERATION", "0")
+    from pathway_tpu.fleet.router import FleetRouter
+
+    router = FleetRouter(poll_interval_s=60.0)
+    assert router.federation is None
+    # no federated families on the provider lines either
+    assert not any(
+        "pathway_fleet_scrapes_total" in li
+        for li in router.openmetrics_lines()
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-launch decode telemetry: histogram families + rate windows
+# ---------------------------------------------------------------------------
+
+
+def test_launch_histograms_render_per_kind():
+    from pathway_tpu.generation import engine as eng
+
+    eng._observe_launch("prefill", 3.0, 4)
+    eng._observe_launch("decode_step", 0.4, 2)
+    lines = eng._PROVIDER.openmetrics_lines()
+    text = "\n".join(lines)
+    assert "# TYPE pathway_decode_launch_ms histogram" in text
+    assert 'pathway_decode_launch_ms_bucket{kind="prefill"' in text
+    assert 'pathway_decode_launch_ms_count{kind="decode_step"}' in text
+    assert "# TYPE pathway_decode_batch_rows histogram" in text
+    assert 'pathway_decode_batch_rows_bucket{kind="prefill"' in text
+    # TYPE precedes the first sample of each family
+    idx_type = lines.index("# TYPE pathway_decode_launch_ms histogram")
+    first_sample = next(
+        i for i, li in enumerate(lines)
+        if li.startswith("pathway_decode_launch_ms")
+    )
+    assert idx_type < first_sample
+
+
+def test_rate_window_tokens_per_s_and_draft_acceptance():
+    from pathway_tpu.generation.engine import _RateWindow
+
+    rw = _RateWindow(window_s=60)
+    now = 5000.0
+    for i in range(30):
+        rw.note_tokens(1, now=now + i * 0.1)  # 30 tokens over ~3 s
+    rw.note_draft(10, 7, now=now + 3.0)
+    snap = rw.snapshot(now=now + 3.0)
+    assert snap["tokens_per_s"] > 0
+    assert snap["draft_acceptance_rate"] == pytest.approx(0.7)
+    assert snap["series"], "per-second series missing"
+    assert {"t", "tokens", "draft_proposed", "draft_accepted"} <= set(
+        snap["series"][0]
+    )
