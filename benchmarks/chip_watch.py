@@ -403,6 +403,22 @@ def fire_fleet() -> bool:
     )
 
 
+def fire_fused() -> bool:
+    """Fused serving-tick megakernel on the real chip (ISSUE 20):
+    serving_bench.py --fused-ab runs the one-launch Pallas megakernel
+    against the staged separate-launch reference (f32/bf16 wire + int8
+    path) with launch-count accounting — the CPU run banked the
+    dispatch-bound ratio; this rebanks real-HBM numbers where the
+    avoided [Q, N] round trip matters most.  Success requires a
+    platform=="tpu" rag_serving_fused record; it additionally lands in
+    chip_results.jsonl."""
+    return _fire_tpu_jsonl(
+        [os.path.join(HERE, "serving_bench.py"), "4096", "--fused-ab"],
+        900.0,
+        bank_metric="rag_serving_fused",
+    )
+
+
 def fire_profile() -> bool:
     """On-demand device profiling on the real chip (ISSUE 15):
     benchmarks/obs_overhead.py --profile-probe starts a live webserver
@@ -604,6 +620,7 @@ def main() -> int:
         "decode": False,
         "spec": False,
         "fleet": False,
+        "fused": False,
         "profile": False,
     }
     fire = {
@@ -621,6 +638,7 @@ def main() -> int:
         "decode": fire_decode_cb,
         "spec": fire_spec,
         "fleet": fire_fleet,
+        "fused": fire_fused,
         "profile": fire_profile,
     }
     last_bank = None  # monotonic() of the last banked record

@@ -34,6 +34,12 @@ dispatch spans, the OFF side kills them with
 ``PATHWAY_FLEET_FEDERATION=0`` + the tracing switches (metric
 ``fleet_obs_overhead``, same ≤2% p50 acceptance).
 
+``--fused`` isolates the fused serving tick's launch-count accounting
+(ISSUE 20): both sides run the full observability stack and only
+``PATHWAY_LAUNCH_ACCOUNTING`` flips, so the measured delta is the
+per-dispatch counters + serving.tick span alone (metric
+``fused_launch_overhead``, same ≤2% p50 acceptance).
+
 Run: ``JAX_PLATFORMS=cpu python benchmarks/obs_overhead.py [n_docs]``
 """
 
@@ -234,6 +240,16 @@ FLEET_PHASE_ENV = {
     "off": {**PHASE_ENV["off"], "PATHWAY_FLEET_FEDERATION": "0"},
 }
 
+#: --fused A/B: ISOLATES the fused-serving launch-count instrumentation
+#: (per-dispatch counters + the per-tick serving.tick span) — BOTH sides
+#: run the full observability stack, only PATHWAY_LAUNCH_ACCOUNTING
+#: flips, so the measured delta is the accounting itself and must stay
+#: inside the same ≤2% serving-overhead budget
+FUSED_PHASE_ENV = {
+    "on": {**PHASE_ENV["on"], "PATHWAY_LAUNCH_ACCOUNTING": "1"},
+    "off": {**PHASE_ENV["on"], "PATHWAY_LAUNCH_ACCOUNTING": "0"},
+}
+
 
 def profile_probe() -> dict:
     """chip_watch ``profile`` suite body: capture one REAL device-profile
@@ -297,8 +313,14 @@ def main() -> int:
         print(json.dumps(_fleet_phase(n_docs)))
         return 0
     fleet = "--fleet" in args
+    fused = "--fused" in args
     phase_flag = "--fleet-phase" if fleet else "--phase"
-    phase_env = FLEET_PHASE_ENV if fleet else PHASE_ENV
+    if fused:
+        phase_env = FUSED_PHASE_ENV
+    elif fleet:
+        phase_env = FLEET_PHASE_ENV
+    else:
+        phase_env = PHASE_ENV
     reps = int(os.environ.get("OBS_BENCH_REPS", "3"))
     phases: dict[str, list[dict]] = {"on": [], "off": []}
     # interleave reps so slow machine drift hits both phases evenly
@@ -316,8 +338,14 @@ def main() -> int:
         for name, runs in phases.items()
     }
     overhead = med["on"] / med["off"] - 1.0
+    if fused:
+        metric = "fused_launch_overhead"
+    elif fleet:
+        metric = "fleet_obs_overhead"
+    else:
+        metric = "obs_overhead"
     rec = {
-        "metric": "fleet_obs_overhead" if fleet else "obs_overhead",
+        "metric": metric,
         "platform": phases["on"][0]["platform"],
         "n_docs": n_docs,
         "queries": MEASURED_QUERIES,
@@ -331,7 +359,10 @@ def main() -> int:
         "p50_per_rep_off": [r["p50_ms"] for r in phases["off"]],
         "meets_acceptance": overhead <= 0.02,
         "acceptance": (
-            "p50 overhead <= 2% with tracing+SLO+federation fully on "
+            "p50 overhead <= 2% from launch-count accounting alone "
+            "(PATHWAY_LAUNCH_ACCOUNTING on vs off, tracing on both sides)"
+            if fused
+            else "p50 overhead <= 2% with tracing+SLO+federation fully on "
             "(routed through the fleet router)"
             if fleet
             else "p50 overhead <= 2% with tracing+SLO+ledger fully on"

@@ -187,6 +187,13 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "XLA compilations per jit entry point (bucket_q/bucket_k pin: flat under serving)",
     ),
+    # fused serving tick (ops/fused_serving.py) — per-stage device
+    # dispatch counts on the serving search path; the fused megakernel's
+    # ≤2-launches-per-tick pin is readable straight off the stage= split
+    "pathway_serving_launches_total": (
+        "counter",
+        "serving-path device dispatches by stage (fused/prep/score/topk/rescore/wire)",
+    ),
     # ingest plane (internals/flight_recorder.py accumulators fed by
     # models/encoder.py packed dispatch, xpacks/llm/_ingest.py pipeline,
     # stdlib/indexing/lowering.py index adds, models/tokenizer.py cache)

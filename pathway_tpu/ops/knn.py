@@ -34,7 +34,6 @@ from .topk import topk_search
 from .quantized_scoring import (
     dequantize_record,
     is_quant_record,
-    kernel_mode,
     quantize_jnp,
     rescore_cache_rows_default,
     rescore_depth_default,
@@ -1116,17 +1115,23 @@ class DeviceKnnIndex:
         return jnp.asarray(q, dtype=jnp.float32)
 
     def _device_search(self, q: np.ndarray, k: int) -> tuple[jax.Array, jax.Array]:
-        """(scores, slot indices) for normalized queries — subclasses
-        override with the mesh-sharded path.  Large cos/dot indexes take
-        the tiled Pallas kernel (score tiles streamed through VMEM); small
-        ones stay on the plain fused XLA path."""
+        """(scores, slot indices) for PREPPED (normalized + padded)
+        queries — the staged REFERENCE chain: scoring and top-k as
+        separate dispatches with the full ``[Q, N]`` score intermediate
+        materialized between them.  Serving reaches this only under
+        ``PATHWAY_SERVING_KERNEL=reference`` (the A/B baseline the fused
+        path is benched and parity-pinned against); subclasses override
+        with the mesh-sharded formulation."""
+        from .fused_serving import (
+            dense_reference_search,
+            quant_reference_search,
+            record_launch,
+        )
         from .topk import PALLAS_MIN_ROWS, pallas_topk_search
 
         if self.quantized:
-            from .quantized_scoring import quant_search
-
             self.quant_searches += 1
-            return quant_search(
+            return quant_reference_search(
                 self._quant_device_search(q),
                 self.codes,
                 self.scales,
@@ -1136,7 +1141,6 @@ class DeviceKnnIndex:
                 c=self.quant_depth(k),
                 k=min(k, self.capacity),
                 metric=self.metric,
-                mode=kernel_mode(),
                 use_cache=self.rescore_cache_rows > 0,
             )
         if (
@@ -1150,6 +1154,7 @@ class DeviceKnnIndex:
             # numbers in knn_crossover before the quantized A/B caught it)
             and jax.default_backend() == "tpu"
         ):
+            record_launch("topk")
             return pallas_topk_search(
                 jnp.asarray(q, dtype=self.dtype),
                 self.vectors,
@@ -1157,16 +1162,65 @@ class DeviceKnnIndex:
                 min(k, self.capacity),
                 self.metric,
             )
-        return topk_search(
-            jnp.asarray(q, dtype=self.dtype),
+        return dense_reference_search(
+            q,
             self.vectors,
             self.valid,
-            min(k, self.capacity),
-            self.metric,
+            k=min(k, self.capacity),
+            metric=self.metric,
+            qdt="bf16" if self.dtype == jnp.bfloat16 else "f32",
+        )
+
+    def _fused_device_search(
+        self, q, k: int, q_b: int, normalize: bool, mode: str
+    ) -> tuple[jax.Array, jax.Array]:
+        """(scores, slot indices) for RAW queries — the fused serving
+        path (megakernel or single-jit XLA per
+        ``fused_serving.pick_serving_impl``): widen/normalize/pad, score
+        and top-k inside one dispatch, plus at most the rescore-ring
+        pass.  Subclasses override with the mesh-sharded fused path."""
+        from .fused_serving import dense_fused_search, quant_fused_search
+
+        if self.quantized:
+            self.quant_searches += 1
+            # raw queries straight in — the fused jit widens/normalizes
+            # in-register (no eager pre-cast dispatch like the staged
+            # reference's `_quant_device_search`)
+            return quant_fused_search(
+                q if isinstance(q, jax.Array)
+                else jnp.asarray(q, dtype=jnp.float32),
+                self.codes,
+                self.scales,
+                self.valid,
+                self.rescore_vecs,
+                self.cache_map,
+                c=self.quant_depth(k),
+                k=min(k, self.capacity),
+                q_b=q_b,
+                metric=self.metric,
+                normalize=normalize,
+                use_cache=self.rescore_cache_rows > 0,
+                mode=mode,
+            )
+        return dense_fused_search(
+            q if isinstance(q, jax.Array) else jnp.asarray(q),
+            self.vectors,
+            self.valid,
+            k=min(k, self.capacity),
+            q_b=q_b,
+            metric=self.metric,
+            normalize=normalize,
+            qdt="bf16" if self.dtype == jnp.bfloat16 else "f32",
+            mode=mode,
         )
 
     def search(
-        self, queries: Any, k: int, n_valid: int | None = None
+        self,
+        queries: Any,
+        k: int,
+        n_valid: int | None = None,
+        *,
+        pre_normalized: bool = False,
     ) -> list[list[tuple[Hashable, float]]]:
         """Top-k per query as (key, score) lists, higher scores better.
 
@@ -1174,14 +1228,27 @@ class DeviceKnnIndex:
         straight off the encoder (the fused serving tick): device
         queries are normalized and bucket-padded on device — the
         embed→search handoff never round-trips through host memory.
+        By default the whole chain runs as the fused serving path —
+        normalize, scoring and top-k in ONE launch (megakernel on TPU,
+        single-jit XLA elsewhere; ``PATHWAY_SERVING_KERNEL`` selects,
+        ``reference`` restores the staged legacy chain).
         ``n_valid`` caps how many leading rows get host-side result
         assembly (the fused tick's trailing dispatch-pad rows searched
         on device anyway, but building and filtering (key, score) lists
-        for them is pure waste)."""
+        for them is pure waste).  ``pre_normalized`` tells a cos index
+        the caller already L2-normalized the queries (the tiered hot
+        tier does) so they are not normalized twice."""
         with self._lock:
-            return self._search_locked(queries, k, n_valid)
+            return self._search_locked(
+                queries, k, n_valid, pre_normalized=pre_normalized
+            )
 
-    def _search_locked(self, queries, k, n_valid=None):
+    def _search_locked(self, queries, k, n_valid=None, *, pre_normalized=False):
+        from .fused_serving import (
+            record_launch,
+            serving_kernel_mode,
+            serving_tick,
+        )
         from .topk import bucket_k, bucket_q
 
         self._apply_staged()
@@ -1199,18 +1266,23 @@ class DeviceKnnIndex:
             if n_valid is not None:
                 n = min(n, n_valid)
             return [[] for _ in range(n)]
+        # normalize cosine queries exactly ONCE: host queries normalize
+        # on host (below), device queries inside the fused jit / the
+        # reference `_prep_queries` dispatch — never both, and never
+        # again when the caller (tiered hot tier) already did
+        normalize = self.metric == "cos" and not pre_normalized
+        mode = serving_kernel_mode()
         if on_device:
             n_q = queries.shape[0]
             q_b = bucket_q(n_q)
-            q = _prep_queries(
-                queries, q_b=q_b, normalize=(self.metric == "cos")
-            )
+            q = queries
         else:
             q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
-            if self.metric == "cos":
+            if normalize:
                 norms = np.linalg.norm(q, axis=1, keepdims=True)
                 norms[norms == 0] = 1.0
                 q = q / norms
+            normalize = False  # already done, host-side
             n_q = q.shape[0]
             # bucket BOTH dims that vary under serving traffic: the ragged
             # scheduler-tick batch size (pad Q to a power of two, slice
@@ -1224,7 +1296,17 @@ class DeviceKnnIndex:
                     [q, np.zeros((q_b - n_q, q.shape[1]), dtype=q.dtype)]
                 )
         k_req = min(k, self.capacity)
-        scores, idx = self._device_search(q, bucket_k(k_req, self.capacity))
+        k_b = bucket_k(k_req, self.capacity)
+        with serving_tick():
+            if mode == "reference":
+                if on_device:
+                    q = _prep_queries(q, q_b=q_b, normalize=normalize)
+                    record_launch("prep")
+                scores, idx = self._device_search(q, k_b)
+            else:
+                scores, idx = self._fused_device_search(
+                    q, k_b, q_b=q_b, normalize=normalize, mode=mode
+                )
         if n_valid is not None:
             n_q = min(n_q, n_valid)
         scores = np.asarray(scores)[:n_q]

@@ -132,6 +132,48 @@ def _sharded_quant_search_fn(
     return jax.jit(mapped)
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_fused_search_fn(
+    mesh: Mesh, k: int, metric: str, n_local: int, normalize: bool,
+    q_b: int, qdt: str,
+):
+    """Fused sharded serving: query widen/L2-normalize/pad folded into
+    the SAME dispatch as the per-shard search + ICI merge — one launch
+    per tick instead of prep + search.  The body reuses the staged
+    ``_sharded_search_fn`` computation verbatim (traced inline), so the
+    sharded fused-vs-reference parity is by construction."""
+    from ..ops.fused_serving import _DTYPES, _prep_body
+
+    base = _sharded_search_fn(mesh, k, metric, n_local)
+
+    def fused(q, vecs, valid):
+        qn = _prep_body(q, q_b, normalize)
+        return base(qn.astype(_DTYPES[qdt]), vecs, valid)
+
+    return jax.jit(fused)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_fused_quant_fn(
+    mesh: Mesh, c: int, metric: str, n_local: int, mode: str,
+    normalize: bool, q_b: int,
+):
+    """Quantized twin: prep + per-shard int8 scoring + ICI top-c merge
+    in one dispatch, returning the normalized queries alongside the
+    candidates so the rescore-ring pass (the only second launch) never
+    re-normalizes."""
+    from ..ops.fused_serving import _prep_body
+
+    base = _sharded_quant_search_fn(mesh, c, metric, n_local, mode)
+
+    def fused(q, codes, scales, valid):
+        qn = _prep_body(q, q_b, normalize)
+        cand_s, cand_i = base(qn, codes, scales, valid)
+        return cand_s, cand_i, qn
+
+    return jax.jit(fused)
+
+
 #: live sharded indexes, for /status + /v1/health mesh surfacing (weak:
 #: a finished run's indexes drop out with it)
 _LIVE_SHARDED: "weakref.WeakSet[ShardedKnnIndex]" = weakref.WeakSet()
@@ -247,6 +289,8 @@ class ShardedKnnIndex(DeviceKnnIndex):
             self.valid = jax.device_put(self.valid, self._mask_sharding)
 
     def _device_search(self, q, k: int):
+        from ..ops.fused_serving import record_launch
+
         n_local = self.capacity // self.n_shards
         self.sharded_ticks += 1
         if self.quantized:
@@ -258,10 +302,12 @@ class ShardedKnnIndex(DeviceKnnIndex):
             fn = _sharded_quant_search_fn(
                 self.mesh, c, self.metric, n_local, kernel_mode()
             )
+            record_launch("score")
             cand_scores, cand_idx = fn(
                 self._quant_device_search(q), self.codes, self.scales, self.valid
             )
             if self.rescore_cache_rows > 0:
+                record_launch("rescore")
                 return rescore_topk(
                     jnp.asarray(q, dtype=jnp.float32),
                     cand_scores,
@@ -273,7 +319,59 @@ class ShardedKnnIndex(DeviceKnnIndex):
                 )
             return cand_scores[:, :k_eff], cand_idx[:, :k_eff]
         fn = _sharded_search_fn(self.mesh, int(k), self.metric, n_local)
+        record_launch("score")
         return fn(jnp.asarray(q, dtype=self.dtype), self.vectors, self.valid)
+
+    def _fused_device_search(self, q, k: int, q_b: int, normalize: bool, mode: str):
+        """Fused sharded serving tick: ≤2 launches (1 dense, 2 with the
+        int8 rescore-ring pass) — prep rides inside the shard_map jit.
+        The ``mode`` knob's pallas/auto distinction is a per-shard
+        concern handled by the quantized scoring dispatcher; the merge
+        topology is the same either way."""
+        from ..ops.fused_serving import record_launch
+
+        n_local = self.capacity // self.n_shards
+        self.sharded_ticks += 1
+        if self.quantized:
+            from ..ops.quantized_scoring import kernel_mode, rescore_topk
+
+            self.quant_searches += 1
+            k_eff = min(int(k), self.capacity)
+            c = self.quant_depth(k_eff)
+            fn = _sharded_fused_quant_fn(
+                self.mesh, c, self.metric, n_local, kernel_mode(),
+                normalize, q_b,
+            )
+            record_launch("fused")
+            cand_scores, cand_idx, qn = fn(
+                q if isinstance(q, jax.Array)
+                else jnp.asarray(q, dtype=jnp.float32),
+                self.codes,
+                self.scales,
+                self.valid,
+            )
+            if self.rescore_cache_rows > 0:
+                record_launch("rescore")
+                return rescore_topk(
+                    qn,
+                    cand_scores,
+                    cand_idx,
+                    self.rescore_vecs,
+                    self.cache_map,
+                    k=k_eff,
+                    metric=self.metric,
+                )
+            return cand_scores[:, :k_eff], cand_idx[:, :k_eff]
+        fn = _sharded_fused_search_fn(
+            self.mesh, int(k), self.metric, n_local, normalize, q_b,
+            "bf16" if self.dtype == jnp.bfloat16 else "f32",
+        )
+        record_launch("fused")
+        return fn(
+            q if isinstance(q, jax.Array) else jnp.asarray(q),
+            self.vectors,
+            self.valid,
+        )
 
     # -- mesh observability ---------------------------------------------
     def hbm_ledger_entries(self) -> dict[str, int]:

@@ -389,9 +389,15 @@ class TieredKnnIndex:
                 return [[] for _ in range(n_q)]
             q = self._normalize(q)
             k_req = min(int(k), len(self.slot_of_key))
-            # 1. hot tick: the HBM brute-force candidates
+            # 1. hot tick: the HBM brute-force candidates.  The queries
+            # are already L2-normalized above — `pre_normalized` keeps
+            # the fused hot-tier kernel from normalizing a second time
+            # (idempotent, but wasted FLOPs and a bf16 rounding
+            # divergence risk; pinned by the normalize-once parity test)
             hot_res = (
-                self.hot.search(q, k_req) if len(self.hot) else [[] for _ in range(n_q)]
+                self.hot.search(q, k_req, pre_normalized=True)
+                if len(self.hot)
+                else [[] for _ in range(n_q)]
             )
             # 2. routing: device-side centroid scoring picks the cold
             # partitions each query probes

@@ -668,6 +668,19 @@ def _batch_embed_device(embedder, texts: list[str]):
         )
     except ValueError:
         return None  # outside the dispatch buckets — host path handles it
+    import jax.numpy as jnp
+
+    from ...ops.fused_serving import record_launch, serving_wire_dtype
+
+    if serving_wire_dtype() == "bf16" and embs.dtype == jnp.float32:
+        # bf16-on-the-wire (the serving default): half the bytes on the
+        # encoder→search handoff.  The fused search and the query-cache
+        # combine both widen back to f32 in-register before any
+        # normalization or cache fill — bf16→f32 is exact, so scores
+        # and cache hit/miss bit-exactness are unchanged
+        # (PATHWAY_SERVING_WIRE_DTYPE=f32 opts out, see MIGRATION).
+        embs = embs.astype(jnp.bfloat16)
+        record_launch("wire")
     return embs
 
 

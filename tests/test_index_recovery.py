@@ -384,17 +384,18 @@ def test_device_oom_in_serving_tick_degrades_and_rebuilds():
     inner = node.index.index  # DeviceKnnIndex
 
     boom = {"armed": True}
-    orig = type(inner)._device_search
+    # the fused megakernel is the default serving path now — inject there
+    orig = type(inner)._fused_device_search
 
-    def exploding(self, q, k):
+    def exploding(self, q, k, *args, **kwargs):
         if boom["armed"]:
             boom["armed"] = False
             raise _FakeXlaRuntimeError(
                 "RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 bytes"
             )
-        return orig(self, q, k)
+        return orig(self, q, k, *args, **kwargs)
 
-    type(inner)._device_search = exploding
+    type(inner)._fused_device_search = exploding
     try:
         # submit THROUGH the scheduler: the device-step loop must survive
         fut = plane.scheduler.submit(plane.group, ("alpha document", 1, None))
@@ -413,7 +414,7 @@ def test_device_oom_in_serving_tick_degrades_and_rebuilds():
         assert plane.breaker.state == "closed"
         assert plane.scheduler.executor_alive()
     finally:
-        type(inner)._device_search = orig
+        type(inner)._fused_device_search = orig
 
 
 def test_ingest_upsert_device_fault_never_kills_engine_path(chaos_seed):

@@ -600,9 +600,11 @@ def test_start_http_server_thread_closes_previous_server():
 
 
 def test_xla_compile_counter_pins_no_recompile_buckets():
-    """pathway_xla_compile_total{site="knn.topk_search"} is the observable
-    form of the bucket_q/bucket_k guarantee: after warming the buckets,
-    heterogeneous (Q, k) serving traffic adds ZERO compilations."""
+    """pathway_xla_compile_total{site="serving.fused_topk"} is the
+    observable form of the bucket_q/bucket_k guarantee: after warming
+    the buckets, heterogeneous (Q, k) serving traffic adds ZERO
+    compilations.  The fused serving jit folds query prep into the same
+    dispatch, so the pin now also covers the prep stage."""
     import numpy as np
 
     from pathway_tpu.ops.knn import DeviceKnnIndex
@@ -614,12 +616,12 @@ def test_xla_compile_counter_pins_no_recompile_buckets():
     # warm one variant per k bucket in play (k<=8 -> buckets 4 and 8)
     idx.search(rng.standard_normal((3, 8)), k=4)
     idx.search(rng.standard_normal((3, 8)), k=8)
-    warm = fr.compile_stats().get("knn.topk_search", 0)
+    warm = fr.compile_stats().get("serving.fused_topk", 0)
     assert warm >= 1, "compile counter never observed a compilation"
     for k in (3, 4, 5, 6, 7, 8):
         for q in (1, 2, 5, 8):
             idx.search(rng.standard_normal((q, 8)), k=k)
-    assert fr.compile_stats().get("knn.topk_search", 0) == warm, (
+    assert fr.compile_stats().get("serving.fused_topk", 0) == warm, (
         "a bucketed (Q, k) combination recompiled — the no-recompile "
         "guarantee regressed"
     )

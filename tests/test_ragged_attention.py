@@ -343,10 +343,12 @@ def test_encode_padded_ragged_keeps_contract(golden):
     np.testing.assert_array_equal(arr[3:], 0.0)
 
 
-def test_fused_serving_tick_parity_with_ragged_impl(golden):
+def test_fused_serving_tick_parity_with_ragged_impl(golden, monkeypatch):
     """The serving tick's device handoff must work unchanged with
     attention_impl='ragged': ONE ragged launch, a DEVICE array handed to
-    the search, results identical to the host path."""
+    the search, results identical to the host path.  The wire dtype is
+    bf16 by default now — the handoff is bf16-close to the host path and
+    bit-close under the f32 opt-out."""
     from pathway_tpu.ops.knn import DeviceKnnIndex
     from pathway_tpu.xpacks.llm._scheduler import (
         _batch_embed,
@@ -359,11 +361,19 @@ def test_fused_serving_tick_parity_with_ragged_impl(golden):
     texts = [f"query about item {i}" for i in range(3)]
     dev = _batch_embed_device(embedder, texts)
     assert isinstance(dev, jax.Array) and not isinstance(dev, np.ndarray)
+    assert dev.dtype == jnp.bfloat16
     assert dev.shape[0] >= len(texts)
     host = _batch_embed(embedder, texts)
     np.testing.assert_allclose(
-        np.asarray(dev, np.float32)[: len(texts)], host, atol=1e-5
+        np.asarray(dev, np.float32)[: len(texts)], host, atol=2e-2
     )
+    monkeypatch.setenv("PATHWAY_SERVING_WIRE_DTYPE", "f32")
+    dev_f32 = _batch_embed_device(embedder, texts)
+    assert dev_f32.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(dev_f32, np.float32)[: len(texts)], host, atol=1e-5
+    )
+    monkeypatch.delenv("PATHWAY_SERVING_WIRE_DTYPE")
 
     idx = DeviceKnnIndex(dim=enc.dim, capacity=64)
     rng = np.random.default_rng(2)

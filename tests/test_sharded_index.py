@@ -292,10 +292,12 @@ def test_runtime_sharded_ticks_under_interactive_and_bulk_contention():
     ]
 
 
-def test_fused_embed_handoff_stays_on_device():
+def test_fused_embed_handoff_stays_on_device(monkeypatch):
     """The serving tick's embed half must hand the search a DEVICE array
     (no D2H/H2D round trip), and that array must search identically to
-    the host-path embeddings."""
+    the host-path embeddings.  Under the bf16-on-the-wire serving
+    default the handoff is bf16 (ranking preserved, scores within
+    bf16 input rounding); the f32 opt-out restores exact equality."""
     from pathway_tpu.xpacks.llm._scheduler import (
         _batch_embed,
         _batch_embed_device,
@@ -307,6 +309,7 @@ def test_fused_embed_handoff_stays_on_device():
     texts = [f"query about item {i}" for i in range(3)]
     dev = _batch_embed_device(embedder, texts)
     assert isinstance(dev, jax.Array) and not isinstance(dev, np.ndarray)
+    assert dev.dtype == jnp.bfloat16  # bf16-on-the-wire serving default
     assert dev.shape[0] >= len(texts)  # dispatch pads ride along
     host = _batch_embed(embedder, texts)
 
@@ -320,6 +323,15 @@ def test_fused_embed_handoff_stays_on_device():
         [k for k, _ in row] for row in r_host
     ]
     for row_d, row_h in zip(r_dev, r_host):
+        for (_, a), (_, b) in zip(row_d, row_h):
+            assert a == pytest.approx(b, abs=2e-2)
+
+    # PATHWAY_SERVING_WIRE_DTYPE=f32 opt-out: the handoff is exact again
+    monkeypatch.setenv("PATHWAY_SERVING_WIRE_DTYPE", "f32")
+    dev32 = _batch_embed_device(embedder, texts)
+    assert dev32.dtype == jnp.float32
+    r_dev32 = idx.search(dev32, 4)[: len(texts)]
+    for row_d, row_h in zip(r_dev32, r_host):
         for (_, a), (_, b) in zip(row_d, row_h):
             assert a == pytest.approx(b, abs=1e-6)
 
